@@ -1,0 +1,50 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle Fluid (reference mounted at /root/reference).
+
+The user-facing API mirrors ``paddle.fluid``:
+
+    import paddle_tpu.fluid as fluid
+    x = fluid.layers.data('x', [784])
+    y = fluid.layers.fc(x, 10, act='softmax')
+    ...
+    exe = fluid.Executor(fluid.TPUPlace(0))
+
+Design: a Python graph IR (framework.py) lowers wholesale into single
+jitted XLA modules (core/lowering.py, executor.py); distributed training
+uses jax.sharding meshes + GSPMD instead of NCCL rings (parallel/).
+"""
+from paddle_tpu import framework
+from paddle_tpu.framework import (
+    CPUPlace,
+    CUDAPlace,
+    Place,
+    Program,
+    TPUPlace,
+    cpu_places,
+    default_main_program,
+    default_startup_program,
+    in_dygraph_mode,
+    name_scope,
+    program_guard,
+)
+from paddle_tpu.executor import Executor
+from paddle_tpu.scope import Scope, global_scope, scope_guard
+
+from paddle_tpu import (
+    backward,
+    clip,
+    initializer,
+    layers,
+    metrics,
+    optimizer,
+    regularizer,
+    unique_name,
+)
+from paddle_tpu.backward import append_backward, gradients
+from paddle_tpu.param_attr import ParamAttr, WeightNormParamAttr
+
+__version__ = "0.1.0"
+
+
+def CUDAPinnedPlace():  # API parity shim
+    return CPUPlace()

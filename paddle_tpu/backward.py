@@ -1,0 +1,295 @@
+"""Static autodiff: append_backward.
+
+Reference: python/paddle/fluid/backward.py:558 — walks forward ops in
+reverse, asks each op's C++ grad-maker for grad op descs
+(core.get_grad_op_desc), renames and sums duplicated gradient
+contributions (_addup_repetitive_outputs_:135), prunes branches that do
+not need grad (:211).
+
+TPU-native twist: the default grad "maker" emits a single ``<type>_grad``
+op whose kernel is derived from the forward kernel via ``jax.vjp``
+(core/registry.py make_vjp_grad_kernel) — per-op hand-written grad kernels
+(the reference's *_grad CUDA kernels) are unnecessary because XLA
+differentiates and fuses the recomputation.  Custom grad makers can still
+be registered per-op for ops whose grads need special structure.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set
+
+import numpy as np
+
+from paddle_tpu import framework
+from paddle_tpu.core import registry
+from paddle_tpu.core import types as core_types
+from paddle_tpu.framework import Operator, Parameter, Variable, grad_var_name
+
+__all__ = ["append_backward", "gradients"]
+
+
+def _is_float_var(v: Variable) -> bool:
+    return core_types.is_float_dtype(v.dtype)
+
+
+def _requires_grad_vars(block, extra_no_grad: Set[str]) -> Set[str]:
+    """Forward sweep: which vars can carry gradient back to a trainable leaf."""
+    req: Set[str] = set()
+    for v in block.vars.values():
+        if v.name in extra_no_grad:
+            continue
+        if isinstance(v, Parameter) and v.trainable:
+            req.add(v.name)
+        elif not v.stop_gradient and v.op is None and _is_float_var(v):
+            # explicitly created leaf (incl. data vars with stop_gradient=False)
+            req.add(v.name)
+    for op in block.ops:
+        try:
+            opdef = registry.get_op(op.type)
+        except KeyError:
+            continue
+        if not opdef.differentiable:
+            continue
+        feeds_grad = False
+        for slot, names in op.inputs.items():
+            if slot in opdef.no_grad_set:
+                continue
+            if any(n in req for n in names):
+                feeds_grad = True
+                break
+        if feeds_grad:
+            for names in op.outputs.values():
+                for n in names:
+                    v = block._find_var_recursive(n)
+                    if v is not None and _is_float_var(v) and n not in extra_no_grad:
+                        req.add(n)
+    return req
+
+
+def _make_grad_op_descs(op: Operator, opdef, out_grad_names: Dict[str, str], req: Set[str]):
+    """Build the generic vjp grad-op desc for ``op``.
+
+    ``out_grad_names``: forward output var name -> its (aggregated) grad var.
+    Returns (inputs, outputs, attrs, grad_in_to_fwd_in) for one grad op.
+    """
+    g_inputs: Dict[str, List[str]] = {}
+    for slot, names in op.inputs.items():
+        g_inputs[slot] = list(names)
+    grad_out_slots = []
+    for slot, names in op.outputs.items():
+        gnames = [out_grad_names.get(n) for n in names]
+        if any(g is not None for g in gnames):
+            g_inputs[slot + "@GRAD"] = [g if g is not None else registry.EMPTY_VAR_NAME for g in gnames]
+            grad_out_slots.append(slot)
+    g_outputs: Dict[str, List[str]] = {}
+    want_slots = []
+    for slot, names in op.inputs.items():
+        if slot in opdef.no_grad_set:
+            continue
+        outs = []
+        any_real = False
+        for n in names:
+            v = op.block._find_var_recursive(n)
+            if v is not None and n in req and _is_float_var(v):
+                outs.append(n)  # placeholder; caller renames to grad var
+                any_real = True
+            else:
+                outs.append(None)
+        if any_real:
+            g_outputs[slot + "@GRAD"] = outs
+            want_slots.append(slot)
+    attrs = dict(op.attrs)
+    attrs["__fwd_output_slots__"] = tuple(op.outputs.keys())
+    attrs["__grad_input_slots__"] = tuple(want_slots)
+    attrs["op_role"] = "backward"
+    return g_inputs, g_outputs, attrs
+
+
+def append_backward(
+    loss: Variable,
+    parameter_list: Optional[Sequence] = None,
+    no_grad_set: Optional[Set[str]] = None,
+    callbacks=None,
+):
+    """Append grad ops for ``loss`` to its program; return [(param, grad)].
+
+    Matches the reference contract (backward.py:558): loss must be a scalar
+    (or shape-[1]) var in the main program's global block.
+    """
+    block = loss.block
+    program = block.program
+    extra_no_grad = set(no_grad_set or ())
+    for v in program.list_vars():
+        if v.stop_gradient and not isinstance(v, Parameter):
+            extra_no_grad.add(v.name)
+        if isinstance(v, Parameter) and not v.trainable:
+            extra_no_grad.add(v.name)
+    extra_no_grad.discard(loss.name)
+
+    req = _requires_grad_vars(block, extra_no_grad - {loss.name})
+    if loss.name not in req:
+        raise ValueError(
+            "loss %r does not depend on any trainable parameter" % loss.name
+        )
+
+    # locate the op producing the loss
+    loss_op_idx = None
+    for i in reversed(range(len(block.ops))):
+        if loss.name in block.ops[i].output_arg_names:
+            loss_op_idx = i
+            break
+    if loss_op_idx is None:
+        raise ValueError("loss %r is not produced by any op" % loss.name)
+
+    # init d(loss)/d(loss) = 1
+    loss_grad = grad_var_name(loss.name)
+    block.create_var(
+        name=loss_grad, shape=loss.shape or (1,), dtype=loss.dtype, stop_gradient=True
+    )
+    block.append_op(
+        type="fill_constant",
+        outputs={"Out": [loss_grad]},
+        attrs={
+            "shape": list(loss.shape or (1,)),
+            "value": 1.0,
+            "dtype": loss.dtype,
+            "op_role": "backward",
+        },
+    )
+
+    # reverse walk, accumulating grad contributions per forward var
+    contributions: Dict[str, List[str]] = {loss.name: [loss_grad]}
+    finalized: Dict[str, str] = {}
+
+    def aggregate(name: str) -> Optional[str]:
+        """Sum multiple grad contributions (reference backward.py:135)."""
+        if name in finalized:
+            return finalized[name]
+        contribs = contributions.get(name)
+        if not contribs:
+            return None
+        if len(contribs) == 1:
+            finalized[name] = contribs[0]
+            return contribs[0]
+        gname = grad_var_name(name)
+        if gname in (c for c in contribs):
+            gname = gname + "@SUM"
+        fv = block._find_var_recursive(name)
+        block.create_var(name=gname, shape=fv.shape if fv else None, dtype=fv.dtype if fv else "float32", stop_gradient=True)
+        block.append_op(
+            type="sum",
+            inputs={"X": contribs},
+            outputs={"Out": [gname]},
+            attrs={"op_role": "backward"},
+        )
+        finalized[name] = gname
+        return gname
+
+    def add_contribution(fwd_name: str, grad_name: str):
+        contributions.setdefault(fwd_name, []).append(grad_name)
+
+    fwd_ops = list(block.ops[: loss_op_idx + 1])
+    for op in reversed(fwd_ops):
+        try:
+            opdef = registry.get_op(op.type)
+        except KeyError:
+            continue
+        if not opdef.differentiable:
+            continue
+        # does any output carry grad?
+        out_has_grad = any(n in contributions for n in op.output_arg_names)
+        if not out_has_grad:
+            continue
+        in_needs_grad = any(
+            n in req and n not in extra_no_grad
+            for slot, names in op.inputs.items()
+            if slot not in opdef.no_grad_set
+            for n in names
+        )
+        if not in_needs_grad:
+            continue
+
+        out_grad_names = {}
+        for n in op.output_arg_names:
+            g = aggregate(n)
+            if g is not None:
+                out_grad_names[n] = g
+
+        if opdef.grad_maker is not None:
+            descs = opdef.grad_maker(op, block, out_grad_names, req - extra_no_grad)
+            for d in descs:
+                block.append_op(**d)
+                for slot, names in d.get("outputs", {}).items():
+                    if not slot.endswith("@GRAD"):
+                        continue
+            # custom makers register contributions themselves via convention:
+            # each output named grad_var_name(x)+suffix maps back by stripping
+            for d in descs:
+                for slot, names in d.get("outputs", {}).items():
+                    if not slot.endswith("@GRAD"):
+                        continue
+                    for gn in names:
+                        if gn and gn != registry.EMPTY_VAR_NAME:
+                            base = gn.split("@GRAD")[0]
+                            add_contribution(base, gn)
+            continue
+
+        g_inputs, g_outputs, g_attrs = _make_grad_op_descs(op, opdef, out_grad_names, req - extra_no_grad)
+        if not g_outputs:
+            continue
+        # name grad outputs uniquely, register contributions
+        final_outputs: Dict[str, List[str]] = {}
+        for slot, names in g_outputs.items():
+            outs = []
+            for fwd_name in names:
+                if fwd_name is None:
+                    outs.append(registry.EMPTY_VAR_NAME)
+                    continue
+                base = grad_var_name(fwd_name)
+                k = len(contributions.get(fwd_name, []))
+                gname = base if k == 0 else "%s@RENAME@%d" % (base, k)
+                fv = block._find_var_recursive(fwd_name)
+                block.create_var(
+                    name=gname,
+                    shape=fv.shape if fv else None,
+                    dtype=fv.dtype if fv else "float32",
+                    stop_gradient=True,
+                )
+                add_contribution(fwd_name, gname)
+                outs.append(gname)
+            final_outputs[slot] = outs
+        block.append_op(type=op.type + "_grad", inputs=g_inputs, outputs=final_outputs, attrs=g_attrs)
+
+    # collect (param, grad) pairs
+    if parameter_list is not None:
+        params = [
+            block._find_var_recursive(p) if isinstance(p, str) else p
+            for p in parameter_list
+        ]
+    else:
+        params = [p for p in program.global_block().all_parameters() if p.trainable]
+    result = []
+    for p in params:
+        if p is None or p.name in extra_no_grad:
+            continue
+        g = aggregate(p.name)
+        if g is None:
+            continue
+        gvar = block._find_var_recursive(g)
+        result.append((p, gvar))
+    program.version += 1
+    return result
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference: backward.py:939 — d(targets)/d(inputs)."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if len(targets) != 1:
+        raise NotImplementedError("gradients() currently supports one target")
+    pg = append_backward(targets[0], no_grad_set=no_grad_set, parameter_list=None)
+    block = targets[0].block
+    out = []
+    for iv in inputs:
+        g = block._find_var_recursive(grad_var_name(iv.name))
+        out.append(g)
+    return out

@@ -1,0 +1,81 @@
+"""Block -> single jitted XLA module.
+
+This replaces the reference's interpreted hot loop
+(paddle/fluid/framework/executor.cc:433-437 ``for op in ops: op->Run``)
+with whole-block tracing: every op kernel is a pure JAX function, so the
+entire block — forward, backward, and optimizer update ops — traces into
+ONE XLA computation.  XLA then fuses elementwise chains into the matmuls
+(MXU), assigns buffers (subsuming the reference's memory-reuse passes,
+ir/memory_optimize_pass/), and schedules collectives.  State (persistable
+vars) is threaded functionally and donated, giving in-place param updates.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from paddle_tpu.core import registry
+from paddle_tpu.core.registry import EMPTY_VAR_NAME
+
+__all__ = ["lower_block", "trace_ops"]
+
+
+def trace_ops(ops, env: Dict[str, Any], block=None) -> Dict[str, Any]:
+    """Run (or trace) a sequence of Operators over an env of name->array."""
+    for op in ops:
+        kernel = registry.get_kernel(op.type)
+        ins: Dict[str, List[Any]] = {}
+        for slot, names in op.inputs.items():
+            vals = []
+            for n in names:
+                if n == EMPTY_VAR_NAME:
+                    continue
+                if n not in env:
+                    raise KeyError(
+                        "op %s input %s=%r not produced/fed (block %s)"
+                        % (op.type, slot, n, getattr(block, "idx", "?"))
+                    )
+                vals.append(env[n])
+            if vals:
+                ins[slot] = vals
+        outs = kernel(ins, op.attrs)
+        if outs is None:
+            continue
+        for slot, names in op.outputs.items():
+            vals = outs.get(slot)
+            if vals is None:
+                continue
+            if not isinstance(vals, (list, tuple)):
+                vals = [vals]
+            for n, v in zip(names, vals):
+                if n != EMPTY_VAR_NAME and v is not None:
+                    env[n] = v
+    return env
+
+
+def lower_block(
+    block,
+    feed_names: Sequence[str],
+    fetch_names: Sequence[str],
+    state_names: Sequence[str],
+):
+    """Build ``fn(state_dict, feed_dict) -> (fetch_list, new_state_dict)``.
+
+    * ``state_names``: persistable vars read/written by the block (params,
+      optimizer moments, LR...).  Returned updated so the caller can donate
+      the old buffers.
+    * Non-persistable intermediates never materialize outside XLA.
+    """
+    feed_names = tuple(feed_names)
+    fetch_names = tuple(fetch_names)
+    state_names = tuple(state_names)
+    ops = list(block.ops)
+
+    def fn(state: Dict[str, Any], feed: Dict[str, Any]):
+        env = dict(state)
+        env.update(feed)
+        trace_ops(ops, env, block)
+        fetches = [env[n] for n in fetch_names]
+        new_state = {n: env[n] for n in state_names if n in env}
+        return fetches, new_state
+
+    return fn

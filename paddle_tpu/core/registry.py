@@ -1,0 +1,250 @@
+"""Op registry: each op type maps to a pure JAX kernel + metadata.
+
+TPU-native analog of the reference's OpRegistry/OpInfoMap
+(paddle/fluid/framework/op_registry.h:66, op_info.cc).  Differences by
+design:
+
+* A kernel is a *pure function* ``kernel(inputs, attrs) -> outputs`` over
+  jax arrays — there is no Place/dtype/layout dispatch key
+  (operator.cc:898 ChooseKernel); XLA owns code generation for every
+  backend, so one kernel body serves CPU and TPU.
+* Shape inference defaults to ``jax.eval_shape`` over the kernel itself —
+  the kernel *is* the InferShape function (reference keeps separate
+  compile/runtime InferShape, shape_inference.h).
+* Grad op makers (grad_op_desc_maker.h) default to a generic ``jax.vjp``
+  maker: the grad op re-runs the forward kernel under vjp.  Inside one
+  jitted module XLA CSE dedups the recomputation, and where it doesn't,
+  the recompute acts as rematerialisation — an HBM win on TPU.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set
+
+import numpy as np
+
+__all__ = ["OpDef", "register_op", "get_op", "has_op", "infer_shape", "get_kernel"]
+
+# inputs: Dict[slot, List[jax.Array]]; returns Dict[slot, List[jax.Array]] or
+# Dict[slot, jax.Array] (normalized to lists by the lowering).
+KernelFn = Callable[[Dict[str, List[Any]], Dict[str, Any]], Dict[str, Any]]
+
+GRAD_SLOT_SUFFIX = "@GRAD"
+# output name used by grad makers for inputs that need no gradient
+EMPTY_VAR_NAME = "@EMPTY@"
+
+_REGISTRY: Dict[str, "OpDef"] = {}
+
+
+class OpDef:
+    def __init__(
+        self,
+        type: str,
+        kernel: Optional[KernelFn],
+        infer_shape: Optional[Callable] = None,
+        grad_maker: Optional[Callable] = None,
+        no_grad_set: Optional[Set[str]] = None,
+        differentiable: bool = True,
+        stateful_outputs: Sequence[str] = (),
+    ):
+        self.type = type
+        self.kernel = kernel
+        self.custom_infer_shape = infer_shape
+        self.grad_maker = grad_maker
+        # input slots that never receive a gradient (e.g. integer Ids)
+        self.no_grad_set = set(no_grad_set or ())
+        self.differentiable = differentiable
+        # output slots that alias an input (in-place optimizer updates)
+        self.stateful_outputs = tuple(stateful_outputs)
+
+
+def register_op(
+    type: str,
+    infer_shape: Optional[Callable] = None,
+    grad_maker: Optional[Callable] = None,
+    no_grad_set: Optional[Set[str]] = None,
+    differentiable: bool = True,
+    stateful_outputs: Sequence[str] = (),
+):
+    """Decorator: ``@register_op("relu")`` over the kernel function."""
+
+    def deco(kernel: KernelFn):
+        _REGISTRY[type] = OpDef(
+            type,
+            kernel,
+            infer_shape=infer_shape,
+            grad_maker=grad_maker,
+            no_grad_set=no_grad_set,
+            differentiable=differentiable,
+            stateful_outputs=stateful_outputs,
+        )
+        return kernel
+
+    return deco
+
+
+def has_op(type: str) -> bool:
+    _ensure_ops_loaded()
+    return type in _REGISTRY or (type.endswith("_grad") and type[: -len("_grad")] in _REGISTRY)
+
+
+def get_op(type: str) -> OpDef:
+    _ensure_ops_loaded()
+    if type in _REGISTRY:
+        return _REGISTRY[type]
+    if type.endswith("_grad"):
+        base = _REGISTRY.get(type[: -len("_grad")])
+        if base is not None and base.kernel is not None:
+            opdef = OpDef(type, make_vjp_grad_kernel(base))
+            _REGISTRY[type] = opdef
+            return opdef
+    raise KeyError("op %r is not registered" % type)
+
+
+def get_kernel(type: str) -> KernelFn:
+    k = get_op(type).kernel
+    if k is None:
+        raise KeyError("op %r has no kernel (structural op?)" % type)
+    return k
+
+
+_ops_loaded = False
+
+
+def _ensure_ops_loaded():
+    global _ops_loaded
+    if not _ops_loaded:
+        _ops_loaded = True
+        import paddle_tpu.ops  # noqa: F401  (registers all builtin ops)
+
+
+# ---------------------------------------------------------------------------
+# Generic vjp-based grad kernel (the DefaultGradOpDescMaker analog,
+# reference: paddle/fluid/framework/grad_op_desc_maker.h)
+# ---------------------------------------------------------------------------
+def _is_float(x) -> bool:
+    return np.issubdtype(np.asarray(x).dtype if not hasattr(x, "dtype") else x.dtype, np.floating) or str(
+        getattr(x, "dtype", "")
+    ) == "bfloat16"
+
+
+def make_vjp_grad_kernel(fwd: OpDef) -> KernelFn:
+    """Build the kernel for ``<type>_grad``.
+
+    Grad-op slot convention (mirrors the reference's grad op descs):
+      inputs  = forward inputs (same slots) + forward outputs (same slots)
+                + ``<out_slot>@GRAD`` for each forward output
+      outputs = ``<in_slot>@GRAD`` for each differentiable forward input
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def kernel(inputs: Dict[str, List[Any]], attrs: Dict[str, Any]) -> Dict[str, Any]:
+        fwd_inputs = {
+            slot: vals
+            for slot, vals in inputs.items()
+            if not slot.endswith(GRAD_SLOT_SUFFIX) and slot not in attrs.get("__fwd_output_slots__", ())
+        }
+        out_grads = {
+            slot[: -len(GRAD_SLOT_SUFFIX)]: vals
+            for slot, vals in inputs.items()
+            if slot.endswith(GRAD_SLOT_SUFFIX)
+        }
+        want_slots = [s for s in attrs.get("__grad_input_slots__", fwd_inputs.keys())]
+        # split differentiable vs static inputs
+        diff = {}
+        for slot in want_slots:
+            if slot in fwd.no_grad_set or slot not in fwd_inputs:
+                continue
+            vals = fwd_inputs[slot]
+            if all(_is_float(v) for v in vals):
+                diff[slot] = vals
+        static = {s: v for s, v in fwd_inputs.items() if s not in diff}
+        fwd_attrs = {k: v for k, v in attrs.items() if not k.startswith("__")}
+
+        def f(diff_vals):
+            all_in = dict(static)
+            all_in.update(diff_vals)
+            outs = fwd.kernel(all_in, fwd_attrs)
+            outs = {k: v if isinstance(v, (list, tuple)) else [v] for k, v in outs.items()}
+            return {k: list(v) for k, v in outs.items() if k in out_grads}
+
+        primals, vjp_fn = jax.vjp(f, diff)
+        def conform(g, v):
+            if g is None:
+                return jnp.zeros(v.shape, v.dtype)
+            g = jnp.asarray(g)
+            if g.shape != v.shape:
+                g = g.reshape(v.shape)
+            return g.astype(v.dtype)
+
+        cots = {}
+        for slot, vals in primals.items():
+            gs = out_grads.get(slot)
+            cots[slot] = [conform(g, v) for v, g in zip(vals, (gs or [None] * len(vals)))]
+        (in_grads,) = vjp_fn(cots)
+        result = {}
+        for slot, gvals in in_grads.items():
+            # cast back: vjp returns grads in primal dtype already
+            result[slot + GRAD_SLOT_SUFFIX] = list(gvals)
+        return result
+
+    return kernel
+
+
+# ---------------------------------------------------------------------------
+# Compile-time shape inference via abstract evaluation
+# ---------------------------------------------------------------------------
+_DUMMY_BATCH = 117  # stand-in for -1 dims during eval_shape; mapped back after
+
+
+def infer_shape(op, block) -> None:
+    """Set output var shapes/dtypes by abstractly evaluating the kernel.
+
+    The reference maintains hand-written InferShape per op
+    (shape_inference.h); here ``jax.eval_shape`` over the kernel gives the
+    same answer for free.  Ops may override via ``infer_shape=`` at
+    registration (e.g. ops whose output shape depends on attr-only info).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        opdef = get_op(op.type)
+    except KeyError:
+        return
+    if opdef.custom_infer_shape is not None:
+        opdef.custom_infer_shape(op, block)
+        return
+    if opdef.kernel is None:
+        return
+    specs: Dict[str, List[Any]] = {}
+    for slot, names in op.inputs.items():
+        lst = []
+        for n in names:
+            if n == EMPTY_VAR_NAME:
+                continue
+            v = block.var(n)
+            if v.shape is None:
+                return  # cannot infer
+            shape = tuple(_DUMMY_BATCH if s == -1 else s for s in v.shape)
+            lst.append(jax.ShapeDtypeStruct(shape, jnp.dtype(v.dtype) if v.dtype != "bfloat16" else jnp.bfloat16))
+        specs[slot] = lst
+    try:
+        out = jax.eval_shape(lambda ins: opdef.kernel(ins, op.attrs), specs)
+    except Exception:
+        return  # kernel needs concrete values; leave shapes unset
+    for slot, names in op.outputs.items():
+        vals = out.get(slot)
+        if vals is None:
+            continue
+        if not isinstance(vals, (list, tuple)):
+            vals = [vals]
+        for n, sd in zip(names, vals):
+            if n == EMPTY_VAR_NAME:
+                continue
+            v = block._find_var_recursive(n)
+            if v is None:
+                continue
+            v.shape = tuple(-1 if s == _DUMMY_BATCH else int(s) for s in sd.shape)
+            v.dtype = str(sd.dtype) if str(sd.dtype) != "bfloat16" else "bfloat16"

@@ -1,0 +1,58 @@
+"""Var/data type enums (reference: paddle/fluid/framework/framework.proto:105-160)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class VarType:
+    LOD_TENSOR = 7
+    SELECTED_ROWS = 8
+    FEED_MINIBATCH = 9
+    FETCH_LIST = 10
+    STEP_SCOPES = 11
+    LOD_RANK_TABLE = 12
+    LOD_TENSOR_ARRAY = 13
+    READER = 15
+    RAW = 17
+
+
+_DTYPE_ALIASES = {
+    "float32": "float32",
+    "fp32": "float32",
+    "float": "float32",
+    "float64": "float64",
+    "fp64": "float64",
+    "double": "float64",
+    "float16": "float16",
+    "fp16": "float16",
+    "bfloat16": "bfloat16",
+    "bf16": "bfloat16",
+    "int8": "int8",
+    "uint8": "uint8",
+    "int16": "int16",
+    "int32": "int32",
+    "int": "int32",
+    "int64": "int64",
+    "bool": "bool",
+}
+
+
+def canonical_dtype(dtype) -> str:
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key in _DTYPE_ALIASES:
+            return _DTYPE_ALIASES[key]
+    return str(np.dtype(dtype))
+
+
+def np_dtype(dtype) -> np.dtype:
+    d = canonical_dtype(dtype)
+    if d == "bfloat16":
+        import jax.numpy as jnp
+
+        return np.dtype(jnp.bfloat16)
+    return np.dtype(d)
+
+
+def is_float_dtype(dtype) -> bool:
+    return canonical_dtype(dtype) in ("float16", "bfloat16", "float32", "float64")

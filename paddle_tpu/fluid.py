@@ -1,0 +1,7 @@
+"""``paddle_tpu.fluid`` — alias namespace so reference-style scripts
+(``import paddle.fluid as fluid``) port by changing one import line."""
+import sys
+
+import paddle_tpu
+
+sys.modules[__name__] = paddle_tpu

@@ -1,0 +1,8 @@
+"""Builtin op library — importing this package registers all kernels."""
+from paddle_tpu.ops import math_ops  # noqa: F401
+from paddle_tpu.ops import tensor_ops  # noqa: F401
+from paddle_tpu.ops import nn_ops  # noqa: F401
+from paddle_tpu.ops import optimizer_ops  # noqa: F401
+from paddle_tpu.ops import metric_ops  # noqa: F401
+from paddle_tpu.ops import sequence_ops  # noqa: F401
+from paddle_tpu.ops import collective_ops  # noqa: F401

@@ -1,0 +1,135 @@
+"""Collective ops: c_allreduce_* / c_broadcast / c_allgather / c_reducescatter.
+
+Reference: paddle/fluid/operators/collective/c_allreduce_op.h:57-110 and
+friends — CUDA kernels calling ncclAllReduce on a ring_id-keyed comm.
+TPU-native: these lower to XLA collectives (lax.psum / all_gather /
+psum_scatter / ppermute) over a named mesh axis, compiled into the same
+module as the compute so XLA can overlap them with the MXU work on ICI.
+The ring_id -> NCCLCommContext registry maps to axis *names* bound by
+shard_map in paddle_tpu/parallel/ (see parallel/env.py).  Outside any
+mapped axis the ring has size 1 and each op is the identity — matching
+the reference's single-trainer behavior.
+"""
+from __future__ import annotations
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import one
+from paddle_tpu.parallel import env as penv
+
+
+def _axis(attrs):
+    name = attrs.get("axis_name")
+    if name is None:
+        name = penv.axis_for_ring(attrs.get("ring_id", 0))
+    return name if penv.axis_active(name) else None
+
+
+def _allreduce(op_name, reduce_fn_name):
+    @register_op(op_name, differentiable=False)
+    def kernel(inputs, attrs, _red=reduce_fn_name):
+        import jax
+
+        x = one(inputs, "X")
+        ax = _axis(attrs)
+        if ax is None:
+            return {"Out": x}
+        fn = getattr(jax.lax, _red)
+        return {"Out": fn(x, axis_name=ax)}
+
+    return kernel
+
+
+_allreduce("c_allreduce_sum", "psum")
+_allreduce("c_allreduce_max", "pmax")
+_allreduce("c_allreduce_min", "pmin")
+
+
+@register_op("c_allreduce_prod", differentiable=False)
+def c_allreduce_prod(inputs, attrs):
+    import jax
+    import jax.numpy as jnp
+
+    x = one(inputs, "X")
+    ax = _axis(attrs)
+    if ax is None:
+        return {"Out": x}
+    return {"Out": jnp.exp(jax.lax.psum(jnp.log(x), axis_name=ax))}
+
+
+@register_op("allreduce", differentiable=False)
+def allreduce(inputs, attrs):
+    # legacy nccl-style allreduce op (reference: operators/distributed_ops/allreduce_op.cc)
+    return _sum_impl(inputs, attrs)
+
+
+def _sum_impl(inputs, attrs):
+    import jax
+
+    x = one(inputs, "X")
+    ax = _axis(attrs)
+    if ax is None:
+        return {"Out": x}
+    return {"Out": jax.lax.psum(x, axis_name=ax)}
+
+
+@register_op("c_broadcast", differentiable=False)
+def c_broadcast(inputs, attrs):
+    import jax
+
+    x = one(inputs, "X")
+    ax = _axis(attrs)
+    if ax is None:
+        return {"Out": x}
+    root = attrs.get("root", 0)
+    # broadcast = select root's shard on every member
+    idx = jax.lax.axis_index(ax)
+    masked = jax.numpy.where(idx == root, x, jax.numpy.zeros_like(x))
+    return {"Out": jax.lax.psum(masked, axis_name=ax)}
+
+
+@register_op("c_allgather", differentiable=False)
+def c_allgather(inputs, attrs):
+    import jax
+
+    x = one(inputs, "X")
+    ax = _axis(attrs)
+    if ax is None:
+        return {"Out": x}
+    g = jax.lax.all_gather(x, axis_name=ax)  # [nranks, ...]
+    return {"Out": g.reshape((-1,) + tuple(x.shape[1:]))}
+
+
+@register_op("c_reducescatter", differentiable=False)
+def c_reducescatter(inputs, attrs):
+    import jax
+
+    x = one(inputs, "X")
+    ax = _axis(attrs)
+    if ax is None:
+        return {"Out": x}
+    return {"Out": jax.lax.psum_scatter(x, axis_name=ax, tiled=True)}
+
+
+@register_op("c_sync_calc_stream", differentiable=False)
+def c_sync_calc_stream(inputs, attrs):
+    # XLA's dataflow ordering subsumes stream sync (reference:
+    # collective/c_sync_calc_stream_op.cc) — identity.
+    return {"Out": one(inputs, "X")}
+
+
+@register_op("c_sync_comm_stream", differentiable=False)
+def c_sync_comm_stream(inputs, attrs):
+    return {"Out": one(inputs, "X")}
+
+
+@register_op("c_comm_init", differentiable=False)
+def c_comm_init(inputs, attrs):
+    # comm setup is handled by jax.distributed / mesh construction; no-op.
+    return {}
+
+
+@register_op("c_gen_nccl_id", differentiable=False)
+def c_gen_nccl_id(inputs, attrs):
+    # TPU runtime performs its own bootstrap (no ncclUniqueId exchange,
+    # reference: collective/c_gen_nccl_id_op.cc); no-op.
+    return {}

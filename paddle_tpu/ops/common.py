@@ -1,0 +1,30 @@
+"""Shared helpers for op kernels."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def one(inputs, slot, default=None):
+    vals = inputs.get(slot)
+    if not vals:
+        return default
+    return vals[0]
+
+
+def maybe(inputs, slot):
+    vals = inputs.get(slot)
+    return vals[0] if vals else None
+
+
+def jdtype(dtype_str):
+    import jax.numpy as jnp
+
+    if dtype_str in ("bfloat16", "bf16"):
+        return jnp.bfloat16
+    return np.dtype(dtype_str)
+
+
+def prng(seed: int):
+    import jax
+
+    return jax.random.key(np.uint32(seed if seed else 12345))

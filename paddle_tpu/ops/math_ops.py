@@ -1,0 +1,240 @@
+"""Math ops: matmul/mul, broadcasting elementwise, reductions, scale, sum.
+
+Reference kernels: paddle/fluid/operators/matmul_op.cc, mul_op.cc,
+operators/elementwise/*, operators/reduce_ops/*.  Here each is a pure JAX
+function; XLA maps matmuls onto the MXU and fuses the elementwise chains
+(the reference needed hand-written fused_elemwise_activation kernels,
+operators/fused/ — XLA does this automatically).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import one
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# matmul / mul
+# ---------------------------------------------------------------------------
+@register_op("matmul")
+def matmul(inputs, attrs):
+    jnp = _jnp()
+    x, y = one(inputs, "X"), one(inputs, "Y")
+    tx, ty = attrs.get("transpose_X", False), attrs.get("transpose_Y", False)
+    alpha = attrs.get("alpha", 1.0)
+    if tx:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if ty:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": out}
+
+
+@register_op("mul")
+def mul(inputs, attrs):
+    """FC matmul: flattens X/Y to 2-D (reference: mul_op.cc)."""
+    jnp = _jnp()
+    x, y = one(inputs, "X"), one(inputs, "Y")
+    xnc = attrs.get("x_num_col_dims", 1)
+    ync = attrs.get("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((int(np.prod(xs[:xnc])), int(np.prod(xs[xnc:]))))
+    y2 = y.reshape((int(np.prod(ys[:ync])), int(np.prod(ys[ync:]))))
+    out = x2 @ y2
+    return {"Out": out.reshape(tuple(xs[:xnc]) + tuple(ys[ync:]))}
+
+
+# ---------------------------------------------------------------------------
+# elementwise with axis-based broadcasting (reference: elementwise_op_function.h:
+# Y's dims align to X starting at `axis`)
+# ---------------------------------------------------------------------------
+def _bcast_y(x, y, attrs):
+    jnp = _jnp()
+    axis = attrs.get("axis", -1)
+    if x.ndim == y.ndim or y.ndim == 0:
+        return y
+    if axis == -1:
+        axis = x.ndim - y.ndim
+    shape = [1] * x.ndim
+    for i, s in enumerate(y.shape):
+        shape[axis + i] = s
+    return y.reshape(shape)
+
+
+def _ew(name, fn):
+    @register_op(name)
+    def kernel(inputs, attrs, _fn=fn):
+        x, y = one(inputs, "X"), one(inputs, "Y")
+        return {"Out": _fn(x, _bcast_y(x, y, attrs))}
+
+    return kernel
+
+
+_ew("elementwise_add", lambda x, y: x + y)
+_ew("elementwise_sub", lambda x, y: x - y)
+_ew("elementwise_mul", lambda x, y: x * y)
+_ew("elementwise_div", lambda x, y: x / y)
+_ew("elementwise_min", lambda x, y: _jnp().minimum(x, y))
+_ew("elementwise_max", lambda x, y: _jnp().maximum(x, y))
+_ew("elementwise_pow", lambda x, y: x**y)
+_ew("elementwise_mod", lambda x, y: x % y)
+_ew("elementwise_floordiv", lambda x, y: x // y)
+
+
+# ---------------------------------------------------------------------------
+# scale / sum / clip
+# ---------------------------------------------------------------------------
+@register_op("scale")
+def scale(inputs, attrs):
+    x = one(inputs, "X")
+    s = attrs.get("scale", 1.0)
+    b = attrs.get("bias", 0.0)
+    after = attrs.get("bias_after_scale", True)
+    out = x * s + b if after else (x + b) * s
+    return {"Out": out.astype(x.dtype)}
+
+
+@register_op("sum")
+def sum_op(inputs, attrs):
+    """N-ary add — the reference's grad-aggregation op (operators/sum_op.cc)."""
+    vals = inputs["X"]
+    out = vals[0]
+    for v in vals[1:]:
+        out = out + v
+    return {"Out": out}
+
+
+@register_op("clip")
+def clip(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "X")
+    return {"Out": jnp.clip(x, attrs.get("min"), attrs.get("max"))}
+
+
+@register_op("clip_by_norm")
+def clip_by_norm(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "X")
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return {"Out": x * (max_norm / jnp.maximum(norm, max_norm))}
+
+
+# ---------------------------------------------------------------------------
+# unary math (reference: operators/activation_op.cc registers these too)
+# ---------------------------------------------------------------------------
+def _unary(name, fn):
+    @register_op(name)
+    def kernel(inputs, attrs, _fn=fn):
+        return {"Out": _fn(one(inputs, "X"))}
+
+    return kernel
+
+
+_unary("sqrt", lambda x: _jnp().sqrt(x))
+_unary("rsqrt", lambda x: 1.0 / _jnp().sqrt(x))
+_unary("square", lambda x: x * x)
+_unary("exp", lambda x: _jnp().exp(x))
+_unary("log", lambda x: _jnp().log(x))
+_unary("abs", lambda x: _jnp().abs(x))
+_unary("ceil", lambda x: _jnp().ceil(x))
+_unary("floor", lambda x: _jnp().floor(x))
+_unary("round", lambda x: _jnp().round(x))
+_unary("reciprocal", lambda x: 1.0 / x)
+_unary("sign", lambda x: _jnp().sign(x))
+_unary("cos", lambda x: _jnp().cos(x))
+_unary("sin", lambda x: _jnp().sin(x))
+_unary("logsigmoid", lambda x: -_jnp().logaddexp(0.0, -x))
+
+
+@register_op("pow")
+def pow_op(inputs, attrs):
+    return {"Out": one(inputs, "X") ** attrs.get("factor", 1.0)}
+
+
+# ---------------------------------------------------------------------------
+# reductions (reference: operators/reduce_ops/)
+# ---------------------------------------------------------------------------
+def _reduce(name, fn):
+    @register_op(name)
+    def kernel(inputs, attrs, _fn=fn):
+        x = one(inputs, "X")
+        dims = attrs.get("dim", [0])
+        keep = attrs.get("keep_dim", False)
+        if attrs.get("reduce_all", False) or dims is None:
+            axis = None
+        else:
+            if isinstance(dims, int):
+                dims = [dims]
+            axis = tuple(d % x.ndim for d in dims)
+        out = _fn(x, axis, keep)
+        return {"Out": out}
+
+    return kernel
+
+
+_reduce("reduce_sum", lambda x, a, k: _jnp().sum(x, axis=a, keepdims=k))
+_reduce("reduce_mean", lambda x, a, k: _jnp().mean(x, axis=a, keepdims=k))
+_reduce("reduce_max", lambda x, a, k: _jnp().max(x, axis=a, keepdims=k))
+_reduce("reduce_min", lambda x, a, k: _jnp().min(x, axis=a, keepdims=k))
+_reduce("reduce_prod", lambda x, a, k: _jnp().prod(x, axis=a, keepdims=k))
+_reduce("reduce_all", lambda x, a, k: _jnp().all(x, axis=a, keepdims=k))
+_reduce("reduce_any", lambda x, a, k: _jnp().any(x, axis=a, keepdims=k))
+
+
+@register_op("mean")
+def mean(inputs, attrs):
+    return {"Out": _jnp().mean(one(inputs, "X"))}
+
+
+# ---------------------------------------------------------------------------
+# comparisons / logical (reference: operators/controlflow/compare_op.cc)
+# ---------------------------------------------------------------------------
+def _cmp(name, fn):
+    @register_op(name, differentiable=False)
+    def kernel(inputs, attrs, _fn=fn):
+        x, y = one(inputs, "X"), one(inputs, "Y")
+        return {"Out": _fn(x, y)}
+
+    return kernel
+
+
+_cmp("equal", lambda x, y: x == y)
+_cmp("not_equal", lambda x, y: x != y)
+_cmp("less_than", lambda x, y: x < y)
+_cmp("less_equal", lambda x, y: x <= y)
+_cmp("greater_than", lambda x, y: x > y)
+_cmp("greater_equal", lambda x, y: x >= y)
+
+
+def _logical(name, fn, binary=True):
+    @register_op(name, differentiable=False)
+    def kernel(inputs, attrs, _fn=fn, _binary=binary):
+        x = one(inputs, "X")
+        if _binary:
+            return {"Out": _fn(x, one(inputs, "Y"))}
+        return {"Out": _fn(x)}
+
+    return kernel
+
+
+_logical("logical_and", lambda x, y: _jnp().logical_and(x, y))
+_logical("logical_or", lambda x, y: _jnp().logical_or(x, y))
+_logical("logical_xor", lambda x, y: _jnp().logical_xor(x, y))
+_logical("logical_not", lambda x: _jnp().logical_not(x), binary=False)
+
+
+@register_op("isfinite", differentiable=False)
+def isfinite(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "X")
+    return {"Out": jnp.all(jnp.isfinite(x)).reshape(1)}

@@ -1,0 +1,24 @@
+"""Metric ops (reference: paddle/fluid/operators/metrics/accuracy_op.cc, auc_op.cc)."""
+from __future__ import annotations
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import one
+
+
+@register_op("accuracy", differentiable=False)
+def accuracy(inputs, attrs):
+    import jax.numpy as jnp
+
+    # reference semantics: Out is top-k accuracy given Indices from top_k
+    idx = one(inputs, "Indices")
+    label = one(inputs, "Label")
+    if label.ndim == 2 and label.shape[-1] == 1:
+        label = label.squeeze(-1)
+    correct = jnp.any(idx == label[:, None], axis=1)
+    num_correct = jnp.sum(correct.astype("float32"))
+    total = jnp.asarray(float(idx.shape[0]), dtype="float32")
+    return {
+        "Accuracy": (num_correct / total).reshape(1),
+        "Correct": num_correct.astype("int32").reshape(1),
+        "Total": total.astype("int32").reshape(1),
+    }

@@ -1,0 +1,417 @@
+"""NN ops: activations, conv/pool, normalization, losses, dropout, softmax.
+
+Reference kernels: operators/activation_op.cc, conv_op.cc (cuDNN/gemm),
+pool_op.cc, batch_norm_op.cc, layer_norm_op.cc, softmax_op.cc,
+cross_entropy_op.cc, softmax_with_cross_entropy_op.cc, dropout_op.cc.
+Convs lower to lax.conv_general_dilated in NCHW — XLA tiles them onto the
+MXU; there is no cuDNN-style algo selection because XLA owns codegen.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import one, prng
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+def _act(name, fn):
+    @register_op(name)
+    def kernel(inputs, attrs, _fn=fn):
+        return {"Out": _fn(one(inputs, "X"), attrs)}
+
+    return kernel
+
+
+_act("relu", lambda x, a: _jax().nn.relu(x))
+_act("relu6", lambda x, a: _jnp().clip(x, 0.0, a.get("threshold", 6.0)))
+_act("sigmoid", lambda x, a: _jax().nn.sigmoid(x))
+_act("tanh", lambda x, a: _jnp().tanh(x))
+_act("gelu", lambda x, a: _jax().nn.gelu(x, approximate=a.get("approximate", False)))
+_act("leaky_relu", lambda x, a: _jax().nn.leaky_relu(x, a.get("alpha", 0.02)))
+_act("elu", lambda x, a: _jax().nn.elu(x, a.get("alpha", 1.0)))
+_act("softplus", lambda x, a: _jax().nn.softplus(x))
+_act("softsign", lambda x, a: x / (1 + _jnp().abs(x)))
+_act("swish", lambda x, a: x * _jax().nn.sigmoid(a.get("beta", 1.0) * x))
+_act("hard_sigmoid", lambda x, a: _jnp().clip(a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0))
+_act("hard_swish", lambda x, a: x * _jnp().clip(x + a.get("offset", 3.0), 0.0, a.get("threshold", 6.0)) / a.get("scale", 6.0))
+_act("thresholded_relu", lambda x, a: _jnp().where(x > a.get("threshold", 1.0), x, 0.0))
+_act("stanh", lambda x, a: a.get("scale_b", 1.7159) * _jnp().tanh(a.get("scale_a", 0.67) * x))
+_act("soft_relu", lambda x, a: _jnp().log1p(_jnp().exp(_jnp().clip(x, -a.get("threshold", 40.0), a.get("threshold", 40.0)))))
+_act("brelu", lambda x, a: _jnp().clip(x, a.get("t_min", 0.0), a.get("t_max", 24.0)))
+_act("prelu_channel", lambda x, a: x)  # placeholder; prelu op below
+
+
+@register_op("prelu")
+def prelu(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "X")
+    alpha = one(inputs, "Alpha")
+    mode = attrs.get("mode", "all")
+    if mode == "channel":
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return {"Out": jnp.where(x > 0, x, alpha * x)}
+
+
+@register_op("softmax")
+def softmax(inputs, attrs):
+    jax = _jax()
+    x = one(inputs, "X")
+    return {"Out": jax.nn.softmax(x, axis=attrs.get("axis", -1))}
+
+
+@register_op("log_softmax")
+def log_softmax(inputs, attrs):
+    jax = _jax()
+    return {"Out": jax.nn.log_softmax(one(inputs, "X"), axis=attrs.get("axis", -1))}
+
+
+# ---------------------------------------------------------------------------
+# conv / pool
+# ---------------------------------------------------------------------------
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+@register_op("conv2d")
+def conv2d(inputs, attrs):
+    jax = _jax()
+    x = one(inputs, "Input")
+    w = one(inputs, "Filter")
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1)
+    out = jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dilations,
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    b = one(inputs, "Bias")
+    if b is not None:
+        out = out + b.reshape((1, -1, 1, 1))
+    return {"Output": out}
+
+
+@register_op("depthwise_conv2d")
+def depthwise_conv2d(inputs, attrs):
+    attrs = dict(attrs)
+    x = one(inputs, "Input")
+    attrs["groups"] = x.shape[1]
+    return conv2d(inputs, attrs)
+
+
+@register_op("conv2d_transpose")
+def conv2d_transpose(inputs, attrs):
+    jax = _jax()
+    x = one(inputs, "Input")
+    w = one(inputs, "Filter")  # reference layout: [in_c, out_c/groups, kh, kw]
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    out = jax.lax.conv_transpose(
+        x,
+        w,
+        strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+    )
+    return {"Output": out}
+
+
+@register_op("pool2d")
+def pool2d(inputs, attrs):
+    jax = _jax()
+    jnp = _jnp()
+    x = one(inputs, "X")
+    ptype = attrs.get("pooling_type", "max")
+    ksize = _pair(attrs.get("ksize", [2, 2]))
+    strides = _pair(attrs.get("strides", [2, 2]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    if attrs.get("global_pooling", False) or attrs.get("adaptive", False) and tuple(attrs.get("ksize")) == (1, 1):
+        if ptype == "max":
+            return {"Out": jnp.max(x, axis=(2, 3), keepdims=True)}
+        return {"Out": jnp.mean(x, axis=(2, 3), keepdims=True)}
+    window = (1, 1) + ksize
+    strides4 = (1, 1) + strides
+    padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    if ptype == "max":
+        init = -jnp.inf
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides4, padding)
+    else:
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides4, padding)
+        if attrs.get("exclusive", True) and (pads[0] or pads[1]):
+            ones = jnp.ones_like(x)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides4, padding)
+            out = summed / counts
+        else:
+            out = summed / float(ksize[0] * ksize[1])
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+@register_op("batch_norm", no_grad_set={"Mean", "Variance"})
+def batch_norm(inputs, attrs):
+    """reference: operators/batch_norm_op.cc.  Outputs MeanOut/VarianceOut
+    alias the running stats vars; SavedMean/SavedVariance feed the grad."""
+    jnp = _jnp()
+    x = one(inputs, "X")
+    scale = one(inputs, "Scale")
+    bias = one(inputs, "Bias")
+    mean = one(inputs, "Mean")
+    var = one(inputs, "Variance")
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False)
+    layout = attrs.get("data_layout", "NCHW")
+    axes = tuple(i for i in range(x.ndim) if i != (1 if layout == "NCHW" else x.ndim - 1))
+    cshape = tuple(-1 if i == (1 if layout == "NCHW" else x.ndim - 1) else 1 for i in range(x.ndim))
+    if is_test:
+        use_mean, use_var = mean, var
+        saved_mean, saved_var = mean, var
+        new_mean, new_var = mean, var
+    else:
+        use_mean = jnp.mean(x, axis=axes)
+        use_var = jnp.var(x, axis=axes)
+        saved_mean, saved_var = use_mean, use_var
+        new_mean = momentum * mean + (1 - momentum) * use_mean
+        new_var = momentum * var + (1 - momentum) * use_var
+    inv = 1.0 / jnp.sqrt(use_var + eps)
+    y = (x - use_mean.reshape(cshape)) * inv.reshape(cshape) * scale.reshape(cshape) + bias.reshape(cshape)
+    return {
+        "Y": y,
+        "MeanOut": new_mean,
+        "VarianceOut": new_var,
+        "SavedMean": saved_mean,
+        "SavedVariance": saved_var,
+    }
+
+
+@register_op("layer_norm")
+def layer_norm(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "X")
+    scale = one(inputs, "Scale")
+    bias = one(inputs, "Bias")
+    eps = attrs.get("epsilon", 1e-5)
+    begin = attrs.get("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + eps)
+    norm_shape = x.shape[begin:]
+    if scale is not None:
+        y = y * scale.reshape(norm_shape)
+    if bias is not None:
+        y = y + bias.reshape(norm_shape)
+    return {"Y": y, "Mean": mean.squeeze(axes), "Variance": var.squeeze(axes)}
+
+
+@register_op("group_norm")
+def group_norm(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "X")  # NCHW
+    scale = one(inputs, "Scale")
+    bias = one(inputs, "Bias")
+    g = attrs.get("groups", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    y = ((xg - mean) / jnp.sqrt(var + eps)).reshape(x.shape)
+    cshape = (1, c) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(cshape)
+    if bias is not None:
+        y = y + bias.reshape(cshape)
+    return {"Y": y, "Mean": mean.reshape((n, g)), "Variance": var.reshape((n, g))}
+
+
+# ---------------------------------------------------------------------------
+# dropout
+# ---------------------------------------------------------------------------
+@register_op("dropout")
+def dropout(inputs, attrs):
+    jax = _jax()
+    jnp = _jnp()
+    x = one(inputs, "X")
+    p = attrs.get("dropout_prob", 0.5)
+    if attrs.get("is_test", False) or p == 0.0:
+        impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+        out = x * (1.0 - p) if impl == "downgrade_in_infer" and not attrs.get("is_test", False) else x
+        if attrs.get("is_test", False) and impl == "downgrade_in_infer":
+            out = x * (1.0 - p)
+        elif attrs.get("is_test", False):
+            out = x
+        return {"Out": out, "Mask": jnp.ones_like(x)}
+    key = prng(attrs.get("seed", 0))
+    mask = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if impl == "upscale_in_train":
+        out = jnp.where(mask, x / (1.0 - p), 0.0)
+    else:
+        out = jnp.where(mask, x, 0.0)
+    return {"Out": out.astype(x.dtype), "Mask": mask.astype(x.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+@register_op("cross_entropy", no_grad_set={"Label"})
+def cross_entropy(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "X")  # probabilities [..., C]
+    label = one(inputs, "Label")
+    eps = 1e-8
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        if label.ndim == x.ndim and label.shape[-1] == 1:
+            lbl = label.squeeze(-1)
+        else:
+            lbl = label
+        picked = jnp.take_along_axis(x, lbl[..., None].astype("int32"), axis=-1)
+        loss = -jnp.log(picked + eps)
+    return {"Y": loss}
+
+
+@register_op("softmax_with_cross_entropy", no_grad_set={"Label"})
+def softmax_with_cross_entropy(inputs, attrs):
+    jax = _jax()
+    jnp = _jnp()
+    logits = one(inputs, "Logits")
+    label = one(inputs, "Label")
+    axis = attrs.get("axis", -1)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    softmax_out = jnp.exp(logp)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        if label.ndim == logits.ndim and label.shape[axis] == 1:
+            lbl = label.squeeze(axis)
+        else:
+            lbl = label
+        picked = jnp.take_along_axis(logp, lbl[..., None].astype("int32"), axis=axis)
+        loss = -picked
+        if attrs.get("ignore_index", -100) >= 0:
+            ig = attrs["ignore_index"]
+            loss = jnp.where(lbl[..., None] == ig, 0.0, loss)
+    return {"Softmax": softmax_out, "Loss": loss}
+
+
+@register_op("sigmoid_cross_entropy_with_logits", no_grad_set={"Label"})
+def sigmoid_cross_entropy_with_logits(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "X")
+    label = one(inputs, "Label")
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ignore = attrs.get("ignore_index", -100)
+    loss = jnp.where(label == ignore, 0.0, loss)
+    if attrs.get("normalize", False):
+        norm = jnp.maximum(jnp.sum(jnp.where(label != ignore, 1.0, 0.0)), 1.0)
+        loss = loss / norm
+    return {"Out": loss}
+
+
+@register_op("square_error_cost", no_grad_set={"Y"})
+def square_error_cost(inputs, attrs):
+    x, y = one(inputs, "X"), one(inputs, "Y")
+    d = x - y
+    return {"Out": d * d}
+
+
+@register_op("huber_loss", no_grad_set={"Y"})
+def huber_loss(inputs, attrs):
+    jnp = _jnp()
+    x, y = one(inputs, "X"), one(inputs, "Y")
+    delta = attrs.get("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= delta, 0.5 * r * r, delta * (ar - 0.5 * delta))
+    return {"Out": loss, "Residual": r}
+
+
+@register_op("smooth_l1_loss", no_grad_set={"Y"})
+def smooth_l1_loss(inputs, attrs):
+    jnp = _jnp()
+    x, y = one(inputs, "X"), one(inputs, "Y")
+    sigma2 = attrs.get("sigma", 1.0) ** 2
+    d = x - y
+    ad = jnp.abs(d)
+    out = jnp.where(ad < 1.0 / sigma2, 0.5 * d * d * sigma2, ad - 0.5 / sigma2)
+    return {"Out": jnp.sum(out, axis=tuple(range(1, out.ndim)), keepdims=True).reshape((x.shape[0], 1)), "Diff": d}
+
+
+@register_op("log_loss", no_grad_set={"Labels"})
+def log_loss(inputs, attrs):
+    jnp = _jnp()
+    p = one(inputs, "Predicted")
+    y = one(inputs, "Labels")
+    eps = attrs.get("epsilon", 1e-4)
+    return {"Loss": -y * jnp.log(p + eps) - (1 - y) * jnp.log(1 - p + eps)}
+
+
+# ---------------------------------------------------------------------------
+# matmul-adjacent nn pieces
+# ---------------------------------------------------------------------------
+@register_op("l2_normalize")
+def l2_normalize(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "X")
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True) + eps)
+    return {"Out": x / norm, "Norm": norm}
+
+
+@register_op("norm")
+def norm(inputs, attrs):
+    return l2_normalize(inputs, attrs)
+
+
+@register_op("maxout")
+def maxout(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "X")
+    g = attrs["groups"]
+    n, c, h, w = x.shape
+    return {"Out": jnp.max(x.reshape(n, c // g, g, h, w), axis=2)}
+
+
+@register_op("im2sequence")
+def im2sequence(inputs, attrs):
+    # simplified patch-extraction (reference: operators/im2sequence_op.cc)
+    jax = _jax()
+    x = one(inputs, "X")
+    kh, kw = _pair(attrs.get("kernels", [1, 1]))
+    sh, sw = _pair(attrs.get("strides", [1, 1]))
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), padding="VALID", dimension_numbers=("NCHW", "OIHW", "NCHW")
+    )
+    n, c, oh, ow = patches.shape
+    return {"Out": patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c)}

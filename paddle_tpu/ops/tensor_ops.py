@@ -1,0 +1,451 @@
+"""Tensor creation / manipulation ops.
+
+Reference kernels: operators/fill_constant_op.cc, gaussian_random_op.cc,
+uniform_random_op.cc, reshape_op.cc, transpose_op.cc, concat_op.cc,
+split_op.cc, cast_op.cc, lookup_table_op.cc, one_hot_op.cc, top_k_op.cc,
+gather_op.cc, assign_op.cc, slice_op.cc, expand_op.cc, stack_op.cc.
+RNG ops take a deterministic per-op ``seed`` attr (assigned by the program,
+framework.Program.next_seed) — jax.random keys instead of curand states.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import jdtype, one, prng
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _shape_from(inputs, attrs):
+    shape = attrs.get("shape")
+    st = inputs.get("ShapeTensor")
+    if st:
+        shape = [int(s) for s in np.asarray(st[0])]
+    return tuple(int(s) for s in shape)
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+def _fill_constant_infer(op, block):
+    shape = tuple(int(s) for s in op.attrs.get("shape", ()))
+    for n in op.output("Out"):
+        v = block._find_var_recursive(n)
+        if v is not None:
+            v.shape = shape
+            v.dtype = op.attrs.get("dtype", "float32")
+
+
+@register_op("fill_constant", differentiable=False, infer_shape=_fill_constant_infer)
+def fill_constant(inputs, attrs):
+    jnp = _jnp()
+    shape = _shape_from(inputs, attrs)
+    return {"Out": jnp.full(shape, attrs.get("value", 0.0), dtype=jdtype(attrs.get("dtype", "float32")))}
+
+
+def _like_infer(op, block):
+    src = block.var(op.input("X")[0])
+    for n in op.output("Out"):
+        v = block._find_var_recursive(n)
+        if v is not None:
+            v.shape = src.shape
+            v.dtype = op.attrs.get("dtype", src.dtype)
+
+
+@register_op("fill_zeros_like", differentiable=False, infer_shape=_like_infer)
+def fill_zeros_like(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "X")
+    return {"Out": jnp.zeros_like(x)}
+
+
+@register_op("fill_constant_batch_size_like", differentiable=False)
+def fill_constant_batch_size_like(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "Input")
+    shape = list(attrs["shape"])
+    in_idx = attrs.get("input_dim_idx", 0)
+    out_idx = attrs.get("output_dim_idx", 0)
+    shape[out_idx] = x.shape[in_idx]
+    return {"Out": jnp.full(tuple(shape), attrs.get("value", 0.0), dtype=jdtype(attrs.get("dtype", "float32")))}
+
+
+def _rng_infer(op, block):
+    shape = tuple(int(s) for s in op.attrs.get("shape", ()))
+    for n in op.output("Out"):
+        v = block._find_var_recursive(n)
+        if v is not None:
+            v.shape = shape
+            v.dtype = op.attrs.get("dtype", "float32")
+
+
+@register_op("gaussian_random", differentiable=False, infer_shape=_rng_infer)
+def gaussian_random(inputs, attrs):
+    import jax
+
+    shape = _shape_from(inputs, attrs)
+    key = prng(attrs.get("seed", 0))
+    dt = jdtype(attrs.get("dtype", "float32"))
+    out = attrs.get("mean", 0.0) + attrs.get("std", 1.0) * jax.random.normal(key, shape, dtype="float32")
+    return {"Out": out.astype(dt)}
+
+
+@register_op("uniform_random", differentiable=False, infer_shape=_rng_infer)
+def uniform_random(inputs, attrs):
+    import jax
+
+    shape = _shape_from(inputs, attrs)
+    key = prng(attrs.get("seed", 0))
+    dt = jdtype(attrs.get("dtype", "float32"))
+    out = jax.random.uniform(
+        key, shape, minval=attrs.get("min", -1.0), maxval=attrs.get("max", 1.0), dtype="float32"
+    )
+    return {"Out": out.astype(dt)}
+
+
+@register_op("truncated_gaussian_random", differentiable=False, infer_shape=_rng_infer)
+def truncated_gaussian_random(inputs, attrs):
+    import jax
+
+    shape = _shape_from(inputs, attrs)
+    key = prng(attrs.get("seed", 0))
+    dt = jdtype(attrs.get("dtype", "float32"))
+    out = attrs.get("mean", 0.0) + attrs.get("std", 1.0) * jax.random.truncated_normal(
+        key, -2.0, 2.0, shape, dtype="float32"
+    )
+    return {"Out": out.astype(dt)}
+
+
+@register_op("assign")
+def assign(inputs, attrs):
+    return {"Out": one(inputs, "X")}
+
+
+def _assign_value_infer(op, block):
+    shape = tuple(int(s) for s in op.attrs.get("shape", ()))
+    for n in op.output("Out"):
+        v = block._find_var_recursive(n)
+        if v is not None:
+            v.shape = shape
+
+
+@register_op("assign_value", differentiable=False, infer_shape=_assign_value_infer)
+def assign_value(inputs, attrs):
+    jnp = _jnp()
+    values = np.asarray(attrs["values"], dtype=jdtype(attrs.get("dtype", "float32")))
+    return {"Out": jnp.asarray(values).reshape(tuple(attrs["shape"]))}
+
+
+@register_op("range", differentiable=False)
+def range_op(inputs, attrs):
+    jnp = _jnp()
+    start, end, step = one(inputs, "Start"), one(inputs, "End"), one(inputs, "Step")
+    # shapes must be static under jit: require python scalars via attrs fallback
+    if start is None:
+        start, end, step = attrs["start"], attrs["end"], attrs["step"]
+    return {"Out": jnp.arange(int(start), int(end), int(step), dtype=jdtype(attrs.get("dtype", "int64")))}
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+def _reshape(x, shape):
+    shape = [int(s) for s in shape]
+    if 0 in shape:
+        shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+    return x.reshape(tuple(shape))
+
+
+@register_op("reshape2")
+def reshape2(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "X")
+    out = _reshape(x, attrs["shape"])
+    return {"Out": out, "XShape": jnp.zeros((0,) + x.shape, dtype=x.dtype)}
+
+
+@register_op("reshape")
+def reshape(inputs, attrs):
+    return {"Out": _reshape(one(inputs, "X"), attrs["shape"])}
+
+
+@register_op("transpose2")
+def transpose2(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "X")
+    return {"Out": jnp.transpose(x, attrs["axis"]), "XShape": jnp.zeros((0,) + x.shape, dtype=x.dtype)}
+
+
+@register_op("transpose")
+def transpose(inputs, attrs):
+    jnp = _jnp()
+    return {"Out": jnp.transpose(one(inputs, "X"), attrs["axis"])}
+
+
+@register_op("squeeze2")
+def squeeze2(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "X")
+    axes = attrs.get("axes", [])
+    if axes:
+        axes = tuple(a % x.ndim for a in axes if x.shape[a % x.ndim] == 1)
+        out = jnp.squeeze(x, axis=axes) if axes else x
+    else:
+        out = jnp.squeeze(x)
+    return {"Out": out, "XShape": jnp.zeros((0,) + x.shape, dtype=x.dtype)}
+
+
+@register_op("unsqueeze2")
+def unsqueeze2(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "X")
+    out = x
+    for a in sorted(attrs["axes"]):
+        out = jnp.expand_dims(out, a)
+    return {"Out": out, "XShape": jnp.zeros((0,) + x.shape, dtype=x.dtype)}
+
+
+@register_op("flatten2")
+def flatten2(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "X")
+    axis = attrs.get("axis", 1)
+    out = x.reshape((int(np.prod(x.shape[:axis])), int(np.prod(x.shape[axis:]))))
+    return {"Out": out, "XShape": jnp.zeros((0,) + x.shape, dtype=x.dtype)}
+
+
+@register_op("concat")
+def concat(inputs, attrs):
+    jnp = _jnp()
+    return {"Out": jnp.concatenate(inputs["X"], axis=attrs.get("axis", 0))}
+
+
+@register_op("split")
+def split(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "X")
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", [])
+    if num:
+        outs = jnp.split(x, num, axis=axis)
+    else:
+        idx = np.cumsum(sections)[:-1].tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("stack")
+def stack(inputs, attrs):
+    jnp = _jnp()
+    return {"Y": jnp.stack(inputs["X"], axis=attrs.get("axis", 0))}
+
+
+@register_op("unstack")
+def unstack(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "X")
+    axis = attrs.get("axis", 0)
+    return {"Y": [jnp.squeeze(s, axis) for s in jnp.split(x, x.shape[axis], axis=axis)]}
+
+
+@register_op("slice")
+def slice_op(inputs, attrs):
+    x = one(inputs, "Input")
+    axes = attrs["axes"]
+    starts = attrs["starts"]
+    ends = attrs["ends"]
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    return {"Out": x[tuple(idx)]}
+
+
+@register_op("strided_slice")
+def strided_slice(inputs, attrs):
+    x = one(inputs, "Input")
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(attrs["axes"], attrs["starts"], attrs["ends"], attrs["strides"]):
+        idx[a] = slice(s, e, st)
+    return {"Out": x[tuple(idx)]}
+
+
+@register_op("expand")
+def expand(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "X")
+    return {"Out": jnp.tile(x, tuple(attrs["expand_times"]))}
+
+
+@register_op("cast")
+def cast(inputs, attrs):
+    x = one(inputs, "X")
+    return {"Out": x.astype(jdtype(attrs["out_dtype"]))}
+
+
+@register_op("shape", differentiable=False)
+def shape_op(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "Input")
+    return {"Out": jnp.asarray(np.array(x.shape, dtype=np.int32))}
+
+
+@register_op("pad")
+def pad(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "X")
+    p = attrs["paddings"]
+    pairs = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": jnp.pad(x, pairs, constant_values=attrs.get("pad_value", 0.0))}
+
+
+@register_op("pad2d")
+def pad2d(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "X")
+    t, b, l, r = attrs["paddings"]
+    mode = attrs.get("mode", "constant")
+    pairs = [(0, 0), (0, 0), (t, b), (l, r)]
+    if mode == "constant":
+        return {"Out": jnp.pad(x, pairs, constant_values=attrs.get("pad_value", 0.0))}
+    jmode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return {"Out": jnp.pad(x, pairs, mode=jmode)}
+
+
+# ---------------------------------------------------------------------------
+# indexing / embedding
+# ---------------------------------------------------------------------------
+@register_op("lookup_table", no_grad_set={"Ids"})
+def lookup_table(inputs, attrs):
+    """Embedding lookup (reference: operators/lookup_table_op.cc).  Ids may
+    carry a trailing [..., 1] dim like the reference's LoDTensor ids."""
+    w = one(inputs, "W")
+    ids = one(inputs, "Ids")
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids.squeeze(-1)
+    padding_idx = attrs.get("padding_idx", -1)
+    out = w[ids]
+    if padding_idx is not None and padding_idx >= 0:
+        jnp = _jnp()
+        mask = (ids != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return {"Out": out}
+
+
+@register_op("lookup_table_v2", no_grad_set={"Ids"})
+def lookup_table_v2(inputs, attrs):
+    return lookup_table(inputs, attrs)
+
+
+@register_op("one_hot", differentiable=False)
+def one_hot(inputs, attrs):
+    import jax
+
+    x = one(inputs, "X")
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        x = x.squeeze(-1)
+    return {"Out": jax.nn.one_hot(x, attrs["depth"], dtype="float32")}
+
+
+@register_op("gather", no_grad_set={"Index"})
+def gather(inputs, attrs):
+    x = one(inputs, "X")
+    idx = one(inputs, "Index")
+    return {"Out": x[idx]}
+
+
+@register_op("gather_nd", no_grad_set={"Index"})
+def gather_nd(inputs, attrs):
+    x = one(inputs, "X")
+    idx = one(inputs, "Index")
+    return {"Out": x[tuple(idx[..., i] for i in range(idx.shape[-1]))]}
+
+
+@register_op("scatter", no_grad_set={"Ids"})
+def scatter(inputs, attrs):
+    x = one(inputs, "X")
+    ids = one(inputs, "Ids")
+    upd = one(inputs, "Updates")
+    if attrs.get("overwrite", True):
+        return {"Out": x.at[ids].set(upd)}
+    return {"Out": x.at[ids].add(upd)}
+
+
+@register_op("where", no_grad_set={"Condition"})
+def where(inputs, attrs):
+    jnp = _jnp()
+    return {"Out": jnp.where(one(inputs, "Condition"), one(inputs, "X"), one(inputs, "Y"))}
+
+
+@register_op("top_k", differentiable=False)
+def top_k(inputs, attrs):
+    import jax
+
+    x = one(inputs, "X")
+    k = attrs["k"]
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": vals, "Indices": idx.astype("int64")}
+
+
+@register_op("arg_max", differentiable=False)
+def arg_max(inputs, attrs):
+    jnp = _jnp()
+    return {"Out": jnp.argmax(one(inputs, "X"), axis=attrs.get("axis", -1)).astype("int64")}
+
+
+@register_op("arg_min", differentiable=False)
+def arg_min(inputs, attrs):
+    jnp = _jnp()
+    return {"Out": jnp.argmin(one(inputs, "X"), axis=attrs.get("axis", -1)).astype("int64")}
+
+
+@register_op("argsort", differentiable=False)
+def argsort(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "X")
+    axis = attrs.get("axis", -1)
+    idx = jnp.argsort(x, axis=axis)
+    if attrs.get("descending", False):
+        idx = jnp.flip(idx, axis=axis)
+    return {"Out": jnp.take_along_axis(x, idx, axis=axis), "Indices": idx.astype("int64")}
+
+
+@register_op("cumsum")
+def cumsum(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "X")
+    axis = attrs.get("axis", -1)
+    if attrs.get("flatten", False):
+        x = x.reshape(-1)
+        axis = 0
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+    if attrs.get("exclusive", False):
+        jnpad = [(0, 0)] * x.ndim
+        jnpad[axis] = (1, 0)
+        out = jnp.pad(out, jnpad)[tuple(slice(0, s) if i == axis else slice(None) for i, s in enumerate(x.shape))]
+    return {"Out": out}
+
+
+@register_op("uniform_random_batch_size_like", differentiable=False)
+def uniform_random_batch_size_like(inputs, attrs):
+    import jax
+
+    x = one(inputs, "Input")
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = x.shape[attrs.get("input_dim_idx", 0)]
+    key = prng(attrs.get("seed", 0))
+    return {
+        "Out": jax.random.uniform(
+            key, tuple(shape), minval=attrs.get("min", -1.0), maxval=attrs.get("max", 1.0)
+        ).astype(jdtype(attrs.get("dtype", "float32")))
+    }

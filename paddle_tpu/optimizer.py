@@ -1,0 +1,463 @@
+"""Optimizers — append update ops into the program.
+
+Reference: python/paddle/fluid/optimizer.py:50 (Optimizer base), SGD:609,
+Momentum:679, LarsMomentum:1046, Adagrad:1146, Adam:1249, Adamax:1430,
+DecayedAdagrad:1584, Adadelta:1676, RMSProp:1774, Ftrl:1947, Lamb:2091.
+Optimizer state (moments, beta pows) are persistable vars updated by
+optimizer *ops* inside the same compiled XLA module as forward+backward —
+the whole train step is one executable (see executor.py docstring).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from paddle_tpu import framework, unique_name
+from paddle_tpu.backward import append_backward
+from paddle_tpu.framework import Variable
+from paddle_tpu.layer_helper import LayerHelper
+
+__all__ = [
+    "Optimizer",
+    "SGD",
+    "SGDOptimizer",
+    "Momentum",
+    "MomentumOptimizer",
+    "LarsMomentum",
+    "LarsMomentumOptimizer",
+    "Adagrad",
+    "AdagradOptimizer",
+    "DecayedAdagrad",
+    "DecayedAdagradOptimizer",
+    "Adam",
+    "AdamOptimizer",
+    "Adamax",
+    "AdamaxOptimizer",
+    "Adadelta",
+    "AdadeltaOptimizer",
+    "RMSProp",
+    "RMSPropOptimizer",
+    "Ftrl",
+    "FtrlOptimizer",
+    "Lamb",
+    "LambOptimizer",
+    "DGCMomentumOptimizer",
+]
+
+
+class Optimizer:
+    _op_type = None
+
+    def __init__(self, learning_rate, regularization=None, name=None):
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self._name = name
+        self._accumulators: Dict[str, Dict[str, Variable]] = {}
+        self._lr_var: Optional[Variable] = None
+
+    # ------------------------------------------------------------------
+    def _create_global_learning_rate(self):
+        if isinstance(self._learning_rate, Variable):
+            self._lr_var = self._learning_rate
+            return
+        if self._lr_var is not None:
+            return
+        from paddle_tpu.layers import tensor as ltensor
+
+        self._lr_var = ltensor.create_global_var(
+            shape=[1],
+            value=float(self._learning_rate),
+            dtype="float32",
+            persistable=True,
+            name=unique_name.generate("learning_rate"),
+        )
+
+    def _global_learning_rate(self):
+        return self._lr_var
+
+    def _create_param_lr(self, param):
+        """Per-param LR multiplier (ParamAttr.learning_rate)."""
+        mult = param.optimize_attr.get("learning_rate", 1.0) if param.optimize_attr else 1.0
+        if mult == 1.0:
+            return self._lr_var
+        from paddle_tpu.layers import tensor as ltensor
+
+        return ltensor.scale(self._lr_var, scale=float(mult))
+
+    # ------------------------------------------------------------------
+    def _add_accumulator(self, name, param, fill_value=0.0, shape=None, dtype=None):
+        if name in self._accumulators and param.name in self._accumulators[name]:
+            return self._accumulators[name][param.name]
+        from paddle_tpu import initializer
+
+        helper = LayerHelper(self.__class__.__name__.lower())
+        shape = shape if shape is not None else list(param.shape)
+        var_name = unique_name.generate("%s_%s" % (param.name, name))
+        block = framework.default_main_program().global_block()
+        var = block.create_var(
+            name=var_name,
+            shape=shape,
+            dtype=dtype or param.dtype,
+            persistable=True,
+            stop_gradient=True,
+        )
+        helper.set_variable_initializer(var, initializer.Constant(fill_value))
+        self._accumulators.setdefault(name, {})[param.name] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # ------------------------------------------------------------------
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _finish_update(self, block, params_grads):
+        pass
+
+    # ------------------------------------------------------------------
+    def backward(self, loss, startup_program=None, parameter_list=None, no_grad_set=None, callbacks=None):
+        return append_backward(loss, parameter_list, no_grad_set, callbacks)
+
+    def apply_gradients(self, params_grads):
+        from paddle_tpu import clip as clip_mod
+        from paddle_tpu import regularizer as reg_mod
+
+        block = framework.default_main_program().global_block()
+        self._create_global_learning_rate()
+        params_grads = clip_mod.append_gradient_clip_ops(params_grads)
+        params_grads = reg_mod.append_regularization_ops(params_grads, self.regularization)
+        self._create_accumulators(block, [p for p, _ in params_grads])
+        ops = []
+        for pg in params_grads:
+            if pg[1] is None:
+                continue
+            ops.append(self._append_optimize_op(block, pg))
+        self._finish_update(block, params_grads)
+        block.program.version += 1
+        return ops
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list, no_grad_set)
+        optimize_ops = self.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+
+# ---------------------------------------------------------------------------
+class SGDOptimizer(Optimizer):
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            type="sgd",
+            inputs={"Param": [p], "Grad": [g], "LearningRate": [self._create_param_lr(p)]},
+            outputs={"ParamOut": [p]},
+            attrs={"op_role": "optimize"},
+        )
+
+
+class MomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum, use_nesterov=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            type="momentum",
+            inputs={"Param": [p], "Grad": [g], "Velocity": [v], "LearningRate": [self._create_param_lr(p)]},
+            outputs={"ParamOut": [p], "VelocityOut": [v]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov, "op_role": "optimize"},
+        )
+
+
+class LarsMomentumOptimizer(MomentumOptimizer):
+    def __init__(self, learning_rate, momentum, lars_coeff=0.001, lars_weight_decay=0.0005, **kwargs):
+        super().__init__(learning_rate, momentum, **kwargs)
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            type="lars_momentum",
+            inputs={"Param": [p], "Grad": [g], "Velocity": [v], "LearningRate": [self._create_param_lr(p)]},
+            outputs={"ParamOut": [p], "VelocityOut": [v]},
+            attrs={
+                "mu": self._momentum,
+                "lars_coeff": self._lars_coeff,
+                "lars_weight_decay": self._lars_weight_decay,
+                "op_role": "optimize",
+            },
+        )
+
+
+class AdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, initial_accumulator_value=0.0, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p, fill_value=self._init_acc)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            type="adagrad",
+            inputs={"Param": [p], "Grad": [g], "Moment": [m], "LearningRate": [self._create_param_lr(p)]},
+            outputs={"ParamOut": [p], "MomentOut": [m]},
+            attrs={"epsilon": self._epsilon, "op_role": "optimize"},
+        )
+
+
+class DecayedAdagradOptimizer(AdagradOptimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kwargs):
+        super().__init__(learning_rate, epsilon=epsilon, **kwargs)
+        self._decay = decay
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            type="decayed_adagrad",
+            inputs={"Param": [p], "Grad": [g], "Moment": [m], "LearningRate": [self._create_param_lr(p)]},
+            outputs={"ParamOut": [p], "MomentOut": [m]},
+            attrs={"epsilon": self._epsilon, "decay": self._decay, "op_role": "optimize"},
+        )
+
+
+class AdamOptimizer(Optimizer):
+    _op = "adam"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, lazy_mode=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1, shape=[1])
+            self._add_accumulator("beta2_pow_acc", p, fill_value=self._beta2, shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow_acc", p)
+        b2p = self._get_accumulator("beta2_pow_acc", p)
+        return block.append_op(
+            type=self._op,
+            inputs={
+                "Param": [p],
+                "Grad": [g],
+                "Moment1": [m1],
+                "Moment2": [m2],
+                "Beta1Pow": [b1p],
+                "Beta2Pow": [b2p],
+                "LearningRate": [self._create_param_lr(p)],
+            },
+            outputs={
+                "ParamOut": [p],
+                "Moment1Out": [m1],
+                "Moment2Out": [m2],
+                "Beta1PowOut": [b1p],
+                "Beta2PowOut": [b2p],
+            },
+            attrs={
+                "beta1": self._beta1,
+                "beta2": self._beta2,
+                "epsilon": self._epsilon,
+                "op_role": "optimize",
+            },
+        )
+
+
+class LambOptimizer(AdamOptimizer):
+    _op = "lamb"
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999, epsilon=1e-6, **kwargs):
+        super().__init__(learning_rate, beta1, beta2, epsilon, **kwargs)
+        self._weight_decay = lamb_weight_decay
+
+    def _append_optimize_op(self, block, param_and_grad):
+        op = super()._append_optimize_op(block, param_and_grad)
+        op.attrs["weight_decay"] = self._weight_decay
+        return op
+
+
+class AdamaxOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1, shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            type="adamax",
+            inputs={
+                "Param": [p],
+                "Grad": [g],
+                "Moment": [self._get_accumulator("moment", p)],
+                "InfNorm": [self._get_accumulator("inf_norm", p)],
+                "Beta1Pow": [self._get_accumulator("beta1_pow_acc", p)],
+                "LearningRate": [self._create_param_lr(p)],
+            },
+            outputs={
+                "ParamOut": [p],
+                "MomentOut": [self._get_accumulator("moment", p)],
+                "InfNormOut": [self._get_accumulator("inf_norm", p)],
+            },
+            attrs={"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon, "op_role": "optimize"},
+        )
+
+    def _finish_update(self, block, params_grads):
+        # beta1 pow update (reference: optimizer.py Adamax._finish_update)
+        for p, _ in params_grads:
+            b1p = self._get_accumulator("beta1_pow_acc", p)
+            block.append_op(
+                type="scale",
+                inputs={"X": [b1p]},
+                outputs={"Out": [b1p]},
+                attrs={"scale": self._beta1, "op_role": "optimize"},
+            )
+
+
+class AdadeltaOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("__avg_squared_grad", p)
+            self._add_accumulator("__avg_squared_update", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        asg = self._get_accumulator("__avg_squared_grad", p)
+        asu = self._get_accumulator("__avg_squared_update", p)
+        return block.append_op(
+            type="adadelta",
+            inputs={"Param": [p], "Grad": [g], "AvgSquaredGrad": [asg], "AvgSquaredUpdate": [asu]},
+            outputs={"ParamOut": [p], "AvgSquaredGradOut": [asg], "AvgSquaredUpdateOut": [asu]},
+            attrs={"epsilon": self._epsilon, "rho": self._rho, "op_role": "optimize"},
+        )
+
+
+class RMSPropOptimizer(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("momentum", p)
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("mean_grad", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            type="rmsprop",
+            inputs={
+                "Param": [p],
+                "Grad": [g],
+                "Moment": [self._get_accumulator("momentum", p)],
+                "MeanSquare": [self._get_accumulator("mean_square", p)],
+                "MeanGrad": [self._get_accumulator("mean_grad", p)],
+                "LearningRate": [self._create_param_lr(p)],
+            },
+            outputs={
+                "ParamOut": [p],
+                "MomentOut": [self._get_accumulator("momentum", p)],
+                "MeanSquareOut": [self._get_accumulator("mean_square", p)],
+                "MeanGradOut": [self._get_accumulator("mean_grad", p)],
+            },
+            attrs={
+                "decay": self._rho,
+                "epsilon": self._epsilon,
+                "momentum": self._momentum,
+                "centered": self._centered,
+                "op_role": "optimize",
+            },
+        )
+
+
+class FtrlOptimizer(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            type="ftrl",
+            inputs={
+                "Param": [p],
+                "Grad": [g],
+                "SquaredAccumulator": [self._get_accumulator("squared", p)],
+                "LinearAccumulator": [self._get_accumulator("linear", p)],
+                "LearningRate": [self._create_param_lr(p)],
+            },
+            outputs={
+                "ParamOut": [p],
+                "SquaredAccumOut": [self._get_accumulator("squared", p)],
+                "LinearAccumOut": [self._get_accumulator("linear", p)],
+            },
+            attrs={"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power, "op_role": "optimize"},
+        )
+
+
+class DGCMomentumOptimizer(MomentumOptimizer):
+    """Deep Gradient Compression (reference: optimizer.py:787).
+
+    On TPU, per-step gradient exchange compiles to ICI all-reduce which is
+    rarely bandwidth-bound; DGC's top-k sparsification is kept as an
+    API-parity momentum optimizer (the sparse-allreduce path is a no-op on
+    a single slice).  Cross-slice (DCN) compression lives in
+    parallel/strategy hooks.
+    """
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step=0, **kwargs):
+        kwargs.pop("rampup_step", None)
+        kwargs.pop("sparsity", None)
+        super().__init__(learning_rate, momentum, **kwargs)
+
+
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+LarsMomentum = LarsMomentumOptimizer
+Adagrad = AdagradOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adam = AdamOptimizer
+Adamax = AdamaxOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer

@@ -1,0 +1,7 @@
+"""Distributed / parallel execution: meshes, sharding, collectives, fleet.
+
+TPU-native replacement for the reference's ParallelExecutor + NCCL stack
+(parallel_executor.cc, operators/collective/, transpiler/) — see
+parallel/compiled_program.py and parallel/fleet.py.
+"""
+from paddle_tpu.parallel import env  # noqa: F401

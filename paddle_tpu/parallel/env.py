@@ -1,0 +1,36 @@
+"""Active collective-axis registry.
+
+The reference keys NCCL comms by ring_id (platform/collective_helper.h:63).
+Here a "ring" is a named mesh axis; the parallel executor binds axes while
+tracing under shard_map, and collective ops query them.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List
+
+_active_axes: List[str] = []
+_ring_to_axis: Dict[int, str] = {0: "data"}
+
+
+def axis_for_ring(ring_id: int) -> str:
+    return _ring_to_axis.get(int(ring_id), "data")
+
+
+def set_ring_axis(ring_id: int, axis: str) -> None:
+    _ring_to_axis[int(ring_id)] = axis
+
+
+def axis_active(name: str) -> bool:
+    return name in _active_axes
+
+
+@contextlib.contextmanager
+def active_axes(names):
+    added = list(names)
+    _active_axes.extend(added)
+    try:
+        yield
+    finally:
+        for n in added:
+            _active_axes.remove(n)

@@ -1,0 +1,108 @@
+"""Runtime Scope: name -> device array store.
+
+Reference: paddle/fluid/framework/scope.h:46 (hierarchical Variable maps)
+and variable.h:26.  On TPU only *persistable* values (parameters, optimizer
+state, LR) ever live in the scope — intermediates stay inside the compiled
+XLA module and never materialize in HBM as named buffers.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+__all__ = ["Scope", "global_scope", "scope_guard"]
+
+
+class _TensorView:
+    """Mimics the reference's LoDTensor pybind surface (get_tensor())."""
+
+    def __init__(self, scope: "Scope", name: str):
+        self._scope = scope
+        self._name = name
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self._scope.vars[self._name])
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def set(self, value, place=None):
+        import jax.numpy as jnp
+
+        self._scope.vars[self._name] = jnp.asarray(value)
+
+    def shape(self):
+        return list(np.shape(self._scope.vars[self._name]))
+
+
+class _VarView:
+    def __init__(self, scope: "Scope", name: str):
+        self._scope = scope
+        self._name = name
+
+    def get_tensor(self) -> _TensorView:
+        return _TensorView(self._scope, self._name)
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self.vars: Dict[str, Any] = {}
+        self.parent = parent
+        self.kids = []
+
+    def new_scope(self) -> "Scope":
+        s = Scope(self)
+        self.kids.append(s)
+        return s
+
+    def find_var(self, name: str) -> Optional[_VarView]:
+        s = self
+        while s is not None:
+            if name in s.vars:
+                return _VarView(s, name)
+            s = s.parent
+        return None
+
+    def var(self, name: str) -> _VarView:
+        if self._lookup(name) is None and name not in self.vars:
+            self.vars[name] = None
+        return _VarView(self, name)
+
+    def _lookup(self, name: str):
+        s = self
+        while s is not None:
+            if name in s.vars:
+                return s.vars[name]
+            s = s.parent
+        return None
+
+    def get(self, name: str):
+        return self._lookup(name)
+
+    def set(self, name: str, value):
+        self.vars[name] = value
+
+    def drop_kids(self):
+        self.kids = []
+
+    def local_var_names(self):
+        return list(self.vars.keys())
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope() -> Scope:
+    return _scope_stack[-1]
+
+
+class scope_guard:
+    def __init__(self, scope: Scope):
+        self._scope = scope
+
+    def __enter__(self):
+        _scope_stack.append(self._scope)
+        return self._scope
+
+    def __exit__(self, *exc):
+        _scope_stack.pop()

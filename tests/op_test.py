@@ -1,0 +1,188 @@
+"""Per-op golden-test harness.
+
+Port of the reference's OpTest
+(python/paddle/fluid/tests/unittests/op_test.py:134): a test declares
+op type, numpy inputs, attrs, and expected numpy outputs; ``check_output``
+runs the single op through a real Program/Executor; ``check_grad``
+compares the framework's appended backward against *numeric* central-
+difference gradients computed through executor re-runs
+(gradient_checker.py analog).
+"""
+from __future__ import annotations
+
+import unittest
+from typing import Dict, List
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import framework
+from paddle_tpu.backward import append_backward
+from paddle_tpu.framework import grad_var_name
+
+
+def _as_list(val):
+    """inputs/outputs values: ndarray or [(name, ndarray), ...]."""
+    if isinstance(val, (list, tuple)) and val and isinstance(val[0], (list, tuple)):
+        return [(n, np.asarray(a)) for n, a in val]
+    return None
+
+
+class OpTest(unittest.TestCase):
+    op_type: str = None
+    atol = 1e-5
+    rtol = 1e-4
+
+    def setUp(self):
+        self.inputs: Dict = {}
+        self.outputs: Dict = {}
+        self.attrs: Dict = {}
+
+    # ------------------------------------------------------------------
+    def _build(self, for_grad=False):
+        prog = framework.Program()
+        startup = framework.Program()
+        feed = {}
+        with framework.program_guard(prog, startup):
+            block = prog.global_block()
+            op_inputs = {}
+            for slot, val in self.inputs.items():
+                pairs = _as_list(val)
+                if pairs is None:
+                    pairs = [(slot.lower(), np.asarray(val))]
+                names = []
+                for name, arr in pairs:
+                    block.create_var(
+                        name=name,
+                        shape=arr.shape,
+                        dtype=str(arr.dtype),
+                        stop_gradient=not (for_grad and np.issubdtype(arr.dtype, np.floating)),
+                        is_data=True,
+                    )
+                    feed[name] = arr
+                    names.append(name)
+                op_inputs[slot] = names
+            op_outputs = {}
+            out_vars = {}
+            for slot, val in self.outputs.items():
+                pairs = _as_list(val)
+                if pairs is None:
+                    pairs = [(slot.lower() + "_out", np.asarray(val))]
+                names = []
+                for name, arr in pairs:
+                    v = block.create_var(name=name, shape=arr.shape, dtype=str(arr.dtype))
+                    names.append(name)
+                    out_vars.setdefault(slot, []).append((name, arr))
+                op_outputs[slot] = names
+            block.append_op(type=self.op_type, inputs=op_inputs, outputs=op_outputs, attrs=self.attrs)
+        return prog, startup, feed, out_vars
+
+    # ------------------------------------------------------------------
+    def check_output(self, atol=None, no_check_set=None):
+        atol = atol if atol is not None else self.atol
+        no_check_set = set(no_check_set or ())
+        prog, startup, feed, out_vars = self._build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        fetch_names = []
+        expected = []
+        for slot, pairs in out_vars.items():
+            if slot in no_check_set:
+                continue
+            for name, arr in pairs:
+                fetch_names.append(name)
+                expected.append(arr)
+        results = exe.run(prog, feed=feed, fetch_list=fetch_names)
+        for name, got, want in zip(fetch_names, results, expected):
+            np.testing.assert_allclose(
+                np.asarray(got, dtype=np.float64) if np.issubdtype(want.dtype, np.floating) else got,
+                want.astype(np.float64) if np.issubdtype(want.dtype, np.floating) else want,
+                atol=atol,
+                rtol=self.rtol,
+                err_msg="output %r of op %r mismatch" % (name, self.op_type),
+            )
+
+    # ------------------------------------------------------------------
+    def check_grad(
+        self,
+        inputs_to_check: List[str],
+        output_names,
+        max_relative_error=0.005,
+        no_grad_set=None,
+        numeric_grad_delta=0.005,
+        user_defined_grads=None,
+    ):
+        if isinstance(output_names, str):
+            output_names = [output_names]
+
+        # ---------- analytic grads through append_backward ----------
+        prog, startup, feed, out_vars = self._build(for_grad=True)
+        block = prog.global_block()
+        # output_names are op output *slots*; resolve to var names
+        out_name_list = [n for slot in output_names for n, _ in out_vars[slot]]
+        with framework.program_guard(prog, startup):
+            from paddle_tpu.layers import tensor as ltensor
+
+            partials = []
+            for oname in out_name_list:
+                ov = block.var(oname)
+                partials.append(ltensor.reduce_sum(ov))
+            loss = partials[0] if len(partials) == 1 else ltensor.sums(partials)
+            loss2 = ltensor.scale(loss, scale=1.0)  # ensure single scalar producer
+            append_backward(loss2, no_grad_set=no_grad_set)
+
+        input_names = []
+        for slot in inputs_to_check:
+            val = self.inputs[slot]
+            pairs = _as_list(val)
+            if pairs is None:
+                input_names.append(slot.lower())
+            else:
+                input_names.extend(n for n, _ in pairs)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        grad_names = [grad_var_name(n) for n in input_names]
+        analytic = exe.run(prog, feed=feed, fetch_list=grad_names)
+
+        # ---------- numeric grads (central difference) ----------
+        if user_defined_grads is not None:
+            numeric = [np.asarray(g) for g in user_defined_grads]
+        else:
+            fprog, fstartup, ffeed, fout_vars = self._build()
+            fexe = fluid.Executor(fluid.CPUPlace())
+            fout_names = [n for slot in output_names for n, _ in fout_vars[slot]]
+
+            def f(feed_dict):
+                outs = fexe.run(fprog, feed=feed_dict, fetch_list=fout_names)
+                return sum(np.sum(np.asarray(o, dtype=np.float64)) for o in outs)
+
+            numeric = []
+            for name in input_names:
+                base = np.asarray(feed[name], dtype=np.float64)
+                g = np.zeros_like(base)
+                flat = base.flatten()
+                delta = numeric_grad_delta
+                for i in range(flat.size):
+                    orig = flat[i]
+                    flat[i] = orig + delta
+                    fd = dict(ffeed)
+                    fd[name] = flat.reshape(base.shape).astype(feed[name].dtype)
+                    fp = f(fd)
+                    flat[i] = orig - delta
+                    fd[name] = flat.reshape(base.shape).astype(feed[name].dtype)
+                    fm = f(fd)
+                    flat[i] = orig
+                    g.flat[i] = (fp - fm) / (2 * delta)
+                numeric.append(g)
+
+        for name, a, n in zip(input_names, analytic, numeric):
+            a = np.asarray(a, dtype=np.float64)
+            abs_a = np.abs(a)
+            abs_a[abs_a < 1e-3] = 1.0
+            diff = np.abs(a - n) / abs_a
+            max_diff = np.max(diff) if diff.size else 0.0
+            self.assertLessEqual(
+                max_diff,
+                max_relative_error,
+                "gradient of %r for op %r: max relative error %g > %g\nanalytic=%s\nnumeric=%s"
+                % (name, self.op_type, max_diff, max_relative_error, a, n),
+            )

@@ -1,0 +1,144 @@
+"""Activation op tests (reference: tests/unittests/test_activation_op.py)."""
+import numpy as np
+
+from op_test import OpTest
+
+
+def _softmax_np(x, axis=-1):
+    e = np.exp(x - np.max(x, axis=axis, keepdims=True))
+    return e / np.sum(e, axis=axis, keepdims=True)
+
+
+class _ActTest(OpTest):
+    fn = None
+    shift = 0.0  # shift inputs away from kinks
+
+    def setUp(self):
+        super().setUp()
+        if self.fn is None:
+            self.skipTest("abstract base")
+        rng = np.random.RandomState(hash(self.op_type) % 2**31)
+        x = rng.uniform(-2, 2, (4, 6)).astype("float32")
+        x[np.abs(x) < 0.1] = 0.5  # avoid non-differentiable points
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.asarray(self.fn(x), dtype="float32")}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+class TestRelu(_ActTest):
+    op_type = "relu"
+    fn = staticmethod(lambda x: np.maximum(x, 0))
+
+
+class TestSigmoid(_ActTest):
+    op_type = "sigmoid"
+    fn = staticmethod(lambda x: 1 / (1 + np.exp(-x)))
+
+
+class TestTanh(_ActTest):
+    op_type = "tanh"
+    fn = staticmethod(np.tanh)
+
+
+class TestExp(_ActTest):
+    op_type = "exp"
+    fn = staticmethod(np.exp)
+
+
+class TestSquare(_ActTest):
+    op_type = "square"
+    fn = staticmethod(np.square)
+
+
+class TestSoftplus(_ActTest):
+    op_type = "softplus"
+    fn = staticmethod(lambda x: np.log1p(np.exp(-np.abs(x))) + np.maximum(x, 0))
+
+
+class TestLeakyRelu(_ActTest):
+    op_type = "leaky_relu"
+    fn = staticmethod(lambda x: np.where(x > 0, x, 0.02 * x))
+
+
+class TestSqrt(OpTest):
+    op_type = "sqrt"
+
+    def setUp(self):
+        super().setUp()
+        x = np.random.RandomState(21).uniform(0.2, 2, (4, 6)).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.sqrt(x)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+class TestLog(OpTest):
+    op_type = "log"
+
+    def setUp(self):
+        super().setUp()
+        x = np.random.RandomState(22).uniform(0.2, 2, (4, 6)).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.log(x)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+class TestGelu(_ActTest):
+    op_type = "gelu"
+
+    @staticmethod
+    def fn(x):
+        from scipy.special import erf
+
+        return 0.5 * x * (1 + erf(x / np.sqrt(2)))
+
+    def setUp(self):
+        try:
+            import scipy  # noqa: F401
+        except ImportError:
+            self.skipTest("scipy unavailable")
+        super().setUp()
+
+
+class TestSoftmaxOp(OpTest):
+    op_type = "softmax"
+
+    def setUp(self):
+        super().setUp()
+        x = np.random.RandomState(23).uniform(-1, 1, (5, 7)).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": _softmax_np(x)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+class TestSoftmaxAxis(OpTest):
+    op_type = "softmax"
+
+    def setUp(self):
+        super().setUp()
+        x = np.random.RandomState(24).uniform(-1, 1, (3, 5, 7)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": _softmax_np(x, axis=1)}
+
+    def test_output(self):
+        self.check_output()

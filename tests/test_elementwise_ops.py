@@ -1,0 +1,167 @@
+"""Elementwise op tests (reference: tests/unittests/test_elementwise_*_op.py)."""
+import numpy as np
+
+from op_test import OpTest
+
+
+class TestElementwiseAdd(OpTest):
+    op_type = "elementwise_add"
+
+    def setUp(self):
+        super().setUp()
+        rng = np.random.RandomState(1)
+        x = rng.uniform(0.1, 1, (3, 4)).astype("float32")
+        y = rng.uniform(0.1, 1, (3, 4)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x + y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseAddBroadcastAxis(OpTest):
+    op_type = "elementwise_add"
+
+    def setUp(self):
+        super().setUp()
+        rng = np.random.RandomState(2)
+        x = rng.uniform(0.1, 1, (2, 3, 4)).astype("float32")
+        y = rng.uniform(0.1, 1, (3,)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseSub(OpTest):
+    op_type = "elementwise_sub"
+
+    def setUp(self):
+        super().setUp()
+        rng = np.random.RandomState(3)
+        x = rng.uniform(0.1, 1, (4, 5)).astype("float32")
+        y = rng.uniform(0.1, 1, (4, 5)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x - y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseMul(OpTest):
+    op_type = "elementwise_mul"
+
+    def setUp(self):
+        super().setUp()
+        rng = np.random.RandomState(4)
+        x = rng.uniform(0.1, 1, (4, 5)).astype("float32")
+        y = rng.uniform(0.1, 1, (4, 5)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x * y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseDiv(OpTest):
+    op_type = "elementwise_div"
+
+    def setUp(self):
+        super().setUp()
+        rng = np.random.RandomState(5)
+        x = rng.uniform(0.5, 1, (4, 5)).astype("float32")
+        y = rng.uniform(0.5, 1, (4, 5)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x / y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+
+class TestElementwiseMax(OpTest):
+    op_type = "elementwise_max"
+
+    def setUp(self):
+        super().setUp()
+        rng = np.random.RandomState(6)
+        x = rng.uniform(0.1, 1, (4, 5)).astype("float32")
+        y = x + rng.uniform(0.2, 0.5, (4, 5)).astype("float32") * np.sign(rng.randn(4, 5)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.maximum(x, y)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestScale(OpTest):
+    op_type = "scale"
+
+    def setUp(self):
+        super().setUp()
+        x = np.random.RandomState(7).uniform(-1, 1, (5, 6)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"scale": 2.5, "bias": 0.5}
+        self.outputs = {"Out": x * 2.5 + 0.5}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestSum3(OpTest):
+    op_type = "sum"
+
+    def setUp(self):
+        super().setUp()
+        rng = np.random.RandomState(8)
+        xs = [("x%d" % i, rng.uniform(-1, 1, (3, 4)).astype("float32")) for i in range(3)]
+        self.inputs = {"X": xs}
+        self.outputs = {"Out": sum(a for _, a in xs)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestClip(OpTest):
+    op_type = "clip"
+
+    def setUp(self):
+        super().setUp()
+        x = np.random.RandomState(9).uniform(-2, 2, (4, 5)).astype("float32")
+        # keep away from clip boundaries for numeric grad
+        x[np.abs(x - 0.8) < 0.05] = 0.5
+        x[np.abs(x + 0.8) < 0.05] = -0.5
+        self.inputs = {"X": x}
+        self.attrs = {"min": -0.8, "max": 0.8}
+        self.outputs = {"Out": np.clip(x, -0.8, 0.8)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")

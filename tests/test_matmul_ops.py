@@ -1,0 +1,94 @@
+"""matmul / mul op tests (reference: tests/unittests/test_matmul_op.py, test_mul_op.py)."""
+import numpy as np
+
+from op_test import OpTest
+
+
+class TestMatmul(OpTest):
+    op_type = "matmul"
+
+    def setUp(self):
+        super().setUp()
+        rng = np.random.RandomState(10)
+        x = rng.uniform(-1, 1, (4, 5)).astype("float32")
+        y = rng.uniform(-1, 1, (5, 3)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.01)
+
+
+class TestMatmulTranspose(OpTest):
+    op_type = "matmul"
+
+    def setUp(self):
+        super().setUp()
+        rng = np.random.RandomState(11)
+        x = rng.uniform(-1, 1, (5, 4)).astype("float32")
+        y = rng.uniform(-1, 1, (3, 5)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_X": True, "transpose_Y": True}
+        self.outputs = {"Out": x.T @ y.T}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.01)
+
+
+class TestMatmulBatched(OpTest):
+    op_type = "matmul"
+
+    def setUp(self):
+        super().setUp()
+        rng = np.random.RandomState(12)
+        x = rng.uniform(-1, 1, (2, 4, 5)).astype("float32")
+        y = rng.uniform(-1, 1, (2, 5, 3)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.matmul(x, y)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.01)
+
+
+class TestMatmulAlpha(OpTest):
+    op_type = "matmul"
+
+    def setUp(self):
+        super().setUp()
+        rng = np.random.RandomState(13)
+        x = rng.uniform(-1, 1, (3, 4)).astype("float32")
+        y = rng.uniform(-1, 1, (4, 2)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"alpha": 2.0}
+        self.outputs = {"Out": 2.0 * (x @ y)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestMul(OpTest):
+    op_type = "mul"
+
+    def setUp(self):
+        super().setUp()
+        rng = np.random.RandomState(14)
+        x = rng.uniform(-1, 1, (4, 2, 3)).astype("float32")
+        y = rng.uniform(-1, 1, (6, 5)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+        self.outputs = {"Out": (x.reshape(4, 6) @ y).reshape(4, 5)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.01)

@@ -1,0 +1,116 @@
+"""Reduce op tests (reference: tests/unittests/test_reduce_op.py)."""
+import numpy as np
+
+from op_test import OpTest
+
+
+class TestReduceSum(OpTest):
+    op_type = "reduce_sum"
+
+    def setUp(self):
+        super().setUp()
+        x = np.random.RandomState(31).uniform(-1, 1, (3, 4, 5)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [1]}
+        self.outputs = {"Out": x.sum(axis=1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestReduceSumKeepDim(OpTest):
+    op_type = "reduce_sum"
+
+    def setUp(self):
+        super().setUp()
+        x = np.random.RandomState(32).uniform(-1, 1, (3, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [0], "keep_dim": True}
+        self.outputs = {"Out": x.sum(axis=0, keepdims=True)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestReduceAll(OpTest):
+    op_type = "reduce_sum"
+
+    def setUp(self):
+        super().setUp()
+        x = np.random.RandomState(33).uniform(-1, 1, (3, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"reduce_all": True}
+        self.outputs = {"Out": np.asarray(x.sum(), dtype="float32")}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestReduceMean(OpTest):
+    op_type = "reduce_mean"
+
+    def setUp(self):
+        super().setUp()
+        x = np.random.RandomState(34).uniform(-1, 1, (3, 4, 5)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [1, 2]}
+        self.outputs = {"Out": x.mean(axis=(1, 2))}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestReduceMax(OpTest):
+    op_type = "reduce_max"
+
+    def setUp(self):
+        super().setUp()
+        x = np.random.RandomState(35).permutation(60).reshape(3, 4, 5).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [-1]}
+        self.outputs = {"Out": x.max(axis=-1)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestReduceProd(OpTest):
+    op_type = "reduce_prod"
+
+    def setUp(self):
+        super().setUp()
+        x = np.random.RandomState(36).uniform(0.5, 1.5, (3, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [1]}
+        self.outputs = {"Out": x.prod(axis=1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+class TestMeanOp(OpTest):
+    op_type = "mean"
+
+    def setUp(self):
+        super().setUp()
+        x = np.random.RandomState(37).uniform(-1, 1, (4, 6)).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.asarray(x.mean(), dtype="float32")}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
