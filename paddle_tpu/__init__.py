@@ -42,6 +42,13 @@ from paddle_tpu import (
 )
 from paddle_tpu.backward import append_backward, gradients
 from paddle_tpu.param_attr import ParamAttr, WeightNormParamAttr
+from paddle_tpu import parallel
+from paddle_tpu.parallel.compiled_program import CompiledProgram
+from paddle_tpu.parallel.strategy import (
+    BuildStrategy,
+    DistributedStrategy,
+    ExecutionStrategy,
+)
 
 __version__ = "0.1.0"
 

@@ -5,3 +5,11 @@ TPU-native replacement for the reference's ParallelExecutor + NCCL stack
 parallel/compiled_program.py and parallel/fleet.py.
 """
 from paddle_tpu.parallel import env  # noqa: F401
+from paddle_tpu.parallel import mesh  # noqa: F401
+from paddle_tpu.parallel.mesh import MeshGuard, data_parallel_mesh, make_mesh  # noqa: F401
+from paddle_tpu.parallel.strategy import (  # noqa: F401
+    BuildStrategy,
+    DistributedStrategy,
+    ExecutionStrategy,
+)
+from paddle_tpu.parallel.compiled_program import CompiledProgram  # noqa: F401

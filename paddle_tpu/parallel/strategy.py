@@ -1,0 +1,77 @@
+"""BuildStrategy / ExecutionStrategy / DistributedStrategy parity objects.
+
+Reference: paddle/fluid/framework/details/build_strategy.h and
+python/paddle/fluid/incubate/fleet/collective/__init__.py:98.  Most of the
+reference's knobs steer its hand-built pass pipeline (fuse allreduce,
+hierarchical rings, memory reuse); under XLA those are compiler decisions,
+so the fields are accepted for API parity and the few that still mean
+something (gradient sharding, microbatches, mesh shape) steer jit
+shardings instead.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+__all__ = ["BuildStrategy", "ExecutionStrategy", "DistributedStrategy"]
+
+
+class BuildStrategy:
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.fuse_elewise_add_act_ops = True  # XLA fuses regardless
+        self.fuse_all_reduce_ops = True
+        self.fuse_all_optimizer_ops = True
+        self.fuse_broadcast_ops = True
+        self.memory_optimize = True
+        self.enable_inplace = True
+        self.enable_sequential_execution = False
+        self.remove_unnecessary_lock = True
+        self.sync_batch_norm = False
+        self.num_trainers = 1
+        self.trainer_id = 0
+        self.use_hierarchical_allreduce = False
+        self.hierarchical_allreduce_inter_nranks = 0
+        self.nccl_comm_num = 1
+
+
+class ExecutionStrategy:
+    class ExecutorType:
+        Default = 0
+        Experimental = 1
+
+    def __init__(self):
+        self.num_threads = 0
+        self.num_iteration_per_drop_scope = 1
+        self.num_iteration_per_run = 1
+        self.use_thread_pool = False
+        self.allow_op_delay = False
+
+
+class DistributedStrategy(BuildStrategy):
+    """Fleet collective-mode strategy (reference:
+    incubate/fleet/collective/__init__.py:98) extended with the TPU mesh
+    shape: axis name -> size. ``sharding_specs`` maps var names to
+    PartitionSpec tuples for model-parallel params."""
+
+    def __init__(self):
+        super().__init__()
+        self.mode = "collective"
+        self.collective_mode = "grad_allreduce"  # or "local_sgd"
+        self.local_sgd_steps = 1
+        self.use_local_sgd = False
+        self.use_dgc = False
+        self.mesh_axes: Dict[str, int] = {}
+        self.sharding_specs: Dict[str, tuple] = {}
+        self.exec_strategy = ExecutionStrategy()
+        self.use_amp = False
+        self.num_microbatches = 1
