@@ -1,0 +1,13 @@
+"""Model zoo: fluid-style program builders for the reference's book-test
+model families (reference: python/paddle/fluid/tests/book/) plus the
+benchmark flagships (ResNet-50, BERT/Transformer).
+
+Each builder appends ops to the current default_main_program (use
+``framework.program_guard``) and returns the key output Variables.
+"""
+from paddle_tpu.models import lenet, resnet, vgg, transformer, word2vec, deepfm  # noqa: F401
+from paddle_tpu.models.lenet import lenet5  # noqa: F401
+from paddle_tpu.models.resnet import resnet50  # noqa: F401
+from paddle_tpu.models.vgg import vgg16  # noqa: F401
+from paddle_tpu.models.transformer import bert_encoder, transformer_lm  # noqa: F401
+from paddle_tpu.models.deepfm import deepfm_ctr  # noqa: F401

@@ -1,0 +1,60 @@
+"""DeepFM CTR model — the sparse/high-dim-lookup benchmark family
+(BASELINE.md "DeepFM / Wide&Deep"; reference serves this class of model via
+the distributed lookup table + PSLib path, SURVEY.md §2.10).
+
+TPU design: the embedding table is a dense HBM gather; at scale the table
+shards over the ``ep`` mesh axis (parallel/auto_shard.py maps
+``*_fm_emb``/``*_deep_emb`` tables onto ``ep``).
+"""
+from __future__ import annotations
+
+from paddle_tpu import ParamAttr, layers
+
+__all__ = ["deepfm_ctr"]
+
+
+def deepfm_ctr(
+    feat_ids,
+    feat_vals,
+    labels,
+    num_features: int = 100000,
+    num_fields: int = 39,
+    embed_dim: int = 8,
+    deep_layers=(400, 400, 400),
+    name: str = "deepfm",
+):
+    """feat_ids: int64 [N, F, 1]; feat_vals: float32 [N, F]; labels [N, 1].
+
+    Returns (avg_loss, auc_prob) where auc_prob is the CTR probability.
+    """
+    vals = layers.reshape(feat_vals, shape=[0, num_fields, 1])
+
+    # ---- first-order (wide) term: sum_f w_id(f) * val(f)
+    w1 = layers.embedding(
+        layers.reshape(feat_ids, shape=[0, num_fields]),
+        size=[num_features, 1],
+        param_attr=ParamAttr(name=name + "_w1_emb"),
+    )  # [N, F, 1]
+    first = layers.reduce_sum(w1 * vals, dim=[1])  # [N, 1]
+
+    # ---- second-order FM term over [N, F, K] embeddings
+    emb = layers.embedding(
+        layers.reshape(feat_ids, shape=[0, num_fields]),
+        size=[num_features, embed_dim],
+        param_attr=ParamAttr(name=name + "_fm_emb"),
+    )  # [N, F, K]
+    xv = emb * vals
+    sum_sq = layers.square(layers.reduce_sum(xv, dim=[1]))  # [N, K]
+    sq_sum = layers.reduce_sum(layers.square(xv), dim=[1])  # [N, K]
+    second = layers.scale(layers.reduce_sum(sum_sq - sq_sum, dim=[1], keep_dim=True), scale=0.5)
+
+    # ---- deep tower over flattened embeddings
+    deep = layers.reshape(xv, shape=[0, num_fields * embed_dim])
+    for i, width in enumerate(deep_layers):
+        deep = layers.fc(deep, size=width, act="relu", param_attr=ParamAttr(name="%s_deep_fc%d_w" % (name, i)))
+    deep_out = layers.fc(deep, size=1, param_attr=ParamAttr(name=name + "_deep_out_w"))
+
+    logits = first + second + deep_out
+    loss = layers.sigmoid_cross_entropy_with_logits(logits, layers.cast(labels, "float32"))
+    prob = layers.sigmoid(logits)
+    return layers.mean(loss), prob

@@ -1,0 +1,152 @@
+"""End-to-end model tests — the reference's "book" test style
+(python/paddle/fluid/tests/book/): build a real model, train a few steps
+on synthetic data, assert the loss decreases.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import framework, models
+
+
+def _train_steps(build_fn, feeds_fn, steps=4, lr=0.01, opt=None, seed=3):
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = seed
+    startup.random_seed = seed
+    with framework.program_guard(prog, startup):
+        loss = build_fn()
+        (opt or fluid.optimizer.AdamOptimizer(learning_rate=lr)).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(seed)
+    feed = feeds_fn(rng)  # one fixed batch: the model must be able to memorize it
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for _ in range(steps):
+            (l,) = exe.run(prog, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(l)))
+    return losses
+
+
+def test_lenet_mnist_trains():
+    def build():
+        img = fluid.layers.data("img", [1, 28, 28])
+        lbl = fluid.layers.data("lbl", [1], dtype="int64")
+        avg_loss, acc, _ = models.lenet5(img, lbl)
+        return avg_loss
+
+    def feeds(rng):
+        return {
+            "img": rng.uniform(-1, 1, (16, 1, 28, 28)).astype("float32"),
+            "lbl": rng.randint(0, 10, (16, 1)).astype("int64"),
+        }
+
+    losses = _train_steps(build, feeds, steps=6, lr=0.001)
+    assert losses[-1] < losses[0], losses
+
+
+def test_resnet18_tiny_trains():
+    def build():
+        img = fluid.layers.data("img", [3, 32, 32])
+        lbl = fluid.layers.data("lbl", [1], dtype="int64")
+        avg_loss, acc, _ = models.resnet.resnet18(img, lbl, class_num=10)
+        return avg_loss
+
+    def feeds(rng):
+        return {
+            "img": rng.uniform(-1, 1, (8, 3, 32, 32)).astype("float32"),
+            "lbl": rng.randint(0, 10, (8, 1)).astype("int64"),
+        }
+
+    losses = _train_steps(build, feeds, steps=4, lr=0.001)
+    assert losses[-1] < losses[0], losses
+
+
+def test_transformer_lm_trains():
+    V, S = 100, 16
+
+    def build():
+        src = fluid.layers.data("src", [S], dtype="int64")
+        tgt = fluid.layers.data("tgt", [S, 1], dtype="int64")
+        avg_loss, _ = models.transformer.transformer_lm(
+            src, tgt, vocab_size=V, d_model=32, n_layer=2, n_head=4,
+            d_inner=64, seq_len=S, max_pos=S,
+        )
+        return avg_loss
+
+    def feeds(rng):
+        toks = rng.randint(0, V, (4, S + 1))
+        return {
+            "src": toks[:, :-1].astype("int64"),
+            "tgt": toks[:, 1:, None].astype("int64"),
+        }
+
+    losses = _train_steps(build, feeds, steps=5, lr=0.01)
+    assert losses[-1] < losses[0], losses
+
+
+def test_bert_encoder_shapes():
+    S = 16
+
+    def build():
+        src = fluid.layers.data("src", [S], dtype="int64")
+        mask = fluid.layers.data("mask", [S], dtype="float32")
+        seq = models.transformer.bert_encoder(
+            src, input_mask=mask, vocab_size=50, d_model=32, n_layer=2,
+            n_head=4, d_inner=64, max_pos=S, seq_len=S,
+        )
+        pooled = fluid.layers.reduce_mean(seq, dim=[1])
+        lbl = fluid.layers.data("lbl", [1], dtype="int64")
+        logits = fluid.layers.fc(pooled, size=2, act="softmax")
+        return fluid.layers.mean(fluid.layers.cross_entropy(logits, lbl))
+
+    def feeds(rng):
+        return {
+            "src": rng.randint(0, 50, (4, S)).astype("int64"),
+            "mask": np.ones((4, S), dtype="float32"),
+            "lbl": rng.randint(0, 2, (4, 1)).astype("int64"),
+        }
+
+    losses = _train_steps(build, feeds, steps=4)
+    assert losses[-1] < losses[0], losses
+
+
+def test_word2vec_trains():
+    V = 50
+
+    def build():
+        ws = [fluid.layers.data("w%d" % i, [1], dtype="int64") for i in range(4)]
+        nxt = fluid.layers.data("next", [1], dtype="int64")
+        avg_loss, _ = models.word2vec.word2vec_ngram(ws, nxt, dict_size=V, embed_size=8, hidden_size=32)
+        return avg_loss
+
+    def feeds(rng):
+        d = {"w%d" % i: rng.randint(0, V, (16, 1)).astype("int64") for i in range(4)}
+        d["next"] = rng.randint(0, V, (16, 1)).astype("int64")
+        return d
+
+    losses = _train_steps(build, feeds, steps=6, lr=0.05)
+    assert losses[-1] < losses[0], losses
+
+
+def test_deepfm_trains():
+    F, NF = 8, 200
+
+    def build():
+        ids = fluid.layers.data("ids", [F, 1], dtype="int64")
+        vals = fluid.layers.data("vals", [F], dtype="float32")
+        lbl = fluid.layers.data("lbl", [1], dtype="int64")
+        avg_loss, _ = models.deepfm_ctr(
+            ids, vals, lbl, num_features=NF, num_fields=F, embed_dim=4, deep_layers=(16, 16)
+        )
+        return avg_loss
+
+    def feeds(rng):
+        return {
+            "ids": rng.randint(0, NF, (32, F, 1)).astype("int64"),
+            "vals": rng.uniform(0, 1, (32, F)).astype("float32"),
+            "lbl": rng.randint(0, 2, (32, 1)).astype("int64"),
+        }
+
+    losses = _train_steps(build, feeds, steps=6, lr=0.05)
+    assert losses[-1] < losses[0], losses
