@@ -100,10 +100,15 @@ class Executor:
         )
         state_out = tuple(sorted(written & persistable))
 
-        # materialize feed on the target device
+        # materialize feed on the target device; values that are already
+        # jax Arrays (e.g. a device-resident input pipeline, reader.py)
+        # pass through untouched — no host round-trip
         device = self._device()
         feed_arrays = {}
         for name, val in feed.items():
+            if isinstance(val, jax.Array):
+                feed_arrays[name] = val
+                continue
             var = block._find_var_recursive(name)
             dtype = core_types.np_dtype(var.dtype) if var is not None else None
             arr = np.asarray(val, dtype=dtype)
