@@ -43,6 +43,21 @@ from paddle_tpu import (
 from paddle_tpu.backward import append_backward, gradients
 from paddle_tpu.param_attr import ParamAttr, WeightNormParamAttr
 from paddle_tpu import parallel
+from paddle_tpu import io
+from paddle_tpu import reader
+from paddle_tpu import dataset
+from paddle_tpu.reader import PyReader, batch
+from paddle_tpu.data_feeder import DataFeeder
+from paddle_tpu.io import (
+    load_inference_model,
+    load_params,
+    load_persistables,
+    load_vars,
+    save_inference_model,
+    save_params,
+    save_persistables,
+    save_vars,
+)
 from paddle_tpu.parallel.compiled_program import CompiledProgram
 from paddle_tpu.parallel.strategy import (
     BuildStrategy,

@@ -1,0 +1,56 @@
+"""DataFeeder: sample lists -> feed dicts.
+
+Reference: python/paddle/fluid/data_feeder.py (DataFeeder converts reader
+output tuples into LoDTensor feed dicts).  TPU version produces numpy
+batches (padded dense); ragged sequence inputs use the padded+length
+encoding from ops/sequence_ops.py.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from paddle_tpu.core import types as core_types
+
+__all__ = ["DataFeeder"]
+
+
+class DataFeeder:
+    def __init__(self, feed_list: Sequence, place=None, program=None):
+        self.feed_vars = list(feed_list)
+        self.place = place
+
+    def feed(self, iterable) -> dict:
+        """iterable: list of sample tuples, one entry per feed var."""
+        cols = list(zip(*iterable))
+        if len(cols) != len(self.feed_vars):
+            raise ValueError(
+                "sample width %d != #feed vars %d" % (len(cols), len(self.feed_vars))
+            )
+        out = {}
+        for var, col in zip(self.feed_vars, cols):
+            dtype = core_types.np_dtype(var.dtype)
+            arrs = [np.asarray(c) for c in col]
+            if var.lod_level and var.lod_level > 0:
+                # ragged: pad to max length, emit companion length vector
+                lens = np.array([a.shape[0] for a in arrs], dtype="int32")
+                maxlen = int(lens.max()) if len(lens) else 0
+                trailing = arrs[0].shape[1:] if arrs else ()
+                padded = np.zeros((len(arrs), maxlen) + tuple(trailing), dtype=dtype)
+                for i, a in enumerate(arrs):
+                    padded[i, : a.shape[0]] = a
+                out[var.name] = padded
+                out[var.name + "_seq_len"] = lens
+            else:
+                batch = np.stack(arrs).astype(dtype)
+                # reference reshapes flat samples to the declared shape
+                want = var.shape
+                if want is not None and len(batch.shape) != len(want):
+                    concrete = [s if s != -1 else batch.shape[0] for s in want]
+                    try:
+                        batch = batch.reshape(concrete)
+                    except ValueError:
+                        pass
+                out[var.name] = batch
+        return out

@@ -1,0 +1,209 @@
+"""Checkpoint / persistence.
+
+Reference: python/paddle/fluid/io.py — save_vars:109, save_persistables:477,
+load_vars:529, load_persistables:718, save_inference_model:925,
+load_inference_model:1116.  The reference emits ``save``/``load`` *ops*
+into tiny programs and runs them through the executor
+(operators/save_op.cc); on TPU a graph-side save would force a d2h
+transfer anyway, so save/load here are host-side: values are pulled from
+the Scope (device→host), written as one ``.npy`` per var plus a manifest,
+and pushed back on load.  Format is versioned so checkpoints round-trip
+across processes/hosts.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu import framework
+from paddle_tpu.framework import Parameter, Program, Variable
+from paddle_tpu.scope import global_scope
+
+__all__ = [
+    "save_vars",
+    "save_params",
+    "save_persistables",
+    "load_vars",
+    "load_params",
+    "load_persistables",
+    "save_inference_model",
+    "load_inference_model",
+]
+
+_MANIFEST = "__manifest__.json"
+_MODEL_FILE = "__model__"
+
+
+def _is_persistable(var: Variable) -> bool:
+    return bool(var.persistable) and not var.is_data
+
+
+def _collect(program: Program, predicate: Callable[[Variable], bool], vars=None) -> List[Variable]:
+    if vars is not None:
+        return [v if isinstance(v, Variable) else program.global_block().var(v) for v in vars]
+    seen, out = set(), []
+    for v in program.list_vars():
+        if v.name not in seen and predicate(v):
+            seen.add(v.name)
+            out.append(v)
+    return out
+
+
+def _var_path(dirname: str, name: str) -> str:
+    # var names may contain '/' from name_scope prefixes
+    return os.path.join(dirname, name.replace("/", "%2F") + ".npy")
+
+
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None, filename=None):
+    """reference: io.py:109.  ``filename`` packs everything into one .npz."""
+    program = main_program or framework.default_main_program()
+    scope = global_scope()
+    to_save = _collect(program, predicate or _is_persistable, vars)
+    os.makedirs(dirname, exist_ok=True)
+    manifest = {"format_version": 1, "vars": []}
+    arrays = {}
+    for v in to_save:
+        val = scope.get(v.name)
+        if val is None:
+            raise RuntimeError("variable %r has no value in scope; run startup first" % v.name)
+        arr = np.asarray(val)
+        arrays[v.name] = arr
+        manifest["vars"].append(
+            {
+                "name": v.name,
+                "shape": list(arr.shape),
+                "dtype": str(arr.dtype),
+                "is_parameter": isinstance(v, Parameter),
+            }
+        )
+    if filename is not None:
+        np.savez(os.path.join(dirname, filename), **arrays)
+        manifest["packed_file"] = filename
+    else:
+        for name, arr in arrays.items():
+            np.save(_var_path(dirname, name), arr)
+    with open(os.path.join(dirname, _MANIFEST), "w") as f:
+        json.dump(manifest, f)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    return save_vars(
+        executor, dirname, main_program,
+        predicate=lambda v: isinstance(v, Parameter), filename=filename,
+    )
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """reference: io.py:477 — params + optimizer state + LR etc."""
+    return save_vars(executor, dirname, main_program, predicate=_is_persistable, filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None, filename=None):
+    """reference: io.py:529.  Loads into the current global scope."""
+    program = main_program or framework.default_main_program()
+    scope = global_scope()
+    import jax.numpy as jnp
+
+    with open(os.path.join(dirname, _MANIFEST)) as f:
+        manifest = json.load(f)
+    packed = None
+    if manifest.get("packed_file"):
+        packed = np.load(os.path.join(dirname, manifest["packed_file"] + (".npz" if not manifest["packed_file"].endswith(".npz") else "")))
+    wanted = None
+    if vars is not None or predicate is not None:
+        wanted = {v.name for v in _collect(program, predicate or _is_persistable, vars)}
+    for entry in manifest["vars"]:
+        name = entry["name"]
+        if wanted is not None and name not in wanted:
+            continue
+        if packed is not None:
+            arr = packed[name]
+        else:
+            arr = np.load(_var_path(dirname, name))
+        var = program.global_block()._find_var_recursive(name)
+        if var is not None and var.shape is not None:
+            expect = tuple(s for s in var.shape)
+            if tuple(arr.shape) != expect and -1 not in expect:
+                raise ValueError(
+                    "shape mismatch loading %r: checkpoint %s vs program %s"
+                    % (name, arr.shape, expect)
+                )
+        scope.set(name, jnp.asarray(arr))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    return load_vars(
+        executor, dirname, main_program,
+        predicate=lambda v: isinstance(v, Parameter), filename=filename,
+    )
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program, predicate=_is_persistable, filename=filename)
+
+
+# ---------------------------------------------------------------------------
+# Inference model: prune to fetch targets + save (reference io.py:925)
+# ---------------------------------------------------------------------------
+def _prune_program(program: Program, feed_names: Sequence[str], fetch_names: Sequence[str]) -> Program:
+    """Backward slice of block-0 ops from the fetch targets (the
+    reference's Prune, framework/prune.cc)."""
+    pruned = program.clone(for_test=True)
+    block = pruned.global_block()
+    needed = set(fetch_names)
+    kept = []
+    for op in reversed(block.ops):
+        if any(n in needed for n in op.output_arg_names):
+            kept.append(op)
+            needed.update(op.input_arg_names)
+    kept.reverse()
+    block.ops = kept
+    used = set(feed_names) | set(fetch_names)
+    for op in kept:
+        used.update(op.input_arg_names)
+        used.update(op.output_arg_names)
+    block.vars = {n: v for n, v in block.vars.items() if n in used}
+    return pruned
+
+
+def save_inference_model(
+    dirname,
+    feeded_var_names: Sequence[str],
+    target_vars: Sequence,
+    executor,
+    main_program: Optional[Program] = None,
+    model_filename=None,
+    params_filename=None,
+):
+    """reference: io.py:925 — prune + save program and params."""
+    program = main_program or framework.default_main_program()
+    fetch_names = [t.name if isinstance(t, Variable) else str(t) for t in target_vars]
+    pruned = _prune_program(program, feeded_var_names, fetch_names)
+    os.makedirs(dirname, exist_ok=True)
+    model = {
+        "format_version": 1,
+        "program": json.loads(pruned.to_json()),
+        "feed_names": list(feeded_var_names),
+        "fetch_names": fetch_names,
+    }
+    with open(os.path.join(dirname, model_filename or _MODEL_FILE), "w") as f:
+        json.dump(model, f)
+    save_vars(
+        executor, dirname, pruned,
+        predicate=lambda v: isinstance(v, Parameter) or (_is_persistable(v)),
+        filename=params_filename,
+    )
+    return fetch_names
+
+
+def load_inference_model(dirname, executor, model_filename=None, params_filename=None):
+    """reference: io.py:1116 — returns (program, feed_names, fetch_vars)."""
+    with open(os.path.join(dirname, model_filename or _MODEL_FILE)) as f:
+        model = json.load(f)
+    program = Program.from_json(json.dumps(model["program"]))
+    load_vars(executor, dirname, program, filename=params_filename)
+    fetch_vars = [program.global_block().var(n) for n in model["fetch_names"]]
+    return program, model["feed_names"], fetch_vars

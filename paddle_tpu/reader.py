@@ -1,0 +1,268 @@
+"""Input pipeline: PyReader + composable reader decorators.
+
+Reference: python/paddle/fluid/reader.py:47 (PyReader over a
+LoDTensorBlockingQueue + graph ``read`` op, reader/double_buffer prefetch)
+and python/paddle/reader/decorator.py (shuffle/batch/buffered/...).
+
+TPU design: instead of in-graph reader ops, PyReader is a host-side
+background-thread pipeline that converts batches and issues async
+``jax.device_put`` — by the time the training step needs batch N+1 it is
+already in HBM (the double_buffer analog; this matters even more on TPU
+where the host link is the usual bottleneck).  The executor accepts the
+resulting device arrays as feeds untouched (executor.py feed passthrough).
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import random
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu import framework
+from paddle_tpu.core import types as core_types
+
+__all__ = [
+    "PyReader",
+    "DataLoader",
+    "shuffle",
+    "batch",
+    "buffered",
+    "map_readers",
+    "chain",
+    "compose",
+    "firstn",
+    "cache",
+]
+
+
+# ---------------------------------------------------------------------------
+# Reader decorators (reference: python/paddle/reader/decorator.py)
+# ---------------------------------------------------------------------------
+def shuffle(reader, buf_size: int, seed: Optional[int] = None):
+    def reader_():
+        rng = random.Random(seed)
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        rng.shuffle(buf)
+        yield from buf
+
+    return reader_
+
+
+def batch(reader, batch_size: int, drop_last: bool = False):
+    def reader_():
+        b = []
+        for item in reader():
+            b.append(item)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return reader_
+
+
+def buffered(reader, size: int):
+    """Prefetch into a bounded queue on a background thread."""
+
+    class _End:
+        pass
+
+    def reader_():
+        q: queue.Queue = queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for item in reader():
+                    q.put(item)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _End:
+                break
+            yield item
+
+    return reader_
+
+
+def map_readers(func: Callable, *readers):
+    def reader_():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+
+    return reader_
+
+
+def chain(*readers):
+    def reader_():
+        for r in readers:
+            yield from r()
+
+    return reader_
+
+
+def compose(*readers, check_alignment: bool = True):
+    def reader_():
+        iters = [r() for r in readers]
+        for items in zip(*iters):
+            out = []
+            for it in items:
+                out.extend(it if isinstance(it, tuple) else (it,))
+            yield tuple(out)
+
+    return reader_
+
+
+def firstn(reader, n: int):
+    def reader_():
+        return itertools.islice(reader(), n)
+
+    return reader_
+
+
+def cache(reader):
+    data: List[Any] = []
+    loaded = [False]
+
+    def reader_():
+        if not loaded[0]:
+            data.extend(reader())
+            loaded[0] = True
+        return iter(data)
+
+    return reader_
+
+
+# ---------------------------------------------------------------------------
+# PyReader
+# ---------------------------------------------------------------------------
+class PyReader:
+    """Iterable data pipeline bound to feed vars (reference: reader.py:47).
+
+    ``for data in reader():`` yields feed dicts whose values are already
+    on-device jax Arrays (async-transferred ahead of use).
+    """
+
+    def __init__(
+        self,
+        feed_list: Optional[Sequence] = None,
+        capacity: int = 4,
+        use_double_buffer: bool = True,
+        iterable: bool = True,
+        return_list: bool = False,
+    ):
+        self._feed_vars = list(feed_list or [])
+        self._capacity = max(2, int(capacity))
+        self._use_double_buffer = use_double_buffer
+        self._iterable = iterable
+        self._return_list = return_list
+        self._generator: Optional[Callable] = None
+        self._places = None
+
+    # --- decoration (reference API) ---
+    def decorate_sample_list_generator(self, generator, places=None):
+        """generator yields lists of sample tuples (one list = one batch)."""
+
+        def batch_gen():
+            for samples in generator():
+                arrays = []
+                for i, var in enumerate(self._feed_vars):
+                    col = [s[i] for s in samples]
+                    arrays.append(self._to_array(col, var))
+                yield arrays
+
+        self._generator = batch_gen
+        self._places = places
+        return self
+
+    def decorate_batch_generator(self, generator, places=None):
+        """generator yields ready batches: tuples/lists of ndarrays."""
+
+        def batch_gen():
+            for batch_arrays in generator():
+                if isinstance(batch_arrays, dict):
+                    arrays = [batch_arrays[v.name] for v in self._feed_vars]
+                else:
+                    arrays = list(batch_arrays)
+                arrays = [
+                    self._cast(np.asarray(a), var)
+                    for a, var in zip(arrays, self._feed_vars)
+                ]
+                yield arrays
+
+        self._generator = batch_gen
+        self._places = places
+        return self
+
+    decorate_tensor_provider = decorate_batch_generator  # legacy alias
+
+    def _cast(self, arr: np.ndarray, var) -> np.ndarray:
+        want = core_types.np_dtype(var.dtype)
+        return arr.astype(want) if arr.dtype != want else arr
+
+    def _to_array(self, col, var) -> np.ndarray:
+        return self._cast(np.stack([np.asarray(c) for c in col]), var)
+
+    # --- iteration ---
+    def __call__(self):
+        return self._iter()
+
+    def __iter__(self):
+        return self._iter()
+
+    def _iter(self):
+        if self._generator is None:
+            raise RuntimeError("PyReader is not decorated with a generator")
+        import jax
+
+        device = None
+        if self._use_double_buffer:
+            try:
+                device = jax.devices()[0]
+            except Exception:
+                device = None
+        names = [v.name for v in self._feed_vars]
+
+        def produce():
+            for arrays in self._generator():
+                if device is not None:
+                    arrays = [jax.device_put(a, device) for a in arrays]
+                yield arrays
+
+        src = buffered(produce, self._capacity)() if self._use_double_buffer else produce()
+        for arrays in src:
+            if self._return_list:
+                yield list(arrays)
+            else:
+                yield dict(zip(names, arrays))
+
+    # --- legacy non-iterable surface ---
+    def start(self):
+        self._started_iter = self._iter()
+
+    def reset(self):
+        self._started_iter = None
+
+    def next(self):
+        return next(self._started_iter)
+
+
+class DataLoader:
+    """Minimal parity shim for fluid.io.DataLoader.from_generator."""
+
+    @staticmethod
+    def from_generator(feed_list=None, capacity=4, use_double_buffer=True, iterable=True, return_list=False):
+        return PyReader(feed_list, capacity, use_double_buffer, iterable, return_list)

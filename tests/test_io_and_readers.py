@@ -1,0 +1,136 @@
+"""Checkpoint round-trip + inference model + reader pipeline tests.
+
+Reference style: book tests assert save/load inference model round-trips
+(tests/book/test_recognize_digits.py), unittests cover reader decorators
+(test_multiprocess_reader_exception.py etc).
+"""
+import os
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import framework
+
+
+def _build_regression(seed=11):
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = seed
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [13])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.01).minimize(loss)
+    return prog, startup, loss, pred
+
+
+def test_save_load_persistables_roundtrip(tmp_path):
+    prog, startup, loss, _ = _build_regression()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(8, 13).astype("float32"), "y": rng.rand(8, 1).astype("float32")}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(prog, feed=feed, fetch_list=[loss])
+        fluid.save_persistables(exe, str(tmp_path / "ckpt"), prog)
+        before = {n: np.asarray(scope.get(n)) for n in scope.local_var_names()}
+
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe.run(startup)  # different values
+        fluid.load_persistables(exe, str(tmp_path / "ckpt"), prog)
+        for n, v in before.items():
+            got = scope2.get(n)
+            if got is not None:
+                np.testing.assert_allclose(np.asarray(got), v, rtol=2e-5, atol=1e-6)
+        # training resumes from the checkpoint
+        exe.run(prog, feed=feed, fetch_list=[loss])
+
+
+def test_save_load_inference_model(tmp_path):
+    prog, startup, loss, pred = _build_regression()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(3)
+    xb = rng.rand(4, 13).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        test_prog = prog.clone(for_test=True)  # no optimizer ops -> no mutation
+        (p1,) = exe.run(test_prog, feed={"x": xb, "y": np.zeros((4, 1), "float32")}, fetch_list=[pred])
+        fluid.save_inference_model(str(tmp_path / "model"), ["x"], [pred], exe, prog)
+
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        infer_prog, feeds, fetches = fluid.load_inference_model(str(tmp_path / "model"), exe)
+        assert feeds == ["x"]
+        # pruned program must not contain loss/optimizer ops
+        types = {op.type for op in infer_prog.global_block().ops}
+        assert "sgd" not in types and "square_error_cost" not in types
+        (p2,) = exe.run(infer_prog, feed={"x": xb}, fetch_list=fetches)
+    np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), rtol=1e-5)
+
+
+def test_reader_decorators():
+    from paddle_tpu import reader as R
+
+    def src():
+        yield from range(10)
+
+    assert list(R.firstn(src, 3)()) == [0, 1, 2]
+    assert sorted(list(R.shuffle(src, 5, seed=0)())) == list(range(10))
+    bs = list(R.batch(src, 4)())
+    assert [len(b) for b in bs] == [4, 4, 2]
+    assert list(R.batch(src, 4, drop_last=True)())[-1] == [4, 5, 6, 7]
+    assert list(R.buffered(src, 2)()) == list(range(10))
+    assert list(R.map_readers(lambda a, b: a + b, src, src)()) == [2 * i for i in range(10)]
+    c = R.cache(src)
+    assert list(c()) == list(c()) == list(range(10))
+
+
+def test_pyreader_feeds_training():
+    from paddle_tpu import dataset, reader as R
+
+    prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(prog, startup):
+        img = fluid.layers.data("img", [784])
+        lbl = fluid.layers.data("lbl", [1], dtype="int64")
+        hidden = fluid.layers.fc(img, 64, act="relu")
+        p = fluid.layers.fc(hidden, 10, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(p, lbl))
+        fluid.optimizer.AdamOptimizer(0.001).minimize(loss)
+
+    py_reader = fluid.PyReader(feed_list=[img, lbl], capacity=4)
+
+    def sample_gen():
+        for im, lb in dataset.mnist.train(size=256)():
+            yield im, np.array([lb], dtype="int64")
+
+    py_reader.decorate_sample_list_generator(R.batch(sample_gen, 32))
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for epoch in range(4):
+            for feed in py_reader():
+                (l,) = exe.run(prog, feed=feed, fetch_list=[loss])
+                losses.append(float(np.asarray(l)))
+    assert np.mean(losses[-4:]) < np.mean(losses[:4]), losses
+
+
+def test_data_feeder_dense_and_ragged():
+    prog = framework.Program()
+    with framework.program_guard(prog, framework.Program()):
+        x = fluid.layers.data("x", [4])
+        seq = fluid.layers.data("seq", [3], dtype="float32", lod_level=1)
+    feeder = fluid.DataFeeder([x, seq], fluid.CPUPlace())
+    samples = [
+        (np.ones(4, "float32"), np.ones((2, 3), "float32")),
+        (np.zeros(4, "float32"), np.ones((5, 3), "float32")),
+    ]
+    d = feeder.feed(samples)
+    assert d["x"].shape == (2, 4)
+    assert d["seq"].shape == (2, 5, 3)
+    np.testing.assert_array_equal(d["seq_seq_len"], [2, 5])
