@@ -75,11 +75,14 @@ def _make_grad_op_descs(op: Operator, opdef, out_grad_names: Dict[str, str], req
     for slot, names in op.inputs.items():
         g_inputs[slot] = list(names)
     grad_out_slots = []
+    empty_mask = {}
     for slot, names in op.outputs.items():
         gnames = [out_grad_names.get(n) for n in names]
         if any(g is not None for g in gnames):
             g_inputs[slot + "@GRAD"] = [g if g is not None else registry.EMPTY_VAR_NAME for g in gnames]
             grad_out_slots.append(slot)
+            if any(g is None for g in gnames):
+                empty_mask[slot] = [g is None for g in gnames]
     g_outputs: Dict[str, List[str]] = {}
     want_slots = []
     for slot, names in op.inputs.items():
@@ -100,6 +103,10 @@ def _make_grad_op_descs(op: Operator, opdef, out_grad_names: Dict[str, str], req
     attrs = dict(op.attrs)
     attrs["__fwd_output_slots__"] = tuple(op.outputs.keys())
     attrs["__grad_input_slots__"] = tuple(want_slots)
+    if empty_mask:
+        # positions whose upstream grad is absent (EMPTY_VAR_NAME inputs
+        # are dropped at trace time; the vjp kernel re-inserts zeros here)
+        attrs["__empty_out_grad_mask__"] = empty_mask
     attrs["op_role"] = "backward"
     return g_inputs, g_outputs, attrs
 

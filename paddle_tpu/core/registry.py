@@ -178,9 +178,14 @@ def make_vjp_grad_kernel(fwd: OpDef) -> KernelFn:
                 g = g.reshape(v.shape)
             return g.astype(v.dtype)
 
+        empty_mask = attrs.get("__empty_out_grad_mask__", {})
         cots = {}
         for slot, vals in primals.items():
             gs = out_grads.get(slot)
+            mask = empty_mask.get(slot)
+            if gs is not None and mask is not None:
+                it = iter(gs)
+                gs = [None if empty else next(it) for empty in mask]
             cots[slot] = [conform(g, v) for v, g in zip(vals, (gs or [None] * len(vals)))]
         (in_grads,) = vjp_fn(cots)
         result = {}

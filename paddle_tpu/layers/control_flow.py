@@ -1,0 +1,318 @@
+"""Control-flow layers: While, StaticRNN, cond, increment.
+
+Reference: python/paddle/fluid/layers/control_flow.py — While:630,
+StaticRNN:280, ConditionalBlock:1352, IfElse:1564.  The reference runs
+sub-blocks through a nested Executor over scope chains; here the layer
+classes compute the *loop-carried variable set* at build time and emit a
+single structural op ("while" / "static_rnn" / "select_branch",
+ops/control_flow_ops.py) that traces the sub-block into lax control flow.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from paddle_tpu import framework, unique_name
+from paddle_tpu.framework import Variable
+from paddle_tpu.layer_helper import LayerHelper
+
+__all__ = ["While", "StaticRNN", "cond", "increment"]
+
+
+def increment(x, value=1.0, in_place=True):
+    """reference: layers/control_flow.py increment."""
+    helper = LayerHelper("increment")
+    out = x if in_place else helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="scale",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"scale": 1.0, "bias": float(value)},
+    )
+    return out
+
+
+def _analyze_sub_block(sub_block, exclude_locals=()):
+    """Return (carried, externals): names written by sub-block ops that
+    live in an outer block (mutated loop state), and outer names read
+    but never locally produced."""
+    produced = set(exclude_locals)
+    carried: List[str] = []
+    externals: List[str] = []
+    parent = sub_block.parent_block
+    for op in sub_block.ops:
+        for n in op.input_arg_names:
+            if n in produced or n in carried or n in externals:
+                continue
+            if parent is not None and parent.has_var(n):
+                externals.append(n)
+        for n in op.output_arg_names:
+            if parent is not None and parent.has_var(n) and n not in sub_block.vars:
+                if n not in carried:
+                    carried.append(n)
+            produced.add(n)
+    # a var both carried and external is loop state, not a constant input
+    externals = [n for n in externals if n not in carried]
+    return carried, externals
+
+
+class While:
+    """reference: layers/control_flow.py:630.
+
+    ::
+
+        i = layers.fill_constant(shape=[1], dtype='int64', value=0)
+        cond = layers.less_than(i, limit)
+        loop = layers.While(cond)
+        with loop.block():
+            ...  # ops mutating outer vars
+            layers.less_than(i, limit, cond=cond)
+    """
+
+    def __init__(self, cond: Variable, is_test: bool = False, name: Optional[str] = None):
+        self.cond_var = cond
+        self.helper = LayerHelper("while", name=name)
+
+    class _BlockGuard:
+        def __init__(self, w):
+            self.w = w
+
+        def __enter__(self):
+            prog = framework.default_main_program()
+            self.w.sub_block = prog._create_block()
+            return self.w.sub_block
+
+        def __exit__(self, exc_type, *a):
+            if exc_type is not None:
+                return False
+            prog = framework.default_main_program()
+            prog._rollback()
+            w = self.w
+            carried, externals = _analyze_sub_block(w.sub_block)
+            if w.cond_var.name not in carried:
+                carried.insert(0, w.cond_var.name)
+            parent = prog.current_block()
+            parent.append_op(
+                type="while",
+                inputs={"X": carried + externals},
+                outputs={"Out": list(carried)},
+                attrs={
+                    "sub_block": w.sub_block,
+                    "carry_names": list(carried),
+                    "external_names": list(externals),
+                    "cond_name": w.cond_var.name,
+                },
+            )
+            return False
+
+    def block(self):
+        return While._BlockGuard(self)
+
+
+def cond(pred: Variable, true_fn, false_fn):
+    """Functional two-armed conditional (modern fluid layers.cond API;
+    subsumes IfElse/ConditionalBlock for the common case)."""
+    prog = framework.default_main_program()
+    parent = prog.current_block()
+
+    def build(fn):
+        blk = prog._create_block()
+        outs = fn()
+        prog._rollback()
+        if outs is None:
+            outs = ()
+        if isinstance(outs, Variable):
+            outs = (outs,)
+        return blk, [o.name for o in outs], list(outs)
+
+    tblk, tnames, touts = build(true_fn)
+    fblk, fnames, fouts = build(false_fn)
+    if len(tnames) != len(fnames):
+        raise ValueError("cond branches must return the same number of outputs")
+
+    # externals = union of both branches' outer reads
+    _, text = _analyze_sub_block(tblk)
+    _, fext = _analyze_sub_block(fblk)
+    externals = list(dict.fromkeys(text + fext))
+
+    # false branch vars are renamed into the true branch's output names
+    # so both arms bind the same out_names
+    rename = dict(zip(fnames, tnames))
+    for op in fblk.ops:
+        for old, new in rename.items():
+            op._rename_output(old, new)
+            op._rename_input(old, new)
+
+    out_vars = []
+    for tv in touts:
+        ov = parent.create_var(
+            name=unique_name.generate(tv.name + ".cond_out"),
+            shape=tv.shape,
+            dtype=tv.dtype,
+        )
+        out_vars.append(ov)
+    parent.append_op(
+        type="select_branch",
+        inputs={"Cond": [pred], "X": externals},
+        outputs={"Out": [v.name for v in out_vars]},
+        attrs={
+            "true_block": tblk,
+            "false_block": fblk,
+            "out_names": tnames,
+            "external_names": externals,
+        },
+    )
+    return out_vars[0] if len(out_vars) == 1 else out_vars
+
+
+class StaticRNN:
+    """reference: layers/control_flow.py:280 — time-major recurrence.
+
+    Inputs are [T, B, ...]; ``step_input`` slices one step, ``memory``
+    declares loop state, ``step_output`` stacks per-step values.
+    Lowered to one lax.scan (op static_rnn) — BPTT via scan transpose.
+    """
+
+    def __init__(self, name: Optional[str] = None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self._x_pairs = []        # (outer var, placeholder)
+        self._mem = []            # (placeholder, init outer var, updated name)
+        self._outputs = []        # sub-block vars to stack
+        self._built = False
+
+    class _StepGuard:
+        def __init__(self, rnn):
+            self.rnn = rnn
+
+        def __enter__(self):
+            prog = framework.default_main_program()
+            self.rnn.sub_block = prog._create_block()
+            return self.rnn
+
+        def __exit__(self, exc_type, *a):
+            if exc_type is not None:
+                return False
+            framework.default_main_program()._rollback()
+            self.rnn._complete()
+            return False
+
+    def step(self):
+        return StaticRNN._StepGuard(self)
+
+    # --- in-step API ---
+    def step_input(self, x: Variable) -> Variable:
+        ph = self.sub_block.create_var(
+            name=unique_name.generate("rnn_step_in"),
+            shape=x.shape[1:],
+            dtype=x.dtype,
+        )
+        self._x_pairs.append((x, ph))
+        return ph
+
+    def memory(self, init: Optional[Variable] = None, shape=None, batch_ref=None,
+               init_value=0.0, init_batch_dim_idx=0, ref_batch_dim_idx=0) -> Variable:
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError("memory needs init= or (shape=, batch_ref=)")
+            # the init must live in the parent block (it is a loop input);
+            # a step-input placeholder batch_ref maps back to its outer
+            # time-major var (+1 on the batch dim index)
+            parent = self.sub_block.parent_block
+            ref_outer, dim_idx = None, ref_batch_dim_idx
+            for outer, ph in self._x_pairs:
+                if ph is batch_ref or ph.name == batch_ref.name:
+                    ref_outer, dim_idx = outer, ref_batch_dim_idx + 1
+                    break
+            if ref_outer is None:
+                ref_outer = batch_ref
+            tail = list(shape[1:]) if shape and shape[0] in (-1, None) else list(shape)
+            init = parent.create_var(
+                name=unique_name.generate("rnn_mem_init"),
+                shape=[-1] + tail,
+                dtype="float32",
+            )
+            parent.append_op(
+                type="fill_constant_batch_size_like",
+                inputs={"Input": [ref_outer]},
+                outputs={"Out": [init]},
+                attrs={
+                    "shape": [-1] + tail,
+                    "value": float(init_value),
+                    "dtype": "float32",
+                    "input_dim_idx": dim_idx,
+                    "output_dim_idx": init_batch_dim_idx,
+                },
+            )
+        ph = self.sub_block.create_var(
+            name=unique_name.generate("rnn_mem"),
+            shape=init.shape,
+            dtype=init.dtype,
+        )
+        self._mem.append([ph, init, None])
+        return ph
+
+    def update_memory(self, mem: Variable, new: Variable):
+        for rec in self._mem:
+            if rec[0] is mem or rec[0].name == mem.name:
+                rec[2] = new.name
+                return
+        raise ValueError("update_memory: %r is not a declared memory" % mem.name)
+
+    def step_output(self, o: Variable):
+        self._outputs.append(o)
+
+    def output(self, *outs):
+        for o in outs:
+            self.step_output(o)
+
+    # --- completion ---
+    def _complete(self):
+        prog = framework.default_main_program()
+        parent = prog.current_block()
+        if any(rec[2] is None for rec in self._mem):
+            raise ValueError("every memory needs update_memory before the step ends")
+
+        locals_ = {ph.name for _, ph in self._x_pairs} | {rec[0].name for rec in self._mem}
+        _, externals = _analyze_sub_block(self.sub_block, exclude_locals=locals_)
+        externals = [n for n in externals if n not in locals_]
+
+        x_outer = [x for x, _ in self._x_pairs]
+        seq_len = x_outer[0].shape[0] if x_outer and x_outer[0].shape else None
+        out_vars = []
+        for o in self._outputs:
+            ov = parent.create_var(
+                name=unique_name.generate(o.name + ".rnn_out"),
+                shape=(seq_len,) + tuple(o.shape or ()),
+                dtype=o.dtype,
+            )
+            out_vars.append(ov)
+        final_mems = []
+        for ph, init, _ in self._mem:
+            fv = parent.create_var(
+                name=unique_name.generate(ph.name + ".final"),
+                shape=init.shape,
+                dtype=init.dtype,
+            )
+            final_mems.append(fv)
+
+        parent.append_op(
+            type="static_rnn",
+            inputs={"X": [x.name for x in x_outer]
+                    + [rec[1].name for rec in self._mem]
+                    + externals},
+            outputs={"Out": [v.name for v in out_vars] + [v.name for v in final_mems]},
+            attrs={
+                "sub_block": self.sub_block,
+                "x_names": [ph.name for _, ph in self._x_pairs],
+                "mem_names": [rec[0].name for rec in self._mem],
+                "mem_out_names": [rec[2] for rec in self._mem],
+                "out_names": [o.name for o in self._outputs],
+                "external_names": externals,
+            },
+        )
+        self._out_vars = out_vars
+        self._built = True
+
+    def __call__(self):
+        if not self._built:
+            raise RuntimeError("StaticRNN used before its step block completed")
+        return self._out_vars[0] if len(self._out_vars) == 1 else self._out_vars

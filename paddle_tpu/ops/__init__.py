@@ -6,3 +6,5 @@ from paddle_tpu.ops import optimizer_ops  # noqa: F401
 from paddle_tpu.ops import metric_ops  # noqa: F401
 from paddle_tpu.ops import sequence_ops  # noqa: F401
 from paddle_tpu.ops import collective_ops  # noqa: F401
+from paddle_tpu.ops import control_flow_ops  # noqa: F401
+from paddle_tpu.ops import rnn_ops  # noqa: F401

@@ -1,0 +1,152 @@
+"""Control-flow ops: while / cond / static_rnn over sub-blocks.
+
+Reference: paddle/fluid/operators/controlflow/while_op.cc (runs a
+sub-block through a nested Executor against a scope chain) and
+conditional_block_op.cc; recurrent_op.cc (StaticRNN runtime).
+
+TPU-native design: a sub-block is *traced* into the parent XLA
+computation as `lax.while_loop` / `lax.cond` / `lax.scan` — loop-carried
+variables are made explicit at layer-build time (layers/control_flow.py
+computes them), replacing the reference's scope-chain mutation with
+functional loop state.  Everything stays inside one compiled module: no
+per-iteration op dispatch, static shapes throughout.
+"""
+from __future__ import annotations
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import one
+
+
+def _trace_sub_block(block, env):
+    from paddle_tpu.core import lowering
+
+    lowering.trace_ops(block.ops, env, block)
+    return env
+
+
+def _as_pred(x):
+    import jax.numpy as jnp
+
+    return jnp.reshape(x, ()).astype(bool)
+
+
+@register_op("while", differentiable=False)
+def while_op(inputs, attrs):
+    """inputs X = carried vars (ordered carry_names) + externals
+    (ordered external_names); outputs Out = final carried values.
+
+    Not reverse-differentiable (XLA While has no generic transpose);
+    use static_rnn/scan for differentiable recurrences — same guidance
+    as jax itself.
+    """
+    import jax
+
+    block = attrs["sub_block"]
+    carry_names = list(attrs["carry_names"])
+    ext_names = list(attrs["external_names"])
+    cond_name = attrs["cond_name"]
+    xs = inputs["X"]
+    carry_vals = tuple(xs[: len(carry_names)])
+    ext = dict(zip(ext_names, xs[len(carry_names) :]))
+    cond_idx = carry_names.index(cond_name)
+
+    def cond_fn(carry):
+        return _as_pred(carry[cond_idx])
+
+    def body_fn(carry):
+        env = dict(zip(carry_names, carry))
+        env.update(ext)
+        _trace_sub_block(block, env)
+        return tuple(env[n] for n in carry_names)
+
+    out = jax.lax.while_loop(cond_fn, body_fn, carry_vals)
+    return {"Out": list(out)}
+
+
+@register_op("conditional_block")
+def conditional_block(inputs, attrs):
+    """Run the sub-block iff Cond is true; carried vars pass through
+    unchanged otherwise (reference: controlflow/conditional_block_op.cc).
+    """
+    import jax
+
+    block = attrs["sub_block"]
+    carry_names = list(attrs["carry_names"])
+    ext_names = list(attrs["external_names"])
+    cond = _as_pred(one(inputs, "Cond"))
+    xs = inputs["X"]
+    carry_vals = tuple(xs[: len(carry_names)])
+    ext = dict(zip(ext_names, xs[len(carry_names) :]))
+
+    def true_fn(carry):
+        env = dict(zip(carry_names, carry))
+        env.update(ext)
+        _trace_sub_block(block, env)
+        return tuple(env[n] for n in carry_names)
+
+    out = jax.lax.cond(cond, true_fn, lambda c: c, carry_vals)
+    return {"Out": list(out)}
+
+
+@register_op("select_branch")
+def select_branch(inputs, attrs):
+    """Two-armed cond (layers.cond): both sub-blocks produce the vars in
+    out_names; lax.cond selects.  reference analog: layers/control_flow.py
+    IfElse (:1564) flattened to functional form."""
+    import jax
+
+    tblock, fblock = attrs["true_block"], attrs["false_block"]
+    out_names = list(attrs["out_names"])
+    ext_names = list(attrs["external_names"])
+    cond = _as_pred(one(inputs, "Cond"))
+    ext = dict(zip(ext_names, inputs.get("X", [])))
+
+    def run(block):
+        def fn(_):
+            env = dict(ext)
+            _trace_sub_block(block, env)
+            return tuple(env[n] for n in out_names)
+
+        return fn
+
+    out = jax.lax.cond(cond, run(tblock), run(fblock), ())
+    return {"Out": list(out)}
+
+
+@register_op("static_rnn")
+def static_rnn(inputs, attrs):
+    """lax.scan over the time dim (reference: recurrent_op.cc re-runs the
+    sub-block per step over scope chains).
+
+    inputs X = step inputs [T, ...] (ordered x_names) + memory inits
+    (ordered mem_names) + externals (ordered external_names).
+    outputs Out = stacked step outputs [T, ...] (ordered out_names),
+    then final memories.
+    Differentiable: scan has a transpose; the generic vjp grad kernel
+    (core/registry.py) handles the backward — BPTT falls out.
+    """
+    import jax
+
+    block = attrs["sub_block"]
+    x_names = list(attrs["x_names"])          # per-step placeholder names
+    mem_names = list(attrs["mem_names"])      # memory placeholder names
+    mem_out_names = list(attrs["mem_out_names"])  # updated-memory var names
+    out_names = list(attrs["out_names"])      # step-output var names
+    ext_names = list(attrs["external_names"])
+    xs_vals = inputs["X"]
+    n_x, n_m = len(x_names), len(mem_names)
+    seq_inputs = tuple(xs_vals[:n_x])          # each [T, ...]
+    mem_init = tuple(xs_vals[n_x : n_x + n_m])
+    ext = dict(zip(ext_names, xs_vals[n_x + n_m :]))
+
+    def body(carry, xt):
+        env = dict(zip(mem_names, carry))
+        env.update(zip(x_names, xt))
+        env.update(ext)
+        _trace_sub_block(block, env)
+        new_carry = tuple(env[n] for n in mem_out_names)
+        outs = tuple(env[n] for n in out_names)
+        return new_carry, outs
+
+    final_mem, stacked = jax.lax.scan(body, mem_init, seq_inputs)
+    return {"Out": list(stacked) + list(final_mem)}

@@ -1,0 +1,159 @@
+"""Recurrent ops: dynamic_lstm / dynamic_gru over padded+length batches.
+
+Reference: paddle/fluid/operators/lstm_op.cc + math/lstm_compute (gate
+order i,c,f,o per lstm_op.cc docs: W_x arranged {W_ix,W_cx,W_fx,W_ox}),
+gru_op.cc + math/gru_compute (update u, reset r, candidate c).  The
+reference iterates LoD-batched timesteps with per-step GEMMs; TPU version
+is a `lax.scan` whose per-step math is identical, over the dense
+padded encoding (ops/sequence_ops.py docstring), with padding masked so
+results match the ragged reference exactly.
+
+Differentiable through the generic vjp grad kernel (scan transposes).
+"""
+from __future__ import annotations
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import maybe, one
+
+def _act(name):
+    import jax
+    import jax.numpy as jnp
+
+    return {
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "relu": jax.nn.relu,
+        "identity": lambda x: x,
+    }[name]
+
+
+def _lens(inputs, x, T):
+    import jax.numpy as jnp
+
+    seq_len = maybe(inputs, "SeqLen")
+    if seq_len is None:
+        return jnp.full((x.shape[0],), T, dtype="int32")
+    return seq_len
+
+
+@register_op("dynamic_lstm", no_grad_set={"SeqLen"})
+def dynamic_lstm(inputs, attrs):
+    """Input [B, T, 4D] (pre-projected, reference requires the x->4D fc
+    done outside, lstm_op.cc), Weight [D, 4D] hidden-to-gates, Bias
+    [1, 4D] (+[1, 3D] peephole tail when use_peepholes).
+
+    Outputs Hidden [B, T, D], Cell [B, T, D].
+    """
+    import jax
+    import jax.numpy as jnp
+
+    x = one(inputs, "Input")
+    w = one(inputs, "Weight")
+    bias = maybe(inputs, "Bias")
+    h0 = maybe(inputs, "H0")
+    c0 = maybe(inputs, "C0")
+    B, T, D4 = x.shape
+    D = D4 // 4
+    use_peepholes = attrs.get("use_peepholes", True)
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cell_act = _act(attrs.get("cell_activation", "tanh"))
+    cand_act = _act(attrs.get("candidate_activation", "tanh"))
+    is_reverse = attrs.get("is_reverse", False)
+
+    if bias is not None:
+        b_gate = bias[..., :D4].reshape(1, D4)
+        peep = bias[..., D4:].reshape(-1) if (use_peepholes and bias.shape[-1] > D4) else None
+    else:
+        b_gate, peep = jnp.zeros((1, D4), x.dtype), None
+    w_ic = peep[:D] if peep is not None else None
+    w_fc = peep[D : 2 * D] if peep is not None else None
+    w_oc = peep[2 * D :] if peep is not None else None
+
+    h_init = h0 if h0 is not None else jnp.zeros((B, D), x.dtype)
+    c_init = c0 if c0 is not None else jnp.zeros((B, D), x.dtype)
+    lens = _lens(inputs, x, T)
+
+    xs = jnp.swapaxes(x, 0, 1)  # [T, B, 4D]
+    if is_reverse:
+        xs = xs[::-1]
+    steps = jnp.arange(T)
+
+    def body(carry, inp):
+        h, c = carry
+        xt, t = inp
+        gates = xt + h @ w + b_gate  # [B, 4D]
+        gi, gc, gf, go = jnp.split(gates, 4, axis=-1)  # reference order i,c,f,o
+        if w_ic is not None:
+            gi = gi + c * w_ic
+            gf = gf + c * w_fc
+        i = gate_act(gi)
+        f = gate_act(gf)
+        cand = cand_act(gc)
+        c_new = f * c + i * cand
+        if w_oc is not None:
+            go = go + c_new * w_oc
+        o = gate_act(go)
+        h_new = o * cell_act(c_new)
+        # padding: hold state, zero the emitted output
+        tt = (T - 1 - t) if is_reverse else t
+        valid = (tt < lens)[:, None]
+        h_keep = jnp.where(valid, h_new, h)
+        c_keep = jnp.where(valid, c_new, c)
+        mask = valid.astype(x.dtype)
+        return (h_keep, c_keep), (h_new * mask, c_new * mask)
+
+    (_, _), (hs, cs) = jax.lax.scan(body, (h_init, c_init), (xs, steps))
+    if is_reverse:
+        hs, cs = hs[::-1], cs[::-1]
+    return {"Hidden": jnp.swapaxes(hs, 0, 1), "Cell": jnp.swapaxes(cs, 0, 1)}
+
+
+@register_op("dynamic_gru", no_grad_set={"SeqLen"})
+def dynamic_gru(inputs, attrs):
+    """Input [B, T, 3D] pre-projected, Weight [D, 3D] ({W_u,W_r} first 2D,
+    W_c last D), Bias [1, 3D] (reference gru_op.cc).
+
+    Output Hidden [B, T, D].
+    """
+    import jax
+    import jax.numpy as jnp
+
+    x = one(inputs, "Input")
+    w = one(inputs, "Weight")
+    bias = maybe(inputs, "Bias")
+    h0 = maybe(inputs, "H0")
+    B, T, D3 = x.shape
+    D = D3 // 3
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cand_act = _act(attrs.get("activation", "tanh"))
+    is_reverse = attrs.get("is_reverse", False)
+
+    b = bias.reshape(1, D3) if bias is not None else jnp.zeros((1, D3), x.dtype)
+    w_gate = w[:, : 2 * D]  # [D, 2D]
+    w_cand = w[:, 2 * D :]  # [D, D]
+    h_init = h0 if h0 is not None else jnp.zeros((B, D), x.dtype)
+    lens = _lens(inputs, x, T)
+
+    xs = jnp.swapaxes(x, 0, 1)
+    if is_reverse:
+        xs = xs[::-1]
+    steps = jnp.arange(T)
+
+    def body(h, inp):
+        xt, t = inp
+        xg = xt + b
+        x_ur, x_c = xg[..., : 2 * D], xg[..., 2 * D :]
+        ur = gate_act(x_ur + h @ w_gate)
+        u, r = jnp.split(ur, 2, axis=-1)
+        cand = cand_act(x_c + (r * h) @ w_cand)
+        # reference gru_compute: h_new = u*h + (1-u)*cand
+        h_new = u * h + (1.0 - u) * cand
+        tt = (T - 1 - t) if is_reverse else t
+        valid = (tt < lens)[:, None]
+        h_keep = jnp.where(valid, h_new, h)
+        return h_keep, h_new * valid.astype(x.dtype)
+
+    _, hs = jax.lax.scan(body, h_init, (xs, steps))
+    if is_reverse:
+        hs = hs[::-1]
+    return {"Hidden": jnp.swapaxes(hs, 0, 1)}

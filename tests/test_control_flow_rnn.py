@@ -1,0 +1,185 @@
+"""Control-flow + RNN op tests.
+
+Reference: tests/unittests/test_while_op.py, test_recurrent_op.py,
+test_lstm_op.py, test_gru_op.py — numeric parity against numpy
+re-implementations, plus end-to-end training through lax.scan BPTT.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import framework
+
+
+def _run(prog, startup, feed, fetch, seed=0):
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return exe.run(prog, feed=feed, fetch_list=fetch)
+
+
+def test_while_loop_sums_to_ten():
+    prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(prog, startup):
+        i = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        total = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        limit = fluid.layers.fill_constant(shape=[1], dtype="float32", value=10.0)
+        i.stop_gradient = total.stop_gradient = True
+        cond = fluid.layers.less_than(i, limit)
+        loop = fluid.layers.While(cond)
+        with loop.block():
+            fluid.layers.assign(total + i, total)
+            fluid.layers.control_flow.increment(i, value=1.0, in_place=True)
+            fluid.layers.less_than(i, limit, cond=cond)
+        (tot, iv) = _run(prog, startup, {}, [total, i])
+    assert float(np.asarray(iv)) == 10.0
+    assert float(np.asarray(tot)) == sum(range(10))
+
+
+def test_cond_select_branch():
+    prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [4])
+        flag = fluid.layers.data("flag", [1])
+        pred = fluid.layers.greater_than(
+            fluid.layers.reduce_sum(flag), fluid.layers.fill_constant([1], "float32", 0.0)
+        )
+        out = fluid.layers.cond(
+            pred,
+            lambda: fluid.layers.scale(x, scale=2.0),
+            lambda: fluid.layers.scale(x, scale=-1.0),
+        )
+    xb = np.arange(4, dtype="float32").reshape(1, 4)
+    (o1,) = _run(prog, startup, {"x": xb, "flag": np.ones((1, 1), "float32")}, [out])
+    (o2,) = _run(prog, startup, {"x": xb, "flag": -np.ones((1, 1), "float32")}, [out])
+    np.testing.assert_allclose(np.asarray(o1), xb * 2)
+    np.testing.assert_allclose(np.asarray(o2), -xb)
+
+
+def test_static_rnn_matches_numpy_and_trains():
+    T, B, D, H = 5, 3, 4, 6
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 7
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("xt", [T, B, D], append_batch_size=False)  # time-major
+        y = fluid.layers.data("y", [B, H], append_batch_size=False)
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            h = rnn.memory(shape=[-1, H], batch_ref=xt, init_value=0.0, ref_batch_dim_idx=0)
+            nh = fluid.layers.fc([xt, h], size=H, act="tanh", bias_attr=False)
+            rnn.update_memory(h, nh)
+            rnn.step_output(nh)
+        outs = rnn()
+        last = fluid.layers.slice(outs, axes=[0], starts=[T - 1], ends=[T])
+        last = fluid.layers.reshape(last, shape=[B, H])
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(last, y))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    xb = rng.uniform(-1, 1, (T, B, D)).astype("float32")
+    yb = rng.uniform(-1, 1, (B, H)).astype("float32")
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # numpy forward parity with initial weights
+        wx = np.asarray(scope.get([p.name for p in prog.all_parameters() if p.shape == (D, H)][0]))
+        wh = np.asarray(scope.get([p.name for p in prog.all_parameters() if p.shape == (H, H)][0]))
+        h = np.zeros((B, H), "float32")
+        for t in range(T):
+            h = np.tanh(xb[t] @ wx + h @ wh)
+        (o, l0) = exe.run(prog, feed={"xt": xb, "y": yb}, fetch_list=[last, loss])
+        np.testing.assert_allclose(np.asarray(o), h, rtol=2e-4, atol=1e-5)
+        losses = [float(np.asarray(l0))]
+        for _ in range(5):
+            (l,) = exe.run(prog, feed={"xt": xb, "y": yb}, fetch_list=[loss])
+            losses.append(float(np.asarray(l)))
+    assert losses[-1] < losses[0], losses
+
+
+def _np_lstm(x, w, b, lens, D):
+    """numpy reference of the padded dynamic_lstm (gate order i,c,f,o,
+    no peepholes)."""
+    B, T, _ = x.shape
+    h = np.zeros((B, D), "float32")
+    c = np.zeros((B, D), "float32")
+    hs = np.zeros((B, T, D), "float32")
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    for t in range(T):
+        g = x[:, t] + h @ w + b
+        gi, gc, gf, go = np.split(g, 4, axis=-1)
+        i, f, o = sig(gi), sig(gf), sig(go)
+        cand = np.tanh(gc)
+        c_new = f * c + i * cand
+        h_new = o * np.tanh(c_new)
+        valid = (t < lens)[:, None]
+        h = np.where(valid, h_new, h)
+        c = np.where(valid, c_new, c)
+        hs[:, t] = np.where(valid, h_new, 0.0)
+    return hs
+
+
+def test_dynamic_lstm_matches_numpy():
+    B, T, D = 3, 6, 5
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 9
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [T, 4 * D], append_batch_size=True, lod_level=1)
+        h, c = fluid.layers.dynamic_lstm(x, size=4 * D, use_peepholes=False)
+    rng = np.random.RandomState(1)
+    xb = rng.uniform(-1, 1, (B, T, 4 * D)).astype("float32")
+    lens = np.array([6, 3, 4], dtype="int32")
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        w = np.asarray(scope.get([p.name for p in prog.all_parameters() if p.shape == (D, 4 * D)][0]))
+        b = np.asarray(scope.get([p.name for p in prog.all_parameters() if p.shape == (1, 4 * D)][0]))
+        (hv,) = exe.run(prog, feed={"x": xb, "x_seq_len": lens}, fetch_list=[h])
+    want = _np_lstm(xb, w, b.reshape(-1), lens, D)
+    np.testing.assert_allclose(np.asarray(hv), want, rtol=2e-4, atol=1e-5)
+
+
+def test_dynamic_gru_trains_sentiment():
+    """bag-of-gru sentiment on synthetic imdb — exercises embedding +
+    ragged batch + scan BPTT end to end."""
+    from paddle_tpu import dataset, reader as R
+
+    V, E, H, T = 200, 16, 16, 24
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 5
+    with framework.program_guard(prog, startup):
+        ids = fluid.layers.data("ids", [T], dtype="int64", lod_level=1)
+        lbl = fluid.layers.data("lbl", [1], dtype="int64")
+        emb = fluid.layers.embedding(ids, size=[V, E])
+        proj = fluid.layers.fc(emb, size=3 * H, num_flatten_dims=2, bias_attr=False)
+        gru = fluid.layers.dynamic_gru(proj, size=H, seq_len=ids.block.var("ids_seq_len"))
+        pooled = fluid.layers.sequence_pool(gru, "max", seq_len=ids.block.var("ids_seq_len"))
+        pred = fluid.layers.fc(pooled, size=2, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, lbl))
+        fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    ids_b = rng.randint(0, V, (16, T)).astype("int64")
+    lens = rng.randint(4, T, 16).astype("int32")
+    for i, L in enumerate(lens):  # positive iff tokens biased high
+        hi = rng.rand() > 0.5
+        ids_b[i, :L] = rng.randint(V // 2 if hi else 0, V if hi else V // 2, L)
+    lbls = (ids_b[np.arange(16), 0] >= V // 2).astype("int64").reshape(-1, 1)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(8):
+            (l,) = exe.run(
+                prog,
+                feed={"ids": ids_b, "ids_seq_len": lens, "lbl": lbls},
+                fetch_list=[loss],
+            )
+            losses.append(float(np.asarray(l)))
+    assert losses[-1] < losses[0], losses

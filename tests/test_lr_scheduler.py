@@ -1,0 +1,52 @@
+"""LR schedule tests (reference: tests/unittests/test_learning_rate_scheduler.py)."""
+import math
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import framework
+
+
+def _run_schedule(make_lr, steps=5):
+    prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.data("y", [1])
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(fluid.layers.fc(x, 1), y))
+        lr = make_lr()
+        fluid.optimizer.SGDOptimizer(learning_rate=lr).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(4, 4).astype("float32"), "y": rng.rand(4, 1).astype("float32")}
+    out = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            (v,) = exe.run(prog, feed=feed, fetch_list=[lr])
+            out.append(float(np.asarray(v)))
+    return out
+
+
+def test_exponential_decay():
+    got = _run_schedule(
+        lambda: fluid.layers.exponential_decay(0.1, decay_steps=2, decay_rate=0.5, staircase=True)
+    )
+    want = [0.1 * 0.5 ** (s // 2) for s in range(5)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_piecewise_decay():
+    got = _run_schedule(lambda: fluid.layers.piecewise_decay([2, 4], [1.0, 0.5, 0.1]))
+    np.testing.assert_allclose(got, [1.0, 1.0, 0.5, 0.5, 0.1], rtol=1e-6)
+
+
+def test_cosine_decay():
+    got = _run_schedule(lambda: fluid.layers.cosine_decay(0.1, step_each_epoch=2, epochs=4))
+    want = [0.1 / 2 * (math.cos((s // 2) * math.pi / 4) + 1) for s in range(5)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_noam_with_warmup_increases_then_decays():
+    got = _run_schedule(lambda: fluid.layers.noam_decay(64, warmup_steps=3), steps=6)
+    assert got[0] < got[1] < got[2]  # warmup phase rises
