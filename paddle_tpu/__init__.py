@@ -43,6 +43,7 @@ from paddle_tpu import (
 from paddle_tpu.backward import append_backward, gradients
 from paddle_tpu.param_attr import ParamAttr, WeightNormParamAttr
 from paddle_tpu import parallel
+from paddle_tpu import dygraph
 from paddle_tpu import io
 from paddle_tpu import reader
 from paddle_tpu import dataset

@@ -1,0 +1,24 @@
+"""Dygraph (eager) mode.
+
+Reference: paddle/fluid/imperative/ (Tracer tracer.cc:140, VarBase/OpBase
+layer.h:133,334) + python/paddle/fluid/dygraph/.  TPU-native design: each
+traced op runs its JAX kernel immediately (per-op dispatch, jit-cached by
+XLA at the op level), a tape records (op, inputs, outputs) and
+``loss.backward()`` replays it in reverse through the same generic vjp
+grad kernels the static graph uses — one autodiff implementation for
+both modes.
+"""
+from paddle_tpu.dygraph import nn  # noqa: F401
+from paddle_tpu.dygraph.base import guard, enabled, no_grad, to_variable  # noqa: F401
+from paddle_tpu.dygraph.layers import Layer  # noqa: F401
+from paddle_tpu.dygraph.nn import (  # noqa: F401
+    BatchNorm,
+    Conv2D,
+    Embedding,
+    FC,
+    LayerNorm,
+    Linear,
+    Pool2D,
+)
+from paddle_tpu.dygraph.parallel import DataParallel, prepare_context  # noqa: F401
+from paddle_tpu.dygraph.checkpoint import load_dygraph, save_dygraph  # noqa: F401

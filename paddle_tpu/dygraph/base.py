@@ -1,0 +1,207 @@
+"""Dygraph tracer + guard + to_variable.
+
+Reference: imperative/tracer.cc:140 (Trace: run kernel immediately, record
+grad descs), dygraph/base.py:98 (guard), :156 (to_variable).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from paddle_tpu import framework
+from paddle_tpu.core import registry
+from paddle_tpu.core import types as core_types
+
+__all__ = ["guard", "enabled", "to_variable", "Tracer"]
+
+
+class TapeEntry:
+    __slots__ = ("op_type", "attrs", "inputs", "outputs")
+
+    def __init__(self, op_type, attrs, inputs, outputs):
+        self.op_type = op_type
+        self.attrs = attrs
+        self.inputs = inputs    # slot -> [Variable]
+        self.outputs = outputs  # slot -> [Variable]
+
+
+def _val(var):
+    v = getattr(var, "_dy_value", None)
+    if v is None:
+        raise RuntimeError(
+            "dygraph: variable %r has no value (did it come from a static "
+            "graph build?)" % getattr(var, "name", var)
+        )
+    return v
+
+
+class Tracer:
+    """Eager executor + tape (reference: imperative/tracer.h:41)."""
+
+    def __init__(self):
+        self.tape: List[TapeEntry] = []
+        self._no_grad = False
+
+    # called from Block.append_op when in dygraph mode
+    def trace_op(self, op_type, inputs, outputs, attrs, block=None):
+        kernel = registry.get_kernel(op_type)
+        attrs = dict(attrs or {})
+
+        def resolve(v):
+            if isinstance(v, str):
+                if block is None:
+                    raise RuntimeError("dygraph trace_op got name %r without a block" % v)
+                return block.var(v)
+            return v
+
+        in_vars: Dict[str, List[Any]] = {}
+        kin: Dict[str, List[Any]] = {}
+        for slot, vs in (inputs or {}).items():
+            if vs is None:
+                continue
+            vs = vs if isinstance(vs, (list, tuple)) else [vs]
+            vs = [resolve(v) for v in vs if v is not None]
+            if not vs:
+                continue
+            in_vars[slot] = list(vs)
+            kin[slot] = [_val(v) for v in vs]
+        outs = kernel(kin, attrs)
+        outs = {k: (v if isinstance(v, (list, tuple)) else [v]) for k, v in (outs or {}).items()}
+        out_vars: Dict[str, List[Any]] = {}
+        for slot, names in (outputs or {}).items():
+            vs = names if isinstance(names, (list, tuple)) else [names]
+            vs = [resolve(v) if v is not None else None for v in vs]
+            vals = outs.get(slot)
+            if vals is None:
+                continue
+            kept = []
+            for var, val in zip(vs, vals):
+                if var is None or val is None:
+                    continue
+                var._dy_value = val
+                var.shape = tuple(np.shape(val))
+                kept.append(var)
+            if kept:
+                out_vars[slot] = kept
+        if not self._no_grad:
+            try:
+                differentiable = registry.get_op(op_type).differentiable
+            except KeyError:
+                differentiable = False
+            if differentiable:
+                self.tape.append(TapeEntry(op_type, attrs, in_vars, out_vars))
+        # return the op-like record (callers mostly ignore it)
+        flat = [v for vs in out_vars.values() for v in vs]
+        return flat[0] if len(flat) == 1 else None
+
+    # ------------------------------------------------------------------
+    def run_backward(self, loss):
+        """Reverse tape walk (reference: VarBase::RunBackward layer.cc:377)."""
+        import jax.numpy as jnp
+
+        grads: Dict[int, Any] = {id(loss): jnp.ones(np.shape(_val(loss)), _val(loss).dtype)}
+        var_by_id = {id(loss): loss}
+        for entry in reversed(self.tape):
+            out_grad_lists = {}
+            any_grad = False
+            for slot, vs in entry.outputs.items():
+                gs = []
+                for v in vs:
+                    g = grads.get(id(v))
+                    gs.append(g)
+                    if g is not None:
+                        any_grad = True
+                out_grad_lists[slot] = gs
+            if not any_grad:
+                continue
+            gkernel = registry.get_kernel(entry.op_type + "_grad")
+            gin: Dict[str, List[Any]] = {}
+            for slot, vs in entry.inputs.items():
+                gin[slot] = [_val(v) for v in vs]
+            fwd_out_slots = tuple(entry.outputs.keys())
+            for slot, vs in entry.outputs.items():
+                gin[slot] = [_val(v) for v in vs]
+            mask = {}
+            for slot, gs in out_grad_lists.items():
+                if any(g is not None for g in gs):
+                    gin[slot + "@GRAD"] = [g for g in gs if g is not None]
+                    if any(g is None for g in gs):
+                        mask[slot] = [g is None for g in gs]
+            want = [
+                s
+                for s, vs in entry.inputs.items()
+                if s not in registry.get_op(entry.op_type).no_grad_set
+                and all(core_types.is_float_dtype(str(np.asarray(_val(v)).dtype)) or "float" in str(_val(v).dtype) for v in vs)
+            ]
+            gattrs = dict(entry.attrs)
+            gattrs["__fwd_output_slots__"] = fwd_out_slots
+            gattrs["__grad_input_slots__"] = tuple(want)
+            if mask:
+                gattrs["__empty_out_grad_mask__"] = mask
+            gout = gkernel(gin, gattrs)
+            for slot, vs in entry.inputs.items():
+                gs = gout.get(slot + "@GRAD")
+                if gs is None:
+                    continue
+                if not isinstance(gs, (list, tuple)):
+                    gs = [gs]
+                for v, g in zip(vs, gs):
+                    if g is None or getattr(v, "stop_gradient", False):
+                        continue
+                    prev = grads.get(id(v))
+                    grads[id(v)] = g if prev is None else prev + g
+                    var_by_id[id(v)] = v
+        # attach grads to variables
+        for vid, g in grads.items():
+            var_by_id[vid]._dy_grad = g
+
+    def reset(self):
+        self.tape.clear()
+
+
+@contextlib.contextmanager
+def no_grad():
+    tr = framework._dygraph_tracer()
+    if tr is None:
+        yield
+        return
+    prev = tr._no_grad
+    tr._no_grad = True
+    try:
+        yield
+    finally:
+        tr._no_grad = prev
+
+
+def enabled() -> bool:
+    return framework.in_dygraph_mode()
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """reference: dygraph/base.py:98."""
+    tracer = Tracer()
+    with framework._dygraph_guard(tracer):
+        yield
+
+
+def to_variable(value, name: Optional[str] = None, block=None):
+    """reference: dygraph/base.py:156 — ndarray -> eager Variable."""
+    import jax.numpy as jnp
+
+    if isinstance(value, framework.Variable):
+        return value
+    arr = np.asarray(value)
+    dtype = core_types.canonical_dtype(str(arr.dtype))
+    block = block or framework.default_main_program().current_block()
+    var = framework.Variable(
+        block,
+        name or framework.unique_name.generate("generated_var"),
+        shape=arr.shape,
+        dtype=dtype,
+        stop_gradient=True,
+    )
+    var._dy_value = jnp.asarray(arr)
+    return var

@@ -1,0 +1,20 @@
+"""Dygraph checkpointing (reference: python/paddle/fluid/dygraph/
+checkpoint.py save_dygraph/load_dygraph)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["save_dygraph", "load_dygraph"]
+
+
+def save_dygraph(state_dict, model_path: str):
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    arrays = {k: np.asarray(v) for k, v in state_dict.items()}
+    np.savez(model_path + ".pdparams.npz", **arrays)
+
+
+def load_dygraph(model_path: str):
+    data = np.load(model_path + ".pdparams.npz")
+    return {k: data[k] for k in data.files}, None

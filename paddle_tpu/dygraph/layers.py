@@ -1,0 +1,105 @@
+"""Dygraph Layer base class (reference: python/paddle/fluid/dygraph/
+layers.py:31)."""
+from __future__ import annotations
+
+import collections
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from paddle_tpu import framework, unique_name
+from paddle_tpu.framework import Parameter, Variable
+from paddle_tpu.layer_helper import LayerHelper
+
+__all__ = ["Layer"]
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype: str = "float32"):
+        self._full_name = unique_name.generate(
+            (name_scope or self.__class__.__name__.lower()).split("/")[-1]
+        )
+        self._dtype = dtype
+        self._parameters: "collections.OrderedDict[str, Parameter]" = collections.OrderedDict()
+        self._sub_layers: "collections.OrderedDict[str, Layer]" = collections.OrderedDict()
+        self.training = True
+
+    def full_name(self) -> str:
+        return self._full_name
+
+    # --- parameter management ---
+    def create_parameter(self, attr, shape, dtype="float32", is_bias=False, default_initializer=None):
+        helper = LayerHelper(self._full_name, param_attr=attr)
+        return helper.create_parameter(attr, shape, dtype, is_bias, default_initializer)
+
+    def add_parameter(self, name: str, parameter: Parameter) -> Parameter:
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        out = list(self._parameters.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.parameters())
+        return out
+
+    def sublayers(self, include_sublayers: bool = True) -> List["Layer"]:
+        out = list(self._sub_layers.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.sublayers())
+        return out
+
+    def named_parameters(self, prefix: str = "") -> Iterator[Tuple[str, Parameter]]:
+        for name, p in self._parameters.items():
+            yield (prefix + name if not prefix else prefix + "." + name), p
+        for lname, l in self._sub_layers.items():
+            sub_prefix = lname if not prefix else prefix + "." + lname
+            yield from l.named_parameters(sub_prefix)
+
+    # --- train/eval ---
+    def train(self):
+        self.training = True
+        for l in self._sub_layers.values():
+            l.train()
+
+    def eval(self):
+        self.training = False
+        for l in self._sub_layers.values():
+            l.eval()
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    # --- state dict ---
+    def state_dict(self, prefix: str = "") -> Dict[str, np.ndarray]:
+        return {name: p.numpy() for name, p in self.named_parameters(prefix)}
+
+    def set_dict(self, state: Dict[str, np.ndarray]):
+        import jax.numpy as jnp
+
+        named = dict(self.named_parameters())
+        for name, value in state.items():
+            if name in named:
+                named[name]._dy_value = jnp.asarray(value)
+
+    load_dict = set_dict
+
+    # --- call protocol ---
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Parameter):
+            self.__dict__.setdefault("_parameters", collections.OrderedDict())[name] = value
+        elif isinstance(value, Layer):
+            self.__dict__.setdefault("_sub_layers", collections.OrderedDict())[name] = value
+        object.__setattr__(self, name, value)

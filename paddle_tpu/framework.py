@@ -149,6 +149,32 @@ class Variable:
 
         return ltensor.cast(self, dtype)
 
+    # --- dygraph surface (reference: framework.py:550 Variable.backward,
+    # .numpy/.gradient on VarBase) ---
+    def numpy(self):
+        if getattr(self, "_dy_value", None) is None:
+            raise RuntimeError("Variable.numpy() requires dygraph mode")
+        import numpy as _np
+
+        return _np.asarray(self._dy_value)
+
+    def backward(self, backward_strategy=None):
+        tracer = _dygraph_tracer()
+        if tracer is None:
+            raise RuntimeError("Variable.backward() requires dygraph mode")
+        tracer.run_backward(self)
+
+    def gradient(self):
+        g = getattr(self, "_dy_grad", None)
+        if g is None:
+            return None
+        import numpy as _np
+
+        return _np.asarray(g)
+
+    def clear_gradient(self):
+        self._dy_grad = None
+
     @property
     def grad_name(self):
         return grad_var_name(self.name)
@@ -384,7 +410,7 @@ class Block:
         from paddle_tpu.core import registry
 
         if in_dygraph_mode():
-            return _dygraph_tracer_.trace_op(type, inputs, outputs, attrs)
+            return _dygraph_tracer_.trace_op(type, inputs, outputs, attrs, block=self)
         op = Operator(self, type, inputs, outputs, attrs)
         self.ops.append(op)
         for ns in op.outputs.values():

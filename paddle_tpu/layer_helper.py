@@ -74,6 +74,12 @@ class LayerHelper:
         param = main_block.create_parameter(
             attr.name, shape, dtype, **{k: v for k, v in attr._to_kwargs().items() if k != "name"}
         )
+        if framework.in_dygraph_mode():
+            # eager init: run the initializer op immediately on the param
+            # (reference dygraph creates VarBase params eagerly)
+            param.stop_gradient = False
+            attr.initializer(param, main_block)
+            return param
         # mirror in startup program with its initializer op
         startup_block = self.startup_program.global_block()
         sparam = startup_block.create_parameter(
@@ -84,6 +90,9 @@ class LayerHelper:
 
     def set_variable_initializer(self, var, init):
         """Create `var` in the startup program and initialize it there."""
+        if framework.in_dygraph_mode():
+            init(var, var.block)
+            return var
         startup_block = self.startup_program.global_block()
         svar = startup_block.create_var(
             name=var.name, shape=var.shape, dtype=var.dtype, persistable=True

@@ -11,9 +11,11 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+import numpy as np
+
 from paddle_tpu import framework, unique_name
 from paddle_tpu.backward import append_backward
-from paddle_tpu.framework import Variable
+from paddle_tpu.framework import Parameter, Variable
 from paddle_tpu.layer_helper import LayerHelper
 
 __all__ = [
@@ -143,9 +145,44 @@ class Optimizer:
         return self.apply_gradients(params_grads)
 
     def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        if framework.in_dygraph_mode():
+            return self._dygraph_minimize(loss, parameter_list)
         params_grads = self.backward(loss, startup_program, parameter_list, no_grad_set)
         optimize_ops = self.apply_gradients(params_grads)
         return optimize_ops, params_grads
+
+    def _dygraph_minimize(self, loss, parameter_list=None):
+        """Eager update: grads were attached by loss.backward(); run the
+        optimizer ops through the tracer (reference: dygraph branch of
+        optimizer.minimize)."""
+        from paddle_tpu.dygraph import base as dybase
+
+        tracer = framework._dygraph_tracer()
+        params = parameter_list
+        if params is None:
+            seen = {}
+            for entry in tracer.tape:
+                for vs in entry.inputs.values():
+                    for v in vs:
+                        if isinstance(v, Parameter) and getattr(v, "_dy_grad", None) is not None:
+                            seen[id(v)] = v
+            params = list(seen.values())
+        pgs = []
+        block = framework.default_main_program().global_block()
+        for p in params:
+            g = getattr(p, "_dy_grad", None)
+            if g is None:
+                continue
+            gv = framework.Variable(
+                block, unique_name.generate(p.name + "@GRAD"),
+                shape=tuple(np.shape(g)), dtype=p.dtype, stop_gradient=True,
+            )
+            gv._dy_value = g
+            pgs.append((p, gv))
+        with dybase.no_grad():
+            self.apply_gradients(pgs)
+        tracer.reset()
+        return None, pgs
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,111 @@
+"""Dygraph (eager) mode tests.
+
+Reference: tests/unittests/test_imperative_basic.py, test_imperative_mnist
+— eager forward, tape backward, optimizer update, state_dict round-trip.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import dygraph
+from paddle_tpu.dygraph import to_variable
+
+
+def test_eager_forward_and_grad():
+    with dygraph.guard():
+        x = to_variable(np.array([[1.0, 2.0], [3.0, 4.0]], dtype="float32"))
+        x.stop_gradient = False
+        y = fluid.layers.relu(x)
+        z = fluid.layers.reduce_sum(y * y)
+        np.testing.assert_allclose(z.numpy(), 30.0, rtol=1e-6)
+        z.backward()
+        np.testing.assert_allclose(x.gradient(), 2 * x.numpy(), rtol=1e-6)
+
+
+def test_linear_regression_trains():
+    rng = np.random.RandomState(0)
+    xb = rng.uniform(-1, 1, (32, 8)).astype("float32")
+    yb = (xb.sum(axis=1, keepdims=True) * 0.3).astype("float32")
+    with dygraph.guard():
+        model = dygraph.Linear(8, 1)
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=0.3)
+        losses = []
+        for _ in range(10):
+            pred = model(to_variable(xb))
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, to_variable(yb))
+            )
+            loss.backward()
+            opt.minimize(loss, parameter_list=model.parameters())
+            model.clear_gradients()
+            losses.append(float(loss.numpy()))
+    assert losses[-1] < 0.2 * losses[0], losses
+
+
+class _ConvNet(dygraph.Layer):
+    def __init__(self):
+        super().__init__()
+        self.conv = dygraph.Conv2D(num_filters=8, filter_size=3, padding=1, act="relu")
+        self.pool = dygraph.Pool2D(pool_size=2, pool_stride=2, pool_type="max")
+        self.fc = dygraph.FC(size=10, act="softmax")
+
+    def forward(self, x):
+        h = self.pool(self.conv(x))
+        return self.fc(h)
+
+
+def test_convnet_mnistish_trains():
+    rng = np.random.RandomState(1)
+    xb = rng.uniform(-1, 1, (16, 1, 8, 8)).astype("float32")
+    yb = rng.randint(0, 10, (16, 1)).astype("int64")
+    with dygraph.guard():
+        model = _ConvNet()
+        opt = fluid.optimizer.AdamOptimizer(learning_rate=0.01)
+        losses = []
+        for _ in range(8):
+            prob = model(to_variable(xb))
+            loss = fluid.layers.mean(fluid.layers.cross_entropy(prob, to_variable(yb)))
+            loss.backward()
+            opt.minimize(loss, parameter_list=model.parameters())
+            model.clear_gradients()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0], losses
+        assert len(model.parameters()) == 4  # conv w/b + fc w/b
+
+
+def test_state_dict_roundtrip(tmp_path):
+    with dygraph.guard():
+        m1 = dygraph.Linear(4, 3)
+        m2 = dygraph.Linear(4, 3)
+        sd = m1.state_dict()
+        dygraph.save_dygraph(sd, str(tmp_path / "model"))
+        loaded, _ = dygraph.load_dygraph(str(tmp_path / "model"))
+        m2.set_dict(loaded)
+        x = to_variable(np.ones((2, 4), "float32"))
+        np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+
+def test_embedding_and_batchnorm_layers():
+    with dygraph.guard():
+        emb = dygraph.Embedding(size=[20, 6])
+        ids = to_variable(np.array([[1], [2], [3]], dtype="int64"))
+        out = emb(ids)
+        assert out.numpy().shape == (3, 6)  # [N,1] ids squeeze like the reference
+
+        bn = dygraph.BatchNorm(num_channels=4)
+        x = to_variable(np.random.RandomState(0).rand(2, 4, 3, 3).astype("float32"))
+        y = bn(x)
+        assert y.numpy().shape == (2, 4, 3, 3)
+        bn.eval()
+        y2 = bn(x)
+        assert y2.numpy().shape == (2, 4, 3, 3)
+
+
+def test_no_grad_blocks_taping():
+    with dygraph.guard():
+        x = to_variable(np.ones((2, 2), "float32"))
+        x.stop_gradient = False
+        with dygraph.no_grad():
+            y = fluid.layers.relu(x)
+        z = fluid.layers.reduce_sum(x * x)
+        z.backward()
+        assert x.gradient() is not None
