@@ -55,6 +55,7 @@ class HybridConfig(NamedTuple):
     sp: int = 1
     ep: int = 1
     lr: float = 0.1
+    ring_attention: bool = True  # sp>1: ring attention vs all-gather KV
 
     @property
     def layers_per_stage(self) -> int:
@@ -270,11 +271,24 @@ def make_train_step(cfg: HybridConfig, mesh=None):
             q = h @ p["wq"]
             k = h @ p["wk"]
             v = h @ p["wv"]
-            # sp: all-gather K/V sequence shards -> full-length keys
-            if cfg.sp > 1:
-                k = jax.lax.all_gather(k, "sp", axis=1, tiled=True)
-                v = jax.lax.all_gather(v, "sp", axis=1, tiled=True)
-            att = _attention_math(q, k, v, causal, h_loc, d_head)
+            if cfg.sp > 1 and cfg.ring_attention:
+                # ring attention: K/V blocks rotate over the sp ring with
+                # online-softmax accumulation (parallel/ring_attention.py)
+                from paddle_tpu.parallel.ring_attention import ring_attention
+
+                b = q.shape[0]
+
+                def heads(z):
+                    return z.reshape(b, t_loc, h_loc, d_head).transpose(0, 2, 1, 3)
+
+                ctx = ring_attention(heads(q), heads(k), heads(v), "sp", causal=True)
+                att = ctx.transpose(0, 2, 1, 3).reshape(b, t_loc, h_loc * d_head)
+            else:
+                # sp: all-gather K/V sequence shards -> full-length keys
+                if cfg.sp > 1:
+                    k = jax.lax.all_gather(k, "sp", axis=1, tiled=True)
+                    v = jax.lax.all_gather(v, "sp", axis=1, tiled=True)
+                att = _attention_math(q, k, v, causal, h_loc, d_head)
             # tp row-parallel output projection + psum over tp
             o = att @ p["wo"]
             o = jax.lax.psum(o, "tp")

@@ -1,0 +1,76 @@
+"""Ring attention: sequence-parallel exact attention over an ``sp`` axis.
+
+The reference has no sequence parallelism (SURVEY.md §2.10 — LoDTensor
+ragged batching is its only long-sequence story); this module is the
+TPU-native long-context mechanism the survey calls for: K/V blocks rotate
+around the ring via `lax.ppermute` while each rank's queries accumulate
+attention with an online (flash-style) running max / denominator — exact
+softmax attention with O(seq/sp) memory per chip and comm overlapped with
+compute by XLA.
+
+Used by parallel/hybrid.py when ``ring_attention=True`` (default for
+sp>1); standalone use:
+
+    out = ring_attention(q, k, v, axis_name="sp", causal=True)
+
+inside shard_map, where q/k/v are [batch, heads, t_local, d] sequence
+shards in ring order.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["ring_attention"]
+
+
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True, scale: Optional[float] = None):
+    """Exact attention over ring-sharded sequences.
+
+    q/k/v: [B, H, Tl, D] local shards (rank r holds tokens
+    [r*Tl, (r+1)*Tl)).  Returns [B, H, Tl, D].
+    """
+    import jax
+    import jax.numpy as jnp
+
+    B, H, Tl, D = q.shape
+    n = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+
+    q_pos = rank * Tl + jnp.arange(Tl)  # global positions of my queries
+
+    neg = jnp.asarray(-1e30, q.dtype)
+
+    def block(carry, step):
+        """Process the K/V block that started at rank (rank - step) % n."""
+        acc, m, l, kb, vb = carry
+        src = (rank - step) % n          # owner of this block
+        k_pos = src * Tl + jnp.arange(Tl)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kb) * scale
+        if causal:
+            mask = k_pos[None, :] <= q_pos[:, None]   # [Tl, Tl]
+            s = jnp.where(mask[None, None], s, neg)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # rescale previous accumulator, add this block
+        p = jnp.exp(s - m_new[..., None])
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + jnp.sum(p, axis=-1)
+        acc_new = acc * correction[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vb)
+        # rotate K/V to the next rank
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        return (acc_new, m_new, l_new, kb, vb), None
+
+    # derive inits from q so they inherit its device-varying (vma) type —
+    # a plain jnp.zeros carry would mismatch the scan body under shard_map
+    acc0 = jnp.zeros_like(q)
+    l0 = jnp.sum(jnp.zeros_like(q), axis=-1)
+    m0 = l0 + neg
+    (acc, m, l, _, _), _ = jax.lax.scan(
+        block, (acc0, m0, l0, k, v), jnp.arange(n)
+    )
+    # rows with no valid key (can't happen when causal and diag included)
+    l = jnp.maximum(l, 1e-20)
+    return acc / l[..., None]

@@ -12,7 +12,7 @@ from paddle_tpu.parallel import hybrid
 from paddle_tpu.parallel.mesh import local_devices
 
 
-def _run_cfg(axes, seed=0):
+def _run_cfg(axes, seed=0, ring=True):
     import jax
     import jax.numpy as jnp
 
@@ -27,6 +27,7 @@ def _run_cfg(axes, seed=0):
         batch=8,
         microbatches=2,
         lr=0.1,
+        ring_attention=ring,
         **axes,
     )
     n = int(np.prod(list(cfg.mesh_axes().values())))
@@ -81,3 +82,48 @@ def test_all_axes_size1_equivalence():
     l1 = _run_cfg({}, seed=3)
     l2 = _run_cfg({"dp": 2, "tp": 2, "pp": 2}, seed=3)
     assert abs(l1 - l2) < 1e-4
+
+
+def test_ring_attention_standalone_parity():
+    """ring attention == full softmax attention, causal, sp=4."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.parallel.ring_attention import ring_attention
+
+    devs = local_devices()
+    if len(devs) < 4:
+        pytest.skip("needs 4 devices")
+    mesh = Mesh(np.array(devs[:4]), ("sp",))
+    B, H, T, D = 2, 3, 32, 8
+    rng = np.random.RandomState(0)
+    q = rng.normal(size=(B, H, T, D)).astype("float32")
+    k = rng.normal(size=(B, H, T, D)).astype("float32")
+    v = rng.normal(size=(B, H, T, D)).astype("float32")
+
+    ring = jax.jit(
+        jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, "sp", causal=True),
+            mesh=mesh,
+            in_specs=(P(None, None, "sp"), P(None, None, "sp"), P(None, None, "sp")),
+            out_specs=P(None, None, "sp"),
+        )
+    )
+    got = np.asarray(ring(q, k, v))
+
+    with jax.default_device(devs[0]):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        mask = np.tril(np.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+        w = jax.nn.softmax(s, axis=-1)
+        want = np.asarray(jnp.einsum("bhqk,bhkd->bhqd", w, v))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_hybrid_with_ring_attention_parity():
+    _run_cfg({"pp": 2, "sp": 2, "ep": 2})  # ring_attention=True default
+
+
+def test_hybrid_allgather_sp_parity():
+    _run_cfg({"dp": 2, "sp": 2, "tp": 2}, seed=4, ring=False)
