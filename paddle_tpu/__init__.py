@@ -44,6 +44,9 @@ from paddle_tpu.backward import append_backward, gradients
 from paddle_tpu.param_attr import ParamAttr, WeightNormParamAttr
 from paddle_tpu import parallel
 from paddle_tpu import dygraph
+from paddle_tpu import distributed
+from paddle_tpu import transpiler
+from paddle_tpu.transpiler import DistributeTranspiler, DistributeTranspilerConfig
 from paddle_tpu import io
 from paddle_tpu import reader
 from paddle_tpu import dataset

@@ -1,0 +1,7 @@
+"""Distributed runtime: launcher + parameter server.
+
+Reference: python/paddle/distributed/launch.py (process launcher),
+paddle/fluid/operators/distributed/ (gRPC/BRPC parameter-server RPC).
+"""
+from paddle_tpu.distributed import launch  # noqa: F401
+from paddle_tpu.distributed.ps import ParameterServer, PSClient  # noqa: F401

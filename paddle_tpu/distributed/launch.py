@@ -1,0 +1,92 @@
+"""Multi-process launcher.
+
+Reference: python/paddle/distributed/launch.py:132-214 — computes
+``PADDLE_TRAINER_ENDPOINTS / PADDLE_TRAINER_ID / PADDLE_CURRENT_ENDPOINT``
+and spawns one worker process per device.  On TPU the unit is one process
+per *host* (a process owns all local chips through the jax runtime), so
+``--nproc_per_node`` defaults to 1; the env contract is kept verbatim so
+fleet role makers (parallel/fleet.py PaddleCloudRoleMaker) port
+unchanged.
+
+Usage:  python -m paddle_tpu.distributed.launch --cluster_node_ips=a,b \
+            --node_ip=a train.py --args
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+from typing import List
+
+__all__ = ["launch", "start_procs"]
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(description="paddle_tpu distributed launcher")
+    p.add_argument("--cluster_node_ips", type=str, default="127.0.0.1")
+    p.add_argument("--node_ip", type=str, default="127.0.0.1")
+    p.add_argument("--started_port", type=int, default=6170)
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def start_procs(args) -> List[subprocess.Popen]:
+    """reference: launch.py:132."""
+    node_ips = [ip for ip in args.cluster_node_ips.split(",") if ip]
+    node_id = node_ips.index(args.node_ip)
+    n_local = args.nproc_per_node
+
+    all_endpoints = []
+    for ip in node_ips:
+        for i in range(n_local):
+            all_endpoints.append("%s:%d" % (ip, args.started_port + i))
+
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+
+    procs = []
+    for local_rank in range(n_local):
+        rank = node_id * n_local + local_rank
+        env = dict(os.environ)
+        env.update(
+            {
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_CURRENT_ENDPOINT": all_endpoints[rank],
+                "PADDLE_TRAINERS_NUM": str(len(all_endpoints)),
+                "PADDLE_TRAINER_ENDPOINTS": ",".join(all_endpoints),
+                "FLAGS_selected_tpus": str(local_rank),
+            }
+        )
+        cmd = [sys.executable, "-u", args.training_script] + args.training_script_args
+        out = None
+        if args.log_dir:
+            out = open(os.path.join(args.log_dir, "workerlog.%d" % rank), "w")
+        procs.append(subprocess.Popen(cmd, env=env, stdout=out, stderr=out))
+    return procs
+
+
+def launch(argv=None):
+    """reference: launch.py:214."""
+    args = _parse_args(argv)
+    procs = start_procs(args)
+
+    def terminate(signum, frame):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+    signal.signal(signal.SIGTERM, terminate)
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

@@ -1,0 +1,232 @@
+"""Parameter server for sparse tables (host-side, over TCP).
+
+Reference: the PS stack in paddle/fluid/operators/distributed/ — gRPC
+SendRecvService (send_recv.proto.in:19-33 SendVariable/GetVariable/
+PrefetchVariable), request_handler_impl.cc (server-side optimize),
+parameter_prefetch.cc (row-wise sparse lookup), listen_and_serv_op.cc.
+
+TPU-native role: dense parameters live in HBM and sync via ICI
+collectives (no PS needed); the PS remains the right tool for *huge
+sparse embedding tables* that exceed HBM — rows live on host-CPU servers
+sharded by id, trainers prefetch rows before the compiled step and push
+sparse grads after (BASELINE.md DeepFM config).  Protocol is
+length-prefixed pickles over TCP — the gRPC wire format analog, kept
+dependency-free; swap in a C++ server without changing the client API.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["ParameterServer", "PSClient", "shard_ids"]
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    data = pickle.dumps(obj, protocol=4)
+    sock.sendall(struct.pack("<Q", len(data)) + data)
+
+
+def _recv_msg(sock: socket.socket):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("<Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+def shard_ids(ids: np.ndarray, n_shards: int) -> List[np.ndarray]:
+    """Round-robin id sharding (reference: split_ids_op.cc / ps_dispatcher
+    RoundRobin)."""
+    return [np.where(ids % n_shards == s)[0] for s in range(n_shards)]
+
+
+class _Table:
+    """One sparse table shard: id -> row, with lazy-initialized rows and
+    a simple optimizer (sgd | adagrad) applied server-side on push —
+    the reference's per-grad optimize sub-blocks (listen_and_serv)."""
+
+    def __init__(self, dim: int, initializer: str = "uniform", seed: int = 0,
+                 optimizer: str = "sgd", lr: float = 0.1):
+        self.dim = dim
+        self.rows: Dict[int, np.ndarray] = {}
+        self.moments: Dict[int, np.ndarray] = {}
+        self.initializer = initializer
+        self.optimizer = optimizer
+        self.lr = lr
+        self._rng = np.random.RandomState(seed)
+        self._lock = threading.Lock()
+
+    def _init_row(self) -> np.ndarray:
+        if self.initializer == "zeros":
+            return np.zeros(self.dim, np.float32)
+        return self._rng.uniform(-0.05, 0.05, self.dim).astype(np.float32)
+
+    def pull(self, ids: Sequence[int]) -> np.ndarray:
+        with self._lock:
+            out = np.empty((len(ids), self.dim), np.float32)
+            for i, idx in enumerate(ids):
+                row = self.rows.get(int(idx))
+                if row is None:
+                    row = self.rows[int(idx)] = self._init_row()
+                out[i] = row
+            return out
+
+    def push(self, ids: Sequence[int], grads: np.ndarray) -> None:
+        with self._lock:
+            for idx, g in zip(ids, grads):
+                idx = int(idx)
+                row = self.rows.get(idx)
+                if row is None:
+                    row = self.rows[idx] = self._init_row()
+                if self.optimizer == "adagrad":
+                    m = self.moments.get(idx)
+                    if m is None:
+                        m = self.moments[idx] = np.zeros(self.dim, np.float32)
+                    m += g * g
+                    row -= self.lr * g / (np.sqrt(m) + 1e-6)
+                else:
+                    row -= self.lr * g
+
+
+class ParameterServer:
+    """Sparse-table server (reference: listen_and_serv_op.cc:109 sync loop
+    + request_handler_impl.cc handlers)."""
+
+    def __init__(self, endpoint: str = "127.0.0.1:0"):
+        host, port = endpoint.rsplit(":", 1)
+        self._tables: Dict[str, _Table] = {}
+        self._barrier_count = 0
+        self._barrier_lock = threading.Lock()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        msg = _recv_msg(self.request)
+                        _send_msg(self.request, outer._dispatch(msg))
+                except (ConnectionError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, int(port)), Handler)
+        self.endpoint = "%s:%d" % self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    # --- server ops ---
+    def create_table(self, name: str, dim: int, **kwargs):
+        self._tables[name] = _Table(dim, **kwargs)
+
+    def _dispatch(self, msg):
+        op = msg["op"]
+        if op == "pull":
+            return {"rows": self._tables[msg["table"]].pull(msg["ids"])}
+        if op == "push":
+            self._tables[msg["table"]].push(msg["ids"], msg["grads"])
+            return {"ok": True}
+        if op == "create_table":
+            self.create_table(msg["table"], msg["dim"], **msg.get("kwargs", {}))
+            return {"ok": True}
+        if op == "save":
+            return {
+                "tables": {
+                    n: {"dim": t.dim, "rows": dict(t.rows)} for n, t in self._tables.items()
+                }
+            }
+        if op == "barrier":  # counted barrier (rpc_server.cc analog)
+            with self._barrier_lock:
+                self._barrier_count += 1
+                return {"count": self._barrier_count}
+        if op == "stats":
+            return {n: len(t.rows) for n, t in self._tables.items()}
+        raise ValueError("unknown PS op %r" % op)
+
+    def start(self):
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class PSClient:
+    """Trainer-side client (reference: distributed/grpc_client.cc +
+    parameter_prefetch.cc).  Ids shard across servers round-robin."""
+
+    def __init__(self, endpoints: Sequence[str]):
+        self.endpoints = list(endpoints)
+        self._socks: List[Optional[socket.socket]] = [None] * len(self.endpoints)
+
+    def _sock(self, i) -> socket.socket:
+        if self._socks[i] is None:
+            host, port = self.endpoints[i].rsplit(":", 1)
+            s = socket.create_connection((host, int(port)), timeout=30)
+            self._socks[i] = s
+        return self._socks[i]
+
+    def _call(self, i, msg):
+        s = self._sock(i)
+        _send_msg(s, msg)
+        return _recv_msg(s)
+
+    def create_table(self, name: str, dim: int, **kwargs):
+        for i in range(len(self.endpoints)):
+            self._call(i, {"op": "create_table", "table": name, "dim": dim, "kwargs": kwargs})
+
+    def pull_sparse(self, table: str, ids: np.ndarray) -> np.ndarray:
+        """Row lookup for a flat id array -> [len(ids), dim]."""
+        ids = np.asarray(ids).reshape(-1)
+        n = len(self.endpoints)
+        parts = shard_ids(ids, n)
+        out = None
+        for i, pos in enumerate(parts):
+            if len(pos) == 0:
+                continue
+            rows = self._call(i, {"op": "pull", "table": table, "ids": ids[pos].tolist()})["rows"]
+            if out is None:
+                out = np.empty((len(ids), rows.shape[1]), np.float32)
+            out[pos] = rows
+        return out
+
+    def push_sparse(self, table: str, ids: np.ndarray, grads: np.ndarray) -> None:
+        ids = np.asarray(ids).reshape(-1)
+        grads = np.asarray(grads).reshape(len(ids), -1)
+        # de-duplicate ids, summing grads (reference merge_ids_op)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        merged = np.zeros((len(uniq), grads.shape[1]), np.float32)
+        np.add.at(merged, inv, grads)
+        parts = shard_ids(uniq, len(self.endpoints))
+        for i, pos in enumerate(parts):
+            if len(pos) == 0:
+                continue
+            self._call(i, {"op": "push", "table": table, "ids": uniq[pos].tolist(), "grads": merged[pos]})
+
+    def barrier(self):
+        for i in range(len(self.endpoints)):
+            self._call(i, {"op": "barrier"})
+
+    def close(self):
+        for s in self._socks:
+            if s is not None:
+                s.close()
+        self._socks = [None] * len(self.endpoints)

@@ -133,3 +133,18 @@ def c_gen_nccl_id(inputs, attrs):
     # TPU runtime performs its own bootstrap (no ncclUniqueId exchange,
     # reference: collective/c_gen_nccl_id_op.cc); no-op.
     return {}
+
+
+@register_op("local_sgd_select", differentiable=False)
+def local_sgd_select(inputs, attrs):
+    """Every k steps take the cross-rank average, else keep the local
+    param (transpiler/collective.py LocalSGD analog; the allreduce feeding
+    Avg is a separate c_allreduce_sum op)."""
+    import jax.numpy as jnp
+
+    p = one(inputs, "Param")
+    avg = one(inputs, "Avg") / float(attrs.get("nranks", 1))
+    step = one(inputs, "Step")
+    k = float(attrs.get("k_steps", 1))
+    take_avg = jnp.equal(jnp.mod(jnp.reshape(step, ()), k), 0.0)
+    return {"Out": jnp.where(take_avg, avg, p)}

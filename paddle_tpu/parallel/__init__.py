@@ -13,3 +13,8 @@ from paddle_tpu.parallel.strategy import (  # noqa: F401
     ExecutionStrategy,
 )
 from paddle_tpu.parallel.compiled_program import CompiledProgram  # noqa: F401
+from paddle_tpu.parallel import collective_transpiler  # noqa: F401
+from paddle_tpu.parallel import fleet as fleet_mod  # noqa: F401
+from paddle_tpu.parallel.fleet import fleet  # noqa: F401
+from paddle_tpu.parallel import hybrid  # noqa: F401
+from paddle_tpu.parallel import ring_attention  # noqa: F401

@@ -1,0 +1,210 @@
+"""Fleet: unified distributed training API.
+
+Reference: python/paddle/fluid/incubate/fleet/base/fleet_base.py:37
+(Fleet + DistributedOptimizer), base/role_maker.py:30-444 (role makers),
+collective/__init__.py (Collective fleet + CollectiveOptimizer).
+
+TPU-native: collective mode wraps the optimizer so ``minimize`` returns a
+CompiledProgram bound to a mesh built from the role maker's world — the
+transpiler NCCL2 rewrite (gen_nccl_id etc.) is unnecessary because the
+jax runtime bootstraps the slice; multi-host init maps to
+``jax.distributed.initialize``.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from paddle_tpu import framework
+from paddle_tpu.parallel import mesh as mesh_lib
+from paddle_tpu.parallel.compiled_program import CompiledProgram
+from paddle_tpu.parallel.strategy import DistributedStrategy
+
+__all__ = [
+    "Fleet",
+    "fleet",
+    "DistributedOptimizer",
+    "PaddleCloudRoleMaker",
+    "UserDefinedRoleMaker",
+    "Role",
+]
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._worker_endpoints: List[str] = []
+        self._server_endpoints: List[str] = []
+        self._role = Role.WORKER
+        self._current_id = 0
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_first_worker(self):
+        return self.is_worker() and self._current_id == 0
+
+    def worker_index(self):
+        return self._current_id
+
+    def server_index(self):
+        return self._current_id
+
+    def worker_num(self):
+        return max(1, len(self._worker_endpoints))
+
+    def server_num(self):
+        return len(self._server_endpoints)
+
+    def get_trainer_endpoints(self):
+        return self._worker_endpoints
+
+    def get_pserver_endpoints(self):
+        return self._server_endpoints
+
+    def generate_role(self):
+        pass
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Reads the launcher env (reference: role_maker.py:328 — the
+    PADDLE_* contract kept verbatim so launch scripts port unchanged)."""
+
+    def __init__(self, is_collective: bool = True):
+        super().__init__()
+        self._is_collective = is_collective
+        self.generate_role()
+
+    def generate_role(self):
+        self._worker_endpoints = [
+            e for e in os.getenv("PADDLE_TRAINER_ENDPOINTS", "").split(",") if e
+        ]
+        self._server_endpoints = [
+            e for e in os.getenv("PADDLE_PSERVER_ENDPOINTS", "").split(",") if e
+        ]
+        role = os.getenv("TRAINING_ROLE", "TRAINER").upper()
+        self._role = Role.SERVER if role == "PSERVER" else Role.WORKER
+        self._current_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, current_id=0, role=Role.WORKER, worker_num=1, server_endpoints=None):
+        super().__init__()
+        self._current_id = current_id
+        self._role = role
+        self._worker_endpoints = ["127.0.0.1:%d" % (6170 + i) for i in range(worker_num)]
+        self._server_endpoints = server_endpoints or []
+
+
+class Fleet:
+    """Collective-mode fleet singleton (reference: fleet_base.py:37)."""
+
+    def __init__(self):
+        self._role_maker: Optional[RoleMakerBase] = None
+        self._strategy: Optional[DistributedStrategy] = None
+        self._inited = False
+
+    def init(self, role_maker: Optional[RoleMakerBase] = None):
+        self._role_maker = role_maker or PaddleCloudRoleMaker()
+        self._role_maker.generate_role()
+        self._inited = True
+        # multi-host: hand the process set to the jax runtime
+        n_hosts = len({e.split(":")[0] for e in self._role_maker.get_trainer_endpoints()})
+        if n_hosts > 1 and os.getenv("PADDLE_TPU_DISTRIBUTED_INIT", "0") == "1":
+            import jax
+
+            jax.distributed.initialize()
+        return self
+
+    # --- introspection (reference API) ---
+    def is_worker(self):
+        return self._role_maker is None or self._role_maker.is_worker()
+
+    def is_server(self):
+        return self._role_maker is not None and self._role_maker.is_server()
+
+    def is_first_worker(self):
+        return self._role_maker is None or self._role_maker.is_first_worker()
+
+    def worker_index(self):
+        return self._role_maker.worker_index() if self._role_maker else 0
+
+    def worker_num(self):
+        return self._role_maker.worker_num() if self._role_maker else 1
+
+    def worker_endpoints(self, to_string=False):
+        eps = self._role_maker.get_trainer_endpoints() if self._role_maker else []
+        return ",".join(eps) if to_string else eps
+
+    def server_endpoints(self, to_string=False):
+        eps = self._role_maker.get_pserver_endpoints() if self._role_maker else []
+        return ",".join(eps) if to_string else eps
+
+    def distributed_optimizer(self, optimizer, strategy: Optional[DistributedStrategy] = None):
+        self._strategy = strategy or DistributedStrategy()
+        return DistributedOptimizer(optimizer, self._strategy, self)
+
+    # --- program lifecycle ---
+    def init_worker(self):
+        pass
+
+    def init_server(self, *args, **kwargs):
+        pass
+
+    def run_server(self):
+        pass
+
+    def stop_worker(self):
+        pass
+
+    def save_inference_model(self, executor, dirname, feeded_var_names, target_vars,
+                             main_program=None, export_for_deployment=True):
+        from paddle_tpu import io
+
+        return io.save_inference_model(dirname, feeded_var_names, target_vars, executor, main_program)
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        from paddle_tpu import io
+
+        return io.save_persistables(executor, dirname, main_program)
+
+    @property
+    def main_program(self):
+        return getattr(self, "_compiled_program", None) or framework.default_main_program()
+
+
+class DistributedOptimizer:
+    """reference: CollectiveOptimizer (incubate/fleet/collective/
+    __init__.py:157).  minimize() appends the normal backward+optimize
+    ops, then binds a CompiledProgram over the fleet mesh; the gradient
+    allreduce is GSPMD's, riding ICI."""
+
+    def __init__(self, optimizer, strategy: DistributedStrategy, fleet_: Fleet):
+        self._optimizer = optimizer
+        self._strategy = strategy
+        self._fleet = fleet_
+
+    def backward(self, *a, **k):
+        return self._optimizer.backward(*a, **k)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        ops, pgs = self._optimizer.minimize(loss, startup_program, parameter_list, no_grad_set)
+        strat = self._strategy
+        if not strat.mesh_axes:
+            strat.mesh_axes = {"dp": len(mesh_lib.local_devices())}
+        compiled = CompiledProgram(loss.block.program).with_strategy(strat)
+        self._fleet._compiled_program = compiled
+        return ops, pgs
+
+
+fleet = Fleet()

@@ -1,0 +1,122 @@
+"""Transpiler namespace parity (reference: python/paddle/fluid/transpiler/).
+
+* ``DistributeTranspiler`` — the reference's PS program rewriter
+  (distribute_transpiler.py:181).  On TPU dense parameters sync via ICI
+  collectives (CompiledProgram / fleet), and sparse tables use the host
+  parameter server (paddle_tpu/distributed/ps.py); this class keeps the
+  API and, in "nccl2"-equivalent collective mode, delegates to the
+  GradAllReduce rewriter.
+* ``memory_optimize`` / ``release_memory`` — no-ops: XLA buffer
+  assignment subsumes the reference's liveness-based reuse pass
+  (memory_optimization_transpiler.py).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from paddle_tpu import framework
+from paddle_tpu.parallel.collective_transpiler import Collective, GradAllReduce, LocalSGD  # noqa: F401
+
+__all__ = [
+    "DistributeTranspiler",
+    "DistributeTranspilerConfig",
+    "GradAllReduce",
+    "LocalSGD",
+    "memory_optimize",
+    "release_memory",
+    "HashName",
+    "RoundRobin",
+]
+
+
+class DistributeTranspilerConfig:
+    """reference: distribute_transpiler.py:131."""
+
+    slice_var_up = True
+    split_method = None
+    min_block_size = 8192
+    mode = "pserver"  # or "nccl2" (collective)
+    print_log = False
+    wait_port = True
+
+
+class DistributeTranspiler:
+    """reference: distribute_transpiler.py:181."""
+
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        self.config = config or DistributeTranspilerConfig()
+        self._collective: Optional[Collective] = None
+
+    def transpile(
+        self,
+        trainer_id: int,
+        program=None,
+        pservers: str = "127.0.0.1:6174",
+        trainers: int = 1,
+        sync_mode: bool = True,
+        startup_program=None,
+        current_endpoint: str = "127.0.0.1:6174",
+    ):
+        program = program or framework.default_main_program()
+        startup_program = startup_program or framework.default_startup_program()
+        if self.config.mode == "nccl2":
+            endpoints = [str(i) for i in range(trainers)]
+            self._collective = GradAllReduce()
+            self._collective.transpile(
+                startup_program, program, trainer_id, endpoints, str(trainer_id),
+            )
+            return
+        # pserver mode: dense PS is legacy on TPU; grads still sync via the
+        # collective path, sparse tables go through distributed/ps.py
+        self.trainer_id = trainer_id
+        self.pserver_endpoints = [e for e in pservers.split(",") if e]
+        self.trainer_num = trainers
+        self.sync_mode = sync_mode
+        self.origin_program = program
+
+    def get_trainer_program(self, wait_port: bool = True):
+        return self.origin_program
+
+    def get_pserver_program(self, endpoint: str):
+        # the TPU build serves sparse tables from distributed/ps.py; dense
+        # pserver programs are not generated (SURVEY.md §2.10 maps dense PS
+        # to sharded optimizer state over ICI instead)
+        prog = framework.Program()
+        return prog
+
+    def get_pserver_programs(self, endpoint: str):
+        prog = self.get_pserver_program(endpoint)
+        return prog, framework.Program()
+
+    def get_startup_program(self, endpoint: str, pserver_program=None):
+        return framework.Program()
+
+
+def memory_optimize(input_program=None, skip_opt_set=None, print_log=False, level=0, skip_grads=False):
+    """No-op: XLA buffer assignment performs cross-op reuse (the
+    reference's memory_optimization_transpiler.py liveness pass)."""
+
+
+def release_memory(input_program=None, skip_opt_set=None):
+    """No-op (see memory_optimize)."""
+
+
+class HashName:
+    def __init__(self, pserver_endpoints):
+        self.endpoints = pserver_endpoints
+
+    def dispatch(self, varlist):
+        return [self.endpoints[hash(v.name) % len(self.endpoints)] for v in varlist]
+
+
+class RoundRobin:
+    def __init__(self, pserver_endpoints):
+        self.endpoints = pserver_endpoints
+        self._i = 0
+
+    def dispatch(self, varlist):
+        out = []
+        for v in varlist:
+            out.append(self.endpoints[self._i % len(self.endpoints)])
+            self._i += 1
+        return out
