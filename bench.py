@@ -16,8 +16,8 @@ import time
 
 import numpy as np
 
-BATCH = int(os.environ.get("BENCH_BATCH", "64"))
-STEPS = int(os.environ.get("BENCH_STEPS", "10"))
+BATCH = int(os.environ.get("BENCH_BATCH", "256"))
+STEPS = int(os.environ.get("BENCH_STEPS", "20"))
 RESNET50_FWD_FLOPS_PER_IMG = 4.09e9
 PEAK_FLOPS = {"tpu": 197e12, "cpu": 1e12}  # v5e bf16; cpu nominal
 
@@ -31,6 +31,7 @@ def main():
     platform = jax.devices()[0].platform
     place = fluid.TPUPlace(0) if platform == "tpu" else fluid.CPUPlace()
 
+    use_amp = os.environ.get("BENCH_AMP", "1") == "1"
     prog, startup = framework.Program(), framework.Program()
     prog.random_seed = startup.random_seed = 42
     with framework.program_guard(prog, startup):
@@ -38,6 +39,8 @@ def main():
         lbl = fluid.layers.data("lbl", [1], dtype="int64")
         avg_loss, acc, _ = models.resnet50(img, lbl)
         opt = fluid.optimizer.MomentumOptimizer(learning_rate=0.1, momentum=0.9)
+        if use_amp:
+            opt = fluid.contrib.mixed_precision.decorate(opt)
         opt.minimize(avg_loss)
 
     rng = np.random.RandomState(0)

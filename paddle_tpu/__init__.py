@@ -47,6 +47,9 @@ from paddle_tpu import dygraph
 from paddle_tpu import distributed
 from paddle_tpu import transpiler
 from paddle_tpu.transpiler import DistributeTranspiler, DistributeTranspilerConfig
+from paddle_tpu import contrib
+from paddle_tpu import inference
+from paddle_tpu import profiler
 from paddle_tpu import io
 from paddle_tpu import reader
 from paddle_tpu import dataset

@@ -1,0 +1,3 @@
+"""Contrib namespace (reference: python/paddle/fluid/contrib/)."""
+from paddle_tpu.contrib import mixed_precision  # noqa: F401
+from paddle_tpu.contrib import slim  # noqa: F401

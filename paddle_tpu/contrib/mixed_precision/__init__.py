@@ -1,0 +1,20 @@
+"""Automatic mixed precision.
+
+Reference: python/paddle/fluid/contrib/mixed_precision/ — decorate()
+(decorator.py:194) wraps the optimizer, rewrite_program casts whitelisted
+ops to fp16 with fp32 master weights and dynamic loss scaling.
+
+TPU-native: the low-precision type is **bfloat16** — same exponent range
+as fp32, so loss scaling is unnecessary (kept as API surface, default
+off).  The rewrite casts inputs of MXU ops (matmul/conv family, the white
+list) to bf16; XLA keeps the fused epilogues in higher precision and the
+parameter/optimizer state stays fp32 (master weights by construction —
+the cast is part of the graph, grads flow back through it to fp32).
+"""
+from paddle_tpu.contrib.mixed_precision.decorator import (  # noqa: F401
+    AutoMixedPrecisionLists,
+    OptimizerWithMixedPrecision,
+    bf16_guard,
+    decorate,
+    rewrite_program,
+)

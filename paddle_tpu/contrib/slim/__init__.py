@@ -1,0 +1,5 @@
+"""Model compression (reference: python/paddle/fluid/contrib/slim/ —
+quantization QAT passes, distillation, pruning, NAS).  Round-1 surface:
+quantization-aware training rewrite; the rest of slim is tracked in
+SURVEY.md §2.9 as open parity items."""
+from paddle_tpu.contrib.slim import quantization  # noqa: F401

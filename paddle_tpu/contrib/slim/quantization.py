@@ -1,0 +1,91 @@
+"""Quantization-aware training rewrite.
+
+Reference: python/paddle/fluid/contrib/slim/quantization/
+quantization_pass.py (QuantizationTransformPass rewrites an IrGraph:
+fake_quantize on inputs/weights of quantizable ops, fake_dequantize after)
+and operators' fake_quantize_*_op.cc.
+
+TPU note: int8 inference on TPU goes through XLA's native int8 matmul
+path; QAT here simulates quantization in fp32 (identical math to the
+reference's fake ops) so trained scales transfer.
+"""
+from __future__ import annotations
+
+from typing import Optional, Set
+
+from paddle_tpu import framework, unique_name
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import one
+
+__all__ = ["QuantizationTransformPass", "quantize_program"]
+
+
+@register_op("fake_quantize_dequantize_abs_max")
+def fake_quantize_dequantize_abs_max(inputs, attrs):
+    """reference: operators/fake_quantize_op.cc — symmetric abs-max
+    quantize+dequantize in one op (straight-through estimator under vjp:
+    the rounding is piecewise-constant, so grads flow through the scale
+    path; matches the reference's behavior)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = one(inputs, "X")
+    bits = attrs.get("bit_length", 8)
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+    q = jnp.round(x / scale * qmax)
+    q = jnp.clip(q, -qmax, qmax)
+    out = q * scale / qmax
+    # straight-through: out = x + stop_grad(quantized - x)
+    out = x + jax.lax.stop_gradient(out - x)
+    return {"Out": out, "OutScale": scale.reshape(1)}
+
+
+class QuantizationTransformPass:
+    """reference: quantization_pass.py QuantizationTransformPass."""
+
+    def __init__(self, quantizable_op_type=("conv2d", "depthwise_conv2d", "mul", "matmul"),
+                 weight_bits: int = 8, activation_bits: int = 8):
+        self.quantizable = set(quantizable_op_type)
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+
+    def apply(self, program) -> None:
+        block = program.global_block()
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            if op.type not in self.quantizable or op.attrs.get("op_role") == "backward":
+                i += 1
+                continue
+            inserted = 0
+            for slot, names in list(op.inputs.items()):
+                new_names = []
+                for n in names:
+                    v = block._find_var_recursive(n)
+                    if v is None or v.dtype not in ("float32",):
+                        new_names.append(n)
+                        continue
+                    is_weight = isinstance(v, framework.Parameter)
+                    bits = self.weight_bits if is_weight else self.activation_bits
+                    qname = unique_name.generate(n + ".quantized")
+                    sname = unique_name.generate(n + ".quant_scale")
+                    block.create_var(name=qname, shape=v.shape, dtype="float32")
+                    block.create_var(name=sname, shape=[1], dtype="float32", stop_gradient=True)
+                    block._insert_op(
+                        i + inserted,
+                        type="fake_quantize_dequantize_abs_max",
+                        inputs={"X": [n]},
+                        outputs={"Out": [qname], "OutScale": [sname]},
+                        attrs={"bit_length": bits, "op_role": op.attrs.get("op_role", "forward")},
+                    )
+                    inserted += 1
+                    new_names.append(qname)
+                op.inputs[slot] = new_names
+            i += inserted + 1
+        program.version += 1
+
+
+def quantize_program(program, **kwargs):
+    QuantizationTransformPass(**kwargs).apply(program)
+    return program

@@ -1,0 +1,97 @@
+"""Inference engine: config + predictor.
+
+Reference: paddle/fluid/inference/api/ — AnalysisConfig,
+AnalysisPredictor (analysis_predictor.h:46: load program, run IR fuse
+passes, NaiveExecutor over an optimized graph, zero-copy tensors),
+CreatePaddlePredictor.
+
+TPU-native: "analysis" = whole-program XLA compilation (the fuse-pass
+pipeline is the compiler); the predictor jit-caches per input signature
+and keeps weights resident in HBM, so repeat Run() calls are one
+dispatch.  Zero-copy = device arrays in/out (ZeroCopyTensor analog).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu import framework, io
+from paddle_tpu.core import lowering
+
+__all__ = ["AnalysisConfig", "PaddlePredictor", "AnalysisPredictor", "create_paddle_predictor"]
+
+
+class AnalysisConfig:
+    """reference: api/paddle_analysis_config.h."""
+
+    def __init__(self, model_dir: Optional[str] = None):
+        self.model_dir = model_dir
+        self.params_file: Optional[str] = None
+        self.model_file: Optional[str] = None
+        self._use_tpu = True
+        self._device_id = 0
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._use_tpu = True  # accelerator = TPU here
+        self._device_id = device_id
+
+    def disable_gpu(self):
+        self._use_tpu = False
+
+    def set_model(self, model_dir: str, params_file: Optional[str] = None):
+        self.model_dir = model_dir
+        self.params_file = params_file
+
+    def switch_use_feed_fetch_ops(self, flag: bool):
+        pass
+
+    def switch_ir_optim(self, flag: bool = True):
+        pass  # XLA always optimizes
+
+
+class PaddlePredictor:
+    pass
+
+
+class AnalysisPredictor(PaddlePredictor):
+    """reference: api/analysis_predictor.h:46."""
+
+    def __init__(self, config: AnalysisConfig):
+        import paddle_tpu as fluid
+
+        self.config = config
+        self._scope = fluid.Scope()
+        self._exe = fluid.Executor(
+            fluid.TPUPlace(config._device_id) if config._use_tpu else fluid.CPUPlace()
+        )
+        with fluid.scope_guard(self._scope):
+            self._program, self._feed_names, self._fetch_vars = io.load_inference_model(
+                config.model_dir, self._exe, params_filename=config.params_file
+            )
+        self._fetch_names = [v.name for v in self._fetch_vars]
+        self._jit_cache: Dict[Any, Any] = {}
+
+    # --- reference surface ---
+    def get_input_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._fetch_names)
+
+    def run(self, feed: Dict[str, np.ndarray] | Sequence[np.ndarray]):
+        import paddle_tpu as fluid
+
+        if not isinstance(feed, dict):
+            feed = dict(zip(self._feed_names, feed))
+        with fluid.scope_guard(self._scope):
+            return self._exe.run(
+                self._program, feed=feed, fetch_list=self._fetch_names
+            )
+
+    Run = run  # C++-style alias
+
+
+def create_paddle_predictor(config: AnalysisConfig) -> AnalysisPredictor:
+    """reference: CreatePaddlePredictor<AnalysisConfig>."""
+    return AnalysisPredictor(config)

@@ -1,0 +1,91 @@
+"""Profiler (reference: python/paddle/fluid/profiler.py:39-225 +
+platform/profiler.cc RecordEvent / CUPTI DeviceTracer).
+
+TPU-native: device-side tracing is jax.profiler (XPlane; view in
+TensorBoard/xprof or chrome://tracing — the timeline.py analog is built
+into xprof), host-side per-run timing is recorded by this module.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+__all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler", "RecordEvent", "cuda_profiler"]
+
+_host_events: Dict[str, List[float]] = defaultdict(list)
+_active_trace_dir: Optional[str] = None
+
+
+class RecordEvent:
+    """Host-side RAII timing marker (reference: profiler.h:81)."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        _host_events[self.name].append(time.perf_counter() - self._t0)
+        return False
+
+
+def start_profiler(state: str = "All", trace_dir: Optional[str] = None):
+    """reference: profiler.py start_profiler / EnableProfiler."""
+    global _active_trace_dir
+    reset_profiler()
+    if trace_dir:
+        import jax
+
+        jax.profiler.start_trace(trace_dir)
+        _active_trace_dir = trace_dir
+
+
+def stop_profiler(sorted_key: str = "total", profile_path: Optional[str] = None):
+    """reference: profiler.py stop_profiler — prints the per-event table."""
+    global _active_trace_dir
+    if _active_trace_dir is not None:
+        import jax
+
+        jax.profiler.stop_trace()
+        _active_trace_dir = None
+    rows = []
+    for name, ts in _host_events.items():
+        rows.append((name, len(ts), sum(ts), max(ts), sum(ts) / len(ts)))
+    key_idx = {"total": 2, "max": 3, "ave": 4, "calls": 1}.get(sorted_key, 2)
+    rows.sort(key=lambda r: r[key_idx], reverse=True)
+    lines = ["%-40s %8s %12s %12s %12s" % ("Event", "Calls", "Total(s)", "Max(s)", "Ave(s)")]
+    for name, calls, total, mx, ave in rows:
+        lines.append("%-40s %8d %12.6f %12.6f %12.6f" % (name, calls, total, mx, ave))
+    report = "\n".join(lines)
+    if profile_path:
+        with open(profile_path, "w") as f:
+            f.write(report)
+    else:
+        print(report)
+    return rows
+
+
+def reset_profiler():
+    _host_events.clear()
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: str = "total",
+             profile_path: Optional[str] = None, trace_dir: Optional[str] = None):
+    """reference: profiler.py:127 context manager."""
+    start_profiler(state, trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def cuda_profiler(*a, **k):
+    """Legacy nvprof hook (reference: profiler.py:39) — device tracing on
+    TPU goes through jax.profiler; kept as a no-op alias."""
+    yield

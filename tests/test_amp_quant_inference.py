@@ -1,0 +1,118 @@
+"""AMP / quantization / inference predictor / profiler tests."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import framework
+
+
+def _mlp_program(seed=21):
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = seed
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [16])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        h = fluid.layers.fc(x, 32, act="relu")
+        pred = fluid.layers.fc(h, 4, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+    return prog, startup, loss, pred
+
+
+def test_amp_bf16_trains():
+    prog, startup, loss, _ = _mlp_program()
+    with framework.program_guard(prog, startup):
+        opt = fluid.contrib.mixed_precision.decorate(
+            fluid.optimizer.AdamOptimizer(0.01)
+        )
+        opt.minimize(loss)
+    # white-list matmuls now consume bf16 casts
+    types = [op.type for op in prog.global_block().ops]
+    assert "cast" in types
+    bf16_inputs = [
+        n for op in prog.global_block().ops if op.type == "mul"
+        for n in op.input_arg_names
+        if prog.global_block()._find_var_recursive(n) is not None
+        and prog.global_block()._find_var_recursive(n).dtype == "bfloat16"
+    ]
+    assert bf16_inputs, "mul ops should see bf16 inputs after AMP rewrite"
+
+    rng = np.random.RandomState(0)
+    feed = {
+        "x": rng.uniform(-1, 1, (32, 16)).astype("float32"),
+        "y": rng.randint(0, 4, (32, 1)).astype("int64"),
+    }
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(8):
+            (l,) = exe.run(prog, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(l)))
+    assert losses[-1] < losses[0], losses
+    # master weights stayed fp32
+    for p in prog.all_parameters():
+        assert str(np.asarray(scope.get(p.name)).dtype) == "float32"
+
+
+def test_qat_rewrite_trains():
+    from paddle_tpu.contrib.slim.quantization import QuantizationTransformPass
+
+    prog, startup, loss, _ = _mlp_program(seed=22)
+    with framework.program_guard(prog, startup):
+        QuantizationTransformPass().apply(prog)
+        fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+    types = [op.type for op in prog.global_block().ops]
+    assert "fake_quantize_dequantize_abs_max" in types
+
+    rng = np.random.RandomState(1)
+    feed = {
+        "x": rng.uniform(-1, 1, (32, 16)).astype("float32"),
+        "y": rng.randint(0, 4, (32, 1)).astype("int64"),
+    }
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(8):
+            (l,) = exe.run(prog, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(l)))
+    assert losses[-1] < losses[0], losses
+
+
+def test_analysis_predictor_roundtrip(tmp_path):
+    prog, startup, loss, pred = _mlp_program(seed=23)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(2)
+    xb = rng.uniform(-1, 1, (4, 16)).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        test_prog = prog.clone(for_test=True)
+        (want,) = exe.run(
+            test_prog, feed={"x": xb, "y": np.zeros((4, 1), "int64")}, fetch_list=[pred]
+        )
+        fluid.save_inference_model(str(tmp_path / "m"), ["x"], [pred], exe, prog)
+
+    from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+
+    cfg = AnalysisConfig(str(tmp_path / "m"))
+    cfg.disable_gpu()
+    predictor = create_paddle_predictor(cfg)
+    assert predictor.get_input_names() == ["x"]
+    (got,) = predictor.run({"x": xb})
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+
+
+def test_profiler_collects_events(capsys):
+    from paddle_tpu import profiler as P
+
+    with P.profiler(sorted_key="total"):
+        with P.RecordEvent("stepA"):
+            sum(range(1000))
+        with P.RecordEvent("stepA"):
+            sum(range(1000))
+        with P.RecordEvent("stepB"):
+            sum(range(10))
+    out = capsys.readouterr().out
+    assert "stepA" in out and "stepB" in out and "Calls" in out
