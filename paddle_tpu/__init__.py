@@ -49,6 +49,8 @@ from paddle_tpu import transpiler
 from paddle_tpu.transpiler import DistributeTranspiler, DistributeTranspilerConfig
 from paddle_tpu import contrib
 from paddle_tpu import inference
+from paddle_tpu import native
+from paddle_tpu.fluid_dataset import DatasetFactory, InMemoryDataset, QueueDataset
 from paddle_tpu import profiler
 from paddle_tpu import io
 from paddle_tpu import reader

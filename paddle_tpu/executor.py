@@ -171,5 +171,29 @@ class Executor:
         return fetches
 
     # ------------------------------------------------------------------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Loop the dataset's batches through run() (reference:
+        executor.py train_from_dataset -> C++ Trainer/DeviceWorker loop,
+        trainer.h:38; here the compiled step is the device worker)."""
+        results = []
+        for i, feed in enumerate(dataset):
+            out = self.run(program, feed=feed, fetch_list=fetch_list, scope=scope)
+            if fetch_list:
+                results.append(out)
+                if debug and i % print_period == 0:
+                    names = fetch_info or [ _as_fetch_name(f) for f in fetch_list]
+                    print("batch %d:" % i, dict(zip(names, [np.asarray(o) for o in out])))
+        return results
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        return self.train_from_dataset(
+            program, dataset, scope, thread, debug, fetch_list, fetch_info, print_period
+        )
+
+    # ------------------------------------------------------------------
     def close(self):
         self._cache.clear()

@@ -1,0 +1,219 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+Reference native parts this covers: paddle/fluid/recordio/ (chunked CRC'd
+record files) and the MultiSlot parsing hot path of
+paddle/fluid/framework/data_feed.cc.  The library builds on first use
+with g++ (cached under ``~/.cache/paddle_tpu``); when no toolchain is
+available a pure-Python fallback keeps the API working.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import tempfile
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["RecordIOWriter", "RecordIOScanner", "parse_multislot", "native_available"]
+
+_lib = None
+_tried = False
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    src = os.path.join(os.path.dirname(__file__), "recordio.cc")
+    cache = os.environ.get(
+        "PADDLE_TPU_CACHE", os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu")
+    )
+    os.makedirs(cache, exist_ok=True)
+    so_path = os.path.join(cache, "libpaddle_tpu_native.so")
+    if not os.path.exists(so_path) or os.path.getmtime(so_path) < os.path.getmtime(src):
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src, "-o", so_path, "-lz"],
+                check=True,
+                capture_output=True,
+            )
+        except (OSError, subprocess.CalledProcessError) as e:
+            sys.stderr.write("paddle_tpu.native: build failed (%s); using Python fallback\n" % e)
+            return None
+    try:
+        lib = ctypes.CDLL(so_path)
+    except OSError:
+        return None
+    lib.recordio_writer_create.restype = ctypes.c_void_p
+    lib.recordio_writer_create.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.recordio_writer_write.restype = ctypes.c_int
+    lib.recordio_writer_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.recordio_writer_close.restype = ctypes.c_int
+    lib.recordio_writer_close.argtypes = [ctypes.c_void_p]
+    lib.recordio_scanner_create.restype = ctypes.c_void_p
+    lib.recordio_scanner_create.argtypes = [ctypes.c_char_p]
+    lib.recordio_scanner_next.restype = ctypes.POINTER(ctypes.c_char)
+    lib.recordio_scanner_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_int)]
+    lib.recordio_scanner_close.argtypes = [ctypes.c_void_p]
+    lib.multislot_parse.restype = ctypes.c_void_p
+    lib.multislot_parse.argtypes = [
+        ctypes.c_char_p, ctypes.c_long, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.multislot_slot_size.restype = ctypes.c_long
+    lib.multislot_slot_size.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.multislot_copy_slot.argtypes = [
+        ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.multislot_free.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+def native_available() -> bool:
+    return _build_and_load() is not None
+
+
+class RecordIOWriter:
+    """reference: recordio/writer.cc."""
+
+    def __init__(self, path: str, compress: bool = True, max_chunk_bytes: int = 1 << 20):
+        self._lib = _build_and_load()
+        self._path = path
+        if self._lib is not None:
+            self._h = self._lib.recordio_writer_create(
+                path.encode(), int(compress), max_chunk_bytes
+            )
+            if not self._h:
+                raise IOError("cannot open %s" % path)
+        else:  # python fallback: naive framed file
+            self._f = open(path, "wb")
+            self._f.write(b"PYRIO\x00")
+
+    def write(self, record: bytes) -> None:
+        if self._lib is not None:
+            rc = self._lib.recordio_writer_write(self._h, record, len(record))
+            if rc != 0:
+                raise IOError("recordio write failed")
+        else:
+            self._f.write(len(record).to_bytes(4, "little") + record)
+
+    def close(self) -> None:
+        if self._lib is not None:
+            if self._lib.recordio_writer_close(self._h) != 0:
+                raise IOError("recordio flush failed")
+        else:
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class RecordIOScanner:
+    """reference: recordio/scanner.cc."""
+
+    def __init__(self, path: str):
+        self._lib = _build_and_load()
+        self._path = path
+        if self._lib is not None:
+            self._h = self._lib.recordio_scanner_create(path.encode())
+            if not self._h:
+                raise IOError("cannot open %s" % path)
+        else:
+            self._f = open(path, "rb")
+            magic = self._f.read(6)
+            if magic != b"PYRIO\x00":
+                raise IOError("bad recordio file (python-fallback format)")
+
+    def __iter__(self) -> Iterator[bytes]:
+        if self._lib is not None:
+            n = ctypes.c_int(0)
+            while True:
+                ptr = self._lib.recordio_scanner_next(self._h, ctypes.byref(n))
+                if not ptr:
+                    if n.value == -1:
+                        raise IOError("corrupt recordio chunk (CRC mismatch)")
+                    return
+                yield ctypes.string_at(ptr, n.value)
+        else:
+            while True:
+                hdr = self._f.read(4)
+                if len(hdr) < 4:
+                    return
+                ln = int.from_bytes(hdr, "little")
+                yield self._f.read(ln)
+
+    def close(self):
+        if self._lib is not None:
+            self._lib.recordio_scanner_close(self._h)
+        else:
+            self._f.close()
+
+
+def parse_multislot(text: bytes, n_slots: int) -> Tuple[int, List[Tuple[np.ndarray, np.ndarray]]]:
+    """Parse MultiSlot text (reference data_feed.cc format: per line, per
+    slot ``<count> <v0> <v1> ...``).  Returns (n_lines, [(values, counts)]
+    per slot)."""
+    if isinstance(text, str):
+        text = text.encode()
+    lib = _build_and_load()
+    if lib is not None:
+        n_lines = ctypes.c_int(0)
+        h = lib.multislot_parse(text, len(text), n_slots, ctypes.byref(n_lines))
+        out = []
+        try:
+            for s in range(n_slots):
+                nv = lib.multislot_slot_size(h, s)
+                values = np.empty(nv, np.float32)
+                counts = np.empty(n_lines.value, np.int32)
+                if n_lines.value:
+                    lib.multislot_copy_slot(
+                        h, s,
+                        values.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                    )
+                out.append((values, counts))
+        finally:
+            lib.multislot_free(h)
+        return n_lines.value, out
+    # python fallback
+    values = [[] for _ in range(n_slots)]
+    counts = [[] for _ in range(n_slots)]
+    n_lines = 0
+    for line in text.decode().splitlines():
+        toks = line.split()
+        if not toks:
+            continue
+        pos = 0
+        row = []
+        ok = True
+        for s in range(n_slots):
+            if pos >= len(toks):
+                ok = False
+                break
+            n = int(toks[pos])
+            pos += 1
+            vals = [float(t) for t in toks[pos : pos + n]]
+            if len(vals) != n:
+                ok = False
+                break
+            pos += n
+            row.append((n, vals))
+        if not ok:
+            continue
+        n_lines += 1
+        for s, (n, vals) in enumerate(row):
+            counts[s].append(n)
+            values[s].extend(vals)
+    return n_lines, [
+        (np.asarray(values[s], np.float32), np.asarray(counts[s], np.int32))
+        for s in range(n_slots)
+    ]
