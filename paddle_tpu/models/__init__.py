@@ -5,7 +5,7 @@ benchmark flagships (ResNet-50, BERT/Transformer).
 Each builder appends ops to the current default_main_program (use
 ``framework.program_guard``) and returns the key output Variables.
 """
-from paddle_tpu.models import lenet, resnet, vgg, transformer, word2vec, deepfm  # noqa: F401
+from paddle_tpu.models import lenet, resnet, vgg, transformer, word2vec, deepfm, seq2seq  # noqa: F401
 from paddle_tpu.models.lenet import lenet5  # noqa: F401
 from paddle_tpu.models.resnet import resnet50  # noqa: F401
 from paddle_tpu.models.vgg import vgg16  # noqa: F401
