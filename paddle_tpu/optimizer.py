@@ -9,6 +9,7 @@ the whole train step is one executable (see executor.py docstring).
 """
 from __future__ import annotations
 
+import contextlib
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -43,6 +44,9 @@ __all__ = [
     "Lamb",
     "LambOptimizer",
     "DGCMomentumOptimizer",
+    "ModelAverage",
+    "ExponentialMovingAverage",
+    "PipelineOptimizer",
 ]
 
 
@@ -485,6 +489,187 @@ class DGCMomentumOptimizer(MomentumOptimizer):
         kwargs.pop("rampup_step", None)
         kwargs.pop("sparsity", None)
         super().__init__(learning_rate, momentum, **kwargs)
+
+
+class ModelAverage:
+    """Running parameter average for evaluation (reference:
+    optimizer.py:2245).
+
+    Construction appends in-graph accumulation ops (sum += param,
+    count += 1 each step — they fuse into the compiled step); ``apply``
+    swaps averaged values into the scope host-side (the reference builds
+    tiny swap programs; on TPU a host swap of HBM handles is equivalent
+    and cheaper than compiling one-off programs).
+    """
+
+    def __init__(self, average_window_rate=0.15, min_average_window=10000,
+                 max_average_window=10000, regularization=None, name=None):
+        self.average_window_rate = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        block = framework.default_main_program().global_block()
+        helper = LayerHelper("model_average")
+        self._params = [p for p in block.all_parameters() if getattr(p, "trainable", True)]
+        self._sums = {}
+        from paddle_tpu import initializer
+
+        for p in self._params:
+            s = block.create_var(
+                name=unique_name.generate(p.name + "@MA_SUM@"),
+                shape=p.shape, dtype=p.dtype, persistable=True, stop_gradient=True,
+            )
+            helper.set_variable_initializer(s, initializer.Constant(0.0))
+            block.append_op(
+                type="elementwise_add",
+                inputs={"X": [s], "Y": [p]},
+                outputs={"Out": [s]},
+                attrs={"op_role": "optimize"},
+            )
+            self._sums[p.name] = s
+        self._count = block.create_var(
+            name=unique_name.generate("@MA_COUNT@"),
+            shape=[1], dtype="float32", persistable=True, stop_gradient=True,
+        )
+        helper.set_variable_initializer(self._count, initializer.Constant(0.0))
+        block.append_op(
+            type="scale",
+            inputs={"X": [self._count]},
+            outputs={"Out": [self._count]},
+            attrs={"scale": 1.0, "bias": 1.0, "op_role": "optimize"},
+        )
+        block.program.version += 1
+        self._backup = None
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        import jax.numpy as jnp
+
+        from paddle_tpu.scope import global_scope
+
+        scope = global_scope()
+        self._backup = {}
+        count = float(np.asarray(scope.get(self._count.name)))
+        count = max(count, 1.0)
+        for p in self._params:
+            self._backup[p.name] = scope.get(p.name)
+            s = scope.get(self._sums[p.name].name)
+            scope.set(p.name, jnp.asarray(s) / count)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor=None):
+        from paddle_tpu.scope import global_scope
+
+        if self._backup:
+            scope = global_scope()
+            for name, val in self._backup.items():
+                scope.set(name, val)
+            self._backup = None
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (reference: optimizer.py:2435).  ``update()``
+    appends the in-graph decay ops; apply/restore swap scope values."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._ema = {}
+        self._params = []
+        self._backup = None
+
+    def update(self):
+        """Append ema = decay*ema + (1-decay)*param for every trainable
+        param in the default main program (call after minimize)."""
+        from paddle_tpu import initializer
+
+        block = framework.default_main_program().global_block()
+        helper = LayerHelper("ema")
+        self._params = [p for p in block.all_parameters() if getattr(p, "trainable", True)]
+        for p in self._params:
+            if p.name in self._ema:
+                continue
+            e = block.create_var(
+                name=unique_name.generate(p.name + "@EMA@"),
+                shape=p.shape, dtype=p.dtype, persistable=True, stop_gradient=True,
+            )
+            helper.set_variable_initializer(e, initializer.Constant(0.0))
+            scaled_e = block.create_var(
+                name=unique_name.generate(p.name + "@EMA_T@"), shape=p.shape, dtype=p.dtype
+            )
+            scaled_p = block.create_var(
+                name=unique_name.generate(p.name + "@EMA_P@"), shape=p.shape, dtype=p.dtype
+            )
+            block.append_op(
+                type="scale", inputs={"X": [e]}, outputs={"Out": [scaled_e]},
+                attrs={"scale": self._decay, "op_role": "optimize"},
+            )
+            block.append_op(
+                type="scale", inputs={"X": [p]}, outputs={"Out": [scaled_p]},
+                attrs={"scale": 1.0 - self._decay, "op_role": "optimize"},
+            )
+            block.append_op(
+                type="elementwise_add", inputs={"X": [scaled_e], "Y": [scaled_p]},
+                outputs={"Out": [e]}, attrs={"op_role": "optimize"},
+            )
+            self._ema[p.name] = e
+        block.program.version += 1
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        from paddle_tpu.scope import global_scope
+
+        scope = global_scope()
+        self._backup = {}
+        for p in self._params:
+            self._backup[p.name] = scope.get(p.name)
+            scope.set(p.name, scope.get(self._ema[p.name].name))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor=None):
+        from paddle_tpu.scope import global_scope
+
+        if self._backup:
+            scope = global_scope()
+            for name, val in self._backup.items():
+                scope.set(name, val)
+            self._backup = None
+
+
+class PipelineOptimizer:
+    """Pipeline-parallel optimizer surface (reference: optimizer.py:2665
+    — cuts the program into sections run by SectionWorker threads,
+    framework/section_worker.cc:141).
+
+    TPU-native pipelining is the compiled GPipe engine
+    (parallel/hybrid.py: stage-sharded params over the ``pp`` mesh axis,
+    ppermute microbatch ring inside one XLA module) — thread+queue
+    section workers would serialize on a TPU.  This wrapper keeps the
+    fluid API: it runs the underlying optimizer and records the
+    microbatch plan on the program for the hybrid executor / fleet to
+    pick up.
+    """
+
+    def __init__(self, optimizer, cut_list=None, place_list=None, concurrency_list=None,
+                 queue_size=30, sync_steps=1, start_cpu_core_id=0, num_microbatches=None):
+        self._optimizer = optimizer
+        self._cut_list = cut_list or []
+        self._num_microbatches = num_microbatches or max(1, len(self._cut_list) or 1)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        ops, pgs = self._optimizer.minimize(loss, startup_program, parameter_list, no_grad_set)
+        prog = loss.block.program
+        prog._pipeline_config = {
+            "num_microbatches": self._num_microbatches,
+            "cut_vars": [getattr(v, "name", v) for v in self._cut_list],
+        }
+        return ops, pgs
 
 
 SGD = SGDOptimizer

@@ -1,0 +1,86 @@
+"""ModelAverage / EMA / PipelineOptimizer tests (reference:
+tests/unittests/test_ema.py, test_pipeline.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import framework
+
+
+def _setup(extra):
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 5
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.data("y", [1])
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(fluid.layers.fc(x, 1, bias_attr=False), y)
+        )
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+        helper_obj = extra()
+    return prog, startup, loss, helper_obj
+
+
+def test_model_average_apply_restore():
+    prog, startup, loss, ma = _setup(lambda: fluid.optimizer.ModelAverage(0.15))
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(8, 4).astype("float32"), "y": rng.rand(8, 1).astype("float32")}
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    wname = prog.all_parameters()[0].name
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        snapshots = []
+        for _ in range(4):
+            exe.run(prog, feed=feed, fetch_list=[loss])
+            snapshots.append(np.asarray(scope.get(wname)))
+        current = np.asarray(scope.get(wname))
+        with ma.apply(exe):
+            avg = np.asarray(scope.get(wname))
+            np.testing.assert_allclose(avg, np.mean(snapshots, axis=0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(scope.get(wname)), current)
+
+
+def test_ema_apply_restore():
+    def make():
+        ema = fluid.optimizer.ExponentialMovingAverage(0.5)
+        ema.update()
+        return ema
+
+    prog, startup, loss, ema = _setup(make)
+    rng = np.random.RandomState(1)
+    feed = {"x": rng.rand(8, 4).astype("float32"), "y": rng.rand(8, 1).astype("float32")}
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    wname = prog.all_parameters()[0].name
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ema_np = np.zeros(4, "float32").reshape(4, 1)
+        for _ in range(3):
+            exe.run(prog, feed=feed, fetch_list=[loss])
+            w = np.asarray(scope.get(wname))
+            ema_np = 0.5 * ema_np + 0.5 * w
+        cur = np.asarray(scope.get(wname))
+        with ema.apply(exe):
+            np.testing.assert_allclose(np.asarray(scope.get(wname)), ema_np, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(scope.get(wname)), cur)
+
+
+def test_pipeline_optimizer_surface():
+    prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.data("y", [1])
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(fluid.layers.fc(x, 1), y)
+        )
+        opt = fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.SGDOptimizer(0.1), num_microbatches=4
+        )
+        opt.minimize(loss)
+    assert prog._pipeline_config["num_microbatches"] == 4
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(prog, feed={"x": np.ones((4, 4), "float32"), "y": np.ones((4, 1), "float32")},
+                fetch_list=[loss])
