@@ -21,6 +21,7 @@ via ParallelExecutor + NCCL op-handles (parallel_executor.cc:356).
 """
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -166,6 +167,20 @@ class Executor:
         fetches, new_state = entry(mut_state, ro_state, feed_arrays)
         for n, v in new_state.items():
             scope.set(n, v)
+        if os.environ.get("FLAGS_check_nan_inf", "0") == "1":
+            # module-boundary nan/inf check (reference checks per-op after
+            # each kernel, operator.cc:954; one compiled module => one
+            # boundary). Costs a d2h sync — debug only.
+            bad = [
+                name
+                for name, val in list(zip(fetch_names, fetches)) + list(new_state.items())
+                if np.issubdtype(np.asarray(val).dtype, np.floating)
+                and not np.all(np.isfinite(np.asarray(val)))
+            ]
+            if bad:
+                raise RuntimeError(
+                    "nan/inf detected in %s (FLAGS_check_nan_inf=1)" % bad
+                )
         if return_numpy:
             fetches = [np.asarray(f) for f in fetches]
         return fetches

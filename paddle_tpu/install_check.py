@@ -1,0 +1,53 @@
+"""Install sanity check (reference: python/paddle/fluid/install_check.py
+run_check — trains a tiny model single- and multi-device)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["run_check"]
+
+
+def run_check():
+    import paddle_tpu as fluid
+    from paddle_tpu import framework
+    from paddle_tpu.parallel.mesh import local_devices
+
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 1
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [2])
+        y = fluid.layers.data("y", [1])
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(fluid.layers.fc(x, 1), y)
+        )
+        fluid.optimizer.SGDOptimizer(0.01).minimize(loss)
+
+    xb = np.array([[1.0, 2.0], [3.0, 4.0]], dtype="float32")
+    yb = np.array([[3.0], [7.0]], dtype="float32")
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(prog, feed={"x": xb, "y": yb}, fetch_list=[loss])
+    print("Your paddle_tpu works well on SINGLE device.")
+
+    devs = local_devices()
+    if len(devs) > 1:
+        prog2, startup2 = framework.Program(), framework.Program()
+        prog2.random_seed = startup2.random_seed = 1
+        with framework.program_guard(prog2, startup2):
+            x = fluid.layers.data("x", [2])
+            y = fluid.layers.data("y", [1])
+            loss2 = fluid.layers.mean(
+                fluid.layers.square_error_cost(fluid.layers.fc(x, 1), y)
+            )
+            fluid.optimizer.SGDOptimizer(0.01).minimize(loss2)
+        compiled = fluid.CompiledProgram(prog2).with_data_parallel(loss_name=loss2.name)
+        reps = -(-len(devs) // len(xb))  # batch must divide across the mesh
+        xb2, yb2 = np.tile(xb, (reps, 1)), np.tile(yb, (reps, 1))
+        scope2 = fluid.Scope()
+        with fluid.scope_guard(scope2):
+            exe.run(startup2)
+            exe.run(compiled, feed={"x": xb2, "y": yb2}, fetch_list=[loss2])
+        print("Your paddle_tpu works well on MUTIPLE devices.")
+    print("Your paddle_tpu is installed successfully!")

@@ -1,0 +1,234 @@
+"""Detection ops: prior/anchor boxes, box coding, IoU, YOLO box, NMS.
+
+Reference: paddle/fluid/operators/detection/ — prior_box_op.cc,
+anchor_generator_op.cc, box_coder_op.cc, iou_similarity_op.cc,
+yolo_box_op.cc, multiclass_nms_op.cc.
+
+TPU notes: the reference's NMS emits a variable-length LoD result; XLA
+needs static shapes, so ``multiclass_nms`` returns a fixed
+``[N, keep_top_k, 6]`` tensor padded with -1 labels (the padded+mask
+convention used framework-wide for ragged data).  The NMS inner loop is a
+`lax.fori_loop` over a static candidate count — compiled, no host sync.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import maybe, one
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+@register_op("prior_box", differentiable=False)
+def prior_box(inputs, attrs):
+    """SSD prior boxes (reference: detection/prior_box_op.cc).  Input
+    [N, C, H, W] feature map + Image [N, C, Him, Wim]; outputs Boxes
+    [H, W, n_priors, 4] (normalized xmin,ymin,xmax,ymax) + Variances."""
+    jnp = _jnp()
+    feat = one(inputs, "Input")
+    img = one(inputs, "Image")
+    H, W = feat.shape[2], feat.shape[3]
+    img_h, img_w = img.shape[2], img.shape[3]
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", [])]
+    ars = [1.0]
+    for ar in attrs.get("aspect_ratios", [1.0]):
+        ar = float(ar)
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(ar)
+            if attrs.get("flip", True):
+                ars.append(1.0 / ar)
+    variances = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    step_w = attrs.get("step_w", 0.0) or img_w / W
+    step_h = attrs.get("step_h", 0.0) or img_h / H
+    offset = attrs.get("offset", 0.5)
+    clip = attrs.get("clip", True)
+
+    widths, heights = [], []
+    for ms in min_sizes:
+        for ar in ars:
+            widths.append(ms * np.sqrt(ar))
+            heights.append(ms / np.sqrt(ar))
+        if max_sizes:
+            mx = max_sizes[min_sizes.index(ms)]
+            widths.append(np.sqrt(ms * mx))
+            heights.append(np.sqrt(ms * mx))
+    n_priors = len(widths)
+    widths = jnp.asarray(widths, "float32")
+    heights = jnp.asarray(heights, "float32")
+
+    cx = (jnp.arange(W, dtype="float32") + offset) * step_w
+    cy = (jnp.arange(H, dtype="float32") + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)  # [H, W]
+    cxg = cxg[..., None]
+    cyg = cyg[..., None]
+    xmin = (cxg - widths / 2.0) / img_w
+    xmax = (cxg + widths / 2.0) / img_w
+    ymin = (cyg - heights / 2.0) / img_h
+    ymax = (cyg + heights / 2.0) / img_h
+    boxes = jnp.stack([xmin, ymin, xmax, ymax], axis=-1)  # [H, W, P, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, "float32"), (H, W, n_priors, 4))
+    return {"Boxes": boxes, "Variances": var}
+
+
+@register_op("box_coder", differentiable=False)
+def box_coder(inputs, attrs):
+    """Encode/decode boxes vs priors (reference: detection/box_coder_op.cc).
+    PriorBox [M,4], TargetBox encode:[M,4] decode:[N,M,4]."""
+    jnp = _jnp()
+    prior = one(inputs, "PriorBox")
+    pvar = maybe(inputs, "PriorBoxVar")
+    target = one(inputs, "TargetBox")
+    code_type = attrs.get("code_type", "encode_center_size")
+    norm = attrs.get("box_normalized", True)
+    one_ = 0.0 if norm else 1.0
+
+    pw = prior[:, 2] - prior[:, 0] + one_
+    ph = prior[:, 3] - prior[:, 1] + one_
+    pcx = prior[:, 0] + pw / 2.0
+    pcy = prior[:, 1] + ph / 2.0
+    if pvar is None:
+        pvar = jnp.ones_like(prior)
+    if "encode" in code_type:
+        tw = target[:, 2] - target[:, 0] + one_
+        th = target[:, 3] - target[:, 1] + one_
+        tcx = target[:, 0] + tw / 2.0
+        tcy = target[:, 1] + th / 2.0
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :] / pvar[None, :, 0]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :] / pvar[None, :, 1]
+        ow = jnp.log(tw[:, None] / pw[None, :]) / pvar[None, :, 2]
+        oh = jnp.log(th[:, None] / ph[None, :]) / pvar[None, :, 3]
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)  # [N, M, 4]
+    else:  # decode_center_size
+        t = target  # [N, M, 4]
+        dcx = pvar[None, :, 0] * t[..., 0] * pw[None, :] + pcx[None, :]
+        dcy = pvar[None, :, 1] * t[..., 1] * ph[None, :] + pcy[None, :]
+        dw = jnp.exp(pvar[None, :, 2] * t[..., 2]) * pw[None, :]
+        dh = jnp.exp(pvar[None, :, 3] * t[..., 3]) * ph[None, :]
+        out = jnp.stack(
+            [dcx - dw / 2.0, dcy - dh / 2.0, dcx + dw / 2.0 - one_, dcy + dh / 2.0 - one_],
+            axis=-1,
+        )
+    return {"OutputBox": out}
+
+
+def _iou_matrix(a, b, normalized=True):
+    jnp = _jnp()
+    one_ = 0.0 if normalized else 1.0
+    area_a = (a[:, 2] - a[:, 0] + one_) * (a[:, 3] - a[:, 1] + one_)
+    area_b = (b[:, 2] - b[:, 0] + one_) * (b[:, 3] - b[:, 1] + one_)
+    ix = jnp.minimum(a[:, None, 2], b[None, :, 2]) - jnp.maximum(a[:, None, 0], b[None, :, 0]) + one_
+    iy = jnp.minimum(a[:, None, 3], b[None, :, 3]) - jnp.maximum(a[:, None, 1], b[None, :, 1]) + one_
+    inter = jnp.maximum(ix, 0.0) * jnp.maximum(iy, 0.0)
+    return inter / (area_a[:, None] + area_b[None, :] - inter + 1e-10)
+
+
+@register_op("iou_similarity", differentiable=False)
+def iou_similarity(inputs, attrs):
+    """reference: detection/iou_similarity_op.cc — X [N,4] vs Y [M,4]."""
+    x = one(inputs, "X")
+    y = one(inputs, "Y")
+    return {"Out": _iou_matrix(x, y, attrs.get("box_normalized", True))}
+
+
+@register_op("yolo_box", differentiable=False)
+def yolo_box(inputs, attrs):
+    """reference: detection/yolo_box_op.cc — decode YOLOv3 head output
+    [N, A*(5+C), H, W] into boxes [N, A*H*W, 4] + scores [N, A*H*W, C]."""
+    import jax
+
+    jnp = _jnp()
+    x = one(inputs, "X")
+    img_size = one(inputs, "ImgSize")  # [N, 2] (h, w)
+    anchors = [float(a) for a in attrs["anchors"]]
+    class_num = int(attrs["class_num"])
+    conf_thresh = attrs.get("conf_thresh", 0.01)
+    downsample = attrs.get("downsample_ratio", 32)
+    N, _, H, W = x.shape
+    A = len(anchors) // 2
+    x = x.reshape(N, A, 5 + class_num, H, W)
+    gx, gy = jnp.meshgrid(jnp.arange(W, dtype="float32"), jnp.arange(H, dtype="float32"))
+    bx = (jax.nn.sigmoid(x[:, :, 0]) + gx) / W  # [N, A, H, W]
+    by = (jax.nn.sigmoid(x[:, :, 1]) + gy) / H
+    aw = jnp.asarray(anchors[0::2], "float32").reshape(1, A, 1, 1)
+    ah = jnp.asarray(anchors[1::2], "float32").reshape(1, A, 1, 1)
+    input_h = downsample * H
+    input_w = downsample * W
+    bw = jnp.exp(x[:, :, 2]) * aw / input_w
+    bh = jnp.exp(x[:, :, 3]) * ah / input_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    probs = jnp.where(conf[:, :, None] < conf_thresh, 0.0, probs)
+
+    imh = img_size[:, 0].astype("float32").reshape(N, 1, 1, 1)
+    imw = img_size[:, 1].astype("float32").reshape(N, 1, 1, 1)
+    boxes = jnp.stack(
+        [(bx - bw / 2) * imw, (by - bh / 2) * imh, (bx + bw / 2) * imw, (by + bh / 2) * imh],
+        axis=-1,
+    )  # [N, A, H, W, 4]
+    return {
+        "Boxes": boxes.reshape(N, A * H * W, 4),
+        "Scores": probs.transpose(0, 1, 3, 4, 2).reshape(N, A * H * W, class_num),
+    }
+
+
+@register_op("multiclass_nms", differentiable=False)
+def multiclass_nms(inputs, attrs):
+    """reference: detection/multiclass_nms_op.cc.  BBoxes [N, M, 4],
+    Scores [N, C, M].  Static-shape result: Out [N, keep_top_k, 6]
+    (label, score, x1, y1, x2, y2), padded with label=-1 — the LoD
+    variable-length output mapped to the padded convention."""
+    import jax
+
+    jnp = _jnp()
+    bboxes = one(inputs, "BBoxes")
+    scores = one(inputs, "Scores")
+    score_thresh = attrs.get("score_threshold", 0.05)
+    nms_thresh = attrs.get("nms_threshold", 0.3)
+    nms_top_k = int(attrs.get("nms_top_k", 64))
+    keep_top_k = int(attrs.get("keep_top_k", 16))
+    normalized = attrs.get("normalized", True)
+    N, C, M = scores.shape
+    k = min(nms_top_k, M)
+
+    def per_image(boxes, score):
+        # per class: top-k candidates, greedy IoU suppression
+        def per_class(c):
+            sc = score[c]
+            top_sc, top_idx = jax.lax.top_k(sc, k)
+            cand = boxes[top_idx]  # [k, 4]
+            iou = _iou_matrix(cand, cand, normalized)
+
+            def body(i, keep):
+                # suppress i if it overlaps any kept higher-scored box
+                mask = (jnp.arange(k) < i) & keep
+                sup = jnp.any((iou[i] > nms_thresh) & mask)
+                return keep.at[i].set(jnp.logical_not(sup) & keep[i])
+
+            keep0 = top_sc > score_thresh
+            keep = jax.lax.fori_loop(1, k, body, keep0)
+            kept_sc = jnp.where(keep, top_sc, -1.0)
+            lbl = jnp.full((k,), float(c))
+            return jnp.concatenate(
+                [lbl[:, None], kept_sc[:, None], cand], axis=-1
+            )  # [k, 6]
+
+        all_cls = jnp.stack([per_class(c) for c in range(C)])  # [C, k, 6]
+        flat = all_cls.reshape(C * k, 6)
+        kk = min(keep_top_k, C * k)
+        top_sc, top_idx = jax.lax.top_k(flat[:, 1], kk)
+        out = flat[top_idx]
+        out = out.at[:, 0].set(jnp.where(top_sc > 0, out[:, 0], -1.0))
+        if kk < keep_top_k:
+            pad = jnp.full((keep_top_k - kk, 6), -1.0)
+            out = jnp.concatenate([out, pad], axis=0)
+        return out
+
+    return {"Out": jax.vmap(per_image)(bboxes, scores)}

@@ -1,0 +1,43 @@
+"""Book test: linear regression on uci_housing (reference:
+python/paddle/fluid/tests/book/test_fit_a_line.py) — full pipeline:
+reader decorators -> DataFeeder -> train -> save/load inference model ->
+infer parity."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import dataset, framework, reader as R
+
+
+def test_fit_a_line(tmp_path):
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 90
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [13])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+
+    train_reader = R.batch(R.shuffle(dataset.uci_housing.train(), 200, seed=0), 20)
+    feeder = fluid.DataFeeder([x, y], fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for epoch in range(6):
+            for batch in train_reader():
+                (l,) = exe.run(prog, feed=feeder.feed(batch), fetch_list=[loss])
+                losses.append(float(np.asarray(l)))
+        assert losses[-1] < 0.3 * losses[0], (losses[0], losses[-1])
+        fluid.save_inference_model(str(tmp_path / "m"), ["x"], [pred], exe, prog)
+
+    # fresh process-equivalent: load + infer
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        infer_prog, feeds, fetches = fluid.load_inference_model(str(tmp_path / "m"), exe)
+        test_x = np.stack([s[0] for s in list(dataset.uci_housing.test(32)())])
+        test_y = np.stack([s[1] for s in list(dataset.uci_housing.test(32)())])
+        (p,) = exe.run(infer_prog, feed={"x": test_x}, fetch_list=fetches)
+        mse = float(np.mean((np.asarray(p) - test_y) ** 2))
+    assert mse < 0.2, mse

@@ -1,0 +1,59 @@
+"""Book test: MNIST digit recognition (reference:
+python/paddle/fluid/tests/book/test_recognize_digits.py) — MLP + conv
+variants, PyReader pipeline, accuracy check on synthetic-deterministic
+mnist (dataset zoo)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import dataset, framework, reader as R
+
+
+def _train(net_fn, lr=0.001, epochs=3, batch=64):
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 100
+    with framework.program_guard(prog, startup):
+        img = fluid.layers.data("img", [784])
+        lbl = fluid.layers.data("lbl", [1], dtype="int64")
+        pred = net_fn(img)
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, lbl))
+        acc = fluid.layers.accuracy(pred, lbl)
+        fluid.optimizer.AdamOptimizer(lr).minimize(loss)
+
+    py_reader = fluid.PyReader(feed_list=[img, lbl], capacity=4)
+
+    def samples():
+        for im, l in dataset.mnist.train(1024)():
+            yield im, np.array([l], dtype="int64")
+
+    py_reader.decorate_sample_list_generator(R.batch(samples, batch, drop_last=True))
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    accs = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(epochs):
+            for feed in py_reader():
+                _, a = exe.run(prog, feed=feed, fetch_list=[loss, acc])
+                accs.append(float(np.asarray(a)))
+    return accs
+
+
+def test_mlp():
+    accs = _train(
+        lambda img: fluid.layers.fc(
+            fluid.layers.fc(img, 128, act="relu"), 10, act="softmax"
+        )
+    )
+    assert np.mean(accs[-4:]) > 0.7, np.mean(accs[-4:])
+
+
+def test_conv_net():
+    def conv_net(img):
+        x = fluid.layers.reshape(img, shape=[0, 1, 28, 28])
+        x = fluid.layers.conv2d(x, num_filters=8, filter_size=5, act="relu")
+        x = fluid.layers.pool2d(x, pool_size=2, pool_stride=2)
+        return fluid.layers.fc(x, 10, act="softmax")
+
+    accs = _train(conv_net, epochs=2)
+    assert np.mean(accs[-4:]) > 0.7, np.mean(accs[-4:])

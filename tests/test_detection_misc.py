@@ -1,0 +1,149 @@
+"""Detection ops + install_check/debugger/nan-inf tests.
+
+Reference: tests/unittests/test_prior_box_op.py, test_box_coder_op.py,
+test_iou_similarity_op.py, test_multiclass_nms_op.py, test_yolo_box_op.py.
+"""
+import os
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import framework
+
+
+def _run_single(build_fn, feed):
+    prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(prog, startup):
+        outs = build_fn()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return exe.run(prog, feed=feed, fetch_list=list(outs))
+
+
+def test_prior_box():
+    def build():
+        feat = fluid.layers.data("feat", [8, 4, 4])
+        img = fluid.layers.data("img", [3, 32, 32])
+        boxes, var = fluid.layers.detection.prior_box(
+            feat, img, min_sizes=[8.0], aspect_ratios=[1.0, 2.0], flip=True, clip=True
+        )
+        return boxes, var
+
+    rng = np.random.RandomState(0)
+    b, v = _run_single(
+        build,
+        {"feat": rng.rand(1, 8, 4, 4).astype("float32"),
+         "img": rng.rand(1, 3, 32, 32).astype("float32")},
+    )
+    b, v = np.asarray(b), np.asarray(v)
+    # 1 min_size x (1 + 2 flipped ratios) = 4 priors... ars: [1, 2, 0.5] -> 3
+    assert b.shape == (4, 4, 3, 4)
+    assert v.shape == b.shape
+    assert (b >= 0).all() and (b <= 1).all()
+    # center prior at cell (0,0) should be near offset*step/img
+    assert abs((b[0, 0, 0, 0] + b[0, 0, 0, 2]) / 2 - (0.5 * 8 / 32)) < 1e-5
+
+
+def test_iou_similarity():
+    def build():
+        x = fluid.layers.data("x", [4], append_batch_size=True)
+        y = fluid.layers.data("y", [4], append_batch_size=True)
+        return (fluid.layers.detection.iou_similarity(x, y),)
+
+    x = np.array([[0, 0, 1, 1], [0, 0, 2, 2]], dtype="float32")
+    y = np.array([[0, 0, 1, 1]], dtype="float32")
+    (iou,) = _run_single(build, {"x": x, "y": y})
+    np.testing.assert_allclose(np.asarray(iou), [[1.0], [0.25]], rtol=1e-5)
+
+
+def test_box_coder_decode_inverts_encode():
+    M, N = 5, 3
+    rng = np.random.RandomState(1)
+    prior = np.sort(rng.rand(M, 4).astype("float32"), axis=-1)[:, [0, 1, 2, 3]]
+    prior[:, 2:] += 0.1
+    target = np.sort(rng.rand(N, 4).astype("float32"), axis=-1)
+    target[:, 2:] += 0.1
+
+    def build_enc():
+        p = fluid.layers.data("p", [4], append_batch_size=True)
+        t = fluid.layers.data("t", [4], append_batch_size=True)
+        return (fluid.layers.detection.box_coder(p, None, t, "encode_center_size"),)
+
+    (enc,) = _run_single(build_enc, {"p": prior, "t": target})
+
+    def build_dec():
+        p = fluid.layers.data("p", [4], append_batch_size=True)
+        t = fluid.layers.data("t", [M, 4], append_batch_size=True)
+        return (fluid.layers.detection.box_coder(p, None, t, "decode_center_size"),)
+
+    (dec,) = _run_single(build_dec, {"p": prior, "t": np.asarray(enc)})
+    want = np.broadcast_to(target[:, None, :], (N, M, 4))
+    np.testing.assert_allclose(np.asarray(dec), want, rtol=1e-4, atol=1e-5)
+
+
+def test_multiclass_nms_suppresses():
+    N, M, C = 1, 6, 2
+    boxes = np.zeros((N, M, 4), "float32")
+    # 3 overlapping boxes at origin, 3 at (10,10)
+    for i in range(3):
+        boxes[0, i] = [0, 0, 1 + 0.01 * i, 1 + 0.01 * i]
+        boxes[0, 3 + i] = [10, 10, 11 + 0.01 * i, 11 + 0.01 * i]
+    scores = np.zeros((N, C, M), "float32")
+    scores[0, 0] = [0.9, 0.8, 0.7, 0.0, 0.0, 0.0]
+    scores[0, 1] = [0.0, 0.0, 0.0, 0.6, 0.5, 0.4]
+
+    def build():
+        b = fluid.layers.data("b", [M, 4])
+        s = fluid.layers.data("s", [C, M])
+        return (
+            fluid.layers.detection.multiclass_nms(
+                b, s, score_threshold=0.1, nms_threshold=0.5, keep_top_k=4
+            ),
+        )
+
+    (out,) = _run_single(build, {"b": boxes, "s": scores})
+    out = np.asarray(out)[0]  # [4, 6]
+    valid = out[out[:, 0] >= 0]
+    # one box per cluster per class survives
+    assert len(valid) == 2, out
+    assert set(valid[:, 0].astype(int)) == {0, 1}
+    np.testing.assert_allclose(sorted(valid[:, 1]), [0.6, 0.9], rtol=1e-5)
+
+
+def test_install_check(capsys):
+    from paddle_tpu import install_check
+
+    install_check.run_check()
+    out = capsys.readouterr().out
+    assert "installed successfully" in out
+
+
+def test_debugger_dumps():
+    from paddle_tpu import debugger
+
+    prog = framework.Program()
+    with framework.program_guard(prog, framework.Program()):
+        x = fluid.layers.data("x", [4])
+        fluid.layers.fc(x, 2)
+    text = debugger.pprint_program_codes(prog)
+    assert "mul" in text
+    dot = debugger.draw_block_graphviz(prog.global_block(), path=None)
+    assert "digraph" in dot
+
+
+def test_nan_inf_flag(monkeypatch):
+    monkeypatch.setenv("FLAGS_check_nan_inf", "1")
+    prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [2])
+        out = fluid.layers.log(x)  # log(-1) -> nan
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        import pytest
+
+        with pytest.raises(RuntimeError, match="nan/inf"):
+            exe.run(prog, feed={"x": np.array([[-1.0, 1.0]], "float32")}, fetch_list=[out])
