@@ -189,18 +189,26 @@ def batch_norm(inputs, attrs):
     layout = attrs.get("data_layout", "NCHW")
     axes = tuple(i for i in range(x.ndim) if i != (1 if layout == "NCHW" else x.ndim - 1))
     cshape = tuple(-1 if i == (1 if layout == "NCHW" else x.ndim - 1) else 1 for i in range(x.ndim))
+    # Statistics and the normalize math run in fp32 regardless of x's
+    # dtype (AMP feeds bf16 activations; running stats / affine params
+    # stay fp32 — contrib/mixed_precision _KEEP_FP32_IN).  XLA fuses the
+    # casts into the surrounding elementwise chain, so activation HBM
+    # traffic stays bf16 while accumulation is exact.
+    stat_dtype = jnp.promote_types(x.dtype, jnp.float32)
+    xf = x.astype(stat_dtype) if x.dtype != stat_dtype else x
     if is_test:
         use_mean, use_var = mean, var
         saved_mean, saved_var = mean, var
         new_mean, new_var = mean, var
     else:
-        use_mean = jnp.mean(x, axis=axes)
-        use_var = jnp.var(x, axis=axes)
+        use_mean = jnp.mean(xf, axis=axes)
+        use_var = jnp.var(xf, axis=axes)
         saved_mean, saved_var = use_mean, use_var
         new_mean = momentum * mean + (1 - momentum) * use_mean
         new_var = momentum * var + (1 - momentum) * use_var
     inv = 1.0 / jnp.sqrt(use_var + eps)
-    y = (x - use_mean.reshape(cshape)) * inv.reshape(cshape) * scale.reshape(cshape) + bias.reshape(cshape)
+    y = (xf - use_mean.reshape(cshape)) * inv.reshape(cshape) * scale.reshape(cshape) + bias.reshape(cshape)
+    y = y.astype(x.dtype)
     return {
         "Y": y,
         "MeanOut": new_mean,
@@ -219,15 +227,17 @@ def layer_norm(inputs, attrs):
     eps = attrs.get("epsilon", 1e-5)
     begin = attrs.get("begin_norm_axis", 1)
     axes = tuple(range(begin, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
-    y = (x - mean) / jnp.sqrt(var + eps)
+    stat_dtype = jnp.promote_types(x.dtype, jnp.float32)
+    xf = x.astype(stat_dtype) if x.dtype != stat_dtype else x
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - mean) / jnp.sqrt(var + eps)
     norm_shape = x.shape[begin:]
     if scale is not None:
         y = y * scale.reshape(norm_shape)
     if bias is not None:
         y = y + bias.reshape(norm_shape)
-    return {"Y": y, "Mean": mean.squeeze(axes), "Variance": var.squeeze(axes)}
+    return {"Y": y.astype(x.dtype), "Mean": mean.squeeze(axes), "Variance": var.squeeze(axes)}
 
 
 @register_op("group_norm")
@@ -240,6 +250,9 @@ def group_norm(inputs, attrs):
     eps = attrs.get("epsilon", 1e-5)
     n, c = x.shape[0], x.shape[1]
     xg = x.reshape((n, g, c // g) + x.shape[2:])
+    stat_dtype = jnp.promote_types(xg.dtype, jnp.float32)
+    if xg.dtype != stat_dtype:
+        xg = xg.astype(stat_dtype)
     axes = tuple(range(2, xg.ndim))
     mean = jnp.mean(xg, axis=axes, keepdims=True)
     var = jnp.var(xg, axis=axes, keepdims=True)
@@ -249,7 +262,7 @@ def group_norm(inputs, attrs):
         y = y * scale.reshape(cshape)
     if bias is not None:
         y = y + bias.reshape(cshape)
-    return {"Y": y, "Mean": mean.reshape((n, g)), "Variance": var.reshape((n, g))}
+    return {"Y": y.astype(x.dtype), "Mean": mean.reshape((n, g)), "Variance": var.reshape((n, g))}
 
 
 # ---------------------------------------------------------------------------
