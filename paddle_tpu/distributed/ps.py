@@ -9,13 +9,19 @@ TPU-native role: dense parameters live in HBM and sync via ICI
 collectives (no PS needed); the PS remains the right tool for *huge
 sparse embedding tables* that exceed HBM — rows live on host-CPU servers
 sharded by id, trainers prefetch rows before the compiled step and push
-sparse grads after (BASELINE.md DeepFM config).  Protocol is
-length-prefixed pickles over TCP — the gRPC wire format analog, kept
-dependency-free; swap in a C++ server without changing the client API.
+sparse grads after (BASELINE.md DeepFM config).
+
+Wire format: length-framed messages of a JSON header plus raw ndarray
+payload bytes — the gRPC+protobuf tensor serde analog (reference:
+sendrecvop_utils.cc / variable_response.cc).  No pickle: nothing on the
+wire can execute code, dtypes are whitelisted, and message size is
+bounded, so an exposed port is a data-plane risk only (like the
+reference's unauthenticated gRPC PS).  Swap in a C++ server without
+changing the client API.
 """
 from __future__ import annotations
 
-import pickle
+import json
 import socket
 import socketserver
 import struct
@@ -26,9 +32,93 @@ import numpy as np
 
 __all__ = ["ParameterServer", "PSClient", "shard_ids"]
 
+# bound per-message allocation (framing is attacker-controlled input)
+_MAX_MSG = int(1 << 31)
+_ALLOWED_DTYPES = {
+    "float32", "float64", "float16", "bfloat16",
+    "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64", "bool",
+}
+
+
+def _encode_msg(obj) -> bytes:
+    """dict/list/scalars + ndarrays -> JSON header || payload bytes."""
+    payloads: List[bytes] = []
+
+    def conv(x):
+        if isinstance(x, np.ndarray):
+            x = np.ascontiguousarray(x)
+            if x.dtype.name not in _ALLOWED_DTYPES:
+                raise TypeError("dtype %s not wire-safe" % x.dtype)
+            payloads.append(x.tobytes())
+            return {"__nd__": len(payloads) - 1, "dtype": x.dtype.name,
+                    "shape": list(x.shape)}
+        if isinstance(x, np.integer):
+            return int(x)
+        if isinstance(x, np.floating):
+            return float(x)
+        if isinstance(x, dict):
+            return {str(k): conv(v) for k, v in x.items()}
+        if isinstance(x, (list, tuple)):
+            return [conv(v) for v in x]
+        if x is None or isinstance(x, (bool, int, float, str)):
+            return x
+        raise TypeError("%r not wire-safe" % type(x))
+
+    header = json.dumps({"m": conv(obj), "p": [len(b) for b in payloads]}).encode()
+    return struct.pack("<I", len(header)) + header + b"".join(payloads)
+
+
+def _decode_msg(data: bytes):
+    """Every malformation raises ValueError — the one exception type the
+    server/client treat as 'corrupt frame from the peer'."""
+    try:
+        (hlen,) = struct.unpack_from("<I", data, 0)
+        if hlen > len(data) - 4:
+            raise ValueError("corrupt message header")
+        meta = json.loads(data[4 : 4 + hlen].decode())
+        sizes = meta["p"]
+        if not isinstance(sizes, list):
+            raise ValueError("corrupt payload index")
+        views = []
+        off = 4 + hlen
+        for n in sizes:
+            if not isinstance(n, int) or n < 0 or off + n > len(data):
+                raise ValueError("corrupt message payload")
+            views.append(data[off : off + n])
+            off += n
+
+        def conv(x):
+            if isinstance(x, dict):
+                if "__nd__" in x:
+                    dtype = str(x["dtype"])
+                    if dtype not in _ALLOWED_DTYPES:
+                        raise ValueError("dtype %s not wire-safe" % dtype)
+                    if dtype == "bfloat16":
+                        import ml_dtypes
+
+                        np_dtype = np.dtype(ml_dtypes.bfloat16)
+                    else:
+                        np_dtype = np.dtype(dtype)
+                    idx = int(x["__nd__"])
+                    if not 0 <= idx < len(views):
+                        raise ValueError("corrupt payload reference")
+                    arr = np.frombuffer(views[idx], np_dtype)
+                    return arr.reshape([int(d) for d in x["shape"]])
+                return {k: conv(v) for k, v in x.items()}
+            if isinstance(x, list):
+                return [conv(v) for v in x]
+            return x
+
+        return conv(meta["m"])
+    except ValueError:
+        raise
+    except Exception as e:  # struct.error, KeyError, json/unicode errors...
+        raise ValueError("corrupt message: %s" % e) from e
+
 
 def _send_msg(sock: socket.socket, obj) -> None:
-    data = pickle.dumps(obj, protocol=4)
+    data = _encode_msg(obj)
     sock.sendall(struct.pack("<Q", len(data)) + data)
 
 
@@ -40,13 +130,15 @@ def _recv_msg(sock: socket.socket):
             raise ConnectionError("peer closed")
         hdr += chunk
     (n,) = struct.unpack("<Q", hdr)
+    if n > _MAX_MSG:
+        raise ValueError("message of %d bytes exceeds limit" % n)
     buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(min(1 << 20, n - len(buf)))
         if not chunk:
             raise ConnectionError("peer closed")
         buf += chunk
-    return pickle.loads(bytes(buf))
+    return _decode_msg(bytes(buf))
 
 
 def shard_ids(ids: np.ndarray, n_shards: int) -> List[np.ndarray]:
@@ -120,6 +212,10 @@ class ParameterServer:
                     while True:
                         msg = _recv_msg(self.request)
                         _send_msg(self.request, outer._dispatch(msg))
+                except ValueError:
+                    # corrupt/over-limit frame: drop the connection quietly
+                    # (protocol error from the peer, not a server bug)
+                    pass
                 except (ConnectionError, OSError):
                     pass
 
@@ -146,11 +242,19 @@ class ParameterServer:
             self.create_table(msg["table"], msg["dim"], **msg.get("kwargs", {}))
             return {"ok": True}
         if op == "save":
-            return {
-                "tables": {
-                    n: {"dim": t.dim, "rows": dict(t.rows)} for n, t in self._tables.items()
-                }
-            }
+            # checkpoint a shard (reference: RequestCheckpoint /
+            # checkpoint_notify_op.cc) as wire-safe arrays
+            tables = {}
+            for n, t in self._tables.items():
+                with t._lock:
+                    ids = np.fromiter(t.rows.keys(), np.int64, len(t.rows))
+                    rows = (
+                        np.stack([t.rows[int(i)] for i in ids])
+                        if len(ids)
+                        else np.zeros((0, t.dim), np.float32)
+                    )
+                tables[n] = {"dim": t.dim, "ids": ids, "rows": rows}
+            return {"tables": tables}
         if op == "barrier":  # counted barrier (rpc_server.cc analog)
             with self._barrier_lock:
                 self._barrier_count += 1
@@ -202,7 +306,7 @@ class PSClient:
         for i, pos in enumerate(parts):
             if len(pos) == 0:
                 continue
-            rows = self._call(i, {"op": "pull", "table": table, "ids": ids[pos].tolist()})["rows"]
+            rows = self._call(i, {"op": "pull", "table": table, "ids": ids[pos]})["rows"]
             if out is None:
                 out = np.empty((len(ids), rows.shape[1]), np.float32)
             out[pos] = rows
@@ -219,7 +323,7 @@ class PSClient:
         for i, pos in enumerate(parts):
             if len(pos) == 0:
                 continue
-            self._call(i, {"op": "push", "table": table, "ids": uniq[pos].tolist(), "grads": merged[pos]})
+            self._call(i, {"op": "push", "table": table, "ids": uniq[pos], "grads": merged[pos]})
 
     def barrier(self):
         for i in range(len(self.endpoints)):

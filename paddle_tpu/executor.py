@@ -40,7 +40,9 @@ def _as_fetch_name(f) -> str:
 
 class Executor:
     def __init__(self, place=None):
-        self.place = place if place is not None else framework.TPUPlace(0)
+        # place=None means "process default device" (jax.devices()[0]) —
+        # an explicit TPUPlace/CPUPlace is honored strictly (_device).
+        self.place = place if place is not None else framework._DefaultPlace()
         self._cache: Dict[tuple, Any] = {}
 
     # ------------------------------------------------------------------
@@ -53,8 +55,23 @@ class Executor:
                 devs = jax.devices(backend)
                 idx = getattr(self.place, "device_id", 0)
                 return devs[idx % len(devs)]
-            except RuntimeError:
-                pass
+            except RuntimeError as e:
+                # Place mismatch is an error, like the reference's hard
+                # failure on an unavailable Place (platform/place.h) —
+                # unless the user opts into fallback explicitly.
+                if os.environ.get("FLAGS_allow_place_fallback", "0") == "1":
+                    import warnings
+
+                    warnings.warn(
+                        "place %r unavailable (%s); falling back to %s"
+                        % (self.place, e, jax.devices()[0].platform)
+                    )
+                else:
+                    raise RuntimeError(
+                        "place %r requests backend %r which is unavailable: %s. "
+                        "Set FLAGS_allow_place_fallback=1 to run on %s instead."
+                        % (self.place, backend, e, jax.devices()[0].platform)
+                    ) from e
         return jax.devices()[0]
 
     # ------------------------------------------------------------------
@@ -107,11 +124,18 @@ class Executor:
         device = self._device()
         feed_arrays = {}
         for name, val in feed.items():
-            if isinstance(val, jax.Array):
-                feed_arrays[name] = val
-                continue
             var = block._find_var_recursive(name)
             dtype = core_types.np_dtype(var.dtype) if var is not None else None
+            if isinstance(val, jax.Array):
+                # coerce device-resident feeds too (cheap on-device cast,
+                # stays in HBM) so the compiled signature matches the
+                # program var — same contract as numpy feeds
+                if dtype is not None:
+                    want = jax.dtypes.canonicalize_dtype(dtype)
+                    if val.dtype != want:
+                        val = val.astype(want)
+                feed_arrays[name] = val
+                continue
             arr = np.asarray(val, dtype=dtype)
             feed_arrays[name] = jax.device_put(arr, device)
 

@@ -76,6 +76,12 @@ class CUDAPlace(TPUPlace):
     accelerator backend."""
 
 
+class _DefaultPlace(Place):
+    """Process-default device (no backend pin): Executor(place=None)."""
+
+    backend = None
+
+
 def cpu_places(device_count=None):
     return [CPUPlace()]
 

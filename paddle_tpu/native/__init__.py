@@ -184,7 +184,14 @@ def parse_multislot(text: bytes, n_slots: int) -> Tuple[int, List[Tuple[np.ndarr
         finally:
             lib.multislot_free(h)
         return n_lines.value, out
-    # python fallback
+    return _parse_multislot_py(text, n_slots)
+
+
+def _parse_multislot_py(text: bytes, n_slots: int):
+    """Pure-Python fallback; malformed lines are skipped whole (matching
+    the native parser's per-line rollback)."""
+    if isinstance(text, str):
+        text = text.encode()
     values = [[] for _ in range(n_slots)]
     counts = [[] for _ in range(n_slots)]
     n_lines = 0
@@ -199,9 +206,16 @@ def parse_multislot(text: bytes, n_slots: int) -> Tuple[int, List[Tuple[np.ndarr
             if pos >= len(toks):
                 ok = False
                 break
-            n = int(toks[pos])
-            pos += 1
-            vals = [float(t) for t in toks[pos : pos + n]]
+            try:
+                n = int(toks[pos])
+                pos += 1
+                if n < 0:
+                    ok = False
+                    break
+                vals = [float(t) for t in toks[pos : pos + n]]
+            except ValueError:
+                ok = False
+                break
             if len(vals) != n:
                 ok = False
                 break

@@ -207,21 +207,38 @@ void* multislot_parse(const char* text, long text_len, int n_slots, int* n_lines
   const char* cur = text;
   const char* end = text + text_len;
   int n_lines = 0;
+  std::vector<size_t> line_start_values(n_slots);
+  std::vector<size_t> line_start_counts(n_slots);
   while (cur < end) {
     const char* line_end = static_cast<const char*>(memchr(cur, '\n', end - cur));
     if (!line_end) line_end = end;
     if (line_end > cur) {
+      // snapshot per-slot sizes so a malformed line restores exactly the
+      // state before it, regardless of how many values were pushed
+      for (int slot = 0; slot < n_slots; ++slot) {
+        line_start_values[slot] = p->values[slot].size();
+        line_start_counts[slot] = p->counts[slot].size();
+      }
       const char* q = cur;
       bool ok = true;
+      // strtol/strtof skip leading whitespace INCLUDING newlines, so an
+      // under-filled line would otherwise steal tokens from the next
+      // line; bound every token to [q, line_end).
+      auto skip_ws = [&](const char*& s) {
+        while (s < line_end && (*s == ' ' || *s == '\t' || *s == '\r')) ++s;
+        return s < line_end;
+      };
       for (int slot = 0; slot < n_slots && ok; ++slot) {
         char* next = nullptr;
+        if (!skip_ws(q)) { ok = false; break; }
         long n = strtol(q, &next, 10);
-        if (next == q || n < 0) { ok = false; break; }
+        if (next == q || next > line_end || n < 0) { ok = false; break; }
         q = next;
         p->counts[slot].push_back(static_cast<int32_t>(n));
         for (long i = 0; i < n; ++i) {
+          if (!skip_ws(q)) { ok = false; break; }
           float v = strtof(q, &next);
-          if (next == q) { ok = false; break; }
+          if (next == q || next > line_end) { ok = false; break; }
           q = next;
           p->values[slot].push_back(v);
         }
@@ -230,12 +247,8 @@ void* multislot_parse(const char* text, long text_len, int n_slots, int* n_lines
         ++n_lines;
       } else {
         for (int slot = 0; slot < n_slots; ++slot) {
-          // roll back partial line
-          if (static_cast<int>(p->counts[slot].size()) > n_lines) {
-            long n = p->counts[slot].back();
-            p->counts[slot].pop_back();
-            p->values[slot].resize(p->values[slot].size() - n);
-          }
+          p->values[slot].resize(line_start_values[slot]);
+          p->counts[slot].resize(line_start_counts[slot]);
         }
       }
     }

@@ -46,6 +46,10 @@ _allreduce("c_allreduce_min", "pmin")
 
 @register_op("c_allreduce_prod", differentiable=False)
 def c_allreduce_prod(inputs, attrs):
+    """Sign-correct product allreduce (reference ncclProd handles any
+    sign, c_allreduce_op.h:57-110): magnitude via psum of log|x| with
+    zeros masked to 0-contribution, sign via psum of negative-counts
+    (parity), zeros via pmax of a zero-flag."""
     import jax
     import jax.numpy as jnp
 
@@ -53,7 +57,14 @@ def c_allreduce_prod(inputs, attrs):
     ax = _axis(attrs)
     if ax is None:
         return {"Out": x}
-    return {"Out": jnp.exp(jax.lax.psum(jnp.log(x), axis_name=ax))}
+    absx = jnp.abs(x)
+    is_zero = absx == 0
+    log_mag = jax.lax.psum(jnp.where(is_zero, 0.0, jnp.log(jnp.where(is_zero, 1.0, absx))), axis_name=ax)
+    neg_count = jax.lax.psum((x < 0).astype(x.dtype), axis_name=ax)
+    any_zero = jax.lax.pmax(is_zero.astype(x.dtype), axis_name=ax)
+    sign = 1.0 - 2.0 * jnp.mod(neg_count, 2.0)
+    out = jnp.where(any_zero > 0, jnp.zeros_like(x), sign * jnp.exp(log_mag))
+    return {"Out": out.astype(x.dtype)}
 
 
 @register_op("allreduce", differentiable=False)

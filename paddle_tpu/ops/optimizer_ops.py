@@ -204,3 +204,55 @@ def lamb(inputs, attrs):
         "Beta1PowOut": b1p * b1,
         "Beta2PowOut": b2p * b2,
     }
+
+
+@register_op("average_accumulates", differentiable=False)
+def average_accumulates(inputs, attrs):
+    """Windowed parameter-average accumulators (reference:
+    operators/average_accumulates_op.cc, used by ModelAverage
+    optimizer.py:2245).  Per step:
+
+      sum_1 += param; num_accumulates += 1; num_updates += 1
+      every max_num_accumulates steps: sum_2 += sum_1; sum_1 = 0
+      when num_accumulates >= min_average_window and
+           num_accumulates >= min(max_average_window,
+                                  num_updates * average_window_rate):
+        sum_3 = sum_1 + sum_2; sum_1 = sum_2 = 0
+        old_num_accumulates = num_accumulates; num_accumulates = 0
+
+    The data-dependent restarts are jnp.where selects, so the whole
+    update stays inside the compiled step (no host round trip).
+    """
+    jnp = _jnp()
+    p = one(inputs, "Param")
+    s1, s2, s3 = one(inputs, "Sum1"), one(inputs, "Sum2"), one(inputs, "Sum3")
+    num_acc = one(inputs, "NumAccumulates").reshape(())
+    old_num = one(inputs, "OldNumAccumulates").reshape(())
+    num_upd = one(inputs, "NumUpdates").reshape(())
+    rate = attrs.get("average_window", 0.15)
+    max_acc = attrs.get("max_num_accumulates", 16384)
+    min_win = attrs.get("min_average_window", 10000)
+    max_win = attrs.get("max_average_window", 10000)
+
+    s1 = s1 + p.astype(s1.dtype)
+    num_acc = num_acc + 1.0
+    num_upd = num_upd + 1.0
+
+    spill = jnp.mod(num_upd, float(max_acc)) == 0.0
+    s2 = jnp.where(spill, s2 + s1, s2)
+    s1 = jnp.where(spill, jnp.zeros_like(s1), s1)
+
+    window = jnp.minimum(float(max_win), num_upd * rate)
+    restart = jnp.logical_and(num_acc >= float(min_win), num_acc >= window)
+    s3 = jnp.where(restart, s1 + s2, s3)
+    s1 = jnp.where(restart, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(restart, jnp.zeros_like(s2), s2)
+    old_num = jnp.where(restart, num_acc, old_num)
+    num_acc = jnp.where(restart, 0.0, num_acc)
+
+    return {
+        "Sum1Out": s1, "Sum2Out": s2, "Sum3Out": s3,
+        "NumAccumulatesOut": num_acc.reshape((1,)),
+        "OldNumAccumulatesOut": old_num.reshape((1,)),
+        "NumUpdatesOut": num_upd.reshape((1,)),
+    }

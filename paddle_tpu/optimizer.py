@@ -492,14 +492,15 @@ class DGCMomentumOptimizer(MomentumOptimizer):
 
 
 class ModelAverage:
-    """Running parameter average for evaluation (reference:
-    optimizer.py:2245).
+    """Sliding-window parameter average for evaluation (reference:
+    optimizer.py:2245 + operators/average_accumulates_op.cc).
 
-    Construction appends in-graph accumulation ops (sum += param,
-    count += 1 each step — they fuse into the compiled step); ``apply``
-    swaps averaged values into the scope host-side (the reference builds
-    tiny swap programs; on TPU a host swap of HBM handles is equivalent
-    and cheaper than compiling one-off programs).
+    Construction appends one ``average_accumulates`` op per parameter —
+    the reference's sum_1/sum_2/sum_3 windowed accumulators with restart
+    logic, fused into the compiled step.  ``apply`` swaps
+    (sum_1+sum_2+sum_3)/(num_accumulates+old_num_accumulates) into the
+    scope host-side (the reference builds tiny swap programs; on TPU a
+    host swap of HBM handles is equivalent).
     """
 
     def __init__(self, average_window_rate=0.15, min_average_window=10000,
@@ -510,33 +511,38 @@ class ModelAverage:
         block = framework.default_main_program().global_block()
         helper = LayerHelper("model_average")
         self._params = [p for p in block.all_parameters() if getattr(p, "trainable", True)]
-        self._sums = {}
+        self._accs = {}
         from paddle_tpu import initializer
 
+        def _state(name, shape, dtype="float32"):
+            v = block.create_var(
+                name=unique_name.generate(name), shape=shape, dtype=dtype,
+                persistable=True, stop_gradient=True,
+            )
+            helper.set_variable_initializer(v, initializer.Constant(0.0))
+            return v
+
         for p in self._params:
-            s = block.create_var(
-                name=unique_name.generate(p.name + "@MA_SUM@"),
-                shape=p.shape, dtype=p.dtype, persistable=True, stop_gradient=True,
-            )
-            helper.set_variable_initializer(s, initializer.Constant(0.0))
+            s1 = _state(p.name + "@MA_SUM1@", p.shape)
+            s2 = _state(p.name + "@MA_SUM2@", p.shape)
+            s3 = _state(p.name + "@MA_SUM3@", p.shape)
+            na = _state(p.name + "@MA_NACC@", [1])
+            no = _state(p.name + "@MA_OLDN@", [1])
+            nu = _state(p.name + "@MA_NUPD@", [1])
             block.append_op(
-                type="elementwise_add",
-                inputs={"X": [s], "Y": [p]},
-                outputs={"Out": [s]},
-                attrs={"op_role": "optimize"},
+                type="average_accumulates",
+                inputs={"Param": [p.name], "Sum1": [s1.name], "Sum2": [s2.name],
+                        "Sum3": [s3.name], "NumAccumulates": [na.name],
+                        "OldNumAccumulates": [no.name], "NumUpdates": [nu.name]},
+                outputs={"Sum1Out": [s1.name], "Sum2Out": [s2.name],
+                         "Sum3Out": [s3.name], "NumAccumulatesOut": [na.name],
+                         "OldNumAccumulatesOut": [no.name], "NumUpdatesOut": [nu.name]},
+                attrs={"average_window": self.average_window_rate,
+                       "min_average_window": self.min_average_window,
+                       "max_average_window": self.max_average_window,
+                       "op_role": "optimize"},
             )
-            self._sums[p.name] = s
-        self._count = block.create_var(
-            name=unique_name.generate("@MA_COUNT@"),
-            shape=[1], dtype="float32", persistable=True, stop_gradient=True,
-        )
-        helper.set_variable_initializer(self._count, initializer.Constant(0.0))
-        block.append_op(
-            type="scale",
-            inputs={"X": [self._count]},
-            outputs={"Out": [self._count]},
-            attrs={"scale": 1.0, "bias": 1.0, "op_role": "optimize"},
-        )
+            self._accs[p.name] = (s1, s2, s3, na, no)
         block.program.version += 1
         self._backup = None
 
@@ -548,12 +554,19 @@ class ModelAverage:
 
         scope = global_scope()
         self._backup = {}
-        count = float(np.asarray(scope.get(self._count.name)))
-        count = max(count, 1.0)
         for p in self._params:
+            s1, s2, s3, na, no = self._accs[p.name]
+            total = np.asarray(scope.get(na.name)).item() + np.asarray(
+                scope.get(no.name)
+            ).item()
+            total = max(total, 1.0)
             self._backup[p.name] = scope.get(p.name)
-            s = scope.get(self._sums[p.name].name)
-            scope.set(p.name, jnp.asarray(s) / count)
+            avg = (
+                jnp.asarray(scope.get(s1.name))
+                + jnp.asarray(scope.get(s2.name))
+                + jnp.asarray(scope.get(s3.name))
+            ) / total
+            scope.set(p.name, avg.astype(self._backup[p.name].dtype))
         try:
             yield
         finally:
@@ -571,23 +584,94 @@ class ModelAverage:
 
 
 class ExponentialMovingAverage:
-    """EMA of parameters (reference: optimizer.py:2435).  ``update()``
-    appends the in-graph decay ops; apply/restore swap scope values."""
+    """EMA of parameters (reference: optimizer.py:2435).
+
+    ``update()`` appends the in-graph decay ops plus a step counter and a
+    decay-power accumulator; ``apply()`` installs the *bias-corrected*
+    EMA — ema / (1 - prod(decay_t)) — matching the reference's
+    ``_ema_vars[...] / (1 - decay_pow)`` apply-time correction, so early
+    evaluations are not biased toward the zero initialization.
+    ``thres_steps`` schedules the decay as
+    min(decay, (1 + step) / (10 + step)) like the reference.
+    """
 
     def __init__(self, decay=0.999, thres_steps=None, name=None):
         self._decay = decay
+        self._thres_steps = thres_steps
         self._ema = {}
         self._params = []
         self._backup = None
+        self._step_var = None
+        self._dpow_var = None
 
     def update(self):
-        """Append ema = decay*ema + (1-decay)*param for every trainable
-        param in the default main program (call after minimize)."""
+        """Append ema = decay_t*ema + (1-decay_t)*param for every
+        trainable param in the default main program (call after
+        minimize)."""
         from paddle_tpu import initializer
 
         block = framework.default_main_program().global_block()
         helper = LayerHelper("ema")
         self._params = [p for p in block.all_parameters() if getattr(p, "trainable", True)]
+
+        def _state(name, init):
+            v = block.create_var(
+                name=unique_name.generate(name), shape=[1], dtype="float32",
+                persistable=True, stop_gradient=True,
+            )
+            helper.set_variable_initializer(v, initializer.Constant(init))
+            return v
+
+        def _tmp(name, shape=(1,), dtype="float32"):
+            return block.create_var(
+                name=unique_name.generate(name), shape=list(shape), dtype=dtype
+            )
+
+        def _op(type, ins, outs, **attrs):
+            attrs.setdefault("op_role", "optimize")
+            block.append_op(type=type, inputs=ins, outputs=outs, attrs=attrs)
+
+        if self._step_var is None:
+            self._step_var = _state("@EMA_STEP@", 0.0)
+            self._dpow_var = _state("@EMA_DPOW@", 1.0)
+            _op("scale", {"X": [self._step_var.name]}, {"Out": [self._step_var.name]},
+                scale=1.0, bias=1.0)
+            # decay_t: scheduled min(decay, (1+t)/(10+t)) or constant.
+            # thres_steps may be the user's global-step Variable
+            # (reference API) — drive the schedule from it; any other
+            # truthy value falls back to the internal step counter.
+            decay_t = _tmp("@EMA_DECAY@")
+            if self._thres_steps is not None:
+                if isinstance(self._thres_steps, framework.Variable):
+                    step_src = _tmp("@EMA_TSRC@")
+                    _op("cast", {"X": [self._thres_steps.name]}, {"Out": [step_src.name]},
+                        in_dtype=self._thres_steps.dtype, out_dtype="float32")
+                    step_name = step_src.name
+                else:
+                    step_name = self._step_var.name
+                num = _tmp("@EMA_NUM@")
+                den = _tmp("@EMA_DEN@")
+                cst = _tmp("@EMA_CST@")
+                _op("scale", {"X": [step_name]}, {"Out": [num.name]},
+                    scale=1.0, bias=1.0)
+                _op("scale", {"X": [step_name]}, {"Out": [den.name]},
+                    scale=1.0, bias=10.0)
+                _op("elementwise_div", {"X": [num.name], "Y": [den.name]}, {"Out": [cst.name]})
+                sched = _tmp("@EMA_SCHED@")
+                _op("scale", {"X": [self._step_var.name]}, {"Out": [sched.name]},
+                    scale=0.0, bias=self._decay)
+                _op("elementwise_min", {"X": [cst.name], "Y": [sched.name]},
+                    {"Out": [decay_t.name]})
+            else:
+                _op("scale", {"X": [self._step_var.name]}, {"Out": [decay_t.name]},
+                    scale=0.0, bias=self._decay)
+            _op("elementwise_mul", {"X": [self._dpow_var.name], "Y": [decay_t.name]},
+                {"Out": [self._dpow_var.name]})
+            self._decay_var = decay_t
+
+        one_minus = _tmp("@EMA_1MD@")
+        _op("scale", {"X": [self._decay_var.name]}, {"Out": [one_minus.name]},
+            scale=-1.0, bias=1.0)
         for p in self._params:
             if p.name in self._ema:
                 continue
@@ -596,36 +680,35 @@ class ExponentialMovingAverage:
                 shape=p.shape, dtype=p.dtype, persistable=True, stop_gradient=True,
             )
             helper.set_variable_initializer(e, initializer.Constant(0.0))
-            scaled_e = block.create_var(
-                name=unique_name.generate(p.name + "@EMA_T@"), shape=p.shape, dtype=p.dtype
-            )
-            scaled_p = block.create_var(
-                name=unique_name.generate(p.name + "@EMA_P@"), shape=p.shape, dtype=p.dtype
-            )
-            block.append_op(
-                type="scale", inputs={"X": [e]}, outputs={"Out": [scaled_e]},
-                attrs={"scale": self._decay, "op_role": "optimize"},
-            )
-            block.append_op(
-                type="scale", inputs={"X": [p]}, outputs={"Out": [scaled_p]},
-                attrs={"scale": 1.0 - self._decay, "op_role": "optimize"},
-            )
-            block.append_op(
-                type="elementwise_add", inputs={"X": [scaled_e], "Y": [scaled_p]},
-                outputs={"Out": [e]}, attrs={"op_role": "optimize"},
-            )
+            scaled_e = _tmp(p.name + "@EMA_T@", p.shape, p.dtype)
+            scaled_p = _tmp(p.name + "@EMA_P@", p.shape, p.dtype)
+            _op("elementwise_mul", {"X": [e.name], "Y": [self._decay_var.name]},
+                {"Out": [scaled_e.name]})
+            _op("elementwise_mul", {"X": [p.name], "Y": [one_minus.name]},
+                {"Out": [scaled_p.name]})
+            _op("elementwise_add", {"X": [scaled_e.name], "Y": [scaled_p.name]},
+                {"Out": [e.name]})
             self._ema[p.name] = e
         block.program.version += 1
 
     @contextlib.contextmanager
     def apply(self, executor=None, need_restore=True):
+        import jax.numpy as jnp
+
         from paddle_tpu.scope import global_scope
 
         scope = global_scope()
         self._backup = {}
+        dpow = (
+            np.asarray(scope.get(self._dpow_var.name)).item()
+            if self._dpow_var is not None
+            else 0.0
+        )
+        corr = max(1.0 - dpow, 1e-12)
         for p in self._params:
             self._backup[p.name] = scope.get(p.name)
-            scope.set(p.name, scope.get(self._ema[p.name].name))
+            ema = jnp.asarray(scope.get(self._ema[p.name].name))
+            scope.set(p.name, (ema / corr).astype(self._backup[p.name].dtype))
         try:
             yield
         finally:

@@ -192,3 +192,58 @@ def test_grad_allreduce_transpile_parity():
     l_multi, w_multi = sharded(persist, xb, yb)
     np.testing.assert_allclose(float(np.asarray(l_multi)), float(np.asarray(l_single)), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(w_multi), w_single, rtol=1e-4, atol=1e-6)
+
+
+def test_c_allreduce_prod_signs_and_zeros():
+    """Product allreduce must match the mathematical product for any sign
+    and for zeros (reference ncclProd, c_allreduce_op.h:57-110; round-1
+    impl NaN'd on negatives via exp(psum(log(x))))."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.core import registry
+    from paddle_tpu.parallel import env as penv
+
+    devs = jax.devices("cpu")[:4]
+    mesh = Mesh(np.array(devs), ("dp",))
+    kernel = registry.get_kernel("c_allreduce_prod")
+
+    x = np.array(
+        [[2.0, -3.0, 0.0, -1.5],
+         [1.0, -1.0, 4.0, 0.5],
+         [-2.0, -2.0, -2.0, 3.0],
+         [0.5, 2.0, 1.0, -4.0]], np.float32)  # [rank, elem]
+    expect = np.prod(x, axis=0)
+
+    def fn(xs):
+        with penv.active_axes(["dp"]):
+            return kernel({"X": [xs[0]]}, {"axis_name": "dp"})["Out"]
+
+    out = jax.jit(
+        jax.shard_map(fn, mesh=mesh, in_specs=(P("dp"),), out_specs=P("dp"), check_vma=False)
+    )(x)
+    # each rank emits the full reduced [4]-vector; out_specs=P("dp")
+    # concatenates them -> [16]
+    np.testing.assert_allclose(np.asarray(out)[:4], expect, rtol=1e-5)
+
+
+def test_place_mismatch_is_loud():
+    """Asking for an unavailable backend must raise, not silently fall
+    back (round-1 weakness: TPUPlace on a CPU box ran on CPU)."""
+    import pytest
+
+    class _GPUPlace(fluid.CPUPlace):
+        backend = "gpu"  # never present in this image
+
+    exe = fluid.Executor(_GPUPlace())
+    with pytest.raises(RuntimeError, match="unavailable"):
+        exe._device()
+    # opt-in fallback works
+    import os
+    os.environ["FLAGS_allow_place_fallback"] = "1"
+    try:
+        with pytest.warns(UserWarning):
+            dev = exe._device()
+        assert dev is not None
+    finally:
+        del os.environ["FLAGS_allow_place_fallback"]

@@ -61,8 +61,67 @@ def test_ema_apply_restore():
             ema_np = 0.5 * ema_np + 0.5 * w
         cur = np.asarray(scope.get(wname))
         with ema.apply(exe):
-            np.testing.assert_allclose(np.asarray(scope.get(wname)), ema_np, rtol=1e-5)
+            # apply installs the bias-corrected EMA (reference divides by
+            # 1 - decay^t at apply time)
+            corrected = ema_np / (1.0 - 0.5 ** 3)
+            np.testing.assert_allclose(np.asarray(scope.get(wname)), corrected, rtol=1e-5)
         np.testing.assert_allclose(np.asarray(scope.get(wname)), cur)
+
+
+def test_ema_thres_steps_schedule():
+    def make():
+        ema = fluid.optimizer.ExponentialMovingAverage(0.9, thres_steps=True)
+        ema.update()
+        return ema
+
+    prog, startup, loss, ema = _setup(make)
+    rng = np.random.RandomState(2)
+    feed = {"x": rng.rand(8, 4).astype("float32"), "y": rng.rand(8, 1).astype("float32")}
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    wname = prog.all_parameters()[0].name
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ema_np = np.zeros((4, 1), "float32")
+        dpow = 1.0
+        for t in range(5):
+            exe.run(prog, feed=feed, fetch_list=[loss])
+            w = np.asarray(scope.get(wname))
+            step = t + 1
+            decay_t = min(0.9, (1.0 + step) / (10.0 + step))
+            ema_np = decay_t * ema_np + (1 - decay_t) * w
+            dpow *= decay_t
+        with ema.apply(exe):
+            np.testing.assert_allclose(
+                np.asarray(scope.get(wname)), ema_np / (1 - dpow), rtol=1e-4
+            )
+
+
+def test_model_average_window_restart():
+    """Small windows force the sum_1/sum_2/sum_3 restart logic (reference:
+    average_accumulates_op.cc): after a restart the average covers only
+    the new window, not history from step 0."""
+    prog, startup, loss, ma = _setup(
+        lambda: fluid.optimizer.ModelAverage(
+            average_window_rate=1.0, min_average_window=2, max_average_window=2
+        )
+    )
+    rng = np.random.RandomState(3)
+    feed = {"x": rng.rand(8, 4).astype("float32"), "y": rng.rand(8, 1).astype("float32")}
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    wname = prog.all_parameters()[0].name
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        snaps = []
+        for _ in range(5):
+            exe.run(prog, feed=feed, fetch_list=[loss])
+            snaps.append(np.asarray(scope.get(wname)))
+        # windows of 2: restarts after steps 2 and 4; at step 5 sum_3 holds
+        # {3,4}, sum_1 holds {5}; old_num=2, num_acc=1
+        expect = (snaps[2] + snaps[3] + snaps[4]) / 3.0
+        with ma.apply(exe):
+            np.testing.assert_allclose(np.asarray(scope.get(wname)), expect, rtol=1e-5)
 
 
 def test_pipeline_optimizer_surface():
