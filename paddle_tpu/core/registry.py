@@ -151,20 +151,38 @@ def make_vjp_grad_kernel(fwd: OpDef) -> KernelFn:
             if slot.endswith(GRAD_SLOT_SUFFIX)
         }
         want_slots = [s for s in attrs.get("__grad_input_slots__", fwd_inputs.keys())]
-        # split differentiable vs static inputs
+        # split differentiable vs static inputs PER POSITION — a slot may
+        # mix float state with bool/int values (e.g. bounded_while's X
+        # carries the loop condition alongside float loop state)
         diff = {}
+        diff_pos = {}
         for slot in want_slots:
             if slot in fwd.no_grad_set or slot not in fwd_inputs:
                 continue
             vals = fwd_inputs[slot]
-            if all(_is_float(v) for v in vals):
-                diff[slot] = vals
-        static = {s: v for s, v in fwd_inputs.items() if s not in diff}
+            idxs = [i for i, v in enumerate(vals) if _is_float(v)]
+            if idxs:
+                diff[slot] = [vals[i] for i in idxs]
+                diff_pos[slot] = idxs
+        static = {}
+        for s, vals in fwd_inputs.items():
+            if s in diff:
+                skip = set(diff_pos[s])
+                static[s] = [None if i in skip else v for i, v in enumerate(vals)]
+            else:
+                static[s] = vals
         fwd_attrs = {k: v for k, v in attrs.items() if not k.startswith("__")}
 
         def f(diff_vals):
-            all_in = dict(static)
-            all_in.update(diff_vals)
+            all_in = {}
+            for s, vals in static.items():
+                if s in diff_vals:
+                    merged = list(vals)
+                    for i, dv in zip(diff_pos[s], diff_vals[s]):
+                        merged[i] = dv
+                    all_in[s] = merged
+                else:
+                    all_in[s] = list(vals)
             outs = fwd.kernel(all_in, fwd_attrs)
             outs = {k: v if isinstance(v, (list, tuple)) else [v] for k, v in outs.items()}
             return {k: list(v) for k, v in outs.items() if k in out_grads}
@@ -190,8 +208,12 @@ def make_vjp_grad_kernel(fwd: OpDef) -> KernelFn:
         (in_grads,) = vjp_fn(cots)
         result = {}
         for slot, gvals in in_grads.items():
-            # cast back: vjp returns grads in primal dtype already
-            result[slot + GRAD_SLOT_SUFFIX] = list(gvals)
+            # re-expand to full slot length: None at non-diff positions
+            # (the lowering drops them against EMPTY output names)
+            full = [None] * len(fwd_inputs[slot])
+            for i, g in zip(diff_pos[slot], gvals):
+                full[i] = g
+            result[slot + GRAD_SLOT_SUFFIX] = full
         return result
 
     return kernel

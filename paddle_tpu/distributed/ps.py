@@ -81,11 +81,12 @@ def _decode_msg(data: bytes):
         if not isinstance(sizes, list):
             raise ValueError("corrupt payload index")
         views = []
+        mv = memoryview(data)  # zero-copy payload slicing
         off = 4 + hlen
         for n in sizes:
             if not isinstance(n, int) or n < 0 or off + n > len(data):
                 raise ValueError("corrupt message payload")
-            views.append(data[off : off + n])
+            views.append(mv[off : off + n])
             off += n
 
         def conv(x):
@@ -208,16 +209,25 @@ class ParameterServer:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
-                try:
-                    while True:
+                while True:
+                    try:
                         msg = _recv_msg(self.request)
-                        _send_msg(self.request, outer._dispatch(msg))
-                except ValueError:
-                    # corrupt/over-limit frame: drop the connection quietly
-                    # (protocol error from the peer, not a server bug)
-                    pass
-                except (ConnectionError, OSError):
-                    pass
+                    except ValueError:
+                        # corrupt/over-limit frame: drop the connection
+                        # (protocol error from the peer, not a server bug)
+                        return
+                    except (ConnectionError, OSError):
+                        return
+                    # application errors go back to the caller as an error
+                    # response (the gRPC status analog), not a dropped socket
+                    try:
+                        resp = outer._dispatch(msg)
+                    except Exception as e:
+                        resp = {"_error": "%s: %s" % (type(e).__name__, e)}
+                    try:
+                        _send_msg(self.request, resp)
+                    except (ConnectionError, OSError):
+                        return
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -241,20 +251,16 @@ class ParameterServer:
         if op == "create_table":
             self.create_table(msg["table"], msg["dim"], **msg.get("kwargs", {}))
             return {"ok": True}
-        if op == "save":
-            # checkpoint a shard (reference: RequestCheckpoint /
-            # checkpoint_notify_op.cc) as wire-safe arrays
-            tables = {}
-            for n, t in self._tables.items():
-                with t._lock:
-                    ids = np.fromiter(t.rows.keys(), np.int64, len(t.rows))
-                    rows = (
-                        np.stack([t.rows[int(i)] for i in ids])
-                        if len(ids)
-                        else np.zeros((0, t.dim), np.float32)
-                    )
-                tables[n] = {"dim": t.dim, "ids": ids, "rows": rows}
-            return {"tables": tables}
+        if op == "tables":
+            # table directory for chunked checkpointing
+            return {
+                "tables": {n: {"dim": t.dim, "size": len(t.rows)} for n, t in self._tables.items()}
+            }
+        if op == "keys":
+            t = self._tables[msg["table"]]
+            with t._lock:
+                ids = np.fromiter(t.rows.keys(), np.int64, len(t.rows))
+            return {"ids": ids}
         if op == "barrier":  # counted barrier (rpc_server.cc analog)
             with self._barrier_lock:
                 self._barrier_count += 1
@@ -291,7 +297,12 @@ class PSClient:
     def _call(self, i, msg):
         s = self._sock(i)
         _send_msg(s, msg)
-        return _recv_msg(s)
+        resp = _recv_msg(s)
+        if isinstance(resp, dict) and "_error" in resp:
+            raise RuntimeError(
+                "PS %s: %s" % (self.endpoints[i], resp["_error"])
+            )
+        return resp
 
     def create_table(self, name: str, dim: int, **kwargs):
         for i in range(len(self.endpoints)):
@@ -328,6 +339,36 @@ class PSClient:
     def barrier(self):
         for i in range(len(self.endpoints)):
             self._call(i, {"op": "barrier"})
+
+    def save(self, chunk_rows: int = 1 << 20):
+        """Checkpoint every table across all shards (reference:
+        checkpoint_notify_op.cc / RequestCheckpoint).  Rows stream in
+        ``chunk_rows``-sized pulls so a shard larger than the wire-frame
+        cap still checkpoints.  Returns {table: (ids[N], rows[N, dim])}."""
+        out: Dict[str, List] = {}
+        for i in range(len(self.endpoints)):
+            tables = self._call(i, {"op": "tables"})["tables"]
+            for name in tables:
+                ids = self._call(i, {"op": "keys", "table": name})["ids"]
+                chunks = []
+                for s in range(0, len(ids), chunk_rows):
+                    part = ids[s : s + chunk_rows]
+                    chunks.append(
+                        self._call(i, {"op": "pull", "table": name, "ids": part})["rows"]
+                    )
+                rows = (
+                    np.concatenate(chunks)
+                    if chunks
+                    else np.zeros((0, tables[name]["dim"]), np.float32)
+                )
+                out.setdefault(name, [[], []])
+                out[name][0].append(ids)
+                out[name][1].append(rows)
+        return {
+            n: (np.concatenate(v[0]) if v[0] else np.zeros(0, np.int64),
+                np.concatenate(v[1]) if v[1] else np.zeros((0, 0), np.float32))
+            for n, v in out.items()
+        }
 
     def close(self):
         for s in self._socks:

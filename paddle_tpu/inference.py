@@ -29,7 +29,10 @@ class AnalysisConfig:
         self.model_dir = model_dir
         self.params_file: Optional[str] = None
         self.model_file: Optional[str] = None
-        self._use_tpu = True
+        # None = process-default device; the user pins a place with
+        # enable_use_gpu()/disable_gpu() and then a mismatch is a hard
+        # error (executor.py _device)
+        self._use_tpu: Optional[bool] = None
         self._device_id = 0
 
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
@@ -62,9 +65,13 @@ class AnalysisPredictor(PaddlePredictor):
 
         self.config = config
         self._scope = fluid.Scope()
-        self._exe = fluid.Executor(
-            fluid.TPUPlace(config._device_id) if config._use_tpu else fluid.CPUPlace()
-        )
+        if config._use_tpu is None:
+            place = None  # process default device
+        elif config._use_tpu:
+            place = fluid.TPUPlace(config._device_id)
+        else:
+            place = fluid.CPUPlace()
+        self._exe = fluid.Executor(place)
         with fluid.scope_guard(self._scope):
             self._program, self._feed_names, self._fetch_vars = io.load_inference_model(
                 config.model_dir, self._exe, params_filename=config.params_file

@@ -15,7 +15,7 @@ from paddle_tpu import framework, unique_name
 from paddle_tpu.framework import Variable
 from paddle_tpu.layer_helper import LayerHelper
 
-__all__ = ["While", "StaticRNN", "cond", "increment"]
+__all__ = ["While", "StaticRNN", "DynamicRNN", "cond", "increment"]
 
 
 def increment(x, value=1.0, in_place=True):
@@ -68,8 +68,14 @@ class While:
             layers.less_than(i, limit, cond=cond)
     """
 
-    def __init__(self, cond: Variable, is_test: bool = False, name: Optional[str] = None):
+    def __init__(self, cond: Variable, is_test: bool = False, name: Optional[str] = None,
+                 max_trip_count: Optional[int] = None):
+        """``max_trip_count``: static trip bound; when given, the loop
+        lowers to a differentiable scan (op ``bounded_while``) so
+        ``append_backward`` can differentiate through it — the TPU-native
+        grad-of-while (reference: controlflow/while_op.cc grad)."""
         self.cond_var = cond
+        self.max_trip_count = max_trip_count
         self.helper = LayerHelper("while", name=name)
 
     class _BlockGuard:
@@ -91,16 +97,45 @@ class While:
             if w.cond_var.name not in carried:
                 carried.insert(0, w.cond_var.name)
             parent = prog.current_block()
+            attrs = {
+                "sub_block": w.sub_block,
+                "carry_names": list(carried),
+                "external_names": list(externals),
+                "cond_name": w.cond_var.name,
+            }
+            op_type = "while"
+            x_in = carried + externals
+            if w.max_trip_count is not None:
+                op_type = "bounded_while"
+                attrs["max_trip_count"] = int(w.max_trip_count)
+                # The loop writes its outputs over its own input names
+                # (reference in-place Scope mutation).  The grad op later
+                # re-reads X to recompute the forward, so it must see the
+                # PRE-loop values — snapshot each carry into a fresh var
+                # (the SSA-ification SURVEY.md §7 hard-part #3 calls for,
+                # applied just where reverse-mode needs it).
+                snap = []
+                for n in carried:
+                    v = parent._find_var_recursive(n)
+                    sn = parent.create_var(
+                        name=unique_name.generate(n + ".while_init"),
+                        shape=v.shape,
+                        dtype=v.dtype,
+                        stop_gradient=v.stop_gradient,
+                    )
+                    parent.append_op(
+                        type="assign",
+                        inputs={"X": [n]},
+                        outputs={"Out": [sn.name]},
+                        attrs={},
+                    )
+                    snap.append(sn.name)
+                x_in = snap + externals
             parent.append_op(
-                type="while",
-                inputs={"X": carried + externals},
+                type=op_type,
+                inputs={"X": x_in},
                 outputs={"Out": list(carried)},
-                attrs={
-                    "sub_block": w.sub_block,
-                    "carry_names": list(carried),
-                    "external_names": list(externals),
-                    "cond_name": w.cond_var.name,
-                },
+                attrs=attrs,
             )
             return False
 
@@ -315,4 +350,187 @@ class StaticRNN:
     def __call__(self):
         if not self._built:
             raise RuntimeError("StaticRNN used before its step block completed")
+        return self._out_vars[0] if len(self._out_vars) == 1 else self._out_vars
+
+
+class DynamicRNN:
+    """Variable-length recurrence (reference: layers/control_flow.py:1700).
+
+    The reference walks LoD ragged batches with a shrinking batch; the
+    TPU-native encoding is padded ``[B, T, ...]`` sequences plus a
+    ``SeqLen`` vector (the framework's LoD shim, ops/sequence_ops.py), so
+    DynamicRNN lowers to ONE lax.scan over the time axis with per-example
+    masking (op ``dynamic_rnn``) — fully differentiable, fixed shapes.
+
+    ::
+
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            word = drnn.step_input(x, seq_len=lens)   # x: [B, T, D]
+            prev = drnn.memory(shape=[H], value=0.0)
+            hidden = layers.fc(layers.concat([word, prev], axis=1), H, act='tanh')
+            drnn.update_memory(prev, hidden)
+            drnn.output(hidden)
+        out = drnn()    # [B, T, H]; padding steps are zero
+    """
+
+    def __init__(self, keep_memory: bool = False, name: Optional[str] = None):
+        self.helper = LayerHelper("dynamic_rnn", name=name)
+        self._x_pairs = []      # (outer seq var [B,T,...], placeholder [B,...])
+        self._statics = []      # (outer var, placeholder)
+        self._mem = []          # [placeholder, init outer var, updated name]
+        self._outputs = []
+        self._seq_len = None
+        self._built = False
+
+    class _BlockGuard:
+        def __init__(self, rnn):
+            self.rnn = rnn
+
+        def __enter__(self):
+            prog = framework.default_main_program()
+            self.rnn.sub_block = prog._create_block()
+            return self.rnn
+
+        def __exit__(self, exc_type, *a):
+            if exc_type is not None:
+                return False
+            framework.default_main_program()._rollback()
+            self.rnn._complete()
+            return False
+
+    def block(self):
+        return DynamicRNN._BlockGuard(self)
+
+    # --- in-step API ---
+    def step_input(self, x: Variable, level: int = 0, seq_len: Optional[Variable] = None) -> Variable:
+        """x: [B, T, ...] padded; ``seq_len``: [B] lengths (required on
+        the first step_input — the reference reads lengths from the LoD)."""
+        if seq_len is not None:
+            self._seq_len = seq_len
+        if self._seq_len is None:
+            raise ValueError(
+                "DynamicRNN.step_input needs seq_len= on its first call "
+                "(padded+mask LoD encoding)"
+            )
+        ph = self.sub_block.create_var(
+            name=unique_name.generate("drnn_step_in"),
+            shape=(x.shape[0],) + tuple(x.shape[2:]),
+            dtype=x.dtype,
+        )
+        self._x_pairs.append((x, ph))
+        return ph
+
+    def static_input(self, x: Variable) -> Variable:
+        """Whole-sequence input visible unchanged at every step."""
+        ph = self.sub_block.create_var(
+            name=unique_name.generate("drnn_static_in"),
+            shape=x.shape,
+            dtype=x.dtype,
+        )
+        self._statics.append((x, ph))
+        return ph
+
+    def memory(self, init: Optional[Variable] = None, shape=None, value=0.0,
+               need_reorder: bool = False, dtype: str = "float32") -> Variable:
+        if init is None:
+            if shape is None:
+                raise ValueError("memory needs init= or shape=")
+            if not self._x_pairs:
+                raise ValueError("declare step_input before value-initialized memory")
+            parent = self.sub_block.parent_block
+            ref = self._x_pairs[0][0]
+            tail = [int(s) for s in shape]
+            init = parent.create_var(
+                name=unique_name.generate("drnn_mem_init"),
+                shape=[-1] + tail,
+                dtype=dtype,
+            )
+            parent.append_op(
+                type="fill_constant_batch_size_like",
+                inputs={"Input": [ref]},
+                outputs={"Out": [init]},
+                attrs={"shape": [-1] + tail, "value": float(value),
+                       "dtype": dtype, "input_dim_idx": 0, "output_dim_idx": 0},
+            )
+        ph = self.sub_block.create_var(
+            name=unique_name.generate("drnn_mem"),
+            shape=init.shape,
+            dtype=init.dtype,
+        )
+        self._mem.append([ph, init, None])
+        return ph
+
+    def update_memory(self, mem: Variable, new: Variable):
+        for rec in self._mem:
+            if rec[0] is mem or rec[0].name == mem.name:
+                rec[2] = new.name
+                return
+        raise ValueError("update_memory: %r is not a declared memory" % mem.name)
+
+    def output(self, *outs):
+        self._outputs.extend(outs)
+
+    # --- completion ---
+    def _complete(self):
+        prog = framework.default_main_program()
+        parent = prog.current_block()
+        if any(rec[2] is None for rec in self._mem):
+            raise ValueError("every memory needs update_memory before the block ends")
+        if not self._x_pairs:
+            raise ValueError("DynamicRNN needs at least one step_input")
+
+        locals_ = (
+            {ph.name for _, ph in self._x_pairs}
+            | {ph.name for _, ph in self._statics}
+            | {rec[0].name for rec in self._mem}
+        )
+        _, externals = _analyze_sub_block(self.sub_block, exclude_locals=locals_)
+        externals = [n for n in externals if n not in locals_]
+
+        x_outer = [x for x, _ in self._x_pairs]
+        static_outer = [x for x, _ in self._statics]
+        T = x_outer[0].shape[1] if len(x_outer[0].shape or ()) > 1 else None
+        out_vars = []
+        for o in self._outputs:
+            shp = tuple(o.shape or ())
+            ov = parent.create_var(
+                name=unique_name.generate(o.name + ".drnn_out"),
+                shape=(shp[0] if shp else -1, T) + tuple(shp[1:]),
+                dtype=o.dtype,
+            )
+            out_vars.append(ov)
+        final_mems = []
+        for ph, init, _ in self._mem:
+            fv = parent.create_var(
+                name=unique_name.generate(ph.name + ".final"),
+                shape=init.shape,
+                dtype=init.dtype,
+            )
+            final_mems.append(fv)
+
+        parent.append_op(
+            type="dynamic_rnn",
+            inputs={"X": [x.name for x in x_outer]
+                    + [rec[1].name for rec in self._mem]
+                    + [x.name for x in static_outer]
+                    + externals,
+                    "SeqLen": [self._seq_len.name]},
+            outputs={"Out": [v.name for v in out_vars] + [v.name for v in final_mems]},
+            attrs={
+                "sub_block": self.sub_block,
+                "x_names": [ph.name for _, ph in self._x_pairs],
+                "mem_names": [rec[0].name for rec in self._mem],
+                "mem_out_names": [rec[2] for rec in self._mem],
+                "out_names": [o.name for o in self._outputs],
+                "static_names": [ph.name for _, ph in self._statics] + externals,
+            },
+        )
+        self._out_vars = out_vars
+        self._final_mems = final_mems
+        self._built = True
+
+    def __call__(self):
+        if not self._built:
+            raise RuntimeError("DynamicRNN used before its block completed")
         return self._out_vars[0] if len(self._out_vars) == 1 else self._out_vars

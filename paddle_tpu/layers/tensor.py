@@ -8,6 +8,7 @@ from paddle_tpu.core import types as core_types
 from paddle_tpu.layer_helper import LayerHelper
 
 __all__ = [
+    "create_parameter",
     "create_tensor",
     "create_global_var",
     "cast",
@@ -75,6 +76,22 @@ def _helper_out(op_type, inputs, attrs=None, dtype="float32", out_slot="Out", st
         outputs.update(extra(helper))
     helper.append_op(type=op_type, inputs=inputs, outputs=outputs, attrs=attrs or {})
     return out
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """reference: layers/tensor.py create_parameter — a raw trainable
+    parameter outside any layer."""
+    from paddle_tpu import initializer as init_mod
+    from paddle_tpu.param_attr import ParamAttr
+
+    helper = LayerHelper("create_parameter")
+    attr = attr or ParamAttr(name=name)
+    if default_initializer is None:
+        default_initializer = (
+            init_mod.Constant(0.0) if is_bias else init_mod.Xavier()
+        )
+    return helper.create_parameter(attr, shape, dtype, is_bias, default_initializer)
 
 
 def create_tensor(dtype, name=None, persistable=False):

@@ -226,29 +226,34 @@ def average_accumulates(inputs, attrs):
     jnp = _jnp()
     p = one(inputs, "Param")
     s1, s2, s3 = one(inputs, "Sum1"), one(inputs, "Sum2"), one(inputs, "Sum3")
+    # integer counters (reference uses int64; float32 would freeze at
+    # 2^24 increments on long CTR runs)
     num_acc = one(inputs, "NumAccumulates").reshape(())
     old_num = one(inputs, "OldNumAccumulates").reshape(())
     num_upd = one(inputs, "NumUpdates").reshape(())
     rate = attrs.get("average_window", 0.15)
-    max_acc = attrs.get("max_num_accumulates", 16384)
-    min_win = attrs.get("min_average_window", 10000)
-    max_win = attrs.get("max_average_window", 10000)
+    max_acc = int(attrs.get("max_num_accumulates", 16384))
+    min_win = int(attrs.get("min_average_window", 10000))
+    max_win = int(attrs.get("max_average_window", 10000))
 
     s1 = s1 + p.astype(s1.dtype)
-    num_acc = num_acc + 1.0
-    num_upd = num_upd + 1.0
+    one_c = jnp.ones((), num_acc.dtype)
+    num_acc = num_acc + one_c
+    num_upd = num_upd + one_c
 
-    spill = jnp.mod(num_upd, float(max_acc)) == 0.0
+    spill = jnp.mod(num_upd, max_acc) == 0
     s2 = jnp.where(spill, s2 + s1, s2)
     s1 = jnp.where(spill, jnp.zeros_like(s1), s1)
 
-    window = jnp.minimum(float(max_win), num_upd * rate)
-    restart = jnp.logical_and(num_acc >= float(min_win), num_acc >= window)
+    window = jnp.minimum(float(max_win), num_upd.astype(jnp.float32) * rate)
+    restart = jnp.logical_and(
+        num_acc >= min_win, num_acc.astype(jnp.float32) >= window
+    )
     s3 = jnp.where(restart, s1 + s2, s3)
     s1 = jnp.where(restart, jnp.zeros_like(s1), s1)
     s2 = jnp.where(restart, jnp.zeros_like(s2), s2)
     old_num = jnp.where(restart, num_acc, old_num)
-    num_acc = jnp.where(restart, 0.0, num_acc)
+    num_acc = jnp.where(restart, jnp.zeros_like(num_acc), num_acc)
 
     return {
         "Sum1Out": s1, "Sum2Out": s2, "Sum3Out": s3,

@@ -526,9 +526,9 @@ class ModelAverage:
             s1 = _state(p.name + "@MA_SUM1@", p.shape)
             s2 = _state(p.name + "@MA_SUM2@", p.shape)
             s3 = _state(p.name + "@MA_SUM3@", p.shape)
-            na = _state(p.name + "@MA_NACC@", [1])
-            no = _state(p.name + "@MA_OLDN@", [1])
-            nu = _state(p.name + "@MA_NUPD@", [1])
+            na = _state(p.name + "@MA_NACC@", [1], "int64")
+            no = _state(p.name + "@MA_OLDN@", [1], "int64")
+            nu = _state(p.name + "@MA_NUPD@", [1], "int64")
             block.append_op(
                 type="average_accumulates",
                 inputs={"Param": [p.name], "Sum1": [s1.name], "Sum2": [s2.name],

@@ -3,6 +3,7 @@ python/paddle/fluid/tests/book/test_recognize_digits.py) — MLP + conv
 variants, PyReader pipeline, accuracy check on synthetic-deterministic
 mnist (dataset zoo)."""
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import dataset, framework, reader as R
@@ -48,6 +49,7 @@ def test_mlp():
     assert np.mean(accs[-4:]) > 0.7, np.mean(accs[-4:])
 
 
+@pytest.mark.slow
 def test_conv_net():
     def conv_net(img):
         x = fluid.layers.reshape(img, shape=[0, 1, 28, 28])

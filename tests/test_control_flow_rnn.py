@@ -183,3 +183,101 @@ def test_dynamic_gru_trains_sentiment():
             )
             losses.append(float(np.asarray(l)))
     assert losses[-1] < losses[0], losses
+
+
+def test_bounded_while_is_differentiable():
+    """grad-of-while (VERDICT missing #2): a 2-level recurrence inside a
+    bounded While must backprop exactly.  y = w^T x repeated N times:
+    s_{k+1} = s_k * (w.x); ds/dw after N steps = N * (w.x)^(N-1) * x."""
+    N = 3
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 7
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [4])
+        w = fluid.layers.create_parameter([4, 1], "float32", name="w_bw")
+        i = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        limit = fluid.layers.fill_constant(shape=[1], dtype="float32", value=float(N))
+        s = fluid.layers.fill_constant(shape=[1, 1], dtype="float32", value=1.0)
+        s.stop_gradient = False  # fill_constant defaults to stop_gradient
+        i.stop_gradient = True
+        cond = fluid.layers.less_than(i, limit)
+        loop = fluid.layers.While(cond, max_trip_count=N + 2)  # bound > actual trips
+        with loop.block():
+            prod = fluid.layers.mul(x, w)          # [1,1]
+            fluid.layers.assign(s * prod, s)
+            fluid.layers.control_flow.increment(i, value=1.0, in_place=True)
+            fluid.layers.less_than(i, limit, cond=cond)
+        loss = fluid.layers.mean(s)
+        fluid.optimizer.SGDOptimizer(0.0).minimize(loss)  # lr=0: just build grads
+
+    gw = framework.grad_var_name("w_bw")
+    xb = np.array([[0.5, -0.3, 0.2, 0.1]], np.float32)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        wv = np.asarray(scope.get("w_bw"))
+        (lv, gv) = exe.run(prog, feed={"x": xb}, fetch_list=[loss, gw])
+    dot = float(xb @ wv)
+    np.testing.assert_allclose(float(np.asarray(lv)), dot ** N, rtol=1e-5)
+    expect_gw = N * dot ** (N - 1) * xb.reshape(4, 1)
+    np.testing.assert_allclose(np.asarray(gv), expect_gw, rtol=1e-4)
+
+
+def test_dynamic_rnn_masks_and_trains():
+    """DynamicRNN on the padded+mask encoding: matches a numpy masked
+    recurrence, final memories freeze at each sequence's end, and a
+    sentiment-style model trains through it (reference:
+    layers/control_flow.py:1700, book test_understand_sentiment)."""
+    B, T, D, H = 4, 6, 3, 5
+    rng = np.random.RandomState(0)
+    xb = rng.randn(B, T, D).astype("float32")
+    lens = np.array([6, 3, 1, 4], np.int32)
+
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 11
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [T, D])
+        sl = fluid.layers.data("sl", [1], dtype="int32")
+        sl2 = fluid.layers.reshape(sl, [-1])
+        label = fluid.layers.data("label", [1])
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            word = drnn.step_input(x, seq_len=sl2)
+            prev = drnn.memory(shape=[H], value=0.0)
+            cat = fluid.layers.concat([word, prev], axis=1)
+            hidden = fluid.layers.fc(cat, H, act="tanh", name="drnn_fc")
+            drnn.update_memory(prev, hidden)
+            drnn.output(hidden)
+        out = drnn()  # [B, T, H]
+        last = fluid.layers.sequence_pool(out, "last", seq_len=sl2)
+        pred = fluid.layers.fc(last, 1, name="drnn_head")
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, label))
+        fluid.optimizer.AdamOptimizer(0.05).minimize(loss)
+
+    yb = rng.randn(B, 1).astype("float32")
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # numpy forward with the initial params to check masking semantics
+        wname = [p.name for p in prog.all_parameters() if "drnn_fc" in p.name and ".b_" not in p.name][0]
+        bname = [p.name for p in prog.all_parameters() if "drnn_fc" in p.name and ".b_" in p.name][0]
+        W = np.asarray(scope.get(wname)); bvec = np.asarray(scope.get(bname))
+        (o0,) = exe.run(prog, feed={"x": xb, "sl": lens.reshape(-1, 1), "label": yb},
+                        fetch_list=[out])
+        o0 = np.asarray(o0)
+        h = np.zeros((B, H), np.float32)
+        ref = np.zeros((B, T, H), np.float32)
+        for t in range(T):
+            cat = np.concatenate([xb[:, t], h], axis=1)
+            nh = np.tanh(cat @ W + bvec)
+            act = (t < lens)
+            h = np.where(act[:, None], nh, h)
+            ref[:, t] = np.where(act[:, None], nh, 0.0)
+        np.testing.assert_allclose(o0, ref, rtol=2e-4, atol=1e-5)
+
+        losses = [float(np.asarray(exe.run(prog,
+                  feed={"x": xb, "sl": lens.reshape(-1, 1), "label": yb},
+                  fetch_list=[loss])[0])) for _ in range(30)]
+    assert losses[-1] < losses[1] * 0.5, losses[:3] + losses[-3:]

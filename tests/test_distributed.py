@@ -247,3 +247,31 @@ def test_place_mismatch_is_loud():
         assert dev is not None
     finally:
         del os.environ["FLAGS_allow_place_fallback"]
+
+
+def test_ps_chunked_save_and_error_channel():
+    """Chunked checkpoint pull (no monolithic >frame-cap message) and the
+    application-error response channel (reference: gRPC status)."""
+    from paddle_tpu.distributed.ps import ParameterServer, PSClient
+
+    s1 = ParameterServer().start()
+    s2 = ParameterServer().start()
+    try:
+        cli = PSClient([s1.endpoint, s2.endpoint])
+        cli.create_table("emb", 4, initializer="zeros", optimizer="sgd", lr=1.0)
+        ids = np.arange(10, dtype=np.int64)
+        grads = -np.ones((10, 4), np.float32)  # sgd lr=1 on zero rows -> +1
+        cli.pull_sparse("emb", ids)
+        cli.push_sparse("emb", ids, grads)
+        saved = cli.save(chunk_rows=3)  # force multiple chunks
+        sids, rows = saved["emb"]
+        assert sorted(sids.tolist()) == ids.tolist()
+        np.testing.assert_allclose(rows, np.ones((10, 4), np.float32))
+
+        import pytest
+        with pytest.raises(RuntimeError, match="unknown PS op"):
+            cli._call(0, {"op": "definitely_not_an_op"})
+        # connection still alive after the app error
+        assert cli._call(0, {"op": "stats"})["emb"] > 0
+    finally:
+        s1.stop(); s2.stop()

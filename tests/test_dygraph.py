@@ -4,6 +4,7 @@ Reference: tests/unittests/test_imperative_basic.py, test_imperative_mnist
 — eager forward, tape backward, optimizer update, state_dict round-trip.
 """
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import dygraph
@@ -53,6 +54,7 @@ class _ConvNet(dygraph.Layer):
         return self.fc(h)
 
 
+@pytest.mark.slow
 def test_convnet_mnistish_trains():
     rng = np.random.RandomState(1)
     xb = rng.uniform(-1, 1, (16, 1, 8, 8)).astype("float32")

@@ -62,14 +62,17 @@ def _run_cfg(axes, seed=0, ring=True):
     return float(loss)
 
 
+@pytest.mark.slow
 def test_dp_tp_pp():
     _run_cfg({"dp": 2, "tp": 2, "pp": 2})
 
 
+@pytest.mark.slow
 def test_pp_sp_ep():
     _run_cfg({"pp": 2, "sp": 2, "ep": 2})
 
 
+@pytest.mark.slow
 def test_dp_sp_tp():
     _run_cfg({"dp": 2, "sp": 2, "tp": 2})
 
@@ -78,6 +81,7 @@ def test_single_device_baseline():
     _run_cfg({})
 
 
+@pytest.mark.slow
 def test_all_axes_size1_equivalence():
     l1 = _run_cfg({}, seed=3)
     l2 = _run_cfg({"dp": 2, "tp": 2, "pp": 2}, seed=3)
@@ -121,6 +125,7 @@ def test_ring_attention_standalone_parity():
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_hybrid_with_ring_attention_parity():
     _run_cfg({"pp": 2, "sp": 2, "ep": 2})  # ring_attention=True default
 

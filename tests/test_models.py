@@ -3,6 +3,7 @@
 on synthetic data, assert the loss decreases.
 """
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import framework, models
@@ -45,6 +46,7 @@ def test_lenet_mnist_trains():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_resnet18_tiny_trains():
     def build():
         img = fluid.layers.data("img", [3, 32, 32])
@@ -62,6 +64,7 @@ def test_resnet18_tiny_trains():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_transformer_lm_trains():
     V, S = 100, 16
 
@@ -85,6 +88,7 @@ def test_transformer_lm_trains():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_bert_encoder_shapes():
     S = 16
 

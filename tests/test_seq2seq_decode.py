@@ -6,6 +6,7 @@ few dozen steps and verifies the decoder end-to-end: a trained model
 must reproduce the source under greedy and beam decoding.
 """
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import decoding, framework, models
@@ -23,6 +24,7 @@ def _make_batch(rng, n):
     return src, tgt_in, labels
 
 
+@pytest.mark.slow
 def test_nmt_copy_task_train_and_decode():
     prog, startup = framework.Program(), framework.Program()
     prog.random_seed = startup.random_seed = 13
