@@ -1,0 +1,51 @@
+"""Distributed lookup-table binding (reference:
+transpiler/distribute_lookup_table.py + operators/distributed/
+parameter_prefetch.cc).
+
+``layers.embedding(is_distributed=True)`` records table metadata on the
+program; this module connects those tables to parameter servers and the
+executor does pull-before/push-after around each compiled step
+(executor.py _prefetch_distributed_tables).  The server applies the
+optimizer on push (listen_and_serv optimize sub-blocks analog), so pass
+the lr that matches the trainer-side optimizer for the dense params.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+from paddle_tpu.distributed.ps import PSClient
+
+__all__ = ["bind_distributed_tables"]
+
+
+def bind_distributed_tables(
+    program,
+    endpoints_or_client: Union[Sequence[str], PSClient],
+    optimizer: str = "sgd",
+    lr: float = 0.1,
+    initializer: str = "uniform",
+    seed: int = 0,
+):
+    """Create each of ``program``'s distributed tables on the servers and
+    attach the client so the executor can prefetch/push.  Returns the
+    client."""
+    tables = getattr(program, "_distributed_tables", None)
+    if not tables:
+        raise ValueError("program has no distributed lookup tables")
+    client = (
+        endpoints_or_client
+        if isinstance(endpoints_or_client, PSClient)
+        else PSClient(list(endpoints_or_client))
+    )
+    seen = set()
+    for meta in tables.values():
+        name = meta["table"]
+        if name in seen:  # tied embeddings share one server table
+            continue
+        seen.add(name)
+        client.create_table(
+            name, meta["dim"], initializer=initializer, seed=seed,
+            optimizer=optimizer, lr=lr,
+        )
+    program._ps_client = client
+    return client

@@ -257,10 +257,15 @@ class ParameterServer:
                 "tables": {n: {"dim": t.dim, "size": len(t.rows)} for n, t in self._tables.items()}
             }
         if op == "keys":
+            # paged, sorted key listing so huge shards fit the wire cap
             t = self._tables[msg["table"]]
+            start = int(msg.get("start", 0))
+            limit = msg.get("limit")
             with t._lock:
                 ids = np.fromiter(t.rows.keys(), np.int64, len(t.rows))
-            return {"ids": ids}
+            ids.sort()
+            page = ids[start : start + int(limit)] if limit is not None else ids[start:]
+            return {"ids": page, "total": int(len(ids))}
         if op == "barrier":  # counted barrier (rpc_server.cc analog)
             with self._barrier_lock:
                 self._barrier_count += 1
@@ -340,26 +345,46 @@ class PSClient:
         for i in range(len(self.endpoints)):
             self._call(i, {"op": "barrier"})
 
-    def save(self, chunk_rows: int = 1 << 20):
+    # stay well under _MAX_MSG per frame (header + payload slack)
+    _SAVE_BYTES_PER_CHUNK = 256 << 20
+
+    def save(self, chunk_rows: Optional[int] = None):
         """Checkpoint every table across all shards (reference:
-        checkpoint_notify_op.cc / RequestCheckpoint).  Rows stream in
-        ``chunk_rows``-sized pulls so a shard larger than the wire-frame
-        cap still checkpoints.  Returns {table: (ids[N], rows[N, dim])}."""
+        checkpoint_notify_op.cc / RequestCheckpoint).  Keys page and rows
+        stream in chunks sized by the row width, so any shard checkpoints
+        within the wire-frame cap.  Returns {table: (ids[N], rows[N, dim])}."""
         out: Dict[str, List] = {}
         for i in range(len(self.endpoints)):
             tables = self._call(i, {"op": "tables"})["tables"]
-            for name in tables:
-                ids = self._call(i, {"op": "keys", "table": name})["ids"]
+            for name, info in tables.items():
+                dim = max(1, int(info["dim"]))
+                rows_per_chunk = chunk_rows or max(
+                    1, self._SAVE_BYTES_PER_CHUNK // (dim * 4)
+                )
+                keys_per_page = max(1, self._SAVE_BYTES_PER_CHUNK // 8)
+                id_pages = []
+                start = 0
+                while True:
+                    resp = self._call(
+                        i, {"op": "keys", "table": name, "start": start, "limit": keys_per_page}
+                    )
+                    page = resp["ids"]
+                    if len(page):
+                        id_pages.append(page)
+                    start += len(page)
+                    if start >= resp["total"] or len(page) == 0:
+                        break
+                ids = np.concatenate(id_pages) if id_pages else np.zeros(0, np.int64)
                 chunks = []
-                for s in range(0, len(ids), chunk_rows):
-                    part = ids[s : s + chunk_rows]
+                for s in range(0, len(ids), rows_per_chunk):
+                    part = ids[s : s + rows_per_chunk]
                     chunks.append(
                         self._call(i, {"op": "pull", "table": name, "ids": part})["rows"]
                     )
                 rows = (
                     np.concatenate(chunks)
                     if chunks
-                    else np.zeros((0, tables[name]["dim"]), np.float32)
+                    else np.zeros((0, dim), np.float32)
                 )
                 out.setdefault(name, [[], []])
                 out[name][0].append(ids)

@@ -97,6 +97,11 @@ class Executor:
         fetch_names = [_as_fetch_name(f) for f in (fetch_list or [])]
 
         block = program.global_block()
+        # distributed lookup tables: pull rows before the step, push the
+        # sparse grads after (reference: parameter_prefetch.cc + the
+        # trainer-side send of SelectedRows grads)
+        ps_push = self._prefetch_distributed_tables(program, block, feed)
+
         persistable = {
             v.name for v in program.list_vars() if v.persistable
         }
@@ -110,6 +115,12 @@ class Executor:
         for fname in fetch_names:
             if fname in persistable:
                 read.add(fname)
+
+        if ps_push:
+            # fetch each prefetched-rows grad so it can be pushed; hidden
+            # from the caller's fetch list (appended, sliced off below)
+            for _, _, gname in ps_push:
+                fetch_names.append(gname)
 
         feed_names = tuple(sorted(feed.keys()))
         state_mut = tuple(sorted((read & written & persistable)))
@@ -191,6 +202,12 @@ class Executor:
         fetches, new_state = entry(mut_state, ro_state, feed_arrays)
         for n, v in new_state.items():
             scope.set(n, v)
+        if ps_push:
+            client = program._ps_client
+            n_user = len(fetch_names) - len(ps_push)
+            for (table, uniq, _), grad in zip(ps_push, fetches[n_user:]):
+                client.push_sparse(table, uniq, np.asarray(grad))
+            fetches = fetches[:n_user]
         if os.environ.get("FLAGS_check_nan_inf", "0") == "1":
             # module-boundary nan/inf check (reference checks per-op after
             # each kernel, operator.cc:954; one compiled module => one
@@ -208,6 +225,58 @@ class Executor:
         if return_numpy:
             fetches = [np.asarray(f) for f in fetches]
         return fetches
+
+    # ------------------------------------------------------------------
+    def _prefetch_distributed_tables(self, program, block, feed):
+        """Pull each distributed table's rows for this batch's unique ids
+        and add them (plus the ids->row map) to the feed.  Returns
+        [(table, padded_unique_ids, rows_grad_name)] for tables whose
+        grad exists in the program (training) so run() can push after the
+        step.  Unique counts are padded to power-of-two buckets to bound
+        recompiles; padding repeats ids[0], which receives zero gradient
+        (no local index maps to it) so the push is a no-op for it."""
+        dist_tables = getattr(program, "_distributed_tables", None)
+        if not dist_tables:
+            return []
+        client = getattr(program, "_ps_client", None)
+        if client is None:
+            raise RuntimeError(
+                "program has distributed lookup tables; call "
+                "paddle_tpu.distributed.bind_distributed_tables(program, "
+                "endpoints) before running it"
+            )
+        from paddle_tpu.framework import grad_var_name
+
+        ps_push = []
+        for meta in dist_tables.values():
+            tname = meta["table"]
+            if meta["rows_name"] in feed:
+                continue  # caller prefetched manually
+            ids_name = meta["ids_name"]
+            if ids_name not in feed:
+                raise RuntimeError(
+                    "distributed table %r needs ids var %r in the feed "
+                    "(prefetch happens host-side per batch)" % (tname, ids_name)
+                )
+            ids_val = np.asarray(feed[ids_name])
+            flat = ids_val.reshape(-1).astype(np.int64)
+            uniq, inv = np.unique(flat, return_inverse=True)
+            bucket = max(8, 1 << max(0, int(len(uniq) - 1).bit_length()))
+            pad = bucket - len(uniq)
+            fill = uniq[0] if len(uniq) else 0
+            uniq_p = np.concatenate([uniq, np.full(pad, fill, np.int64)])
+            rows = client.pull_sparse(meta["table"], uniq_p)
+            local = inv.astype(np.int32)
+            if meta["squeeze_last"] and ids_val.ndim >= 2 and ids_val.shape[-1] == 1:
+                local = local.reshape(ids_val.shape[:-1])
+            else:
+                local = local.reshape(ids_val.shape)
+            feed[meta["rows_name"]] = np.asarray(rows, np.float32)
+            feed[meta["local_name"]] = local
+            gname = grad_var_name(meta["rows_name"])
+            if block._find_var_recursive(gname) is not None:
+                ps_push.append((meta["table"], uniq_p, gname))
+        return ps_push
 
     # ------------------------------------------------------------------
     def train_from_dataset(self, program=None, dataset=None, scope=None,

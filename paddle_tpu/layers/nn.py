@@ -90,10 +90,58 @@ def embedding(
     param_attr=None,
     dtype="float32",
 ):
-    """reference: layers/nn.py:449.  ``is_sparse/is_distributed`` are kept
-    for API parity; on TPU the lookup lowers to a dense HBM gather (the
-    distributed path shards the table over the mesh — parallel/)."""
+    """reference: layers/nn.py:449.
+
+    ``is_distributed=True``: the table does NOT live in HBM — rows are
+    served by the parameter server (distributed/ps.py) and prefetched
+    per batch (reference: transpiler/distribute_lookup_table.py +
+    parameter_prefetch.cc).  The layer records table metadata on the
+    program; bind servers with
+    ``paddle_tpu.distributed.bind_distributed_tables(program, endpoints)``
+    and the executor handles pull-before/push-after each step.  The ids
+    must be a feed of the step.  Otherwise the lookup is a dense HBM
+    gather."""
     helper = LayerHelper("embedding", param_attr=param_attr)
+    if is_distributed:
+        from paddle_tpu import unique_name as _un
+        from paddle_tpu.param_attr import ParamAttr
+
+        block = helper.main_program.current_block()
+        attr = param_attr if isinstance(param_attr, ParamAttr) else ParamAttr(name=param_attr)
+        table_name = attr.name or _un.generate("dist_emb_table")
+        rows = block.create_var(
+            name=_un.generate(table_name + "@PREFETCH"),
+            shape=[-1, size[1]], dtype=dtype, stop_gradient=False,
+        )
+        ids_shape = tuple(input.shape or ())
+        local_shape = ids_shape[:-1] if ids_shape and ids_shape[-1] == 1 else ids_shape
+        local = block.create_var(
+            name=_un.generate(table_name + "@LOCALIDS"),
+            shape=list(local_shape) or [-1], dtype="int32", stop_gradient=True,
+        )
+        tmp = helper.create_variable_for_type_inference(dtype)
+        pad = -1 if padding_idx is None else (padding_idx if padding_idx >= 0 else size[0] + padding_idx)
+        helper.append_op(
+            type="distributed_lookup_table",
+            inputs={"Rows": [rows], "Ids": [local], "OrigIds": [input]},
+            outputs={"Out": [tmp]},
+            attrs={"table": table_name, "padding_idx": pad},
+        )
+        prog = helper.main_program
+        if not hasattr(prog, "_distributed_tables"):
+            prog._distributed_tables = {}
+        # keyed by the prefetch var (unique per lookup SITE) — several
+        # sites may share one server table (tied embeddings)
+        prog._distributed_tables[rows.name] = {
+            "table": table_name,
+            "dim": int(size[1]),
+            "height": int(size[0]),
+            "ids_name": input.name,
+            "rows_name": rows.name,
+            "local_name": local.name,
+            "squeeze_last": bool(ids_shape and ids_shape[-1] == 1),
+        }
+        return tmp
     w = helper.create_parameter(param_attr, shape=size, dtype=dtype)
     tmp = helper.create_variable_for_type_inference(dtype)
     padding_idx = -1 if padding_idx is None else (padding_idx if padding_idx >= 0 else size[0] + padding_idx)

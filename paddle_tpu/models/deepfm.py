@@ -22,26 +22,38 @@ def deepfm_ctr(
     embed_dim: int = 8,
     deep_layers=(400, 400, 400),
     name: str = "deepfm",
+    distributed_emb: bool = False,
 ):
     """feat_ids: int64 [N, F, 1]; feat_vals: float32 [N, F]; labels [N, 1].
+
+    ``distributed_emb=True`` serves both tables from the parameter server
+    (huge-vocab CTR where the tables exceed HBM — BASELINE.md DeepFM;
+    feat_ids must be a feed, bind via
+    distributed.bind_distributed_tables).
 
     Returns (avg_loss, auc_prob) where auc_prob is the CTR probability.
     """
     vals = layers.reshape(feat_vals, shape=[0, num_fields, 1])
+    emb_kw = dict(is_sparse=True, is_distributed=True) if distributed_emb else {}
+    # distributed mode looks up the raw [N, F, 1] feed ids (prefetch needs
+    # the feed var); dense mode drops the trailing 1 first
+    ids_in = feat_ids if distributed_emb else layers.reshape(feat_ids, shape=[0, num_fields])
 
     # ---- first-order (wide) term: sum_f w_id(f) * val(f)
     w1 = layers.embedding(
-        layers.reshape(feat_ids, shape=[0, num_fields]),
+        ids_in,
         size=[num_features, 1],
         param_attr=ParamAttr(name=name + "_w1_emb"),
+        **emb_kw,
     )  # [N, F, 1]
     first = layers.reduce_sum(w1 * vals, dim=[1])  # [N, 1]
 
     # ---- second-order FM term over [N, F, K] embeddings
     emb = layers.embedding(
-        layers.reshape(feat_ids, shape=[0, num_fields]),
+        ids_in,
         size=[num_features, embed_dim],
         param_attr=ParamAttr(name=name + "_fm_emb"),
+        **emb_kw,
     )  # [N, F, K]
     xv = emb * vals
     sum_sq = layers.square(layers.reduce_sum(xv, dim=[1]))  # [N, K]

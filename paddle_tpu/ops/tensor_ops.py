@@ -449,3 +449,29 @@ def uniform_random_batch_size_like(inputs, attrs):
             key, tuple(shape), minval=attrs.get("min", -1.0), maxval=attrs.get("max", 1.0)
         ).astype(jdtype(attrs.get("dtype", "float32")))
     }
+
+
+@register_op("distributed_lookup_table", no_grad_set={"Ids", "OrigIds"})
+def distributed_lookup_table(inputs, attrs):
+    """Lookup over host-prefetched rows (reference:
+    operators/distributed/parameter_prefetch.cc + prefetch_op).
+
+    The executor pulls the batch's unique rows from the parameter server
+    before the compiled step and feeds them as ``Rows`` plus the
+    ids-to-row index map ``Ids``; the in-graph op is a plain gather, so
+    its vjp is the scatter-add that becomes the sparse gradient pushed
+    back after the step (executor.py _prefetch_distributed_tables).
+    ``OrigIds`` + padding_idx mask pad tokens to zero rows (and, via the
+    vjp, zero their pushed gradients) like the dense lookup_table."""
+    jnp = _jnp()
+    rows = one(inputs, "Rows")
+    ids = one(inputs, "Ids")
+    out = jnp.take(rows, ids, axis=0)
+    padding_idx = attrs.get("padding_idx", -1)
+    orig = one(inputs, "OrigIds")
+    if padding_idx is not None and padding_idx >= 0 and orig is not None:
+        if orig.ndim >= 2 and orig.shape[-1] == 1:
+            orig = orig.squeeze(-1)
+        mask = (orig != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return {"Out": out}
