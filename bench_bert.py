@@ -1,0 +1,121 @@
+"""Benchmark: BERT-base pretraining step (MLM+NSP) on one TPU chip.
+
+Prints ONE JSON line like bench.py (metric bert_base_pretrain_*).
+
+MFU accounting: FLOPs/step = 6 * n_params * tokens (fwd+bwd matmuls)
++ 12 * n_layer * B * S^2 * d_model (attention score/context terms,
+fwd+bwd) against v5e bf16 peak 197 TFLOP/s — the scaling-book 6PD rule
+with the quadratic attention correction.
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+BATCH = int(os.environ.get("BENCH_BERT_BATCH", "128"))  # 76% MFU on v5e; 32->43%, 64->64%
+SEQ = int(os.environ.get("BENCH_BERT_SEQ", "128"))
+MASKS = max(1, int(SEQ * 0.15))
+STEPS = int(os.environ.get("BENCH_STEPS", "20"))
+PEAK_FLOPS = {"tpu": 197e12, "cpu": 1e12}
+
+
+def main():
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu import framework, models
+
+    platform = jax.devices()[0].platform
+    place = fluid.TPUPlace(0) if platform == "tpu" else fluid.CPUPlace()
+    use_amp = os.environ.get("BENCH_AMP", "1") == "1"
+
+    V, D, L, H, DI, S = 30522, 768, 12, 12, 3072, SEQ
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 42
+    with framework.program_guard(prog, startup):
+        src = fluid.layers.data("src", [S], dtype="int64")
+        sent = fluid.layers.data("sent", [S], dtype="int64")
+        mask = fluid.layers.data("mask", [S])
+        mpos = fluid.layers.data("mpos", [1], dtype="int64")
+        mlab = fluid.layers.data("mlab", [1], dtype="int64")
+        nlab = fluid.layers.data("nlab", [1], dtype="int64")
+        total, mlm_loss, nsp_acc = models.bert_pretrain(
+            src, sent, mask, mpos, mlab, nlab,
+            vocab_size=V, d_model=D, n_layer=L, n_head=H, d_inner=DI,
+            seq_len=S, dropout_rate=0.0,
+        )
+        opt = fluid.optimizer.AdamOptimizer(1e-4)
+        if use_amp:
+            opt = fluid.contrib.mixed_precision.decorate(opt)
+        opt.minimize(total)
+
+    n_params = 0
+    for p in prog.all_parameters():
+        n = 1
+        for s in p.shape:
+            n *= max(1, int(s))
+        n_params += n
+
+    rng = np.random.RandomState(0)
+    srcv = rng.randint(0, V, (BATCH, S)).astype(np.int64)
+    sentv = rng.randint(0, 2, (BATCH, S)).astype(np.int64)
+    maskv = np.ones((BATCH, S), np.float32)
+    # flattened positions into [N*S]
+    mposv = (
+        np.arange(BATCH)[:, None] * S
+        + rng.randint(0, S, (BATCH, MASKS))
+    ).reshape(-1, 1).astype(np.int64)
+    mlabv = rng.randint(0, V, (BATCH * MASKS, 1)).astype(np.int64)
+    nlabv = rng.randint(0, 2, (BATCH, 1)).astype(np.int64)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(place)
+    dev = jax.devices()[0]
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        feed = {
+            "src": jax.device_put(srcv.astype(np.int32), dev),
+            "sent": jax.device_put(sentv.astype(np.int32), dev),
+            "mask": jax.device_put(maskv, dev),
+            "mpos": jax.device_put(mposv.astype(np.int32), dev),
+            "mlab": jax.device_put(mlabv.astype(np.int32), dev),
+            "nlab": jax.device_put(nlabv.astype(np.int32), dev),
+        }
+        for _ in range(4):
+            (l,) = exe.run(prog, feed=feed, fetch_list=[total], return_numpy=False)
+            np.asarray(l)
+        t0 = time.perf_counter()
+        done = 0
+        while done < STEPS:
+            for _ in range(10):
+                (l,) = exe.run(prog, feed=feed, fetch_list=[total], return_numpy=False)
+                done += 1
+            lv = np.asarray(l)
+        dt = time.perf_counter() - t0
+
+    step_time = dt / STEPS
+    tokens = BATCH * S
+    flops = 6.0 * n_params * tokens + 12.0 * L * BATCH * S * S * D
+    mfu = (flops / step_time) / PEAK_FLOPS.get(platform, 197e12)
+    print(
+        json.dumps(
+            {
+                "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+                "value": round(tokens / step_time, 1),
+                "unit": "tokens/sec",
+                "vs_baseline": round(mfu / 0.50, 4),
+                "step_time_ms": round(step_time * 1e3, 2),
+                "mfu": round(mfu, 4),
+                "batch": BATCH,
+                "seq_len": S,
+                "n_params": n_params,
+                "platform": platform,
+                "loss": float(lv),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -9,5 +9,5 @@ from paddle_tpu.models import lenet, resnet, vgg, transformer, word2vec, deepfm,
 from paddle_tpu.models.lenet import lenet5  # noqa: F401
 from paddle_tpu.models.resnet import resnet50  # noqa: F401
 from paddle_tpu.models.vgg import vgg16  # noqa: F401
-from paddle_tpu.models.transformer import bert_encoder, transformer_lm  # noqa: F401
+from paddle_tpu.models.transformer import bert_encoder, bert_pretrain, transformer_lm  # noqa: F401
 from paddle_tpu.models.deepfm import deepfm_ctr  # noqa: F401

@@ -154,3 +154,44 @@ def test_deepfm_trains():
 
     losses = _train_steps(build, feeds, steps=6, lr=0.05)
     assert losses[-1] < losses[0], losses
+
+
+def test_bert_pretrain_trains():
+    """MLM+NSP pretraining objective trains on a tiny config (flagship
+    BASELINE config 3; heads follow the original BERT recipe)."""
+    V, D, L, H, DI, S, B, M = 50, 16, 2, 2, 32, 12, 4, 3
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 17
+    with framework.program_guard(prog, startup):
+        src = fluid.layers.data("src", [S], dtype="int64")
+        sent = fluid.layers.data("sent", [S], dtype="int64")
+        mask = fluid.layers.data("mask", [S])
+        mpos = fluid.layers.data("mpos", [1], dtype="int64")
+        mlab = fluid.layers.data("mlab", [1], dtype="int64")
+        nlab = fluid.layers.data("nlab", [1], dtype="int64")
+        total, mlm_loss, nsp_acc = models.bert_pretrain(
+            src, sent, mask, mpos, mlab, nlab,
+            vocab_size=V, d_model=D, n_layer=L, n_head=H, d_inner=DI,
+            seq_len=S, dropout_rate=0.0,
+        )
+        fluid.optimizer.AdamOptimizer(5e-3).minimize(total)
+
+    rng = np.random.RandomState(0)
+    feed = {
+        "src": rng.randint(0, V, (B, S)).astype("int64"),
+        "sent": rng.randint(0, 2, (B, S)).astype("int64"),
+        "mask": np.ones((B, S), "float32"),
+        "mpos": (np.arange(B)[:, None] * S + rng.randint(0, S, (B, M))).reshape(-1, 1).astype("int64"),
+        "mlab": rng.randint(0, V, (B * M, 1)).astype("int64"),
+        "nlab": rng.randint(0, 2, (B, 1)).astype("int64"),
+    }
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for _ in range(25):
+            (l,) = exe.run(prog, feed=feed, fetch_list=[total])
+            losses.append(float(np.asarray(l)))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
