@@ -96,6 +96,11 @@ class Executor:
         feed = dict(feed or {})
         fetch_names = [_as_fetch_name(f) for f in (fetch_list or [])]
 
+        if getattr(program, "_pipeline_plan", None):
+            return self._run_pipeline(
+                program, feed, fetch_names, scope, return_numpy
+            )
+
         block = program.global_block()
         # distributed lookup tables: pull rows before the step, push the
         # sparse grads after (reference: parameter_prefetch.cc + the
@@ -225,6 +230,73 @@ class Executor:
         if return_numpy:
             fetches = [np.asarray(f) for f in fetches]
         return fetches
+
+    # ------------------------------------------------------------------
+    def _run_pipeline(self, program, feed, fetch_names, scope, return_numpy):
+        """Run one compiled-GPipe step (PipelineOptimizer with cut_list;
+        reference: PipelineTrainer/SectionWorker, section_worker.cc:141).
+        Fetches are limited to the loss (the schedule's only global
+        scalar)."""
+        import jax
+
+        from paddle_tpu.parallel import mesh as mesh_lib, pipeline_program
+
+        plan = program._pipeline_plan
+        loss_name = plan["loss_name"]
+        for f in fetch_names:
+            if f != loss_name:
+                raise ValueError(
+                    "pipeline programs can fetch only the loss %r (got %r)"
+                    % (loss_name, f)
+                )
+        K = len(plan["cut_vars"]) + 1
+        feed_sig = tuple(
+            (n, tuple(np.shape(v)),
+             str(v.dtype if hasattr(v, "dtype") else np.asarray(v).dtype))
+            for n, v in sorted(feed.items())
+        )
+        key = ("pipeline", id(program), program.version, feed_sig)
+        entry = self._cache.get(key)
+        if entry is None:
+            # honor the executor's place like the main path (_device)
+            mesh = mesh_lib.make_mesh(
+                {"pp": K}, backend=getattr(self.place, "backend", None)
+            )
+            run_plan = dict(plan)
+            run_plan["feed_names"] = sorted(feed.keys())
+            step, state_names = pipeline_program.build_pipeline_step(
+                program, loss_name, run_plan, mesh
+            )
+            # donate state like the main path: param/velocity updates are
+            # in-place in HBM
+            entry = (jax.jit(step, donate_argnums=(0,)), state_names)
+            self._cache[key] = entry
+        step, state_names = entry
+
+        state = {}
+        for n in state_names:
+            v = scope.get(n)
+            if v is None:
+                if n.endswith("@PP_VELOCITY"):
+                    base = scope.get(n[: -len("@PP_VELOCITY")])
+                    v = np.zeros(np.shape(base), np.asarray(base).dtype)
+                    scope.set(n, v)
+                else:
+                    raise RuntimeError(
+                        "param %r not initialized — run the startup program" % n
+                    )
+            state[n] = v
+        feed_arrays = {
+            n: v if isinstance(v, jax.Array) else np.asarray(v)
+            for n, v in feed.items()
+        }
+        loss, new_state = step(state, feed_arrays)
+        for n, v in new_state.items():
+            scope.set(n, v)
+        out = [loss for _ in fetch_names] or []
+        if return_numpy:
+            out = [np.asarray(o) for o in out]
+        return out
 
     # ------------------------------------------------------------------
     def _prefetch_distributed_tables(self, program, block, feed):

@@ -726,17 +726,21 @@ class ExponentialMovingAverage:
 
 
 class PipelineOptimizer:
-    """Pipeline-parallel optimizer surface (reference: optimizer.py:2665
-    — cuts the program into sections run by SectionWorker threads,
-    framework/section_worker.cc:141).
+    """Pipeline-parallel optimizer (reference: optimizer.py:2665 — cuts
+    the program into sections run by SectionWorker threads,
+    framework/pipeline_trainer.cc, section_worker.cc:141).
 
-    TPU-native pipelining is the compiled GPipe engine
-    (parallel/hybrid.py: stage-sharded params over the ``pp`` mesh axis,
-    ppermute microbatch ring inside one XLA module) — thread+queue
-    section workers would serialize on a TPU.  This wrapper keeps the
-    fluid API: it runs the underlying optimizer and records the
-    microbatch plan on the program for the hybrid executor / fleet to
-    pick up.
+    TPU-native: with a non-empty ``cut_list`` the program's forward is
+    cut into stages and the executor runs a COMPILED GPipe schedule over
+    the ``pp`` mesh axis (parallel/pipeline_program.py — ppermute ring
+    inside one lax.scan; reverse-mode AD through it is the reference's
+    2K-1 backward sections).  The wrapped optimizer's update rule is
+    applied functionally; sgd and momentum are supported — for other
+    optimizers or stage-sharded memory scaling use the hybrid engine
+    (parallel/hybrid.py).
+
+    Without a cut_list this degrades to the wrapped optimizer plus a
+    recorded microbatch plan (API-parity surface).
     """
 
     def __init__(self, optimizer, cut_list=None, place_list=None, concurrency_list=None,
@@ -746,11 +750,43 @@ class PipelineOptimizer:
         self._num_microbatches = num_microbatches or max(1, len(self._cut_list) or 1)
 
     def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
-        ops, pgs = self._optimizer.minimize(loss, startup_program, parameter_list, no_grad_set)
         prog = loss.block.program
+        if self._cut_list:
+            opt = self._optimizer
+            if type(opt) is SGDOptimizer:
+                kind, mu = "sgd", 0.0
+            elif type(opt) is MomentumOptimizer and not opt._use_nesterov:
+                kind, mu = "momentum", float(opt._momentum)
+            else:
+                raise NotImplementedError(
+                    "PipelineOptimizer supports plain SGD/Momentum on the "
+                    "fluid path; use parallel.hybrid for other optimizers"
+                )
+            if isinstance(opt._learning_rate, Variable):
+                raise NotImplementedError("pipeline needs a float learning rate")
+            if opt.regularization is not None:
+                raise NotImplementedError("pipeline path does not apply regularization")
+            if parameter_list is not None or no_grad_set:
+                raise NotImplementedError("pipeline path updates all trainable params")
+            for p in prog.all_parameters():
+                if p.optimize_attr and p.optimize_attr.get("learning_rate", 1.0) != 1.0:
+                    raise NotImplementedError(
+                        "pipeline path ignores per-param LR multipliers (%s)" % p.name
+                    )
+            prog._pipeline_plan = {
+                "cut_vars": [getattr(v, "name", v) for v in self._cut_list],
+                "num_microbatches": self._num_microbatches,
+                "loss_name": loss.name,
+                "opt_kind": kind,
+                "lr": float(opt._learning_rate),
+                "momentum": mu,
+            }
+            # no backward/optimizer ops: the compiled schedule owns them
+            return [], [(p, None) for p in prog.all_parameters()]
+        ops, pgs = self._optimizer.minimize(loss, startup_program, parameter_list, no_grad_set)
         prog._pipeline_config = {
             "num_microbatches": self._num_microbatches,
-            "cut_vars": [getattr(v, "name", v) for v in self._cut_list],
+            "cut_vars": [],
         }
         return ops, pgs
 
