@@ -4,5 +4,6 @@ Reference: python/paddle/distributed/launch.py (process launcher),
 paddle/fluid/operators/distributed/ (gRPC/BRPC parameter-server RPC).
 """
 from paddle_tpu.distributed import launch  # noqa: F401
+from paddle_tpu.distributed.communicator import Communicator, GeoSGD  # noqa: F401
 from paddle_tpu.distributed.lookup import bind_distributed_tables  # noqa: F401
 from paddle_tpu.distributed.ps import ParameterServer, PSClient  # noqa: F401

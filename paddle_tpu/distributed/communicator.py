@@ -1,0 +1,231 @@
+"""Async parameter-server communication (reference:
+operators/distributed/communicator.h:160 — background send threads with
+per-var queues and merge-before-send) and geo-SGD (reference:
+DistributeTranspilerConfig geo mode, distribute_transpiler.py:131 —
+periodic parameter-delta sync instead of per-step grad push).
+
+TPU-native role: the compiled step stays synchronous on-device; what
+goes async is the HOST side — sparse grad pushes drain through a
+background thread so the next step's compute overlaps the PS round
+trip, at the cost of bounded staleness (the reference's async mode
+trade, listen_and_serv RunAsyncLoop).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.distributed.ps import PSClient
+
+__all__ = ["Communicator", "GeoSGD"]
+
+
+class Communicator:
+    """Background sparse-grad pusher with per-table merge queues.
+
+    ``push`` enqueues and returns immediately; the send thread drains a
+    table's queue, merges duplicate ids (grad sum — the reference's
+    merge-before-send), and issues one PS push.  ``max_merge`` bounds
+    staleness: at most that many batches are merged into one send.
+    """
+
+    def __init__(self, client: PSClient, max_merge: int = 20, capacity: int = 200):
+        self._client = client
+        self._queues: Dict[str, queue.Queue] = {}
+        self._max_merge = max_merge
+        self._capacity = capacity
+        self._lock = threading.Lock()
+        # serializes PS pushes between the send thread and flush() — the
+        # client's sockets are not safe for interleaved frames
+        self._send_lock = threading.Lock()
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    # -- lifecycle (reference: Communicator::Start/Stop) --
+    def start(self):
+        self._running = True
+        self._thread = threading.Thread(target=self._send_loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        self.flush()
+
+    def push(self, table: str, ids: np.ndarray, grads: np.ndarray):
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+        with self._lock:
+            q = self._queues.setdefault(table, queue.Queue(self._capacity))
+        try:
+            q.put((np.asarray(ids).reshape(-1), np.asarray(grads)), timeout=60)
+        except queue.Full:
+            raise RuntimeError(
+                "Communicator queue for %r full for 60s — PS unreachable?" % table
+            )
+
+    def flush(self):
+        """Drain everything synchronously (barrier before eval/save).
+        Loops until each queue is empty; the send lock serializes with
+        any in-flight background push, so on return all enqueued grads
+        are on the server."""
+        for table in list(self._queues):
+            while self._drain(table, block=False):
+                pass
+        # wait out an in-flight background push
+        with self._send_lock:
+            pass
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def pending(self) -> int:
+        return sum(q.qsize() for q in self._queues.values())
+
+    # -- internals --
+    def _drain(self, table: str, block: bool) -> bool:
+        q = self._queues[table]
+        batch: List = []
+        try:
+            batch.append(q.get(timeout=0.05 if block else 0))
+        except queue.Empty:
+            return False
+        while len(batch) < self._max_merge:
+            try:
+                batch.append(q.get_nowait())
+            except queue.Empty:
+                break
+        ids = np.concatenate([b[0] for b in batch])
+        grads = np.concatenate([b[1].reshape(len(b[0]), -1) for b in batch])
+        # PSClient.push_sparse dedups+sums — the merge
+        with self._send_lock:
+            self._client.push_sparse(table, ids, grads)
+        return True
+
+    def _send_loop(self):
+        import time
+
+        while self._running:
+            any_sent = False
+            for table in list(self._queues):
+                try:
+                    any_sent |= self._drain(table, block=True)
+                except Exception as e:
+                    # surface on next push/flush but KEEP the thread
+                    # alive — a transient PS error must not turn into a
+                    # silent dead queue (the failed batch is dropped)
+                    self._error = e
+                    time.sleep(0.5)
+            if not any_sent and not self._queues:
+                time.sleep(0.01)
+
+
+class GeoSGD:
+    """Geo-SGD periodic delta sync for dense params (reference: geo mode
+    of DistributeTranspiler — trainers run local SGD and every
+    ``sync_every`` steps push (param - snapshot)/num_trainers to the PS
+    and pull the merged global params back).
+
+    Each param maps to one PS table (rows = flattened param chunks);
+    the server applies the delta with lr=1 sgd, so pushes from all
+    trainers accumulate.
+    """
+
+    def __init__(self, program, scope, client_or_endpoints, num_trainers: int = 1,
+                 trainer_id: int = 0, sync_every: int = 10, table_prefix: str = "geo"):
+        self._program = program
+        self._scope = scope
+        self._client = (
+            client_or_endpoints
+            if isinstance(client_or_endpoints, PSClient)
+            else PSClient(list(client_or_endpoints))
+        )
+        self._n = max(1, int(num_trainers))
+        self._trainer_id = int(trainer_id)
+        self._every = max(1, int(sync_every))
+        self._prefix = table_prefix
+        self._params = [p.name for p in program.all_parameters()]
+        self._shapes = {}
+        self._snap: Dict[str, np.ndarray] = {}
+        self._step = 0
+
+    def _table(self, name: str) -> str:
+        return "%s/%s" % (self._prefix, name)
+
+    _SEED_FLAG = "__seeded__"
+
+    def init_worker(self, timeout: float = 60.0):
+        """Create tables; trainer 0 seeds the server with its initial
+        params and raises a 'seeded' flag table, other trainers WAIT for
+        the flag then pull — deterministic rank-0 init broadcast like the
+        reference's pserver startup, no barrier-count guessing."""
+        import time
+
+        for n in self._params:
+            val = np.asarray(self._scope.get(n), np.float32)
+            self._shapes[n] = val.shape
+            flat = val.reshape(val.shape[0], -1) if val.ndim > 1 else val.reshape(1, -1)
+            self._client.create_table(
+                self._table(n), flat.shape[1], initializer="zeros",
+                optimizer="sgd", lr=1.0,
+            )
+            self._snap[n] = val.copy()
+        flag = self._table(self._SEED_FLAG)
+        self._client.create_table(flag, 1, initializer="zeros", optimizer="sgd", lr=1.0)
+        if self._trainer_id == 0:
+            for n in self._params:
+                val = self._snap[n]
+                flat = val.reshape(val.shape[0], -1) if val.ndim > 1 else val.reshape(1, -1)
+                ids = np.arange(flat.shape[0], dtype=np.int64)
+                self._client.push_sparse(self._table(n), ids, -flat)  # row -= 1*(-v)
+            self._client.push_sparse(flag, np.zeros(1, np.int64), -np.ones((1, 1), np.float32))
+        else:
+            deadline = time.time() + timeout
+            while True:
+                rows = self._client.pull_sparse(flag, np.zeros(1, np.int64))
+                if rows is not None and float(rows[0, 0]) > 0:
+                    break
+                if time.time() > deadline:
+                    raise RuntimeError("geo-SGD: trainer 0 never seeded the server")
+                time.sleep(0.05)
+            self.pull_all()
+            for n in self._params:
+                self._snap[n] = np.asarray(self._scope.get(n), np.float32).copy()
+        return self
+
+    def pull_all(self):
+        import jax.numpy as jnp
+
+        for n in self._params:
+            shape = self._shapes[n]
+            rows = shape[0] if len(shape) > 1 else 1
+            ids = np.arange(rows, dtype=np.int64)
+            flat = self._client.pull_sparse(self._table(n), ids)
+            self._scope.set(n, jnp.asarray(flat.reshape(shape)))
+
+    def step(self):
+        """Call after each local train step; every sync_every steps the
+        delta goes up and the merged params come down."""
+        self._step += 1
+        if self._step % self._every:
+            return False
+        import jax.numpy as jnp
+
+        for n in self._params:
+            cur = np.asarray(self._scope.get(n), np.float32)
+            delta = (cur - self._snap[n]) / self._n
+            flat = delta.reshape(delta.shape[0], -1) if delta.ndim > 1 else delta.reshape(1, -1)
+            ids = np.arange(flat.shape[0], dtype=np.int64)
+            self._client.push_sparse(self._table(n), ids, -flat)  # row += delta
+        self.pull_all()
+        for n in self._params:
+            self._snap[n] = np.asarray(self._scope.get(n), np.float32).copy()
+        return True

@@ -25,10 +25,16 @@ def bind_distributed_tables(
     lr: float = 0.1,
     initializer: str = "uniform",
     seed: int = 0,
+    async_mode: bool = False,
 ):
     """Create each of ``program``'s distributed tables on the servers and
     attach the client so the executor can prefetch/push.  Returns the
-    client."""
+    client.
+
+    ``async_mode``: grad pushes drain through a background Communicator
+    (reference: communicator.h async PS) — next step's pull may miss the
+    newest grads (bounded staleness); call
+    ``program._ps_communicator.flush()`` before eval/save."""
     tables = getattr(program, "_distributed_tables", None)
     if not tables:
         raise ValueError("program has no distributed lookup tables")
@@ -48,4 +54,10 @@ def bind_distributed_tables(
             optimizer=optimizer, lr=lr,
         )
     program._ps_client = client
+    if async_mode:
+        from paddle_tpu.distributed.communicator import Communicator
+
+        # own connections: the send thread must not interleave frames on
+        # the executor's pull sockets
+        program._ps_communicator = Communicator(PSClient(client.endpoints)).start()
     return client

@@ -239,6 +239,16 @@ class ParameterServer:
 
     # --- server ops ---
     def create_table(self, name: str, dim: int, **kwargs):
+        # idempotent: a second trainer joining must not wipe rows the
+        # first already trained/seeded (reference: pserver tables are
+        # created once by the transpiled startup program)
+        existing = self._tables.get(name)
+        if existing is not None:
+            if existing.dim != dim:
+                raise ValueError(
+                    "table %r exists with dim %d != %d" % (name, existing.dim, dim)
+                )
+            return
         self._tables[name] = _Table(dim, **kwargs)
 
     def _dispatch(self, msg):

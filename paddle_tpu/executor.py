@@ -208,10 +208,16 @@ class Executor:
         for n, v in new_state.items():
             scope.set(n, v)
         if ps_push:
+            # async mode: enqueue on the Communicator (merge-before-send
+            # background thread); sync mode: blocking push
+            comm = getattr(program, "_ps_communicator", None)
             client = program._ps_client
             n_user = len(fetch_names) - len(ps_push)
             for (table, uniq, _), grad in zip(ps_push, fetches[n_user:]):
-                client.push_sparse(table, uniq, np.asarray(grad))
+                if comm is not None:
+                    comm.push(table, uniq, np.asarray(grad))
+                else:
+                    client.push_sparse(table, uniq, np.asarray(grad))
             fetches = fetches[:n_user]
         if os.environ.get("FLAGS_check_nan_inf", "0") == "1":
             # module-boundary nan/inf check (reference checks per-op after

@@ -79,7 +79,9 @@ def build_pipeline_step(program, loss_name: str, plan: Dict[str, Any], mesh):
         )
 
     param_names = sorted(p.name for p in program.all_parameters())
-    param_set = set(param_names)
+    trainable = {
+        p.name for p in program.all_parameters() if getattr(p, "trainable", True)
+    }
     feed_names = sorted(plan["feed_names"])
 
     # per-stage reads/writes to find each stage's params and feeds
@@ -121,15 +123,17 @@ def build_pipeline_step(program, loss_name: str, plan: Dict[str, Any], mesh):
             return {c: env[c] for c in cut_names}
 
         one_mb = {n: v[0] for n, v in feeds_mb.items()}
-        cut_shapes = {
-            c: tuple(s.shape)
-            for c, s in jax.eval_shape(full_fwd, params, one_mb).items()
-        }
+        cut_abstract = jax.eval_shape(full_fwd, params, one_mb)
+        cut_shapes = {c: tuple(s.shape) for c, s in cut_abstract.items()}
+        cut_dtypes = {c: s.dtype for c, s in cut_abstract.items()}
         flat_dims = {
             c: int(np.prod(shp[1:])) if len(shp) > 1 else 1
             for c, shp in cut_shapes.items()
         }
         maxd = max(flat_dims.values())
+        # ring buffer dtype: wide enough for every boundary (bf16 cuts
+        # travel as-is; mixing promotes)
+        buf_dtype = jnp.result_type(*cut_dtypes.values())
 
         def run_local(params, feeds_mb):
             stage = jax.lax.axis_index("pp")
@@ -141,7 +145,11 @@ def build_pipeline_step(program, loss_name: str, plan: Dict[str, Any], mesh):
                     if i > 0:
                         cin = cut_names[i - 1]
                         shp = cut_shapes[cin]
-                        env[cin] = act_in[:, : flat_dims[cin]].reshape(shp)
+                        env[cin] = (
+                            act_in[:, : flat_dims[cin]]
+                            .reshape(shp)
+                            .astype(cut_dtypes[cin])
+                        )
                     stage_trace(i)(env)
                     if i < K - 1:
                         cout = cut_names[i]
@@ -149,9 +157,9 @@ def build_pipeline_step(program, loss_name: str, plan: Dict[str, Any], mesh):
                         pad = maxd - flat.shape[1]
                         if pad:
                             flat = jnp.pad(flat, ((0, 0), (0, pad)))
-                        return flat.astype(jnp.float32), jnp.zeros((), jnp.float32)
+                        return flat.astype(buf_dtype), jnp.zeros((), jnp.float32)
                     loss = env[loss_name].reshape(())
-                    return jnp.zeros((mb, maxd), jnp.float32), loss.astype(jnp.float32)
+                    return jnp.zeros((mb, maxd), buf_dtype), loss.astype(jnp.float32)
 
                 return branch
 
@@ -174,7 +182,7 @@ def build_pipeline_step(program, loss_name: str, plan: Dict[str, Any], mesh):
                 )
                 return (sent, loss_acc), None
 
-            init = (jnp.zeros((mb, maxd), jnp.float32), jnp.zeros((), jnp.float32))
+            init = (jnp.zeros((mb, maxd), buf_dtype), jnp.zeros((), jnp.float32))
             (_, loss_sum), _ = jax.lax.scan(body, init, jnp.arange(T))
             # PRE-psum local loss (nonzero on the last stage only).
             # Differentiating the replicated post-psum value would scale
@@ -189,6 +197,8 @@ def build_pipeline_step(program, loss_name: str, plan: Dict[str, Any], mesh):
             grads = {n: jax.lax.psum(g, "pp") for n, g in grads.items()}
             new_state = dict(state)
             for n in param_names:
+                if n not in trainable:
+                    continue  # frozen params stay untouched (backward.py filter)
                 g = grads[n].astype(state[n].dtype)
                 if opt_kind == "momentum":
                     v = state[n + "@PP_VELOCITY"]
