@@ -92,21 +92,24 @@ class Communicator:
 
     # -- internals --
     def _drain(self, table: str, block: bool) -> bool:
+        # pop AND push under the send lock: flush()'s empty-queue +
+        # lock-acquire check must never observe a popped-but-unpushed
+        # batch (that would break its barrier guarantee)
         q = self._queues[table]
-        batch: List = []
-        try:
-            batch.append(q.get(timeout=0.05 if block else 0))
-        except queue.Empty:
-            return False
-        while len(batch) < self._max_merge:
-            try:
-                batch.append(q.get_nowait())
-            except queue.Empty:
-                break
-        ids = np.concatenate([b[0] for b in batch])
-        grads = np.concatenate([b[1].reshape(len(b[0]), -1) for b in batch])
-        # PSClient.push_sparse dedups+sums — the merge
         with self._send_lock:
+            batch: List = []
+            try:
+                batch.append(q.get(timeout=0.05 if block else 0))
+            except queue.Empty:
+                return False
+            while len(batch) < self._max_merge:
+                try:
+                    batch.append(q.get_nowait())
+                except queue.Empty:
+                    break
+            ids = np.concatenate([b[0] for b in batch])
+            grads = np.concatenate([b[1].reshape(len(b[0]), -1) for b in batch])
+            # PSClient.push_sparse dedups+sums — the merge
             self._client.push_sparse(table, ids, grads)
         return True
 

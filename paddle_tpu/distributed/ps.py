@@ -203,8 +203,12 @@ class ParameterServer:
     def __init__(self, endpoint: str = "127.0.0.1:0"):
         host, port = endpoint.rsplit(":", 1)
         self._tables: Dict[str, _Table] = {}
+        self._tables_lock = threading.Lock()
         self._barrier_count = 0
         self._barrier_lock = threading.Lock()
+        # rendezvous state for the host allreduce collective
+        self._coll: Dict[str, dict] = {}
+        self._coll_cv = threading.Condition()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -239,17 +243,18 @@ class ParameterServer:
 
     # --- server ops ---
     def create_table(self, name: str, dim: int, **kwargs):
-        # idempotent: a second trainer joining must not wipe rows the
-        # first already trained/seeded (reference: pserver tables are
-        # created once by the transpiled startup program)
-        existing = self._tables.get(name)
-        if existing is not None:
-            if existing.dim != dim:
-                raise ValueError(
-                    "table %r exists with dim %d != %d" % (name, existing.dim, dim)
-                )
-            return
-        self._tables[name] = _Table(dim, **kwargs)
+        # idempotent AND race-free: concurrent trainers joining must not
+        # wipe rows another already trained/seeded (reference: pserver
+        # tables are created once by the transpiled startup program)
+        with self._tables_lock:
+            existing = self._tables.get(name)
+            if existing is not None:
+                if existing.dim != dim:
+                    raise ValueError(
+                        "table %r exists with dim %d != %d" % (name, existing.dim, dim)
+                    )
+                return
+            self._tables[name] = _Table(dim, **kwargs)
 
     def _dispatch(self, msg):
         op = msg["op"]
@@ -276,6 +281,36 @@ class ParameterServer:
             ids.sort()
             page = ids[start : start + int(limit)] if limit is not None else ids[start:]
             return {"ids": page, "total": int(len(ids))}
+        if op == "allreduce":
+            # blocking sum-allreduce rendezvous: nranks callers post
+            # tensors under one key; all get the sum (the TCP collective
+            # the reference's dygraph NCCLParallelContext bootstraps —
+            # here the host ring IS the transport, a Gloo analog)
+            key = str(msg["key"])
+            nranks = int(msg["nranks"])
+            arr = np.asarray(msg["value"], np.float32)
+            import time as _time
+
+            deadline = _time.monotonic() + 60.0
+            with self._coll_cv:
+                ent = self._coll.get(key)
+                if ent is None:
+                    ent = self._coll[key] = {"sum": arr.copy(), "count": 1, "left": nranks}
+                else:
+                    ent["sum"] = ent["sum"] + arr
+                    ent["count"] += 1
+                self._coll_cv.notify_all()
+                while ent["count"] < nranks:
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0 or not self._coll_cv.wait(timeout=remaining):
+                        # drop the partial entry so a retry starts clean
+                        self._coll.pop(key, None)
+                        raise ValueError("allreduce %r timed out" % key)
+                out = ent["sum"]
+                ent["left"] -= 1
+                if ent["left"] == 0:
+                    self._coll.pop(key, None)
+            return {"sum": out}
         if op == "barrier":  # counted barrier (rpc_server.cc analog)
             with self._barrier_lock:
                 self._barrier_count += 1
@@ -304,8 +339,21 @@ class PSClient:
 
     def _sock(self, i) -> socket.socket:
         if self._socks[i] is None:
+            import time
+
             host, port = self.endpoints[i].rsplit(":", 1)
-            s = socket.create_connection((host, int(port)), timeout=30)
+            # retry with deadline: peers start concurrently and the
+            # server process may still be booting (real rendezvous
+            # semantics; a refused connection fails instantly otherwise)
+            deadline = time.monotonic() + 60.0
+            while True:
+                try:
+                    s = socket.create_connection((host, int(port)), timeout=30)
+                    break
+                except ConnectionRefusedError:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.2)
             self._socks[i] = s
         return self._socks[i]
 

@@ -2,13 +2,18 @@
 parallel.py:84 — DataParallel scales the loss and allreduces grads via
 ``_allreduce`` ops; imperative/nccl_context.cc TCP-bootstraps NCCL).
 
-TPU eager DP runs one process per host with the jax runtime handling the
-mesh; eager per-op collectives are not the TPU-efficient path (compile
-the step instead — parallel/hybrid.py), so this class keeps the API:
-loss scaling + grad averaging across ``Env.nranks`` (1 in-process)."""
+TPU eager DP: one trainer process per device/host, grads averaged with a
+REAL cross-process allreduce.  The transport is the host collective on
+the parameter-server (distributed/ps.py op "allreduce" — the TCP
+rendezvous that replaces the reference's TCP-bootstrapped NCCL ring;
+eager per-op device collectives are not the TPU-efficient path, compile
+the step instead — parallel/hybrid.py).  Rank 0 hosts the collective
+server on its trainer endpoint; everyone connects.
+"""
 from __future__ import annotations
 
 import os
+from typing import Optional
 
 from paddle_tpu.dygraph.layers import Layer
 
@@ -44,10 +49,64 @@ class Env:
         return self._trainer_endpoints
 
 
+class ParallelContext:
+    """Cross-process collective context (reference: NCCLParallelContext,
+    imperative/nccl_context.cc — rank 0 creates the id and TCP-bcasts;
+    here rank 0 hosts the collective server itself)."""
+
+    def __init__(self, env: Env):
+        self.env = env
+        self._server = None
+        self._client = None
+        self._seq = 0
+        if env.nranks > 1:
+            from paddle_tpu.distributed.ps import ParameterServer, PSClient
+
+            root = env.trainer_endpoints[0]
+            if env.local_rank == 0:
+                host, port = root.rsplit(":", 1)
+                # collective port = trainer port + 2000 (trainer ports are
+                # taken by the launch contract)
+                self._server = ParameterServer("%s:%d" % (host, int(port) + 2000)).start()
+            host, port = root.rsplit(":", 1)
+            self._client = PSClient(["%s:%d" % (host, int(port) + 2000)])
+
+    def allreduce(self, value, name: str = ""):
+        """Blocking sum-allreduce across all ranks.  Keys carry the
+        caller-provided name plus a per-context step so different params
+        can never rendezvous with each other even if one rank skips."""
+        import numpy as np
+
+        if self._client is None:
+            return value
+        out = self._client._call(
+            0,
+            {"op": "allreduce", "key": "dygraph/%d/%s" % (self._seq, name),
+             "nranks": self.env.nranks, "value": np.asarray(value, np.float32)},
+        )["sum"]
+        return out
+
+    def next_step(self):
+        self._seq += 1
+
+    def close(self):
+        if self._client is not None:
+            self._client.close()
+        if self._server is not None:
+            self._server.stop()
+
+
+_ctx: Optional[ParallelContext] = None
+
+
 def prepare_context(strategy=None):
-    """reference: dygraph/parallel.py prepare_context — jax.distributed
-    owns process-group bootstrap on TPU; returns the env descriptor."""
-    return Env()
+    """reference: dygraph/parallel.py prepare_context — boots the host
+    collective (rank 0 serves) and returns the env descriptor."""
+    global _ctx
+    env = Env()
+    if _ctx is None:
+        _ctx = ParallelContext(env)
+    return env
 
 
 class DataParallel(Layer):
@@ -71,11 +130,30 @@ class DataParallel(Layer):
         return L.scale(loss, scale=1.0 / self.nranks)
 
     def apply_collective_grads(self):
-        """Average gradients across ranks (psum/nranks). In-process
-        single-rank eager mode this is the identity; the multi-rank path
-        is the compiled hybrid engine."""
+        """Sum gradients across ranks via the host collective (with
+        scale_loss dividing by nranks, the result is the average —
+        reference: apply_collective_grads calling _allreduce per grad)."""
         if self.nranks <= 1:
             return
+        if _ctx is None or _ctx._client is None:
+            raise RuntimeError(
+                "call fluid.dygraph.parallel.prepare_context() before "
+                "apply_collective_grads in multi-rank mode"
+            )
+        import jax.numpy as jnp
+        import numpy as np
+
+        _ctx.next_step()
+        for p in self.parameters():
+            g = getattr(p, "_dy_grad", None)
+            if g is None:
+                # every rank must post every param or the rendezvous
+                # starves — a rank where the param was unused sends zeros
+                # (reference: allreduce of zero grads)
+                g = jnp.zeros(tuple(p.shape), "float32")
+            dtype = getattr(g, "dtype", np.float32)
+            summed = _ctx.allreduce(np.asarray(g, np.float32), name=p.name)
+            p._dy_grad = jnp.asarray(summed).astype(dtype)
 
     def parameters(self, include_sublayers=True):
         return self._layers.parameters(include_sublayers)

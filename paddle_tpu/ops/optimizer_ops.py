@@ -19,6 +19,12 @@ def _jnp():
     return jnp
 
 
+def _jax():
+    import jax
+
+    return jax
+
+
 @register_op("sgd", differentiable=False)
 def sgd(inputs, attrs):
     p = one(inputs, "Param")
@@ -260,4 +266,58 @@ def average_accumulates(inputs, attrs):
         "NumAccumulatesOut": num_acc.reshape((1,)),
         "OldNumAccumulatesOut": old_num.reshape((1,)),
         "NumUpdatesOut": num_upd.reshape((1,)),
+    }
+
+
+@register_op("dgc_momentum", differentiable=False)
+def dgc_momentum(inputs, attrs):
+    """Deep Gradient Compression momentum update (reference:
+    operators/dgc_op.cc:23 + optimizer.py:787 DGCMomentumOptimizer +
+    details/sparse_all_reduce_op_handle.h:30).
+
+    Local momentum correction (u = mu*u + g), gradient accumulation
+    (v += u), top-k selection on |v| (static k from the final sparsity —
+    XLA needs static shapes; the rampup phase before
+    ``rampup_begin_step`` sends dense instead), accumulator clearing at
+    selected positions, then allreduce of the sparse tensor over the dp
+    axis when one is active (the SparseAllReduceOpHandle).  The param
+    steps with the allreduced sparse gradient.
+    """
+    jax = _jax()
+    jnp = _jnp()
+    p, g = one(inputs, "Param"), one(inputs, "Grad")
+    u, v = one(inputs, "U"), one(inputs, "V")
+    step = one(inputs, "CurrentStep").reshape(())
+    lr = one(inputs, "LearningRate").reshape(()).astype(p.dtype)
+    mu = attrs.get("mu", 0.9)
+    sparsity = float(attrs.get("sparsity", 0.999))
+    rampup = float(attrs.get("rampup_begin_step", 0.0))
+
+    u_new = mu * u + g
+    v_new = v + u_new
+    flat = jnp.abs(v_new.reshape(-1))
+    n = flat.shape[0]
+    k = max(1, int(round(n * (1.0 - sparsity))))
+    kth = jax.lax.top_k(flat, k)[0][-1]
+    mask = jnp.abs(v_new) >= kth
+    sparse_grad = jnp.where(mask, v_new, 0.0)
+
+    from paddle_tpu.parallel import env as penv
+
+    ax = attrs.get("axis_name") or penv.axis_for_ring(attrs.get("ring_id", 0))
+    if penv.axis_active(ax):
+        sparse_grad = jax.lax.psum(sparse_grad, axis_name=ax)
+
+    # before rampup_begin_step the reference runs plain (dense) momentum
+    # with u as the velocity and leaves the DGC accumulators alone; note
+    # in dense phase g is expected pre-allreduced (regular DP path),
+    # while in sparse phase DGC owns the communication.
+    dense = step < rampup
+    update = jnp.where(dense, u_new, sparse_grad)
+    u_out = jnp.where(dense, u_new, jnp.where(mask, 0.0, u_new))
+    v_out = jnp.where(dense, v, jnp.where(mask, 0.0, v_new))
+    return {
+        "ParamOut": p - lr * update,
+        "UOut": u_out,
+        "VOut": v_out,
     }

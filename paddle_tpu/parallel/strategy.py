@@ -4,18 +4,38 @@ Reference: paddle/fluid/framework/details/build_strategy.h and
 python/paddle/fluid/incubate/fleet/collective/__init__.py:98.  Most of the
 reference's knobs steer its hand-built pass pipeline (fuse allreduce,
 hierarchical rings, memory reuse); under XLA those are compiler decisions,
-so the fields are accepted for API parity and the few that still mean
-something (gradient sharding, microbatches, mesh shape) steer jit
-shardings instead.
+so the fields are accepted for API parity — setting one after
+construction WARNS that it is inert here — and the few that still mean
+something (gradient sharding, microbatches, mesh shape, local SGD, DGC)
+steer jit shardings / the transpilers instead.
 """
 from __future__ import annotations
 
-from typing import Dict, Optional
+import warnings
+from typing import Dict
 
 __all__ = ["BuildStrategy", "ExecutionStrategy", "DistributedStrategy"]
 
 
-class BuildStrategy:
+class _WarnsOnInertKnobs:
+    """Warn when a knob that XLA subsumes is explicitly set (round-1
+    weakness: accepted-and-ignored silently)."""
+
+    _INERT: frozenset = frozenset()
+    _init_done = False
+
+    def __setattr__(self, name, value):
+        if self._init_done and name in self._INERT:
+            warnings.warn(
+                "%s.%s is accepted for fluid API parity but has no effect "
+                "on TPU: XLA owns fusion/scheduling/memory decisions"
+                % (type(self).__name__, name),
+                stacklevel=2,
+            )
+        object.__setattr__(self, name, value)
+
+
+class BuildStrategy(_WarnsOnInertKnobs):
     class ReduceStrategy:
         AllReduce = 0
         Reduce = 1
@@ -24,6 +44,14 @@ class BuildStrategy:
         CoeffNumDevice = 0
         One = 1
         Customized = 2
+
+    _INERT = frozenset({
+        "fuse_elewise_add_act_ops", "fuse_all_reduce_ops",
+        "fuse_all_optimizer_ops", "fuse_broadcast_ops", "memory_optimize",
+        "enable_inplace", "enable_sequential_execution",
+        "remove_unnecessary_lock", "use_hierarchical_allreduce",
+        "hierarchical_allreduce_inter_nranks", "nccl_comm_num",
+    })
 
     def __init__(self):
         self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
@@ -42,12 +70,18 @@ class BuildStrategy:
         self.use_hierarchical_allreduce = False
         self.hierarchical_allreduce_inter_nranks = 0
         self.nccl_comm_num = 1
+        self._init_done = True
 
 
-class ExecutionStrategy:
+class ExecutionStrategy(_WarnsOnInertKnobs):
     class ExecutorType:
         Default = 0
         Experimental = 1
+
+    _INERT = frozenset({
+        "num_threads", "num_iteration_per_drop_scope",
+        "use_thread_pool", "allow_op_delay",
+    })
 
     def __init__(self):
         self.num_threads = 0
@@ -55,6 +89,7 @@ class ExecutionStrategy:
         self.num_iteration_per_run = 1
         self.use_thread_pool = False
         self.allow_op_delay = False
+        self._init_done = True
 
 
 class DistributedStrategy(BuildStrategy):
@@ -65,6 +100,8 @@ class DistributedStrategy(BuildStrategy):
 
     def __init__(self):
         super().__init__()
+        # reopen: BuildStrategy.__init__ closed the init window
+        object.__setattr__(self, "_init_done", False)
         self.mode = "collective"
         self.collective_mode = "grad_allreduce"  # or "local_sgd"
         self.local_sgd_steps = 1
@@ -75,3 +112,4 @@ class DistributedStrategy(BuildStrategy):
         self.exec_strategy = ExecutionStrategy()
         self.use_amp = False
         self.num_microbatches = 1
+        self._init_done = True
