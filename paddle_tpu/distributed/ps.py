@@ -303,8 +303,10 @@ class ParameterServer:
                 while ent["count"] < nranks:
                     remaining = deadline - _time.monotonic()
                     if remaining <= 0 or not self._coll_cv.wait(timeout=remaining):
-                        # drop the partial entry so a retry starts clean
-                        self._coll.pop(key, None)
+                        # drop OUR partial entry so a retry starts clean —
+                        # but never a fresh entry later arrivals recreated
+                        if self._coll.get(key) is ent:
+                            del self._coll[key]
                         raise ValueError("allreduce %r timed out" % key)
                 out = ent["sum"]
                 ent["left"] -= 1

@@ -4,7 +4,9 @@ from __future__ import annotations
 
 from paddle_tpu.layer_helper import LayerHelper
 
-__all__ = ["prior_box", "box_coder", "iou_similarity", "yolo_box", "multiclass_nms"]
+__all__ = ["prior_box", "box_coder", "iou_similarity", "yolo_box", "multiclass_nms",
+           "anchor_generator", "box_clip", "roi_align", "roi_pool",
+           "bipartite_match", "target_assign"]
 
 
 def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
@@ -101,3 +103,81 @@ def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=64,
     )
     out.stop_gradient = True
     return out
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None, offset=0.5,
+                     name=None):
+    """reference: layers/detection.py anchor_generator."""
+    helper = LayerHelper("anchor_generator", name=name)
+    anchors = helper.create_variable_for_type_inference("float32")
+    variances = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="anchor_generator", inputs={"Input": [input]},
+        outputs={"Anchors": [anchors], "Variances": [variances]},
+        attrs={"anchor_sizes": list(anchor_sizes or [64.0]),
+               "aspect_ratios": list(aspect_ratios or [1.0]),
+               "variances": list(variance),
+               "stride": list(stride or [16.0, 16.0]),
+               "offset": offset},
+    )
+    return anchors, variances
+
+
+def box_clip(input, im_info, name=None):
+    helper = LayerHelper("box_clip", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="box_clip", inputs={"Input": [input], "ImInfo": [im_info]},
+                     outputs={"Output": [out]}, attrs={})
+    return out
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0,
+              sampling_ratio=-1, batch_index=None, name=None):
+    helper = LayerHelper("roi_align", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input], "ROIs": [rois]}
+    if batch_index is not None:
+        ins["BatchIndex"] = [batch_index]
+    helper.append_op(type="roi_align", inputs=ins, outputs={"Out": [out]},
+                     attrs={"pooled_height": pooled_height, "pooled_width": pooled_width,
+                            "spatial_scale": spatial_scale, "sampling_ratio": sampling_ratio})
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0,
+             batch_index=None, name=None):
+    helper = LayerHelper("roi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input], "ROIs": [rois]}
+    if batch_index is not None:
+        ins["BatchIndex"] = [batch_index]
+    helper.append_op(type="roi_pool", inputs=ins, outputs={"Out": [out]},
+                     attrs={"pooled_height": pooled_height, "pooled_width": pooled_width,
+                            "spatial_scale": spatial_scale})
+    return out
+
+
+def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5, name=None):
+    helper = LayerHelper("bipartite_match", name=name)
+    idx = helper.create_variable_for_type_inference("int32")
+    dist = helper.create_variable_for_type_inference(dist_matrix.dtype)
+    helper.append_op(
+        type="bipartite_match", inputs={"DistMat": [dist_matrix]},
+        outputs={"ColToRowMatchIndices": [idx], "ColToRowMatchDist": [dist]},
+        attrs={"match_type": match_type, "dist_threshold": dist_threshold},
+    )
+    return idx, dist
+
+
+def target_assign(input, matched_indices, mismatch_value=0, name=None):
+    helper = LayerHelper("target_assign", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    w = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="target_assign",
+        inputs={"X": [input], "MatchIndices": [matched_indices]},
+        outputs={"Out": [out], "OutWeight": [w]},
+        attrs={"mismatch_value": mismatch_value},
+    )
+    return out, w

@@ -51,6 +51,25 @@ __all__ = [
     "clip_by_norm",
     "mean",
     "smooth_l1",
+    "warpctc",
+    "sequence_conv",
+    "sequence_erase",
+    "sequence_enumerate",
+    "sequence_expand_as",
+    "sequence_first_step",
+    "sequence_last_step",
+    "nce",
+    "hsigmoid",
+    "lstm_unit",
+    "gru_unit",
+    "image_resize",
+    "resize_bilinear",
+    "resize_nearest",
+    "pixel_shuffle",
+    "shuffle_channel",
+    "crop",
+    "pad_constant_like",
+    "py_func",
 ]
 
 
@@ -707,3 +726,271 @@ def clip(x, min, max, name=None):
 
 def clip_by_norm(x, max_norm, name=None):
     return _simple("clip_by_norm", x, {"max_norm": max_norm})
+
+
+# ---------------------------------------------------------------------------
+# round-2 breadth: CTC, sequence_conv, NCE, hsigmoid, cell units, resize,
+# pixel ops, py_func (reference: layers/nn.py warpctc:4324, nce:4950,
+# hsigmoid:5066, sequence_conv:2210, image_resize:7622, pixel_shuffle,
+# py_func)
+# ---------------------------------------------------------------------------
+def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
+            label_length=None):
+    """CTC loss; input [B, T, C] padded logits, label [B, L]."""
+    helper = LayerHelper("warpctc")
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"Logits": [input], "Label": [label]}
+    if input_length is not None:
+        ins["LogitsLength"] = [input_length]
+    if label_length is not None:
+        ins["LabelLength"] = [label_length]
+    helper.append_op(
+        type="warpctc", inputs=ins, outputs={"Loss": [loss]},
+        attrs={"blank": blank, "norm_by_times": norm_by_times},
+    )
+    return loss
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, bias_attr=None, param_attr=None, act=None,
+                  seq_len=None, name=None):
+    """Context-window conv over padded sequences [B, T, D]."""
+    helper = LayerHelper("sequence_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    D = input.shape[-1]
+    w = helper.create_parameter(param_attr, shape=[filter_size * D, num_filters],
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input], "Filter": [w]}
+    if seq_len is not None:
+        ins["SeqLen"] = [seq_len]
+    helper.append_op(
+        type="sequence_conv", inputs=ins, outputs={"Out": [out]},
+        attrs={"contextStart": -int(filter_size // 2), "contextLength": filter_size,
+               "contextStride": filter_stride},
+    )
+    return helper.append_activation(helper.append_bias_op(out, dim_start=2))
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=10, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss -> [B, 1] cost."""
+    helper = LayerHelper("nce", param_attr=param_attr, bias_attr=bias_attr, name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(param_attr, shape=[num_total_classes, dim], dtype=input.dtype)
+    b = helper.create_parameter(bias_attr, shape=[num_total_classes], dtype=input.dtype, is_bias=True)
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"Input": [input], "Label": [label], "Weight": [w]}
+    if b is not None:
+        ins["Bias"] = [b]
+    helper.append_op(
+        type="nce", inputs=ins, outputs={"Cost": [cost]},
+        attrs={"num_neg_samples": num_neg_samples, "seed": seed},
+    )
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    """Hierarchical sigmoid loss over the default complete binary tree."""
+    helper = LayerHelper("hierarchical_sigmoid", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(param_attr, shape=[num_classes - 1, dim], dtype=input.dtype)
+    b = helper.create_parameter(bias_attr, shape=[num_classes - 1], dtype=input.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pre = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input], "Label": [label], "W": [w]}
+    if b is not None:
+        ins["Bias"] = [b]
+    helper.append_op(
+        type="hierarchical_sigmoid", inputs=ins,
+        outputs={"Out": [out], "PreOut": [pre]},
+        attrs={"num_classes": num_classes},
+    )
+    return out
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """One LSTM step: returns (hidden, cell).  x_t [B, D] concatenated
+    with h_prev feeds a 4H projection (reference: layers/nn.py lstm_unit)."""
+    helper = LayerHelper("lstm_unit", param_attr=param_attr, bias_attr=bias_attr, name=name)
+    from paddle_tpu.layers import tensor as ltensor
+
+    H = hidden_t_prev.shape[-1]
+    cat = ltensor.concat([x_t, hidden_t_prev], axis=1)
+    gates = fc(cat, 4 * H, param_attr=param_attr, bias_attr=bias_attr)
+    c = helper.create_variable_for_type_inference(x_t.dtype)
+    h = helper.create_variable_for_type_inference(x_t.dtype)
+    helper.append_op(
+        type="lstm_unit",
+        inputs={"X": [gates], "C_prev": [cell_t_prev]},
+        outputs={"C": [c], "H": [h]},
+        attrs={"forget_bias": forget_bias},
+    )
+    return h, c
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid", origin_mode=False):
+    """One GRU step (reference: layers/nn.py gru_unit).  size = 3*H."""
+    helper = LayerHelper("gru_unit", param_attr=param_attr, bias_attr=bias_attr)
+    H = size // 3
+    w = helper.create_parameter(param_attr, shape=[H, 3 * H], dtype=input.dtype)
+    b = helper.create_parameter(bias_attr, shape=[1, 3 * H], dtype=input.dtype, is_bias=True)
+    gate = helper.create_variable_for_type_inference(input.dtype)
+    reset_h = helper.create_variable_for_type_inference(input.dtype)
+    out_h = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"Input": [input], "HiddenPrev": [hidden], "Weight": [w]}
+    if b is not None:
+        ins["Bias"] = [b]
+    helper.append_op(
+        type="gru_unit", inputs=ins,
+        outputs={"Gate": [gate], "ResetHiddenPrev": [reset_h], "Hidden": [out_h]},
+        attrs={},
+    )
+    return out_h, reset_h, gate
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR", actual_shape=None, align_corners=True,
+                 align_mode=1):
+    """reference: layers/nn.py image_resize — bilinear/nearest."""
+    helper = LayerHelper("image_resize", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    if out_shape is None and scale is None:
+        raise ValueError("image_resize: one of out_shape and scale must be set")
+    attrs = {"align_corners": bool(align_corners)}
+    if out_shape is not None:
+        attrs["out_h"], attrs["out_w"] = int(out_shape[0]), int(out_shape[1])
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    op_type = "bilinear_interp" if resample.upper() == "BILINEAR" else "nearest_interp"
+    helper.append_op(type=op_type, inputs={"X": [input]}, outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None, **kw):
+    return image_resize(input, out_shape, scale, name, "BILINEAR")
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None, **kw):
+    return image_resize(input, out_shape, scale, name, "NEAREST")
+
+
+def pixel_shuffle(x, upscale_factor):
+    helper = LayerHelper("pixel_shuffle")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="pixel_shuffle", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"upscale_factor": upscale_factor})
+    return out
+
+
+def shuffle_channel(x, group):
+    helper = LayerHelper("shuffle_channel")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="shuffle_channel", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"group": group})
+    return out
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    helper = LayerHelper("crop", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    ins = {"X": [x]}
+    attrs = {"offsets": list(offsets or [0] * len(x.shape))}
+    if isinstance(shape, (list, tuple)):
+        attrs["shape"] = list(shape)
+    elif shape is not None:
+        ins["Y"] = [shape]
+    helper.append_op(type="crop", inputs=ins, outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    helper = LayerHelper("pad_constant_like", name=name)
+    out = helper.create_variable_for_type_inference(y.dtype)
+    helper.append_op(type="pad_constant_like", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs={"pad_value": float(pad_value)})
+    return out
+
+
+# py_func escape hatch (reference: operators/py_func_op.cc + layers
+# py_func).  The registry dedupes identical (func, specs) registrations
+# so rebuilding the same program in a loop doesn't grow it; distinct
+# closures (e.g. fresh lambdas per rebuild) are pinned for the process
+# lifetime — reuse a module-level function for long loops.
+_PY_FUNC_REGISTRY = []
+_PY_FUNC_INDEX = {}
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Run a host-python ``func`` inside the compiled step via
+    jax.pure_callback.  ``out`` must be pre-created var(s) with correct
+    shape/dtype (reference contract).  backward_func is not supported —
+    mark inputs stop_gradient or use differentiable ops."""
+    if backward_func is not None:
+        raise NotImplementedError("py_func backward_func: use differentiable ops")
+    helper = LayerHelper("py_func")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    from paddle_tpu.core import types as core_types
+
+    specs = [(tuple(int(s) for s in o.shape), core_types.np_dtype(o.dtype)) for o in outs]
+    dedupe_key = (func, tuple(specs))
+    func_id = _PY_FUNC_INDEX.get(dedupe_key)
+    if func_id is None:
+        _PY_FUNC_REGISTRY.append((func, specs))
+        func_id = len(_PY_FUNC_REGISTRY) - 1
+        _PY_FUNC_INDEX[dedupe_key] = func_id
+    helper.append_op(
+        type="py_func",
+        inputs={"X": [v.name for v in xs]},
+        outputs={"Out": [o.name for o in outs]},
+        attrs={"func_id": func_id},
+    )
+    return outs if isinstance(out, (list, tuple)) else outs[0]
+
+
+def sequence_erase(input, tokens, seq_len=None, name=None):
+    """reference: sequence_erase_op.cc; returns (packed, new_seq_len)."""
+    helper = LayerHelper("sequence_erase", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    new_len = helper.create_variable_for_type_inference("int32")
+    ins = {"X": [input]}
+    if seq_len is not None:
+        ins["SeqLen"] = [seq_len]
+    helper.append_op(type="sequence_erase", inputs=ins,
+                     outputs={"Out": [out], "OutSeqLen": [new_len]},
+                     attrs={"tokens": list(tokens)})
+    return out, new_len
+
+
+def sequence_enumerate(input, win_size, pad_value=0, seq_len=None, name=None):
+    helper = LayerHelper("sequence_enumerate", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input]}
+    if seq_len is not None:
+        ins["SeqLen"] = [seq_len]
+    helper.append_op(type="sequence_enumerate", inputs=ins, outputs={"Out": [out]},
+                     attrs={"win_size": win_size, "pad_value": pad_value})
+    return out
+
+
+def sequence_expand_as(x, y, seq_len=None, name=None):
+    helper = LayerHelper("sequence_expand_as", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="sequence_expand_as", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def sequence_first_step(input, seq_len=None):
+    return sequence_pool(input, "first", seq_len=seq_len)
+
+
+def sequence_last_step(input, seq_len=None):
+    return sequence_pool(input, "last", seq_len=seq_len)

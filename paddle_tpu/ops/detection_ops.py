@@ -18,6 +18,12 @@ from paddle_tpu.core.registry import register_op
 from paddle_tpu.ops.common import maybe, one
 
 
+def _jax():
+    import jax
+
+    return jax
+
+
 def _jnp():
     import jax.numpy as jnp
 
@@ -232,3 +238,225 @@ def multiclass_nms(inputs, attrs):
         return out
 
     return {"Out": jax.vmap(per_image)(bboxes, scores)}
+
+
+@register_op("anchor_generator", differentiable=False)
+def anchor_generator(inputs, attrs):
+    """reference: operators/detection/anchor_generator_op.cc — anchors
+    per feature-map cell from sizes x ratios."""
+    jnp = _jnp()
+    x = one(inputs, "Input")  # [N, C, H, W]
+    sizes = [float(s) for s in attrs.get("anchor_sizes", [64.0])]
+    ratios = [float(r) for r in attrs.get("aspect_ratios", [1.0])]
+    stride = [float(s) for s in attrs.get("stride", [16.0, 16.0])]
+    offset = float(attrs.get("offset", 0.5))
+    variances = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    H, W = x.shape[2], x.shape[3]
+    wh = []
+    for r in ratios:
+        for s in sizes:
+            w = s * np.sqrt(r)
+            h = s / np.sqrt(r)
+            wh.append((w, h))
+    A = len(wh)
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * stride[0]
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * stride[1]
+    cxg, cyg = jnp.meshgrid(cx, cy, indexing="xy")  # [H, W]
+    wh_arr = jnp.asarray(wh, jnp.float32)  # [A, 2]
+    boxes = jnp.stack(
+        [
+            cxg[..., None] - wh_arr[:, 0] / 2,
+            cyg[..., None] - wh_arr[:, 1] / 2,
+            cxg[..., None] + wh_arr[:, 0] / 2,
+            cyg[..., None] + wh_arr[:, 1] / 2,
+        ],
+        axis=-1,
+    )  # [H, W, A, 4]
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), boxes.shape)
+    return {"Anchors": boxes, "Variances": var}
+
+
+@register_op("box_clip", no_grad_set={"ImInfo"})
+def box_clip(inputs, attrs):
+    """reference: operators/detection/box_clip_op.cc — clip boxes to the
+    image (ImInfo rows: [h, w, scale])."""
+    jnp = _jnp()
+    boxes = one(inputs, "Input")  # [N, M, 4] or [M, 4]
+    im = one(inputs, "ImInfo")
+    h = im[..., 0] - 1.0
+    w = im[..., 1] - 1.0
+    if boxes.ndim == 3:
+        h = h.reshape(-1, 1)
+        w = w.reshape(-1, 1)
+    out = jnp.stack(
+        [
+            jnp.clip(boxes[..., 0], 0.0, w),
+            jnp.clip(boxes[..., 1], 0.0, h),
+            jnp.clip(boxes[..., 2], 0.0, w),
+            jnp.clip(boxes[..., 3], 0.0, h),
+        ],
+        axis=-1,
+    )
+    return {"Output": out}
+
+
+@register_op("roi_align", no_grad_set={"ROIs", "RoisNum", "BatchIndex"})
+def roi_align(inputs, attrs):
+    """reference: operators/detection/roi_align_op.cc (ROIAlign,
+    bilinear-sampled pooling).  X [N, C, H, W]; ROIs [R, 4] plus
+    BatchIndex [R] (batch id per roi; defaults to 0)."""
+    jax = _jax()
+    jnp = _jnp()
+    x = one(inputs, "X")
+    rois = one(inputs, "ROIs")
+    bidx = maybe(inputs, "BatchIndex")
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    ratio = int(attrs.get("sampling_ratio", -1))
+    ratio = ratio if ratio > 0 else 2
+    bidx = jnp.zeros((R,), jnp.int32) if bidx is None else bidx.reshape(R).astype(jnp.int32)
+
+    x1 = rois[:, 0] * scale
+    y1 = rois[:, 1] * scale
+    x2 = rois[:, 2] * scale
+    y2 = rois[:, 3] * scale
+    rw = jnp.maximum(x2 - x1, 1.0)
+    rh = jnp.maximum(y2 - y1, 1.0)
+    bin_w = rw / pw
+    bin_h = rh / ph
+
+    # sampling grid: [R, ph*ratio] ys and [R, pw*ratio] xs
+    gy = (jnp.arange(ph * ratio, dtype=jnp.float32) + 0.5) / ratio  # in bin units
+    gx = (jnp.arange(pw * ratio, dtype=jnp.float32) + 0.5) / ratio
+    ys = y1[:, None] + gy[None, :] * bin_h[:, None]  # [R, ph*ratio]
+    xs = x1[:, None] + gx[None, :] * bin_w[:, None]  # [R, pw*ratio]
+
+    def bilinear(img, ys, xs):
+        # img [C, H, W]; ys [hh], xs [ww] -> [C, hh, ww]
+        y0 = jnp.clip(jnp.floor(ys), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(xs), 0, W - 1)
+        y1_ = jnp.clip(y0 + 1, 0, H - 1)
+        x1_ = jnp.clip(x0 + 1, 0, W - 1)
+        wy = jnp.clip(ys, 0, H - 1) - y0
+        wx = jnp.clip(xs, 0, W - 1) - x0
+        y0i, y1i, x0i, x1i = y0.astype(int), y1_.astype(int), x0.astype(int), x1_.astype(int)
+        v00 = img[:, y0i][:, :, x0i]
+        v01 = img[:, y0i][:, :, x1i]
+        v10 = img[:, y1i][:, :, x0i]
+        v11 = img[:, y1i][:, :, x1i]
+        return (
+            v00 * (1 - wy)[None, :, None] * (1 - wx)[None, None, :]
+            + v01 * (1 - wy)[None, :, None] * wx[None, None, :]
+            + v10 * wy[None, :, None] * (1 - wx)[None, None, :]
+            + v11 * wy[None, :, None] * wx[None, None, :]
+        )
+
+    def per_roi(b, ys_r, xs_r):
+        img = x[b]  # [C, H, W]
+        sampled = bilinear(img, ys_r, xs_r)  # [C, ph*ratio, pw*ratio]
+        return sampled.reshape(C, ph, ratio, pw, ratio).mean(axis=(2, 4))
+
+    out = jax.vmap(per_roi)(bidx, ys, xs)  # [R, C, ph, pw]
+    return {"Out": out}
+
+
+@register_op("roi_pool", no_grad_set={"ROIs", "BatchIndex"})
+def roi_pool(inputs, attrs):
+    """reference: operators/roi_pool_op.cc — max pooling inside bins;
+    approximated by a dense 4x-oversampled bilinear grid + max (exact for
+    integer-aligned rois, differentiable everywhere)."""
+    jax = _jax()
+    jnp = _jnp()
+    x = one(inputs, "X")
+    rois = one(inputs, "ROIs")
+    bidx = maybe(inputs, "BatchIndex")
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    ratio = 4
+    bidx = jnp.zeros((R,), jnp.int32) if bidx is None else bidx.reshape(R).astype(jnp.int32)
+    x1 = jnp.round(rois[:, 0] * scale)
+    y1 = jnp.round(rois[:, 1] * scale)
+    x2 = jnp.round(rois[:, 2] * scale)
+    y2 = jnp.round(rois[:, 3] * scale)
+    rw = jnp.maximum(x2 - x1 + 1, 1.0)
+    rh = jnp.maximum(y2 - y1 + 1, 1.0)
+    gy = (jnp.arange(ph * ratio, dtype=jnp.float32) + 0.5) / (ph * ratio)
+    gx = (jnp.arange(pw * ratio, dtype=jnp.float32) + 0.5) / (pw * ratio)
+    ys = y1[:, None] + gy[None, :] * rh[:, None] - 0.5
+    xs = x1[:, None] + gx[None, :] * rw[:, None] - 0.5
+
+    def per_roi(b, ys_r, xs_r):
+        img = x[b]
+        yi = jnp.clip(jnp.round(ys_r), 0, H - 1).astype(int)
+        xi = jnp.clip(jnp.round(xs_r), 0, W - 1).astype(int)
+        sampled = img[:, yi][:, :, xi]  # [C, ph*ratio, pw*ratio]
+        return sampled.reshape(C, ph, ratio, pw, ratio).max(axis=(2, 4))
+
+    out = jax.vmap(per_roi)(bidx, ys, xs)
+    return {"Out": out}
+
+
+@register_op("bipartite_match", differentiable=False)
+def bipartite_match(inputs, attrs):
+    """reference: operators/detection/bipartite_match_op.cc — greedy
+    bipartite matching on a [N, M, P] similarity (M priors to P gt
+    boxes): repeatedly take the global argmax, mark row+col used."""
+    jax = _jax()
+    jnp = _jnp()
+    dist = one(inputs, "DistMat")
+    if dist.ndim == 2:
+        dist = dist[None]
+    N, M, P = dist.shape
+    NEG = -1e9
+
+    def match_one(d):
+        def body(carry, _):
+            d_cur, row_match, row_dist = carry
+            flat = jnp.argmax(d_cur)
+            i, j = flat // P, flat % P
+            val = d_cur[i, j]
+            ok = val > NEG / 2
+            row_match = jnp.where(ok, row_match.at[i].set(j), row_match)
+            row_dist = jnp.where(ok, row_dist.at[i].set(val), row_dist)
+            d_cur = jnp.where(ok, d_cur.at[i, :].set(NEG).at[:, j].set(NEG), d_cur)
+            return (d_cur, row_match, row_dist), None
+
+        init = (d, jnp.full((M,), -1, jnp.int32), jnp.zeros((M,), d.dtype))
+        (d_cur, row_match, row_dist), _ = jax.lax.scan(body, init, None, length=min(M, P))
+        # unmatched rows fall back to per-row argmax if match_type allows
+        if attrs.get("match_type", "bipartite") == "per_prediction":
+            thr = float(attrs.get("dist_threshold", 0.5))
+            col = jnp.argmax(d, axis=1)
+            colv = jnp.max(d, axis=1)
+            fallback = (row_match < 0) & (colv >= thr)
+            row_match = jnp.where(fallback, col, row_match)
+            row_dist = jnp.where(fallback, colv, row_dist)
+        return row_match, row_dist
+
+    matches, dists = jax.vmap(match_one)(dist)
+    return {"ColToRowMatchIndices": matches, "ColToRowMatchDist": dists}
+
+
+@register_op("target_assign", differentiable=False)
+def target_assign(inputs, attrs):
+    """reference: operators/detection/target_assign_op.cc — scatter gt
+    rows to priors by match indices; unmatched get mismatch_value."""
+    jnp = _jnp()
+    x = one(inputs, "X")  # [N, P, K] gt values
+    match = one(inputs, "MatchIndices")  # [N, M]
+    mismatch = attrs.get("mismatch_value", 0)
+    N, M = match.shape
+    safe = jnp.maximum(match, 0)
+    gathered = jnp.take_along_axis(
+        x, safe[..., None].astype(jnp.int32), axis=1
+    )  # [N, M, K]
+    matched = (match >= 0)[..., None]
+    out = jnp.where(matched, gathered, mismatch)
+    weight = matched.astype(jnp.float32)
+    return {"Out": out, "OutWeight": weight}

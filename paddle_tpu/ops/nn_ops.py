@@ -428,3 +428,307 @@ def im2sequence(inputs, attrs):
     )
     n, c, oh, ow = patches.shape
     return {"Out": patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c)}
+
+
+# ---------------------------------------------------------------------------
+# CTC loss (reference: operators/warpctc_op.cc — wraps warp-ctc; here the
+# standard log-space alpha recursion compiles into the step via lax.scan,
+# differentiable through autodiff)
+# ---------------------------------------------------------------------------
+@register_op("warpctc", no_grad_set={"Label", "LogitsLength", "LabelLength"})
+def warpctc(inputs, attrs):
+    """Logits [B, T, C] padded batch-major, Label [B, L] int (padded),
+    LogitsLength/LabelLength [B].  Returns Loss [B, 1] (negative log
+    likelihood; norm_by_times divides by the logit length)."""
+    jax = _jax()
+    jnp = _jnp()
+    from paddle_tpu.ops.common import maybe
+
+    logits = one(inputs, "Logits")
+    label = one(inputs, "Label").astype(jnp.int32)
+    B, T, C = logits.shape
+    L = label.shape[1]
+    logit_len = maybe(inputs, "LogitsLength")
+    label_len = maybe(inputs, "LabelLength")
+    logit_len = (
+        jnp.full((B,), T, jnp.int32) if logit_len is None else logit_len.reshape(B).astype(jnp.int32)
+    )
+    label_len = (
+        jnp.full((B,), L, jnp.int32) if label_len is None else label_len.reshape(B).astype(jnp.int32)
+    )
+    blank = int(attrs.get("blank", 0))
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    S = 2 * L + 1
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(label)
+    prev2 = jnp.concatenate([jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]], axis=1)
+    skip_ok = (ext != blank) & (ext != prev2)
+
+    NEG = -1e30
+    alpha = jnp.full((B, S), NEG, jnp.float32)
+    alpha = alpha.at[:, 0].set(logp[:, 0, blank])
+    if S > 1:
+        first_lbl = jnp.take_along_axis(logp[:, 0, :], ext[:, 1:2], axis=1)[:, 0]
+        alpha = alpha.at[:, 1].set(first_lbl)
+
+    def shift(a, k):
+        return jnp.concatenate([jnp.full((B, k), NEG, jnp.float32), a[:, :-k]], axis=1)
+
+    def step(alpha, t):
+        lp_t = jnp.take_along_axis(logp[:, t, :], ext, axis=1)  # [B, S]
+        m = jnp.logaddexp(alpha, shift(alpha, 1))
+        m = jnp.where(skip_ok, jnp.logaddexp(m, shift(alpha, 2)), m)
+        new = m + lp_t
+        active = (t < logit_len)[:, None]
+        return jnp.where(active, new, alpha), None
+
+    alpha, _ = jax.lax.scan(step, alpha, jnp.arange(1, T))
+    last = (2 * label_len)[:, None]
+    a_last = jnp.take_along_axis(alpha, last, axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(alpha, jnp.maximum(last - 1, 0), axis=1)[:, 0]
+    ll = jnp.where(label_len > 0, jnp.logaddexp(a_last, a_prev), a_last)
+    loss = -ll
+    if attrs.get("norm_by_times", False):
+        loss = loss / jnp.maximum(logit_len.astype(jnp.float32), 1.0)
+    return {"Loss": loss.reshape(B, 1).astype(logits.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# RNN cell units (reference: operators/lstm_unit_op.cc, gru_unit_op.cc)
+# ---------------------------------------------------------------------------
+@register_op("lstm_unit")
+def lstm_unit(inputs, attrs):
+    """X = pre-activation gates [B, 4H] (i, f, c, o packed), C_prev [B, H];
+    returns C [B, H], H (hidden) [B, H]."""
+    jax = _jax()
+    jnp = _jnp()
+    x = one(inputs, "X")
+    c_prev = one(inputs, "C_prev")
+    forget_bias = attrs.get("forget_bias", 0.0)
+    H = c_prev.shape[-1]
+    i, f, c_hat, o = jnp.split(x, 4, axis=-1)
+    c = jax.nn.sigmoid(f + forget_bias) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(c_hat)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return {"C": c, "H": h}
+
+
+@register_op("gru_unit")
+def gru_unit(inputs, attrs):
+    """Input [B, 3H] (update, reset, candidate-input packed),
+    HiddenPrev [B, H], Weight [H, 3H] (reference layout: first 2H for
+    update/reset, last H for candidate), Bias [1, 3H] optional."""
+    jax = _jax()
+    jnp = _jnp()
+    from paddle_tpu.ops.common import maybe
+
+    x = one(inputs, "Input")
+    h_prev = one(inputs, "HiddenPrev")
+    w = one(inputs, "Weight")
+    b = maybe(inputs, "Bias")
+    H = h_prev.shape[-1]
+    if b is not None:
+        x = x + b.reshape(1, 3 * H)
+    xu, xr, xc = x[:, :H], x[:, H : 2 * H], x[:, 2 * H :]
+    wu, wr = w[:, :H], w[:, H : 2 * H]
+    wc = w[:, 2 * H :]
+    u = jax.nn.sigmoid(xu + h_prev @ wu)
+    r = jax.nn.sigmoid(xr + h_prev @ wr)
+    c = jnp.tanh(xc + (r * h_prev) @ wc)
+    h = u * h_prev + (1.0 - u) * c
+    return {"Gate": jnp.concatenate([u, r, c], axis=-1), "ResetHiddenPrev": r * h_prev, "Hidden": h}
+
+
+# ---------------------------------------------------------------------------
+# sequence_conv (reference: operators/sequence_ops/sequence_conv_op.cc) —
+# context-window conv over padded sequences
+# ---------------------------------------------------------------------------
+@register_op("sequence_conv", no_grad_set={"SeqLen"})
+def sequence_conv(inputs, attrs):
+    """X [B, T, D] padded, Filter [ctx_len*D, F]; out [B, T, F].  Rows
+    outside a sequence contribute zeros (LoD boundary semantics)."""
+    jnp = _jnp()
+    from paddle_tpu.ops.common import maybe
+
+    x = one(inputs, "X")
+    w = one(inputs, "Filter")
+    seq_len = maybe(inputs, "SeqLen")
+    ctx_start = int(attrs.get("contextStart", attrs.get("context_start", -1)))
+    ctx_len = int(attrs.get("contextLength", attrs.get("context_length", 3)))
+    B, T, D = x.shape
+    if seq_len is not None:
+        t_idx = jnp.arange(T)[None, :, None]
+        x = jnp.where(t_idx < seq_len.reshape(B, 1, 1), x, 0.0)
+    cols = []
+    for j in range(ctx_start, ctx_start + ctx_len):
+        if j < 0:
+            shifted = jnp.pad(x, ((0, 0), (-j, 0), (0, 0)))[:, :T]
+        elif j > 0:
+            shifted = jnp.pad(x, ((0, 0), (0, j), (0, 0)))[:, j:]
+        else:
+            shifted = x
+        cols.append(shifted)
+    ctx = jnp.concatenate(cols, axis=-1)  # [B, T, ctx_len*D]
+    out = ctx @ w
+    if seq_len is not None:
+        out = jnp.where(t_idx < seq_len.reshape(B, 1, 1), out, 0.0)
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# NCE (reference: operators/nce_op.cc) — noise-contrastive estimation with
+# a uniform sampler compiled into the step
+# ---------------------------------------------------------------------------
+@register_op("nce", no_grad_set={"Label"})
+def nce(inputs, attrs):
+    """Input [B, D], Label [B, 1], Weight [V, D], Bias [V] optional.
+    Uniform negative sampler (num_neg_samples), logistic NCE loss with
+    the log(k*P) correction.  Cost [B, 1]."""
+    jax = _jax()
+    jnp = _jnp()
+    from paddle_tpu.ops.common import maybe, prng
+
+    x = one(inputs, "Input")
+    label = one(inputs, "Label").reshape(-1).astype(jnp.int32)
+    w = one(inputs, "Weight")
+    b = maybe(inputs, "Bias")
+    V = w.shape[0]
+    k = int(attrs.get("num_neg_samples", 10))
+    # fresh negatives per distinct batch: fold the labels into the key
+    # (a constant key would reuse the same k negatives forever; identical
+    # repeated batches still get identical draws — deterministic)
+    key = jax.random.fold_in(
+        prng(int(attrs.get("seed", 0))), jnp.sum(label).astype(jnp.uint32)
+    )
+    neg = jax.random.randint(key, (k,), 0, V)  # shared negatives per batch
+    log_kp = jnp.log(k / V)  # uniform sampler: log(k * P(w)), P = 1/V
+
+    true_logit = jnp.sum(x * w[label], axis=-1)
+    neg_logit = x @ w[neg].T  # [B, k]
+    if b is not None:
+        true_logit = true_logit + b.reshape(-1)[label]
+        neg_logit = neg_logit + b.reshape(-1)[neg][None, :]
+    pos_cost = jax.nn.softplus(-(true_logit - log_kp))
+    neg_cost = jnp.sum(jax.nn.softplus(neg_logit - log_kp), axis=-1)
+    cost = pos_cost + neg_cost
+    return {"Cost": cost.reshape(-1, 1)}
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical sigmoid (reference: operators/hierarchical_sigmoid_op.cc)
+# over the default complete binary tree
+# ---------------------------------------------------------------------------
+@register_op("hierarchical_sigmoid", no_grad_set={"Label"})
+def hierarchical_sigmoid(inputs, attrs):
+    """X [B, D], Label [B, 1], W [num_classes-1, D], Bias [num_classes-1]
+    optional.  Complete-binary-tree paths like the reference's default
+    (heap indexing: leaf code = label + num_classes; internal node id =
+    code//2 - 1 at each level)."""
+    jax = _jax()
+    jnp = _jnp()
+    from paddle_tpu.ops.common import maybe
+
+    x = one(inputs, "X")
+    label = one(inputs, "Label").reshape(-1).astype(jnp.int32)
+    w = one(inputs, "W")
+    b = maybe(inputs, "Bias")
+    K = int(attrs["num_classes"])
+    depth = max(1, int(np.ceil(np.log2(K))) + 1)
+
+    code = label + K  # heap leaf code
+    total = jnp.zeros(x.shape[0], jnp.float32)
+    for _ in range(depth):
+        valid = code > 1
+        node = jnp.maximum(code // 2 - 1, 0)
+        bit = (code % 2).astype(jnp.float32)  # 1 = right child
+        logit = jnp.sum(x * w[node], axis=-1)
+        if b is not None:
+            logit = logit + b.reshape(-1)[node]
+        # p(bit) = sigmoid(logit) for bit 1 else sigmoid(-logit)
+        sign = 2.0 * bit - 1.0
+        total = total + jnp.where(valid, jax.nn.softplus(-sign * logit), 0.0)
+        code = code // 2
+    return {"Out": total.reshape(-1, 1), "PreOut": total.reshape(-1, 1)}
+
+
+# ---------------------------------------------------------------------------
+# Image resize (reference: operators/interpolate_op.cc bilinear_interp /
+# nearest_interp) and pixel reorganization ops
+# ---------------------------------------------------------------------------
+def _interp(inputs, attrs, method):
+    jax = _jax()
+    jnp = _jnp()
+    from paddle_tpu.ops.common import maybe
+
+    x = one(inputs, "X")  # NCHW
+    out_size = maybe(inputs, "OutSize")
+    if out_size is not None:
+        raise NotImplementedError("dynamic OutSize tensor; pass out_h/out_w attrs")
+    out_h = int(attrs.get("out_h", 0))
+    out_w = int(attrs.get("out_w", 0))
+    scale = attrs.get("scale", 0)
+    n, c, h, w = x.shape
+    if out_h <= 0 or out_w <= 0:
+        if not scale:
+            raise ValueError("interpolate needs out_h/out_w or scale")
+        out_h, out_w = int(h * scale), int(w * scale)
+    if attrs.get("align_corners", True) and out_h > 1 and out_w > 1:
+        # fluid default: corners map to corners — src = dst*(in-1)/(out-1)
+        ys = jnp.arange(out_h, dtype=jnp.float32) * ((h - 1) / max(out_h - 1, 1))
+        xs = jnp.arange(out_w, dtype=jnp.float32) * ((w - 1) / max(out_w - 1, 1))
+        if method == "nearest":
+            yi = jnp.round(ys).astype(int)
+            xi = jnp.round(xs).astype(int)
+            out = x[:, :, yi][:, :, :, xi]
+        else:
+            y0 = jnp.clip(jnp.floor(ys).astype(int), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xs).astype(int), 0, w - 1)
+            y1 = jnp.clip(y0 + 1, 0, h - 1)
+            x1 = jnp.clip(x0 + 1, 0, w - 1)
+            wy = (ys - y0).reshape(1, 1, -1, 1)
+            wx = (xs - x0).reshape(1, 1, 1, -1)
+            v00 = x[:, :, y0][:, :, :, x0]
+            v01 = x[:, :, y0][:, :, :, x1]
+            v10 = x[:, :, y1][:, :, :, x0]
+            v11 = x[:, :, y1][:, :, :, x1]
+            out = (
+                v00 * (1 - wy) * (1 - wx)
+                + v01 * (1 - wy) * wx
+                + v10 * wy * (1 - wx)
+                + v11 * wy * wx
+            )
+    else:
+        out = jax.image.resize(x, (n, c, out_h, out_w), method=method)
+    return {"Out": out.astype(x.dtype)}
+
+
+@register_op("bilinear_interp")
+def bilinear_interp(inputs, attrs):
+    return _interp(inputs, attrs, "bilinear")
+
+
+@register_op("nearest_interp")
+def nearest_interp(inputs, attrs):
+    return _interp(inputs, attrs, "nearest")
+
+
+@register_op("pixel_shuffle")
+def pixel_shuffle(inputs, attrs):
+    """reference: operators/pixel_shuffle_op.cc — [N, C*r^2, H, W] ->
+    [N, C, H*r, W*r]."""
+    x = one(inputs, "X")
+    r = int(attrs.get("upscale_factor", 1))
+    n, c, h, w = x.shape
+    oc = c // (r * r)
+    out = x.reshape(n, oc, r, r, h, w).transpose(0, 1, 4, 2, 5, 3).reshape(n, oc, h * r, w * r)
+    return {"Out": out}
+
+
+@register_op("shuffle_channel")
+def shuffle_channel(inputs, attrs):
+    """reference: operators/shuffle_channel_op.cc."""
+    x = one(inputs, "X")
+    g = int(attrs.get("group", 1))
+    n, c, h, w = x.shape
+    out = x.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+    return {"Out": out}

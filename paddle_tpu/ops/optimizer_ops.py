@@ -302,11 +302,16 @@ def dgc_momentum(inputs, attrs):
     mask = jnp.abs(v_new) >= kth
     sparse_grad = jnp.where(mask, v_new, 0.0)
 
-    from paddle_tpu.parallel import env as penv
+    # Sparse allreduce happens here ONLY when a DGC-aware transpiler set
+    # use_collective (grads arrive LOCAL).  Under the standard
+    # GradAllReduce rewrite grads are already averaged before optimizer
+    # ops, so psum-ing again would scale the update by nranks.
+    if attrs.get("use_collective", False):
+        from paddle_tpu.parallel import env as penv
 
-    ax = attrs.get("axis_name") or penv.axis_for_ring(attrs.get("ring_id", 0))
-    if penv.axis_active(ax):
-        sparse_grad = jax.lax.psum(sparse_grad, axis_name=ax)
+        ax = attrs.get("axis_name") or penv.axis_for_ring(attrs.get("ring_id", 0))
+        if penv.axis_active(ax):
+            sparse_grad = jax.lax.psum(sparse_grad, axis_name=ax)
 
     # before rampup_begin_step the reference runs plain (dense) momentum
     # with u as the velocity and leaves the DGC accumulators alone; note

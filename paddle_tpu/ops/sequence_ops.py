@@ -137,3 +137,59 @@ def sequence_slice(inputs, attrs):
     # dense view: slice along time with static offsets is handled by slice op;
     # here pass-through with masking is the parity behavior
     return {"Out": one(inputs, "X")}
+
+
+@register_op("sequence_erase", no_grad_set={"SeqLen"}, differentiable=False)
+def sequence_erase(inputs, attrs):
+    """Remove listed tokens and repack left (reference:
+    operators/sequence_ops/sequence_erase_op.cc).  X [B, T] int padded,
+    returns Out [B, T] (packed, zero-padded) + OutSeqLen [B]."""
+    jnp = _jnp()
+    x = one(inputs, "X")
+    seq_len = maybe(inputs, "SeqLen")
+    tokens = attrs.get("tokens", [])
+    B, T = x.shape[0], x.shape[1]
+    t_idx = jnp.arange(T)[None, :]
+    valid = t_idx < (seq_len.reshape(-1, 1) if seq_len is not None else T)
+    erase = jnp.zeros_like(x, dtype=bool)
+    for tok in tokens:
+        erase = erase | (x == tok)
+    keep = valid & ~erase
+    # stable repack: sort positions by (dropped, index)
+    order = jnp.argsort(jnp.where(keep, t_idx, T + t_idx), axis=1)
+    packed = jnp.take_along_axis(x, order, axis=1)
+    new_len = keep.sum(axis=1)
+    packed = jnp.where(jnp.arange(T)[None, :] < new_len[:, None], packed, 0)
+    return {"Out": packed, "OutSeqLen": new_len.astype(jnp.int32)}
+
+
+@register_op("sequence_enumerate", no_grad_set={"SeqLen"}, differentiable=False)
+def sequence_enumerate(inputs, attrs):
+    """Sliding windows of ids (reference: sequence_enumerate_op.cc).
+    X [B, T] -> Out [B, T, win_size], positions past the end filled with
+    pad_value."""
+    jnp = _jnp()
+    x = one(inputs, "X")
+    seq_len = maybe(inputs, "SeqLen")
+    win = int(attrs.get("win_size", 2))
+    pad = attrs.get("pad_value", 0)
+    B, T = x.shape
+    cols = []
+    length = seq_len.reshape(-1, 1) if seq_len is not None else jnp.full((B, 1), T)
+    t_idx = jnp.arange(T)[None, :]
+    for j in range(win):
+        shifted = jnp.pad(x, ((0, 0), (0, j)), constant_values=pad)[:, j : j + T]
+        shifted = jnp.where(t_idx + j < length, shifted, pad)
+        cols.append(shifted)
+    return {"Out": jnp.stack(cols, axis=-1)}
+
+
+@register_op("sequence_expand_as", no_grad_set={"Y", "SeqLen"})
+def sequence_expand_as(inputs, attrs):
+    """Expand each row of X to match Y's time dim (reference:
+    sequence_expand_as_op.cc on the padded encoding: broadcast rows)."""
+    jnp = _jnp()
+    x = one(inputs, "X")
+    y = one(inputs, "Y")
+    T = y.shape[1]
+    return {"Out": jnp.broadcast_to(x[:, None, ...], (x.shape[0], T) + tuple(x.shape[1:]))}

@@ -21,6 +21,12 @@ def _jnp():
     return jnp
 
 
+def _jax():
+    import jax
+
+    return jax
+
+
 def _shape_from(inputs, attrs):
     shape = attrs.get("shape")
     st = inputs.get("ShapeTensor")
@@ -475,3 +481,114 @@ def distributed_lookup_table(inputs, attrs):
         mask = (orig != padding_idx)[..., None]
         out = out * mask.astype(out.dtype)
     return {"Out": out}
+
+
+@register_op("crop", no_grad_set={"Offsets"})
+def crop(inputs, attrs):
+    """reference: operators/crop_op.cc — static offsets/shape attrs."""
+    jax = _jax()
+    from paddle_tpu.ops.common import maybe
+
+    x = one(inputs, "X")
+    offs = attrs.get("offsets") or [0] * x.ndim
+    y = maybe(inputs, "Y")
+    shape = list(y.shape) if y is not None else list(attrs.get("shape"))
+    return {"Out": jax.lax.dynamic_slice(x, [int(o) for o in offs], [int(s) for s in shape])}
+
+
+@register_op("crop_tensor", no_grad_set={"Shape", "Offsets"})
+def crop_tensor(inputs, attrs):
+    return crop(inputs, attrs)
+
+
+@register_op("pad_constant_like", no_grad_set={"X"})
+def pad_constant_like(inputs, attrs):
+    """reference: operators/pad_constant_like_op.cc — pad Y up to X's
+    shape with pad_value."""
+    jnp = _jnp()
+    x = one(inputs, "X")
+    y = one(inputs, "Y")
+    val = attrs.get("pad_value", 0.0)
+    pads = [(0, int(sx - sy)) for sx, sy in zip(x.shape, y.shape)]
+    return {"Out": jnp.pad(y, pads, constant_values=val)}
+
+
+@register_op("linspace", differentiable=False)
+def linspace(inputs, attrs):
+    jnp = _jnp()
+    from paddle_tpu.core import types as core_types
+
+    start = one(inputs, "Start").reshape(())
+    stop = one(inputs, "Stop").reshape(())
+    num = int(np.asarray(one(inputs, "Num")).reshape(()))
+    dtype = core_types.np_dtype(attrs.get("dtype", "float32"))
+    return {"Out": jnp.linspace(start, stop, num).astype(dtype)}
+
+
+@register_op("meshgrid")
+def meshgrid(inputs, attrs):
+    jnp = _jnp()
+    xs = inputs["X"]
+    outs = jnp.meshgrid(*xs, indexing="ij")
+    return {"Out": list(outs)}
+
+
+@register_op("roll")
+def roll(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "X")
+    shifts = attrs.get("shifts", [0])
+    dims = attrs.get("axis", attrs.get("dims", None))
+    if dims is None:
+        return {"Out": jnp.roll(x.reshape(-1), shifts[0]).reshape(x.shape)}
+    return {"Out": jnp.roll(x, shifts, axis=tuple(dims))}
+
+
+@register_op("sampling_id", differentiable=False)
+def sampling_id(inputs, attrs):
+    """reference: operators/sampling_id_op.cc — sample one id per row of
+    a probability matrix."""
+    jax = _jax()
+    from paddle_tpu.ops.common import prng
+
+    x = one(inputs, "X")
+    key = prng(int(attrs.get("seed", 0)))
+    ids = jax.random.categorical(key, jax.numpy.log(jax.numpy.maximum(x, 1e-20)), axis=-1)
+    return {"Out": ids.astype("int64")}
+
+
+@register_op("py_func", differentiable=False)
+def py_func(inputs, attrs):
+    """Host-python escape hatch (reference: operators/py_func_op.cc).
+    The callable is registered host-side (layers/nn.py py_func) and runs
+    via jax.pure_callback — executes on the host CPU at the op's
+    position in the compiled step."""
+    import jax
+
+    from paddle_tpu.layers import nn as nn_layers
+
+    fn, out_specs = nn_layers._PY_FUNC_REGISTRY[int(attrs["func_id"])]
+    xs = inputs.get("X", [])
+    # resolve declared -1 dims from the first input's actual shape
+    # (batch-dim convention; py_func outs must otherwise be static)
+    ref_shape = tuple(xs[0].shape) if xs else ()
+    result_shapes = []
+    for s, d in out_specs:
+        shape = tuple(
+            ref_shape[i] if dim < 0 and i < len(ref_shape) else dim
+            for i, dim in enumerate(s)
+        )
+        if any(dim < 0 for dim in shape):
+            raise ValueError("py_func output shape %r is not static" % (s,))
+        result_shapes.append(jax.ShapeDtypeStruct(shape, d))
+
+    def host_fn(*arrays):
+        out = fn(*arrays)
+        if not isinstance(out, (list, tuple)):
+            out = (out,)
+        return tuple(np.asarray(o) for o in out)
+
+    outs = jax.pure_callback(host_fn, result_shapes, *xs)
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    return {"Out": list(outs)}

@@ -1,0 +1,316 @@
+"""Round-2 breadth ops: CTC, sequence_conv/erase/enumerate, cell units,
+NCE, hsigmoid, resize, pixel ops, crop/pad, roi ops, bipartite match,
+py_func (reference: tests/unittests/test_{warpctc,sequence_conv,nce,
+hsigmoid,bilinear_interp,pixel_shuffle,crop,roi_align,bipartite_match,
+py_func}_op.py style)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import framework
+from tests.op_test import OpTest
+
+
+def _ref_ctc_loss(logits, labels, blank=0):
+    """Brute-force CTC via alpha recursion in prob space (single seq)."""
+    T, C = logits.shape
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    ext = [blank]
+    for l in labels:
+        ext += [int(l), blank]
+    S = len(ext)
+    alpha = np.zeros((T, S))
+    alpha[0, 0] = probs[0, blank]
+    if S > 1:
+        alpha[0, 1] = probs[0, ext[1]]
+    for t in range(1, T):
+        for s in range(S):
+            a = alpha[t - 1, s]
+            if s >= 1:
+                a += alpha[t - 1, s - 1]
+            if s >= 2 and ext[s] != blank and ext[s] != ext[s - 2]:
+                a += alpha[t - 1, s - 2]
+            alpha[t, s] = a * probs[t, ext[s]]
+    return -np.log(alpha[T - 1, S - 1] + alpha[T - 1, S - 2])
+
+
+class TestWarpCTCOp(OpTest):
+    op_type = "warpctc"
+    atol = 1e-4
+
+    def test_output_and_grad(self):
+        rng = np.random.RandomState(0)
+        B, T, C, L = 3, 6, 5, 2
+        logits = rng.randn(B, T, C).astype("float32")
+        labels = rng.randint(1, C, (B, L)).astype("int64")
+        expect = np.stack(
+            [_ref_ctc_loss(logits[b], labels[b]) for b in range(B)]
+        ).reshape(B, 1).astype("float32")
+        self.inputs = {"Logits": logits, "Label": labels}
+        self.attrs = {"blank": 0}
+        self.outputs = {"Loss": expect}
+        self.check_output()
+        self.check_grad(["Logits"], "Loss")
+
+
+class TestSequenceConvOp(OpTest):
+    op_type = "sequence_conv"
+
+    def test_output_and_grad(self):
+        rng = np.random.RandomState(1)
+        B, T, D, F = 2, 5, 3, 4
+        x = rng.randn(B, T, D).astype("float32")
+        w = rng.randn(3 * D, F).astype("float32")
+        lens = np.array([5, 3], "int32")
+        mask = (np.arange(T)[None, :] < lens[:, None])[..., None]
+        xm = np.where(mask, x, 0.0)
+        ctx = np.concatenate(
+            [
+                np.pad(xm, ((0, 0), (1, 0), (0, 0)))[:, :T],
+                xm,
+                np.pad(xm, ((0, 0), (0, 1), (0, 0)))[:, 1:],
+            ],
+            axis=-1,
+        )
+        expect = np.where(mask, ctx @ w, 0.0).astype("float32")
+        self.inputs = {"X": x, "Filter": [("filt", w)], "SeqLen": lens}
+        self.attrs = {"contextStart": -1, "contextLength": 3}
+        self.outputs = {"Out": expect}
+        self.check_output()
+        self.check_grad(["X", "Filter"], "Out")
+
+
+class TestLstmUnitOp(OpTest):
+    op_type = "lstm_unit"
+
+    def test_output_and_grad(self):
+        rng = np.random.RandomState(2)
+        B, H = 4, 3
+        x = rng.randn(B, 4 * H).astype("float32")
+        c_prev = rng.randn(B, H).astype("float32")
+
+        def sig(v):
+            return 1.0 / (1.0 + np.exp(-v))
+
+        i, f, c_hat, o = np.split(x, 4, axis=-1)
+        c = sig(f) * c_prev + sig(i) * np.tanh(c_hat)
+        h = sig(o) * np.tanh(c)
+        self.inputs = {"X": x, "C_prev": c_prev}
+        self.outputs = {"C": c.astype("float32"), "H": h.astype("float32")}
+        self.check_output()
+        self.check_grad(["X", "C_prev"], "H")
+
+
+class TestGruUnitOp(OpTest):
+    op_type = "gru_unit"
+
+    def test_output(self):
+        rng = np.random.RandomState(3)
+        B, H = 4, 3
+        x = rng.randn(B, 3 * H).astype("float32")
+        h_prev = rng.randn(B, H).astype("float32")
+        w = rng.randn(H, 3 * H).astype("float32")
+
+        def sig(v):
+            return 1.0 / (1.0 + np.exp(-v))
+
+        u = sig(x[:, :H] + h_prev @ w[:, :H])
+        r = sig(x[:, H:2*H] + h_prev @ w[:, H:2*H])
+        c = np.tanh(x[:, 2*H:] + (r * h_prev) @ w[:, 2*H:])
+        h = u * h_prev + (1 - u) * c
+        self.inputs = {"Input": x, "HiddenPrev": h_prev, "Weight": w}
+        self.outputs = {
+            "Gate": np.concatenate([u, r, c], -1).astype("float32"),
+            "ResetHiddenPrev": (r * h_prev).astype("float32"),
+            "Hidden": h.astype("float32"),
+        }
+        self.check_output()
+
+
+class TestBilinearInterpOp(OpTest):
+    op_type = "bilinear_interp"
+    atol = 1e-4
+
+    def test_output(self):
+        import jax
+
+        rng = np.random.RandomState(4)
+        x = rng.randn(1, 2, 4, 4).astype("float32")
+        # half-pixel mode matches jax.image.resize
+        expect = np.asarray(jax.image.resize(x, (1, 2, 8, 8), "bilinear"))
+        self.inputs = {"X": x}
+        self.attrs = {"out_h": 8, "out_w": 8, "align_corners": False}
+        self.outputs = {"Out": expect}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+    def test_align_corners(self):
+        # fluid default align_corners=True: corners map exactly, and a
+        # linear ramp resamples to a linear ramp
+        x = np.arange(4, dtype="float32").reshape(1, 1, 1, 4).repeat(2, axis=2)
+        expect = np.linspace(0.0, 3.0, 7, dtype="float32").reshape(1, 1, 1, 7)
+        # out_h=1 keeps align path off for h; use 2 rows -> 3 rows ramp too
+        x2 = np.arange(4, dtype="float32").reshape(1, 1, 1, 4)
+        x2 = np.concatenate([x2, x2 + 3.0], axis=2)  # [1,1,2,4]
+        ys = np.linspace(0.0, 3.0, 7, dtype="float32")
+        expect2 = np.stack([ys, ys + 1.5, ys + 3.0]).reshape(1, 1, 3, 7)
+        self.inputs = {"X": x2.astype("float32")}
+        self.attrs = {"out_h": 3, "out_w": 7, "align_corners": True}
+        self.outputs = {"Out": expect2}
+        self.check_output()
+
+
+class TestPixelShuffleOp(OpTest):
+    op_type = "pixel_shuffle"
+
+    def test_output(self):
+        rng = np.random.RandomState(5)
+        x = rng.randn(2, 8, 3, 3).astype("float32")
+        n, c, h, w = x.shape
+        r = 2
+        expect = (
+            x.reshape(n, c // 4, r, r, h, w)
+            .transpose(0, 1, 4, 2, 5, 3)
+            .reshape(n, c // 4, h * r, w * r)
+        )
+        self.inputs = {"X": x}
+        self.attrs = {"upscale_factor": r}
+        self.outputs = {"Out": expect}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestCropOp(OpTest):
+    op_type = "crop"
+
+    def test_output(self):
+        x = np.arange(24, dtype="float32").reshape(4, 6)
+        self.inputs = {"X": x}
+        self.attrs = {"offsets": [1, 2], "shape": [2, 3]}
+        self.outputs = {"Out": x[1:3, 2:5]}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestPadConstantLikeOp(OpTest):
+    op_type = "pad_constant_like"
+
+    def test_output(self):
+        x = np.zeros((4, 5), "float32")
+        y = np.ones((2, 3), "float32")
+        expect = np.pad(y, ((0, 2), (0, 2)), constant_values=7.0)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"pad_value": 7.0}
+        self.outputs = {"Out": expect}
+        self.check_output()
+        self.check_grad(["Y"], "Out")
+
+
+class TestRoiAlignOp(OpTest):
+    op_type = "roi_align"
+    atol = 1e-4
+
+    def test_constant_map(self):
+        # constant feature map -> every pooled cell equals the constant
+        x = np.full((1, 2, 8, 8), 3.5, "float32")
+        rois = np.array([[0.0, 0.0, 7.0, 7.0], [2.0, 2.0, 6.0, 6.0]], "float32")
+        expect = np.full((2, 2, 2, 2), 3.5, "float32")
+        self.inputs = {"X": x, "ROIs": rois}
+        self.attrs = {"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0}
+        self.outputs = {"Out": expect}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestBipartiteMatchOp(OpTest):
+    op_type = "bipartite_match"
+
+    def test_greedy_match(self):
+        dist = np.array(
+            [[[0.1, 0.9], [0.8, 0.2], [0.3, 0.3]]], "float32"
+        )  # [1, 3 rows, 2 cols]
+        # greedy: global max 0.9 -> row0<-col1; next 0.8 -> row1<-col0
+        expect_idx = np.array([[1, 0, -1]], "int32")
+        expect_dist = np.array([[0.9, 0.8, 0.0]], "float32")
+        self.inputs = {"DistMat": dist}
+        self.outputs = {
+            "ColToRowMatchIndices": expect_idx,
+            "ColToRowMatchDist": expect_dist,
+        }
+        self.check_output()
+
+
+def test_nce_and_hsigmoid_train():
+    """NCE and hierarchical sigmoid train a small classifier (loss
+    decreases) — the reference's usage-level guarantee."""
+    for kind in ("nce", "hsigmoid"):
+        prog, startup = framework.Program(), framework.Program()
+        prog.random_seed = startup.random_seed = 71
+        with framework.program_guard(prog, startup):
+            x = fluid.layers.data("x", [8])
+            y = fluid.layers.data("y", [1], dtype="int64")
+            h = fluid.layers.fc(x, 16, act="tanh")
+            if kind == "nce":
+                cost = fluid.layers.nce(h, y, num_total_classes=20, num_neg_samples=5)
+            else:
+                cost = fluid.layers.hsigmoid(h, y, num_classes=20)
+            loss = fluid.layers.mean(cost)
+            fluid.optimizer.AdamOptimizer(0.05).minimize(loss)
+        rng = np.random.RandomState(0)
+        xb = rng.randn(32, 8).astype("float32")
+        yb = (np.abs(xb.sum(1)) * 3 % 20).astype("int64").reshape(-1, 1)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            losses = [
+                float(np.asarray(exe.run(prog, feed={"x": xb, "y": yb}, fetch_list=[loss])[0]))
+                for _ in range(30)
+            ]
+        assert losses[-1] < losses[0] * 0.7, (kind, losses[0], losses[-1])
+
+
+def test_py_func_host_callback():
+    """py_func escape hatch: host numpy runs inside the compiled step."""
+    prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [4])
+        block = prog.global_block()
+        out_var = block.create_var(name="pyf_out", shape=[-1, 4], dtype="float32")
+
+        def double_plus_one(a):
+            return (a * 2 + 1).astype(np.float32)
+
+        out = fluid.layers.py_func(double_plus_one, x, out_var)
+        total = fluid.layers.reduce_sum(out)
+    xb = np.arange(8, dtype="float32").reshape(2, 4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (o, t) = exe.run(prog, feed={"x": xb}, fetch_list=[out, total])
+    np.testing.assert_allclose(np.asarray(o), xb * 2 + 1)
+    np.testing.assert_allclose(float(np.asarray(t)), float((xb * 2 + 1).sum()))
+
+
+def test_sequence_erase_and_enumerate():
+    x = np.array([[3, 1, 4, 1, 5], [2, 6, 0, 0, 0]], "int64")
+    lens = np.array([5, 2], "int32")
+    prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(prog, startup):
+        xin = fluid.layers.data("x", [5], dtype="int64")
+        sl = fluid.layers.data("sl", [1], dtype="int32")
+        sl2 = fluid.layers.reshape(sl, [-1])
+        packed, new_len = fluid.layers.sequence_erase(xin, [1], seq_len=sl2)
+        windows = fluid.layers.sequence_enumerate(xin, 2, pad_value=0, seq_len=sl2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        p, nl, wnd = exe.run(
+            prog, feed={"x": x, "sl": lens.reshape(-1, 1)},
+            fetch_list=[packed, new_len, windows],
+        )
+    np.testing.assert_array_equal(np.asarray(p), [[3, 4, 5, 0, 0], [2, 6, 0, 0, 0]])
+    np.testing.assert_array_equal(np.asarray(nl), [3, 2])
+    # windows for row 1 (len 2): [2,6], [6,0(pad)] then zeros
+    np.testing.assert_array_equal(np.asarray(wnd)[1, 0], [2, 6])
+    np.testing.assert_array_equal(np.asarray(wnd)[1, 1], [6, 0])
