@@ -27,7 +27,7 @@ from paddle_tpu.framework import (
     name_scope,
     program_guard,
 )
-from paddle_tpu.executor import Executor
+from paddle_tpu.executor import AsyncExecutor, Executor
 from paddle_tpu.scope import Scope, global_scope, scope_guard
 
 from paddle_tpu import (
@@ -52,6 +52,9 @@ from paddle_tpu import inference
 from paddle_tpu import native
 from paddle_tpu.fluid_dataset import DatasetFactory, InMemoryDataset, QueueDataset
 from paddle_tpu import profiler
+from paddle_tpu import memory
+from paddle_tpu import io_fs
+from paddle_tpu import incubate
 from paddle_tpu import io
 from paddle_tpu import reader
 from paddle_tpu import dataset

@@ -1,5 +1,6 @@
 """Model compression (reference: python/paddle/fluid/contrib/slim/ —
-quantization QAT passes, distillation, pruning, NAS).  Round-1 surface:
-quantization-aware training rewrite; the rest of slim is tracked in
-SURVEY.md §2.9 as open parity items."""
-from paddle_tpu.contrib.slim import quantization  # noqa: F401
+quantization QAT passes, distillation, pruning, NAS).  Surface:
+quantization-aware training rewrite, magnitude pruning with in-graph
+masks, distillation losses + program merge.  NAS (simulated annealing
+searcher) remains an open parity item."""
+from paddle_tpu.contrib.slim import distillation, prune, quantization  # noqa: F401

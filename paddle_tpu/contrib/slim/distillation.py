@@ -1,0 +1,92 @@
+"""Knowledge distillation losses (reference: python/paddle/fluid/contrib/
+slim/distillation/distillation_strategy.py + distiller.py — FSP / L2 /
+soft-label losses merged into the student program).
+
+Here the losses are layer functions over (teacher_var, student_var)
+pairs — compose them into the student's loss; the whole
+teacher+student+loss graph compiles into one XLA module, so the teacher
+forward rides the same step (the reference merges graphs the same way).
+"""
+from __future__ import annotations
+
+__all__ = ["soft_label_loss", "l2_loss", "fsp_loss", "merge"]
+
+
+def merge(teacher_program, student_program, data_name_map=None, place=None,
+          scope=None, name_prefix="teacher_"):
+    """Append the teacher program's ops into the student program with
+    prefixed var names (reference: distillation merge).  Returns the
+    mapping of teacher var -> merged var name."""
+    from paddle_tpu import framework
+
+    data_name_map = data_name_map or {}
+    sblock = student_program.global_block()
+    tblock = teacher_program.global_block()
+    rename = {}
+    for var in tblock.vars.values():
+        if var.name in data_name_map:
+            rename[var.name] = data_name_map[var.name]
+            continue
+        new_name = name_prefix + var.name
+        rename[var.name] = new_name
+        if not sblock.has_var(new_name):
+            sblock.create_var(
+                name=new_name, shape=var.shape, dtype=var.dtype,
+                persistable=var.persistable, stop_gradient=True,
+            )
+    for op in tblock.ops:
+        inputs = {s: [rename.get(n, n) for n in ns] for s, ns in op.inputs.items()}
+        outputs = {s: [rename.get(n, n) for n in ns] for s, ns in op.outputs.items()}
+        sblock.append_op(type=op.type, inputs=inputs, outputs=outputs, attrs=dict(op.attrs))
+    student_program.version += 1
+    if scope is not None:
+        # copy already-initialized teacher values to their merged names
+        # (run the teacher startup into this scope first)
+        import jax.numpy as jnp
+
+        for var in tblock.vars.values():
+            if not var.persistable or var.name in data_name_map:
+                continue
+            val = scope.get(var.name)
+            if val is not None:
+                scope.set(rename[var.name], jnp.asarray(val))
+    return rename
+
+
+def soft_label_loss(teacher_logits, student_logits, teacher_temperature=1.0,
+                    student_temperature=1.0):
+    """KL(student || teacher-softened) soft-label loss (reference:
+    distiller.py soft_label_loss)."""
+    from paddle_tpu import layers
+
+    t = layers.softmax(layers.scale(teacher_logits, scale=1.0 / teacher_temperature))
+    s = layers.log_softmax(layers.scale(student_logits, scale=1.0 / student_temperature))
+    return layers.mean(layers.reduce_sum(t * (-s), dim=-1))
+
+
+def l2_loss(teacher_feature, student_feature):
+    from paddle_tpu import layers
+
+    diff = teacher_feature - student_feature
+    return layers.mean(layers.reduce_sum(diff * diff, dim=-1))
+
+
+def fsp_loss(teacher_a, teacher_b, student_a, student_b):
+    """Flow-of-solution-procedure loss: L2 between gram matrices of two
+    feature maps [N, C, H, W] (reference: distiller.py fsp_loss)."""
+    from paddle_tpu import layers
+
+    def gram(a, b):
+        n, ca = a.shape[0], a.shape[1]
+        cb = b.shape[1]
+        hw = int(a.shape[2]) * int(a.shape[3])
+        fa = layers.reshape(a, [0, ca, hw])
+        fb = layers.reshape(b, [0, cb, hw])
+        return layers.scale(
+            layers.matmul(fa, layers.transpose(fb, [0, 2, 1])), scale=1.0 / hw
+        )
+
+    gt = gram(teacher_a, teacher_b)
+    gs = gram(student_a, student_b)
+    diff = gt - gs
+    return layers.mean(diff * diff)

@@ -9,4 +9,6 @@ This environment has no network egress, so the zoo generates
 real datasets (documented per module).  Swap in real data by pointing
 ``PADDLE_TPU_DATA_HOME`` at pre-downloaded copies; modules check it first.
 """
-from paddle_tpu.dataset import cifar, imdb, mnist, uci_housing  # noqa: F401
+from paddle_tpu.dataset import (  # noqa: F401
+    cifar, flowers, imdb, mnist, movielens, uci_housing, voc2012, wmt14, wmt16,
+)

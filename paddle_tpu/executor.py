@@ -31,7 +31,7 @@ from paddle_tpu.core import lowering
 from paddle_tpu.core import types as core_types
 from paddle_tpu.scope import Scope, global_scope
 
-__all__ = ["Executor"]
+__all__ = ["Executor", "AsyncExecutor"]
 
 
 def _as_fetch_name(f) -> str:
@@ -383,3 +383,39 @@ class Executor:
     # ------------------------------------------------------------------
     def close(self):
         self._cache.clear()
+
+
+class AsyncExecutor:
+    """Legacy filelist-driven trainer facade (reference:
+    framework/async_executor.h:62 + executor_thread_worker.cc — pre-
+    Trainer API that ran ExecutorThreadWorker threads over a Dataset).
+
+    On TPU the compiled step IS the device worker, so this delegates to
+    Executor.train_from_dataset over a Dataset built from the filelist —
+    same API shape, one compiled module instead of thread workers.
+    """
+
+    def __init__(self, place=None):
+        self._exe = Executor(place)
+
+    def run(self, program, data_feed, filelist, thread_num=1, fetch_list=None,
+            fetch_info=None, debug=False, mode="", scope=None):
+        from paddle_tpu.fluid_dataset import DatasetFactory
+
+        slots = getattr(data_feed, "slots", None)
+        if not slots:
+            raise ValueError(
+                "AsyncExecutor needs a data_feed with a .slots list of the "
+                "program's input Variables (DataFeedDesc analog)"
+            )
+        if isinstance(filelist, str):
+            filelist = [filelist]
+        dataset = DatasetFactory().create_dataset("InMemoryDataset")
+        dataset.set_use_var(slots)
+        dataset.set_filelist(list(filelist))
+        if hasattr(dataset, "load_into_memory"):
+            dataset.load_into_memory()
+        return self._exe.train_from_dataset(
+            program=program, dataset=dataset, scope=scope,
+            fetch_list=fetch_list, fetch_info=fetch_info, debug=debug,
+        )

@@ -1,0 +1,2 @@
+"""Incubating APIs (reference: python/paddle/fluid/incubate/)."""
+from paddle_tpu.incubate import data_generator  # noqa: F401

@@ -1,0 +1,102 @@
+"""Filesystem shim (reference: paddle/fluid/framework/io/fs.cc + shell.cc
+— local + HDFS file ops used by Dataset/Fleet file-sharding).
+
+Local paths work natively; ``hdfs://`` paths route through the ``hadoop
+fs`` CLI when present (the reference shells out the same way,
+io/shell.cc), else raise with a clear message.  The API mirrors fs.cc:
+``fs_ls / fs_exists / fs_mkdir / fs_rm / fs_mv / open_read /
+open_write / file_shard``.
+"""
+from __future__ import annotations
+
+import glob as _glob
+import os
+import shutil
+import subprocess
+from typing import IO, List
+
+__all__ = [
+    "fs_ls", "fs_exists", "fs_mkdir", "fs_rm", "fs_mv",
+    "open_read", "open_write", "file_shard",
+]
+
+
+def _is_hdfs(path: str) -> bool:
+    return path.startswith(("hdfs://", "afs://"))
+
+
+def _hadoop(*args: str) -> str:
+    exe = shutil.which("hadoop")
+    if exe is None:
+        raise RuntimeError(
+            "hdfs:// path requires the 'hadoop' CLI on PATH (reference "
+            "io/fs.cc shells out identically); not present in this image"
+        )
+    return subprocess.run(
+        [exe, "fs", *args], check=True, capture_output=True, text=True
+    ).stdout
+
+
+def fs_ls(path: str) -> List[str]:
+    if _is_hdfs(path):
+        out = _hadoop("-ls", path)
+        return [ln.split()[-1] for ln in out.splitlines() if ln.startswith(("-", "d"))]
+    if os.path.isdir(path):
+        return sorted(os.path.join(path, p) for p in os.listdir(path))
+    return sorted(_glob.glob(path))
+
+
+def fs_exists(path: str) -> bool:
+    if _is_hdfs(path):
+        try:
+            _hadoop("-test", "-e", path)
+            return True
+        except subprocess.CalledProcessError:
+            return False
+    return os.path.exists(path)
+
+
+def fs_mkdir(path: str) -> None:
+    if _is_hdfs(path):
+        _hadoop("-mkdir", "-p", path)
+        return
+    os.makedirs(path, exist_ok=True)
+
+
+def fs_rm(path: str) -> None:
+    if _is_hdfs(path):
+        _hadoop("-rm", "-r", path)
+        return
+    if os.path.isdir(path):
+        shutil.rmtree(path)
+    elif os.path.exists(path):
+        os.remove(path)
+
+
+def fs_mv(src: str, dst: str) -> None:
+    if _is_hdfs(src) or _is_hdfs(dst):
+        _hadoop("-mv", src, dst)
+        return
+    shutil.move(src, dst)
+
+
+def open_read(path: str, mode: str = "r") -> IO:
+    if _is_hdfs(path):
+        exe = shutil.which("hadoop")
+        if exe is None:
+            raise RuntimeError("hdfs:// read requires the 'hadoop' CLI")
+        proc = subprocess.Popen([exe, "fs", "-cat", path], stdout=subprocess.PIPE)
+        return proc.stdout if "b" in mode else open(proc.stdout.fileno(), "r")
+    return open(path, mode)
+
+
+def open_write(path: str, mode: str = "w") -> IO:
+    if _is_hdfs(path):
+        raise NotImplementedError("hdfs:// streaming write: stage locally, fs_mv after")
+    return open(path, mode)
+
+
+def file_shard(paths: List[str], trainer_id: int, trainer_num: int) -> List[str]:
+    """Round-robin file sharding across trainers (reference:
+    fleet file_list split / data_set.cc SetFileList distribution)."""
+    return [p for i, p in enumerate(sorted(paths)) if i % trainer_num == trainer_id]

@@ -775,7 +775,14 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
 def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
         bias_attr=None, num_neg_samples=10, name=None, sampler="uniform",
         custom_dist=None, seed=0, is_sparse=False):
-    """Noise-contrastive estimation loss -> [B, 1] cost."""
+    """Noise-contrastive estimation loss -> [B, 1] cost.  Only the
+    uniform sampler is implemented (its log(k*P) correction is baked into
+    the kernel)."""
+    if sampler != "uniform" or custom_dist is not None or sample_weight is not None:
+        raise NotImplementedError(
+            "nce supports sampler='uniform' without custom_dist/"
+            "sample_weight; log_uniform/custom samplers are open parity items"
+        )
     helper = LayerHelper("nce", param_attr=param_attr, bias_attr=bias_attr, name=name)
     dim = input.shape[-1]
     w = helper.create_parameter(param_attr, shape=[num_total_classes, dim], dtype=input.dtype)
@@ -873,12 +880,16 @@ def image_resize(input, out_shape=None, scale=None, name=None,
     return out
 
 
-def resize_bilinear(input, out_shape=None, scale=None, name=None, **kw):
-    return image_resize(input, out_shape, scale, name, "BILINEAR")
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    align_corners=True, align_mode=1, **kw):
+    return image_resize(input, out_shape, scale, name, "BILINEAR",
+                        align_corners=align_corners, align_mode=align_mode)
 
 
-def resize_nearest(input, out_shape=None, scale=None, name=None, **kw):
-    return image_resize(input, out_shape, scale, name, "NEAREST")
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   align_corners=True, **kw):
+    return image_resize(input, out_shape, scale, name, "NEAREST",
+                        align_corners=align_corners)
 
 
 def pixel_shuffle(x, upscale_factor):

@@ -1,0 +1,75 @@
+"""Device-memory observability (reference: paddle/fluid/memory/ —
+AllocatorFacade stats, allocation/allocator_facade.h:32, and the
+FLAGS_fraction_of_gpu_memory_to_use family, platform/gpu_info.cc).
+
+On TPU, allocation itself belongs to PJRT/XLA (buffer assignment inside
+compiled modules, donation at boundaries — see executor.py), so the
+framework surface is OBSERVABILITY plus the pre-allocation knobs jax
+exposes:
+
+* ``device_memory_stats()`` — live PJRT per-device stats (bytes in use,
+  peak, limit) — the `memory::StatGetCurrentValue` analog.
+* ``FLAGS_fraction_of_gpu_memory_to_use`` / ``FLAGS_tpu_memory_fraction``
+  env var seeds XLA_PYTHON_CLIENT_MEM_FRACTION at import (the gflags→env
+  seeding tier, python/__init__.py in the reference).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+__all__ = ["device_memory_stats", "memory_summary"]
+
+# gflags→env seeding (must run before jax initializes its backends)
+_frac = os.environ.get("FLAGS_fraction_of_gpu_memory_to_use") or os.environ.get(
+    "FLAGS_tpu_memory_fraction"
+)
+if _frac:
+    os.environ.setdefault("XLA_PYTHON_CLIENT_MEM_FRACTION", _frac)
+
+
+def device_memory_stats(device=None) -> List[Dict[str, Optional[int]]]:
+    """Per-device memory stats from PJRT.  Returns a list of dicts with
+    ``device``, ``bytes_in_use``, ``peak_bytes_in_use``, ``bytes_limit``
+    (None where the platform doesn't report — e.g. CPU)."""
+    import jax
+
+    devices = [device] if device is not None else jax.devices()
+    out = []
+    for d in devices:
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        stats = stats or {}
+        out.append(
+            {
+                "device": str(d),
+                "platform": d.platform,
+                "bytes_in_use": stats.get("bytes_in_use"),
+                "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+                "bytes_limit": stats.get("bytes_limit"),
+                "num_allocs": stats.get("num_allocs"),
+            }
+        )
+    return out
+
+
+def memory_summary(device=None) -> str:
+    """Human-readable per-device memory table (lodtensor_printer-style
+    debug aid)."""
+    rows = device_memory_stats(device)
+    lines = ["%-28s %14s %14s %14s" % ("device", "in_use", "peak", "limit")]
+
+    def fmt(v):
+        if v is None:
+            return "-"
+        return "%.1fMB" % (v / (1 << 20))
+
+    for r in rows:
+        lines.append(
+            "%-28s %14s %14s %14s"
+            % (r["device"], fmt(r["bytes_in_use"]), fmt(r["peak_bytes_in_use"]), fmt(r["bytes_limit"]))
+        )
+    return "\n".join(lines)

@@ -116,3 +116,78 @@ def test_profiler_collects_events(capsys):
             sum(range(10))
     out = capsys.readouterr().out
     assert "stepA" in out and "stepB" in out and "Calls" in out
+
+
+def test_slim_prune_masks_persist_through_training():
+    """Magnitude pruning zeroes the smallest weights and the in-graph
+    mask keeps them zero across optimizer updates (reference:
+    contrib/slim/prune Pruner)."""
+    from paddle_tpu.contrib.slim.prune import Pruner
+
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 81
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [16])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1, bias_attr=False, name="prune_fc")
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    wname = prog.all_parameters()[0].name
+
+    rng = np.random.RandomState(0)
+    xb = rng.randn(32, 16).astype("float32")
+    yb = xb.sum(1, keepdims=True).astype("float32")
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(prog, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        sparsity = Pruner().prune(prog, scope, [wname], [0.5])
+        assert abs(sparsity[wname] - 0.5) < 0.1
+        zero_mask = np.asarray(scope.get(wname)) == 0.0
+        assert zero_mask.sum() >= 7
+        for _ in range(5):
+            exe.run(prog, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        w_after = np.asarray(scope.get(wname))
+        # pruned positions stayed exactly zero through 5 SGD updates
+        assert np.all(w_after[zero_mask] == 0.0)
+        # un-pruned positions kept training
+        assert np.any(w_after[~zero_mask] != 0.0)
+
+
+def test_slim_distillation_soft_label():
+    """Distillation: teacher merged into the student program; soft-label
+    loss pulls student logits toward the (frozen) teacher's."""
+    from paddle_tpu.contrib.slim import distillation as distill
+
+    tprog, tstart = framework.Program(), framework.Program()
+    tprog.random_seed = tstart.random_seed = 7
+    with framework.program_guard(tprog, tstart):
+        tx = fluid.layers.data("x", [8])
+        tlogits = fluid.layers.fc(tx, 4, name="teacher_fc")
+
+    sprog, sstart = framework.Program(), framework.Program()
+    sprog.random_seed = sstart.random_seed = 8
+    with framework.program_guard(sprog, sstart):
+        sx = fluid.layers.data("x", [8])
+        slogits = fluid.layers.fc(sx, 4, name="student_fc")
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(tstart)  # teacher params first, then merge copies them
+    with fluid.scope_guard(scope):
+        rename = distill.merge(tprog, sprog, data_name_map={"x": "x"}, scope=scope)
+    with framework.program_guard(sprog, sstart):
+        tvar = sprog.global_block().var(rename[tlogits.name])
+        loss = distill.soft_label_loss(tvar, slogits, 1.0, 1.0)
+        fluid.optimizer.AdamOptimizer(0.05).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    xb = rng.randn(32, 8).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(sstart)
+        losses = [
+            float(np.asarray(exe.run(sprog, feed={"x": xb}, fetch_list=[loss])[0]))
+            for _ in range(60)
+        ]
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])

@@ -1,0 +1,119 @@
+"""Auxiliary subsystems: memory stats, io/fs shim, data_generator,
+AsyncExecutor facade, dataset zoo additions (reference:
+memory/allocation/allocator_facade.h stats, framework/io/fs.cc,
+incubate/data_generator/__init__.py, async_executor.h,
+python/paddle/dataset/{wmt16,movielens,flowers,voc2012}.py)."""
+import io as _io
+import os
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_device_memory_stats_surface():
+    stats = fluid.memory.device_memory_stats()
+    assert stats and "bytes_in_use" in stats[0] and "platform" in stats[0]
+    summary = fluid.memory.memory_summary()
+    assert "device" in summary and "in_use" in summary
+
+
+def test_io_fs_local_roundtrip(tmp_path):
+    from paddle_tpu import io_fs as fs
+
+    d = str(tmp_path / "x")
+    fs.fs_mkdir(d)
+    with fs.open_write(os.path.join(d, "a.txt")) as f:
+        f.write("hello")
+    assert fs.fs_exists(os.path.join(d, "a.txt"))
+    assert fs.fs_ls(d) == [os.path.join(d, "a.txt")]
+    with fs.open_read(os.path.join(d, "a.txt")) as f:
+        assert f.read() == "hello"
+    fs.fs_mv(os.path.join(d, "a.txt"), os.path.join(d, "b.txt"))
+    assert not fs.fs_exists(os.path.join(d, "a.txt"))
+    fs.fs_rm(d)
+    assert not fs.fs_exists(d)
+    assert fs.file_shard(["a", "b", "c", "d"], 0, 2) == ["a", "c"]
+
+
+def test_data_generator_multislot_roundtrip():
+    from paddle_tpu import native
+    from paddle_tpu.incubate.data_generator import MultiSlotDataGenerator
+
+    class Gen(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def r():
+                toks = line.split()
+                yield [("ids", [int(t) for t in toks[:-1]]),
+                       ("label", [float(toks[-1])])]
+
+            return r
+
+    g = Gen()
+    g.set_batch(2)
+    buf = _io.StringIO()
+    g.run_from_memory(["1 2 3 0.5", "4 5 1.0"], buf)
+    n, slots = native.parse_multislot(buf.getvalue().encode(), 2)
+    assert n == 2
+    np.testing.assert_allclose(slots[0][0], [1, 2, 3, 4, 5])
+    np.testing.assert_array_equal(slots[0][1], [3, 2])
+    np.testing.assert_allclose(slots[1][0], [0.5, 1.0])
+
+
+def test_dataset_zoo_shapes():
+    from paddle_tpu.dataset import flowers, movielens, voc2012, wmt14, wmt16
+
+    src, trg, trg_next = next(wmt16.train(size=4)())
+    assert trg.shape[0] == trg_next.shape[0] == src.shape[0] + 1
+    assert trg[0] == wmt16.BOS and trg_next[-1] == wmt16.EOS
+
+    s14 = next(wmt14.train(size=2)())
+    assert len(s14) == 3
+
+    m = next(movielens.train(size=2)())
+    assert len(m) == 8 and 1.0 <= m[7] <= 5.0
+
+    img, label = next(flowers.train(size=2)())
+    assert img.shape == (3, 224, 224) and 0 <= label < 102
+
+    img, mask = next(voc2012.train(size=2)())
+    assert img.shape[0] == 3 and mask.shape == img.shape[1:]
+    assert mask.max() <= 20
+
+
+def test_async_executor_facade(tmp_path):
+    """AsyncExecutor.run trains over a MultiSlot filelist (reference:
+    async_executor.h contract)."""
+    from paddle_tpu import framework
+
+    f = tmp_path / "part-0.txt"
+    rng = np.random.RandomState(0)
+    lines = []
+    for _ in range(64):
+        x = rng.rand(4)
+        y = x.sum() * 0.5
+        lines.append("4 " + " ".join("%.4f" % v for v in x) + " 1 %.4f" % y)
+    f.write_text("\n".join(lines) + "\n")
+
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 12
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.data("y", [1])
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(fluid.layers.fc(x, 1), y)
+        )
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+
+    class Feed:
+        slots = [x, y]
+
+    exe = fluid.AsyncExecutor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        results = exe.run(prog, Feed(), [str(f)], fetch_list=[loss], scope=scope)
+    assert results, "no batches ran"
+    first = float(np.asarray(results[0][0]))
+    last = float(np.asarray(results[-1][0]))
+    assert last < first, (first, last)
