@@ -30,49 +30,49 @@ class Pruner:
               place=None, lazy: bool = False, only_graph: bool = False):
         """Mask the smallest-|w| fraction ``ratio`` of each param.
         Returns {param: actual_sparsity}."""
-        from paddle_tpu import framework, unique_name
-        from paddle_tpu.layer_helper import LayerHelper
-        from paddle_tpu import initializer
+        from paddle_tpu import unique_name
         import jax.numpy as jnp
 
         block = program.global_block()
         result: Dict[str, float] = {}
-        with framework.program_guard(program):
-            helper = LayerHelper("prune")
-            for name, ratio in zip(params, ratios):
-                val = np.asarray(scope.get(name))
-                k = int(round(val.size * float(ratio)))
-                mask = np.ones(val.shape, np.float32)
-                if k > 0:
-                    # zero EXACTLY k entries (a magnitude-threshold test
-                    # over-prunes when values tie at the k-th magnitude)
-                    idx = np.argsort(np.abs(val).ravel(), kind="stable")[:k]
-                    flat = mask.ravel()
-                    flat[idx] = 0.0
-                    mask = flat.reshape(val.shape)
-                mask_var = block.create_var(
-                    name=unique_name.generate(name + "@PRUNE_MASK@"),
-                    shape=list(val.shape), dtype="float32",
-                    persistable=True, stop_gradient=True,
+        for name, ratio in zip(params, ratios):
+            val = np.asarray(scope.get(name))
+            k = int(round(val.size * float(ratio)))
+            mask = np.ones(val.shape, np.float32)
+            if k > 0:
+                # zero EXACTLY k entries (a magnitude-threshold test
+                # over-prunes when values tie at the k-th magnitude)
+                idx = np.argsort(np.abs(val).ravel(), kind="stable")[:k]
+                flat = mask.ravel()
+                flat[idx] = 0.0
+                mask = flat.reshape(val.shape)
+            # NO startup initializer: the mask value is written to the
+            # scope directly (an initializer in a startup program
+            # would re-set it to ones on re-init, resurrecting pruned
+            # weights — and pollute an unrelated default startup).
+            # After re-initializing a fresh scope, call prune() again.
+            mask_var = block.create_var(
+                name=unique_name.generate(name + "@PRUNE_MASK@"),
+                shape=list(val.shape), dtype="float32",
+                persistable=True, stop_gradient=True,
+            )
+            scope.set(mask_var.name, jnp.asarray(mask))
+            if not only_graph:
+                scope.set(name, jnp.asarray(val * mask))
+            # re-apply the mask after every update of this param: find
+            # the LAST op writing it and insert mul right after
+            last_idx = None
+            for i, op in enumerate(block.ops):
+                if name in op.output_arg_names:
+                    last_idx = i
+            if last_idx is not None:
+                block._insert_op(
+                    last_idx + 1,
+                    type="elementwise_mul",
+                    inputs={"X": [name], "Y": [mask_var.name]},
+                    outputs={"Out": [name]},
+                    attrs={"op_role": "optimize"},
                 )
-                helper.set_variable_initializer(mask_var, initializer.Constant(1.0))
-                scope.set(mask_var.name, jnp.asarray(mask))
-                if not only_graph:
-                    scope.set(name, jnp.asarray(val * mask))
-                # re-apply the mask after every update of this param: find
-                # the LAST op writing it and insert mul right after
-                last_idx = None
-                for i, op in enumerate(block.ops):
-                    if name in op.output_arg_names:
-                        last_idx = i
-                if last_idx is not None:
-                    block._insert_op(
-                        last_idx + 1,
-                        type="elementwise_mul",
-                        inputs={"X": [name], "Y": [mask_var.name]},
-                        outputs={"Out": [name]},
-                        attrs={"op_role": "optimize"},
-                    )
-                result[name] = 1.0 - float(mask.mean())
+            result[name] = 1.0 - float(mask.mean())
         program.version += 1
         return result

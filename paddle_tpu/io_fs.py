@@ -80,13 +80,45 @@ def fs_mv(src: str, dst: str) -> None:
     shutil.move(src, dst)
 
 
+class _ProcReader:
+    """File-like over a subprocess pipe that reaps the process on close
+    and surfaces a nonzero exit status (an empty stream must not be
+    mistaken for an empty file)."""
+
+    def __init__(self, proc: subprocess.Popen, stream):
+        self._proc = proc
+        self._stream = stream
+
+    def __getattr__(self, name):
+        return getattr(self._stream, name)
+
+    def __iter__(self):
+        return iter(self._stream)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def close(self):
+        self._stream.close()
+        rc = self._proc.wait()
+        if rc != 0:
+            raise RuntimeError("hadoop fs -cat exited with status %d" % rc)
+
+
 def open_read(path: str, mode: str = "r") -> IO:
     if _is_hdfs(path):
+        import io as _iomod
+
         exe = shutil.which("hadoop")
         if exe is None:
             raise RuntimeError("hdfs:// read requires the 'hadoop' CLI")
         proc = subprocess.Popen([exe, "fs", "-cat", path], stdout=subprocess.PIPE)
-        return proc.stdout if "b" in mode else open(proc.stdout.fileno(), "r")
+        stream = proc.stdout if "b" in mode else _iomod.TextIOWrapper(proc.stdout)
+        return _ProcReader(proc, stream)
     return open(path, mode)
 
 

@@ -117,3 +117,52 @@ def test_async_executor_facade(tmp_path):
     first = float(np.asarray(results[0][0]))
     last = float(np.asarray(results[-1][0]))
     assert last < first, (first, last)
+
+
+def test_pass_framework_and_pattern_matcher():
+    """Pass registry + PassManager + op-chain matcher (ir/pass.h:38 +
+    GraphPatternDetector analogs); eager shape errors at append_op."""
+    from paddle_tpu import framework
+    from paddle_tpu.core import passes
+
+    assert "amp_bf16" in passes.list_passes()
+    prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [8])
+        h = fluid.layers.fc(x, 4, act="relu", name="pm_fc")
+        out = fluid.layers.fc(h, 2, name="pm_out")
+
+    block = prog.global_block()
+    # fc lowers to mul (+elementwise_add bias) + relu: match the chain
+    chains = passes.match_chain(block, ["mul", "elementwise_add", "relu"])
+    assert len(chains) == 1
+    assert [op.type for op in chains[0]] == ["mul", "elementwise_add", "relu"]
+
+    # amp pass through the manager == direct rewrite: fc weights cast in
+    passes.PassManager(["amp_bf16"]).apply(prog)
+    assert any(op.type == "cast" for op in block.ops)
+
+    # prune pass returns a clone sliced to the target
+    pruned = passes.apply_pass("prune_to_targets", prog, feeds=["x"], targets=[out.name])
+    assert len(pruned.global_block().ops) <= len(block.ops)
+
+
+def test_eager_shape_error_at_append_op():
+    """A static-shape mismatch raises AT BUILD TIME with the op named
+    (round-1 weakness #6: errors surfaced deep inside jax tracing)."""
+    import pytest
+    from paddle_tpu import framework
+
+    prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(prog, startup):
+        block = prog.global_block()
+        block.create_var(name="sa", shape=[3, 4], dtype="float32", is_data=True)
+        block.create_var(name="sb", shape=[5, 6], dtype="float32", is_data=True)
+        block.create_var(name="sc", shape=[3, 6], dtype="float32")
+        with pytest.raises(ValueError, match="shape inference failed for op 'matmul'"):
+            block.append_op(
+                type="matmul",
+                inputs={"X": ["sa"], "Y": ["sb"]},
+                outputs={"Out": ["sc"]},
+                attrs={"transpose_X": False, "transpose_Y": False, "alpha": 1.0},
+            )
