@@ -15,7 +15,8 @@ from paddle_tpu import framework, unique_name
 from paddle_tpu.framework import Variable
 from paddle_tpu.layer_helper import LayerHelper
 
-__all__ = ["While", "StaticRNN", "DynamicRNN", "cond", "increment"]
+__all__ = ["While", "StaticRNN", "DynamicRNN", "IfElse", "Switch", "cond",
+           "increment", "create_array", "array_write", "array_read", "array_length"]
 
 
 def increment(x, value=1.0, in_place=True):
@@ -534,3 +535,169 @@ class DynamicRNN:
         if not self._built:
             raise RuntimeError("DynamicRNN used before its block completed")
         return self._out_vars[0] if len(self._out_vars) == 1 else self._out_vars
+
+
+def create_array(size, shape, dtype="float32", name=None):
+    """LoDTensorArray analog: a pre-sized stacked tensor [size, *shape]
+    (reference: layers/control_flow.py create_array over
+    LOD_TENSOR_ARRAY; XLA needs the static bound up front)."""
+    from paddle_tpu.layers import tensor as ltensor
+
+    return ltensor.fill_constant([int(size)] + list(shape), dtype, 0.0)
+
+
+def array_write(x, i, array):
+    """reference: layers/control_flow.py array_write."""
+    helper = LayerHelper("array_write")
+    out = helper.create_variable_for_type_inference(array.dtype)
+    helper.append_op(
+        type="write_to_array",
+        inputs={"Array": [array], "I": [i], "X": [x]},
+        outputs={"Out": [out]},
+        attrs={},
+    )
+    return out
+
+
+def array_read(array, i):
+    """reference: layers/control_flow.py array_read."""
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(array.dtype)
+    helper.append_op(
+        type="read_from_array",
+        inputs={"X": [array], "I": [i]},
+        outputs={"Out": [out]},
+        attrs={},
+    )
+    return out
+
+
+def array_length(array):
+    """Length of the array: the STATIC allocated capacity (create_array
+    size), not a written-element count — the padded-static shim's
+    divergence from the reference's growing LoDTensorArray.  Track a
+    separate counter var if the loop writes fewer slots."""
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="lod_array_length", inputs={"X": [array]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+class IfElse:
+    """reference: layers/control_flow.py:1564 — per-example two-way
+    branch: true_block/false_block see the rows selected by the
+    condition; outputs merge back in original order.
+
+    TPU-native: both blocks run on the FULL batch (SPMD-friendly, no
+    dynamic shapes) and jnp.where merges per row — semantically the
+    reference's split+merge for elementwise-batch computations.
+
+    GRADIENT CAVEAT (the classic where-grad gotcha): because the
+    unselected branch still executes on every row, a branch whose vjp is
+    non-finite on unselected rows (sqrt/log/div of invalid inputs)
+    poisons the gradient (0 * NaN = NaN).  Guard the branch INPUT, not
+    just its output: ``safe = layers.where(cond, x, ones_like(x))``
+    inside the branch.
+    """
+
+    def __init__(self, cond: Variable, name: Optional[str] = None):
+        self._cond = cond
+        self._true_outs: List[Variable] = []
+        self._false_outs: List[Variable] = []
+        self._in_true = None
+
+    class _Branch:
+        def __init__(self, parent, is_true):
+            self.parent, self.is_true = parent, is_true
+
+        def __enter__(self):
+            self.parent._in_true = self.is_true
+            return self
+
+        def __exit__(self, *exc):
+            self.parent._in_true = None
+            return False
+
+    def true_block(self):
+        return IfElse._Branch(self, True)
+
+    def false_block(self):
+        return IfElse._Branch(self, False)
+
+    def input(self, x: Variable) -> Variable:
+        # full-batch pass-through (the reference slices selected rows;
+        # here masking happens at merge)
+        return x
+
+    def output(self, *outs):
+        if self._in_true is None:
+            raise RuntimeError("IfElse.output called outside a branch block")
+        (self._true_outs if self._in_true else self._false_outs).extend(outs)
+
+    def __call__(self):
+        if len(self._true_outs) != len(self._false_outs):
+            raise ValueError("IfElse branches produced different output counts")
+        from paddle_tpu.layers import tensor as ltensor
+
+        merged = [
+            ltensor.where(self._cond, t, f)
+            for t, f in zip(self._true_outs, self._false_outs)
+        ]
+        return merged[0] if len(merged) == 1 else merged
+
+
+class Switch:
+    """reference: layers/control_flow.py Switch — sequential
+    case/default assignment, lowered to nested where-selects."""
+
+    def __init__(self, name: Optional[str] = None):
+        self._cases = []  # (cond_var or None, fn-scope marker)
+        self._pending = None
+
+    class _Case:
+        def __init__(self, sw, cond):
+            self.sw, self.cond = sw, cond
+
+        def __enter__(self):
+            self.sw._pending = (self.cond, [])
+            return self
+
+        def __exit__(self, *exc):
+            self.sw._cases.append(self.sw._pending)
+            self.sw._pending = None
+            return False
+
+    def case(self, cond: Variable):
+        return Switch._Case(self, cond)
+
+    def default(self):
+        return Switch._Case(self, None)
+
+    def assign(self, var: Variable):
+        """Record this branch's value (call inside a case block)."""
+        if self._pending is None:
+            raise RuntimeError("Switch.assign outside a case block")
+        self._pending[1].append(var)
+
+    def merge(self):
+        """Fold cases: first true condition wins, else default."""
+        from paddle_tpu.layers import tensor as ltensor
+
+        default = None
+        conds = []
+        for cond, vals in self._cases:
+            if len(vals) != 1:
+                raise ValueError(
+                    "each Switch case needs exactly one assign (got %d)" % len(vals)
+                )
+            if cond is None:
+                default = vals[0]
+            else:
+                conds.append((cond, vals[0]))
+        if default is None:
+            raise ValueError("Switch needs a default case")
+        out = default
+        for cond, val in reversed(conds):
+            out = ltensor.where(cond, val, out)
+        return out

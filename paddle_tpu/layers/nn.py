@@ -314,6 +314,7 @@ def batch_norm(
     moving_variance_name=None,
     do_model_average_for_mean_and_var=False,
     use_global_stats=False,
+    sync=False,
 ):
     """reference: layers/nn.py batch_norm.  Running stats are persistable
     vars updated in-graph (MeanOut/VarianceOut alias Mean/Variance)."""
@@ -350,6 +351,7 @@ def batch_norm(
             "epsilon": epsilon,
             "is_test": is_test or use_global_stats,
             "data_layout": data_layout,
+            "sync_bn": bool(sync),
         },
     )
     return helper.append_activation(out)

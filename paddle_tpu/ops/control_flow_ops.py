@@ -248,3 +248,37 @@ def dynamic_rnn(inputs, attrs):
     # [T,B,...] -> [B,T,...]
     stacked = [jnp.moveaxis(s, 0, 1) for s in stacked]
     return {"Out": list(stacked) + list(final_mem)}
+
+
+# ---------------------------------------------------------------------------
+# tensor array ops (reference: operators/controlflow/tensor_array_read_write
+# _op.cc over LOD_TENSOR_ARRAY vars; here the array is a STACKED tensor
+# [A, ...] with a length scalar — static shapes for XLA)
+# ---------------------------------------------------------------------------
+@register_op("write_to_array", no_grad_set={"I"})
+def write_to_array(inputs, attrs):
+    """Array [A, ...] (pre-sized stack), I scalar index, X value ->
+    ArrayOut with slot I replaced."""
+    arr = one(inputs, "Array")
+    i = one(inputs, "I").reshape(()).astype("int32")
+    x = one(inputs, "X")
+    import jax
+
+    return {"Out": jax.lax.dynamic_update_index_in_dim(arr, x.astype(arr.dtype), i, 0)}
+
+
+@register_op("read_from_array", no_grad_set={"I"})
+def read_from_array(inputs, attrs):
+    arr = one(inputs, "X")
+    i = one(inputs, "I").reshape(()).astype("int32")
+    import jax
+
+    return {"Out": jax.lax.dynamic_index_in_dim(arr, i, 0, keepdims=False)}
+
+
+@register_op("lod_array_length", differentiable=False)
+def lod_array_length(inputs, attrs):
+    import jax.numpy as jnp
+
+    arr = one(inputs, "X")
+    return {"Out": jnp.asarray([arr.shape[0]], "int64")}

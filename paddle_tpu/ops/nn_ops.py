@@ -203,6 +203,20 @@ def batch_norm(inputs, attrs):
     else:
         use_mean = jnp.mean(xf, axis=axes)
         use_var = jnp.var(xf, axis=axes)
+        if attrs.get("sync_bn", False):
+            # SyncBatchNorm (reference: sync_batch_norm_op.cu — NCCL
+            # stat exchange): global batch statistics via psum over the
+            # active dp axis; E[x^2]-E[x]^2 so one reduce round trip
+            from paddle_tpu.parallel import env as penv
+
+            ax = attrs.get("axis_name") or penv.axis_for_ring(attrs.get("ring_id", 0))
+            if penv.axis_active(ax):
+                import jax as _jaxmod
+
+                n = _jaxmod.lax.psum(1, axis_name=ax)
+                mean_sq = jnp.mean(xf * xf, axis=axes)
+                use_mean = _jaxmod.lax.psum(use_mean, axis_name=ax) / n
+                use_var = _jaxmod.lax.psum(mean_sq, axis_name=ax) / n - use_mean * use_mean
         saved_mean, saved_var = use_mean, use_var
         new_mean = momentum * mean + (1 - momentum) * use_mean
         new_var = momentum * var + (1 - momentum) * use_var

@@ -338,3 +338,55 @@ def test_dgc_momentum_sparsifies_and_converges():
         60,
     )
     assert sparse[-1] < sparse[0] * 0.05, (sparse[0], sparse[-1])
+
+
+def test_ifelse_and_switch_and_tensor_array():
+    """IfElse per-row branch merge, Switch case folding, and the
+    LoDTensorArray shim (reference: layers/control_flow.py IfElse:1564,
+    Switch, array_write/array_read)."""
+    prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [3])
+        zero = fluid.layers.fill_constant([1], "float32", 0.0)
+        row_sum = fluid.layers.reduce_sum(x, dim=1, keep_dim=True)  # [N,1]
+        cond = fluid.layers.greater_than(row_sum, zero)
+
+        ie = fluid.layers.IfElse(cond)
+        with ie.true_block():
+            ie.output(fluid.layers.scale(x, scale=2.0))
+        with ie.false_block():
+            ie.output(fluid.layers.scale(x, scale=-1.0))
+        merged = ie()
+
+        # Switch over a scalar: lr schedule style
+        step = fluid.layers.fill_constant([1], "float32", 7.0)
+        five = fluid.layers.fill_constant([1], "float32", 5.0)
+        ten = fluid.layers.fill_constant([1], "float32", 10.0)
+        sw = fluid.layers.Switch()
+        with sw.case(fluid.layers.less_than(step, five)):
+            sw.assign(fluid.layers.fill_constant([1], "float32", 0.1))
+        with sw.case(fluid.layers.less_than(step, ten)):
+            sw.assign(fluid.layers.fill_constant([1], "float32", 0.01))
+        with sw.default():
+            sw.assign(fluid.layers.fill_constant([1], "float32", 0.001))
+        lr = sw.merge()
+
+        # tensor array round trip
+        arr = fluid.layers.create_array(4, [3])
+        i0 = fluid.layers.fill_constant([1], "int64", 2)
+        row0 = fluid.layers.reshape(fluid.layers.slice(x, axes=[0], starts=[0], ends=[1]), [3])
+        arr2 = fluid.layers.array_write(row0, i0, arr)
+        back = fluid.layers.array_read(arr2, i0)
+        alen = fluid.layers.array_length(arr2)
+
+    xb = np.array([[1, 2, 3], [-1, -2, -3]], "float32")
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        m, l, b, n = exe.run(
+            prog, feed={"x": xb}, fetch_list=[merged, lr, back, alen]
+        )
+    np.testing.assert_allclose(np.asarray(m), [[2, 4, 6], [1, 2, 3]])
+    assert np.asarray(l).item() == np.float32(0.01)
+    np.testing.assert_allclose(np.asarray(b), xb[0])
+    assert np.asarray(n).item() == 4

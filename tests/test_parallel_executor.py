@@ -78,3 +78,78 @@ def test_tensor_parallel_sharding_specs():
     compiled = fluid.CompiledProgram(prog2).with_strategy(strat)
     par = _train(compiled, prog2, startup2, loss2)
     np.testing.assert_allclose(single, par, rtol=1e-4, atol=1e-5)
+
+
+def test_batch_norm_under_data_parallel_and_sync():
+    """BN under dp sharding: per-shard stats by default (ParallelExecutor
+    per-device BN), GLOBAL batch stats with sync=True — parity vs the
+    full-batch single-device run (round-1 weakness #9; reference:
+    sync_batch_norm_op.cu)."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.core import lowering
+    from paddle_tpu.parallel import env as penv
+
+    devs = jax.devices("cpu")
+    if len(devs) < 4:
+        pytest.skip("needs 4 devices")
+
+    B, C, H, W = 16, 4, 3, 3
+
+    def build(sync):
+        prog, startup = framework.Program(), framework.Program()
+        prog.random_seed = startup.random_seed = 19
+        with framework.program_guard(prog, startup):
+            x = fluid.layers.data("x", [C, H, W])
+            y = fluid.layers.data("y", [1])
+            h = fluid.layers.batch_norm(x, act="relu", sync=sync)
+            pool = fluid.layers.pool2d(h, pool_type="avg", global_pooling=True)
+            pred = fluid.layers.fc(pool, 1, name="bn_head")
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+        return prog, startup, loss
+
+    rng = np.random.RandomState(6)
+    xb = (rng.randn(B, C, H, W) * np.arange(1, C + 1).reshape(1, C, 1, 1)).astype("float32")
+    yb = rng.randn(B, 1).astype("float32")
+
+    # single-device full batch
+    prog, startup, loss = build(False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (l_single,) = exe.run(prog, feed={"x": xb, "y": yb}, fetch_list=[loss])
+    l_single = float(np.asarray(l_single))
+
+    def run_sharded(sync):
+        prog, startup, loss = build(sync)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            persist = {
+                v.name: scope.get(v.name)
+                for v in prog.list_vars()
+                if v.persistable and scope.get(v.name) is not None
+            }
+        fn = lowering.lower_block(prog.global_block(), ["x", "y"], [loss.name], [])
+        mesh = Mesh(np.array(devs[:4]), ("dp",))
+        penv.set_ring_axis(0, "dp")
+
+        def step(state, xs, ys):
+            with penv.active_axes(["dp"]):
+                fetches, _ = fn(dict(state), {"x": xs, "y": ys})
+            return jax.lax.pmean(fetches[0], "dp")
+
+        sharded = jax.jit(jax.shard_map(
+            step, mesh=mesh, in_specs=(P(), P("dp"), P("dp")), out_specs=P(),
+            check_vma=False,
+        ))
+        return float(np.asarray(sharded(persist, xb, yb)))
+
+    l_sync = run_sharded(True)
+    l_local = run_sharded(False)
+    # sync BN == full-batch stats: exact parity with single device
+    np.testing.assert_allclose(l_sync, l_single, rtol=1e-5)
+    # per-shard BN differs (different normalization statistics)
+    assert abs(l_local - l_single) > 1e-6
