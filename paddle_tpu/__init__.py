@@ -53,6 +53,8 @@ from paddle_tpu import native
 from paddle_tpu.fluid_dataset import DatasetFactory, InMemoryDataset, QueueDataset
 from paddle_tpu import profiler
 from paddle_tpu import memory
+from paddle_tpu import trainer_desc
+from paddle_tpu.trainer_desc import TrainerFactory
 from paddle_tpu import io_fs
 from paddle_tpu import incubate
 from paddle_tpu import io

@@ -1,6 +1,6 @@
 """Model compression (reference: python/paddle/fluid/contrib/slim/ —
 quantization QAT passes, distillation, pruning, NAS).  Surface:
 quantization-aware training rewrite, magnitude pruning with in-graph
-masks, distillation losses + program merge.  NAS (simulated annealing
-searcher) remains an open parity item."""
-from paddle_tpu.contrib.slim import distillation, prune, quantization  # noqa: F401
+masks, distillation losses + program merge, NAS simulated-annealing
+controller."""
+from paddle_tpu.contrib.slim import distillation, nas, prune, quantization  # noqa: F401

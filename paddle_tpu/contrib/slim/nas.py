@@ -1,0 +1,75 @@
+"""Neural-architecture search controller (reference: python/paddle/fluid/
+contrib/slim/nas/ — light_nas_strategy.py + the simulated-annealing
+controller in controller.py / sa_controller).
+
+Pure host-side search logic: tokens index a ``range_table`` of per-slot
+choice counts; ``next_tokens`` perturbs the best-so-far, ``update``
+accepts by the Metropolis criterion with geometric temperature decay.
+Model construction from tokens is the user's search space function, as
+in the reference.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["SAController"]
+
+
+class SAController:
+    def __init__(self, range_table: Sequence[int], reduce_rate: float = 0.85,
+                 init_temperature: float = 1024.0, max_try_times: int = 300,
+                 init_tokens: Optional[Sequence[int]] = None, seed: int = 0):
+        self._range_table = [int(r) for r in range_table]
+        self._reduce_rate = float(reduce_rate)
+        self._temperature = float(init_temperature)
+        self._max_try_times = int(max_try_times)
+        self._rng = np.random.RandomState(seed)
+        self._tokens = (
+            [int(t) for t in init_tokens]
+            if init_tokens is not None
+            else [int(self._rng.randint(0, r)) for r in self._range_table]
+        )
+        self._reward = -float("inf")
+        self.best_tokens = list(self._tokens)
+        self.max_reward = -float("inf")
+        self._iter = 0
+
+    @property
+    def current_tokens(self) -> List[int]:
+        return list(self._tokens)
+
+    def next_tokens(self, control_token: Optional[Sequence[int]] = None,
+                    constraint=None) -> List[int]:
+        """Perturb one random slot of the current tokens.  With a
+        ``constraint(tokens) -> bool`` (e.g. a FLOPs budget), resample up
+        to ``max_try_times`` until it holds (reference sa_controller
+        retry loop)."""
+        for _ in range(self._max_try_times):
+            base = list(control_token) if control_token is not None else list(self._tokens)
+            idx = int(self._rng.randint(0, len(base)))
+            base[idx] = int(self._rng.randint(0, self._range_table[idx]))
+            if constraint is None or constraint(base):
+                return base
+        raise RuntimeError(
+            "no tokens satisfying the constraint in %d tries" % self._max_try_times
+        )
+
+    def update(self, tokens: Sequence[int], reward: float) -> bool:
+        """Metropolis accept/reject; returns True when accepted.  Also
+        tracks the best-ever (tokens, reward)."""
+        self._iter += 1
+        self._temperature *= self._reduce_rate
+        reward = float(reward)
+        accept = reward > self._reward or self._rng.uniform() < math.exp(
+            min(0.0, (reward - self._reward)) / max(self._temperature, 1e-9)
+        )
+        if accept:
+            self._tokens = list(tokens)
+            self._reward = reward
+        if reward > self.max_reward:
+            self.max_reward = reward
+            self.best_tokens = list(tokens)
+        return bool(accept)

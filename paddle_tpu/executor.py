@@ -359,10 +359,29 @@ class Executor:
     # ------------------------------------------------------------------
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
-                           fetch_info=None, print_period=100):
+                           fetch_info=None, print_period=100,
+                           trainer_desc=None):
         """Loop the dataset's batches through run() (reference:
         executor.py train_from_dataset -> C++ Trainer/DeviceWorker loop,
-        trainer.h:38; here the compiled step is the device worker)."""
+        trainer.h:38; here the compiled step is the device worker).
+
+        ``trainer_desc`` (trainer_desc.py): supplies fetch config
+        defaults and validates that the chosen device worker matches the
+        program (Section needs a PipelineOptimizer-cut program,
+        DownpourSGD needs distributed lookup tables)."""
+        if trainer_desc is not None:
+            worker = trainer_desc._worker
+            if worker.worker_kind == "Section" and not getattr(program, "_pipeline_plan", None):
+                raise ValueError(
+                    "Section worker needs a PipelineOptimizer(cut_list=...) program"
+                )
+            if worker.worker_kind == "DownpourSGD" and not getattr(program, "_distributed_tables", None):
+                raise ValueError(
+                    "DownpourSGD worker needs embedding(is_distributed=True) tables"
+                )
+            fetch_list = fetch_list or trainer_desc._fetch_vars
+            fetch_info = fetch_info or trainer_desc._fetch_info
+            print_period = trainer_desc._print_period
         results = []
         for i, feed in enumerate(dataset):
             out = self.run(program, feed=feed, fetch_list=fetch_list, scope=scope)

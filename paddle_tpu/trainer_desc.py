@@ -1,0 +1,131 @@
+"""Trainer / DeviceWorker descriptors (reference: framework/trainer.h:38
+TrainerBase/MultiTrainer/DistMultiTrainer/PipelineTrainer,
+device_worker.h:103 Hogwild/Downpour/Section workers, trainer_desc.proto,
+python/paddle/fluid/trainer_desc.py + trainer_factory.py).
+
+TPU-native mapping: the reference's thread-pool of device workers
+interpreting ops is replaced by ONE compiled step (executor.py), so
+these descriptors configure HOW ``Executor.train_from_dataset`` drives
+that step rather than spawning thread workers:
+
+* ``HogwildWorker``  -> plain compiled step per batch (the lock-free
+  shared-scope semantics are subsumed: XLA's dataflow has no races).
+* ``DownpourWorker`` -> compiled step + distributed-table prefetch/push
+  (executor._prefetch_distributed_tables; async via the Communicator).
+* ``SectionWorker``  -> the compiled GPipe pipeline
+  (parallel/pipeline_program.py; PipelineOptimizer cut_list).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+__all__ = [
+    "TrainerDesc", "MultiTrainer", "DistMultiTrainer", "PipelineTrainer",
+    "DeviceWorker", "Hogwild", "DownpourSGD", "Section",
+    "TrainerFactory",
+]
+
+
+class DeviceWorker:
+    """Base device-worker descriptor (device_worker.h:103)."""
+
+    worker_kind = "Hogwild"
+
+    def __init__(self):
+        self._fleet_desc = None
+        self._program = None
+
+    def _set_fleet_desc(self, desc):
+        self._fleet_desc = desc
+
+    def _set_program(self, program):
+        self._program = program
+
+
+class Hogwild(DeviceWorker):
+    """Lock-free shared-scope SGD worker (hogwild_worker.cc) — on TPU
+    the compiled step is race-free by construction."""
+
+    worker_kind = "Hogwild"
+
+
+class DownpourSGD(DeviceWorker):
+    """PS pull/push worker (downpour_worker.cc) — maps to the
+    distributed-lookup-table prefetch/push the executor already does for
+    programs with ``embedding(is_distributed=True)``."""
+
+    worker_kind = "DownpourSGD"
+
+
+class Section(DeviceWorker):
+    """Pipeline stage worker (section_worker.cc:141) — maps to the
+    compiled GPipe schedule (PipelineOptimizer with cut_list)."""
+
+    worker_kind = "Section"
+
+    def __init__(self, num_microbatches: int = 1):
+        super().__init__()
+        self.num_microbatches = num_microbatches
+
+
+class TrainerDesc:
+    """reference: trainer_desc.proto:21 + python trainer_desc.py."""
+
+    def __init__(self):
+        self._worker: DeviceWorker = Hogwild()
+        self._fetch_vars: List = []
+        self._fetch_info: List[str] = []
+        self._print_period = 100
+        self.thread_num = 1
+
+    def set_device_worker(self, worker: DeviceWorker):
+        self._worker = worker
+
+    def set_fetch_var_and_info(self, fetch_vars, fetch_info, print_period):
+        self._fetch_vars = list(fetch_vars or [])
+        self._fetch_info = list(fetch_info or [])
+        self._print_period = print_period
+
+    def set_thread(self, n: int):
+        self.thread_num = n  # informational: one compiled step serves all
+
+
+class MultiTrainer(TrainerDesc):
+    """Single-node multi-thread trainer (trainer.h:63) — one compiled
+    step; thread_num is accepted for parity."""
+
+
+class DistMultiTrainer(TrainerDesc):
+    """PS-distributed trainer (trainer.h:81) — pair with DownpourSGD and
+    bind_distributed_tables."""
+
+
+class PipelineTrainer(TrainerDesc):
+    """Pipeline trainer (trainer.h:95) — pair with Section and a
+    PipelineOptimizer-cut program."""
+
+
+class TrainerFactory:
+    """reference: trainer_factory.cc + python trainer_factory.py."""
+
+    _TRAINERS = {
+        "MultiTrainer": MultiTrainer,
+        "DistMultiTrainer": DistMultiTrainer,
+        "PipelineTrainer": PipelineTrainer,
+    }
+    _WORKERS = {
+        "Hogwild": Hogwild,
+        "DownpourSGD": DownpourSGD,
+        "Section": Section,
+    }
+
+    def create_trainer(self, opt_info: Optional[dict] = None) -> TrainerDesc:
+        opt_info = opt_info or {}
+        trainer = self._TRAINERS[opt_info.get("trainer", "MultiTrainer")]()
+        kind = opt_info.get("device_worker", "Hogwild")
+        if kind == "Section":
+            worker = Section(num_microbatches=int(opt_info.get("num_microbatches", 1)))
+        else:
+            worker = self._WORKERS[kind]()
+        trainer.set_device_worker(worker)
+        return trainer

@@ -191,3 +191,20 @@ def test_slim_distillation_soft_label():
             for _ in range(60)
         ]
     assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_slim_nas_sa_controller_optimizes():
+    """Simulated-annealing NAS controller climbs a known reward surface
+    (reference: slim/nas sa_controller)."""
+    from paddle_tpu.contrib.slim.nas import SAController
+
+    target = [3, 1, 4, 1, 5]
+    ctrl = SAController([8] * 5, init_temperature=10.0, reduce_rate=0.9, seed=3)
+
+    def reward(tokens):
+        return -sum((a - b) ** 2 for a, b in zip(tokens, target))
+
+    for _ in range(300):
+        cand = ctrl.next_tokens()
+        ctrl.update(cand, reward(cand))
+    assert reward(ctrl.best_tokens) >= -2, (ctrl.best_tokens, ctrl.max_reward)

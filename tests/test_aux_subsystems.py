@@ -166,3 +166,65 @@ def test_eager_shape_error_at_append_op():
                 outputs={"Out": ["sc"]},
                 attrs={"transpose_X": False, "transpose_Y": False, "alpha": 1.0},
             )
+
+
+def test_trainer_factory_surface():
+    """Trainer/DeviceWorker descriptor surface (trainer.h:38,
+    device_worker.h:103 analogs)."""
+    from paddle_tpu.trainer_desc import (
+        DistMultiTrainer, DownpourSGD, Hogwild, MultiTrainer,
+        PipelineTrainer, Section, TrainerFactory,
+    )
+
+    t = TrainerFactory().create_trainer()
+    assert isinstance(t, MultiTrainer) and isinstance(t._worker, Hogwild)
+    t2 = TrainerFactory().create_trainer(
+        {"trainer": "DistMultiTrainer", "device_worker": "DownpourSGD"}
+    )
+    assert isinstance(t2, DistMultiTrainer) and isinstance(t2._worker, DownpourSGD)
+    t3 = TrainerFactory().create_trainer(
+        {"trainer": "PipelineTrainer", "device_worker": "Section"}
+    )
+    assert isinstance(t3, PipelineTrainer) and t3._worker.worker_kind == "Section"
+    t3.set_fetch_var_and_info(["loss"], ["loss"], 10)
+    t3.set_thread(4)
+
+
+def test_trainer_desc_wired_into_train_from_dataset():
+    """TrainerDesc is consumed: worker/program mismatch raises; fetch
+    config defaults flow through."""
+    import pytest
+    from paddle_tpu import framework
+    from paddle_tpu.trainer_desc import TrainerFactory
+
+    prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.data("y", [1])
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(fluid.layers.fc(x, 1), y)
+        )
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    sec = TrainerFactory().create_trainer(
+        {"trainer": "PipelineTrainer", "device_worker": "Section",
+         "num_microbatches": 4}
+    )
+    assert sec._worker.num_microbatches == 4
+    with pytest.raises(ValueError, match="Section worker"):
+        exe.train_from_dataset(program=prog, dataset=[], trainer_desc=sec)
+    dps = TrainerFactory().create_trainer({"device_worker": "DownpourSGD"})
+    with pytest.raises(ValueError, match="DownpourSGD worker"):
+        exe.train_from_dataset(program=prog, dataset=[], trainer_desc=dps)
+    # Hogwild + fetch config defaults: runs the loop
+    hog = TrainerFactory().create_trainer()
+    hog.set_fetch_var_and_info([loss], ["loss"], 1)
+    rng = np.random.RandomState(0)
+    feed = [{"x": rng.rand(8, 4).astype("float32"),
+             "y": rng.rand(8, 1).astype("float32")} for _ in range(3)]
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        out = exe.train_from_dataset(program=prog, dataset=feed,
+                                     scope=scope, trainer_desc=hog)
+    assert len(out) == 3
