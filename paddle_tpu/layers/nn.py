@@ -777,13 +777,13 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
 def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
         bias_attr=None, num_neg_samples=10, name=None, sampler="uniform",
         custom_dist=None, seed=0, is_sparse=False):
-    """Noise-contrastive estimation loss -> [B, 1] cost.  Only the
-    uniform sampler is implemented (its log(k*P) correction is baked into
-    the kernel)."""
-    if sampler != "uniform" or custom_dist is not None or sample_weight is not None:
+    """Noise-contrastive estimation loss -> [B, 1] cost.  uniform and
+    log_uniform (Zipfian) samplers with their log(k*P) corrections;
+    custom_dist remains open."""
+    if sampler not in ("uniform", "log_uniform") or custom_dist is not None or sample_weight is not None:
         raise NotImplementedError(
-            "nce supports sampler='uniform' without custom_dist/"
-            "sample_weight; log_uniform/custom samplers are open parity items"
+            "nce supports sampler='uniform'|'log_uniform' without "
+            "custom_dist/sample_weight"
         )
     helper = LayerHelper("nce", param_attr=param_attr, bias_attr=bias_attr, name=name)
     dim = input.shape[-1]
@@ -795,7 +795,7 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
         ins["Bias"] = [b]
     helper.append_op(
         type="nce", inputs=ins, outputs={"Cost": [cost]},
-        attrs={"num_neg_samples": num_neg_samples, "seed": seed},
+        attrs={"num_neg_samples": num_neg_samples, "seed": seed, "sampler": sampler},
     )
     return cost
 

@@ -608,22 +608,42 @@ def nce(inputs, attrs):
     b = maybe(inputs, "Bias")
     V = w.shape[0]
     k = int(attrs.get("num_neg_samples", 10))
+    sampler = attrs.get("sampler", "uniform")
     # fresh negatives per distinct batch: fold the labels into the key
     # (a constant key would reuse the same k negatives forever; identical
     # repeated batches still get identical draws — deterministic)
     key = jax.random.fold_in(
         prng(int(attrs.get("seed", 0))), jnp.sum(label).astype(jnp.uint32)
     )
-    neg = jax.random.randint(key, (k,), 0, V)  # shared negatives per batch
-    log_kp = jnp.log(k / V)  # uniform sampler: log(k * P(w)), P = 1/V
+    if sampler == "log_uniform":
+        # Zipfian P(c) = log((c+2)/(c+1)) / log(V+1); inverse-CDF draw
+        # c = floor(exp(u*log(V+1))) - 1 (the reference's LogUniformSampler,
+        # operators/math/sampler.cc)
+        u = jax.random.uniform(key, (k,))
+        neg = jnp.clip(
+            jnp.exp(u * jnp.log(float(V + 1))).astype(jnp.int32) - 1, 0, V - 1
+        )
+
+        def logp(c):
+            # log1p keeps precision at large class ids (log((c+2)/(c+1))
+            # rounds to log(1.0) = 0 in fp32 once c+1 >= 2^24)
+            cf = c.astype(jnp.float32)
+            return jnp.log(jnp.log1p(1.0 / (cf + 1.0)) / jnp.log(float(V + 1)))
+
+        log_kp_true = jnp.log(float(k)) + logp(label)      # [B]
+        log_kp_neg = jnp.log(float(k)) + logp(neg)         # [k]
+    else:  # uniform
+        neg = jax.random.randint(key, (k,), 0, V)
+        log_kp_true = jnp.full((label.shape[0],), jnp.log(k / V))
+        log_kp_neg = jnp.full((k,), jnp.log(k / V))
 
     true_logit = jnp.sum(x * w[label], axis=-1)
     neg_logit = x @ w[neg].T  # [B, k]
     if b is not None:
         true_logit = true_logit + b.reshape(-1)[label]
         neg_logit = neg_logit + b.reshape(-1)[neg][None, :]
-    pos_cost = jax.nn.softplus(-(true_logit - log_kp))
-    neg_cost = jnp.sum(jax.nn.softplus(neg_logit - log_kp), axis=-1)
+    pos_cost = jax.nn.softplus(-(true_logit - log_kp_true))
+    neg_cost = jnp.sum(jax.nn.softplus(neg_logit - log_kp_neg[None, :]), axis=-1)
     cost = pos_cost + neg_cost
     return {"Cost": cost.reshape(-1, 1)}
 

@@ -242,9 +242,10 @@ class TestBipartiteMatchOp(OpTest):
 
 
 def test_nce_and_hsigmoid_train():
-    """NCE and hierarchical sigmoid train a small classifier (loss
-    decreases) — the reference's usage-level guarantee."""
-    for kind in ("nce", "hsigmoid"):
+    """NCE (uniform + log_uniform samplers) and hierarchical sigmoid
+    train a small classifier (loss decreases) — the reference's
+    usage-level guarantee."""
+    for kind in ("nce", "nce_logu", "hsigmoid"):
         prog, startup = framework.Program(), framework.Program()
         prog.random_seed = startup.random_seed = 71
         with framework.program_guard(prog, startup):
@@ -253,6 +254,9 @@ def test_nce_and_hsigmoid_train():
             h = fluid.layers.fc(x, 16, act="tanh")
             if kind == "nce":
                 cost = fluid.layers.nce(h, y, num_total_classes=20, num_neg_samples=5)
+            elif kind == "nce_logu":
+                cost = fluid.layers.nce(h, y, num_total_classes=20,
+                                        num_neg_samples=5, sampler="log_uniform")
             else:
                 cost = fluid.layers.hsigmoid(h, y, num_classes=20)
             loss = fluid.layers.mean(cost)
