@@ -83,7 +83,14 @@ class Executor:
         scope: Optional[Scope] = None,
         return_numpy: bool = True,
         use_program_cache: bool = True,
+        steps: int = 1,
     ):
+        """``steps`` (TPU-native extension): run N optimizer steps inside ONE
+        jitted call (a ``lax.fori_loop`` over the compiled step, same feed
+        each iteration), returning the last step's fetches.  Amortizes the
+        per-dispatch host->device overhead — the analog of the reference's
+        multi-iteration DeviceWorker loop (device_worker.h TrainFiles runs
+        many batches per Run call)."""
         import jax
 
         compiled = None
@@ -97,6 +104,8 @@ class Executor:
         fetch_names = [_as_fetch_name(f) for f in (fetch_list or [])]
 
         if getattr(program, "_pipeline_plan", None):
+            if steps != 1:
+                raise ValueError("steps>1 is not supported for pipeline programs")
             return self._run_pipeline(
                 program, feed, fetch_names, scope, return_numpy
             )
@@ -126,6 +135,12 @@ class Executor:
             # from the caller's fetch list (appended, sliced off below)
             for _, _, gname in ps_push:
                 fetch_names.append(gname)
+        if steps != 1 and (ps_push or steps < 1):
+            raise ValueError(
+                "steps=%d: multi-step run() needs steps>=1 and is "
+                "incompatible with distributed lookup tables (the PS "
+                "pull/push is host-side per batch)" % steps
+            )
 
         feed_names = tuple(sorted(feed.keys()))
         state_mut = tuple(sorted((read & written & persistable)))
@@ -176,16 +191,39 @@ class Executor:
             state_out,
             getattr(self.place, "backend", None),
             id(compiled) if compiled is not None else None,
+            steps,
         )
 
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
             fn = lowering.lower_block(block, feed_names, fetch_names, state_out)
 
-            def stepfn(mut_state, ro_state, feed_dict):
-                state = dict(mut_state)
-                state.update(ro_state)
-                return fn(state, feed_dict)
+            if steps == 1:
+                def stepfn(mut_state, ro_state, feed_dict):
+                    state = dict(mut_state)
+                    state.update(ro_state)
+                    return fn(state, feed_dict)
+            else:
+                def stepfn(mut_state, ro_state, feed_dict):
+                    # carry (mut, fetches, extras) with extras = written-but-
+                    # not-carried state, so no array appears twice in the
+                    # loop carry (a duplicated param forces a copy per
+                    # iteration)
+                    def one(mut):
+                        state = dict(mut)
+                        state.update(ro_state)
+                        fetches, new_state = fn(state, feed_dict)
+                        nxt = {n: new_state.get(n, mut[n]) for n in mut}
+                        extras = {
+                            n: v for n, v in new_state.items() if n not in mut
+                        }
+                        return nxt, fetches, extras
+
+                    carry = one(mut_state)
+                    mut, fetches, extras = jax.lax.fori_loop(
+                        0, steps - 1, lambda i, c: one(c[0]), carry
+                    )
+                    return fetches, {**mut, **extras}
 
             jit_kwargs = {"donate_argnums": (0,)}
             if compiled is not None:

@@ -1,12 +1,21 @@
 """Detection layers (reference: python/paddle/fluid/layers/detection.py —
-prior_box, box_coder, iou_similarity, yolo_box, multiclass_nms...)."""
+prior_box, box_coder, iou_similarity, yolo_box, multiclass_nms,
+yolov3_loss, ssd_loss, mine_hard_examples, density_prior_box...).
+
+Ragged gt convention: the reference feeds ground truth as LoD tensors;
+here gt boxes/labels are padded to [N, B, ...] with zero-area boxes
+(w/h <= 1e-6) marking padding rows — the framework-wide padded+mask
+convention (SURVEY.md LoD mapping)."""
 from __future__ import annotations
 
 from paddle_tpu.layer_helper import LayerHelper
 
 __all__ = ["prior_box", "box_coder", "iou_similarity", "yolo_box", "multiclass_nms",
            "anchor_generator", "box_clip", "roi_align", "roi_pool",
-           "bipartite_match", "target_assign"]
+           "bipartite_match", "target_assign", "yolov3_loss", "ssd_loss",
+           "mine_hard_examples", "density_prior_box", "sigmoid_focal_loss",
+           "multi_box_head", "detection_output", "rpn_target_assign",
+           "generate_proposals", "detection_map"]
 
 
 def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
@@ -181,3 +190,403 @@ def target_assign(input, matched_indices, mismatch_value=0, name=None):
         attrs={"mismatch_value": mismatch_value},
     )
     return out, w
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None):
+    """YOLOv3 training loss (reference: layers/detection.py yolov3_loss +
+    operators/detection/yolov3_loss_op.cc).  Returns the per-image loss
+    [N].  gt_box [N, B, 4] normalized center-form; padding rows are
+    zero-area boxes."""
+    helper = LayerHelper("yolov3_loss", name=name)
+    loss = helper.create_variable_for_type_inference(x.dtype)
+    obj_mask = helper.create_variable_for_type_inference(x.dtype)
+    gt_match = helper.create_variable_for_type_inference("int32")
+    inputs = {"X": [x], "GTBox": [gt_box], "GTLabel": [gt_label]}
+    if gt_score is not None:
+        inputs["GTScore"] = [gt_score]
+    helper.append_op(
+        type="yolov3_loss",
+        inputs=inputs,
+        outputs={"Loss": [loss], "ObjectnessMask": [obj_mask],
+                 "GTMatchMask": [gt_match]},
+        attrs={
+            "anchors": list(anchors),
+            "anchor_mask": list(anchor_mask),
+            "class_num": class_num,
+            "ignore_thresh": ignore_thresh,
+            "downsample_ratio": downsample_ratio,
+            "use_label_smooth": use_label_smooth,
+        },
+    )
+    obj_mask.stop_gradient = True
+    gt_match.stop_gradient = True
+    return loss
+
+
+def mine_hard_examples(cls_loss, match_indices, match_dist,
+                       neg_pos_ratio=3.0, neg_dist_threshold=0.5,
+                       mining_type="max_negative", sample_size=None,
+                       name=None):
+    """reference: ssd_loss's mine_hard_examples op append
+    (layers/detection.py:1408).  NegIndices is a [N, M] 0/1 mask (padded
+    analog of the reference's LoD index list)."""
+    helper = LayerHelper("mine_hard_examples", name=name)
+    neg = helper.create_variable_for_type_inference("int32")
+    updated = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="mine_hard_examples",
+        inputs={"ClsLoss": [cls_loss], "MatchIndices": [match_indices],
+                "MatchDist": [match_dist]},
+        outputs={"NegIndices": [neg], "UpdatedMatchIndices": [updated]},
+        attrs={"neg_pos_ratio": neg_pos_ratio,
+               "neg_dist_threshold": neg_dist_threshold,
+               "mining_type": mining_type,
+               "sample_size": sample_size or 0},
+    )
+    neg.stop_gradient = True
+    updated.stop_gradient = True
+    return neg, updated
+
+
+def target_assign_ex(input, matched_indices, negative_indices=None,
+                     mismatch_value=0, name=None):
+    """target_assign with the optional NegIndices mask input (the public
+    target_assign signature stays reference-compatible)."""
+    helper = LayerHelper("target_assign", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    w = helper.create_variable_for_type_inference("float32")
+    ins = {"X": [input], "MatchIndices": [matched_indices]}
+    if negative_indices is not None:
+        ins["NegIndices"] = [negative_indices]
+    helper.append_op(
+        type="target_assign", inputs=ins,
+        outputs={"Out": [out], "OutWeight": [w]},
+        attrs={"mismatch_value": mismatch_value},
+    )
+    out.stop_gradient = True
+    w.stop_gradient = True
+    return out, w
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True, sample_size=None):
+    """SSD multibox loss (reference: layers/detection.py:1246 ssd_loss) —
+    the same 5-step composition (match -> conf loss -> hard-negative
+    mining -> target assign -> weighted loss) over the padded gt
+    convention: gt_box [N, B, 4], gt_label [N, B] (or [N, B, 1]) with
+    zero-area boxes marking padding.
+
+    location [N, P, 4]; confidence [N, P, C]; prior_box [P, 4].
+    Returns the weighted loss [N*P, 1] like the reference."""
+    from paddle_tpu.layers import nn, tensor
+
+    if mining_type != "max_negative":
+        raise ValueError("Only support mining_type == max_negative now.")
+    N, P, C = confidence.shape
+
+    # 1. match priors to gt: IoU [N, B, P] -> dist [N, P_rows, B_cols]
+    iou = iou_similarity(gt_box, prior_box)
+    dist = tensor.transpose(iou, [0, 2, 1])
+    matched_indices, matched_dist = bipartite_match(
+        dist, match_type, overlap_threshold
+    )
+
+    # 2. first-pass conf loss for mining
+    if len(gt_label.shape) == 2:
+        gt_label = tensor.reshape(gt_label, shape=[0, -1, 1])
+    gt_label.stop_gradient = True
+    target_label, _ = target_assign(
+        gt_label, matched_indices, mismatch_value=background_label
+    )
+    conf2d = tensor.reshape(confidence, shape=[-1, C])
+    tl2d = tensor.reshape(tensor.cast(target_label, "int64"), shape=[-1, 1])
+    tl2d.stop_gradient = True
+    conf_loss = nn.softmax_with_cross_entropy(conf2d, tl2d)
+    conf_loss_nm = tensor.reshape(conf_loss, shape=[-1, P])
+    conf_loss_nm.stop_gradient = True
+
+    # 3. mine hard negatives
+    neg_mask, updated_match = mine_hard_examples(
+        conf_loss_nm, matched_indices, matched_dist,
+        neg_pos_ratio=neg_pos_ratio, neg_dist_threshold=neg_overlap,
+        mining_type=mining_type, sample_size=sample_size,
+    )
+
+    # 4. regression / classification targets
+    encoded_bbox = box_coder(
+        prior_box=prior_box, prior_box_var=prior_box_var,
+        target_box=gt_box, code_type="encode_center_size",
+    )  # [N, B, P, 4]
+    target_bbox, target_loc_weight = target_assign_ex(
+        encoded_bbox, updated_match, mismatch_value=background_label
+    )
+    target_label2, target_conf_weight = target_assign_ex(
+        gt_label, updated_match, negative_indices=neg_mask,
+        mismatch_value=background_label,
+    )
+
+    # 5. weighted losses
+    tl2 = tensor.reshape(tensor.cast(target_label2, "int64"), shape=[-1, 1])
+    tl2.stop_gradient = True
+    conf_loss = nn.softmax_with_cross_entropy(conf2d, tl2)
+    conf_w = tensor.reshape(target_conf_weight, shape=[-1, 1])
+    conf_loss = tensor.elementwise_mul(conf_loss, conf_w)
+
+    loc2d = tensor.reshape(location, shape=[-1, 4])
+    tb2d = tensor.reshape(target_bbox, shape=[-1, 4])
+    tb2d.stop_gradient = True
+    loc_loss = nn.smooth_l1(loc2d, tb2d)
+    loc_w = tensor.reshape(target_loc_weight, shape=[-1, 1])
+    loc_loss = tensor.elementwise_mul(loc_loss, loc_w)
+
+    loss = tensor.elementwise_add(
+        tensor.scale(conf_loss, scale=conf_loss_weight),
+        tensor.scale(loc_loss, scale=loc_loss_weight),
+    )
+    loss = tensor.reshape(loss, shape=[-1, P])
+    loss = tensor.reduce_sum(loss, dim=1, keep_dim=True)
+    if normalize:
+        normalizer = tensor.reduce_sum(target_loc_weight)
+        loss = tensor.elementwise_div(loss, normalizer)
+    return tensor.reshape(loss, shape=[-1, 1])
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=(0.1, 0.1, 0.2, 0.2),
+                      clip=False, steps=(0.0, 0.0), offset=0.5,
+                      flatten_to_2d=False, name=None):
+    """reference: layers/detection.py:1608 density_prior_box."""
+    from paddle_tpu.layers import tensor
+
+    helper = LayerHelper("density_prior_box", name=name)
+    boxes = helper.create_variable_for_type_inference("float32")
+    var = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="density_prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [var]},
+        attrs={
+            "densities": list(densities or []),
+            "fixed_sizes": list(fixed_sizes or []),
+            "fixed_ratios": list(fixed_ratios or [1.0]),
+            "variances": list(variance),
+            "clip": clip,
+            "step_w": steps[0],
+            "step_h": steps[1],
+            "offset": offset,
+        },
+    )
+    boxes.stop_gradient = var.stop_gradient = True
+    if flatten_to_2d:
+        boxes = tensor.reshape(boxes, shape=[-1, 4])
+        var = tensor.reshape(var, shape=[-1, 4])
+    return boxes, var
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2, alpha=0.25):
+    """reference: layers/detection.py:372 sigmoid_focal_loss."""
+    helper = LayerHelper("sigmoid_focal_loss")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="sigmoid_focal_loss",
+        inputs={"X": [x], "Label": [label], "FgNum": [fg_num]},
+        outputs={"Out": [out]},
+        attrs={"gamma": gamma, "alpha": alpha},
+    )
+    return out
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None):
+    """SSD detection head (reference: layers/detection.py:1737
+    multi_box_head): per feature map, a conv predicting [loc, conf] per
+    prior + the matching prior boxes; results concatenated over maps.
+
+    Returns (mbox_locs [N, P, 4], mbox_confs [N, P, C],
+    prior_boxes [P, 4], variances [P, 4])."""
+    from paddle_tpu.layers import nn, tensor
+
+    n_maps = len(inputs)
+    if min_sizes is None:
+        # the reference's ratio interpolation (detection.py:1898)
+        min_sizes, max_sizes = [], []
+        if min_ratio is None or max_ratio is None:
+            raise ValueError("either min_sizes/max_sizes or min_ratio/max_ratio")
+        step = int((max_ratio - min_ratio) / (n_maps - 2)) if n_maps > 2 else 0
+        min_sizes = [base_size * 0.1]
+        max_sizes = [base_size * 0.2]
+        for ratio in range(min_ratio, max_ratio + 1, max(step, 1)):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = min_sizes[:n_maps]
+        max_sizes = max_sizes[:n_maps]
+
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, feat in enumerate(inputs):
+        ms = min_sizes[i]
+        mx = max_sizes[i] if max_sizes else None
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[0], (list, tuple)) else aspect_ratios
+        st = steps[i] if steps else (step_w[i] if step_w else 0.0,
+                                     step_h[i] if step_h else 0.0)
+        if not isinstance(st, (list, tuple)):
+            st = (st, st)
+        box, var = prior_box(
+            feat, image, [ms] if not isinstance(ms, (list, tuple)) else ms,
+            [mx] if mx and not isinstance(mx, (list, tuple)) else mx,
+            ar, variance, flip, clip, st, offset,
+        )
+        box = tensor.reshape(box, shape=[-1, 4])
+        var = tensor.reshape(var, shape=[-1, 4])
+        # prior count per cell is derived from the prior_box output size
+        # at compile time: total / (H*W)
+        hw = feat.shape[2] * feat.shape[3]
+        num_priors = box.shape[0] // hw
+
+        loc = nn.conv2d(feat, num_filters=num_priors * 4,
+                        filter_size=kernel_size, padding=pad, stride=stride)
+        conf = nn.conv2d(feat, num_filters=num_priors * num_classes,
+                         filter_size=kernel_size, padding=pad, stride=stride)
+        # [N, A*4, H, W] -> [N, H, W, A*4] -> [N, H*W*A, 4]
+        loc = tensor.transpose(loc, [0, 2, 3, 1])
+        conf = tensor.transpose(conf, [0, 2, 3, 1])
+        locs.append(tensor.reshape(loc, shape=[0, -1, 4]))
+        confs.append(tensor.reshape(conf, shape=[0, -1, num_classes]))
+        boxes_all.append(box)
+        vars_all.append(var)
+
+    mbox_locs = tensor.concat(locs, axis=1)
+    mbox_confs = tensor.concat(confs, axis=1)
+    prior_boxes = tensor.concat(boxes_all, axis=0)
+    box_vars = tensor.concat(vars_all, axis=0)
+    prior_boxes.stop_gradient = box_vars.stop_gradient = True
+    return mbox_locs, mbox_confs, prior_boxes, box_vars
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
+    """reference: layers/detection.py:440 detection_output — decode
+    loc vs priors then multiclass NMS.  Returns [N, keep_top_k, 6]
+    padded with label -1 (static-shape analog of the LoD output)."""
+    from paddle_tpu.layers import nn, tensor
+
+    decoded = box_coder(
+        prior_box=prior_box, prior_box_var=prior_box_var, target_box=loc,
+        code_type="decode_center_size",
+    )
+    scores = nn.softmax(scores)
+    scores = tensor.transpose(scores, [0, 2, 1])  # [N, C, P]
+    return multiclass_nms(
+        decoded, scores, score_threshold=score_threshold,
+        nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+        nms_threshold=nms_threshold, normalized=False,
+    )
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """reference: layers/detection.py:221 rpn_target_assign.
+
+    Static-shape variant: instead of gathering sampled anchors into
+    compact LoD tensors, returns full-anchor tensors + weights —
+    (predicted_scores [N, A, 1], predicted_location [N, A, 4],
+    target_label [N, A, 1] (-1 = unsampled), target_bbox [N, A, 4],
+    bbox_inside_weight [N, A, 4], score_weight [N, A, 1]).  Sampling is
+    the deterministic use_random=False reference path; multiply the
+    score loss by score_weight and the loc loss by bbox_inside_weight to
+    reproduce the reference objective."""
+    from paddle_tpu.layers import tensor
+
+    helper = LayerHelper("rpn_target_assign")
+    label = helper.create_variable_for_type_inference("int32")
+    tgt_bbox = helper.create_variable_for_type_inference("float32")
+    loc_w = helper.create_variable_for_type_inference("float32")
+    score_w = helper.create_variable_for_type_inference("float32")
+    ins = {"Anchor": [anchor_box], "GtBoxes": [gt_boxes]}
+    if im_info is not None:
+        ins["ImInfo"] = [im_info]
+    helper.append_op(
+        type="rpn_target_assign", inputs=ins,
+        outputs={"TargetLabel": [label], "TargetBBox": [tgt_bbox],
+                 "LocWeight": [loc_w], "ScoreWeight": [score_w]},
+        attrs={"rpn_batch_size_per_im": rpn_batch_size_per_im,
+               "rpn_straddle_thresh": rpn_straddle_thresh,
+               "rpn_fg_fraction": rpn_fg_fraction,
+               "rpn_positive_overlap": rpn_positive_overlap,
+               "rpn_negative_overlap": rpn_negative_overlap},
+    )
+    for v in (label, tgt_bbox, loc_w, score_w):
+        v.stop_gradient = True
+    A = anchor_box.shape[0]
+    label3 = tensor.reshape(label, shape=[0, A, 1])
+    locw3 = tensor.reshape(loc_w, shape=[0, A, 1])
+    scw3 = tensor.reshape(score_w, shape=[0, A, 1])
+    bbox_inside_weight = tensor.expand(locw3, expand_times=[1, 1, 4])
+    return (cls_logits, bbox_pred, label3, tgt_bbox,
+            bbox_inside_weight, scw3)
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None):
+    """reference: layers/detection.py:2410 generate_proposals.  Returns
+    (rpn_rois [N, post_nms_top_n, 4], rpn_roi_probs [N, post_nms_top_n, 1])
+    padded with zero boxes / -1 scores (static analog of the LoD out)."""
+    helper = LayerHelper("generate_proposals", name=name)
+    rois = helper.create_variable_for_type_inference("float32")
+    probs = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="generate_proposals",
+        inputs={"Scores": [scores], "BboxDeltas": [bbox_deltas],
+                "ImInfo": [im_info], "Anchors": [anchors],
+                "Variances": [variances]},
+        outputs={"RpnRois": [rois], "RpnRoiProbs": [probs]},
+        attrs={"pre_nms_topN": pre_nms_top_n, "post_nms_topN": post_nms_top_n,
+               "nms_thresh": nms_thresh, "min_size": min_size, "eta": eta},
+    )
+    rois.stop_gradient = True
+    probs.stop_gradient = True
+    return rois, probs
+
+
+def detection_map(detect_res, label, class_num, gt_box=None,
+                  background_label=0, overlap_threshold=0.5,
+                  evaluate_difficult=True, ap_version="integral"):
+    """reference: layers/detection.py:968 detection_map — batch mAP.
+    Padded convention: detect_res [N, K, 6]; label [N, B] + gt_box
+    [N, B, 4] (the reference packs gt into one LoD tensor; here they are
+    separate padded tensors, so ``gt_box`` is required).
+    ``evaluate_difficult`` is accepted for signature parity but the
+    padded gt carries no difficult flag — every valid gt is evaluated.
+    Streaming across batches lives in metrics.DetectionMAP."""
+    if gt_box is None:
+        raise ValueError(
+            "detection_map needs gt_box: the reference packs [label, ...,"
+            " box] into one LoD tensor; the padded convention passes"
+            " labels [N, B] and boxes [N, B, 4] separately"
+        )
+    helper = LayerHelper("detection_map")
+    m = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="detection_map",
+        inputs={"DetectRes": [detect_res], "Label": [label],
+                "GtBox": [gt_box]},
+        outputs={"MAP": [m]},
+        attrs={"overlap_threshold": overlap_threshold,
+               "class_num": class_num,
+               "background_label": background_label,
+               "ap_type": ap_version},
+    )
+    m.stop_gradient = True
+    return m

@@ -103,15 +103,23 @@ def box_coder(inputs, attrs):
     if pvar is None:
         pvar = jnp.ones_like(prior)
     if "encode" in code_type:
-        tw = target[:, 2] - target[:, 0] + one_
-        th = target[:, 3] - target[:, 1] + one_
-        tcx = target[:, 0] + tw / 2.0
-        tcy = target[:, 1] + th / 2.0
-        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :] / pvar[None, :, 0]
-        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :] / pvar[None, :, 1]
-        ow = jnp.log(tw[:, None] / pw[None, :]) / pvar[None, :, 2]
-        oh = jnp.log(th[:, None] / ph[None, :]) / pvar[None, :, 3]
-        out = jnp.stack([ox, oy, ow, oh], axis=-1)  # [N, M, 4]
+        # padded-batch extension: target may be [B, N, 4] -> out [B, N, M, 4]
+        batched = target.ndim == 3
+        t = target if batched else target[None]
+        tw = t[..., 2] - t[..., 0] + one_
+        th = t[..., 3] - t[..., 1] + one_
+        tcx = t[..., 0] + tw / 2.0
+        tcy = t[..., 1] + th / 2.0
+        # avoid log(0) for zero-area padding rows; weights zero them out
+        tw = jnp.maximum(tw, 1e-10)
+        th = jnp.maximum(th, 1e-10)
+        ox = (tcx[..., None] - pcx) / pw / pvar[None, :, 0]
+        oy = (tcy[..., None] - pcy) / ph / pvar[None, :, 1]
+        ow = jnp.log(tw[..., None] / pw) / pvar[None, :, 2]
+        oh = jnp.log(th[..., None] / ph) / pvar[None, :, 3]
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)  # [B?, N, M, 4]
+        if not batched:
+            out = out[0]
     else:  # decode_center_size
         t = target  # [N, M, 4]
         dcx = pvar[None, :, 0] * t[..., 0] * pw[None, :] + pcx[None, :]
@@ -138,10 +146,16 @@ def _iou_matrix(a, b, normalized=True):
 
 @register_op("iou_similarity", differentiable=False)
 def iou_similarity(inputs, attrs):
-    """reference: detection/iou_similarity_op.cc — X [N,4] vs Y [M,4]."""
+    """reference: detection/iou_similarity_op.cc — X [N,4] vs Y [M,4].
+    Padded-batch extension: X may be [B,N,4] (the LoD batch mapped to the
+    framework-wide padded convention) -> Out [B,N,M]."""
+    jax = _jax()
     x = one(inputs, "X")
     y = one(inputs, "Y")
-    return {"Out": _iou_matrix(x, y, attrs.get("box_normalized", True))}
+    norm = attrs.get("box_normalized", True)
+    if x.ndim == 3:
+        return {"Out": jax.vmap(lambda a: _iou_matrix(a, y, norm))(x)}
+    return {"Out": _iou_matrix(x, y, norm)}
 
 
 @register_op("yolo_box", differentiable=False)
@@ -316,7 +330,13 @@ def roi_align(inputs, attrs):
     pw = int(attrs.get("pooled_width", 1))
     scale = float(attrs.get("spatial_scale", 1.0))
     ratio = int(attrs.get("sampling_ratio", -1))
-    ratio = ratio if ratio > 0 else 2
+    # sampling_ratio=-1: the reference adapts per roi,
+    # ratio = ceil(roi_size / pooled_size) (roi_align_op.cc:267).  XLA
+    # needs static shapes, so the adaptive count is computed per roi and
+    # realized by masking a static cap-sized grid — exact for rois up to
+    # cap x pooled_size (attr max_sampling_ratio, default 4; beyond that
+    # the ratio saturates at cap).
+    cap = int(attrs.get("max_sampling_ratio", 4)) if ratio <= 0 else ratio
     bidx = jnp.zeros((R,), jnp.int32) if bidx is None else bidx.reshape(R).astype(jnp.int32)
 
     x1 = rois[:, 0] * scale
@@ -327,12 +347,25 @@ def roi_align(inputs, attrs):
     rh = jnp.maximum(y2 - y1, 1.0)
     bin_w = rw / pw
     bin_h = rh / ph
+    if ratio > 0:
+        r_h = jnp.full((R,), float(ratio))
+        r_w = jnp.full((R,), float(ratio))
+    else:
+        r_h = jnp.clip(jnp.ceil(rh / ph), 1.0, cap)
+        r_w = jnp.clip(jnp.ceil(rw / pw), 1.0, cap)
 
-    # sampling grid: [R, ph*ratio] ys and [R, pw*ratio] xs
-    gy = (jnp.arange(ph * ratio, dtype=jnp.float32) + 0.5) / ratio  # in bin units
-    gx = (jnp.arange(pw * ratio, dtype=jnp.float32) + 0.5) / ratio
-    ys = y1[:, None] + gy[None, :] * bin_h[:, None]  # [R, ph*ratio]
-    xs = x1[:, None] + gx[None, :] * bin_w[:, None]  # [R, pw*ratio]
+    # sampling grid: [R, ph*cap] ys and [R, pw*cap] xs; sample k of a bin
+    # sits at (k+0.5)/r, masked out when k >= r
+    ky = jnp.arange(ph * cap) % cap
+    kx = jnp.arange(pw * cap) % cap
+    biny = jnp.arange(ph * cap) // cap
+    binx = jnp.arange(pw * cap) // cap
+    gy = biny[None, :] + (ky[None, :] + 0.5) / r_h[:, None]  # [R, ph*cap] bin units
+    gx = binx[None, :] + (kx[None, :] + 0.5) / r_w[:, None]
+    ys = y1[:, None] + gy * bin_h[:, None]  # [R, ph*cap]
+    xs = x1[:, None] + gx * bin_w[:, None]  # [R, pw*cap]
+    mask_y = (ky[None, :] < r_h[:, None]).astype(x.dtype)  # [R, ph*cap]
+    mask_x = (kx[None, :] < r_w[:, None]).astype(x.dtype)
 
     def bilinear(img, ys, xs):
         # img [C, H, W]; ys [hh], xs [ww] -> [C, hh, ww]
@@ -354,12 +387,14 @@ def roi_align(inputs, attrs):
             + v11 * wy[None, :, None] * wx[None, None, :]
         )
 
-    def per_roi(b, ys_r, xs_r):
+    def per_roi(b, ys_r, xs_r, my, mx, nsamp):
         img = x[b]  # [C, H, W]
-        sampled = bilinear(img, ys_r, xs_r)  # [C, ph*ratio, pw*ratio]
-        return sampled.reshape(C, ph, ratio, pw, ratio).mean(axis=(2, 4))
+        sampled = bilinear(img, ys_r, xs_r)  # [C, ph*cap, pw*cap]
+        w = my[:, None] * mx[None, :]  # [ph*cap, pw*cap]
+        acc = (sampled * w).reshape(C, ph, cap, pw, cap).sum(axis=(2, 4))
+        return acc / nsamp
 
-    out = jax.vmap(per_roi)(bidx, ys, xs)  # [R, C, ph, pw]
+    out = jax.vmap(per_roi)(bidx, ys, xs, mask_y, mask_x, r_h * r_w)  # [R, C, ph, pw]
     return {"Out": out}
 
 
@@ -421,7 +456,10 @@ def bipartite_match(inputs, attrs):
             flat = jnp.argmax(d_cur)
             i, j = flat // P, flat % P
             val = d_cur[i, j]
-            ok = val > NEG / 2
+            # the reference skips pairs with similarity < 1e-6
+            # (bipartite_match_op.cc:115 kEPS) — this is what keeps
+            # zero-area padded gt rows unmatched in the padded convention
+            ok = val >= 1e-6
             row_match = jnp.where(ok, row_match.at[i].set(j), row_match)
             row_dist = jnp.where(ok, row_dist.at[i].set(val), row_dist)
             d_cur = jnp.where(ok, d_cur.at[i, :].set(NEG).at[:, j].set(NEG), d_cur)
@@ -446,17 +484,615 @@ def bipartite_match(inputs, attrs):
 @register_op("target_assign", differentiable=False)
 def target_assign(inputs, attrs):
     """reference: operators/detection/target_assign_op.cc — scatter gt
-    rows to priors by match indices; unmatched get mismatch_value."""
+    rows to priors by match indices; unmatched get mismatch_value.
+
+    X forms (padded analogs of the reference's LoD input):
+      [N, G, K]    per-gt payload (labels)           -> Out [N, M, K]
+      [N, G, M, K] per-(gt, prior) payload (encoded
+                   boxes from batched box_coder)     -> Out [N, M, K]
+    Optional NegIndices: a [N, M] 0/1 mask (the reference's LoD negative
+    index list in padded form) — negative priors keep mismatch_value but
+    get weight 1 (target_assign_op.h NegIndices branch)."""
     jnp = _jnp()
-    x = one(inputs, "X")  # [N, P, K] gt values
+    x = one(inputs, "X")
     match = one(inputs, "MatchIndices")  # [N, M]
+    neg = maybe(inputs, "NegIndices")
     mismatch = attrs.get("mismatch_value", 0)
     N, M = match.shape
-    safe = jnp.maximum(match, 0)
-    gathered = jnp.take_along_axis(
-        x, safe[..., None].astype(jnp.int32), axis=1
-    )  # [N, M, K]
+    safe = jnp.maximum(match, 0).astype(jnp.int32)
+    if x.ndim == 4:
+        # x[n, match[n, m], m, :]
+        gathered = x[
+            jnp.arange(N)[:, None], safe, jnp.arange(M)[None, :]
+        ]  # [N, M, K]
+    else:
+        gathered = jnp.take_along_axis(x, safe[..., None], axis=1)  # [N, M, K]
     matched = (match >= 0)[..., None]
     out = jnp.where(matched, gathered, mismatch)
     weight = matched.astype(jnp.float32)
+    if neg is not None:
+        weight = jnp.maximum(weight, neg.astype(jnp.float32)[..., None])
     return {"Out": out, "OutWeight": weight}
+
+
+@register_op("mine_hard_examples", differentiable=False)
+def mine_hard_examples(inputs, attrs):
+    """reference: operators/detection/mine_hard_examples_op.cc
+    (max_negative mining).  Eligible negatives are unmatched priors with
+    match_dist < neg_dist_threshold; the top ``num_pos * neg_pos_ratio``
+    of them by classification loss are selected.
+
+    TPU-native output shape: the reference emits NegIndices as a ragged
+    LoD list; here NegIndices is a static [N, M] 0/1 mask (the padded
+    convention), which target_assign consumes directly."""
+    jnp = _jnp()
+    cls_loss = one(inputs, "ClsLoss")  # [N, M]
+    match = one(inputs, "MatchIndices")  # [N, M]
+    dist = one(inputs, "MatchDist")  # [N, M]
+    neg_pos_ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    neg_thresh = float(attrs.get("neg_dist_threshold", 0.5))
+    mining_type = attrs.get("mining_type", "max_negative")
+    if mining_type != "max_negative":
+        raise NotImplementedError(
+            "mine_hard_examples: only max_negative mining is supported "
+            "(the reference python layer enforces the same, "
+            "layers/detection.py ssd_loss)"
+        )
+    N, M = match.shape
+    eligible = (match == -1) & (dist < neg_thresh)
+    num_pos = jnp.sum((match != -1).astype(jnp.int32), axis=1)  # [N]
+    num_elig = jnp.sum(eligible.astype(jnp.int32), axis=1)
+    neg_sel = jnp.minimum(
+        (num_pos.astype(jnp.float32) * neg_pos_ratio).astype(jnp.int32),
+        num_elig,
+    )  # [N]
+    # rank eligible priors by loss desc; mask = rank < neg_sel
+    masked_loss = jnp.where(eligible, cls_loss, -jnp.inf)
+    order = jnp.argsort(-masked_loss, axis=1)  # [N, M] prior idx by loss desc
+    ranks = jnp.argsort(order, axis=1).astype(jnp.int32)  # rank of each prior
+    neg_mask = eligible & (ranks < neg_sel[:, None])
+    return {
+        "NegIndices": neg_mask.astype(jnp.int32),
+        "UpdatedMatchIndices": match,
+    }
+
+
+@register_op(
+    "yolov3_loss", no_grad_set={"GTBox", "GTLabel", "GTScore"}
+)
+def yolov3_loss(inputs, attrs):
+    """reference: operators/detection/yolov3_loss_op.h (Yolov3LossKernel).
+
+    Fully vectorized: the reference's quadruple loops become broadcast
+    IoU tensors + scatter/gather; matching decisions (best anchor, ignore
+    mask) are wrapped in stop_gradient so autodiff reproduces the
+    reference's hand-written grad (which also treats matches as
+    constants).  Assumes H == W like the reference (grid_size = h is used
+    for both axes, yolov3_loss_op.h:328).
+
+    X [N, mask_num*(5+C), H, W]; GTBox [N, B, 4] normalized center-form
+    (x, y, w, h); GTLabel [N, B] int; GTScore [N, B] optional (mixup).
+    Padding rows are gt boxes with w or h <= 1e-6 (GtValid,
+    yolov3_loss_op.h:238).  Outputs Loss [N], ObjectnessMask
+    [N, mask_num, H, W], GTMatchMask [N, B]."""
+    jax = _jax()
+    jnp = _jnp()
+    x = one(inputs, "X")
+    gt_box = one(inputs, "GTBox")
+    gt_label = one(inputs, "GTLabel")
+    gt_score = maybe(inputs, "GTScore")
+    anchors = [float(a) for a in attrs["anchors"]]
+    anchor_mask = [int(a) for a in attrs["anchor_mask"]]
+    class_num = int(attrs["class_num"])
+    ignore_thresh = float(attrs["ignore_thresh"])
+    downsample = int(attrs.get("downsample_ratio", 32))
+    use_label_smooth = bool(attrs.get("use_label_smooth", True))
+
+    N, C, H, W = x.shape
+    mask_num = len(anchor_mask)
+    an_num = len(anchors) // 2
+    B = gt_box.shape[1]
+    input_size = float(downsample * H)
+    xr = x.reshape(N, mask_num, 5 + class_num, H, W)
+    if gt_label.ndim == 3:
+        gt_label = gt_label[..., 0]
+    gt_label = gt_label.astype(jnp.int32)
+    if gt_score is None:
+        gt_score = jnp.ones((N, B), x.dtype)
+    elif gt_score.ndim == 3:
+        gt_score = gt_score[..., 0]
+
+    label_pos, label_neg = 1.0, 0.0
+    if use_label_smooth:
+        sw = min(1.0 / class_num, 1.0 / 40.0)
+        label_pos, label_neg = 1.0 - sw, sw
+
+    def sce(logit, label):
+        # numerically-stable sigmoid cross entropy (yolov3_loss_op.h:35)
+        return (
+            jnp.maximum(logit, 0.0)
+            - logit * label
+            + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+        )
+
+    valid = (gt_box[..., 2] > 1e-6) & (gt_box[..., 3] > 1e-6)  # [N, B]
+
+    # ---- ignore mask: best IoU of each decoded pred box vs any valid gt
+    xd = jax.lax.stop_gradient(xr)
+    gx = jnp.arange(W, dtype=x.dtype)
+    gy = jnp.arange(H, dtype=x.dtype)
+    px = (gx[None, None, None, :] + jax.nn.sigmoid(xd[:, :, 0])) / H
+    py = (gy[None, None, :, None] + jax.nn.sigmoid(xd[:, :, 1])) / H
+    amw = jnp.asarray([anchors[2 * m] for m in anchor_mask], x.dtype)
+    amh = jnp.asarray([anchors[2 * m + 1] for m in anchor_mask], x.dtype)
+    pw = jnp.exp(xd[:, :, 2]) * amw[None, :, None, None] / input_size
+    ph = jnp.exp(xd[:, :, 3]) * amh[None, :, None, None] / input_size
+
+    def overlap(c1, w1, c2, w2):
+        left = jnp.maximum(c1 - w1 / 2.0, c2 - w2 / 2.0)
+        right = jnp.minimum(c1 + w1 / 2.0, c2 + w2 / 2.0)
+        return right - left
+
+    gb = gt_box[:, None, None, None, :, :]  # [N,1,1,1,B,4]
+    ow = overlap(px[..., None], pw[..., None], gb[..., 0], gb[..., 2])
+    oh = overlap(py[..., None], ph[..., None], gb[..., 1], gb[..., 3])
+    inter = jnp.where((ow < 0) | (oh < 0), 0.0, ow * oh)
+    union = pw[..., None] * ph[..., None] + gb[..., 2] * gb[..., 3] - inter
+    iou = inter / jnp.maximum(union, 1e-10)  # [N, M, H, W, B]
+    iou = jnp.where(valid[:, None, None, None, :], iou, 0.0)
+    best_iou = jnp.max(iou, axis=-1) if B else jnp.zeros_like(px)
+    ignore = best_iou > ignore_thresh  # [N, M, H, W]
+
+    # ---- per-gt best anchor (shifted-box IoU = wh IoU over ALL anchors)
+    aw_all = jnp.asarray(anchors[0::2], x.dtype) / input_size  # [A]
+    ah_all = jnp.asarray(anchors[1::2], x.dtype) / input_size
+    iw = jnp.minimum(gt_box[..., 2][..., None], aw_all)
+    ih = jnp.minimum(gt_box[..., 3][..., None], ah_all)
+    inter_a = iw * ih
+    union_a = (
+        gt_box[..., 2][..., None] * gt_box[..., 3][..., None]
+        + aw_all * ah_all
+        - inter_a
+    )
+    an_iou = inter_a / jnp.maximum(union_a, 1e-10)  # [N, B, A]
+    best_n = jnp.argmax(an_iou, axis=-1).astype(jnp.int32)  # [N, B]
+    lookup = np.full((an_num,), -1, np.int32)
+    for pos, m in enumerate(anchor_mask):
+        lookup[m] = pos
+    mask_idx = jnp.asarray(lookup)[best_n]  # [N, B] position in anchor_mask
+    gt_match = jnp.where(valid, mask_idx, -1).astype(jnp.int32)
+    pos_mask = valid & (mask_idx >= 0)  # [N, B]
+
+    gi = jnp.clip((gt_box[..., 0] * W).astype(jnp.int32), 0, W - 1)
+    gj = jnp.clip((gt_box[..., 1] * H).astype(jnp.int32), 0, H - 1)
+    n_idx = jnp.arange(N)[:, None]
+    m_safe = jnp.maximum(mask_idx, 0)
+    cell = xr[n_idx, m_safe, :, gj, gi]  # [N, B, 5+C]
+
+    # location loss (CalcBoxLocationLoss): sce on x/y, L1 on w/h
+    tx = gt_box[..., 0] * H - gi.astype(x.dtype)
+    ty = gt_box[..., 1] * H - gj.astype(x.dtype)
+    aw_sel = jnp.take(jnp.asarray(anchors[0::2], x.dtype), best_n)
+    ah_sel = jnp.take(jnp.asarray(anchors[1::2], x.dtype), best_n)
+    gtw = jnp.where(pos_mask, gt_box[..., 2], 1.0)
+    gth = jnp.where(pos_mask, gt_box[..., 3], 1.0)
+    tw = jnp.log(jnp.maximum(gtw * input_size / aw_sel, 1e-10))
+    th = jnp.log(jnp.maximum(gth * input_size / ah_sel, 1e-10))
+    scale = (2.0 - gt_box[..., 2] * gt_box[..., 3]) * gt_score
+    loc_loss = (
+        sce(cell[..., 0], tx) + sce(cell[..., 1], ty)
+    ) * scale + (
+        jnp.abs(cell[..., 2] - tw) + jnp.abs(cell[..., 3] - th)
+    ) * scale
+
+    # label loss (CalcLabelLoss): per-class sigmoid CE with smoothing
+    cls_tgt = jnp.where(
+        jnp.arange(class_num) == gt_label[..., None], label_pos, label_neg
+    ).astype(x.dtype)
+    lab_loss = jnp.sum(sce(cell[..., 5:], cls_tgt), axis=-1) * gt_score
+    per_gt = jnp.where(pos_mask, loc_loss + lab_loss, 0.0)
+    loss = jnp.sum(per_gt, axis=1)  # [N]
+
+    # objectness mask: -1 ignored, 0 negative, score positive (positives
+    # overwrite ignores, matching the reference's loop order)
+    obj = jnp.where(ignore, -1.0, 0.0).astype(x.dtype)
+    gj_s = jnp.where(pos_mask, gj, H)  # out-of-bounds rows are dropped
+    obj = obj.at[n_idx, m_safe, gj_s, gi].set(gt_score, mode="drop")
+    obj = jax.lax.stop_gradient(obj)
+
+    x4 = xr[:, :, 4]  # [N, M, H, W]
+    pos_cell = obj > 1e-5
+    neg_cell = (obj > -0.5) & ~pos_cell
+    obj_loss = jnp.sum(
+        jnp.where(pos_cell, sce(x4, 1.0) * obj, 0.0)
+        + jnp.where(neg_cell, sce(x4, 0.0), 0.0),
+        axis=(1, 2, 3),
+    )
+    return {
+        "Loss": loss + obj_loss,
+        "ObjectnessMask": obj,
+        "GTMatchMask": gt_match,
+    }
+
+
+@register_op("density_prior_box", differentiable=False)
+def density_prior_box(inputs, attrs):
+    """reference: operators/detection/density_prior_box_op.cc — PyramidBox
+    dense priors: per cell, for each (density, fixed_size, fixed_ratio)
+    a density x density shifted grid of boxes."""
+    jnp = _jnp()
+    feat = one(inputs, "Input")
+    img = one(inputs, "Image")
+    H, W = feat.shape[2], feat.shape[3]
+    img_h, img_w = img.shape[2], img.shape[3]
+    densities = [int(d) for d in attrs.get("densities", [])]
+    fixed_sizes = [float(s) for s in attrs.get("fixed_sizes", [])]
+    fixed_ratios = [float(r) for r in attrs.get("fixed_ratios", [1.0])]
+    variances = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    clip = attrs.get("clip", False)
+    step_w = attrs.get("step_w", 0.0) or img_w / W
+    step_h = attrs.get("step_h", 0.0) or img_h / H
+    offset = float(attrs.get("offset", 0.5))
+
+    # per-cell offsets and sizes for every dense box (static python loops,
+    # mirrors density_prior_box_op.h:146)
+    dx, dy, bw, bh = [], [], [], []
+    for density, fs in zip(densities, fixed_sizes):
+        for ratio in fixed_ratios:
+            box_w = fs * np.sqrt(ratio)
+            box_h = fs / np.sqrt(ratio)
+            shift = 1.0 / density
+            for di in range(density):
+                for dj in range(density):
+                    dx.append((dj + 0.5) * shift - 0.5)
+                    dy.append((di + 0.5) * shift - 0.5)
+                    bw.append(box_w)
+                    bh.append(box_h)
+    P = len(dx)
+    dx = jnp.asarray(dx, jnp.float32) * step_w
+    dy = jnp.asarray(dy, jnp.float32) * step_h
+    bw = jnp.asarray(bw, jnp.float32)
+    bh = jnp.asarray(bh, jnp.float32)
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy, indexing="xy")  # [H, W]
+    ccx = cxg[..., None] + dx  # [H, W, P]
+    ccy = cyg[..., None] + dy
+    boxes = jnp.stack(
+        [
+            (ccx - bw / 2.0) / img_w,
+            (ccy - bh / 2.0) / img_h,
+            (ccx + bw / 2.0) / img_w,
+            (ccy + bh / 2.0) / img_h,
+        ],
+        axis=-1,
+    )  # [H, W, P, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), (H, W, P, 4))
+    return {"Boxes": boxes, "Variances": var}
+
+
+@register_op("sigmoid_focal_loss", no_grad_set={"Label", "FgNum"})
+def sigmoid_focal_loss(inputs, attrs):
+    """reference: operators/detection/sigmoid_focal_loss_op.cu — RetinaNet
+    focal loss.  X [R, C] logits, Label [R, 1] int (0 = background,
+    class ids are 1-based), FgNum [1] int normalizer."""
+    jax = _jax()
+    jnp = _jnp()
+    x = one(inputs, "X")
+    label = one(inputs, "Label")
+    fg_num = one(inputs, "FgNum")
+    gamma = float(attrs.get("gamma", 2.0))
+    alpha = float(attrs.get("alpha", 0.25))
+    R, C = x.shape
+    lbl = label.reshape(R).astype(jnp.int32)
+    # per (row, class): positive iff lbl == c + 1 (ids are 1-based)
+    tgt = (lbl[:, None] == jnp.arange(1, C + 1)[None, :]).astype(x.dtype)
+    fg = jnp.maximum(fg_num.reshape(()).astype(x.dtype), 1.0)
+    p = jax.nn.sigmoid(x)
+    ce = (
+        jnp.maximum(x, 0.0) - x * tgt + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    )
+    p_t = p * tgt + (1.0 - p) * (1.0 - tgt)
+    alpha_t = alpha * tgt + (1.0 - alpha) * (1.0 - tgt)
+    loss = alpha_t * jnp.power(1.0 - p_t, gamma) * ce / fg
+    return {"Out": loss}
+
+
+@register_op("rpn_target_assign", differentiable=False)
+def rpn_target_assign(inputs, attrs):
+    """reference: operators/detection/rpn_target_assign_op.cc — label RPN
+    anchors fg/bg and compute regression targets.
+
+    Padded analog: Anchor [A, 4]; GtBoxes [N, B, 4] corner-form with
+    zero-area padding rows; ImInfo [N, 3].  The reference gathers sampled
+    anchors into compact LoD tensors and (by default) random-subsamples
+    fg/bg; XLA needs static shapes, so outputs are full-anchor masks —
+    TargetLabel [N, A] (1 fg / 0 bg / -1 ignore), TargetBBox [N, A, 4]
+    encoded deltas, ScoreWeight / LocWeight [N, A] — and sampling is the
+    reference's deterministic use_random=False path (first-k in anchor
+    order, rpn_target_assign_op.cc:117)."""
+    jax = _jax()
+    jnp = _jnp()
+    anchor = one(inputs, "Anchor")  # [A, 4]
+    gt = one(inputs, "GtBoxes")  # [N, B, 4]
+    im_info = maybe(inputs, "ImInfo")
+    batch_size_per_im = int(attrs.get("rpn_batch_size_per_im", 256))
+    straddle_thresh = float(attrs.get("rpn_straddle_thresh", 0.0))
+    fg_fraction = float(attrs.get("rpn_fg_fraction", 0.5))
+    pos_overlap = float(attrs.get("rpn_positive_overlap", 0.7))
+    neg_overlap = float(attrs.get("rpn_negative_overlap", 0.3))
+    A = anchor.shape[0]
+    N, B = gt.shape[0], gt.shape[1]
+    fg_max = int(batch_size_per_im * fg_fraction)
+
+    valid_gt = (gt[..., 2] - gt[..., 0] > 1e-6) & (gt[..., 3] - gt[..., 1] > 1e-6)
+
+    def per_image(gt_i, valid_i, im_i):
+        # straddling anchors are filtered BEFORE matching, like the
+        # reference (FilterStraddleAnchor runs first,
+        # rpn_target_assign_op.cc:367); overlaps use the same legacy +1
+        # pixel convention as the regression encoding (bbox_util.h)
+        inside = jnp.ones((A,), bool)
+        if im_i is not None and straddle_thresh >= 0:
+            h, w = im_i[0], im_i[1]
+            inside = (
+                (anchor[:, 0] >= -straddle_thresh)
+                & (anchor[:, 1] >= -straddle_thresh)
+                & (anchor[:, 2] < w + straddle_thresh)
+                & (anchor[:, 3] < h + straddle_thresh)
+            )
+        iou = _iou_matrix(anchor, gt_i, normalized=False)  # [A, B]
+        iou = jnp.where(valid_i[None, :] & inside[:, None], iou, 0.0)
+        a2g_max = jnp.max(iou, axis=1)
+        a2g_arg = jnp.argmax(iou, axis=1)
+        # anchors that are the best for some gt are fg too
+        g2a_max = jnp.max(iou, axis=0)  # [B]
+        is_best = jnp.any(
+            (iou >= g2a_max[None, :] - 1e-9) & (iou > 0.0) & valid_i[None, :],
+            axis=1,
+        )
+        fg = inside & (is_best | (a2g_max >= pos_overlap))
+        bg = inside & ~fg & (a2g_max < neg_overlap)
+        # deterministic first-k sampling (use_random=False reference path)
+        fg_rank = jnp.cumsum(fg.astype(jnp.int32)) - 1
+        fg_sel = fg & (fg_rank < fg_max)
+        n_fg = jnp.sum(fg_sel.astype(jnp.int32))
+        bg_max = batch_size_per_im - n_fg
+        bg_rank = jnp.cumsum(bg.astype(jnp.int32)) - 1
+        bg_sel = bg & (bg_rank < bg_max)
+        label = jnp.where(fg_sel, 1, jnp.where(bg_sel, 0, -1))
+        # regression target: encode matched gt vs anchor (center form,
+        # bbox_util.h BoxToDelta with weights 1)
+        mg = gt_i[a2g_arg]  # [A, 4]
+        aw = anchor[:, 2] - anchor[:, 0] + 1.0
+        ah = anchor[:, 3] - anchor[:, 1] + 1.0
+        acx = anchor[:, 0] + aw * 0.5
+        acy = anchor[:, 1] + ah * 0.5
+        gw = mg[:, 2] - mg[:, 0] + 1.0
+        gh = mg[:, 3] - mg[:, 1] + 1.0
+        gcx = mg[:, 0] + gw * 0.5
+        gcy = mg[:, 1] + gh * 0.5
+        tgt = jnp.stack(
+            [
+                (gcx - acx) / aw,
+                (gcy - acy) / ah,
+                jnp.log(jnp.maximum(gw / aw, 1e-10)),
+                jnp.log(jnp.maximum(gh / ah, 1e-10)),
+            ],
+            axis=1,
+        )
+        return (
+            label.astype(jnp.int32),
+            tgt,
+            fg_sel.astype(jnp.float32),
+            (fg_sel | bg_sel).astype(jnp.float32),
+        )
+
+    if im_info is None:
+        label, tgt, locw, scw = jax.vmap(
+            lambda g, v: per_image(g, v, None)
+        )(gt, valid_gt)
+    else:
+        label, tgt, locw, scw = jax.vmap(per_image)(gt, valid_gt, im_info)
+    return {
+        "TargetLabel": label,
+        "TargetBBox": tgt,
+        "LocWeight": locw,
+        "ScoreWeight": scw,
+    }
+
+
+@register_op("generate_proposals", differentiable=False)
+def generate_proposals(inputs, attrs):
+    """reference: operators/detection/generate_proposals_op.cc — decode
+    RPN deltas over anchors, clip, filter small, NMS, keep top proposals.
+
+    Static-shape outputs (the reference emits LoD): RpnRois
+    [N, post_nms_topN, 4] and RpnRoiProbs [N, post_nms_topN, 1], padded
+    with zero boxes / -1 scores."""
+    jax = _jax()
+    jnp = _jnp()
+    scores = one(inputs, "Scores")  # [N, A, H, W]
+    deltas = one(inputs, "BboxDeltas")  # [N, 4A, H, W]
+    im_info = one(inputs, "ImInfo")  # [N, 3] (h, w, scale)
+    anchors = one(inputs, "Anchors").reshape(-1, 4)  # [H*W*A, 4]
+    variances = maybe(inputs, "Variances")
+    if variances is not None:
+        variances = variances.reshape(-1, 4)
+    else:
+        variances = jnp.ones_like(anchors)
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_thresh = float(attrs.get("nms_thresh", 0.7))
+    min_size = float(attrs.get("min_size", 0.1))
+    eta = float(attrs.get("eta", 1.0))
+    N, A, H, W = scores.shape
+    total = A * H * W
+    pre_n = min(pre_n, total)
+    kBBoxClip = float(np.log(1000.0 / 16.0))
+
+    def per_image(sc, dl, im):
+        # [A, H, W] -> [H, W, A] flat, matching the anchor layout
+        s = sc.transpose(1, 2, 0).reshape(-1)
+        d = dl.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        top_s, top_i = jax.lax.top_k(s, pre_n)
+        an = anchors[top_i]
+        va = variances[top_i]
+        de = d[top_i]
+        # decode (generate_proposals_op.cc BoxCoder: legacy +1 widths)
+        aw = an[:, 2] - an[:, 0] + 1.0
+        ah = an[:, 3] - an[:, 1] + 1.0
+        acx = an[:, 0] + aw * 0.5
+        acy = an[:, 1] + ah * 0.5
+        cx = va[:, 0] * de[:, 0] * aw + acx
+        cy = va[:, 1] * de[:, 1] * ah + acy
+        w = jnp.exp(jnp.minimum(va[:, 2] * de[:, 2], kBBoxClip)) * aw
+        h = jnp.exp(jnp.minimum(va[:, 3] * de[:, 3], kBBoxClip)) * ah
+        x1 = cx - 0.5 * w
+        y1 = cy - 0.5 * h
+        x2 = cx + 0.5 * w - 1.0
+        y2 = cy + 0.5 * h - 1.0
+        # clip to image
+        x1 = jnp.clip(x1, 0.0, im[1] - 1.0)
+        y1 = jnp.clip(y1, 0.0, im[0] - 1.0)
+        x2 = jnp.clip(x2, 0.0, im[1] - 1.0)
+        y2 = jnp.clip(y2, 0.0, im[0] - 1.0)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=1)
+        # filter boxes smaller than min_size (scaled)
+        ms = min_size * im[2]
+        keep = ((x2 - x1 + 1.0) >= ms) & ((y2 - y1 + 1.0) >= ms)
+        sc_f = jnp.where(keep, top_s, -jnp.inf)
+        # greedy NMS over the pre_n candidates (already score-sorted);
+        # eta < 1 shrinks the threshold after each kept box once it
+        # exceeds 0.5 (adaptive NMS, generate_proposals_op.cc NMS loop).
+        # IoU rows are computed inside the loop — a full pre_n x pre_n
+        # matrix would be ~144 MB per image at the default pre_n=6000.
+        def body(i, carry):
+            kp, thr = carry
+            b = jax.lax.dynamic_slice_in_dim(boxes, i, 1, 0)  # [1, 4]
+            iou_row = _iou_matrix(b, boxes, normalized=False)[0]  # [pre_n]
+            mask = (jnp.arange(pre_n) < i) & kp
+            sup = jnp.any((iou_row > thr) & mask)
+            keep_i = jnp.logical_not(sup) & kp[i]
+            kp = kp.at[i].set(keep_i)
+            thr = jnp.where(keep_i & (thr > 0.5), thr * eta, thr) \
+                if eta < 1.0 else thr
+            return kp, thr
+
+        kp0 = sc_f > -jnp.inf
+        thr0 = jnp.asarray(nms_thresh, boxes.dtype)
+        if eta < 1.0:
+            thr0 = jnp.where(kp0[0] & (thr0 > 0.5), thr0 * eta, thr0)
+        kp, _ = jax.lax.fori_loop(1, pre_n, body, (kp0, thr0))
+        sc_k = jnp.where(kp, sc_f, -jnp.inf)
+        out_s, out_i = jax.lax.top_k(sc_k, min(post_n, pre_n))
+        out_b = boxes[out_i]
+        ok = jnp.isfinite(out_s)
+        out_b = jnp.where(ok[:, None], out_b, 0.0)
+        out_s = jnp.where(ok, out_s, -1.0)
+        if post_n > pre_n:
+            out_b = jnp.concatenate(
+                [out_b, jnp.zeros((post_n - pre_n, 4), out_b.dtype)]
+            )
+            out_s = jnp.concatenate(
+                [out_s, jnp.full((post_n - pre_n,), -1.0, out_s.dtype)]
+            )
+        return out_b, out_s[:, None]
+
+    rois, probs = jax.vmap(per_image)(scores, deltas, im_info)
+    return {"RpnRois": rois, "RpnRoiProbs": probs}
+
+
+@register_op("detection_map", differentiable=False)
+def detection_map(inputs, attrs):
+    """reference: operators/detection/detection_map_op.cc — mAP of padded
+    NMS detections vs padded gt for ONE batch (the streaming evaluator
+    lives in metrics.DetectionMAP, matching the reference's
+    fluid/metrics.py DetectionMAP on top of this op).
+
+    DetectRes [N, K, 6] (label, score, x1, y1, x2, y2; label -1 pads);
+    GtLabel [N, B]; GtBox [N, B, 4] (zero-area pads)."""
+    jax = _jax()
+    jnp = _jnp()
+    det = one(inputs, "DetectRes")
+    gt_label = one(inputs, "Label")
+    gt_box = one(inputs, "GtBox")
+    overlap_threshold = float(attrs.get("overlap_threshold", 0.5))
+    ap_type = attrs.get("ap_type", "integral")
+    class_num = int(attrs["class_num"])
+    background_label = int(attrs.get("background_label", 0))
+    N, K, _ = det.shape
+    B = gt_box.shape[1]
+    if gt_label.ndim == 3:
+        gt_label = gt_label[..., 0]
+    gt_valid = (gt_box[..., 2] - gt_box[..., 0] > 1e-6) & (
+        gt_box[..., 3] - gt_box[..., 1] > 1e-6
+    )
+
+    def for_class(c):
+        det_is_c = det[..., 0].astype(jnp.int32) == c  # [N, K]
+        gt_is_c = gt_valid & (gt_label.astype(jnp.int32) == c)  # [N, B]
+        n_gt = jnp.sum(gt_is_c.astype(jnp.int32))
+
+        # flatten detections across the batch, sort by score desc
+        scores = jnp.where(det_is_c, det[..., 1], -jnp.inf).reshape(-1)
+        order = jnp.argsort(-scores)
+        img_of = (jnp.arange(N * K) // K)[order]
+        boxes = det[..., 2:6].reshape(-1, 4)[order]
+        valid_det = jnp.isfinite(scores[order]) & (scores[order] > -jnp.inf)
+
+        def body(carry, idx):
+            used, tp, fp, i = carry
+            b = boxes[i]
+            n_img = img_of[i]
+            iou = _iou_matrix(b[None, :], gt_box[n_img])[0]  # [B]
+            # VOC matching (detection_map_op.cc): the detection is judged
+            # against its OVERALL max-IoU gt; if that gt was already
+            # matched, the detection is a false positive — it does NOT
+            # fall through to the next-best gt.
+            iou = jnp.where(gt_is_c[n_img], iou, 0.0)
+            best = jnp.argmax(iou)
+            hit = (
+                (iou[best] >= overlap_threshold)
+                & ~used[n_img, best]
+                & valid_det[i]
+            )
+            used = jnp.where(
+                hit, used.at[n_img, best].set(True), used
+            )
+            tp = tp.at[i].set(jnp.where(valid_det[i] & hit, 1.0, 0.0))
+            fp = fp.at[i].set(jnp.where(valid_det[i] & ~hit, 1.0, 0.0))
+            return (used, tp, fp, i + 1), None
+
+        M = N * K
+        init = (
+            jnp.zeros((N, B), bool),
+            jnp.zeros((M,)),
+            jnp.zeros((M,)),
+            0,
+        )
+        (used, tp, fp, _), _ = jax.lax.scan(body, init, None, length=M)
+        ctp = jnp.cumsum(tp)
+        cfp = jnp.cumsum(fp)
+        recall = ctp / jnp.maximum(n_gt.astype(jnp.float32), 1.0)
+        precision = ctp / jnp.maximum(ctp + cfp, 1e-10)
+        if ap_type == "11point":
+            pts = jnp.linspace(0.0, 1.0, 11)
+            ap = jnp.mean(
+                jax.vmap(
+                    lambda r: jnp.max(
+                        jnp.where(recall >= r, precision, 0.0)
+                    )
+                )(pts)
+            )
+        else:  # integral
+            drecall = jnp.diff(recall, prepend=0.0)
+            ap = jnp.sum(precision * drecall)
+        has_gt = (n_gt > 0) & (c != background_label)
+        return jnp.where(has_gt, ap, 0.0), has_gt.astype(jnp.float32)
+
+    aps, has = jax.vmap(for_class)(jnp.arange(class_num))
+    m_ap = jnp.sum(aps) / jnp.maximum(jnp.sum(has), 1.0)
+    return {"MAP": m_ap.reshape(1)}

@@ -228,3 +228,51 @@ def test_trainer_desc_wired_into_train_from_dataset():
         out = exe.train_from_dataset(program=prog, dataset=feed,
                                      scope=scope, trainer_desc=hog)
     assert len(out) == 3
+
+
+def test_executor_multi_step_parity():
+    """run(steps=N) — one jitted fori_loop over N optimizer steps — must
+    match N single-step run() calls exactly (the dispatch-amortizing path
+    bench.py uses; analog of the reference DeviceWorker multi-batch
+    loop)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import framework
+
+    def build():
+        prog, startup = framework.Program(), framework.Program()
+        prog.random_seed = startup.random_seed = 7
+        with framework.program_guard(prog, startup):
+            x = fluid.layers.data("x", [4])
+            y = fluid.layers.data("y", [1])
+            h = fluid.layers.fc(x, size=8, act="relu")
+            p = fluid.layers.fc(h, size=1)
+            loss = fluid.layers.mean(fluid.layers.square(p - y))
+            fluid.optimizer.MomentumOptimizer(0.05, 0.9).minimize(loss)
+        return prog, startup, loss
+
+    rng = np.random.RandomState(0)
+    feed = {
+        "x": rng.randn(16, 4).astype(np.float32),
+        "y": rng.randn(16, 1).astype(np.float32),
+    }
+    prog, startup, loss = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    scope_a = fluid.Scope()
+    with fluid.scope_guard(scope_a):
+        exe.run(startup)
+        for _ in range(6):
+            (la,) = exe.run(prog, feed=feed, fetch_list=[loss])
+    params_a = {
+        p.name: np.asarray(scope_a.get(p.name)) for p in prog.all_parameters()
+    }
+
+    scope_b = fluid.Scope()
+    with fluid.scope_guard(scope_b):
+        exe.run(startup)
+        (lb,) = exe.run(prog, feed=feed, fetch_list=[loss], steps=6)
+    np.testing.assert_allclose(la, lb, rtol=1e-5, atol=1e-6)
+    for n, want in params_a.items():
+        np.testing.assert_allclose(
+            np.asarray(scope_b.get(n)), want, rtol=1e-5, atol=1e-6, err_msg=n
+        )

@@ -1,0 +1,487 @@
+"""Detection training suite: yolov3_loss / ssd_loss / rpn ops / mAP.
+
+Reference tests: tests/unittests/test_yolov3_loss_op.py,
+test_ssd_loss.py (in test_detection.py), test_mine_hard_examples_op.py,
+test_rpn_target_assign_op.py, test_generate_proposals_op.py,
+test_detection_map_op.py.  The numpy goldens re-derive the reference
+kernels (operators/detection/yolov3_loss_op.h etc.) loop-for-loop.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import framework
+
+from op_test import OpTest
+
+
+# ---------------------------------------------------------------------------
+# numpy golden for yolov3_loss (yolov3_loss_op.h Yolov3LossKernel)
+# ---------------------------------------------------------------------------
+def _sce(z, t):
+    return max(z, 0.0) - z * t + np.log1p(np.exp(-abs(z)))
+
+
+def _sig(z):
+    return 1.0 / (1.0 + np.exp(-z))
+
+
+def _iou_cw(b1, b2):
+    def ov(c1, w1, c2, w2):
+        return min(c1 + w1 / 2, c2 + w2 / 2) - max(c1 - w1 / 2, c2 - w2 / 2)
+
+    ow = ov(b1[0], b1[2], b2[0], b2[2])
+    oh = ov(b1[1], b1[3], b2[1], b2[3])
+    inter = 0.0 if (ow < 0 or oh < 0) else ow * oh
+    return inter / (b1[2] * b1[3] + b2[2] * b2[3] - inter)
+
+
+def np_yolov3_loss(x, gtbox, gtlabel, gtscore, anchors, anchor_mask,
+                   class_num, ignore_thresh, downsample, use_label_smooth):
+    n, c, h, w = x.shape
+    b = gtbox.shape[1]
+    mask_num = len(anchor_mask)
+    an_num = len(anchors) // 2
+    input_size = downsample * h
+    loss = np.zeros(n)
+    obj_mask = np.zeros((n, mask_num, h, w), np.float32)
+    gt_match = np.full((n, b), -1, np.int32)
+    xr = x.reshape(n, mask_num, 5 + class_num, h, w)
+    label_pos, label_neg = 1.0, 0.0
+    if use_label_smooth:
+        sw = min(1.0 / class_num, 1.0 / 40)
+        label_pos, label_neg = 1.0 - sw, sw
+    valid = (gtbox[:, :, 2] > 1e-6) & (gtbox[:, :, 3] > 1e-6)
+    for i in range(n):
+        for j in range(mask_num):
+            for k in range(h):
+                for ll in range(w):
+                    px = (ll + _sig(xr[i, j, 0, k, ll])) / h
+                    py = (k + _sig(xr[i, j, 1, k, ll])) / h
+                    pw = np.exp(xr[i, j, 2, k, ll]) * anchors[2 * anchor_mask[j]] / input_size
+                    ph = np.exp(xr[i, j, 3, k, ll]) * anchors[2 * anchor_mask[j] + 1] / input_size
+                    best = 0.0
+                    for t in range(b):
+                        if not valid[i, t]:
+                            continue
+                        best = max(best, _iou_cw((px, py, pw, ph), gtbox[i, t]))
+                    if best > ignore_thresh:
+                        obj_mask[i, j, k, ll] = -1
+        for t in range(b):
+            if not valid[i, t]:
+                gt_match[i, t] = -1
+                continue
+            gx_, gy_, gw_, gh_ = gtbox[i, t]
+            gi, gj = int(gx_ * w), int(gy_ * h)
+            best_iou, best_n = 0.0, 0
+            for a in range(an_num):
+                aw, ah = anchors[2 * a] / input_size, anchors[2 * a + 1] / input_size
+                inter = min(aw, gw_) * min(ah, gh_)
+                iou = inter / (aw * ah + gw_ * gh_ - inter)
+                if iou > best_iou:
+                    best_iou, best_n = iou, a
+            mask_idx = anchor_mask.index(best_n) if best_n in anchor_mask else -1
+            gt_match[i, t] = mask_idx
+            if mask_idx >= 0:
+                score = gtscore[i, t]
+                tx = gx_ * h - gi
+                ty = gy_ * h - gj
+                tw = np.log(gw_ * input_size / anchors[2 * best_n])
+                th = np.log(gh_ * input_size / anchors[2 * best_n + 1])
+                scale = (2.0 - gw_ * gh_) * score
+                loss[i] += _sce(xr[i, mask_idx, 0, gj, gi], tx) * scale
+                loss[i] += _sce(xr[i, mask_idx, 1, gj, gi], ty) * scale
+                loss[i] += abs(xr[i, mask_idx, 2, gj, gi] - tw) * scale
+                loss[i] += abs(xr[i, mask_idx, 3, gj, gi] - th) * scale
+                obj_mask[i, mask_idx, gj, gi] = score
+                lbl = int(gtlabel[i, t])
+                for ci in range(class_num):
+                    tgt = label_pos if ci == lbl else label_neg
+                    loss[i] += _sce(xr[i, mask_idx, 5 + ci, gj, gi], tgt) * score
+        for j in range(mask_num):
+            for k in range(h):
+                for ll in range(w):
+                    o = obj_mask[i, j, k, ll]
+                    if o > 1e-5:
+                        loss[i] += _sce(xr[i, j, 4, k, ll], 1.0) * o
+                    elif o > -0.5:
+                        loss[i] += _sce(xr[i, j, 4, k, ll], 0.0)
+    return loss.astype(np.float32), obj_mask, gt_match
+
+
+def _yolo_case(seed=7, n=2, b=3, h=5, class_num=4):
+    rng = np.random.RandomState(seed)
+    anchors = [10, 13, 16, 30, 33, 23]
+    anchor_mask = [0, 1]
+    mask_num = len(anchor_mask)
+    x = rng.randn(n, mask_num * (5 + class_num), h, h).astype(np.float32)
+    # gts in distinct cells, well inside (0,1); one padding row
+    gtbox = np.zeros((n, b, 4), np.float32)
+    cells = [(1, 1), (3, 2)]
+    for i in range(n):
+        for t, (cx, cy) in enumerate(cells):
+            gtbox[i, t] = [
+                (cx + 0.3 + 0.1 * i) / h,
+                (cy + 0.6 - 0.1 * i) / h,
+                0.28 + 0.05 * t,
+                0.2 + 0.07 * i,
+            ]
+    gtlabel = rng.randint(0, class_num, (n, b)).astype(np.int32)
+    gtscore = rng.uniform(0.5, 1.0, (n, b)).astype(np.float32)
+    return x, gtbox, gtlabel, gtscore, anchors, anchor_mask, class_num
+
+
+class TestYolov3LossOp(OpTest):
+    op_type = "yolov3_loss"
+    atol = 2e-4
+
+    def test_output_and_grad(self):
+        (x, gtbox, gtlabel, gtscore, anchors, anchor_mask,
+         class_num) = _yolo_case()
+        self.attrs = {
+            "anchors": anchors,
+            "anchor_mask": anchor_mask,
+            "class_num": class_num,
+            "ignore_thresh": 0.7,
+            "downsample_ratio": 32,
+            "use_label_smooth": True,
+        }
+        loss, obj, match = np_yolov3_loss(
+            x.astype(np.float64), gtbox, gtlabel, gtscore, anchors,
+            anchor_mask, class_num, 0.7, 32, True,
+        )
+        self.inputs = {
+            "X": x, "GTBox": gtbox, "GTLabel": gtlabel, "GTScore": gtscore,
+        }
+        self.outputs = {
+            "Loss": loss,
+            "ObjectnessMask": obj,
+            "GTMatchMask": match,
+        }
+        self.check_output()
+        self.check_grad(["X"], "Loss", max_relative_error=0.02)
+
+    def test_no_score_no_smooth(self):
+        (x, gtbox, gtlabel, _, anchors, anchor_mask, class_num) = _yolo_case(11)
+        ones = np.ones(gtlabel.shape, np.float32)
+        self.attrs = {
+            "anchors": anchors, "anchor_mask": anchor_mask,
+            "class_num": class_num, "ignore_thresh": 0.5,
+            "downsample_ratio": 32, "use_label_smooth": False,
+        }
+        loss, obj, match = np_yolov3_loss(
+            x.astype(np.float64), gtbox, gtlabel, ones, anchors,
+            anchor_mask, class_num, 0.5, 32, False,
+        )
+        self.inputs = {"X": x, "GTBox": gtbox, "GTLabel": gtlabel}
+        self.outputs = {"Loss": loss, "ObjectnessMask": obj, "GTMatchMask": match}
+        self.check_output()
+
+
+class TestMineHardExamplesOp(OpTest):
+    op_type = "mine_hard_examples"
+
+    def test_max_negative(self):
+        # reference test_mine_hard_examples_op.py setup
+        cls_loss = np.array(
+            [[0.1, 0.1, 0.3, 0.3, 0.1, 0.1], [0.1, 0.1, 0.5, 0.3, 0.1, 0.1]],
+            np.float32,
+        )
+        match = np.array([[0, -1, -1, 0, -1, 1], [0, -1, -1, -1, 1, -1]], np.int32)
+        dist = np.array(
+            [[0.8, 0.1, 0.2, 0.9, 0.1, 0.9], [0.9, 0.1, 0.4, 0.3, 0.9, 0.1]],
+            np.float32,
+        )
+        # eligible: match==-1 & dist<0.5; num_pos*1.0 capped
+        # image 0: pos=3, eligible={1(0.1),2(0.3),4(0.1)} -> all 3 kept
+        # image 1: pos=2, eligible={1(0.1),2(0.5loss,0.4dist),3(0.3),5(0.1)}
+        #          top-2 by loss: 2 and 3
+        neg = np.array([[0, 1, 1, 0, 1, 0], [0, 0, 1, 1, 0, 0]], np.int32)
+        self.inputs = {"ClsLoss": cls_loss, "MatchIndices": match, "MatchDist": dist}
+        self.attrs = {"neg_pos_ratio": 1.0, "neg_dist_threshold": 0.5,
+                      "mining_type": "max_negative"}
+        self.outputs = {"NegIndices": neg, "UpdatedMatchIndices": match}
+        self.check_output()
+
+
+class TestSigmoidFocalLossOp(OpTest):
+    op_type = "sigmoid_focal_loss"
+    atol = 1e-5
+
+    def test_output_and_grad(self):
+        rng = np.random.RandomState(3)
+        R, C = 12, 5
+        x = rng.randn(R, C).astype(np.float32)
+        label = rng.randint(0, C + 1, (R, 1)).astype(np.int32)  # 0 = bg
+        fg = np.array([4], np.int32)
+        gamma, alpha = 2.0, 0.25
+        p = 1.0 / (1.0 + np.exp(-x.astype(np.float64)))
+        tgt = (label == np.arange(1, C + 1)[None, :]).astype(np.float64)
+        ce = np.maximum(x, 0) - x * tgt + np.log1p(np.exp(-np.abs(x)))
+        pt = p * tgt + (1 - p) * (1 - tgt)
+        at = alpha * tgt + (1 - alpha) * (1 - tgt)
+        out = (at * (1 - pt) ** gamma * ce / max(fg[0], 1)).astype(np.float32)
+        self.inputs = {"X": x, "Label": label, "FgNum": fg}
+        self.attrs = {"gamma": gamma, "alpha": alpha}
+        self.outputs = {"Out": out}
+        self.check_output()
+        self.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+def _run_single(build_fn, feed):
+    prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(prog, startup):
+        outs = build_fn()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return exe.run(prog, feed=feed, fetch_list=list(outs))
+
+
+def test_density_prior_box():
+    def build():
+        feat = fluid.layers.data("feat", [4, 4, 4])
+        img = fluid.layers.data("img", [3, 32, 32])
+        return fluid.layers.detection.density_prior_box(
+            feat, img, densities=[2, 1], fixed_sizes=[8.0, 16.0],
+            fixed_ratios=[1.0], clip=True,
+        )
+
+    rng = np.random.RandomState(0)
+    b, v = _run_single(
+        build,
+        {"feat": rng.rand(1, 4, 4, 4).astype("float32"),
+         "img": rng.rand(1, 3, 32, 32).astype("float32")},
+    )
+    b = np.asarray(b)
+    # 2*2 boxes from density 2 + 1 box from density 1 = 5 per cell
+    assert b.shape == (4, 4, 5, 4)
+    assert (b >= 0).all() and (b <= 1).all()
+    # density-1 box at cell (0,0): centered at offset*step = 4, size 16
+    np.testing.assert_allclose(
+        b[0, 0, 4], [0, 0, 12 / 32, 12 / 32], atol=1e-6
+    )
+
+
+def test_rpn_target_assign_and_generate_proposals():
+    A_, H, W = 3, 4, 4
+
+    def build():
+        scores = fluid.layers.data("scores", [A_, H, W])
+        deltas = fluid.layers.data("deltas", [4 * A_, H, W])
+        im_info = fluid.layers.data("im_info", [3])
+        feat = fluid.layers.data("feat", [8, H, W])
+        anchors, variances = fluid.layers.detection.anchor_generator(
+            feat, anchor_sizes=[8.0], aspect_ratios=[0.5, 1.0, 2.0],
+            stride=[8.0, 8.0],
+        )
+        rois, probs = fluid.layers.detection.generate_proposals(
+            scores, deltas, im_info, anchors, variances,
+            pre_nms_top_n=20, post_nms_top_n=6, nms_thresh=0.7, min_size=1.0,
+        )
+        anchors2d = fluid.layers.reshape(anchors, shape=[-1, 4])
+        gt = fluid.layers.data("gt", [2, 4])
+        bbox_pred = fluid.layers.data("bp", [A_ * H * W, 4])
+        cls_log = fluid.layers.data("cl", [A_ * H * W, 1])
+        (ps, pl, tl, tb, biw, sw) = fluid.layers.detection.rpn_target_assign(
+            bbox_pred, cls_log, anchors2d, anchors2d, gt, im_info=im_info,
+            rpn_batch_size_per_im=32, rpn_positive_overlap=0.5,
+            rpn_negative_overlap=0.3,
+        )
+        return rois, probs, tl, tb, biw, sw
+
+    rng = np.random.RandomState(0)
+    N = 2
+    gt = np.zeros((N, 2, 4), np.float32)
+    gt[:, 0] = [4.0, 4.0, 12.0, 12.0]  # one real gt; row 1 stays padding
+    rois, probs, tl, tb, biw, sw = _run_single(
+        build,
+        {
+            "scores": rng.rand(N, A_, H, W).astype("float32"),
+            "deltas": (rng.randn(N, 4 * A_, H, W) * 0.1).astype("float32"),
+            "im_info": np.tile([32.0, 32.0, 1.0], (N, 1)).astype("float32"),
+            "feat": rng.rand(N, 8, H, W).astype("float32"),
+            "gt": gt,
+            "bp": rng.randn(N, A_ * H * W, 4).astype("float32"),
+            "cl": rng.randn(N, A_ * H * W, 1).astype("float32"),
+        },
+    )
+    rois, probs = np.asarray(rois), np.asarray(probs)
+    assert rois.shape == (N, 6, 4) and probs.shape == (N, 6, 1)
+    # valid proposals have prob > -1 and stay inside the 32x32 image
+    valid = probs[..., 0] > -1
+    assert valid.any()
+    assert (rois[valid] >= 0).all() and (rois[valid] <= 31).all()
+    tl, biw, sw = np.asarray(tl), np.asarray(biw), np.asarray(sw)
+    # the gt-overlapping anchors must produce at least one fg label/image
+    assert ((tl == 1).sum(axis=(1, 2)) >= 1).all()
+    # fg anchors carry loc weight; sampled anchors carry score weight
+    assert (biw.max(axis=(1, 2)) == 1).all()
+    assert (sw.sum(axis=(1, 2)) >= (tl == 1).sum(axis=(1, 2))).all()
+
+
+def test_detection_map_perfect_and_miss():
+    B = 3
+
+    def build():
+        det = fluid.layers.data("det", [4, 6])
+        lbl = fluid.layers.data("lbl", [B], dtype="int32")
+        gtb = fluid.layers.data("gtb", [B, 4])
+        m = fluid.layers.detection.detection_map(det, lbl, class_num=3, gt_box=gtb)
+        return (m,)
+
+    gtb = np.zeros((1, B, 4), np.float32)
+    gtb[0, 0] = [0.1, 0.1, 0.4, 0.4]
+    gtb[0, 1] = [0.5, 0.5, 0.9, 0.9]
+    lbl = np.array([[1, 2, 0]], np.int32)
+    # perfect detections
+    det = np.full((1, 4, 6), -1, np.float32)
+    det[0, 0] = [1, 0.9, 0.1, 0.1, 0.4, 0.4]
+    det[0, 1] = [2, 0.8, 0.5, 0.5, 0.9, 0.9]
+    (m,) = _run_single(build, {"det": det, "lbl": lbl, "gtb": gtb})
+    np.testing.assert_allclose(np.asarray(m), [1.0], atol=1e-5)
+    # all-miss detections
+    det_bad = np.full((1, 4, 6), -1, np.float32)
+    det_bad[0, 0] = [1, 0.9, 0.6, 0.6, 0.7, 0.7]
+    (m2,) = _run_single(build, {"det": det_bad, "lbl": lbl, "gtb": gtb})
+    assert np.asarray(m2)[0] < 0.01
+
+
+def test_roi_align_adaptive_matches_explicit():
+    """sampling_ratio=-1 must equal the explicit per-roi ceil ratio
+    (ADVICE round-2: the old code forced ratio=2)."""
+    rng = np.random.RandomState(0)
+    x = rng.rand(1, 2, 8, 8).astype("float32")
+    # roi of size 6x3 pooled to 2x2 -> adaptive ratios ceil(3)=3, ceil(1.5)=2
+    rois = np.array([[1.0, 1.0, 7.0, 4.0]], np.float32)
+
+    def build(ratio):
+        def _b():
+            xi = fluid.layers.data("x", [2, 8, 8])
+            r = fluid.layers.data("rois", [4], append_batch_size=True)
+            return (fluid.layers.detection.roi_align(
+                xi, r, pooled_height=2, pooled_width=2, sampling_ratio=ratio),)
+        return _b
+
+    (adaptive,) = _run_single(build(-1), {"x": x, "rois": rois})
+    adaptive = np.asarray(adaptive)
+    # explicit: sample at ratio 3 on y? adaptive is per-axis (3 on x, 2 on y)
+    # verify against a numpy bilinear average with the exact per-axis ratios
+    def bilin(img, y, xq):
+        y0, x0 = int(np.floor(y)), int(np.floor(xq))
+        y1, x1 = min(y0 + 1, 7), min(x0 + 1, 7)
+        wy, wx = y - y0, xq - x0
+        return (img[:, y0, x0] * (1 - wy) * (1 - wx) + img[:, y0, x1] * (1 - wy) * wx
+                + img[:, y1, x0] * wy * (1 - wx) + img[:, y1, x1] * wy * wx)
+
+    x1_, y1_, x2_, y2_ = rois[0]
+    rw, rh = max(x2_ - x1_, 1.0), max(y2_ - y1_, 1.0)
+    bw, bh = rw / 2, rh / 2
+    r_w, r_h = int(np.ceil(bw)), int(np.ceil(bh))
+    want = np.zeros((2, 2, 2), np.float32)
+    for i in range(2):
+        for j in range(2):
+            acc = np.zeros(2)
+            for ky in range(r_h):
+                for kx in range(r_w):
+                    yy = y1_ + (i + (ky + 0.5) / r_h) * bh
+                    xx = x1_ + (j + (kx + 0.5) / r_w) * bw
+                    acc += bilin(x[0], yy, xx)
+            want[:, i, j] = acc / (r_h * r_w)
+    np.testing.assert_allclose(adaptive[0], want, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: tiny SSD and tiny YOLO must train (VERDICT r2 item 2)
+# ---------------------------------------------------------------------------
+def _train_losses(build_fn, feed, steps=12, lr=0.01):
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 5
+    with framework.program_guard(prog, startup):
+        loss = build_fn()
+        fluid.optimizer.MomentumOptimizer(lr, 0.9).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            (lv,) = exe.run(prog, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(lv)))
+    return losses
+
+
+@pytest.mark.slow
+def test_tiny_ssd_trains():
+    N, B, C = 2, 3, 4  # C classes incl. background 0
+
+    def build():
+        img = fluid.layers.data("img", [3, 32, 32])
+        gt_box = fluid.layers.data("gt_box", [B, 4])
+        gt_label = fluid.layers.data("gt_label", [B, 1], dtype="int32")
+        c1 = fluid.layers.conv2d(img, num_filters=8, filter_size=3,
+                                 padding=1, stride=2, act="relu")  # 16x16
+        c2 = fluid.layers.conv2d(c1, num_filters=8, filter_size=3,
+                                 padding=1, stride=2, act="relu")  # 8x8
+        c3 = fluid.layers.conv2d(c2, num_filters=8, filter_size=3,
+                                 padding=1, stride=2, act="relu")  # 4x4
+        locs, confs, priors, pvars = fluid.layers.detection.multi_box_head(
+            inputs=[c2, c3], image=img, base_size=32, num_classes=C,
+            aspect_ratios=[[1.0], [1.0]], min_sizes=[8.0, 16.0],
+            max_sizes=[16.0, 24.0], flip=False,
+        )
+        loss = fluid.layers.detection.ssd_loss(
+            locs, confs, gt_box, gt_label, priors, pvars,
+        )
+        return fluid.layers.mean(loss)
+
+    rng = np.random.RandomState(0)
+    gt_box = np.zeros((N, B, 4), np.float32)
+    gt_box[:, 0] = [0.1, 0.1, 0.45, 0.45]
+    gt_box[:, 1] = [0.55, 0.5, 0.95, 0.95]  # row 2 stays zero = padding
+    gt_label = np.zeros((N, B, 1), np.int32)
+    gt_label[:, 0, 0] = 1
+    gt_label[:, 1, 0] = 2
+    feed = {
+        "img": rng.rand(N, 3, 32, 32).astype("float32"),
+        "gt_box": gt_box,
+        "gt_label": gt_label,
+    }
+    losses = _train_losses(build, feed, steps=12, lr=0.05)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+@pytest.mark.slow
+def test_tiny_yolo_trains():
+    N, B, C = 2, 3, 4
+    anchors = [10, 13, 16, 30, 33, 23]
+
+    def build():
+        img = fluid.layers.data("img", [3, 32, 32])
+        gt_box = fluid.layers.data("gt_box", [B, 4])
+        gt_label = fluid.layers.data("gt_label", [B], dtype="int32")
+        c1 = fluid.layers.conv2d(img, num_filters=16, filter_size=3,
+                                 padding=1, stride=4, act="relu")  # 8x8
+        head = fluid.layers.conv2d(c1, num_filters=3 * (5 + C),
+                                   filter_size=3, padding=1, stride=2)  # 4x4
+        loss = fluid.layers.detection.yolov3_loss(
+            head, gt_box, gt_label, anchors=anchors, anchor_mask=[0, 1, 2],
+            class_num=C, ignore_thresh=0.7, downsample_ratio=8,
+        )
+        return fluid.layers.mean(loss)
+
+    rng = np.random.RandomState(1)
+    gt_box = np.zeros((N, B, 4), np.float32)
+    gt_box[:, 0] = [0.3, 0.35, 0.25, 0.2]
+    gt_box[:, 1] = [0.7, 0.65, 0.35, 0.3]
+    gt_label = rng.randint(0, C, (N, B)).astype(np.int32)
+    feed = {
+        "img": rng.rand(N, 3, 32, 32).astype("float32"),
+        "gt_box": gt_box,
+        "gt_label": gt_label,
+    }
+    losses = _train_losses(build, feed, steps=12, lr=0.01)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.8, losses
