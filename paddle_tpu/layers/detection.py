@@ -179,16 +179,23 @@ def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5, nam
     return idx, dist
 
 
-def target_assign(input, matched_indices, mismatch_value=0, name=None):
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    """reference: layers/detection.py target_assign — NegIndices is the
+    [N, M] 0/1 mask (padded analog of the reference's LoD index list)."""
     helper = LayerHelper("target_assign", name=name)
     out = helper.create_variable_for_type_inference(input.dtype)
     w = helper.create_variable_for_type_inference("float32")
+    ins = {"X": [input], "MatchIndices": [matched_indices]}
+    if negative_indices is not None:
+        ins["NegIndices"] = [negative_indices]
     helper.append_op(
-        type="target_assign",
-        inputs={"X": [input], "MatchIndices": [matched_indices]},
+        type="target_assign", inputs=ins,
         outputs={"Out": [out], "OutWeight": [w]},
         attrs={"mismatch_value": mismatch_value},
     )
+    out.stop_gradient = True
+    w.stop_gradient = True
     return out, w
 
 
@@ -250,26 +257,6 @@ def mine_hard_examples(cls_loss, match_indices, match_dist,
     return neg, updated
 
 
-def target_assign_ex(input, matched_indices, negative_indices=None,
-                     mismatch_value=0, name=None):
-    """target_assign with the optional NegIndices mask input (the public
-    target_assign signature stays reference-compatible)."""
-    helper = LayerHelper("target_assign", name=name)
-    out = helper.create_variable_for_type_inference(input.dtype)
-    w = helper.create_variable_for_type_inference("float32")
-    ins = {"X": [input], "MatchIndices": [matched_indices]}
-    if negative_indices is not None:
-        ins["NegIndices"] = [negative_indices]
-    helper.append_op(
-        type="target_assign", inputs=ins,
-        outputs={"Out": [out], "OutWeight": [w]},
-        attrs={"mismatch_value": mismatch_value},
-    )
-    out.stop_gradient = True
-    w.stop_gradient = True
-    return out, w
-
-
 def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
              prior_box_var=None, background_label=0, overlap_threshold=0.5,
              neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
@@ -322,10 +309,10 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
         prior_box=prior_box, prior_box_var=prior_box_var,
         target_box=gt_box, code_type="encode_center_size",
     )  # [N, B, P, 4]
-    target_bbox, target_loc_weight = target_assign_ex(
+    target_bbox, target_loc_weight = target_assign(
         encoded_bbox, updated_match, mismatch_value=background_label
     )
-    target_label2, target_conf_weight = target_assign_ex(
+    target_label2, target_conf_weight = target_assign(
         gt_label, updated_match, negative_indices=neg_mask,
         mismatch_value=background_label,
     )
@@ -484,10 +471,14 @@ def detection_output(loc, scores, prior_box, prior_box_var,
     )
     scores = nn.softmax(scores)
     scores = tensor.transpose(scores, [0, 2, 1])  # [N, C, P]
+    # decoded SSD boxes are in normalized [0,1] coordinates — the op's
+    # normalized attr must stay true or the +1-pixel IoU convention
+    # inflates overlap and suppresses distinct objects (the reference
+    # leaves the attr at its default true here)
     return multiclass_nms(
         decoded, scores, score_threshold=score_threshold,
         nms_top_k=nms_top_k, keep_top_k=keep_top_k,
-        nms_threshold=nms_threshold, normalized=False,
+        nms_threshold=nms_threshold, normalized=True,
     )
 
 

@@ -70,6 +70,14 @@ __all__ = [
     "crop",
     "pad_constant_like",
     "py_func",
+    "linear_chain_crf",
+    "crf_decoding",
+    "spectral_norm",
+    "data_norm",
+    "row_conv",
+    "bilinear_tensor_product",
+    "edit_distance",
+    "ctc_greedy_decoder",
 ]
 
 
@@ -1007,3 +1015,190 @@ def sequence_first_step(input, seq_len=None):
 
 def sequence_last_step(input, seq_len=None):
     return sequence_pool(input, "last", seq_len=seq_len)
+
+
+# ---------------------------------------------------------------------------
+# CRF, spectral/data norm, row_conv, bilinear tensor product, edit distance
+# (reference: layers/nn.py linear_chain_crf:1358, crf_decoding:1419,
+# data_norm:3353, spectral_norm:3670, edit_distance:5459, row_conv:6334,
+# bilinear_tensor_product:11534)
+# ---------------------------------------------------------------------------
+def linear_chain_crf(input, label, param_attr=None, seq_len=None):
+    """CRF negative log-likelihood cost [B, 1]; creates the [K+2, K]
+    transition parameter (row 0 start, row 1 end, rows 2.. transitions)."""
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr)
+    size = input.shape[-1]
+    transition = helper.create_parameter(param_attr, shape=[size + 2, size], dtype=input.dtype)
+    alpha = helper.create_variable_for_type_inference(input.dtype)
+    emission_exps = helper.create_variable_for_type_inference(input.dtype)
+    transition_exps = helper.create_variable_for_type_inference(input.dtype)
+    log_likelihood = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"Emission": [input], "Transition": [transition], "Label": [label]}
+    if seq_len is not None:
+        ins["SeqLen"] = [seq_len]
+    helper.append_op(
+        type="linear_chain_crf", inputs=ins,
+        outputs={"Alpha": [alpha], "EmissionExps": [emission_exps],
+                 "TransitionExps": [transition_exps],
+                 "LogLikelihood": [log_likelihood]},
+        attrs={},
+    )
+    return log_likelihood
+
+
+def crf_decoding(input, param_attr, label=None, seq_len=None):
+    """Viterbi decode using the transition parameter created by
+    linear_chain_crf (shared by ``param_attr.name``)."""
+    from paddle_tpu.param_attr import ParamAttr
+
+    helper = LayerHelper("crf_decoding")
+    attr = ParamAttr._to_attr(param_attr)
+    transition = helper.main_program.global_block().var(attr.name)
+    viterbi_path = helper.create_variable_for_type_inference("int64")
+    ins = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        ins["Label"] = [label]
+    if seq_len is not None:
+        ins["SeqLen"] = [seq_len]
+    helper.append_op(type="crf_decoding", inputs=ins,
+                     outputs={"ViterbiPath": [viterbi_path]}, attrs={})
+    return viterbi_path
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Spectrally-normalized view of ``weight``; creates persistent U/V
+    power-iteration buffers (Normal-initialized, non-trainable)."""
+    from paddle_tpu import initializer
+    from paddle_tpu.param_attr import ParamAttr
+
+    helper = LayerHelper("spectral_norm", name=name)
+    if any(int(s) < 0 for s in weight.shape):
+        raise ValueError(
+            "spectral_norm requires a fully static weight shape, got %s"
+            % (weight.shape,)
+        )
+    h = int(weight.shape[dim])
+    w = 1
+    for i, s in enumerate(weight.shape):
+        if i != dim:
+            w *= int(s)
+    u = helper.create_parameter(
+        ParamAttr(trainable=False), shape=[h], dtype=weight.dtype,
+        default_initializer=initializer.Normal(0.0, 1.0))
+    v = helper.create_parameter(
+        ParamAttr(trainable=False), shape=[w], dtype=weight.dtype,
+        default_initializer=initializer.Normal(0.0, 1.0))
+    out = helper.create_variable_for_type_inference(weight.dtype)
+    helper.append_op(
+        type="spectral_norm",
+        inputs={"Weight": [weight], "U": [u], "V": [v]},
+        outputs={"Out": [out]},
+        attrs={"dim": int(dim), "power_iters": int(power_iters), "eps": float(eps)},
+    )
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-4, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=False):
+    """CTR data normalization; BatchSize/BatchSum/BatchSquareSum stat
+    accumulators are *trainable* so SGD folds fresh batch stats in via
+    the op's custom cotangents (see ops/nn_ops.py data_norm)."""
+    from paddle_tpu import initializer
+    from paddle_tpu.param_attr import ParamAttr
+
+    helper = LayerHelper("data_norm", name=name, act=act)
+    c = int(input.shape[1] if data_layout == "NCHW" else input.shape[-1])
+    defaults = {"batch_size": 1e4, "batch_sum": 0.0, "batch_square": 1e4}
+    if param_attr and isinstance(param_attr, dict):
+        defaults.update({k: param_attr.get(k, v) for k, v in defaults.items()})
+    mk = lambda suffix, val: helper.create_parameter(
+        ParamAttr(name=None if name is None else name + "." + suffix),
+        shape=[c], dtype=input.dtype,
+        default_initializer=initializer.Constant(float(val)))
+    batch_size = mk("batch_size", defaults["batch_size"])
+    batch_sum = mk("batch_sum", defaults["batch_sum"])
+    batch_square_sum = mk("batch_square_sum", defaults["batch_square"])
+    means = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    scales = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="data_norm",
+        inputs={"X": [input], "BatchSize": [batch_size],
+                "BatchSum": [batch_sum], "BatchSquareSum": [batch_square_sum]},
+        outputs={"Y": [out], "Means": [means], "Scales": [scales]},
+        attrs={"epsilon": float(epsilon), "data_layout": data_layout},
+    )
+    return helper.append_activation(out)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None, seq_len=None):
+    """Lookahead (row) convolution; filter [future_context_size + 1, D]."""
+    helper = LayerHelper("row_conv", param_attr=param_attr, act=act)
+    d = int(input.shape[-1])
+    filt = helper.create_parameter(param_attr, shape=[future_context_size + 1, d],
+                                   dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input], "Filter": [filt]}
+    if seq_len is not None:
+        ins["SeqLen"] = [seq_len]
+    helper.append_op(type="row_conv", inputs=ins, outputs={"Out": [out]}, attrs={})
+    return helper.append_activation(out)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None, param_attr=None,
+                            bias_attr=None):
+    """out[b, k] = x[b]^T W[k] y[b] + bias, W [size, M, N]."""
+    helper = LayerHelper("bilinear_tensor_product", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    m, n = int(x.shape[-1]), int(y.shape[-1])
+    w = helper.create_parameter(param_attr, shape=[size, m, n], dtype=x.dtype)
+    bias = helper.create_parameter(bias_attr, shape=[1, size], dtype=x.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    ins = {"X": [x], "Y": [y], "Weight": [w]}
+    if bias is not None:
+        ins["Bias"] = [bias]
+    helper.append_op(type="bilinear_tensor_product", inputs=ins,
+                     outputs={"Out": [out]}, attrs={})
+    return helper.append_activation(out)
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """Batched Levenshtein distance -> (Out [B, 1], SequenceNum []).
+    ``ignored_tokens`` are erased (sequence_erase) before the DP."""
+    helper = LayerHelper("edit_distance")
+    if ignored_tokens:
+        input, input_length = sequence_erase(input, ignored_tokens, input_length)
+        label, label_length = sequence_erase(label, ignored_tokens, label_length)
+    out = helper.create_variable_for_type_inference("float32")
+    seq_num = helper.create_variable_for_type_inference("int64")
+    ins = {"Hyps": [input], "Refs": [label]}
+    if input_length is not None:
+        ins["HypsLength"] = [input_length]
+    if label_length is not None:
+        ins["RefsLength"] = [label_length]
+    helper.append_op(type="edit_distance", inputs=ins,
+                     outputs={"Out": [out], "SequenceNum": [seq_num]},
+                     attrs={"normalized": normalized})
+    return out, seq_num
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0):
+    """Greedy CTC decode: per-step argmax then ctc_align (merge repeats,
+    drop blanks).  Returns (decoded [B, T], decoded_length [B])."""
+    helper = LayerHelper("ctc_greedy_decoder")
+    from paddle_tpu.layers import tensor as ltensor
+
+    idx = ltensor.argmax(input, axis=-1)
+    out = helper.create_variable_for_type_inference("int64")
+    out_len = helper.create_variable_for_type_inference("int32")
+    ins = {"Input": [idx]}
+    if input_length is not None:
+        ins["SeqLen"] = [input_length]
+    helper.append_op(type="ctc_align", inputs=ins,
+                     outputs={"Output": [out], "OutputLength": [out_len]},
+                     attrs={"blank": int(blank), "merge_repeated": True,
+                            "padding_num": int(padding_value)})
+    return out, out_len

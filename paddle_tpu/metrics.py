@@ -3,7 +3,7 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["MetricBase", "Accuracy", "Auc", "Precision", "Recall", "CompositeMetric", "ChunkEvaluator"]
+__all__ = ["MetricBase", "Accuracy", "Auc", "Precision", "Recall", "CompositeMetric", "ChunkEvaluator", "DetectionMAP"]
 
 
 class MetricBase:

@@ -11,7 +11,7 @@ from __future__ import annotations
 import numpy as np
 
 from paddle_tpu.core.registry import register_op
-from paddle_tpu.ops.common import one, prng
+from paddle_tpu.ops.common import maybe, one, prng
 
 
 def _jnp():
@@ -765,4 +765,124 @@ def shuffle_channel(inputs, attrs):
     g = int(attrs.get("group", 1))
     n, c, h, w = x.shape
     out = x.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+    return {"Out": out}
+
+
+@register_op("spectral_norm", no_grad_set={"U", "V"})
+def spectral_norm(inputs, attrs):
+    """reference: operators/spectral_norm_op.h CalcMatrixSigmaAndNormWeight —
+    power iteration v = W^T u / ||.||, u = W v / ||.||, sigma = u^T W v,
+    Out = W / sigma.  U/V are persistent buffers treated as constants for
+    the gradient (stop_gradient), matching the reference grad kernel which
+    differentiates only through Weight."""
+    import jax
+
+    jnp = _jnp()
+    w = one(inputs, "Weight")
+    u = one(inputs, "U").reshape(-1)
+    v = one(inputs, "V").reshape(-1)
+    dim = int(attrs.get("dim", 0))
+    power_iters = int(attrs.get("power_iters", 1))
+    eps = attrs.get("eps", 1e-12)
+    perm = (dim,) + tuple(i for i in range(w.ndim) if i != dim)
+    h = w.shape[dim]
+    wmat = jnp.transpose(w, perm).reshape(h, -1)
+    u = jax.lax.stop_gradient(u)
+    v = jax.lax.stop_gradient(v)
+    for _ in range(power_iters):
+        v = wmat.T @ u
+        v = jax.lax.stop_gradient(v / (jnp.linalg.norm(v) + eps))
+        u = wmat @ v
+        u = jax.lax.stop_gradient(u / (jnp.linalg.norm(u) + eps))
+    sigma = u @ (wmat @ v)
+    out = wmat / sigma
+    inv_perm = tuple(np.argsort(perm))
+    out = jnp.transpose(out.reshape(tuple(w.shape[p] for p in perm)), inv_perm)
+    return {"Out": out}
+
+
+@register_op("data_norm")
+def data_norm(inputs, attrs):
+    """reference: operators/data_norm_op.cc — CTR data normalization.
+
+    Y = (X - mean) * scale with mean = BatchSum/BatchSize and
+    scale = sqrt(BatchSize/BatchSquareSum).  The reference routes *stat
+    updates* through the gradient channel (DataNormGradKernel sets
+    dBatchSize=N, dBatchSum=sum(x), dBatchSquareSum=sum((x-mean)^2)+N*eps
+    so plain SGD with lr folds fresh batch stats into the accumulators);
+    jax.custom_vjp reproduces exactly those cotangents."""
+    import jax
+
+    jnp = _jnp()
+    x = one(inputs, "X")
+    bsize = one(inputs, "BatchSize")
+    bsum = one(inputs, "BatchSum")
+    bsqsum = one(inputs, "BatchSquareSum")
+    eps = attrs.get("epsilon", 1e-4)
+    layout = attrs.get("data_layout", "NCHW")
+    caxis = 1 if (layout == "NCHW" and x.ndim > 2) else x.ndim - 1
+    cshape = tuple(-1 if i == caxis else 1 for i in range(x.ndim))
+    n = x.shape[0]
+    red = tuple(i for i in range(x.ndim) if i != caxis)
+
+    @jax.custom_vjp
+    def _dn(xv, bsz, bsm, bss):
+        means = bsm / bsz
+        scales = jnp.sqrt(bsz / bss)
+        return (xv - means.reshape(cshape)) * scales.reshape(cshape)
+
+    def _dn_fwd(xv, bsz, bsm, bss):
+        means = bsm / bsz
+        scales = jnp.sqrt(bsz / bss)
+        y = (xv - means.reshape(cshape)) * scales.reshape(cshape)
+        return y, (xv, means, scales)
+
+    def _dn_bwd(res, gy):
+        xv, means, scales = res
+        dx = gy * scales.reshape(cshape)
+        d_bsz = jnp.full(means.shape, float(n), dtype=xv.dtype)
+        d_bsm = jnp.sum(xv, axis=red)
+        d_bss = jnp.sum(jnp.square(xv - means.reshape(cshape)), axis=red) + d_bsz * eps
+        return dx, d_bsz, d_bsm, d_bss
+
+    _dn.defvjp(_dn_fwd, _dn_bwd)
+    means = bsum / bsize
+    scales = jnp.sqrt(bsize / bsqsum)
+    return {"Y": _dn(x, bsize, bsum, bsqsum), "Means": means, "Scales": scales}
+
+
+@register_op("row_conv", no_grad_set={"SeqLen"})
+def row_conv(inputs, attrs):
+    """reference: operators/row_conv_op.h — lookahead convolution (Deep
+    Speech 2): out[t] = sum_{j=0..k-1} x[t+j] * filter[j], future context
+    zero beyond each sequence's end.  Padded [B, T, D] + SeqLen encoding;
+    the k shifted adds stay fused elementwise on TPU (k is tiny)."""
+    jnp = _jnp()
+    x = one(inputs, "X")  # [B, T, D]
+    filt = one(inputs, "Filter")  # [k, D]
+    seq_len = maybe(inputs, "SeqLen")
+    k = filt.shape[0]
+    B, T, D = x.shape
+    if seq_len is not None:
+        m = (jnp.arange(T)[None, :] < seq_len.reshape(-1)[:, None]).astype(x.dtype)
+        x = x * m[:, :, None]
+    xpad = jnp.pad(x, ((0, 0), (0, k), (0, 0)))
+    out = jnp.zeros_like(x)
+    for j in range(k):
+        out = out + xpad[:, j : j + T, :] * filt[j][None, None, :]
+    return {"Out": out}
+
+
+@register_op("bilinear_tensor_product")
+def bilinear_tensor_product(inputs, attrs):
+    """reference: operators/bilinear_tensor_product_op.h —
+    out[b,k] = x[b]^T W[k] y[b] (+ bias).  One einsum -> two MXU matmuls."""
+    jnp = _jnp()
+    x = one(inputs, "X")  # [B, M]
+    y = one(inputs, "Y")  # [B, N]
+    w = one(inputs, "Weight")  # [K, M, N]
+    bias = maybe(inputs, "Bias")  # [1, K]
+    out = jnp.einsum("bm,kmn,bn->bk", x, w, y)
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
     return {"Out": out}
