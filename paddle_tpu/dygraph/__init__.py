@@ -13,12 +13,19 @@ from paddle_tpu.dygraph.base import guard, enabled, no_grad, to_variable  # noqa
 from paddle_tpu.dygraph.layers import Layer  # noqa: F401
 from paddle_tpu.dygraph.nn import (  # noqa: F401
     BatchNorm,
+    BilinearTensorProduct,
     Conv2D,
+    Conv2DTranspose,
     Embedding,
     FC,
+    GroupNorm,
+    GRUUnit,
     LayerNorm,
     Linear,
+    NCE,
     Pool2D,
+    PRelu,
+    SpectralNorm,
 )
 from paddle_tpu.dygraph.parallel import DataParallel, prepare_context  # noqa: F401
 from paddle_tpu.dygraph.checkpoint import load_dygraph, save_dygraph  # noqa: F401

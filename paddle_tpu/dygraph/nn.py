@@ -11,7 +11,11 @@ from typing import Optional
 from paddle_tpu import layers
 from paddle_tpu.dygraph.layers import Layer
 
-__all__ = ["Conv2D", "FC", "Linear", "BatchNorm", "Embedding", "LayerNorm", "Pool2D"]
+__all__ = [
+    "Conv2D", "FC", "Linear", "BatchNorm", "Embedding", "LayerNorm",
+    "Pool2D", "Conv2DTranspose", "GroupNorm", "PRelu", "SpectralNorm",
+    "GRUUnit", "NCE", "BilinearTensorProduct",
+]
 
 
 class Conv2D(Layer):
@@ -275,3 +279,313 @@ class Pool2D(Layer):
             pool_padding=self._pool_padding,
             global_pooling=self._global_pooling,
         )
+
+
+class Conv2DTranspose(Layer):
+    """reference: dygraph/nn.py Conv2DTranspose — filter [in_c,
+    out_c//groups, kh, kw] created on first forward (needs in channels)."""
+
+    def __init__(self, name_scope=None, num_filters=None, filter_size=None,
+                 output_size=None, padding=0, stride=1, dilation=1, groups=1,
+                 param_attr=None, bias_attr=None, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._num_filters = num_filters
+        self._filter_size = filter_size
+        self._output_size = output_size
+        self._padding = padding
+        self._stride = stride
+        self._dilation = dilation
+        self._groups = groups
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act = act
+
+    def forward(self, input):
+        from paddle_tpu.layer_helper import LayerHelper
+
+        if not hasattr(self, "weight"):
+            num_channels = int(input.shape[1])
+            fsize = (self._filter_size if isinstance(self._filter_size, (list, tuple))
+                     else [self._filter_size] * 2)
+            helper = LayerHelper(self._full_name, param_attr=self._param_attr,
+                                 bias_attr=self._bias_attr)
+            self.weight = helper.create_parameter(
+                self._param_attr,
+                shape=[num_channels, self._num_filters // self._groups] + list(fsize),
+                dtype=self._dtype,
+            )
+            self.bias = helper.create_parameter(
+                self._bias_attr, shape=[self._num_filters], dtype=self._dtype,
+                is_bias=True,
+            )
+        helper = LayerHelper(self._full_name, act=self._act)
+        out = helper.create_variable_for_type_inference(self._dtype)
+        helper.append_op(
+            type="conv2d_transpose",
+            inputs={"Input": [input], "Filter": [self.weight]},
+            outputs={"Output": [out]},
+            attrs={
+                "strides": [self._stride] * 2 if isinstance(self._stride, int) else list(self._stride),
+                "paddings": [self._padding] * 2 if isinstance(self._padding, int) else list(self._padding),
+                "dilations": [self._dilation] * 2 if isinstance(self._dilation, int) else list(self._dilation),
+                "groups": self._groups,
+            },
+        )
+        if self.bias is not None:
+            tmp = helper.create_variable_for_type_inference(self._dtype)
+            helper.append_op(
+                type="elementwise_add",
+                inputs={"X": [out], "Y": [self.bias]},
+                outputs={"Out": [tmp]}, attrs={"axis": 1},
+            )
+            out = tmp
+        return helper.append_activation(out)
+
+
+class GroupNorm(Layer):
+    """reference: dygraph/nn.py GroupNorm."""
+
+    def __init__(self, name_scope=None, groups=None, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, act=None, channels=None,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._groups = groups
+        self._epsilon = epsilon
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act = act
+        if channels is not None:
+            self._build(channels)
+
+    def _build(self, channels):
+        from paddle_tpu import initializer
+        from paddle_tpu.layer_helper import LayerHelper
+
+        helper = LayerHelper(self._full_name, param_attr=self._param_attr,
+                             bias_attr=self._bias_attr)
+        self.weight = helper.create_parameter(
+            self._param_attr, shape=[channels], dtype=self._dtype,
+            default_initializer=initializer.Constant(1.0))
+        self.bias = helper.create_parameter(
+            self._bias_attr, shape=[channels], dtype=self._dtype, is_bias=True)
+
+    def forward(self, input):
+        from paddle_tpu.layer_helper import LayerHelper
+
+        if not hasattr(self, "weight"):
+            self._build(int(input.shape[1]))
+        helper = LayerHelper(self._full_name, act=self._act)
+        out = helper.create_variable_for_type_inference(self._dtype)
+        mean = helper.create_variable_for_type_inference(self._dtype, stop_gradient=True)
+        var = helper.create_variable_for_type_inference(self._dtype, stop_gradient=True)
+        helper.append_op(
+            type="group_norm",
+            inputs={"X": [input], "Scale": [self.weight], "Bias": [self.bias]},
+            outputs={"Y": [out], "Mean": [mean], "Variance": [var]},
+            attrs={"epsilon": self._epsilon, "groups": self._groups},
+        )
+        return helper.append_activation(out)
+
+
+class PRelu(Layer):
+    """reference: dygraph/nn.py PRelu — mode all/channel/element; the
+    channel/element alpha shape binds on first forward."""
+
+    def __init__(self, name_scope=None, mode="all", param_attr=None,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        if mode not in ("all", "channel", "element"):
+            raise ValueError("mode should be 'all', 'channel' or 'element'")
+        self._mode = mode
+        self._param_attr = param_attr
+        if mode == "all":
+            self._build([1])
+
+    def _build(self, alpha_shape):
+        from paddle_tpu import initializer
+        from paddle_tpu.layer_helper import LayerHelper
+
+        helper = LayerHelper(self._full_name, param_attr=self._param_attr)
+        self.weight = helper.create_parameter(
+            self._param_attr, shape=alpha_shape, dtype=self._dtype,
+            default_initializer=initializer.Constant(0.25))
+
+    def forward(self, input):
+        from paddle_tpu.layer_helper import LayerHelper
+
+        if not hasattr(self, "weight"):
+            self._build([int(input.shape[1])] if self._mode == "channel"
+                        else [int(s) for s in input.shape[1:]])
+        helper = LayerHelper(self._full_name)
+        out = helper.create_variable_for_type_inference(self._dtype)
+        helper.append_op(
+            type="prelu", inputs={"X": [input], "Alpha": [self.weight]},
+            outputs={"Out": [out]}, attrs={"mode": self._mode},
+        )
+        return out
+
+
+class SpectralNorm(Layer):
+    """reference: dygraph/nn.py SpectralNorm — U/V power-iteration
+    buffers bind to the weight's shape on first forward."""
+
+    def __init__(self, name_scope=None, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+
+    def forward(self, weight):
+        import numpy as np
+
+        from paddle_tpu import initializer
+        from paddle_tpu.layer_helper import LayerHelper
+        from paddle_tpu.param_attr import ParamAttr
+
+        if not hasattr(self, "weight_u"):
+            if any(int(s) < 0 for s in weight.shape):
+                raise ValueError(
+                    "SpectralNorm requires a fully static weight shape, got %s"
+                    % (weight.shape,))
+            h = int(weight.shape[self._dim])
+            w = int(np.prod([int(s) for i, s in enumerate(weight.shape)
+                             if i != self._dim]))
+            helper = LayerHelper(self._full_name)
+            self.weight_u = helper.create_parameter(
+                ParamAttr(trainable=False), shape=[h], dtype=self._dtype,
+                default_initializer=initializer.Normal(0.0, 1.0))
+            self.weight_v = helper.create_parameter(
+                ParamAttr(trainable=False), shape=[w], dtype=self._dtype,
+                default_initializer=initializer.Normal(0.0, 1.0))
+        helper = LayerHelper(self._full_name)
+        out = helper.create_variable_for_type_inference(self._dtype)
+        helper.append_op(
+            type="spectral_norm",
+            inputs={"Weight": [weight], "U": [self.weight_u], "V": [self.weight_v]},
+            outputs={"Out": [out]},
+            attrs={"dim": int(self._dim), "power_iters": int(self._power_iters),
+                   "eps": float(self._eps)},
+        )
+        return out
+
+
+class GRUUnit(Layer):
+    """reference: dygraph/nn.py GRUUnit — one GRU step over a
+    pre-projected input [B, 3H]; returns (hidden, reset_hidden_prev,
+    gate) like the op."""
+
+    def __init__(self, name_scope=None, size=None, param_attr=None,
+                 bias_attr=None, activation="tanh",
+                 gate_activation="sigmoid", origin_mode=False,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        from paddle_tpu.layer_helper import LayerHelper
+
+        h = size // 3
+        helper = LayerHelper(self._full_name, param_attr=param_attr,
+                             bias_attr=bias_attr)
+        self.weight = helper.create_parameter(param_attr, shape=[h, 3 * h],
+                                              dtype=dtype)
+        self.bias = helper.create_parameter(bias_attr, shape=[1, 3 * h],
+                                            dtype=dtype, is_bias=True)
+        self._activation = activation
+        self._gate_activation = gate_activation
+        self._origin_mode = origin_mode
+
+    def forward(self, input, hidden):
+        from paddle_tpu.layer_helper import LayerHelper
+
+        helper = LayerHelper(self._full_name)
+        gate = helper.create_variable_for_type_inference(self._dtype)
+        reset_h = helper.create_variable_for_type_inference(self._dtype)
+        out_h = helper.create_variable_for_type_inference(self._dtype)
+        ins = {"Input": [input], "HiddenPrev": [hidden], "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        helper.append_op(
+            type="gru_unit", inputs=ins,
+            outputs={"Gate": [gate], "ResetHiddenPrev": [reset_h],
+                     "Hidden": [out_h]},
+            attrs={"activation": self._activation,
+                   "gate_activation": self._gate_activation,
+                   "origin_mode": self._origin_mode},
+        )
+        return out_h, reset_h, gate
+
+
+class NCE(Layer):
+    """reference: dygraph/nn.py NCE — noise-contrastive estimation loss
+    head owning the [num_total_classes, dim] weight table."""
+
+    def __init__(self, name_scope=None, num_total_classes=None, dim=None,
+                 sample_weight=None, param_attr=None, bias_attr=None,
+                 num_neg_samples=10, sampler="uniform", seed=0,
+                 is_sparse=False, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        if sampler not in ("uniform", "log_uniform") or sample_weight is not None:
+            raise NotImplementedError(
+                "NCE supports sampler='uniform'|'log_uniform' without sample_weight")
+        from paddle_tpu.layer_helper import LayerHelper
+
+        helper = LayerHelper(self._full_name, param_attr=param_attr,
+                             bias_attr=bias_attr)
+        self.weight = helper.create_parameter(
+            param_attr, shape=[num_total_classes, dim], dtype=dtype)
+        self.bias = helper.create_parameter(
+            bias_attr, shape=[num_total_classes], dtype=dtype, is_bias=True)
+        self._attrs = {"num_neg_samples": num_neg_samples, "seed": seed,
+                       "sampler": sampler}
+
+    def forward(self, input, label):
+        from paddle_tpu.layer_helper import LayerHelper
+
+        helper = LayerHelper(self._full_name)
+        cost = helper.create_variable_for_type_inference(self._dtype)
+        ins = {"Input": [input], "Label": [label], "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        helper.append_op(type="nce", inputs=ins, outputs={"Cost": [cost]},
+                         attrs=dict(self._attrs))
+        return cost
+
+
+class BilinearTensorProduct(Layer):
+    """reference: dygraph/nn.py BilinearTensorProduct —
+    out[b, k] = x[b]^T W[k] y[b] + bias."""
+
+    def __init__(self, name_scope=None, size=None, name=None, act=None,
+                 param_attr=None, bias_attr=None, input1_dim=None,
+                 input2_dim=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._size = size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act = act
+        if input1_dim is not None and input2_dim is not None:
+            self._build(input1_dim, input2_dim)
+
+    def _build(self, m, n):
+        from paddle_tpu.layer_helper import LayerHelper
+
+        helper = LayerHelper(self._full_name, param_attr=self._param_attr,
+                             bias_attr=self._bias_attr)
+        self.weight = helper.create_parameter(
+            self._param_attr, shape=[self._size, m, n], dtype=self._dtype)
+        self.bias = helper.create_parameter(
+            self._bias_attr, shape=[1, self._size], dtype=self._dtype,
+            is_bias=True)
+
+    def forward(self, x, y):
+        from paddle_tpu.layer_helper import LayerHelper
+
+        if not hasattr(self, "weight"):
+            self._build(int(x.shape[-1]), int(y.shape[-1]))
+        helper = LayerHelper(self._full_name, act=self._act)
+        out = helper.create_variable_for_type_inference(self._dtype)
+        ins = {"X": [x], "Y": [y], "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        helper.append_op(type="bilinear_tensor_product", inputs=ins,
+                         outputs={"Out": [out]}, attrs={})
+        return helper.append_activation(out)

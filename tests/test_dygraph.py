@@ -111,3 +111,110 @@ def test_no_grad_blocks_taping():
         z = fluid.layers.reduce_sum(x * x)
         z.backward()
         assert x.gradient() is not None
+
+
+def test_conv2d_transpose_layer_trains():
+    rng = np.random.RandomState(2)
+    xb = rng.uniform(-1, 1, (4, 3, 5, 5)).astype("float32")
+    with dygraph.guard():
+        model = dygraph.Conv2DTranspose(num_filters=6, filter_size=3, stride=2)
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=0.05)
+        losses = []
+        for _ in range(5):
+            out = model(to_variable(xb))
+            assert tuple(out.numpy().shape[:2]) == (4, 6)
+            loss = fluid.layers.mean(out * out)
+            loss.backward()
+            opt.minimize(loss, parameter_list=model.parameters())
+            model.clear_gradients()
+            losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_groupnorm_prelu_layers_train():
+    rng = np.random.RandomState(3)
+    xb = rng.uniform(-1, 1, (4, 6, 4, 4)).astype("float32")
+    with dygraph.guard():
+        gn = dygraph.GroupNorm(groups=3)
+        pr = dygraph.PRelu(mode="channel")
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=0.1)
+        losses = []
+        for _ in range(5):
+            h = pr(gn(to_variable(xb)))
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(
+                h, to_variable(np.ones_like(xb) * 0.2)))
+            loss.backward()
+            params = gn.parameters() + pr.parameters()
+            opt.minimize(loss, parameter_list=params)
+            gn.clear_gradients()
+            pr.clear_gradients()
+            losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+    assert tuple(pr.weight.shape) == (6,)
+
+
+def test_spectral_norm_layer_bounds_sigma():
+    rng = np.random.RandomState(4)
+    w = (rng.randn(8, 12) * 3).astype("float32")
+    with dygraph.guard():
+        sn = dygraph.SpectralNorm(dim=0, power_iters=10)
+        wn = sn(to_variable(w))
+        # top singular value of the normalized weight ~ 1
+        s = np.linalg.svd(wn.numpy(), compute_uv=False)[0]
+    assert abs(s - 1.0) < 0.05, s
+
+
+def test_gru_unit_layer_trains():
+    rng = np.random.RandomState(5)
+    H = 4
+    xb = rng.randn(6, 3 * H).astype("float32")
+    hb = rng.randn(6, H).astype("float32")
+    with dygraph.guard():
+        cell = dygraph.GRUUnit(size=3 * H)
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=0.1)
+        losses = []
+        for _ in range(5):
+            h, _, _ = cell(to_variable(xb), to_variable(hb))
+            loss = fluid.layers.mean(h * h)
+            loss.backward()
+            opt.minimize(loss, parameter_list=cell.parameters())
+            cell.clear_gradients()
+            losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_nce_layer_trains():
+    rng = np.random.RandomState(6)
+    xb = rng.randn(8, 16).astype("float32")
+    yb = rng.randint(0, 50, (8, 1)).astype("int64")
+    with dygraph.guard():
+        head = dygraph.NCE(num_total_classes=50, dim=16, num_neg_samples=5, seed=1)
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=0.2)
+        losses = []
+        for _ in range(8):
+            cost = head(to_variable(xb), to_variable(yb))
+            loss = fluid.layers.mean(cost)
+            loss.backward()
+            opt.minimize(loss, parameter_list=head.parameters())
+            head.clear_gradients()
+            losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0], losses
+
+
+def test_bilinear_tensor_product_layer_trains():
+    rng = np.random.RandomState(7)
+    xb = rng.randn(6, 3).astype("float32")
+    yb = rng.randn(6, 5).astype("float32")
+    with dygraph.guard():
+        btp = dygraph.BilinearTensorProduct(size=4)
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=0.05)
+        losses = []
+        for _ in range(5):
+            out = btp(to_variable(xb), to_variable(yb))
+            loss = fluid.layers.mean(out * out)
+            loss.backward()
+            opt.minimize(loss, parameter_list=btp.parameters())
+            btp.clear_gradients()
+            losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+    assert tuple(btp.weight.shape) == (4, 3, 5)
