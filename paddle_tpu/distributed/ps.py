@@ -196,6 +196,121 @@ class _Table:
                     row -= self.lr * g
 
 
+class _DenseParam:
+    """One dense parameter served by the legacy PS path (reference:
+    listen_and_serv_op.cc:109 RunSyncLoop — the server owns the master
+    copy AND the optimizer state, trainers send grads and recv params).
+
+    Sync mode: pushes for round ``version`` accumulate until all
+    ``n_trainers`` arrive, then the mean grad feeds the server-side
+    optimizer exactly once and ``version`` bumps; ``pull(min_version)``
+    blocks on that bump — the reference's per-step recv barrier.
+    Async mode (Hogwild): every push applies immediately.
+    """
+
+    _OPTS = ("sgd", "momentum", "adagrad", "adam")
+
+    def __init__(self, shape, optimizer: str = "sgd", attrs: Optional[dict] = None,
+                 n_trainers: int = 1, sync: bool = True):
+        if optimizer not in self._OPTS:
+            raise ValueError(
+                "dense PS optimizer %r not in %s" % (optimizer, self._OPTS))
+        self.shape = tuple(int(s) for s in shape)
+        self.value: Optional[np.ndarray] = None  # set by seed (trainer 0)
+        self.optimizer = optimizer
+        self.attrs = dict(attrs or {})
+        self.n_trainers = max(1, int(n_trainers))
+        self.sync = bool(sync)
+        self.version = 0
+        self._acc: Optional[np.ndarray] = None
+        self._acc_count = 0
+        self._state: Dict[str, np.ndarray] = {}
+        self._cv = threading.Condition()
+
+    def seed(self, value: np.ndarray) -> bool:
+        """First writer wins (trainer 0 broadcast init); returns whether
+        this call seeded."""
+        with self._cv:
+            if self.value is not None:
+                return False
+            v = np.asarray(value, np.float32).reshape(self.shape)
+            self.value = v.copy()
+            self._cv.notify_all()
+            return True
+
+    def _optimize(self, grad: np.ndarray, lr: float) -> None:
+        # numpy mirror of ops/optimizer_ops.py kernels — the server is
+        # host-side by design, so the update must not touch the chip
+        p, s = self.value, self._state
+        if self.optimizer == "sgd":
+            p -= lr * grad
+        elif self.optimizer == "momentum":
+            mu = float(self.attrs.get("mu", 0.9))
+            v = s.setdefault("velocity", np.zeros_like(p))
+            v *= mu
+            v += grad
+            if self.attrs.get("use_nesterov", False):
+                p -= (grad + mu * v) * lr
+            else:
+                p -= lr * v
+        elif self.optimizer == "adagrad":
+            eps = float(self.attrs.get("epsilon", 1e-6))
+            m = s.setdefault("moment", np.zeros_like(p))
+            m += grad * grad
+            p -= lr * grad / (np.sqrt(m) + eps)
+        elif self.optimizer == "adam":
+            b1 = float(self.attrs.get("beta1", 0.9))
+            b2 = float(self.attrs.get("beta2", 0.999))
+            eps = float(self.attrs.get("epsilon", 1e-8))
+            m = s.setdefault("m", np.zeros_like(p))
+            v = s.setdefault("v", np.zeros_like(p))
+            t = s.setdefault("t", np.zeros(()))
+            t += 1
+            m *= b1
+            m += (1 - b1) * grad
+            v *= b2
+            v += (1 - b2) * grad * grad
+            lr_t = lr * np.sqrt(1 - b2 ** float(t)) / (1 - b1 ** float(t))
+            p -= lr_t * m / (np.sqrt(v) + eps)
+
+    def push(self, grad: np.ndarray, lr: float, timeout: float = 60.0) -> int:
+        grad = np.asarray(grad, np.float32).reshape(self.shape)
+        with self._cv:
+            if self.value is None:
+                raise ValueError("dense param not seeded yet")
+            if not self.sync:
+                self._optimize(grad, lr)
+                self.version += 1
+                self._cv.notify_all()
+                return self.version
+            my_round = self.version
+            if self._acc is None:
+                self._acc = grad.copy()
+            else:
+                self._acc += grad
+            self._acc_count += 1
+            if self._acc_count == self.n_trainers:
+                self._optimize(self._acc / self.n_trainers, lr)
+                self._acc = None
+                self._acc_count = 0
+                self.version += 1
+                self._cv.notify_all()
+            return my_round + 1
+
+    def pull(self, min_version: int = 0, timeout: float = 60.0) -> np.ndarray:
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        with self._cv:
+            while self.value is None or self.version < min_version:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0 or not self._cv.wait(timeout=remaining):
+                    raise ValueError(
+                        "pull_dense timed out waiting for version %d (at %d)"
+                        % (min_version, self.version))
+            return self.value.copy()
+
+
 class ParameterServer:
     """Sparse-table server (reference: listen_and_serv_op.cc:109 sync loop
     + request_handler_impl.cc handlers)."""
@@ -203,6 +318,7 @@ class ParameterServer:
     def __init__(self, endpoint: str = "127.0.0.1:0"):
         host, port = endpoint.rsplit(":", 1)
         self._tables: Dict[str, _Table] = {}
+        self._dense: Dict[str, _DenseParam] = {}
         self._tables_lock = threading.Lock()
         self._barrier_count = 0
         self._barrier_lock = threading.Lock()
@@ -281,6 +397,30 @@ class ParameterServer:
             ids.sort()
             page = ids[start : start + int(limit)] if limit is not None else ids[start:]
             return {"ids": page, "total": int(len(ids))}
+        if op == "create_dense":
+            with self._tables_lock:
+                existing = self._dense.get(msg["name"])
+                if existing is not None:
+                    if existing.shape != tuple(msg["shape"]):
+                        raise ValueError(
+                            "dense param %r exists with shape %s != %s"
+                            % (msg["name"], existing.shape, msg["shape"]))
+                else:
+                    self._dense[msg["name"]] = _DenseParam(
+                        msg["shape"], optimizer=msg.get("optimizer", "sgd"),
+                        attrs=msg.get("attrs"), n_trainers=msg.get("n_trainers", 1),
+                        sync=msg.get("sync", True))
+            return {"ok": True}
+        if op == "seed_dense":
+            return {"seeded": self._dense[msg["name"]].seed(msg["value"])}
+        if op == "push_dense":
+            v = self._dense[msg["name"]].push(msg["grad"], float(msg.get("lr", 0.1)))
+            return {"version": v}
+        if op == "pull_dense":
+            d = self._dense[msg["name"]]
+            val = d.pull(int(msg.get("min_version", 0)),
+                         timeout=float(msg.get("timeout", 60.0)))
+            return {"value": val, "version": d.version}
         if op == "allreduce":
             # blocking sum-allreduce rendezvous: nranks callers post
             # tensors under one key; all get the sum (the TCP collective
@@ -404,6 +544,42 @@ class PSClient:
     def barrier(self):
         for i in range(len(self.endpoints)):
             self._call(i, {"op": "barrier"})
+
+    # ---- dense legacy PS (reference: send_op/recv_op around the step) ----
+    def shard_for(self, name: str) -> int:
+        """Dense params dispatch whole to one server by name hash (the
+        reference slices big vars into blocks; whole-param placement keeps
+        the optimizer update atomic per param)."""
+        import zlib
+
+        return zlib.crc32(name.encode()) % len(self.endpoints)
+
+    def create_dense(self, name: str, shape, optimizer: str = "sgd",
+                     attrs: Optional[dict] = None, n_trainers: int = 1,
+                     sync: bool = True):
+        self._call(self.shard_for(name), {
+            "op": "create_dense", "name": name, "shape": list(shape),
+            "optimizer": optimizer, "attrs": attrs or {},
+            "n_trainers": n_trainers, "sync": sync,
+        })
+
+    def seed_dense(self, name: str, value: np.ndarray) -> bool:
+        r = self._call(self.shard_for(name),
+                       {"op": "seed_dense", "name": name,
+                        "value": np.asarray(value, np.float32)})
+        return bool(r["seeded"])
+
+    def push_dense(self, name: str, grad: np.ndarray, lr: float) -> int:
+        r = self._call(self.shard_for(name),
+                       {"op": "push_dense", "name": name,
+                        "grad": np.asarray(grad, np.float32), "lr": float(lr)})
+        return int(r["version"])
+
+    def pull_dense(self, name: str, min_version: int = 0, timeout: float = 60.0):
+        r = self._call(self.shard_for(name),
+                       {"op": "pull_dense", "name": name,
+                        "min_version": int(min_version), "timeout": timeout})
+        return np.asarray(r["value"], np.float32)
 
     # stay well under _MAX_MSG per frame (header + payload slack)
     _SAVE_BYTES_PER_CHUNK = 256 << 20

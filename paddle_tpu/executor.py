@@ -103,12 +103,24 @@ class Executor:
         feed = dict(feed or {})
         fetch_names = [_as_fetch_name(f) for f in (fetch_list or [])]
 
+        if getattr(program, "_pserver_ctx", None):
+            return self._run_pserver(program)
+
         if getattr(program, "_pipeline_plan", None):
             if steps != 1:
                 raise ValueError("steps>1 is not supported for pipeline programs")
             return self._run_pipeline(
                 program, feed, fetch_names, scope, return_numpy
             )
+
+        dense_ps = getattr(program, "_dense_ps_ctx", None)
+        if dense_ps is not None:
+            if steps != 1:
+                raise ValueError(
+                    "steps>1 is incompatible with dense PS mode (the grad "
+                    "send / param recv is host-side per batch)"
+                )
+            self._dense_ps_init(dense_ps, scope)
 
         block = program.global_block()
         # distributed lookup tables: pull rows before the step, push the
@@ -135,6 +147,13 @@ class Executor:
             # from the caller's fetch list (appended, sliced off below)
             for _, _, gname in ps_push:
                 fetch_names.append(gname)
+        n_dense_fetch = 0
+        if dense_ps is not None:
+            # fetch each param's dense grad for the send (hidden like
+            # ps_push; sliced off before returning to the caller)
+            for desc in dense_ps["params"].values():
+                fetch_names.append(desc["grad"])
+                n_dense_fetch += 1
         if steps != 1 and (ps_push or steps < 1):
             raise ValueError(
                 "steps=%d: multi-step run() needs steps>=1 and is "
@@ -245,6 +264,25 @@ class Executor:
         fetches, new_state = entry(mut_state, ro_state, feed_arrays)
         for n, v in new_state.items():
             scope.set(n, v)
+        if n_dense_fetch:
+            # dense PS round (reference: send_barrier -> send grads ->
+            # recv params, distribute_transpiler.py:320): push EVERY grad
+            # before pulling ANY param — in sync mode the pull blocks on
+            # the server applying all trainers' grads, so interleaving
+            # would deadlock this trainer against itself
+            client = self._dense_ps_client(dense_ps)
+            names = list(dense_ps["params"])
+            grads = fetches[len(fetches) - n_dense_fetch:]
+            fetches = fetches[: len(fetches) - n_dense_fetch]
+            for name, grad in zip(names, grads):
+                lr_var = dense_ps["params"][name]["lr_var"]
+                lr_val = scope.get(lr_var)
+                lr = float(np.asarray(lr_val)) if lr_val is not None else 0.1
+                client.push_dense(name, np.asarray(grad), lr)
+            dense_ps["step"] += 1
+            min_v = dense_ps["step"] if dense_ps["sync"] else 0
+            for name in names:
+                scope.set(name, client.pull_dense(name, min_version=min_v))
         if ps_push:
             # async mode: enqueue on the Communicator (merge-before-send
             # background thread); sync mode: blocking push
@@ -274,6 +312,72 @@ class Executor:
         if return_numpy:
             fetches = [np.asarray(f) for f in fetches]
         return fetches
+
+    # ------------------------------------------------------------------
+    # Dense legacy PS (reference: distribute_transpiler.py trainer side +
+    # listen_and_serv_op.cc server loop)
+    # ------------------------------------------------------------------
+    def _dense_ps_client(self, ctx):
+        client = ctx.get("_client")
+        if client is None:
+            from paddle_tpu.distributed.ps import PSClient
+
+            client = ctx["_client"] = PSClient(ctx["endpoints"])
+        return client
+
+    def _dense_ps_init(self, ctx, scope):
+        """First-run handshake: create the server-side entries, trainer 0
+        seeds its initial param values (deterministic broadcast), everyone
+        pulls the seeded copy — the reference pserver startup + initial
+        recv (distribute_transpiler.py get_startup_program)."""
+        if ctx["initialized"]:
+            return
+        client = self._dense_ps_client(ctx)
+        for name, desc in ctx["params"].items():
+            val = scope.get(name)
+            if val is None:
+                raise RuntimeError(
+                    "dense PS param %r not in scope — run the startup "
+                    "program first" % name
+                )
+            client.create_dense(
+                name, np.shape(val), optimizer=desc["optimizer"],
+                attrs=desc["attrs"], n_trainers=ctx["n_trainers"],
+                sync=ctx["sync"],
+            )
+            if ctx["trainer_id"] == 0:
+                client.seed_dense(name, np.asarray(val))
+            scope.set(name, client.pull_dense(name, min_version=0))
+        ctx["initialized"] = True
+
+    def _run_pserver(self, program):
+        """Serve the dense params hashed to this endpoint and BLOCK, like
+        the reference's listen_and_serv op.  The live server object is
+        exposed as ``program._pserver`` so a host test/driver can stop it."""
+        from paddle_tpu.distributed.ps import ParameterServer, PSClient
+
+        ctx = program._pserver_ctx
+        server = ParameterServer(ctx["endpoint"])
+        # register this shard's dense params directly (no wire round-trip;
+        # shard placement must match the trainer-side PSClient.shard_for)
+        placer = PSClient(ctx["endpoints"])
+        my_idx = ctx["endpoints"].index(ctx["endpoint"])
+        from paddle_tpu.distributed.ps import _DenseParam
+
+        for name, desc in ctx["params"].items():
+            if placer.shard_for(name) != my_idx:
+                continue
+            server._dense[name] = _DenseParam(
+                desc["shape"], optimizer=desc["optimizer"], attrs=desc["attrs"],
+                n_trainers=ctx["n_trainers"], sync=ctx["sync"],
+            )
+        program._pserver = server
+        server.start()
+        try:
+            server._thread.join()
+        except KeyboardInterrupt:
+            server.stop()
+        return []
 
     # ------------------------------------------------------------------
     def _run_pipeline(self, program, feed, fetch_names, scope, return_numpy):

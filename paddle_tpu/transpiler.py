@@ -66,29 +66,104 @@ class DistributeTranspiler:
                 startup_program, program, trainer_id, endpoints, str(trainer_id),
             )
             return
-        # pserver mode: dense PS is legacy on TPU; grads still sync via the
-        # collective path, sparse tables go through distributed/ps.py
+        # pserver mode — the legacy dense PS (reference:
+        # distribute_transpiler.py:181): the trainer program loses its
+        # optimizer-update ops (it computes grads and send/recvs around
+        # the compiled step, executor.py _run_dense_ps), and the pserver
+        # program serves the params with server-side optimizer state
+        # (distributed/ps.py _DenseParam; listen_and_serv_op.cc:109).
         self.trainer_id = trainer_id
         self.pserver_endpoints = [e for e in pservers.split(",") if e]
         self.trainer_num = trainers
         self.sync_mode = sync_mode
         self.origin_program = program
+        self._analyze_optimize_ops()
+
+    # update-op types the dense PS can run server-side; everything the
+    # reference's listen_and_serv optimize blocks support on this build
+    _SERVER_OPTS = ("sgd", "momentum", "adagrad", "adam")
+
+    def _analyze_optimize_ops(self):
+        """Find (Param, Grad, LearningRate, optimizer) per parameter."""
+        self._param_updates = {}
+        block = self.origin_program.global_block()
+        for op in block.ops:
+            if "Param" in op.inputs and "Grad" in op.inputs and "ParamOut" in op.outputs:
+                if op.type not in self._SERVER_OPTS:
+                    raise NotImplementedError(
+                        "dense PS mode supports server-side %s; program uses "
+                        "%r — use collective (nccl2) mode or GeoSGD instead"
+                        % (list(self._SERVER_OPTS), op.type)
+                    )
+                self._param_updates[op.inputs["Param"][0]] = {
+                    "grad": op.inputs["Grad"][0],
+                    "lr_var": op.inputs["LearningRate"][0],
+                    "optimizer": op.type,
+                    "attrs": {k: v for k, v in op.attrs.items()
+                              if not k.startswith("__")},
+                }
+        if not self._param_updates:
+            raise ValueError(
+                "transpile(mode='pserver') found no optimizer update ops — "
+                "call minimize() before transpile (reference: "
+                "distribute_transpiler.py:272 _has_distributed_lookup_table)"
+            )
 
     def get_trainer_program(self, wait_port: bool = True):
-        return self.origin_program
+        """Trainer program: optimizer updates stripped; the executor
+        pushes grads / pulls params around each step (the send/recv+
+        barrier ops of distribute_transpiler.py:320 as host-side calls)."""
+        prog = self.origin_program.clone()
+        update_params = set(self._param_updates)
+        for blk in prog.blocks:
+            blk.ops = [
+                op for op in blk.ops
+                if not (op.type in self._SERVER_OPTS
+                        and op.inputs.get("Param", [None])[0] in update_params)
+            ]
+        prog._dense_ps_ctx = {
+            "endpoints": list(self.pserver_endpoints),
+            "trainer_id": int(self.trainer_id),
+            "n_trainers": int(self.trainer_num),
+            "sync": bool(self.sync_mode),
+            "params": dict(self._param_updates),
+            "step": 0,
+            "initialized": False,
+        }
+        return prog
 
     def get_pserver_program(self, endpoint: str):
-        # the TPU build serves sparse tables from distributed/ps.py; dense
-        # pserver programs are not generated (SURVEY.md §2.10 maps dense PS
-        # to sharded optimizer state over ICI instead)
+        """Pserver program: running it (Executor.run) starts the dense
+        server loop for the params hashed to ``endpoint`` and BLOCKS
+        serving, like the reference's listen_and_serv op."""
+        if endpoint not in self.pserver_endpoints:
+            raise ValueError("%r not in pserver list %s" % (endpoint, self.pserver_endpoints))
         prog = framework.Program()
+        block = self.origin_program.global_block()
+        prog._pserver_ctx = {
+            "endpoint": endpoint,
+            "endpoints": list(self.pserver_endpoints),
+            "n_trainers": int(self.trainer_num),
+            "sync": bool(self.sync_mode),
+            "params": {
+                name: {
+                    "shape": [int(s) for s in block.var(name).shape],
+                    "optimizer": desc["optimizer"],
+                    "attrs": desc["attrs"],
+                }
+                for name, desc in self._param_updates.items()
+            },
+        }
         return prog
 
     def get_pserver_programs(self, endpoint: str):
         prog = self.get_pserver_program(endpoint)
-        return prog, framework.Program()
+        return prog, self.get_startup_program(endpoint, prog)
 
     def get_startup_program(self, endpoint: str, pserver_program=None):
+        # dense params are seeded by trainer 0's initial values (the
+        # deterministic broadcast in executor.py _run_dense_ps), so the
+        # pserver startup is empty on this build
         return framework.Program()
 
 

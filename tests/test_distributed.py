@@ -654,3 +654,146 @@ def test_dygraph_data_parallel_two_processes(tmp_path):
             model.clear_gradients()
         w_sp = np.asarray(model.state_dict()[wkey_sp]).ravel()
     np.testing.assert_allclose(outs[0], w_sp, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Dense legacy parameter-server mode (reference: distribute_transpiler.py:181
+# trainer rewrite + listen_and_serv_op.cc:109 RunSyncLoop; test style:
+# test_dist_mnist.py loss parity)
+# ---------------------------------------------------------------------------
+def _dense_ps_model(opt_factory, seed=11):
+    # fresh name generator: every trainer/pserver process in a real
+    # deployment builds the program from scratch, so param names match
+    # across ranks; in-process we must reset the global counter
+    from paddle_tpu import unique_name
+
+    with unique_name.guard():
+        return _dense_ps_model_inner(opt_factory, seed)
+
+
+def _dense_ps_model_inner(opt_factory, seed):
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = seed
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [8])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        h = fluid.layers.fc(x, 16, act="relu")
+        logits = fluid.layers.fc(h, 4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y)
+        )
+        opt_factory().minimize(loss)
+    return prog, startup, loss
+
+
+def _run_dense_ps_parity(opt_factory, steps=6, rtol=2e-4):
+    import threading
+
+    from paddle_tpu.transpiler import DistributeTranspiler
+
+    rng = np.random.RandomState(0)
+    xb = rng.uniform(-1, 1, (16, 8)).astype("float32")
+    yb = rng.randint(0, 4, (16, 1)).astype("int64")
+
+    # ---- single-process baseline
+    prog, startup, loss = _dense_ps_model(opt_factory)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    base = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            (l,) = exe.run(prog, feed={"x": xb, "y": yb}, fetch_list=[loss])
+            base.append(float(np.asarray(l)))
+
+    # ---- 2-trainer sync dense PS on two localhost pservers
+    import socket as _socket
+
+    def _free_port():
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    eps = ["127.0.0.1:%d" % _free_port(), "127.0.0.1:%d" % _free_port()]
+    pservers = []
+    for ep in eps:
+        t = DistributeTranspiler()
+        p, s, _ = _dense_ps_model(opt_factory)
+        t.transpile(0, program=p, pservers=",".join(eps), trainers=2)
+        pprog = t.get_pserver_program(ep)
+        th = threading.Thread(
+            target=fluid.Executor(fluid.CPUPlace()).run, args=(pprog,),
+            daemon=True,
+        )
+        th.start()
+        pservers.append(pprog)
+
+    results = {}
+
+    # program building touches the process-global default-program guard /
+    # unique_name state, so build both trainers' programs up front and
+    # only RUN them concurrently
+    built = {}
+    for tid in (0, 1):
+        prog, startup, loss = _dense_ps_model(opt_factory)
+        t = DistributeTranspiler()
+        t.transpile(tid, program=prog, pservers=",".join(eps), trainers=2,
+                    sync_mode=True)
+        built[tid] = (t.get_trainer_program(), startup, loss)
+
+    def trainer(tid):
+        tprog, startup, loss = built[tid]
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        ls = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(steps):
+                (l,) = exe.run(tprog, feed={"x": xb, "y": yb}, fetch_list=[loss],
+                               scope=scope)
+                ls.append(float(np.asarray(l)))
+        results[tid] = ls
+
+    threads = [threading.Thread(target=trainer, args=(tid,)) for tid in (0, 1)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=180)
+    try:
+        assert set(results) == {0, 1}, "a trainer thread died: %s" % (results,)
+        # both trainers feed the SAME batch -> mean grad equals the
+        # baseline grad -> the server trajectory must match the local
+        # optimizer trajectory step for step
+        np.testing.assert_allclose(results[0], base, rtol=rtol)
+        np.testing.assert_allclose(results[1], base, rtol=rtol)
+    finally:
+        for pprog in pservers:
+            if hasattr(pprog, "_pserver"):
+                pprog._pserver.stop()
+
+
+def test_dense_ps_sgd_loss_parity():
+    _run_dense_ps_parity(lambda: fluid.optimizer.SGDOptimizer(0.2))
+
+
+def test_dense_ps_momentum_loss_parity():
+    _run_dense_ps_parity(
+        lambda: fluid.optimizer.MomentumOptimizer(0.1, momentum=0.9))
+
+
+@pytest.mark.slow
+def test_dense_ps_adam_loss_parity():
+    _run_dense_ps_parity(
+        lambda: fluid.optimizer.AdamOptimizer(0.01), rtol=5e-4)
+
+
+def test_dense_ps_unsupported_optimizer_raises():
+    from paddle_tpu.transpiler import DistributeTranspiler
+
+    prog, startup, _ = _dense_ps_model(
+        lambda: fluid.optimizer.AdadeltaOptimizer(0.1))
+    t = DistributeTranspiler()
+    with pytest.raises(NotImplementedError):
+        t.transpile(0, program=prog, pservers="127.0.0.1:6174", trainers=2)
