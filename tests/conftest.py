@@ -17,6 +17,15 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# the axon sitecustomize calls jax.config.update("jax_platforms",
+# "axon,cpu") at interpreter start, which BEATS the env var above and
+# silently turns every mesh test into a 1-real-TPU-device skip — restore
+# the virtual 8-device CPU platform via the same config channel (backend
+# init is lazy, so this is still early enough)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 # persistent compilation cache across test runs (first run pays, rest hit)
 os.environ.setdefault(
     "JAX_COMPILATION_CACHE_DIR",
