@@ -391,12 +391,6 @@ class Executor:
 
         plan = program._pipeline_plan
         loss_name = plan["loss_name"]
-        for f in fetch_names:
-            if f != loss_name:
-                raise ValueError(
-                    "pipeline programs can fetch only the loss %r (got %r)"
-                    % (loss_name, f)
-                )
         K = len(plan["cut_vars"]) + 1
         feed_sig = tuple(
             (n, tuple(np.shape(v)),
@@ -421,18 +415,22 @@ class Executor:
             self._cache[key] = entry
         step, state_names = entry
 
+        # fetches: the loss plus any state var (params and optimizer
+        # accumulators are the schedule's persistables)
+        for f in fetch_names:
+            if f != loss_name and f not in state_names:
+                raise ValueError(
+                    "pipeline programs can fetch the loss %r or a "
+                    "persistable state var %s (got %r)"
+                    % (loss_name, state_names, f)
+                )
         state = {}
         for n in state_names:
             v = scope.get(n)
             if v is None:
-                if n.endswith("@PP_VELOCITY"):
-                    base = scope.get(n[: -len("@PP_VELOCITY")])
-                    v = np.zeros(np.shape(base), np.asarray(base).dtype)
-                    scope.set(n, v)
-                else:
-                    raise RuntimeError(
-                        "param %r not initialized — run the startup program" % n
-                    )
+                raise RuntimeError(
+                    "var %r not initialized — run the startup program" % n
+                )
             state[n] = v
         feed_arrays = {
             n: v if isinstance(v, jax.Array) else np.asarray(v)
@@ -441,7 +439,7 @@ class Executor:
         loss, new_state = step(state, feed_arrays)
         for n, v in new_state.items():
             scope.set(n, v)
-        out = [loss for _ in fetch_names] or []
+        out = [loss if f == loss_name else new_state[f] for f in fetch_names]
         if return_numpy:
             out = [np.asarray(o) for o in out]
         return out

@@ -811,19 +811,12 @@ class PipelineOptimizer:
         prog = loss.block.program
         if self._cut_list:
             opt = self._optimizer
-            if type(opt) is SGDOptimizer:
-                kind, mu = "sgd", 0.0
-            elif type(opt) is MomentumOptimizer and not opt._use_nesterov:
-                kind, mu = "momentum", float(opt._momentum)
-            else:
-                raise NotImplementedError(
-                    "PipelineOptimizer supports plain SGD/Momentum on the "
-                    "fluid path; use parallel.hybrid for other optimizers"
-                )
-            if isinstance(opt._learning_rate, Variable):
-                raise NotImplementedError("pipeline needs a float learning rate")
             if opt.regularization is not None:
-                raise NotImplementedError("pipeline path does not apply regularization")
+                raise NotImplementedError(
+                    "pipeline path computes grads via AD through the "
+                    "schedule; program-level regularization ops would be "
+                    "skipped — fold decay into the optimizer or use hybrid"
+                )
             if parameter_list is not None or no_grad_set:
                 raise NotImplementedError("pipeline path updates all trainable params")
             for p in prog.all_parameters():
@@ -831,16 +824,43 @@ class PipelineOptimizer:
                     raise NotImplementedError(
                         "pipeline path ignores per-param LR multipliers (%s)" % p.name
                     )
+            # run the wrapped optimizer for real: its update ops land in
+            # the program (op_role=optimize) and its accumulators get
+            # startup initializers.  The compiled schedule skips the
+            # appended backward ops (AD through the scan replaces them,
+            # the reference's 2K-1 backward sections) and REPLAYS the
+            # update ops' registered kernels on the functional state —
+            # any optimizer in sections (reference: optimizer.py:2665).
+            ops, params_grads = opt.minimize(
+                loss, startup_program, parameter_list, no_grad_set
+            )
+            block = prog.global_block()
+            update_descs = []
+            for op in block.ops:
+                if (
+                    op.attrs.get("op_role") == "optimize"
+                    and "Param" in op.inputs
+                    and "Grad" in op.inputs
+                ):
+                    update_descs.append({
+                        "type": op.type,
+                        "inputs": {s: list(ns) for s, ns in op.inputs.items()},
+                        "outputs": {s: list(ns) for s, ns in op.outputs.items()},
+                        "attrs": {k: v for k, v in op.attrs.items()
+                                  if not k.startswith("__")},
+                    })
+            if not update_descs:
+                raise NotImplementedError(
+                    "PipelineOptimizer: wrapped optimizer %r appended no "
+                    "Param/Grad update ops" % type(opt).__name__
+                )
             prog._pipeline_plan = {
                 "cut_vars": [getattr(v, "name", v) for v in self._cut_list],
                 "num_microbatches": self._num_microbatches,
                 "loss_name": loss.name,
-                "opt_kind": kind,
-                "lr": float(opt._learning_rate),
-                "momentum": mu,
+                "update_descs": update_descs,
             }
-            # no backward/optimizer ops: the compiled schedule owns them
-            return [], [(p, None) for p in prog.all_parameters()]
+            return ops, params_grads
         ops, pgs = self._optimizer.minimize(loss, startup_program, parameter_list, no_grad_set)
         prog._pipeline_config = {
             "num_microbatches": self._num_microbatches,

@@ -94,9 +94,23 @@ def build_pipeline_step(program, loss_name: str, plan: Dict[str, Any], mesh):
 
         return fn
 
-    opt_kind = plan.get("opt_kind", "sgd")
-    lr = float(plan.get("lr", 0.01))
-    mu = float(plan.get("momentum", 0.0))
+    # the program's own optimizer-update ops, replayed functionally on
+    # the (state, grads) pair after AD — any registered optimizer works
+    # in sections (reference: optimizer.py:2665 + section_worker.cc)
+    update_descs = list(plan["update_descs"])
+    grad_of = {d["inputs"]["Param"][0]: d["inputs"]["Grad"][0] for d in update_descs}
+    aux_names = set()
+    for d in update_descs:
+        pname, gname = d["inputs"]["Param"][0], d["inputs"]["Grad"][0]
+        for slot, names in d["inputs"].items():
+            for nm in names:
+                if nm not in (pname, gname):
+                    aux_names.add(nm)
+        for slot, names in d["outputs"].items():
+            for nm in names:
+                if nm not in (pname, gname):
+                    aux_names.add(nm)
+    aux_names -= set(param_names)
 
     def step(state: Dict[str, Any], feed: Dict[str, Any]):
         # shapes from the actual batch
@@ -191,22 +205,36 @@ def build_pipeline_step(program, loss_name: str, plan: Dict[str, Any], mesh):
             return loss_sum / M
 
         def local_step(state, feeds_mb):
+            from paddle_tpu.core.registry import get_kernel
+
             params = {n: state[n] for n in param_names}
             loss_local, grads = jax.value_and_grad(run_local)(params, feeds_mb)
             loss = jax.lax.psum(loss_local, "pp")
             grads = {n: jax.lax.psum(g, "pp") for n, g in grads.items()}
             new_state = dict(state)
-            for n in param_names:
-                if n not in trainable:
+            for desc in update_descs:
+                pname = desc["inputs"]["Param"][0]
+                if pname not in trainable:
                     continue  # frozen params stay untouched (backward.py filter)
-                g = grads[n].astype(state[n].dtype)
-                if opt_kind == "momentum":
-                    v = state[n + "@PP_VELOCITY"]
-                    v = mu * v + g
-                    new_state[n + "@PP_VELOCITY"] = v
-                    new_state[n] = state[n] - lr * v
-                else:  # sgd
-                    new_state[n] = state[n] - lr * g
+                gname = desc["inputs"]["Grad"][0]
+                ins = {}
+                for slot, names in desc["inputs"].items():
+                    vals = []
+                    for nm in names:
+                        if nm == gname and slot == "Grad":
+                            vals.append(grads[pname].astype(state[pname].dtype))
+                        else:
+                            vals.append(new_state[nm])
+                    ins[slot] = vals
+                outs = get_kernel(desc["type"])(ins, desc["attrs"])
+                for slot, names in desc["outputs"].items():
+                    val = outs.get(slot)
+                    if val is None:
+                        continue
+                    vals = val if isinstance(val, (list, tuple)) else [val]
+                    for nm, v in zip(names, vals):
+                        if nm in new_state:
+                            new_state[nm] = v.astype(new_state[nm].dtype)
             return loss, new_state
 
         smapped = jax.shard_map(
@@ -218,7 +246,7 @@ def build_pipeline_step(program, loss_name: str, plan: Dict[str, Any], mesh):
         )
         return smapped(state, feeds_mb)
 
-    state_names = list(param_names)
-    if opt_kind == "momentum":
-        state_names += [n + "@PP_VELOCITY" for n in param_names]
+    # state = params + every optimizer aux var (moments, beta pows, lr) —
+    # all are startup-initialized program vars pulled from the scope
+    state_names = list(param_names) + sorted(aux_names)
     return step, state_names
