@@ -71,8 +71,40 @@ class Pruner:
                     type="elementwise_mul",
                     inputs={"X": [name], "Y": [mask_var.name]},
                     outputs={"Out": [name]},
-                    attrs={"op_role": "optimize"},
+                    attrs={"op_role": "optimize", "__prune_mask_for__": name},
                 )
             result[name] = 1.0 - float(mask.mean())
+        # record what was pruned: an op appended AFTER the mask op that
+        # writes a pruned param would silently resurrect zeroed weights
+        # (ADVICE r2) — _check_no_late_writers catches it at next use
+        pruned = getattr(program, "_pruned_params", None) or {}
+        pruned.update(result)
+        program._pruned_params = pruned
         program.version += 1
         return result
+
+
+def _check_no_late_writers(program) -> None:
+    """Raise if any op writes a pruned param after its mask re-apply op
+    (prune() must be the final mutation of a pruned param's writers)."""
+    pruned = getattr(program, "_pruned_params", None)
+    if not pruned:
+        return
+    for block in program.blocks:
+        mask_pos = {}
+        for i, op in enumerate(block.ops):
+            tgt = op.attrs.get("__prune_mask_for__")
+            if tgt is not None:
+                mask_pos[tgt] = i
+        for i, op in enumerate(block.ops):
+            if op.attrs.get("__prune_mask_for__") is not None:
+                continue
+            for name in op.output_arg_names:
+                if name in mask_pos and i > mask_pos[name]:
+                    raise RuntimeError(
+                        "op %r (index %d) writes pruned param %r after its "
+                        "prune-mask op (index %d) — the write would "
+                        "resurrect pruned weights; call prune() again "
+                        "after the last program mutation"
+                        % (op.type, i, name, mask_pos[name])
+                    )

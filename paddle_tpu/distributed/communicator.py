@@ -32,11 +32,14 @@ class Communicator:
     staleness: at most that many batches are merged into one send.
     """
 
-    def __init__(self, client: PSClient, max_merge: int = 20, capacity: int = 200):
+    def __init__(self, client: PSClient, max_merge: int = 20, capacity: int = 200,
+                 max_retries: int = 3):
         self._client = client
         self._queues: Dict[str, queue.Queue] = {}
         self._max_merge = max_merge
         self._capacity = capacity
+        self._max_retries = max(1, int(max_retries))
+        self._dropped = 0  # batches lost to a full queue after retries
         self._lock = threading.Lock()
         # serializes PS pushes between the send thread and flush() — the
         # client's sockets are not safe for interleaved frames
@@ -61,8 +64,9 @@ class Communicator:
 
     def push(self, table: str, ids: np.ndarray, grads: np.ndarray):
         if self._error is not None:
-            err, self._error = self._error, None
-            raise err
+            # surface but DON'T clear: a concurrent flush() must also see
+            # it; only flush() (the barrier) acknowledges and resets
+            raise self._error
         with self._lock:
             q = self._queues.setdefault(table, queue.Queue(self._capacity))
         try:
@@ -90,6 +94,12 @@ class Communicator:
     def pending(self) -> int:
         return sum(q.qsize() for q in self._queues.values())
 
+    @property
+    def dropped(self) -> int:
+        """Batches lost because the re-enqueue after a failed send found
+        the queue full — nonzero means grads were lost."""
+        return self._dropped
+
     # -- internals --
     def _drain(self, table: str, block: bool) -> bool:
         # pop AND push under the send lock: flush()'s empty-queue +
@@ -109,9 +119,26 @@ class Communicator:
                     break
             ids = np.concatenate([b[0] for b in batch])
             grads = np.concatenate([b[1].reshape(len(b[0]), -1) for b in batch])
-            # PSClient.push_sparse dedups+sums — the merge
-            self._client.push_sparse(table, ids, grads)
-        return True
+            # PSClient.push_sparse dedups+sums — the merge.  Transient PS
+            # errors get a bounded retry (reference: grpc_client.cc send
+            # deadline + retry); if the send still fails the merged batch
+            # re-enqueues so no grads are lost, and only when the queue
+            # itself is full do we count a drop.
+            import time as _time
+
+            last = None
+            for attempt in range(self._max_retries):
+                try:
+                    self._client.push_sparse(table, ids, grads)
+                    return True
+                except Exception as e:  # noqa: BLE001 — network layer
+                    last = e
+                    _time.sleep(0.2 * (2 ** attempt))
+            try:
+                q.put_nowait((ids, grads))
+            except queue.Full:
+                self._dropped += len(batch)
+            raise last
 
     def _send_loop(self):
         import time
@@ -124,7 +151,7 @@ class Communicator:
                 except Exception as e:
                     # surface on next push/flush but KEEP the thread
                     # alive — a transient PS error must not turn into a
-                    # silent dead queue (the failed batch is dropped)
+                    # silent dead queue (the batch re-enqueued in _drain)
                     self._error = e
                     time.sleep(0.5)
             if not any_sent and not self._queues:

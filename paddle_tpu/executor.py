@@ -123,6 +123,15 @@ class Executor:
             self._dense_ps_init(dense_ps, scope)
 
         block = program.global_block()
+        if getattr(program, "_pruned_params", None):
+            # a writer appended after prune() would resurrect pruned
+            # weights (ADVICE r2); re-validate when the op count moved
+            n_ops = sum(len(b.ops) for b in program.blocks)
+            if n_ops != getattr(program, "_pruned_checked_ops", None):
+                from paddle_tpu.contrib.slim.prune import _check_no_late_writers
+
+                _check_no_late_writers(program)
+                program._pruned_checked_ops = n_ops
         # distributed lookup tables: pull rows before the step, push the
         # sparse grads after (reference: parameter_prefetch.cc + the
         # trainer-side send of SelectedRows grads)

@@ -811,21 +811,39 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
 def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
              name=None, path_table=None, path_code=None, is_custom=False,
              is_sparse=False):
-    """Hierarchical sigmoid loss over the default complete binary tree."""
+    """Hierarchical sigmoid loss.  Default: complete binary tree over
+    ``num_classes`` leaves.  Custom (is_custom=True): ``path_table`` /
+    ``path_code`` [N, L] give each sample's leaf->root non-leaf indices
+    (-1 padded) and branch labels, and ``num_classes`` is the NON-LEAF
+    count (reference: layers/nn.py hsigmoid custom-tree contract)."""
+    if is_custom:
+        if path_table is None or path_code is None:
+            raise ValueError(
+                "hsigmoid(is_custom=True) requires path_table and path_code"
+            )
+    elif path_table is not None or path_code is not None:
+        raise ValueError(
+            "hsigmoid: path_table/path_code need is_custom=True "
+            "(silently ignoring them would train the wrong tree)"
+        )
     helper = LayerHelper("hierarchical_sigmoid", param_attr=param_attr,
                          bias_attr=bias_attr, name=name)
     dim = input.shape[-1]
-    w = helper.create_parameter(param_attr, shape=[num_classes - 1, dim], dtype=input.dtype)
-    b = helper.create_parameter(bias_attr, shape=[num_classes - 1], dtype=input.dtype, is_bias=True)
+    rows = num_classes if is_custom else num_classes - 1
+    w = helper.create_parameter(param_attr, shape=[rows, dim], dtype=input.dtype)
+    b = helper.create_parameter(bias_attr, shape=[rows], dtype=input.dtype, is_bias=True)
     out = helper.create_variable_for_type_inference(input.dtype)
     pre = helper.create_variable_for_type_inference(input.dtype)
     ins = {"X": [input], "Label": [label], "W": [w]}
     if b is not None:
         ins["Bias"] = [b]
+    if is_custom:
+        ins["PathTable"] = [path_table]
+        ins["PathCode"] = [path_code]
     helper.append_op(
         type="hierarchical_sigmoid", inputs=ins,
         outputs={"Out": [out], "PreOut": [pre]},
-        attrs={"num_classes": num_classes},
+        attrs={"num_classes": num_classes, "is_custom": is_custom},
     )
     return out
 
@@ -948,11 +966,17 @@ _PY_FUNC_REGISTRY = []
 _PY_FUNC_INDEX = {}
 
 
-def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None,
+            out_shape_fn=None):
     """Run a host-python ``func`` inside the compiled step via
     jax.pure_callback.  ``out`` must be pre-created var(s) with correct
     shape/dtype (reference contract).  backward_func is not supported —
-    mark inputs stop_gradient or use differentiable ops."""
+    mark inputs stop_gradient or use differentiable ops.
+
+    Dynamic out dims: a ``-1`` in position 0 resolves from the first
+    input's leading (batch) dim; any other dynamic dim needs
+    ``out_shape_fn(input_shapes) -> [shape, ...]``, called at trace time
+    with the actual input shapes."""
     if backward_func is not None:
         raise NotImplementedError("py_func backward_func: use differentiable ops")
     helper = LayerHelper("py_func")
@@ -961,10 +985,10 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     from paddle_tpu.core import types as core_types
 
     specs = [(tuple(int(s) for s in o.shape), core_types.np_dtype(o.dtype)) for o in outs]
-    dedupe_key = (func, tuple(specs))
+    dedupe_key = (func, tuple(specs), out_shape_fn)
     func_id = _PY_FUNC_INDEX.get(dedupe_key)
     if func_id is None:
-        _PY_FUNC_REGISTRY.append((func, specs))
+        _PY_FUNC_REGISTRY.append((func, specs, out_shape_fn))
         func_id = len(_PY_FUNC_REGISTRY) - 1
         _PY_FUNC_INDEX[dedupe_key] = func_id
     helper.append_op(

@@ -652,20 +652,44 @@ def nce(inputs, attrs):
 # Hierarchical sigmoid (reference: operators/hierarchical_sigmoid_op.cc)
 # over the default complete binary tree
 # ---------------------------------------------------------------------------
-@register_op("hierarchical_sigmoid", no_grad_set={"Label"})
+@register_op("hierarchical_sigmoid", no_grad_set={"Label", "PathTable", "PathCode"})
 def hierarchical_sigmoid(inputs, attrs):
-    """X [B, D], Label [B, 1], W [num_classes-1, D], Bias [num_classes-1]
-    optional.  Complete-binary-tree paths like the reference's default
-    (heap indexing: leaf code = label + num_classes; internal node id =
-    code//2 - 1 at each level)."""
+    """X [B, D], Label [B, 1], W [num_classes-1, D] (default tree) or
+    [non_leaf_num, D] (custom), Bias optional.
+
+    Default: complete-binary-tree paths like the reference (heap
+    indexing: leaf code = label + num_classes; internal node id =
+    code//2 - 1 at each level).  Custom (reference:
+    hierarchical_sigmoid_op.cc custom-tree path via MatrixBitCodeFunctor
+    CustomCode): PathTable [B, L] holds each sample's leaf->root
+    non-leaf row indices (-1 padding), PathCode [B, L] the 0/1 branch
+    labels; Label is unused for path construction."""
     jax = _jax()
     jnp = _jnp()
     from paddle_tpu.ops.common import maybe
 
     x = one(inputs, "X")
-    label = one(inputs, "Label").reshape(-1).astype(jnp.int32)
     w = one(inputs, "W")
     b = maybe(inputs, "Bias")
+    ptable = maybe(inputs, "PathTable")
+    pcode = maybe(inputs, "PathCode")
+
+    if ptable is not None:
+        if pcode is None:
+            raise ValueError("hierarchical_sigmoid: PathTable without PathCode")
+        valid = ptable >= 0  # [B, L]
+        node = jnp.maximum(ptable, 0).astype(jnp.int32)
+        bit = pcode.astype(jnp.float32)
+        logit = jnp.einsum("bd,bld->bl", x, w[node])
+        if b is not None:
+            logit = logit + b.reshape(-1)[node]
+        sign = 2.0 * bit - 1.0
+        total = jnp.sum(
+            jnp.where(valid, jax.nn.softplus(-sign * logit), 0.0), axis=1
+        )
+        return {"Out": total.reshape(-1, 1), "PreOut": total.reshape(-1, 1)}
+
+    label = one(inputs, "Label").reshape(-1).astype(jnp.int32)
     K = int(attrs["num_classes"])
     depth = max(1, int(np.ceil(np.log2(K))) + 1)
 
@@ -706,10 +730,15 @@ def _interp(inputs, attrs, method):
         if not scale:
             raise ValueError("interpolate needs out_h/out_w or scale")
         out_h, out_w = int(h * scale), int(w * scale)
-    if attrs.get("align_corners", True) and out_h > 1 and out_w > 1:
-        # fluid default: corners map to corners — src = dst*(in-1)/(out-1)
-        ys = jnp.arange(out_h, dtype=jnp.float32) * ((h - 1) / max(out_h - 1, 1))
-        xs = jnp.arange(out_w, dtype=jnp.float32) * ((w - 1) / max(out_w - 1, 1))
+    if attrs.get("align_corners", True):
+        # fluid default: corners map to corners — src = dst*(in-1)/(out-1).
+        # A degenerate axis (out==1) samples coordinate 0 (ratio 0, like
+        # the reference's ratio_h/w = 0 branch) — per-axis, NOT a
+        # whole-op fallback to half-pixel sampling (ADVICE r2).
+        ratio_h = (h - 1) / (out_h - 1) if out_h > 1 else 0.0
+        ratio_w = (w - 1) / (out_w - 1) if out_w > 1 else 0.0
+        ys = jnp.arange(out_h, dtype=jnp.float32) * ratio_h
+        xs = jnp.arange(out_w, dtype=jnp.float32) * ratio_w
         if method == "nearest":
             yi = jnp.round(ys).astype(int)
             xi = jnp.round(xs).astype(int)

@@ -567,20 +567,35 @@ def py_func(inputs, attrs):
 
     from paddle_tpu.layers import nn as nn_layers
 
-    fn, out_specs = nn_layers._PY_FUNC_REGISTRY[int(attrs["func_id"])]
+    fn, out_specs, out_shape_fn = nn_layers._PY_FUNC_REGISTRY[int(attrs["func_id"])]
     xs = inputs.get("X", [])
-    # resolve declared -1 dims from the first input's actual shape
-    # (batch-dim convention; py_func outs must otherwise be static)
-    ref_shape = tuple(xs[0].shape) if xs else ()
     result_shapes = []
-    for s, d in out_specs:
-        shape = tuple(
-            ref_shape[i] if dim < 0 and i < len(ref_shape) else dim
-            for i, dim in enumerate(s)
-        )
-        if any(dim < 0 for dim in shape):
-            raise ValueError("py_func output shape %r is not static" % (s,))
-        result_shapes.append(jax.ShapeDtypeStruct(shape, d))
+    if out_shape_fn is not None:
+        # explicit resolver: called with the actual input shapes
+        shapes = out_shape_fn([tuple(x.shape) for x in xs])
+        for (s, d), shape in zip(out_specs, shapes):
+            shape = tuple(int(v) for v in shape)
+            if any(dim < 0 for dim in shape):
+                raise ValueError(
+                    "py_func out_shape_fn returned non-static %r" % (shape,))
+            result_shapes.append(jax.ShapeDtypeStruct(shape, d))
+    else:
+        # a -1 resolves ONLY in position 0, from the first input's
+        # leading dim (the batch convention); any other dynamic position
+        # silently guessed wrong before — now it demands the resolver
+        batch = int(xs[0].shape[0]) if xs and len(xs[0].shape) else None
+        for s, d in out_specs:
+            shape = []
+            for i, dim in enumerate(s):
+                if dim >= 0:
+                    shape.append(dim)
+                elif i == 0 and batch is not None:
+                    shape.append(batch)
+                else:
+                    raise ValueError(
+                        "py_func output shape %r has a dynamic dim outside "
+                        "position 0 — pass out_shape_fn to py_func" % (s,))
+            result_shapes.append(jax.ShapeDtypeStruct(tuple(shape), d))
 
     def host_fn(*arrays):
         out = fn(*arrays)

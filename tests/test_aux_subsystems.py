@@ -276,3 +276,41 @@ def test_executor_multi_step_parity():
         np.testing.assert_allclose(
             np.asarray(scope_b.get(n)), want, rtol=1e-5, atol=1e-6, err_msg=n
         )
+
+
+def test_prune_late_writer_guard():
+    """An op that writes a pruned param after its mask op raises instead
+    of silently resurrecting pruned weights (ADVICE r2)."""
+    import pytest
+
+    from paddle_tpu import framework
+    from paddle_tpu.contrib.slim import prune as slim_prune
+
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 4
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [6])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1, name="pr_fc", bias_attr=False,
+                               param_attr=fluid.ParamAttr(name="pr_w"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(4, 6).astype("float32"),
+            "y": rng.randn(4, 1).astype("float32")}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        pruner = slim_prune.Pruner()
+        pruner.prune(prog, scope, ["pr_w"], [0.5])
+        exe.run(prog, feed=feed, fetch_list=[loss])  # fine
+        # sneak in a late writer of the pruned param
+        with framework.program_guard(prog, startup):
+            blk = prog.global_block()
+            blk.append_op(
+                type="scale", inputs={"X": ["pr_w"]},
+                outputs={"Out": ["pr_w"]}, attrs={"scale": 1.0},
+            )
+        with pytest.raises(RuntimeError, match="resurrect"):
+            exe.run(prog, feed=feed, fetch_list=[loss])

@@ -318,3 +318,134 @@ def test_sequence_erase_and_enumerate():
     # windows for row 1 (len 2): [2,6], [6,0(pad)] then zeros
     np.testing.assert_array_equal(np.asarray(wnd)[1, 0], [2, 6])
     np.testing.assert_array_equal(np.asarray(wnd)[1, 1], [6, 0])
+
+
+def test_hsigmoid_custom_tree():
+    """Custom-tree hsigmoid (reference: hierarchical_sigmoid_op.cc
+    custom path via PathTable/PathCode): a hand-built 3-leaf tree
+    trains, its loss matches a numpy softplus computation, and the old
+    silent-ignore hole is closed (path args without is_custom raise)."""
+    import pytest
+
+    # tree: root(0) -> {leaf0 | node(1) -> {leaf1 | leaf2}}
+    # paths (leaf->root order, -1 pad): leaf0: [0], code [0]
+    #   leaf1: [1, 0] code [0, 1]; leaf2: [1, 0] code [1, 1]
+    ptable = {0: [0, -1], 1: [1, 0], 2: [1, 0]}
+    pcode = {0: [0, 0], 1: [0, 1], 2: [1, 1]}
+    rng = np.random.RandomState(3)
+    B, D = 12, 6
+    xb = rng.randn(B, D).astype("float32")
+    yb = rng.randint(0, 3, B)
+    pt = np.array([ptable[c] for c in yb], "int64")
+    pc = np.array([pcode[c] for c in yb], "int64")
+
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 5
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [D])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        table = fluid.layers.data("pt", [2], dtype="int64")
+        code = fluid.layers.data("pc", [2], dtype="int64")
+        cost = fluid.layers.hsigmoid(
+            x, y, num_classes=2, path_table=table, path_code=code,
+            is_custom=True, bias_attr=False,
+            param_attr=fluid.ParamAttr(name="hs_w"),
+        )
+        loss = fluid.layers.mean(cost)
+        fluid.optimizer.SGDOptimizer(0.5).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        w0 = np.asarray(scope.get("hs_w")).copy()
+        losses = []
+        for _ in range(25):
+            (l,) = exe.run(
+                prog, feed={"x": xb, "y": yb.reshape(-1, 1).astype("int64"),
+                            "pt": pt, "pc": pc},
+                fetch_list=[loss],
+            )
+            losses.append(float(np.asarray(l)))
+    # first loss == numpy golden over the explicit path
+    def softplus(z):
+        return np.log1p(np.exp(-np.abs(z))) + np.maximum(z, 0)
+
+    expect = 0.0
+    for i in range(B):
+        for node, bit in zip(pt[i], pc[i]):
+            if node < 0:
+                continue
+            logit = xb[i] @ w0[node]
+            sign = 2.0 * bit - 1.0
+            expect += softplus(-sign * logit)
+    np.testing.assert_allclose(losses[0], expect / B, rtol=1e-5)
+    assert losses[-1] < losses[0] * 0.7, losses
+
+    # silent-ignore hole closed
+    with framework.program_guard(framework.Program(), framework.Program()):
+        x = fluid.layers.data("x", [D])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        t = fluid.layers.data("t", [2], dtype="int64")
+        with pytest.raises(ValueError):
+            fluid.layers.hsigmoid(x, y, num_classes=3, path_table=t)
+        with pytest.raises(ValueError):
+            fluid.layers.hsigmoid(x, y, num_classes=3, is_custom=True)
+
+
+def test_py_func_out_shape_fn():
+    """py_func dynamic out dims: position-0 -1 resolves from the batch;
+    any other dynamic dim demands an explicit out_shape_fn (the old
+    positional guess silently mismatched non-batch axes)."""
+    import pytest
+
+    # transpose output: [4, -1] with -1 in position 1 -> needs resolver
+    prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [4])
+        block = prog.global_block()
+        out_var = block.create_var(name="pyt_out", shape=[4, -1], dtype="float32")
+        out = fluid.layers.py_func(
+            lambda a: a.T.astype(np.float32), x, out_var,
+            out_shape_fn=lambda shapes: [(4, shapes[0][0])],
+        )
+    xb = np.arange(12, dtype="float32").reshape(3, 4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (o,) = exe.run(prog, feed={"x": xb}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(o), xb.T)
+
+    # without the resolver, a non-position-0 dynamic dim raises instead
+    # of guessing
+    prog2, startup2 = framework.Program(), framework.Program()
+    with framework.program_guard(prog2, startup2):
+        x = fluid.layers.data("x", [4])
+        block = prog2.global_block()
+        bad = block.create_var(name="pyb_out", shape=[4, -1], dtype="float32")
+        out2 = fluid.layers.py_func(lambda a: a.T.astype(np.float32), x, bad)
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe2.run(startup2)
+        with pytest.raises(Exception, match="out_shape_fn"):
+            exe2.run(prog2, feed={"x": xb}, fetch_list=[out2])
+
+
+def test_bilinear_interp_align_corners_degenerate_axis():
+    """align_corners=True with out==1 on one axis samples coordinate 0
+    on that axis and keeps align-corners sampling on the other (ADVICE
+    r2: the old code fell back to half-pixel for BOTH axes)."""
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+
+    class _T(OpTest):
+        op_type = "bilinear_interp"
+
+    t = _T("setUp")
+    t.setUp()
+    t.op_type = "bilinear_interp"
+    t.inputs = {"X": x}
+    t.attrs = {"out_h": 1, "out_w": 3, "align_corners": True}
+    # out_h=1 -> row 0; out_w=3 align-corners over w=4 -> cols 0, 1.5, 3
+    row = x[0, 0, 0]
+    expect = np.array([row[0], (row[1] + row[2]) / 2, row[3]], "float32")
+    t.outputs = {"Out": expect.reshape(1, 1, 1, 3)}
+    t.check_output()

@@ -797,3 +797,69 @@ def test_dense_ps_unsupported_optimizer_raises():
     t = DistributeTranspiler()
     with pytest.raises(NotImplementedError):
         t.transpile(0, program=prog, pservers="127.0.0.1:6174", trainers=2)
+
+
+def test_communicator_retries_and_requeues_failed_batch():
+    """A transient PS failure must not lose grads (ADVICE r2): the send
+    retries with backoff, re-enqueues the merged batch on exhaustion,
+    and the error stays visible until flush() acknowledges it."""
+    import time
+
+    from paddle_tpu.distributed.communicator import Communicator
+
+    class FlakyClient:
+        def __init__(self, fail_times):
+            self.fail_times = fail_times
+            self.calls = 0
+            self.pushed = []
+
+        def push_sparse(self, table, ids, grads):
+            self.calls += 1
+            if self.calls <= self.fail_times:
+                raise ConnectionError("transient PS blip %d" % self.calls)
+            self.pushed.append((table, np.asarray(ids).copy(),
+                                np.asarray(grads).copy()))
+
+    # 1) failure shorter than the retry budget: delivered, no error
+    c = FlakyClient(fail_times=2)
+    comm = Communicator(c, max_retries=3)
+    comm.start()
+    comm.push("t", np.array([1, 2]), np.ones((2, 4), np.float32))
+    comm.flush()
+    comm.stop()
+    assert len(c.pushed) == 1 and c.calls == 3
+    assert comm.dropped == 0
+
+    # 2) failure longer than the budget: batch re-enqueued (pending
+    #    again), error surfaced on push AND still visible to flush;
+    #    after the PS heals, flush delivers the SAME grads
+    c = FlakyClient(fail_times=3)
+    comm = Communicator(c, max_retries=3)
+    comm.start()
+    comm.push("t", np.array([5]), np.full((1, 4), 2.0, np.float32))
+    deadline = time.time() + 20
+    while comm._error is None and time.time() < deadline:
+        time.sleep(0.05)
+    assert comm._error is not None
+    try:
+        comm.push("t", np.array([6]), np.ones((1, 4), np.float32))
+        raised = False
+    except ConnectionError:
+        raised = True
+    assert raised
+    # error NOT cleared by the push raise — flush() still sees it...
+    assert comm._error is not None
+    # ...the PS has healed (fail_times exhausted), so flush delivers the
+    # re-enqueued batch, then raises the stored error exactly once (the
+    # acknowledge point) — after which the communicator is clean
+    try:
+        comm.flush()
+        flush_raised = False
+    except ConnectionError:
+        flush_raised = True
+    assert flush_raised
+    assert comm._error is None
+    comm.flush()  # second flush: clean
+    comm.stop()
+    assert comm.dropped == 0
+    assert any((ids == 5).all() for _, ids, _ in c.pushed), c.pushed
