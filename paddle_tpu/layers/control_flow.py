@@ -16,7 +16,8 @@ from paddle_tpu.framework import Variable
 from paddle_tpu.layer_helper import LayerHelper
 
 __all__ = ["While", "StaticRNN", "DynamicRNN", "IfElse", "Switch", "cond",
-           "increment", "create_array", "array_write", "array_read", "array_length"]
+           "increment", "create_array", "array_write", "array_read",
+           "array_length", "lod_rank_table", "reorder_lod_tensor_by_rank"]
 
 
 def increment(x, value=1.0, in_place=True):
@@ -701,3 +702,48 @@ class Switch:
         for cond, val in reversed(conds):
             out = ltensor.where(cond, val, out)
         return out
+
+
+def lod_rank_table(x, level=0, seq_len=None):
+    """Rank table sorted by sequence length descending (reference:
+    layers/control_flow.py lod_rank_table + lod_rank_table.cc).
+
+    On the padded encoding the table is built from the companion length
+    vector: for a ``data(lod_level>=1)`` var the ``<name>_seq_len``
+    (level 0) or ``<name>_inner_len`` (level 1) var is found
+    automatically; pass ``seq_len`` explicitly otherwise.  Returns the
+    index var (sorted original positions); its ``.lengths`` attribute
+    holds the sorted-lengths var."""
+    helper = LayerHelper("lod_rank_table")
+    if seq_len is None:
+        suffix = "_seq_len" if level == 0 else "_inner_len"
+        block = helper.main_program.current_block()
+        name = getattr(x, "name", str(x)) + suffix
+        seq_len = block._find_var_recursive(name)
+        if seq_len is None:
+            raise ValueError(
+                "lod_rank_table: no companion %r length var; pass seq_len" % name
+            )
+    index = helper.create_variable_for_type_inference("int32", stop_gradient=True)
+    lengths = helper.create_variable_for_type_inference("int32", stop_gradient=True)
+    helper.append_op(
+        type="lod_rank_table", inputs={"X": [seq_len]},
+        outputs={"Index": [index], "Length": [lengths]},
+        attrs={"level": int(level)},
+    )
+    index.lengths = lengths
+    return index
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """Gather x's batch rows into rank-table order (reference:
+    layers/control_flow.py reorder_lod_tensor_by_rank +
+    reorder_lod_tensor_by_rank_op.cc)."""
+    helper = LayerHelper("reorder_lod_tensor_by_rank")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="reorder_lod_tensor_by_rank",
+        inputs={"X": [x], "RankTable": [rank_table]},
+        outputs={"Out": [out]}, attrs={},
+    )
+    return out

@@ -35,4 +35,20 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True, stop
             stop_gradient=True,
             is_data=True,
         )
+    if lod_level > 1:
+        # nested (2-level) LoD: docs -> sentences -> words
+        # (reference: lod_tensor.h:110 multi-level offsets).  Padded
+        # encoding adds a per-outer-position inner length matrix
+        # [B, S1max]; rows past a doc's sentence count are zero.
+        block.create_var(
+            name=name + "_inner_len",
+            shape=[-1, -1],
+            dtype="int32",
+            stop_gradient=True,
+            is_data=True,
+        )
+    if lod_level > 2:
+        raise NotImplementedError(
+            "padded LoD shim supports lod_level<=2 (docs->sents->words)"
+        )
     return var

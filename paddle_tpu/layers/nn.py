@@ -78,6 +78,7 @@ __all__ = [
     "bilinear_tensor_product",
     "edit_distance",
     "ctc_greedy_decoder",
+    "nested_sequence_pool",
 ]
 
 
@@ -1226,3 +1227,28 @@ def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0):
                      attrs={"blank": int(blank), "merge_repeated": True,
                             "padding_num": int(padding_value)})
     return out, out_len
+
+
+def nested_sequence_pool(input, outer_len, inner_len, pool_type="sum",
+                         inner_pool_type=None):
+    """Two-level LoD pooling on the padded nested encoding (reference:
+    nested-sequence semantics of lod_tensor.h:110 — a doc is a sequence
+    of sentences, each a sequence of words).
+
+    input [B, S, W, D]; outer_len [B] docs' sentence counts; inner_len
+    [B, S] per-sentence word counts.  Pools words per sentence (level 1)
+    then sentences per doc (level 0); returns [B, D].  Implemented as
+    reshape to [B*S, W, D] + the standard sequence_pool twice — the
+    static-shape equivalent of the reference's per-level LoD walk."""
+    from paddle_tpu.layers import tensor as ltensor
+
+    inner_pool_type = inner_pool_type or pool_type
+    B, S = int(input.shape[0]), int(input.shape[1])
+    shape2 = [B * S if B > 0 else -1, int(input.shape[2])] + [
+        int(s) for s in input.shape[3:]
+    ]
+    flat = ltensor.reshape(input, shape=[-1] + shape2[1:])
+    flat_len = ltensor.reshape(inner_len, shape=[-1])
+    sent = sequence_pool(flat, inner_pool_type, seq_len=flat_len)  # [B*S, D]
+    docs = ltensor.reshape(sent, shape=[-1, S] + [int(s) for s in sent.shape[1:]])
+    return sequence_pool(docs, pool_type, seq_len=outer_len)

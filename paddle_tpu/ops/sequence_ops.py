@@ -386,3 +386,27 @@ def crf_decoding(inputs, attrs):
         lbl = label.squeeze(-1) if label.ndim == 3 else label
         path = (path == lbl.astype("int64")).astype("int64") * t_mask
     return {"ViterbiPath": path}
+
+
+@register_op("lod_rank_table", differentiable=False, no_grad_set={"X"})
+def lod_rank_table(inputs, attrs):
+    """Rank table over sequence lengths (reference: lod_rank_table.cc —
+    items sorted by sequence length DESCENDING, ties keeping original
+    order).  On the padded encoding the LoD level's lengths ARE the
+    input; returns the sorted original indices plus the sorted lengths —
+    the (index, length) pairs of the reference's table."""
+    jnp = _jnp()
+    lengths = one(inputs, "X").reshape(-1).astype("int32")
+    order = jnp.argsort(-lengths, stable=True).astype("int32")
+    return {"Index": order, "Length": lengths[order]}
+
+
+@register_op("reorder_lod_tensor_by_rank", no_grad_set={"RankTable"})
+def reorder_lod_tensor_by_rank(inputs, attrs):
+    """Gather batch rows into rank-table order (reference:
+    reorder_lod_tensor_by_rank_op.cc — the shrink-batch reordering that
+    makes ragged RNNs efficient).  Differentiable: the vjp of the gather
+    is the inverse scatter, so grads flow back in original order."""
+    x = one(inputs, "X")
+    idx = one(inputs, "RankTable").reshape(-1)
+    return {"Out": x[idx]}

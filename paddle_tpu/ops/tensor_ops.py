@@ -167,7 +167,33 @@ def _reshape(x, shape):
     return x.reshape(tuple(shape))
 
 
-@register_op("reshape2")
+def _reshape_infer(op, block):
+    """Compile-time shape for reshape: a -1 target dim stays -1 when the
+    input has dynamic dims (eval_shape would bake the dummy batch
+    stand-in into a STATIC wrong dim and poison downstream inference —
+    e.g. reshaping [B, S] lengths to [-1] next to a [B*S, W, D] tensor)."""
+    x = block.var(op.inputs["X"][0])
+    if x.shape is None:
+        return
+    xshape = list(x.shape)
+    tgt = [int(s) for s in op.attrs["shape"]]
+    out = [xshape[i] if s == 0 and i < len(xshape) else s for i, s in enumerate(tgt)]
+    if -1 in out and not any(s == -1 for s in xshape):
+        total = int(np.prod(xshape))
+        known = int(np.prod([s for s in out if s != -1])) or 1
+        out[out.index(-1)] = total // known
+    v = block._find_var_recursive(op.outputs["Out"][0])
+    if v is not None:
+        v.shape = tuple(out)
+        v.dtype = x.dtype
+    if "XShape" in op.outputs:
+        xs = block._find_var_recursive(op.outputs["XShape"][0])
+        if xs is not None:
+            xs.shape = (0,) + tuple(xshape)
+            xs.dtype = x.dtype
+
+
+@register_op("reshape2", infer_shape=_reshape_infer)
 def reshape2(inputs, attrs):
     jnp = _jnp()
     x = one(inputs, "X")
@@ -175,7 +201,7 @@ def reshape2(inputs, attrs):
     return {"Out": out, "XShape": jnp.zeros((0,) + x.shape, dtype=x.dtype)}
 
 
-@register_op("reshape")
+@register_op("reshape", infer_shape=_reshape_infer)
 def reshape(inputs, attrs):
     return {"Out": _reshape(one(inputs, "X"), attrs["shape"])}
 
