@@ -15,7 +15,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["SAController"]
+__all__ = ["SAController", "SearchSpace", "SANAS", "program_flops"]
 
 
 class SAController:
@@ -73,3 +73,135 @@ class SAController:
             self.max_reward = reward
             self.best_tokens = list(tokens)
         return bool(accept)
+
+
+class SearchSpace:
+    """Architecture search space (reference: contrib/slim/nas/
+    search_space.py — init_tokens / range_table / create_net contract).
+
+    ``create_net(tokens)`` must return
+    ``(startup_program, train_program, eval_program, train_fetches,
+    eval_fetches)`` where fetches are lists of Variables; the FIRST
+    train fetch is minimized-loss-like (logged) and the FIRST eval fetch
+    is the reward metric (higher is better).
+    """
+
+    def init_tokens(self) -> List[int]:
+        raise NotImplementedError("Abstract method.")
+
+    def range_table(self) -> List[int]:
+        raise NotImplementedError("Abstract method.")
+
+    def create_net(self, tokens: Sequence[int]):
+        raise NotImplementedError("Abstract method.")
+
+
+def program_flops(program) -> int:
+    """Rough FLOPs of a Program's matmul/conv ops (for NAS constraints —
+    reference: light_nas_strategy.py target_flops on GraphWrapper)."""
+    total = 0
+    for op in program.global_block().ops:
+        try:
+            if op.type in ("mul", "matmul"):
+                x = program.global_block().var(op.inputs["X"][0])
+                y = program.global_block().var(op.inputs["Y"][0])
+                if x.shape and y.shape:
+                    m = int(np.prod([abs(int(s)) for s in x.shape[:-1]]))
+                    k = abs(int(x.shape[-1]))
+                    n = abs(int(y.shape[-1]))
+                    total += 2 * m * k * n
+            elif op.type == "conv2d":
+                w = program.global_block().var(op.inputs["Filter"][0])
+                out = program.global_block().var(op.outputs["Output"][0])
+                if w.shape and out.shape:
+                    per_out = 2 * int(np.prod([int(s) for s in w.shape[1:]]))
+                    total += per_out * int(np.prod([abs(int(s)) for s in out.shape]))
+        except (KeyError, ValueError, TypeError):
+            continue
+    return total
+
+
+class SANAS:
+    """Simulated-annealing NAS driver (reference: contrib/slim/nas/ —
+    light_nas_strategy.py's controller loop + sa_nas in later releases):
+    actually BUILDS, TRAINS, and EVALUATES each candidate program the
+    controller proposes, then feeds the reward back.
+
+    Either drive it manually (``next_archs()`` ... ``reward(score)``) or
+    call ``search(train_feeds, eval_feeds, ...)`` for the full loop.
+    """
+
+    def __init__(self, search_space: SearchSpace, search_steps: int = 10,
+                 reduce_rate: float = 0.85, init_temperature: float = 1024.0,
+                 constraint=None, seed: int = 0):
+        self.space = search_space
+        self.steps = int(search_steps)
+        self._constraint = constraint
+        self.controller = SAController(
+            search_space.range_table(),
+            reduce_rate=reduce_rate,
+            init_temperature=init_temperature,
+            init_tokens=search_space.init_tokens(),
+            seed=seed,
+        )
+        self._pending: Optional[List[int]] = None
+        self.history: List[dict] = []
+
+    # -- manual protocol (reference: search_agent.py next_tokens/reward) --
+    def next_archs(self) -> List[int]:
+        self._pending = self.controller.next_tokens(constraint=self._constraint)
+        return list(self._pending)
+
+    def reward(self, score: float) -> bool:
+        if self._pending is None:
+            raise RuntimeError("reward() without next_archs()")
+        accepted = self.controller.update(self._pending, score)
+        self.history.append(
+            {"tokens": list(self._pending), "reward": float(score),
+             "accepted": bool(accepted)}
+        )
+        self._pending = None
+        return accepted
+
+    @property
+    def best_tokens(self) -> List[int]:
+        return list(self.controller.best_tokens)
+
+    @property
+    def max_reward(self) -> float:
+        return float(self.controller.max_reward)
+
+    # -- full search loop --
+    def search(self, train_feeds: Sequence[dict], eval_feeds: Sequence[dict],
+               train_epochs: int = 1, place=None) -> List[int]:
+        """For each controller proposal: build the candidate via
+        ``space.create_net(tokens)``, train it ``train_epochs`` passes
+        over ``train_feeds``, evaluate the first eval fetch averaged
+        over ``eval_feeds`` as the reward, update the controller.
+        Returns the best tokens found."""
+        from paddle_tpu import executor as executor_mod
+        from paddle_tpu.executor import Executor
+        from paddle_tpu.framework import CPUPlace
+        from paddle_tpu.scope import Scope, scope_guard
+
+        place = place or CPUPlace()
+        exe = Executor(place)
+        for _ in range(self.steps):
+            tokens = self.next_archs()
+            startup, train_prog, eval_prog, train_f, eval_f = (
+                self.space.create_net(tokens)
+            )
+            scope = Scope()
+            with scope_guard(scope):
+                exe.run(startup)
+                for _ in range(int(train_epochs)):
+                    for feed in train_feeds:
+                        exe.run(train_prog, feed=feed,
+                                fetch_list=list(train_f))
+                scores = []
+                for feed in eval_feeds:
+                    vals = exe.run(eval_prog, feed=feed,
+                                   fetch_list=list(eval_f))
+                    scores.append(float(np.asarray(vals[0])))
+            self.reward(float(np.mean(scores)))
+        return self.best_tokens
