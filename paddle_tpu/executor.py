@@ -518,6 +518,7 @@ class Executor:
         defaults and validates that the chosen device worker matches the
         program (Section needs a PipelineOptimizer-cut program,
         DownpourSGD needs distributed lookup tables)."""
+        n_prefetch = int(thread)
         if trainer_desc is not None:
             worker = trainer_desc._worker
             if worker.worker_kind == "Section" and not getattr(program, "_pipeline_plan", None):
@@ -528,11 +529,44 @@ class Executor:
                 raise ValueError(
                     "DownpourSGD worker needs embedding(is_distributed=True) tables"
                 )
+            # worker-specific runtime behavior: Hogwild flips a dense-PS
+            # program to async rounds, DownpourSGD installs the async
+            # Communicator, Section validates the microbatch plan
+            worker._prepare(program)
             fetch_list = fetch_list or trainer_desc._fetch_vars
             fetch_info = fetch_info or trainer_desc._fetch_info
             print_period = trainer_desc._print_period
+            n_prefetch = n_prefetch or int(getattr(trainer_desc, "thread_num", 0))
+        batches = iter(dataset)
+        if n_prefetch > 1:
+            # the reference's reader threads feeding device workers
+            # (trainer.h thread_num): a bounded background prefetcher
+            # overlaps host batch prep with the compiled step
+            import queue as _queue
+            import threading as _threading
+
+            q: "_queue.Queue" = _queue.Queue(maxsize=n_prefetch)
+            _END = object()
+
+            def _fill(it):
+                try:
+                    for item in it:
+                        q.put(item)
+                finally:
+                    q.put(_END)
+
+            _threading.Thread(target=_fill, args=(batches,), daemon=True).start()
+
+            def _drain():
+                while True:
+                    item = q.get()
+                    if item is _END:
+                        return
+                    yield item
+
+            batches = _drain()
         results = []
-        for i, feed in enumerate(dataset):
+        for i, feed in enumerate(batches):
             out = self.run(program, feed=feed, fetch_list=fetch_list, scope=scope)
             if fetch_list:
                 results.append(out)

@@ -41,20 +41,53 @@ class DeviceWorker:
     def _set_program(self, program):
         self._program = program
 
+    def _prepare(self, program):
+        """Hook run by train_from_dataset before the loop — subclasses
+        install their runtime behavior here."""
+
 
 class Hogwild(DeviceWorker):
     """Lock-free shared-scope SGD worker (hogwild_worker.cc) — on TPU
-    the compiled step is race-free by construction."""
+    the compiled step is race-free by construction; on a dense-PS
+    trainer program Hogwild means ASYNC updates, so it flips the
+    program's PS round to sync=False (each push applies immediately,
+    no cross-trainer barrier — the hogwild contract)."""
 
     worker_kind = "Hogwild"
 
+    def _prepare(self, program):
+        ctx = getattr(program, "_dense_ps_ctx", None)
+        if ctx is not None and ctx.get("sync"):
+            if ctx.get("initialized"):
+                raise ValueError(
+                    "Hogwild worker on an already-initialized SYNC dense-PS "
+                    "program — transpile with sync_mode=False instead"
+                )
+            ctx["sync"] = False
+
 
 class DownpourSGD(DeviceWorker):
-    """PS pull/push worker (downpour_worker.cc) — maps to the
-    distributed-lookup-table prefetch/push the executor already does for
-    programs with ``embedding(is_distributed=True)``."""
+    """PS pull/push worker (downpour_worker.cc) — drives the
+    distributed-lookup-table prefetch/push through the ASYNC
+    Communicator (merge-before-send background thread), installing one
+    on the program when none is bound (reference: downpour_worker.cc
+    push_sparse via the communicator)."""
 
     worker_kind = "DownpourSGD"
+
+    def __init__(self, max_merge: int = 20, capacity: int = 200):
+        super().__init__()
+        self.max_merge = int(max_merge)
+        self.capacity = int(capacity)
+
+    def _prepare(self, program):
+        client = getattr(program, "_ps_client", None)
+        if client is not None and getattr(program, "_ps_communicator", None) is None:
+            from paddle_tpu.distributed.communicator import Communicator
+
+            program._ps_communicator = Communicator(
+                client, max_merge=self.max_merge, capacity=self.capacity
+            ).start()
 
 
 class Section(DeviceWorker):
@@ -66,6 +99,17 @@ class Section(DeviceWorker):
     def __init__(self, num_microbatches: int = 1):
         super().__init__()
         self.num_microbatches = num_microbatches
+
+    def _prepare(self, program):
+        plan = getattr(program, "_pipeline_plan", None)
+        if plan is not None and self.num_microbatches > 1 and (
+            int(plan["num_microbatches"]) != int(self.num_microbatches)
+        ):
+            raise ValueError(
+                "Section worker num_microbatches=%d disagrees with the "
+                "program's PipelineOptimizer plan (%d)"
+                % (self.num_microbatches, plan["num_microbatches"])
+            )
 
 
 class TrainerDesc:
@@ -87,7 +131,10 @@ class TrainerDesc:
         self._print_period = print_period
 
     def set_thread(self, n: int):
-        self.thread_num = n  # informational: one compiled step serves all
+        # one compiled step serves all compute threads; n maps to the
+        # host-side batch-prefetch depth in train_from_dataset (the
+        # reference's reader threads feeding device workers)
+        self.thread_num = n
 
 
 class MultiTrainer(TrainerDesc):

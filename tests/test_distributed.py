@@ -863,3 +863,51 @@ def test_communicator_retries_and_requeues_failed_batch():
     comm.stop()
     assert comm.dropped == 0
     assert any((ids == 5).all() for _, ids, _ in c.pushed), c.pushed
+
+
+def test_hogwild_async_dense_ps_trains():
+    """Hogwild device worker + dense PS = async rounds (sync=False): a
+    trainer pushes/pulls without a cross-trainer barrier and still
+    learns (reference: hogwild_worker.cc over listen_and_serv async)."""
+    import socket as _socket
+    import threading
+
+    from paddle_tpu.trainer_desc import TrainerFactory
+    from paddle_tpu.transpiler import DistributeTranspiler
+
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    ep = "127.0.0.1:%d" % s.getsockname()[1]
+    s.close()
+
+    t = DistributeTranspiler()
+    p, st, _ = _dense_ps_model(lambda: fluid.optimizer.SGDOptimizer(0.2))
+    t.transpile(0, program=p, pservers=ep, trainers=1, sync_mode=False)
+    pprog = t.get_pserver_program(ep)
+    threading.Thread(target=fluid.Executor(fluid.CPUPlace()).run,
+                     args=(pprog,), daemon=True).start()
+
+    prog, startup, loss = _dense_ps_model(lambda: fluid.optimizer.SGDOptimizer(0.2))
+    t2 = DistributeTranspiler()
+    t2.transpile(0, program=prog, pservers=ep, trainers=1, sync_mode=True)
+    tprog = t2.get_trainer_program()
+    desc = TrainerFactory().create_trainer()  # Hogwild default
+    desc.set_fetch_var_and_info([loss], ["loss"], 100)
+
+    rng = np.random.RandomState(0)
+    xb = rng.uniform(-1, 1, (16, 8)).astype("float32")
+    yb = rng.randint(0, 4, (16, 1)).astype("int64")
+    feeds = [{"x": xb, "y": yb} for _ in range(12)]
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    try:
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            out = exe.train_from_dataset(program=tprog, dataset=feeds,
+                                         scope=scope, trainer_desc=desc)
+        assert tprog._dense_ps_ctx["sync"] is False  # Hogwild flipped it
+        losses = [float(np.asarray(o[0])) for o in out]
+        assert losses[-1] < losses[0] * 0.9, losses
+    finally:
+        if hasattr(pprog, "_pserver"):
+            pprog._pserver.stop()
