@@ -1,7 +1,8 @@
 """fluid-style layers namespace (reference: python/paddle/fluid/layers/)."""
-from paddle_tpu.layers import control_flow, detection, io, learning_rate_scheduler, nn, ops, rnn, tensor
+from paddle_tpu.layers import control_flow, detection, extended, io, learning_rate_scheduler, nn, ops, rnn, tensor
 from paddle_tpu.layers.control_flow import *  # noqa: F401,F403
 from paddle_tpu.layers.detection import *  # noqa: F401,F403
+from paddle_tpu.layers.extended import *  # noqa: F401,F403
 from paddle_tpu.layers.io import *  # noqa: F401,F403
 from paddle_tpu.layers.learning_rate_scheduler import *  # noqa: F401,F403
 from paddle_tpu.layers.nn import *  # noqa: F401,F403

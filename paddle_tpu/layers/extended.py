@@ -1,0 +1,841 @@
+"""Extended layer surface (reference: python/paddle/fluid/layers/nn.py
+tail — the long fluid 1.5 API; per-function reference pointers below).
+
+Wrappers over ops/extended_ops.py kernels plus compositions and
+subsumed-identity shims where the TPU-native design already delivers
+the semantics (SelectedRows helpers).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu import framework
+from paddle_tpu.layer_helper import LayerHelper
+
+__all__ = [
+    "selu", "lrn", "affine_channel", "conv3d", "conv3d_transpose", "pool3d",
+    "adaptive_pool2d", "adaptive_pool3d", "resize_trilinear", "multiplex",
+    "space_to_depth", "temporal_shift", "unfold", "cos_sim", "kldiv_loss",
+    "rank_loss", "margin_rank_loss", "bpr_loss", "center_loss",
+    "teacher_student_sigmoid_loss", "mean_iou", "dice_loss", "npair_loss",
+    "affine_grid", "grid_sampler", "add_position_encoding", "shard_index",
+    "hash", "sampling_id", "random_crop", "sequence_reshape",
+    "sequence_scatter", "sequence_concat", "sequence_pad", "sequence_unpad",
+    "sequence_slice", "unique_with_counts", "unique", "psroi_pool",
+    "gaussian_random", "gaussian_random_batch_size_like",
+    "uniform_random_batch_size_like", "sum", "rank", "size", "reduce_all",
+    "reduce_any", "elementwise_mod", "elementwise_floordiv", "logical_xor",
+    "image_resize_short", "autoincreased_step_counter",
+    "get_tensor_from_selected_rows", "merge_selected_rows", "lod_reset",
+    "lod_append", "beam_search", "beam_search_decode", "chunk_eval",
+    "sampled_softmax_with_cross_entropy", "continuous_value_model",
+    "filter_by_instag", "fsp_matrix", "deformable_conv", "dynamic_lstmp",
+    "lstm",
+]
+
+
+def _simple(op_type, ins, attrs=None, outs=("Out",), dtype=None, extra_vars=None):
+    helper = LayerHelper(op_type)
+    first = next(iter(ins.values()))[0]
+    out_vars = {}
+    for slot in outs:
+        d = dtype or getattr(first, "dtype", "float32")
+        if extra_vars and slot in extra_vars:
+            d = extra_vars[slot]
+        out_vars[slot] = helper.create_variable_for_type_inference(d)
+    helper.append_op(
+        type=op_type,
+        inputs={k: [v for v in vs] for k, vs in ins.items()},
+        outputs={k: [v] for k, v in out_vars.items()},
+        attrs=attrs or {},
+    )
+    return [out_vars[s] for s in outs]
+
+
+# -- activations / norms ---------------------------------------------------
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    """reference: layers/nn.py selu."""
+    return _simple("selu", {"X": [x]}, {"scale": scale, "alpha": alpha})[0]
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    """reference: layers/nn.py lrn (note fluid layer default k=1.0)."""
+    return _simple("lrn", {"X": [input]},
+                   {"n": n, "k": k, "alpha": alpha, "beta": beta},
+                   outs=("Out", "MidOut"))[0]
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None):
+    """reference: layers/nn.py affine_channel."""
+    return _simple("affine_channel", {"X": [x], "Scale": [scale], "Bias": [bias]},
+                   {"data_layout": data_layout})[0]
+
+
+# -- 3D conv/pool ----------------------------------------------------------
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None):
+    """reference: layers/nn.py conv3d — NCDHW."""
+    helper = LayerHelper("conv3d", param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    c = int(input.shape[1])
+    fs = filter_size if isinstance(filter_size, (list, tuple)) else [filter_size] * 3
+    w = helper.create_parameter(
+        param_attr, shape=[num_filters, c // groups] + list(fs), dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="conv3d", inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": stride, "paddings": padding, "dilations": dilation,
+               "groups": groups},
+    )
+    pre = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(pre)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    """reference: layers/nn.py conv3d_transpose."""
+    helper = LayerHelper("conv3d_transpose", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    c = int(input.shape[1])
+    fs = filter_size if isinstance(filter_size, (list, tuple)) else [filter_size] * 3
+    w = helper.create_parameter(
+        param_attr, shape=[c, num_filters // groups] + list(fs), dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="conv3d_transpose", inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": stride, "paddings": padding, "dilations": dilation,
+               "groups": groups},
+    )
+    pre = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(pre)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, exclusive=True, name=None):
+    """reference: layers/nn.py pool3d."""
+    return _simple(
+        "pool3d", {"X": [input]},
+        {"ksize": pool_size, "strides": pool_stride, "paddings": pool_padding,
+         "pooling_type": pool_type, "global_pooling": global_pooling,
+         "exclusive": exclusive},
+    )[0]
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    """reference: layers/nn.py adaptive_pool2d."""
+    if require_index:
+        raise NotImplementedError("adaptive_pool2d require_index")
+    return _simple("adaptive_pool2d", {"X": [input]},
+                   {"pool_size": pool_size, "pooling_type": pool_type})[0]
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    """reference: layers/nn.py adaptive_pool3d — via global/strided pool3d
+    when the input is divisible, else NotImplementedError (rare shapes)."""
+    d, h, w = (pool_size if isinstance(pool_size, (list, tuple))
+               else [pool_size] * 3)
+    D, H, W = (int(s) for s in input.shape[2:])
+    if (d, h, w) == (1, 1, 1):
+        return pool3d(input, pool_type=pool_type, global_pooling=True)
+    if D % d or H % h or W % w:
+        raise NotImplementedError(
+            "adaptive_pool3d needs divisible spatial dims on this build")
+    ks = [D // d, H // h, W // w]
+    return pool3d(input, pool_size=ks, pool_type=pool_type, pool_stride=ks)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     actual_shape=None, align_corners=True, align_mode=1):
+    """reference: layers/nn.py resize_trilinear."""
+    if out_shape is None:
+        out_shape = [int(s * scale) for s in input.shape[2:]]
+    return _simple(
+        "trilinear_interp", {"X": [input]},
+        {"out_d": int(out_shape[0]), "out_h": int(out_shape[1]),
+         "out_w": int(out_shape[2])},
+    )[0]
+
+
+# -- rearrangement ---------------------------------------------------------
+def multiplex(inputs, index):
+    """reference: layers/nn.py multiplex."""
+    helper = LayerHelper("multiplex")
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    helper.append_op(type="multiplex",
+                     inputs={"X": list(inputs), "Ids": [index]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def space_to_depth(x, blocksize, name=None):
+    """reference: layers/nn.py space_to_depth."""
+    return _simple("space_to_depth", {"X": [x]}, {"blocksize": blocksize})[0]
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    """reference: layers/nn.py temporal_shift."""
+    return _simple("temporal_shift", {"X": [x]},
+                   {"seg_num": seg_num, "shift_ratio": shift_ratio})[0]
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """reference: layers/nn.py unfold (im2col)."""
+    mk = lambda v: list(v) if isinstance(v, (list, tuple)) else [int(v)] * 2
+    helper = LayerHelper("unfold")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="unfold", inputs={"X": [x]}, outputs={"Y": [out]},
+        attrs={"kernel_sizes": mk(kernel_sizes), "strides": mk(strides),
+               "paddings": mk(paddings), "dilations": mk(dilations)},
+    )
+    return out
+
+
+# -- losses / metrics ------------------------------------------------------
+def cos_sim(X, Y):
+    """reference: layers/nn.py cos_sim."""
+    return _simple("cos_sim", {"X": [X], "Y": [Y]},
+                   outs=("Out", "XNorm", "YNorm"))[0]
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    """reference: layers/nn.py kldiv_loss."""
+    helper = LayerHelper("kldiv_loss")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="kldiv_loss",
+                     inputs={"X": [x], "Target": [target]},
+                     outputs={"Loss": [out]}, attrs={"reduction": reduction})
+    return out
+
+
+def rank_loss(label, left, right, name=None):
+    """reference: layers/nn.py rank_loss."""
+    return _simple("rank_loss",
+                   {"Label": [label], "Left": [left], "Right": [right]})[0]
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    """reference: layers/nn.py margin_rank_loss."""
+    return _simple("margin_rank_loss",
+                   {"Label": [label], "X1": [left], "X2": [right]},
+                   {"margin": margin}, outs=("Out", "Activated"))[0]
+
+
+def bpr_loss(input, label, name=None):
+    """reference: layers/nn.py bpr_loss."""
+    return _simple("bpr_loss", {"X": [input], "Label": [label]})[0]
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,
+                update_center=True):
+    """reference: layers/nn.py center_loss — creates the centers param;
+    the kernel's CentersOut writes back through the stateful output."""
+    from paddle_tpu import initializer
+    from paddle_tpu.layers import tensor as ltensor
+
+    helper = LayerHelper("center_loss", param_attr=param_attr)
+    dim = int(input.shape[-1])
+    centers = helper.create_parameter(
+        param_attr, shape=[num_classes, dim], dtype=input.dtype,
+        default_initializer=initializer.Constant(0.0))
+    centers.stop_gradient = True
+    rate = ltensor.fill_constant([1], input.dtype, float(alpha))
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    diff = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="center_loss",
+        inputs={"X": [input], "Label": [label], "Centers": [centers],
+                "CenterUpdateRate": [rate]},
+        outputs={"Loss": [loss], "SampleCenterDiff": [diff],
+                 "CentersOut": [centers]},
+        attrs={"need_update": bool(update_center)},
+    )
+    return loss
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """reference: layers/nn.py teacher_student_sigmoid_loss."""
+    helper = LayerHelper("teacher_student_sigmoid_loss")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="teacher_student_sigmoid_loss",
+                     inputs={"X": [input], "Label": [label]},
+                     outputs={"Y": [out]}, attrs={})
+    return out
+
+
+def mean_iou(input, label, num_classes):
+    """reference: layers/nn.py mean_iou — returns (miou, wrong, correct)."""
+    helper = LayerHelper("mean_iou")
+    miou = helper.create_variable_for_type_inference("float32")
+    wrong = helper.create_variable_for_type_inference("int32")
+    correct = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="mean_iou", inputs={"Predictions": [input], "Labels": [label]},
+        outputs={"OutMeanIou": [miou], "OutWrong": [wrong],
+                 "OutCorrect": [correct]},
+        attrs={"num_classes": int(num_classes)},
+    )
+    return miou, wrong, correct
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """reference: layers/nn.py dice_loss — composition over existing ops."""
+    from paddle_tpu.layers import tensor as ltensor
+
+    label = ltensor.cast(label, input.dtype)
+    inter = ltensor.reduce_sum(input * label)
+    union = ltensor.reduce_sum(input) + ltensor.reduce_sum(label)
+    return 1.0 - (2.0 * inter + epsilon) / (union + epsilon)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """reference: layers/nn.py npair_loss — cross-entropy over the
+    anchor@positive^T similarity matrix with equal-label soft targets,
+    plus L2 on the embeddings."""
+    from paddle_tpu.layers import nn, tensor as ltensor
+
+    sim = nn.matmul(anchor, positive, transpose_y=True)  # [B, B]
+    lab_col = ltensor.cast(ltensor.reshape(labels, shape=[-1, 1]), "float32")
+    helper = LayerHelper("npair_equal")
+    eqv = helper.create_variable_for_type_inference("bool")
+    helper.append_op(type="equal",
+                     inputs={"X": [lab_col],
+                             "Y": [ltensor.transpose(lab_col, [1, 0])]},
+                     outputs={"Out": [eqv]}, attrs={})
+    tgt = ltensor.cast(eqv, "float32")
+    tgt = tgt / ltensor.reduce_sum(tgt, dim=1, keep_dim=True)
+    xent = nn.softmax_with_cross_entropy(sim, tgt, soft_label=True)
+    l2 = ltensor.reduce_mean(
+        ltensor.reduce_sum(anchor * anchor, dim=1)
+        + ltensor.reduce_sum(positive * positive, dim=1)
+    )
+    return ltensor.reduce_mean(xent) + l2 * l2_reg
+
+
+# -- grid / positional -----------------------------------------------------
+def affine_grid(theta, out_shape, name=None):
+    """reference: layers/nn.py affine_grid."""
+    helper = LayerHelper("affine_grid")
+    out = helper.create_variable_for_type_inference(theta.dtype)
+    helper.append_op(
+        type="affine_grid", inputs={"Theta": [theta]},
+        outputs={"Output": [out]},
+        attrs={"output_shape": [int(s) for s in out_shape]},
+    )
+    return out
+
+
+def grid_sampler(x, grid, name=None):
+    """reference: layers/nn.py grid_sampler."""
+    helper = LayerHelper("grid_sampler")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="grid_sampler",
+                     inputs={"X": [x], "Grid": [grid]},
+                     outputs={"Output": [out]}, attrs={})
+    return out
+
+
+def add_position_encoding(input, alpha, beta, name=None):
+    """reference: layers/nn.py add_position_encoding."""
+    return _simple("add_position_encoding", {"X": [input]},
+                   {"alpha": float(alpha), "beta": float(beta)})[0]
+
+
+# -- id transforms ---------------------------------------------------------
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """reference: layers/nn.py shard_index."""
+    return _simple("shard_index", {"X": [input]},
+                   {"index_num": index_num, "nshards": nshards,
+                    "shard_id": shard_id, "ignore_value": ignore_value},
+                   dtype=input.dtype)[0]
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    """reference: layers/nn.py hash (bucketed id hashing; see the op's
+    docstring for the xxhash divergence note)."""
+    return _simple("hash", {"X": [input]},
+                   {"mod_by": int(hash_size), "num_hash": int(num_hash)},
+                   dtype="int64")[0]
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
+    """reference: layers/nn.py sampling_id."""
+    return _simple("sampling_id", {"X": [x]}, {"seed": int(seed)},
+                   dtype="int64")[0]
+
+
+def random_crop(x, shape, seed=None):
+    """reference: layers/nn.py random_crop."""
+    prog = framework.default_main_program()
+    return _simple("random_crop", {"X": [x]},
+                   {"shape": [int(s) for s in shape],
+                    "seed": int(seed) if seed is not None else prog.next_seed()},
+                   outs=("Out", "SeedOut"), dtype=x.dtype)[0]
+
+
+# -- sequence extensions ---------------------------------------------------
+def sequence_reshape(input, new_dim, seq_len=None):
+    """reference: layers/nn.py sequence_reshape."""
+    helper = LayerHelper("sequence_reshape")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input]}
+    outs = {"Out": [out]}
+    new_len = None
+    if seq_len is not None:
+        ins["SeqLen"] = [seq_len]
+        new_len = helper.create_variable_for_type_inference("int32")
+        outs["OutSeqLen"] = [new_len]
+    helper.append_op(type="sequence_reshape", inputs=ins, outputs=outs,
+                     attrs={"new_dim": int(new_dim)})
+    return (out, new_len) if seq_len is not None else out
+
+
+def sequence_scatter(input, index, updates, seq_len=None, name=None):
+    """reference: layers/nn.py sequence_scatter."""
+    helper = LayerHelper("sequence_scatter")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input], "Ids": [index], "Updates": [updates]}
+    if seq_len is not None:
+        ins["SeqLen"] = [seq_len]
+    helper.append_op(type="sequence_scatter", inputs=ins,
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def sequence_concat(input, name=None):
+    """reference: layers/sequence_concat — concat along time."""
+    helper = LayerHelper("sequence_concat")
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op(type="sequence_concat", inputs={"X": list(input)},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def sequence_pad(x, pad_value, maxlen=None, seq_len=None, name=None):
+    """reference: layers/nn.py sequence_pad — identity on the padded
+    encoding; returns (x, lengths)."""
+    helper = LayerHelper("sequence_pad")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    length = helper.create_variable_for_type_inference("int64")
+    ins = {"X": [x], "PadValue": [pad_value]}
+    if seq_len is not None:
+        ins["SeqLen"] = [seq_len]
+    helper.append_op(type="sequence_pad", inputs=ins,
+                     outputs={"Out": [out], "Length": [length]}, attrs={})
+    return out, length
+
+
+def sequence_unpad(x, length, name=None):
+    """reference: layers/nn.py sequence_unpad — identity view on the
+    padded encoding (lengths travel alongside)."""
+    helper = LayerHelper("sequence_unpad")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="sequence_unpad",
+                     inputs={"X": [x], "Length": [length]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    """reference: layers/nn.py sequence_slice."""
+    helper = LayerHelper("sequence_slice")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="sequence_slice",
+                     inputs={"X": [input], "Offset": [offset],
+                             "Length": [length]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def unique_with_counts(x, dtype="int32"):
+    """reference: layers/nn.py unique_with_counts — padded-static
+    variant: Out is len(x) long with UniqueCount real entries."""
+    helper = LayerHelper("unique_with_counts")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    index = helper.create_variable_for_type_inference(dtype)
+    count = helper.create_variable_for_type_inference(dtype)
+    ucount = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="unique_with_counts", inputs={"X": [x]},
+        outputs={"Out": [out], "Index": [index], "Count": [count],
+                 "UniqueCount": [ucount]},
+        attrs={},
+    )
+    return out, index, count
+
+
+def unique(x, dtype="int32"):
+    """reference: layers/nn.py unique."""
+    out, index, _ = unique_with_counts(x, dtype)
+    return out, index
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, name=None):
+    """reference: layers/nn.py psroi_pool."""
+    helper = LayerHelper("psroi_pool")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="psroi_pool", inputs={"X": [input], "ROIs": [rois]},
+        outputs={"Out": [out]},
+        attrs={"output_channels": int(output_channels),
+               "spatial_scale": float(spatial_scale),
+               "pooled_height": int(pooled_height),
+               "pooled_width": int(pooled_width)},
+    )
+    return out
+
+
+# -- random / misc wrappers over existing kernels --------------------------
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
+    """reference: layers/ops.py gaussian_random."""
+    prog = framework.default_main_program()
+    return _simple(
+        "gaussian_random", {"ShapeLike": []},
+        {"shape": [int(s) for s in shape], "mean": float(mean),
+         "std": float(std), "seed": int(seed) or prog.next_seed(),
+         "dtype": dtype},
+        dtype=dtype)[0]
+
+
+def gaussian_random_batch_size_like(input, shape, mean=0.0, std=1.0,
+                                    input_dim_idx=0, output_dim_idx=0,
+                                    seed=0, dtype="float32"):
+    """reference: layers/nn.py gaussian_random_batch_size_like — batch
+    dim copied from input at run time via ShapeLike."""
+    prog = framework.default_main_program()
+    return _simple(
+        "gaussian_random", {"ShapeLike": [input]},
+        {"shape": [int(s) for s in shape], "mean": float(mean),
+         "std": float(std), "seed": int(seed) or prog.next_seed(),
+         "dtype": dtype, "input_dim_idx": int(input_dim_idx),
+         "output_dim_idx": int(output_dim_idx)},
+        dtype=dtype)[0]
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    """reference: layers/nn.py uniform_random_batch_size_like."""
+    prog = framework.default_main_program()
+    return _simple(
+        "uniform_random", {"ShapeLike": [input]},
+        {"shape": [int(s) for s in shape], "min": float(min),
+         "max": float(max), "seed": int(seed) or prog.next_seed(),
+         "dtype": dtype, "input_dim_idx": int(input_dim_idx),
+         "output_dim_idx": int(output_dim_idx)},
+        dtype=dtype)[0]
+
+
+def sum(x):
+    """reference: layers/tensor.py sum (elementwise accumulate)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    return _simple("sum", {"X": list(xs)})[0]
+
+
+def rank(input):
+    """reference: layers/nn.py rank — static ndim as a constant."""
+    from paddle_tpu.layers import tensor as ltensor
+
+    return ltensor.fill_constant([1], "int32", len(input.shape))
+
+
+def size(input):
+    """reference: layers/nn.py size — element count (static dims only)."""
+    from paddle_tpu.layers import tensor as ltensor
+
+    n = 1
+    for s in input.shape:
+        n *= int(s)
+    if n < 0:
+        raise ValueError("size() needs a fully static shape, got %s"
+                         % (input.shape,))
+    return ltensor.fill_constant([1], "int64", n)
+
+
+def reduce_all(input, dim=None, keep_dim=False, name=None):
+    """reference: layers/nn.py reduce_all."""
+    return _simple("reduce_all", {"X": [input]},
+                   {"dim": dim if dim is None or isinstance(dim, list) else [dim],
+                    "keep_dim": keep_dim, "reduce_all": dim is None},
+                   dtype="bool")[0]
+
+
+def reduce_any(input, dim=None, keep_dim=False, name=None):
+    """reference: layers/nn.py reduce_any."""
+    return _simple("reduce_any", {"X": [input]},
+                   {"dim": dim if dim is None or isinstance(dim, list) else [dim],
+                    "keep_dim": keep_dim, "reduce_all": dim is None},
+                   dtype="bool")[0]
+
+
+def elementwise_mod(x, y, axis=-1, act=None, name=None):
+    """reference: layers/nn.py elementwise_mod."""
+    return _simple("elementwise_mod", {"X": [x], "Y": [y]}, {"axis": axis})[0]
+
+
+def elementwise_floordiv(x, y, axis=-1, act=None, name=None):
+    """reference: layers/nn.py elementwise_floordiv."""
+    return _simple("elementwise_floordiv", {"X": [x], "Y": [y]}, {"axis": axis})[0]
+
+
+def logical_xor(x, y, out=None, name=None):
+    """reference: layers/nn.py logical_xor."""
+    return _simple("logical_xor", {"X": [x], "Y": [y]}, dtype="bool")[0]
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """reference: layers/nn.py image_resize_short — resize so the short
+    side hits out_short_len."""
+    from paddle_tpu.layers import nn
+
+    h, w = int(input.shape[2]), int(input.shape[3])
+    short = min(h, w)
+    oh = int(round(h * out_short_len / short))
+    ow = int(round(w * out_short_len / short))
+    return nn.image_resize(input, out_shape=[oh, ow], resample=resample)
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """reference: layers/nn.py autoincreased_step_counter — persistable
+    int64 counter bumped by ``step`` each execution."""
+    from paddle_tpu import initializer
+    from paddle_tpu.layer_helper import LayerHelper
+
+    helper = LayerHelper("global_step_counter")
+    block = helper.main_program.global_block()
+    name = counter_name or "@STEP_COUNTER@"
+    counter = block.vars.get(name)
+    if counter is None:
+        counter = block.create_var(name=name, shape=[1], dtype="int64",
+                                   persistable=True, stop_gradient=True)
+        helper.set_variable_initializer(
+            counter, initializer.Constant(float(begin - step)))
+    helper.append_op(
+        type="scale", inputs={"X": [counter]}, outputs={"Out": [counter]},
+        attrs={"scale": 1.0, "bias": float(step)},
+    )
+    return counter
+
+
+# -- subsumed SelectedRows helpers ----------------------------------------
+def get_tensor_from_selected_rows(x, name=None):
+    """reference: layers/nn.py get_tensor_from_selected_rows.  On this
+    build sparse row-grads are subsumed by the PS push path (PARITY #14)
+    — dense vars pass through unchanged."""
+    return x
+
+
+def merge_selected_rows(x, name=None):
+    """reference: layers/nn.py merge_selected_rows — duplicate-row
+    merging happens inside PSClient.push_sparse on this build; identity
+    for dense vars."""
+    return x
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """reference: layers/nn.py lod_reset.  Padded-shim: lengths travel
+    as a companion var, so this RETURNS the new lengths var to pass to
+    downstream sequence ops (x itself is unchanged)."""
+    from paddle_tpu.layers import tensor as ltensor
+
+    if y is not None:
+        return x, y
+    if target_lod is None:
+        raise ValueError("lod_reset needs y or target_lod")
+    lengths = [int(b) - int(a) for a, b in zip(target_lod, target_lod[1:])] \
+        if len(target_lod) and target_lod[0] == 0 else [int(t) for t in target_lod]
+    return x, ltensor.assign(np.asarray(lengths, "int32"))
+
+
+def lod_append(x, level):
+    """reference: layers/nn.py lod_append — nested-LoD shim: returns the
+    inner-length var for a new nested level."""
+    from paddle_tpu.layers import tensor as ltensor
+
+    return x, ltensor.assign(np.asarray(level, "int32"))
+
+
+# -- decode / eval wrappers ------------------------------------------------
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None, return_parent_idx=False):
+    """reference: layers/nn.py beam_search.  The per-step expand/prune
+    op only makes sense inside the reference's While-op decode loop; the
+    TPU-native decode is the whole-search lax.scan in decoding.beam_search
+    (same beams, one compiled module) — use that instead."""
+    raise NotImplementedError(
+        "per-step beam_search: use paddle_tpu.decoding.beam_search (the "
+        "compiled whole-search TPU path, tests/test_seq2seq_decode.py)"
+    )
+
+
+def beam_search_decode(ids, scores, beam_size, end_id, name=None):
+    """reference: layers/nn.py beam_search_decode (see beam_search)."""
+    raise NotImplementedError(
+        "beam_search_decode: paddle_tpu.decoding.beam_search returns the "
+        "decoded ids/scores directly"
+    )
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """reference: layers/nn.py chunk_eval — host-side streaming metric
+    (metrics.ChunkEvaluator) fed via py_func is the supported path on
+    this build; the op surface raises to avoid silently wrong counts."""
+    raise NotImplementedError(
+        "chunk_eval: use paddle_tpu.metrics.ChunkEvaluator on fetched "
+        "predictions (host-side streaming metric)"
+    )
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1, remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    """reference: layers/nn.py sampled_softmax_with_cross_entropy — the
+    NCE/sampled family; the nce op covers the sampled-loss use case on
+    this build, full sampled-softmax raises for honesty."""
+    raise NotImplementedError(
+        "sampled_softmax_with_cross_entropy: use layers.nce (sampled "
+        "loss) or full softmax_with_cross_entropy"
+    )
+
+
+# -- CTR / distillation / deformable / LSTM family -------------------------
+def continuous_value_model(input, cvm, use_cvm=True):
+    """reference: layers/nn.py continuous_value_model (cvm_op.h)."""
+    helper = LayerHelper("cvm")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="cvm", inputs={"X": [input], "CVM": [cvm]},
+                     outputs={"Y": [out]}, attrs={"use_cvm": bool(use_cvm)})
+    return out
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True):
+    """reference: layers/nn.py filter_by_instag — static-shape packed
+    variant (see the op docstring): returns (out, loss_weight)."""
+    helper = LayerHelper("filter_by_instag")
+    out = helper.create_variable_for_type_inference(ins.dtype)
+    loss_weight = helper.create_variable_for_type_inference(ins.dtype)
+    index_map = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="filter_by_instag",
+        inputs={"Ins": [ins], "Ins_tag": [ins_tag], "Filter_tag": [filter_tag]},
+        outputs={"Out": [out], "LossWeight": [loss_weight],
+                 "IndexMap": [index_map]},
+        attrs={"is_lod": bool(is_lod)},
+    )
+    return out, loss_weight
+
+
+def fsp_matrix(x, y):
+    """reference: layers/nn.py fsp_matrix (fsp_op.cc)."""
+    helper = LayerHelper("fsp")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="fsp", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size, stride=1,
+                    padding=0, dilation=1, groups=None, deformable_groups=None,
+                    im2col_step=None, param_attr=None, bias_attr=None,
+                    modulated=True, name=None):
+    """reference: layers/nn.py deformable_conv (v2 modulated / v1 when
+    mask is None)."""
+    helper = LayerHelper("deformable_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    c = int(input.shape[1])
+    fs = filter_size if isinstance(filter_size, (list, tuple)) else [filter_size] * 2
+    w = helper.create_parameter(
+        param_attr, shape=[num_filters, c // (groups or 1)] + list(fs),
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mk = lambda v: list(v) if isinstance(v, (list, tuple)) else [int(v)] * 2
+    ins = {"Input": [input], "Offset": [offset], "Filter": [w]}
+    if modulated and mask is not None:
+        ins["Mask"] = [mask]
+    helper.append_op(
+        type="deformable_conv", inputs=ins, outputs={"Output": [out]},
+        attrs={"strides": mk(stride), "paddings": mk(padding),
+               "dilations": mk(dilation), "groups": groups or 1,
+               "deformable_groups": deformable_groups or 1},
+    )
+    return helper.append_bias_op(out, dim_start=1, dim_end=2)
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None, seq_len=None):
+    """reference: layers/nn.py dynamic_lstmp — LSTM with recurrent
+    projection; input must be pre-projected to [B, T, 4*hidden]
+    (size = 4*hidden)."""
+    helper = LayerHelper("dynamic_lstmp", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    hidden = size // 4
+    w = helper.create_parameter(param_attr, shape=[proj_size, size], dtype=dtype)
+    w_proj = helper.create_parameter(param_attr, shape=[hidden, proj_size],
+                                     dtype=dtype)
+    bias_w = 7 * hidden if use_peepholes else 4 * hidden
+    b = helper.create_parameter(bias_attr, shape=[1, bias_w], dtype=dtype,
+                                is_bias=True)
+    proj = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    ins = {"Input": [input], "Weight": [w], "ProjWeight": [w_proj], "Bias": [b]}
+    if seq_len is not None:
+        ins["SeqLen"] = [seq_len]
+    helper.append_op(
+        type="dynamic_lstmp", inputs=ins,
+        outputs={"Projection": [proj], "Cell": [cell]},
+        attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation,
+               "proj_activation": proj_activation},
+    )
+    return proj, cell
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """reference: layers/nn.py lstm (the cudnn multi-layer LSTM) — built
+    as stacked fc->dynamic_lstm layers (+ reversed pass concat when
+    bidirectional); XLA fuses the stack into one module, which is the
+    cudnn-speed path on TPU."""
+    from paddle_tpu.layers import nn, rnn as lrnn, tensor as ltensor
+
+    h = input
+    last_h_list, last_c_list = [], []
+    for _ in range(num_layers):
+        proj = nn.fc(h, hidden_size * 4, num_flatten_dims=2, bias_attr=False)
+        fwd, fwd_c = lrnn.dynamic_lstm(proj, hidden_size * 4, use_peepholes=False)
+        if is_bidirec:
+            projb = nn.fc(h, hidden_size * 4, num_flatten_dims=2, bias_attr=False)
+            bwd, bwd_c = lrnn.dynamic_lstm(projb, hidden_size * 4,
+                                           use_peepholes=False, is_reverse=True)
+            h = ltensor.concat([fwd, bwd], axis=2)
+            last_c_list += [nn.sequence_last_step(fwd_c),
+                            nn.sequence_last_step(bwd_c)]
+        else:
+            h = fwd
+            last_c_list.append(nn.sequence_last_step(fwd_c))
+        if dropout_prob and not is_test:
+            h = nn.dropout(h, dropout_prob)
+        last_h_list.append(nn.sequence_last_step(h))
+    last_hidden = ltensor.stack(last_h_list, axis=0)
+    last_cell = ltensor.stack(last_c_list, axis=0)
+    return h, last_hidden, last_cell

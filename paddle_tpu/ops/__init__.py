@@ -9,3 +9,4 @@ from paddle_tpu.ops import collective_ops  # noqa: F401
 from paddle_tpu.ops import control_flow_ops  # noqa: F401
 from paddle_tpu.ops import rnn_ops  # noqa: F401
 from paddle_tpu.ops import detection_ops  # noqa: F401
+from paddle_tpu.ops import extended_ops  # noqa: F401,E402

@@ -1,0 +1,774 @@
+"""Extended op batch: 3D conv/pool, vision rearrangement, ranking/CTR
+losses, grid sampling, hashing/sharding, and padded-shim sequence ops.
+
+Reference kernels (paddle/fluid/operators/): selu_op.cc, lrn_op.cc,
+conv_op.cc (3D), pool_op.cc (3D + adaptive), multiplex_op.cc,
+cos_sim_op.cc, kldiv_loss_op.cc, rank_loss_op.cc, margin_rank_loss_op.cc,
+bpr_loss_op.cc, center_loss_op.cc, teacher_student_sigmoid_loss_op.cc,
+mean_iou_op.cc, space_to_depth_op.cc, temporal_shift_op.cc, unfold_op.cc,
+affine_channel_op.cc, affine_grid_op.cc, grid_sampler_op.cc,
+add_position_encoding_op.cc, shard_index_op.cc, hash_op.cc,
+sampling_id_op.cc, random_crop_op.cc, interpolate_op.cc (trilinear),
+sequence_ops/sequence_reshape_op.cc, sequence_ops/sequence_scatter_op.cc,
+unique_with_counts_op.cc, detection/psroi_pool_op.cc.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import maybe, one, prng
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# activations / normalization
+# ---------------------------------------------------------------------------
+@register_op("selu")
+def selu(inputs, attrs):
+    """reference: selu_op.cc — scale * (x > 0 ? x : alpha*(e^x - 1))."""
+    jnp = _jnp()
+    x = one(inputs, "X")
+    scale = attrs.get("scale", 1.0507009873554805)
+    alpha = attrs.get("alpha", 1.6732632423543772)
+    return {"Out": scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0))}
+
+
+@register_op("lrn")
+def lrn(inputs, attrs):
+    """reference: lrn_op.cc — cross-channel local response norm (NCHW):
+    mid = k + alpha * sum_{window n} x^2; out = x * mid^-beta."""
+    jax, jnp = _jax(), _jnp()
+    x = one(inputs, "X")
+    n = int(attrs.get("n", 5))
+    k = attrs.get("k", 2.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = x * x
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, n - 1 - half), (0, 0), (0, 0)))
+    C = x.shape[1]
+    acc = jnp.zeros_like(x)
+    for i in range(n):
+        acc = acc + pad[:, i : i + C]
+    mid = k + alpha * acc
+    return {"Out": x * mid ** (-beta), "MidOut": mid}
+
+
+@register_op("affine_channel")
+def affine_channel(inputs, attrs):
+    """reference: affine_channel_op.cc — x * scale[C] + bias[C]."""
+    x = one(inputs, "X")
+    scale = one(inputs, "Scale").reshape(-1)
+    bias = one(inputs, "Bias").reshape(-1)
+    caxis = 1 if attrs.get("data_layout", "NCHW") == "NCHW" else x.ndim - 1
+    shp = tuple(-1 if i == caxis else 1 for i in range(x.ndim))
+    return {"Out": x * scale.reshape(shp) + bias.reshape(shp)}
+
+
+# ---------------------------------------------------------------------------
+# 3D conv / pool / adaptive pooling / trilinear resize
+# ---------------------------------------------------------------------------
+def _triple(v):
+    return list(v) if isinstance(v, (list, tuple)) else [int(v)] * 3
+
+
+@register_op("conv3d")
+def conv3d(inputs, attrs):
+    """reference: conv_op.cc 3D path — NCDHW."""
+    jax = _jax()
+    x = one(inputs, "Input")
+    w = one(inputs, "Filter")
+    strides = _triple(attrs.get("strides", 1))
+    pads = _triple(attrs.get("paddings", 0))
+    dils = _triple(attrs.get("dilations", 1))
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(p, p) for p in pads],
+        rhs_dilation=dils,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=int(attrs.get("groups", 1)),
+    )
+    return {"Output": out}
+
+
+@register_op("conv3d_transpose")
+def conv3d_transpose(inputs, attrs):
+    """reference: conv_transpose_op.cc 3D — paddle padding p maps to
+    (k_eff - 1 - p) on the stride-dilated input (see conv2d_transpose)."""
+    jax = _jax()
+    x = one(inputs, "Input")
+    w = one(inputs, "Filter")  # [in_c, out_c/groups, kd, kh, kw]
+    strides = _triple(attrs.get("strides", 1))
+    pads = _triple(attrs.get("paddings", 0))
+    dils = _triple(attrs.get("dilations", 1))
+    keff = [(w.shape[2 + i] - 1) * dils[i] + 1 for i in range(3)]
+    jpad = [(keff[i] - 1 - pads[i], keff[i] - 1 - pads[i]) for i in range(3)]
+    out = jax.lax.conv_transpose(
+        x, w, strides=strides,
+        padding=jpad,
+        rhs_dilation=dils,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        transpose_kernel=True,
+    )
+    return {"Output": out}
+
+
+@register_op("pool3d")
+def pool3d(inputs, attrs):
+    """reference: pool_op.cc 3D — max/avg over NCDHW windows."""
+    jax, jnp = _jax(), _jnp()
+    x = one(inputs, "X")
+    ptype = attrs.get("pooling_type", "max")
+    if attrs.get("global_pooling", False):
+        fn = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": fn(x, axis=(2, 3, 4), keepdims=True)}
+    ks = _triple(attrs.get("ksize", 2))
+    st = _triple(attrs.get("strides", ks))
+    pd = _triple(attrs.get("paddings", 0))
+    dims = (1, 1) + tuple(ks)
+    strides = (1, 1) + tuple(st)
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+    if ptype == "max":
+        out = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims, strides, pads)
+    else:
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides, pads)
+        if attrs.get("exclusive", True):
+            # padding excluded from the divisor (reference exclusive=True)
+            cnt = jax.lax.reduce_window(
+                jnp.ones_like(x), 0.0, jax.lax.add, dims, strides, pads)
+        else:
+            cnt = float(np.prod(ks))
+        out = s / cnt
+    return {"Out": out}
+
+
+@register_op("adaptive_pool2d")
+def adaptive_pool2d(inputs, attrs):
+    """reference: pool_op.cc adaptive path — torch-style bins:
+    start = floor(i*H/oh), end = ceil((i+1)*H/oh)."""
+    jnp = _jnp()
+    x = one(inputs, "X")  # NCHW
+    oh, ow = attrs["pool_size"] if isinstance(attrs.get("pool_size"), (list, tuple)) else [attrs["pool_size"]] * 2
+    ptype = attrs.get("pooling_type", "max")
+    N, C, H, W = x.shape
+    rows = []
+    for i in range(int(oh)):
+        h0, h1 = (i * H) // oh, -(-((i + 1) * H) // oh)
+        cols = []
+        for j in range(int(ow)):
+            w0, w1 = (j * W) // ow, -(-((j + 1) * W) // ow)
+            win = x[:, :, h0:h1, w0:w1]
+            cols.append(
+                jnp.max(win, axis=(2, 3)) if ptype == "max" else jnp.mean(win, axis=(2, 3))
+            )
+        rows.append(jnp.stack(cols, axis=-1))
+    return {"Out": jnp.stack(rows, axis=-2)}
+
+
+@register_op("trilinear_interp")
+def trilinear_interp(inputs, attrs):
+    """reference: interpolate_op.cc trilinear — NCDHW resize."""
+    jax = _jax()
+    x = one(inputs, "X")
+    n, c = x.shape[:2]
+    out_d = int(attrs.get("out_d", 0)) or x.shape[2]
+    out_h = int(attrs.get("out_h", 0)) or x.shape[3]
+    out_w = int(attrs.get("out_w", 0)) or x.shape[4]
+    out = jax.image.resize(x, (n, c, out_d, out_h, out_w), method="trilinear")
+    return {"Out": out.astype(x.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# tensor rearrangement
+# ---------------------------------------------------------------------------
+@register_op("multiplex", no_grad_set={"Ids"})
+def multiplex(inputs, attrs):
+    """reference: multiplex_op.cc — out[i] = X[ids[i]][i]."""
+    jnp = _jnp()
+    xs = jnp.stack(inputs["X"], axis=0)  # [K, B, ...]
+    ids = one(inputs, "Ids").reshape(-1).astype("int32")
+    b = jnp.arange(xs.shape[1])
+    return {"Out": xs[ids, b]}
+
+
+@register_op("space_to_depth")
+def space_to_depth(inputs, attrs):
+    """reference: space_to_depth_op.cc — [N, C, H, W] ->
+    [N, C*b*b, H/b, W/b]."""
+    x = one(inputs, "X")
+    b = int(attrs.get("blocksize", 2))
+    N, C, H, W = x.shape
+    out = (
+        x.reshape(N, C, H // b, b, W // b, b)
+        .transpose(0, 3, 5, 1, 2, 4)
+        .reshape(N, C * b * b, H // b, W // b)
+    )
+    return {"Out": out}
+
+
+@register_op("temporal_shift")
+def temporal_shift(inputs, attrs):
+    """reference: temporal_shift_op.cc — [N*T, C, H, W]: the first
+    C*ratio channels shift t-1 -> t, the next C*ratio shift t+1 -> t,
+    the rest stay (TSM)."""
+    jnp = _jnp()
+    x = one(inputs, "X")
+    T = int(attrs["seg_num"])
+    ratio = attrs.get("shift_ratio", 0.25)
+    NT, C, H, W = x.shape
+    N = NT // T
+    c1 = int(C * ratio)
+    c2 = int(C * 2 * ratio)
+    v = x.reshape(N, T, C, H, W)
+    fwd = jnp.pad(v[:, : T - 1, :c1], ((0, 0), (1, 0), (0, 0), (0, 0), (0, 0)))
+    bwd = jnp.pad(v[:, 1:, c1:c2], ((0, 0), (0, 1), (0, 0), (0, 0), (0, 0)))
+    out = jnp.concatenate([fwd, bwd, v[:, :, c2:]], axis=2)
+    return {"Out": out.reshape(NT, C, H, W)}
+
+
+@register_op("unfold")
+def unfold(inputs, attrs):
+    """reference: unfold_op.cc — im2col: [N, C, H, W] ->
+    [N, C*kh*kw, L]."""
+    jax, jnp = _jax(), _jnp()
+    x = one(inputs, "X")
+    kh, kw = attrs["kernel_sizes"]
+    sh, sw = attrs.get("strides", [1, 1])
+    ph, pw = attrs.get("paddings", [0, 0])[:2]
+    dh, dw = attrs.get("dilations", [1, 1])
+    N, C, H, W = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    oh = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            patch = xp[:, :, i * dh : i * dh + oh * sh : sh,
+                       j * dw : j * dw + ow * sw : sw]
+            cols.append(patch)
+    out = jnp.stack(cols, axis=2)  # [N, C, kh*kw, oh, ow]
+    return {"Y": out.reshape(N, C * kh * kw, oh * ow)}
+
+
+# ---------------------------------------------------------------------------
+# similarity / ranking / CTR losses
+# ---------------------------------------------------------------------------
+@register_op("cos_sim")
+def cos_sim(inputs, attrs):
+    """reference: cos_sim_op.h — row-wise cosine; Y may be [1, D]
+    (broadcast)."""
+    jnp = _jnp()
+    x = one(inputs, "X")
+    y = one(inputs, "Y")
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / (xn * yn + 1e-12)
+    return {"Out": out, "XNorm": xn, "YNorm": yn}
+
+
+@register_op("kldiv_loss", no_grad_set={"Target"})
+def kldiv_loss(inputs, attrs):
+    """reference: kldiv_loss_op.cc — x is LOG-prob, target is prob:
+    l = target * (log(target) - x)."""
+    jnp = _jnp()
+    x = one(inputs, "X")
+    t = one(inputs, "Target")
+    l = jnp.where(t > 0, t * (jnp.log(jnp.maximum(t, 1e-30)) - x), 0.0)
+    red = attrs.get("reduction", "mean")
+    if red == "mean":
+        l = jnp.mean(l)
+    elif red == "sum":
+        l = jnp.sum(l)
+    elif red == "batchmean":
+        l = jnp.sum(l) / x.shape[0]
+    return {"Loss": l}
+
+
+@register_op("rank_loss", no_grad_set={"Label"})
+def rank_loss(inputs, attrs):
+    """reference: rank_loss_op.cc — o = left-right, out =
+    log(1+e^o) - label*o (RankNet pairwise loss)."""
+    jax = _jax()
+    o = one(inputs, "Left") - one(inputs, "Right")
+    label = one(inputs, "Label")
+    return {"Out": jax.nn.softplus(o) - label * o}
+
+
+@register_op("margin_rank_loss", no_grad_set={"Label"})
+def margin_rank_loss(inputs, attrs):
+    """reference: margin_rank_loss_op.cc — relu(-label*(x1-x2)+margin)."""
+    jnp = _jnp()
+    x1, x2 = one(inputs, "X1"), one(inputs, "X2")
+    label = one(inputs, "Label")
+    margin = attrs.get("margin", 0.0)
+    act = jnp.maximum(-label * (x1 - x2) + margin, 0.0)
+    return {"Out": act, "Activated": (act > 0).astype(x1.dtype)}
+
+
+@register_op("bpr_loss", no_grad_set={"Label"})
+def bpr_loss(inputs, attrs):
+    """reference: bpr_loss_op.cc — Bayesian personalized ranking over
+    logits [N, C]: loss[n] = -mean_{j != y} log(sigmoid(x[y] - x[j]))."""
+    jax, jnp = _jax(), _jnp()
+    x = one(inputs, "X")
+    y = one(inputs, "Label").reshape(-1).astype("int32")
+    N, C = x.shape
+    pos = jnp.take_along_axis(x, y[:, None], axis=1)  # [N, 1]
+    diff = pos - x  # [N, C]
+    logsig = -jax.nn.softplus(-diff)
+    mask = jnp.ones((N, C), x.dtype).at[jnp.arange(N), y].set(0.0)
+    loss = -jnp.sum(logsig * mask, axis=1, keepdims=True) / jnp.maximum(C - 1, 1)
+    return {"Out": loss}
+
+
+@register_op("center_loss", no_grad_set={"Label", "Centers", "CenterUpdateRate"})
+def center_loss(inputs, attrs):
+    """reference: center_loss_op.cc — loss = 0.5*||x - c_y||^2;
+    CentersOut folds the per-class mean diff back with the update rate
+    when attr ``need_update`` (the stateful half the reference does in
+    the same kernel)."""
+    jnp = _jnp()
+    x = one(inputs, "X")
+    y = one(inputs, "Label").reshape(-1).astype("int32")
+    centers = one(inputs, "Centers")
+    rate = maybe(inputs, "CenterUpdateRate")
+    rate = rate.reshape(()) if rate is not None else jnp.asarray(0.5, x.dtype)
+    cx = centers[y]  # [B, D]
+    diff = x - cx
+    loss = 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
+    if attrs.get("need_update", True):
+        # per-class accumulated diff normalized by 1+count (reference's
+        # denominator), applied with the update rate
+        num_c = centers.shape[0]
+        ones = jnp.ones_like(y, dtype=x.dtype)
+        counts = jnp.zeros((num_c,), x.dtype).at[y].add(ones)
+        acc = jnp.zeros_like(centers).at[y].add(diff)
+        centers_out = centers + rate * acc / (1.0 + counts)[:, None]
+    else:
+        centers_out = centers
+    return {"Loss": loss, "SampleCenterDiff": diff, "CentersOut": centers_out}
+
+
+@register_op("teacher_student_sigmoid_loss", no_grad_set={"Label"})
+def teacher_student_sigmoid_loss(inputs, attrs):
+    """reference: teacher_student_sigmoid_loss_op.h — label encodes
+    (click z, optional teacher score z'): -2 -> z=0 no z'; -1 -> z=1 no
+    z'; [0,1) -> z=0, z'=label; [1,2] -> z=1, z'=label-1.  Loss =
+    bce(x, z) (+ bce(x, z') when the teacher score exists)."""
+    jnp = _jnp()
+    x = one(inputs, "X").reshape(-1)
+    lbl = one(inputs, "Label").reshape(-1)
+
+    def bce(z):
+        return jnp.maximum(x, 0.0) - x * z + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+    y = jnp.where(
+        lbl < -1.0,
+        bce(0.0),
+        jnp.where(
+            lbl < 0.0,
+            bce(1.0),
+            jnp.where(lbl < 1.0, bce(0.0) + bce(lbl), bce(1.0) + bce(lbl - 1.0)),
+        ),
+    )
+    return {"Y": y.reshape(-1, 1)}
+
+
+@register_op("mean_iou", differentiable=False,
+             no_grad_set={"Predictions", "Labels"})
+def mean_iou(inputs, attrs):
+    """reference: mean_iou_op.h — mean IoU over classes present in
+    pred or label."""
+    jnp = _jnp()
+    pred = one(inputs, "Predictions").reshape(-1).astype("int32")
+    label = one(inputs, "Labels").reshape(-1).astype("int32")
+    k = int(attrs["num_classes"])
+    inter = jnp.zeros((k,), "float32").at[pred].add(
+        (pred == label).astype("float32"))
+    pred_cnt = jnp.zeros((k,), "float32").at[pred].add(1.0)
+    lab_cnt = jnp.zeros((k,), "float32").at[label].add(1.0)
+    union = pred_cnt + lab_cnt - inter
+    present = union > 0
+    iou = jnp.where(present, inter / jnp.maximum(union, 1.0), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(present.astype("float32")), 1.0)
+    wrong = (pred_cnt - inter).astype("int32")
+    correct = inter.astype("int32")
+    return {"OutMeanIou": miou, "OutWrong": wrong, "OutCorrect": correct}
+
+
+# ---------------------------------------------------------------------------
+# grid sampling / position encoding
+# ---------------------------------------------------------------------------
+@register_op("affine_grid", no_grad_set={"OutputShape"})
+def affine_grid(inputs, attrs):
+    """reference: affine_grid_op.cc — theta [N, 2, 3] -> sampling grid
+    [N, H, W, 2] over normalized [-1, 1] coords (align-corners)."""
+    jnp = _jnp()
+    theta = one(inputs, "Theta")
+    shape = attrs.get("output_shape")
+    H, W = int(shape[2]), int(shape[3])
+    ys = jnp.linspace(-1.0, 1.0, H)
+    xs = jnp.linspace(-1.0, 1.0, W)
+    gx, gy = jnp.meshgrid(xs, ys)  # [H, W]
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+    grid = jnp.einsum("hwk,nck->nhwc", base, theta)  # [N, H, W, 2]
+    return {"Output": grid}
+
+
+@register_op("grid_sampler")
+def grid_sampler(inputs, attrs):
+    """reference: grid_sampler_op.cc — bilinear sample of x [N, C, H, W]
+    at grid [N, H', W', 2] normalized coords (align-corners, zero pad)."""
+    jnp = _jnp()
+    x = one(inputs, "X")
+    grid = one(inputs, "Grid")
+    N, C, H, W = x.shape
+    gx = (grid[..., 0] + 1.0) * (W - 1) / 2.0  # [N, Ho, Wo]
+    gy = (grid[..., 1] + 1.0) * (H - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(yi, xi):
+        inb = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+        yc = jnp.clip(yi, 0, H - 1).astype("int32")
+        xc = jnp.clip(xi, 0, W - 1).astype("int32")
+        v = x[jnp.arange(N)[:, None, None], :, yc, xc]  # [N, Ho, Wo, C]
+        return v * inb[..., None]
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    out = (
+        v00 * ((1 - wy) * (1 - wx))[..., None]
+        + v01 * ((1 - wy) * wx)[..., None]
+        + v10 * (wy * (1 - wx))[..., None]
+        + v11 * (wy * wx)[..., None]
+    )
+    return {"Output": out.transpose(0, 3, 1, 2)}
+
+
+@register_op("add_position_encoding")
+def add_position_encoding(inputs, attrs):
+    """reference: add_position_encoding_op.h — out = alpha*x + beta*PE,
+    sinusoidal PE over [B, T, D]."""
+    jnp = _jnp()
+    x = one(inputs, "X")
+    alpha = attrs.get("alpha", 1.0)
+    beta = attrs.get("beta", 1.0)
+    B, T, D = x.shape
+    pos = jnp.arange(T, dtype="float32")[:, None]
+    half = D // 2
+    div = jnp.power(10000.0, jnp.arange(half, dtype="float32") / half)
+    pe = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)], axis=1)
+    return {"Out": alpha * x + beta * pe[None, :, :].astype(x.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# id transforms: shard_index, hash, sampling_id, random_crop
+# ---------------------------------------------------------------------------
+@register_op("shard_index", differentiable=False)
+def shard_index(inputs, attrs):
+    """reference: shard_index_op.cc — map global ids to shard-local:
+    in-shard ids -> id % shard_size, others -> ignore_value."""
+    jnp = _jnp()
+    x = one(inputs, "X")
+    index_num = int(attrs["index_num"])
+    nshards = int(attrs["nshards"])
+    shard_id = int(attrs["shard_id"])
+    ignore = attrs.get("ignore_value", -1)
+    shard_size = (index_num + nshards - 1) // nshards
+    local = x % shard_size
+    return {"Out": jnp.where(x // shard_size == shard_id, local, ignore)}
+
+
+@register_op("hash", differentiable=False)
+def hash_op(inputs, attrs):
+    """reference: hash_op.cc (xxhash % mod_by).  Deterministic integer
+    mix hash here (splitmix-style) — the CONTRACT (stable many-to-few
+    bucketing of int ids into [0, mod_by) x num_hash) matches; exact
+    bucket values differ from xxhash and are documented as such."""
+    jnp = _jnp()
+    x = one(inputs, "X").astype("uint32")
+    num_hash = int(attrs.get("num_hash", 1))
+    mod_by = int(attrs.get("mod_by", 1))
+    outs = []
+    for i in range(num_hash):
+        h = x * np.uint32(2654435761) + np.uint32(0x9E3779B9) * np.uint32(i + 1)
+        h = h ^ (h >> 16)
+        h = h * np.uint32(0x85EBCA6B)
+        h = h ^ (h >> 13)
+        outs.append((h % np.uint32(mod_by)).astype("int64"))
+    out = _jnp().stack(outs, axis=-2) if num_hash > 1 else outs[0]
+    return {"Out": out}
+
+
+@register_op("sampling_id", differentiable=False)
+def sampling_id(inputs, attrs):
+    """reference: sampling_id_op.cc — one categorical sample per row of
+    probs [B, C]."""
+    jax = _jnp()
+    import jax as j
+
+    x = one(inputs, "X")
+    key = prng(int(attrs.get("seed", 0)) or 7919)
+    ids = j.random.categorical(key, _jnp().log(_jnp().maximum(x, 1e-30)), axis=1)
+    return {"Out": ids.astype("int64")}
+
+
+@register_op("random_crop", differentiable=False)
+def random_crop(inputs, attrs):
+    """reference: random_crop_op.h — seeded random crop of the trailing
+    dims to attr shape."""
+    import jax as j
+
+    jnp = _jnp()
+    x = one(inputs, "X")
+    shape = [int(s) for s in attrs["shape"]]
+    key = prng(int(attrs.get("seed", 0)) or 7919)
+    nd = len(shape)
+    starts = []
+    for i, tgt in enumerate(shape):
+        dim = x.shape[x.ndim - nd + i]
+        key, sub = j.random.split(key)
+        starts.append(
+            j.random.randint(sub, (), 0, max(dim - tgt, 0) + 1)
+        )
+    idx = tuple([slice(None)] * (x.ndim - nd))
+    out = j.lax.dynamic_slice(
+        x,
+        tuple([0] * (x.ndim - nd)) + tuple(starts),
+        tuple(x.shape[: x.ndim - nd]) + tuple(shape),
+    )
+    return {"Out": out, "SeedOut": jnp.asarray([int(attrs.get("seed", 0))], "int64")}
+
+
+# ---------------------------------------------------------------------------
+# padded-shim sequence extensions + unique
+# ---------------------------------------------------------------------------
+@register_op("sequence_reshape", no_grad_set={"SeqLen"})
+def sequence_reshape(inputs, attrs):
+    """reference: sequence_ops/sequence_reshape_op.cc — re-chunk each
+    row's features to ``new_dim``: [B, T, D] -> [B, T*D/new_dim,
+    new_dim]; lengths scale by D/new_dim."""
+    x = one(inputs, "X")
+    seq_len = maybe(inputs, "SeqLen")
+    new_dim = int(attrs["new_dim"])
+    B, T, D = x.shape
+    out = x.reshape(B, T * D // new_dim, new_dim)
+    res = {"Out": out}
+    if seq_len is not None:
+        res["OutSeqLen"] = (seq_len * D) // new_dim
+    return res
+
+
+@register_op("sequence_scatter", no_grad_set={"Ids", "SeqLen"})
+def sequence_scatter(inputs, attrs):
+    """reference: sequence_ops/sequence_scatter_op.cc — per batch row b:
+    out[b, ids[b, t]] += updates[b, t] for valid t (padded encoding)."""
+    jnp = _jnp()
+    x = one(inputs, "X")  # [B, D]
+    ids = one(inputs, "Ids").astype("int32")  # [B, T]
+    upd = one(inputs, "Updates")  # [B, T]
+    seq_len = maybe(inputs, "SeqLen")
+    B, T = ids.shape
+    if seq_len is not None:
+        m = jnp.arange(T)[None, :] < seq_len.reshape(-1, 1)
+        upd = upd * m.astype(upd.dtype)
+    b_idx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+    return {"Out": x.at[b_idx.reshape(-1), ids.reshape(-1)].add(upd.reshape(-1))}
+
+
+@register_op("unique_with_counts", differentiable=False)
+def unique_with_counts(inputs, attrs):
+    """reference: unique_with_counts_op.cc.  XLA needs static shapes, so
+    Out is padded to len(X) with the first unique repeated; UniqueCount
+    [1] carries the true count (the reference returns a short tensor)."""
+    jnp = _jnp()
+    x = one(inputs, "X").reshape(-1)
+    n = x.shape[0]
+    uniq, index, counts = jnp.unique(
+        x, return_inverse=True, return_counts=True, size=n, fill_value=x[0]
+    )
+    k = jnp.asarray(jnp.sum(counts > 0), "int32")
+    # fill_value rows count the fill; recompute count of real uniques
+    first = jnp.concatenate([jnp.ones((1,), bool), uniq[1:] != uniq[:-1]])
+    k = jnp.sum(first.astype("int32"))
+    return {
+        "Out": uniq,
+        "Index": index.astype("int32"),
+        "Count": counts.astype("int32"),
+        "UniqueCount": k.reshape(1),
+    }
+
+
+# ---------------------------------------------------------------------------
+# psroi_pool (position-sensitive ROI pooling)
+# ---------------------------------------------------------------------------
+@register_op("psroi_pool", no_grad_set={"ROIs"})
+def psroi_pool(inputs, attrs):
+    """reference: detection/psroi_pool_op.h — each output bin (i, j) of
+    channel c average-pools from input channel c*ph*pw + i*pw + j over
+    its spatial sub-window of the ROI."""
+    jnp = _jnp()
+    x = one(inputs, "X")  # [N, C, H, W]
+    rois = one(inputs, "ROIs")  # [R, 4] x1,y1,x2,y2 (batch 0)
+    ph = int(attrs["pooled_height"])
+    pw = int(attrs["pooled_width"])
+    oc = int(attrs["output_channels"])
+    scale = attrs.get("spatial_scale", 1.0)
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    x0 = jnp.round(rois[:, 0] * scale)
+    y0 = jnp.round(rois[:, 1] * scale)
+    x1 = jnp.round(rois[:, 2] * scale) + 1.0
+    y1 = jnp.round(rois[:, 3] * scale) + 1.0
+    rw = jnp.maximum(x1 - x0, 0.1) / pw
+    rh = jnp.maximum(y1 - y0, 0.1) / ph
+    hh = jnp.arange(H, dtype="float32")
+    ww = jnp.arange(W, dtype="float32")
+    outs = []
+    for i in range(ph):
+        for j in range(pw):
+            hs = jnp.floor(y0 + i * rh)[:, None]
+            he = jnp.ceil(y0 + (i + 1) * rh)[:, None]
+            ws = jnp.floor(x0 + j * rw)[:, None]
+            we = jnp.ceil(x0 + (j + 1) * rw)[:, None]
+            mh = ((hh[None, :] >= hs) & (hh[None, :] < he)).astype(x.dtype)
+            mw = ((ww[None, :] >= ws) & (ww[None, :] < we)).astype(x.dtype)
+            m = mh[:, :, None] * mw[:, None, :]  # [R, H, W]
+            cidx = jnp.arange(oc) * (ph * pw) + i * pw + j  # [oc]
+            feat = x[0, cidx]  # [oc, H, W] (single-image batch contract)
+            s = jnp.einsum("rhw,chw->rc", m, feat)
+            area = jnp.maximum(m.sum(axis=(1, 2)), 1.0)[:, None]
+            outs.append(s / area)
+    out = jnp.stack(outs, axis=-1).reshape(R, oc, ph, pw)
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# CTR ops: cvm, filter_by_instag; distillation fsp_matrix; deformable conv
+# ---------------------------------------------------------------------------
+@register_op("cvm", no_grad_set={"CVM"})
+def cvm(inputs, attrs):
+    """reference: cvm_op.h CvmComputeKernel — continuous-value model
+    show/click prefix: use_cvm keeps all columns with y0=log(x0+1),
+    y1=log(x1+1)-y0; else the two cvm columns drop."""
+    jnp = _jnp()
+    x = one(inputs, "X")
+    if attrs.get("use_cvm", True):
+        y0 = jnp.log(x[:, :1] + 1.0)
+        y1 = jnp.log(x[:, 1:2] + 1.0) - y0
+        return {"Y": jnp.concatenate([y0, y1, x[:, 2:]], axis=1)}
+    return {"Y": x[:, 2:]}
+
+
+@register_op("filter_by_instag", differentiable=False,
+             no_grad_set={"Ins_tag", "Filter_tag"})
+def filter_by_instag(inputs, attrs):
+    """reference: filter_by_instag_op.cc — keep rows whose tag set
+    intersects Filter_tag.  Static-shape variant: kept rows pack to the
+    top (stable), the tail zero-fills; LossWeight marks real rows and
+    IndexMap maps packed row -> source row (-1 past the end)."""
+    jnp = _jnp()
+    ins = one(inputs, "Ins")  # [N, D]
+    tags = one(inputs, "Ins_tag")  # [N, T] (-1 padded)
+    filt = one(inputs, "Filter_tag").reshape(-1)  # [K]
+    match = (tags[:, :, None] == filt[None, None, :]) & (tags >= 0)[:, :, None]
+    keep = match.any(axis=(1, 2))  # [N]
+    n = ins.shape[0]
+    order = jnp.argsort((~keep).astype("int32"), stable=True)
+    packed = ins[order]
+    cnt = keep.sum()
+    valid = jnp.arange(n) < cnt
+    out = jnp.where(valid[:, None], packed, 0.0)
+    loss_w = valid.astype(ins.dtype).reshape(-1, 1)
+    index_map = jnp.where(valid, order, -1).astype("int64")
+    return {"Out": out, "LossWeight": loss_w, "IndexMap": index_map}
+
+
+@register_op("fsp")
+def fsp(inputs, attrs):
+    """reference: fsp_op.cc — flow-of-solution-procedure matrix between
+    two feature maps [N, C1, H, W] x [N, C2, H, W] -> [N, C1, C2]
+    (spatial-mean of the outer product)."""
+    jnp = _jnp()
+    x = one(inputs, "X")
+    y = one(inputs, "Y")
+    n, c1, h, w = x.shape
+    return {"Out": jnp.einsum("nahw,nbhw->nab", x, y) / (h * w)}
+
+
+@register_op("deformable_conv", no_grad_set={"Mask"})
+def deformable_conv(inputs, attrs):
+    """reference: deformable_conv_op.cc (v2 with modulation mask) /
+    deformable_conv_v1 — each kernel tap samples the input at
+    (base + learned offset) by bilinear interpolation, then a regular
+    conv contraction.  Expressed as gather + einsum: XLA keeps it
+    fused and MXU-bound for the contraction."""
+    jnp = _jnp()
+    x = one(inputs, "Input")  # [N, C, H, W]
+    offset = one(inputs, "Offset")  # [N, 2*kh*kw*dg, Ho, Wo] (y, x pairs)
+    mask = maybe(inputs, "Mask")  # [N, kh*kw*dg, Ho, Wo] or None (v1)
+    wgt = one(inputs, "Filter")  # [O, C/g, kh, kw]
+    sh, sw = (attrs.get("strides", [1, 1]) + [1, 1])[:2]
+    ph, pw = (attrs.get("paddings", [0, 0]) + [0, 0])[:2]
+    dh, dw = (attrs.get("dilations", [1, 1]) + [1, 1])[:2]
+    groups = int(attrs.get("groups", 1))
+    if groups != 1 or int(attrs.get("deformable_groups", 1)) != 1:
+        raise NotImplementedError("deformable_conv groups>1 on this build")
+    N, C, H, W = x.shape
+    O, _, kh, kw = wgt.shape
+    Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+
+    oy = jnp.arange(Ho) * sh - ph
+    ox = jnp.arange(Wo) * sw - pw
+    off = offset.reshape(N, kh * kw, 2, Ho, Wo)
+
+    def bilinear(py, px):
+        # py/px [N, khkw, Ho, Wo] absolute float coords
+        y0 = jnp.floor(py)
+        x0 = jnp.floor(px)
+        wy = py - y0
+        wx = px - x0
+
+        def g(yi, xi):
+            inb = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+            yc = jnp.clip(yi, 0, H - 1).astype("int32")
+            xc = jnp.clip(xi, 0, W - 1).astype("int32")
+            # x[n, :, yc, xc] -> [N, khkw, Ho, Wo, C]
+            v = x[jnp.arange(N)[:, None, None, None], :, yc, xc]
+            return v * inb[..., None]
+
+        return (
+            g(y0, x0) * ((1 - wy) * (1 - wx))[..., None]
+            + g(y0, x0 + 1) * ((1 - wy) * wx)[..., None]
+            + g(y0 + 1, x0) * (wy * (1 - wx))[..., None]
+            + g(y0 + 1, x0 + 1) * (wy * wx)[..., None]
+        )
+
+    ky = jnp.repeat(jnp.arange(kh) * dh, kw)  # [khkw]
+    kx = jnp.tile(jnp.arange(kw) * dw, kh)
+    py = oy[None, None, :, None] + ky[None, :, None, None] + off[:, :, 0]
+    px = ox[None, None, None, :] + kx[None, :, None, None] + off[:, :, 1]
+    samp = bilinear(py.astype(x.dtype), px.astype(x.dtype))  # [N,khkw,Ho,Wo,C]
+    if mask is not None:
+        samp = samp * mask.reshape(N, kh * kw, Ho, Wo)[..., None]
+    wk = wgt.reshape(O, C, kh * kw)
+    out = jnp.einsum("nkhwc,ock->nohw", samp, wk)
+    return {"Output": out}

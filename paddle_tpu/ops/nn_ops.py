@@ -123,19 +123,30 @@ def depthwise_conv2d(inputs, attrs):
 
 @register_op("conv2d_transpose")
 def conv2d_transpose(inputs, attrs):
+    """reference: conv_transpose_op.cc — out = (in-1)*stride - 2*pad +
+    k_eff.  jax.lax.conv_transpose's explicit padding pads the
+    stride-dilated input before a VALID conv, so paddle padding p maps
+    to (k_eff - 1 - p) per side."""
     jax = _jax()
     x = one(inputs, "Input")
     w = one(inputs, "Filter")  # reference layout: [in_c, out_c/groups, kh, kw]
     strides = _pair(attrs.get("strides", [1, 1]))
     pads = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
+    keff = [
+        (w.shape[2 + i] - 1) * dilations[i] + 1 for i in range(2)
+    ]
+    jpad = [(keff[i] - 1 - pads[i], keff[i] - 1 - pads[i]) for i in range(2)]
+    # OIHW + transpose_kernel: jax flips the spatial taps and swaps
+    # in/out channels — the true gradient-of-conv the reference computes
     out = jax.lax.conv_transpose(
         x,
         w,
         strides=strides,
-        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        padding=jpad,
         rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        transpose_kernel=True,
     )
     return {"Output": out}
 

@@ -157,3 +157,62 @@ def dynamic_gru(inputs, attrs):
     if is_reverse:
         hs = hs[::-1]
     return {"Hidden": jnp.swapaxes(hs, 0, 1)}
+
+
+@register_op("dynamic_lstmp", no_grad_set={"SeqLen"})
+def dynamic_lstmp(inputs, attrs):
+    """LSTM with recurrent projection (reference: lstmp_op.cc) — Input
+    [B, T, 4D] pre-projected, Weight [P, 4D] projection-to-gates,
+    ProjWeight [D, P]; the recurrent state is the P-dim projection.
+    Outputs Projection [B, T, P], Cell [B, T, D]."""
+    import jax
+    import jax.numpy as jnp
+
+    x = one(inputs, "Input")
+    w = one(inputs, "Weight")  # [P, 4D]
+    w_proj = one(inputs, "ProjWeight")  # [D, P]
+    bias = maybe(inputs, "Bias")
+    B, T, D4 = x.shape
+    D = D4 // 4
+    P = w_proj.shape[1]
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cell_act = _act(attrs.get("cell_activation", "tanh"))
+    cand_act = _act(attrs.get("candidate_activation", "tanh"))
+    proj_act = _act(attrs.get("proj_activation", "tanh"))
+    use_peepholes = attrs.get("use_peepholes", True)
+    if bias is not None:
+        b_gate = bias[..., :D4].reshape(1, D4)
+        peep = bias[..., D4:].reshape(-1) if (use_peepholes and bias.shape[-1] > D4) else None
+    else:
+        b_gate, peep = jnp.zeros((1, D4), x.dtype), None
+    w_ic = peep[:D] if peep is not None else None
+    w_fc = peep[D:2 * D] if peep is not None else None
+    w_oc = peep[2 * D:] if peep is not None else None
+    lens = _lens(inputs, x, T)
+    r_init = jnp.zeros((B, P), x.dtype)
+    c_init = jnp.zeros((B, D), x.dtype)
+    xs = jnp.swapaxes(x, 0, 1)
+
+    def body(carry, inp):
+        r, c = carry
+        xt, t = inp
+        gates = xt + r @ w + b_gate
+        gi, gc, gf, go = jnp.split(gates, 4, axis=-1)
+        if w_ic is not None:
+            gi = gi + c * w_ic
+            gf = gf + c * w_fc
+        i = gate_act(gi)
+        f = gate_act(gf)
+        c_new = f * c + i * cand_act(gc)
+        if w_oc is not None:
+            go = go + c_new * w_oc
+        o = gate_act(go)
+        h = o * cell_act(c_new)
+        r_new = proj_act(h @ w_proj)
+        active = (t < lens)[:, None]
+        r_out = jnp.where(active, r_new, r)
+        c_out = jnp.where(active, c_new, c)
+        return (r_out, c_out), (r_out, c_out)
+
+    (_, _), (rs, cs) = jax.lax.scan(body, (r_init, c_init), (xs, jnp.arange(T)))
+    return {"Projection": jnp.swapaxes(rs, 0, 1), "Cell": jnp.swapaxes(cs, 0, 1)}

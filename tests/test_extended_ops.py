@@ -1,0 +1,385 @@
+"""Extended op/layer batch (reference: the layers/nn.py long tail —
+selu, lrn, 3D convs, ranking/CTR losses, grid sampling, hashing,
+deformable conv, LSTMP; per-op pointers in ops/extended_ops.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import framework
+
+
+def _run(build, feeds, n_fetch=None, seed=3):
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = seed
+    with framework.program_guard(prog, startup):
+        outs = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        return exe.run(prog, feed=feeds, fetch_list=list(outs))
+
+
+def test_selu_lrn_affine_channel():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4, 3, 3).astype("float32")
+    sc = rng.rand(4).astype("float32")
+    bi = rng.rand(4).astype("float32")
+
+    def build():
+        xv = fluid.layers.data("x", [4, 3, 3])
+        s = fluid.layers.data("s", [4], append_batch_size=False)
+        b = fluid.layers.data("b", [4], append_batch_size=False)
+        return (fluid.layers.selu(xv), fluid.layers.lrn(xv),
+                fluid.layers.affine_channel(xv, s, b))
+
+    selu_o, lrn_o, aff_o = _run(build, {"x": x, "s": sc, "b": bi})
+    lam, alp = 1.0507009873554805, 1.6732632423543772
+    np.testing.assert_allclose(
+        np.asarray(selu_o), lam * np.where(x > 0, x, alp * (np.exp(x) - 1)),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(aff_o), x * sc[None, :, None, None] + bi[None, :, None, None],
+        rtol=1e-5)
+    assert np.asarray(lrn_o).shape == x.shape
+
+
+def test_conv3d_pool3d_trains():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 2, 4, 6, 6).astype("float32")
+
+    def build():
+        xv = fluid.layers.data("x", [2, 4, 6, 6])
+        h = fluid.layers.conv3d(xv, 3, 2, act="relu")
+        p = fluid.layers.pool3d(h, pool_size=2, pool_stride=2, pool_type="avg")
+        up = fluid.layers.conv3d_transpose(p, 2, filter_size=2, stride=2)
+        tri = fluid.layers.resize_trilinear(p, out_shape=[4, 6, 6])
+        ap = fluid.layers.adaptive_pool2d(
+            fluid.layers.reshape(xv, shape=[0, 2 * 4, 6, 6]), [2, 2], "avg")
+        loss = fluid.layers.mean(up) + fluid.layers.mean(tri)
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+        return p, up, tri, ap, loss
+
+    p, up, tri, ap, loss = _run(build, {"x": x})
+    assert np.asarray(p).shape == (2, 3, 1, 2, 2)
+    assert np.asarray(up).shape == (2, 2, 2, 4, 4)
+    assert np.asarray(tri).shape == (2, 3, 4, 6, 6)
+    assert np.asarray(ap).shape == (2, 8, 2, 2)
+
+
+def test_ranking_and_ctr_losses():
+    rng = np.random.RandomState(2)
+    l = rng.randn(6, 1).astype("float32")
+    r = rng.randn(6, 1).astype("float32")
+    lab = rng.randint(0, 2, (6, 1)).astype("float32")
+
+    def build():
+        lv = fluid.layers.data("l", [1])
+        rv = fluid.layers.data("r", [1])
+        labv = fluid.layers.data("lab", [1])
+        return (fluid.layers.rank_loss(labv, lv, rv),
+                fluid.layers.margin_rank_loss(labv, lv, rv, margin=0.2))
+
+    rl, mrl = _run(build, {"l": l, "r": r, "lab": lab})
+    o = l - r
+    np.testing.assert_allclose(
+        np.asarray(rl), np.log1p(np.exp(o)) - lab * o, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(mrl), np.maximum(-lab * (l - r) + 0.2, 0), rtol=1e-5)
+
+    # bpr + cvm + teacher_student: train a step
+    x = rng.randn(8, 5).astype("float32")
+    y = rng.randint(0, 5, (8, 1)).astype("int64")
+
+    def build2():
+        xv = fluid.layers.data("x", [5])
+        yv = fluid.layers.data("y", [1], dtype="int64")
+        h = fluid.layers.fc(xv, 5)
+        loss = fluid.layers.mean(fluid.layers.bpr_loss(h, yv))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+        return (loss,)
+
+    (bl,) = _run(build2, {"x": x, "y": y})
+    assert np.isfinite(float(np.asarray(bl)))
+
+    show_clk = np.abs(rng.rand(8, 2)).astype("float32")
+    feat = np.concatenate([show_clk, x], 1)
+
+    def build3():
+        f = fluid.layers.data("f", [7])
+        c = fluid.layers.data("c", [2])
+        return (fluid.layers.continuous_value_model(f, c, use_cvm=True),
+                fluid.layers.continuous_value_model(f, c, use_cvm=False))
+
+    cv1, cv2 = _run(build3, {"f": feat, "c": show_clk})
+    assert np.asarray(cv1).shape == (8, 7)
+    np.testing.assert_allclose(np.asarray(cv2), feat[:, 2:], rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(cv1)[:, 0], np.log(feat[:, 0] + 1), rtol=1e-5)
+
+
+def test_center_loss_trains_and_updates_centers():
+    rng = np.random.RandomState(4)
+    x = rng.randn(12, 6).astype("float32")
+    y = rng.randint(0, 3, (12, 1)).astype("int64")
+
+    def build():
+        xv = fluid.layers.data("x", [6])
+        yv = fluid.layers.data("y", [1], dtype="int64")
+        emb = fluid.layers.fc(xv, 4)
+        loss = fluid.layers.mean(
+            fluid.layers.center_loss(emb, yv, num_classes=3, alpha=0.5))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+        return (loss,)
+
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 5
+    with framework.program_guard(prog, startup):
+        (loss,) = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = [
+            float(np.asarray(exe.run(prog, feed={"x": x, "y": y},
+                                     fetch_list=[loss])[0]))
+            for _ in range(10)
+        ]
+    # pulling embeddings toward (moving) centers shrinks the loss
+    assert losses[-1] < losses[0], losses
+
+
+def test_grid_affine_position_encoding():
+    rng = np.random.RandomState(5)
+    x = rng.rand(2, 3, 5, 5).astype("float32")
+    theta = np.tile(np.array([[1, 0, 0], [0, 1, 0]], "float32"), (2, 1, 1))
+
+    def build():
+        xv = fluid.layers.data("x", [3, 5, 5])
+        th = fluid.layers.data("th", [2, 3])
+        grid = fluid.layers.affine_grid(th, [2, 3, 5, 5])
+        samp = fluid.layers.grid_sampler(xv, grid)
+        seq = fluid.layers.data("seq", [4, 6])
+        pe = fluid.layers.add_position_encoding(seq, alpha=1.0, beta=1.0)
+        return samp, pe
+
+    samp, pe = _run(build, {"x": x, "th": theta,
+                            "seq": np.zeros((2, 4, 6), "float32")})
+    # identity theta reproduces the input
+    np.testing.assert_allclose(np.asarray(samp), x, atol=1e-5)
+    # zero input -> pure sinusoidal table; positions 0: sin=0, cos=1
+    pe = np.asarray(pe)
+    np.testing.assert_allclose(pe[0, 0, :3], 0.0, atol=1e-6)
+    np.testing.assert_allclose(pe[0, 0, 3:], 1.0, atol=1e-6)
+
+
+def test_id_transforms():
+    def build():
+        ids = fluid.layers.data("ids", [1], dtype="int64")
+        sharded = fluid.layers.shard_index(ids, index_num=20, nshards=2,
+                                           shard_id=1, ignore_value=-1)
+        hashed = fluid.layers.hash(ids, hash_size=100, num_hash=2)
+        probs = fluid.layers.data("p", [4])
+        sid = fluid.layers.sampling_id(probs)
+        return sharded, hashed, sid
+
+    ids = np.array([[3], [12], [17]], "int64")
+    p = np.full((3, 4), 0.25, "float32")
+    sh, ha, sid = _run(build, {"ids": ids, "p": p})
+    np.testing.assert_array_equal(np.asarray(sh).ravel(), [-1, 2, 7])
+    assert np.asarray(ha).min() >= 0 and np.asarray(ha).max() < 100
+    assert np.asarray(sid).shape == (3,)
+
+
+def test_sequence_reshape_scatter_and_instag():
+    def build():
+        x = fluid.layers.data("x", [3, 4], lod_level=1)
+        block = framework.default_main_program().global_block()
+        sl = block.var("x_seq_len")
+        out, new_len = fluid.layers.sequence_reshape(x, new_dim=2, seq_len=sl)
+        base = fluid.layers.data("base", [6])
+        ids = fluid.layers.data("ids", [3], dtype="int64")
+        upd = fluid.layers.data("upd", [3])
+        scat = fluid.layers.sequence_scatter(base, ids, upd, seq_len=sl)
+        ins = fluid.layers.data("ins", [4])
+        tags = fluid.layers.data("tags", [2], dtype="int64")
+        ftag = fluid.layers.data("ftag", [2], dtype="int64",
+                                 append_batch_size=False)
+        fo, lw = fluid.layers.filter_by_instag(ins, tags, ftag)
+        return out, new_len, scat, fo, lw
+
+    x = np.arange(24, dtype="float32").reshape(2, 3, 4)
+    sl = np.array([3, 2], "int32")
+    base = np.zeros((2, 6), "float32")
+    ids = np.array([[0, 2, 4], [1, 1, 3]], "int64")
+    upd = np.ones((2, 3), "float32")
+    ins = np.arange(8, dtype="float32").reshape(2, 4)
+    tags = np.array([[1, -1], [2, 3]], "int64")
+    ftag = np.array([3, 9], "int64")
+    out, nl, scat, fo, lw = _run(
+        build, {"x": x, "x_seq_len": sl, "base": base, "ids": ids,
+                "upd": upd, "ins": ins, "tags": tags, "ftag": ftag})
+    assert np.asarray(out).shape == (2, 6, 2)
+    np.testing.assert_array_equal(np.asarray(nl), [6, 4])
+    np.testing.assert_allclose(np.asarray(scat)[0], [1, 0, 1, 0, 1, 0])
+    # row 1 valid len 2 -> ids (1,1): +2 at col 1
+    np.testing.assert_allclose(np.asarray(scat)[1], [0, 2, 0, 0, 0, 0])
+    # only row 1 carries tag 3
+    np.testing.assert_allclose(np.asarray(fo)[0], ins[1])
+    np.testing.assert_allclose(np.asarray(lw).ravel(), [1, 0])
+
+
+def test_deformable_conv_zero_offset_matches_conv2d():
+    """With zero offsets and unit mask, deformable conv == plain conv."""
+    rng = np.random.RandomState(6)
+    x = rng.randn(1, 2, 6, 6).astype("float32")
+
+    def build():
+        xv = fluid.layers.data("x", [2, 6, 6])
+        off = fluid.layers.data("off", [2 * 9, 4, 4])
+        mask = fluid.layers.data("mask", [9, 4, 4])
+        out = fluid.layers.deformable_conv(
+            xv, off, mask, num_filters=3, filter_size=3,
+            param_attr=fluid.ParamAttr(name="dcn_w"), bias_attr=False)
+        ref = fluid.layers.conv2d(
+            xv, 3, 3, param_attr=fluid.ParamAttr(name="dcn_w"),
+            bias_attr=False)
+        return out, ref
+
+    off = np.zeros((1, 18, 4, 4), "float32")
+    mask = np.ones((1, 9, 4, 4), "float32")
+    out, ref = _run(build, {"x": x, "off": off, "mask": mask})
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_dynamic_lstmp_and_stacked_lstm_train():
+    rng = np.random.RandomState(7)
+    x = rng.randn(4, 5, 8).astype("float32")
+
+    def build():
+        xv = fluid.layers.data("x", [5, 8])
+        proj_in = fluid.layers.fc(xv, 4 * 6, num_flatten_dims=2,
+                                  bias_attr=False)
+        proj, cell = fluid.layers.dynamic_lstmp(proj_in, size=4 * 6,
+                                                proj_size=3)
+        out, last_h, last_c = fluid.layers.lstm(
+            xv, None, None, max_len=5, hidden_size=4, num_layers=2)
+        loss = fluid.layers.mean(proj) + fluid.layers.mean(out)
+        fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+        return proj, cell, out, last_h, last_c, loss
+
+    proj, cell, out, lh, lc, loss = _run(build, {"x": x})
+    assert np.asarray(proj).shape == (4, 5, 3)
+    assert np.asarray(cell).shape == (4, 5, 6)
+    assert np.asarray(out).shape == (4, 5, 4)
+    assert np.asarray(lh).shape == (2, 4, 4)
+    assert np.isfinite(float(np.asarray(loss)))
+
+
+def test_misc_wrappers():
+    rng = np.random.RandomState(8)
+
+    def build():
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.data("y", [4])
+        cs = fluid.layers.cos_sim(x, y)
+        kd = fluid.layers.kldiv_loss(fluid.layers.log_softmax(x),
+                                     fluid.layers.softmax(y))
+        dice = fluid.layers.dice_loss(fluid.layers.softmax(x),
+                                      fluid.layers.softmax(y))
+        npair = fluid.layers.npair_loss(x, y, fluid.layers.data(
+            "lab", [1], dtype="int64"))
+        anyv = fluid.layers.reduce_any(fluid.layers.cast(x, "bool"))
+        s = fluid.layers.size(x) if False else fluid.layers.rank(x)
+        pred = fluid.layers.data("pred", [6], dtype="int32")
+        labl = fluid.layers.data("labl", [6], dtype="int32")
+        miou, _, _ = fluid.layers.mean_iou(pred, labl, 4)
+        fm = fluid.layers.fsp_matrix(
+            fluid.layers.data("fa", [2, 3, 3]),
+            fluid.layers.data("fb", [5, 3, 3]))
+        return cs, kd, dice, npair, anyv, s, miou, fm
+
+    x = rng.rand(3, 4).astype("float32")
+    y = rng.rand(3, 4).astype("float32")
+    outs = _run(build, {
+        "x": x, "y": y, "lab": rng.randint(0, 2, (3, 1)).astype("int64"),
+        "pred": rng.randint(0, 4, (1, 6)).astype("int32"),
+        "labl": rng.randint(0, 4, (1, 6)).astype("int32"),
+        "fa": rng.rand(1, 2, 3, 3).astype("float32"),
+        "fb": rng.rand(1, 5, 3, 3).astype("float32"),
+    })
+    cs = np.asarray(outs[0])
+    exp = (x * y).sum(1) / (np.linalg.norm(x, axis=1) * np.linalg.norm(y, axis=1))
+    np.testing.assert_allclose(cs.ravel(), exp, rtol=1e-5)
+    fm = np.asarray(outs[7])
+    assert fm.shape == (1, 2, 5)
+
+
+def test_space_depth_temporal_unfold_multiplex_unique():
+    rng = np.random.RandomState(9)
+
+    def build():
+        x = fluid.layers.data("x", [4, 4, 4])
+        sd = fluid.layers.space_to_depth(x, 2)
+        ts = fluid.layers.temporal_shift(x, seg_num=2, shift_ratio=0.25)
+        uf = fluid.layers.unfold(x, [2, 2])
+        a = fluid.layers.data("a", [3])
+        b = fluid.layers.data("b", [3])
+        idx = fluid.layers.data("idx", [1], dtype="int32")
+        mx = fluid.layers.multiplex([a, b], idx)
+        u = fluid.layers.data("u", [6], dtype="int64", append_batch_size=False)
+        uo, ui, uc = fluid.layers.unique_with_counts(u)
+        return sd, ts, uf, mx, uo, uc
+
+    x = rng.rand(2, 4, 4, 4).astype("float32")
+    a = rng.rand(2, 3).astype("float32")
+    b = rng.rand(2, 3).astype("float32")
+    outs = _run(build, {"x": x, "a": a, "b": b,
+                        "idx": np.array([[1], [0]], "int32"),
+                        "u": np.array([5, 2, 5, 2, 2, 9], "int64")})
+    assert np.asarray(outs[0]).shape == (2, 16, 2, 2)
+    assert np.asarray(outs[1]).shape == x.shape
+    assert np.asarray(outs[2]).shape == (2, 16, 9)
+    np.testing.assert_allclose(np.asarray(outs[3]), np.stack([b[0], a[1]]))
+    assert np.asarray(outs[4])[:3].tolist() == [2, 5, 9]
+
+
+def test_honest_raises():
+    with framework.program_guard(framework.Program(), framework.Program()):
+        x = fluid.layers.data("x", [4])
+        with pytest.raises(NotImplementedError):
+            fluid.layers.chunk_eval(x, x, "IOB", 3)
+        with pytest.raises(NotImplementedError):
+            fluid.layers.sampled_softmax_with_cross_entropy(x, x, 5)
+        with pytest.raises(NotImplementedError):
+            fluid.layers.beam_search(None, None, x, x, 4, 0)
+
+
+def test_conv2d_transpose_golden():
+    """conv2d_transpose == the scatter-accumulate definition (gradient
+    of conv2d; reference conv_transpose_op.cc) for several stride/pad
+    combos — the old kernel neither flipped the taps nor mapped paddle
+    padding to the dilated-input padding."""
+    rng = np.random.RandomState(11)
+    x = rng.randn(1, 2, 3, 3).astype("float32")
+    w = rng.randn(2, 4, 3, 3).astype("float32")
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import registry
+
+    for s, p in [(1, 0), (2, 0), (2, 1), (1, 1)]:
+        H = (3 - 1) * s - 2 * p + 3
+        exp = np.zeros((1, 4, H, H), np.float32)
+        for ic in range(2):
+            for oc in range(4):
+                for i in range(3):
+                    for j in range(3):
+                        for ki in range(3):
+                            for kj in range(3):
+                                oi, oj = i * s + ki - p, j * s + kj - p
+                                if 0 <= oi < H and 0 <= oj < H:
+                                    exp[0, oc, oi, oj] += x[0, ic, i, j] * w[ic, oc, ki, kj]
+        out = registry.get_kernel("conv2d_transpose")(
+            {"Input": [jnp.asarray(x)], "Filter": [jnp.asarray(w)]},
+            {"strides": [s, s], "paddings": [p, p]})["Output"]
+        np.testing.assert_allclose(np.asarray(out), exp, atol=1e-4,
+                                   err_msg="s=%d p=%d" % (s, p))
