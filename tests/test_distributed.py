@@ -569,6 +569,7 @@ def test_geo_sgd_two_trainers():
         server.stop()
 
 
+@pytest.mark.slow
 def test_dygraph_data_parallel_two_processes(tmp_path):
     """Dygraph DataParallel with a REAL cross-process grad allreduce
     (host collective on rank-0's server; reference: dygraph/parallel.py
