@@ -30,7 +30,8 @@ __all__ = [
     "lod_append", "beam_search", "beam_search_decode", "chunk_eval",
     "sampled_softmax_with_cross_entropy", "continuous_value_model",
     "filter_by_instag", "fsp_matrix", "deformable_conv", "dynamic_lstmp",
-    "lstm",
+    "lstm", "similarity_focus", "var_conv_2d", "tree_conv",
+    "deformable_roi_pooling",
 ]
 
 
@@ -839,3 +840,81 @@ def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
     last_hidden = ltensor.stack(last_h_list, axis=0)
     last_cell = ltensor.stack(last_c_list, axis=0)
     return h, last_hidden, last_cell
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """reference: layers/nn.py similarity_focus."""
+    return _simple("similarity_focus", {"X": [input]},
+                   {"axis": int(axis), "indexes": [int(i) for i in indexes]})[0]
+
+
+def var_conv_2d(input, row, col, input_channel, output_channel, filter_size,
+                stride=1, param_attr=None, act=None, dtype="float32",
+                name=None):
+    """reference: layers/nn.py var_conv_2d — variable-size 2D conv over
+    per-sample (row, col) extents (padded-batch masked conv here)."""
+    helper = LayerHelper("var_conv_2d", param_attr=param_attr, act=act,
+                         name=name)
+    fs = filter_size if isinstance(filter_size, (list, tuple)) else [filter_size] * 2
+    st = stride if isinstance(stride, (list, tuple)) else [stride] * 2
+    w = helper.create_parameter(
+        param_attr, shape=[output_channel, input_channel * fs[0] * fs[1]],
+        dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="var_conv_2d",
+        inputs={"X": [input], "ROW": [row], "COLUMN": [col], "W": [w]},
+        outputs={"Out": [out]},
+        attrs={"InputChannel": int(input_channel),
+               "OutputChannel": int(output_channel),
+               "KernelH": int(fs[0]), "KernelW": int(fs[1]),
+               "StrideH": int(st[0]), "StrideW": int(st[1])},
+    )
+    return helper.append_activation(out)
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1, max_depth=2,
+              act="tanh", param_attr=None, bias_attr=None, name=None):
+    """reference: layers/nn.py tree_conv (TBCNN)."""
+    helper = LayerHelper("tree_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    feature_size = int(nodes_vector.shape[-1])
+    w = helper.create_parameter(
+        param_attr, shape=[feature_size, 3, output_size, num_filters],
+        dtype=nodes_vector.dtype)
+    out = helper.create_variable_for_type_inference(nodes_vector.dtype)
+    helper.append_op(
+        type="tree_conv",
+        inputs={"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+                "Filter": [w]},
+        outputs={"Out": [out]},
+        attrs={"max_depth": int(max_depth)},
+    )
+    pre = helper.append_bias_op(out, dim_start=2)
+    return helper.append_activation(pre)
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=[1, 1],
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1, position_sensitive=False,
+                           name=None):
+    """reference: layers/nn.py deformable_roi_pooling
+    (deformable_psroi_pooling_op.cc)."""
+    helper = LayerHelper("deformable_roi_pooling")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    top = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"Input": [input], "ROIs": [rois]}
+    if not no_trans and trans is not None:
+        ins["Trans"] = [trans]
+    helper.append_op(
+        type="deformable_psroi_pooling", inputs=ins,
+        outputs={"Output": [out], "TopCount": [top]},
+        attrs={"no_trans": bool(no_trans),
+               "spatial_scale": float(spatial_scale),
+               "pooled_height": int(pooled_height),
+               "pooled_width": int(pooled_width),
+               "sample_per_part": int(sample_per_part),
+               "trans_std": float(trans_std)},
+    )
+    return out

@@ -772,3 +772,226 @@ def deformable_conv(inputs, attrs):
     wk = wgt.reshape(O, C, kh * kw)
     out = jnp.einsum("nkhwc,ock->nohw", samp, wk)
     return {"Output": out}
+
+
+# ---------------------------------------------------------------------------
+# the last four: similarity_focus, var_conv_2d, tree_conv,
+# deformable_roi_pooling
+# ---------------------------------------------------------------------------
+@register_op("similarity_focus", differentiable=False)
+def similarity_focus(inputs, attrs):
+    """reference: similarity_focus_op.cc — per selected channel, greedy
+    max-assignment over the [B-rows, C-cols] slice (each chosen max
+    blocks its row and column), OR the masks over indexes, broadcast to
+    the full shape.  The greedy loop is a lax.fori_loop of min(B, C)
+    static steps."""
+    import jax
+
+    jnp = _jnp()
+    x = one(inputs, "X")  # [N, A, B, C]
+    axis = int(attrs.get("axis", 1))
+    indexes = [int(i) for i in attrs["indexes"]]
+    if axis != 1:
+        x = jnp.moveaxis(x, axis, 1)
+    N, A, B, C = x.shape
+    steps = min(B, C)
+
+    def one_mask(t):  # t [B, C] -> greedy assignment mask
+        def body(i, carry):
+            mask, rows_used, cols_used = carry
+            avail = (~rows_used)[:, None] & (~cols_used)[None, :]
+            tm = jnp.where(avail, t, -jnp.inf)
+            flat = jnp.argmax(tm)
+            r, c = flat // C, flat % C
+            mask = mask.at[r, c].set(1.0)
+            return (mask, rows_used.at[r].set(True), cols_used.at[c].set(True))
+
+        mask0 = jnp.zeros((B, C))
+        m, _, _ = jax.lax.fori_loop(
+            0, steps, body,
+            (mask0, jnp.zeros(B, bool), jnp.zeros(C, bool)))
+        return m
+
+    masks = []
+    for idx in indexes:
+        masks.append(jax.vmap(one_mask)(x[:, idx]))  # [N, B, C]
+    mask = masks[0]
+    for m in masks[1:]:
+        mask = jnp.maximum(mask, m)
+    out = jnp.broadcast_to(mask[:, None], (N, A, B, C)).astype(x.dtype)
+    if axis != 1:
+        out = jnp.moveaxis(out, 1, axis)
+    return {"Out": out}
+
+
+@register_op("var_conv_2d", no_grad_set={"ROW", "COLUMN"})
+def var_conv_2d(inputs, attrs):
+    """reference: var_conv_2d_op.cc — conv over per-sample variable
+    [row_i, col_i] images.  Padded encoding: X [N, C_in, Hmax, Wmax]
+    with ROW/COLUMN the per-sample valid heights/widths; inputs beyond
+    a sample's extent are masked to zero before the conv and outputs
+    beyond the strided extent masked after — the dense-batch equivalent
+    of the reference's per-sample LoD loop."""
+    jax = _jax()
+    jnp = _jnp()
+    x = one(inputs, "X")
+    rows = one(inputs, "ROW").reshape(-1)
+    cols = one(inputs, "COLUMN").reshape(-1)
+    w = one(inputs, "W")  # [out_c, in_c * kh * kw]
+    ic = int(attrs.get("InputChannel", 1))
+    oc = int(attrs.get("OutputChannel", 1))
+    kh, kw = int(attrs.get("KernelH", 1)), int(attrs.get("KernelW", 1))
+    sh, sw = int(attrs.get("StrideH", 1)), int(attrs.get("StrideW", 1))
+    N, C, H, W = x.shape
+    hm = jnp.arange(H)[None, :] < rows[:, None]
+    wm = jnp.arange(W)[None, :] < cols[:, None]
+    xm = x * (hm[:, None, :, None] & wm[:, None, None, :]).astype(x.dtype)
+    wk = w.reshape(oc, ic, kh, kw)
+    ph, pw = kh // 2, kw // 2  # reference uses same-ish padding k/2
+    out = jax.lax.conv_general_dilated(
+        xm, wk, window_strides=(sh, sw), padding=[(ph, ph), (pw, pw)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    Ho, Wo = out.shape[2], out.shape[3]
+    orow = (rows + sh - 1) // sh
+    ocol = (cols + sw - 1) // sw
+    ohm = jnp.arange(Ho)[None, :] < orow[:, None]
+    owm = jnp.arange(Wo)[None, :] < ocol[:, None]
+    out = out * (ohm[:, None, :, None] & owm[:, None, None, :]).astype(x.dtype)
+    return {"Out": out}
+
+
+@register_op("tree_conv", no_grad_set={"EdgeSet"})
+def tree_conv(inputs, attrs):
+    """reference: tree_conv_op.cc + math/tree2col.cc (TBCNN).
+
+    Per root u the patch holds every descendant v within
+    depth < max_depth, weighted by the continuous binary-tree
+    coefficients eta_t = (K-d)/K, eta_l = (1-eta_t)*(i-1)/(s-1)
+    (0.5 when s==1), eta_r = (1-eta_t)*(1-eta_l), where d is v's depth
+    below u and (i, s) its 1-based sibling position/count.  The DFS
+    becomes adjacency-matrix powers (d is unique in a tree), so the
+    whole batch is three einsums — no data-dependent control flow.
+
+    NodesVector [N, M, F]; EdgeSet [N, E, 2] (parent, child; 1-based,
+    rows with parent<=0 are padding); Filter [F, 3, O, K].
+    Out [N, M, O, K] (rows of padding nodes are zero).
+    """
+    import jax
+
+    jnp = _jnp()
+    feats = one(inputs, "NodesVector")
+    edges = one(inputs, "EdgeSet").astype("int32")
+    w = one(inputs, "Filter")  # [F, 3, O, Kf]
+    K = int(attrs.get("max_depth", 2))
+    N, M, F = feats.shape
+    E = edges.shape[1]
+
+    def per_sample(feat, edge):
+        par, chd = edge[:, 0], edge[:, 1]
+        valid = par > 0
+        p = jnp.where(valid, par, 0)
+        c = jnp.where(valid, chd, 0)
+        # adjacency over 1..M (slot 0 = dump for padding)
+        A = jnp.zeros((M + 1, M + 1)).at[p, c].max(
+            jnp.where(valid, 1.0, 0.0))
+        A = A.at[0, :].set(0.0).at[:, 0].set(0.0)
+        # sibling index: 1 + count of earlier edges sharing the parent
+        same_parent = (p[None, :] == p[:, None]) & valid[None, :] & valid[:, None]
+        earlier = jnp.tril(same_parent, k=-1)
+        idx_e = earlier.sum(axis=1) + 1  # [E]
+        pclen_e = A.sum(axis=1)[p]  # children count of each edge's parent
+        index_v = jnp.zeros((M + 1,)).at[c].max(
+            jnp.where(valid, idx_e.astype("float32"), 0.0))
+        pclen_v = jnp.zeros((M + 1,)).at[c].max(
+            jnp.where(valid, pclen_e, 0.0))
+        # depth-0 root slot: index 1, pclen 1 (vanishes in eta_l/r anyway)
+        base = jnp.where(pclen_v <= 1.0, 0.5,
+                         (index_v - 1.0) / jnp.maximum(pclen_v - 1.0, 1.0))
+        # reachability powers and per-depth etas
+        Cl = jnp.zeros((M + 1, M + 1))
+        Cr = jnp.zeros((M + 1, M + 1))
+        Ct = jnp.zeros((M + 1, M + 1))
+        R = jnp.eye(M + 1)
+        for d in range(K):
+            eta_t = (K - d) / K
+            eta_l = (1.0 - eta_t) * base
+            eta_r = (1.0 - eta_t) * (1.0 - base)
+            Ct = Ct + R * eta_t
+            Cl = Cl + R * eta_l[None, :]
+            Cr = Cr + R * eta_r[None, :]
+            R = jnp.minimum(R @ A, 1.0)
+        featp = jnp.concatenate([jnp.zeros((1, F), feat.dtype), feat], axis=0)
+        coef = jnp.stack([Cl, Cr, Ct], axis=-1)  # [M+1, M+1, 3]
+        patch = jnp.einsum("uvc,vf->ufc", coef, featp)  # [M+1, F, 3]
+        out = jnp.einsum("ufc,fcok->uok", patch, w)
+        return out[1:]
+
+    return {"Out": jax.vmap(per_sample)(feats, edges)}
+
+
+@register_op("deformable_psroi_pooling", no_grad_set={"ROIs"})
+def deformable_psroi_pooling(inputs, attrs):
+    """reference: deformable_psroi_pooling_op.cc — PS-ROI pooling where
+    each bin's sub-window shifts by a learned normalized offset
+    (Trans * trans_std * roi size); each bin averages
+    sample_per_part^2 bilinear samples from its channel group."""
+    jnp = _jnp()
+    x = one(inputs, "Input")  # [1, C, H, W]
+    rois = one(inputs, "ROIs")  # [R, 4]
+    trans = maybe(inputs, "Trans")  # [R, 2, ph, pw] or None
+    no_trans = attrs.get("no_trans", trans is None)
+    scale = attrs.get("spatial_scale", 1.0)
+    ph = int(attrs["pooled_height"])
+    pw = int(attrs["pooled_width"])
+    od = int(attrs.get("output_dim", x.shape[1] // (ph * pw)))
+    spp = int(attrs.get("sample_per_part", 4))
+    tstd = attrs.get("trans_std", 0.1)
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    x0 = rois[:, 0] * scale - 0.5
+    y0 = rois[:, 1] * scale - 0.5
+    x1 = (rois[:, 2] + 1.0) * scale - 0.5
+    y1 = (rois[:, 3] + 1.0) * scale - 0.5
+    rw = jnp.maximum(x1 - x0, 0.1)
+    rh = jnp.maximum(y1 - y0, 0.1)
+    bin_w = rw / pw
+    bin_h = rh / ph
+
+    def bilinear(cidx, py, px):
+        yy0 = jnp.floor(py)
+        xx0 = jnp.floor(px)
+        wy = py - yy0
+        wx = px - xx0
+
+        def g(yi, xi):
+            inb = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+            yc = jnp.clip(yi, 0, H - 1).astype("int32")
+            xc = jnp.clip(xi, 0, W - 1).astype("int32")
+            return x[0, cidx][:, yc, xc] * inb  # [od, ...]
+
+        return (
+            g(yy0, xx0) * (1 - wy) * (1 - wx)
+            + g(yy0, xx0 + 1) * (1 - wy) * wx
+            + g(yy0 + 1, xx0) * wy * (1 - wx)
+            + g(yy0 + 1, xx0 + 1) * wy * wx
+        )
+
+    outs = []
+    for i in range(ph):
+        for j in range(pw):
+            if no_trans:
+                dy = jnp.zeros((R,))
+                dx = jnp.zeros((R,))
+            else:
+                dy = trans[:, 0, i, j] * tstd * rh
+                dx = trans[:, 1, i, j] * tstd * rw
+            sub = (jnp.arange(spp) + 0.5) / spp
+            py = (y0 + i * bin_h + dy)[:, None] + sub[None, :] * bin_h[:, None]
+            px = (x0 + j * bin_w + dx)[:, None] + sub[None, :] * bin_w[:, None]
+            cidx = jnp.arange(od) * (ph * pw) + i * pw + j
+            # [od, R, spp, spp]
+            vals = bilinear(cidx, py[:, :, None], px[:, None, :])
+            outs.append(vals.mean(axis=(2, 3)).T)  # [R, od]
+    out = jnp.stack(outs, axis=-1).reshape(R, od, ph, pw)
+    return {"Output": out, "TopCount": jnp.ones((R, od, ph, pw))}

@@ -383,3 +383,136 @@ def test_conv2d_transpose_golden():
             {"strides": [s, s], "paddings": [p, p]})["Output"]
         np.testing.assert_allclose(np.asarray(out), exp, atol=1e-4,
                                    err_msg="s=%d p=%d" % (s, p))
+
+
+def test_similarity_focus_golden():
+    """Greedy row/column-exclusive max assignment (similarity_focus_op.cc)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import registry
+
+    x = np.zeros((1, 2, 3, 3), "float32")
+    x[0, 0] = [[9, 1, 2], [1, 8, 3], [2, 3, 7]]
+    x[0, 1] = 0.0
+    out = registry.get_kernel("similarity_focus")(
+        {"X": [jnp.asarray(x)]}, {"axis": 1, "indexes": [0]})["Out"]
+    out = np.asarray(out)
+    exp = np.eye(3, dtype="float32")  # diagonal maxes, each blocking row+col
+    np.testing.assert_allclose(out[0, 0], exp)
+    np.testing.assert_allclose(out[0, 1], exp)  # broadcast over channels
+
+    # conflicting max: 9 at (0,0); next largest avoiding row0/col0 is 8
+    # at (1,1); then 7 at (2,2) — with a decoy larger value in a blocked
+    # cell
+    x2 = np.zeros((1, 1, 2, 3), "float32")
+    x2[0, 0] = [[9, 8.5, 1], [8.4, 2, 3]]
+    out2 = np.asarray(registry.get_kernel("similarity_focus")(
+        {"X": [jnp.asarray(x2)]}, {"axis": 1, "indexes": [0]})["Out"])
+    exp2 = np.array([[1, 0, 0], [0, 0, 1]], "float32")  # 8.5/8.4 blocked
+    np.testing.assert_allclose(out2[0, 0], exp2)
+
+
+def _tree_conv_numpy(feats, edges, w, max_depth):
+    """Reference algorithm: DFS patches with eta coefficients
+    (math/tree2col.cc), numpy."""
+    M, F = feats.shape
+    _, _, O, Kf = w.shape
+    children = {}
+    for p, c in edges:
+        if p > 0:
+            children.setdefault(int(p), []).append(int(c))
+    out = np.zeros((M, O, Kf), "float32")
+    for u in range(1, M + 1):
+        # patch: (node, index, pclen, depth)
+        patch = [(u, 1, 1, 0)]
+        stack = [(u, 0)]
+        visited = {u}
+        while stack:
+            node, d = stack.pop()
+            if d + 1 < max_depth:
+                kids = children.get(node, [])
+                for i, v in enumerate(kids):
+                    if v not in visited:
+                        visited.add(v)
+                        patch.append((v, i + 1, len(kids), d + 1))
+                        stack.append((v, d + 1))
+        acc = np.zeros((F, 3), "float32")
+        for (v, idx, pclen, d) in patch:
+            eta_t = (max_depth - d) / max_depth
+            base = 0.5 if pclen == 1 else (idx - 1.0) / (pclen - 1.0)
+            eta_l = (1 - eta_t) * base
+            eta_r = (1 - eta_t) * (1 - base)
+            acc[:, 0] += eta_l * feats[v - 1]
+            acc[:, 1] += eta_r * feats[v - 1]
+            acc[:, 2] += eta_t * feats[v - 1]
+        out[u - 1] = np.einsum("fc,fcok->ok", acc, w)
+    return out
+
+
+def test_tree_conv_golden():
+    """tree_conv == the reference DFS+eta algorithm on a 6-node tree
+    (tree_conv_op.cc / math/tree2col.cc)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import registry
+
+    rng = np.random.RandomState(12)
+    M, F, O, Kf = 6, 4, 3, 2
+    feats = rng.randn(M, F).astype("float32")
+    #       1
+    #     / | \
+    #    2  3  4
+    #       |
+    #       5     (node 6 isolated)
+    edges = np.array([[1, 2], [1, 3], [1, 4], [3, 5], [0, 0], [0, 0]], "int64")
+    w = rng.randn(F, 3, O, Kf).astype("float32")
+    for K in (2, 3):
+        out = registry.get_kernel("tree_conv")(
+            {"NodesVector": [jnp.asarray(feats[None])],
+             "EdgeSet": [jnp.asarray(edges[None])],
+             "Filter": [jnp.asarray(w)]},
+            {"max_depth": K})["Out"]
+        exp = _tree_conv_numpy(feats, edges, w, K)
+        np.testing.assert_allclose(np.asarray(out)[0], exp, rtol=1e-4,
+                                   atol=1e-5, err_msg="max_depth=%d" % K)
+
+
+def test_var_conv_2d_masks_variable_extents():
+    rng = np.random.RandomState(13)
+
+    def build():
+        x = fluid.layers.data("x", [1, 6, 6])
+        row = fluid.layers.data("row", [1], dtype="int32")
+        col = fluid.layers.data("col", [1], dtype="int32")
+        out = fluid.layers.var_conv_2d(x, row, col, input_channel=1,
+                                       output_channel=2, filter_size=3)
+        return (out,)
+
+    x = rng.rand(2, 1, 6, 6).astype("float32")
+    row = np.array([[6], [3]], "int32")
+    col = np.array([[6], [4]], "int32")
+    (o,) = _run(build, {"x": x, "row": row, "col": col})
+    o = np.asarray(o)
+    assert o.shape[:2] == (2, 2)
+    # sample 1's output beyond its 3x4 extent is zeroed
+    assert np.allclose(o[1, :, 3:, :], 0) and np.allclose(o[1, :, :, 4:], 0)
+    assert not np.allclose(o[1, :, :3, :4], 0)
+
+
+def test_deformable_roi_pooling_no_trans():
+    """no_trans + whole-image ROI: each 1x1-bin output channel equals
+    the mean of bilinear samples from its own channel group — with a
+    constant-per-channel input, exactly that channel's value."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import registry
+
+    C, H, W = 4, 6, 6  # od=4 with 1x1 bins
+    x = np.stack([np.full((H, W), float(c + 1), "float32") for c in range(C)])
+    rois = np.array([[0.0, 0.0, 5.0, 5.0]], "float32")
+    out = registry.get_kernel("deformable_psroi_pooling")(
+        {"Input": [jnp.asarray(x[None])], "ROIs": [jnp.asarray(rois)]},
+        {"no_trans": True, "spatial_scale": 1.0, "pooled_height": 1,
+         "pooled_width": 1, "output_dim": 4, "sample_per_part": 4})["Output"]
+    np.testing.assert_allclose(np.asarray(out).ravel(), [1, 2, 3, 4],
+                               rtol=1e-5)
