@@ -60,6 +60,17 @@ from paddle_tpu import incubate
 from paddle_tpu import io
 from paddle_tpu import reader
 from paddle_tpu import dataset
+from paddle_tpu import nets
+from paddle_tpu import install_check
+from paddle_tpu.layers import learning_rate_scheduler as learning_rate_decay
+
+# LoDTensor/Tensor surface: device arrays ARE the tensors on this build;
+# the scope's tensor view carries the set/shape API (reference
+# lod_tensor.h analog lives in the padded encoding, SURVEY.md §7)
+from paddle_tpu.scope import _TensorView as Tensor
+
+LoDTensor = Tensor
+LoDTensorArray = list
 from paddle_tpu.reader import PyReader, batch
 from paddle_tpu.data_feeder import DataFeeder
 from paddle_tpu.io import (

@@ -141,14 +141,18 @@ class Executor:
             v.name for v in program.list_vars() if v.persistable
         }
 
+        # true dataflow reads: a name counts as read-from-outside only
+        # when some op reads it BEFORE any op writes it (a load/fill op
+        # producing a persistable must not demand scope pre-init)
         read, written = set(), set()
         for op in block.ops:
             for n in op.input_arg_names:
-                read.add(n)
+                if n not in written:
+                    read.add(n)
             for n in op.output_arg_names:
                 written.add(n)
         for fname in fetch_names:
-            if fname in persistable:
+            if fname in persistable and fname not in written:
                 read.add(fname)
 
         if ps_push:

@@ -31,7 +31,8 @@ __all__ = [
     "sampled_softmax_with_cross_entropy", "continuous_value_model",
     "filter_by_instag", "fsp_matrix", "deformable_conv", "dynamic_lstmp",
     "lstm", "similarity_focus", "var_conv_2d", "tree_conv",
-    "deformable_roi_pooling",
+    "deformable_roi_pooling", "diag", "eye", "linspace", "reverse",
+    "has_inf", "has_nan", "tensor_array_to_tensor", "is_empty", "Print",
 ]
 
 
@@ -918,3 +919,85 @@ def deformable_roi_pooling(input, rois, trans, no_trans=False,
                "trans_std": float(trans_std)},
     )
     return out
+
+
+# -- tensor-namespace tail (reference: layers/tensor.py) -------------------
+def diag(diagonal):
+    """reference: layers/tensor.py diag."""
+    helper = LayerHelper("diag")
+    out = helper.create_variable_for_type_inference(diagonal.dtype)
+    helper.append_op(type="diag", inputs={"Diagonal": [diagonal]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def eye(num_rows, num_columns=None, batch_shape=None, dtype="float32"):
+    """reference: layers/tensor.py eye."""
+    from paddle_tpu.layers import tensor as ltensor
+
+    num_columns = num_columns or num_rows
+    e = np.eye(int(num_rows), int(num_columns)).astype(dtype)
+    if batch_shape:
+        e = np.broadcast_to(e, list(batch_shape) + list(e.shape)).copy()
+    return ltensor.assign(e)
+
+
+def linspace(start, stop, num, dtype="float32"):
+    """reference: layers/tensor.py linspace."""
+    from paddle_tpu.layers import tensor as ltensor
+
+    return ltensor.assign(np.linspace(float(start), float(stop), int(num),
+                                      dtype=dtype))
+
+
+def reverse(x, axis):
+    """reference: layers/tensor.py reverse."""
+    helper = LayerHelper("reverse")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="reverse", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"axis": axis if isinstance(axis, list) else [axis]})
+    return out
+
+
+def has_inf(x):
+    """reference: layers/tensor.py has_inf."""
+    return _simple("has_inf", {"X": [x]}, dtype="bool")[0]
+
+
+def has_nan(x):
+    """reference: layers/tensor.py has_nan."""
+    return _simple("has_nan", {"X": [x]}, dtype="bool")[0]
+
+
+def tensor_array_to_tensor(input, axis=1, name=None):
+    """reference: layers/tensor.py tensor_array_to_tensor — concat the
+    (static pre-sized) array shim along axis; returns (out, sizes)."""
+    from paddle_tpu.layers import tensor as ltensor
+
+    vals = input if isinstance(input, (list, tuple)) else list(input)
+    out = ltensor.concat(list(vals), axis=axis)
+    sizes = ltensor.assign(
+        np.asarray([int(v.shape[axis]) for v in vals], "int32"))
+    return out, sizes
+
+
+def is_empty(x, cond=None):
+    """reference: layers/control_flow.py is_empty — static emptiness on
+    this build (shapes are compile-time)."""
+    from paddle_tpu.layers import tensor as ltensor
+
+    n = 1
+    for s in x.shape:
+        n *= int(s)
+    return ltensor.assign(np.asarray([n == 0]))
+
+
+def Print(input, first_n=-1, message=None, summarize=-1, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_lod=True, print_phase="both"):
+    """reference: layers/control_flow.py Print — host-side print at the
+    op's position via the debug print callback."""
+    return _simple("print", {"X": [input]},
+                   {"message": message or "", "first_n": int(first_n),
+                    "summarize": int(summarize)})[0]

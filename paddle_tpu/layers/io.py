@@ -4,7 +4,9 @@ from __future__ import annotations
 from paddle_tpu import framework
 from paddle_tpu.core import types as core_types
 
-__all__ = ["data"]
+__all__ = ["data", "py_reader", "create_py_reader_by_data", "batch",
+           "shuffle", "double_buffer", "load", "read_file", "open_files",
+           "random_data_generator", "Preprocessor"]
 
 
 def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True, stop_gradient=True, **kwargs):
@@ -52,3 +54,102 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True, stop
             "padded LoD shim supports lod_level<=2 (docs->sents->words)"
         )
     return var
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """reference: layers/io.py py_reader — returns a PyReader-like
+    object; feed it with decorate_paddle_reader/decorate_batch_generator
+    and iterate (the TPU build feeds the compiled step directly, see
+    paddle_tpu/reader.py PyReader)."""
+    from paddle_tpu import reader as reader_mod
+
+    feed_names = [name or "pyr_%d" % i for i, _ in enumerate(shapes)]
+    return reader_mod.PyReader(
+        feed_list=None, capacity=capacity, use_double_buffer=use_double_buffer,
+        iterable=True,
+    )
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    """reference: layers/io.py create_py_reader_by_data."""
+    from paddle_tpu import reader as reader_mod
+
+    return reader_mod.PyReader(
+        feed_list=feed_list, capacity=capacity,
+        use_double_buffer=use_double_buffer, iterable=True,
+    )
+
+
+def batch(reader, batch_size, drop_last=False):
+    """reference: layers/io.py batch (decorator form)."""
+    from paddle_tpu import reader as reader_mod
+
+    return reader_mod.batch(reader, batch_size, drop_last)
+
+
+def shuffle(reader, buffer_size):
+    """reference: layers/io.py shuffle (decorator form)."""
+    from paddle_tpu import reader as reader_mod
+
+    return reader_mod.shuffle(reader, buffer_size)
+
+
+def double_buffer(reader, place=None, name=None):
+    """reference: layers/io.py double_buffer — the TPU reader pipeline
+    double-buffers device puts internally (reader.py), so this is the
+    identity on an already-wrapped reader."""
+    return reader
+
+
+def load(out, file_path, load_as_fp16=None):
+    """reference: layers/io.py load — load one persistable var's value
+    from an io.save_vars file into the scope var at startup."""
+    from paddle_tpu import io as io_mod
+    from paddle_tpu.layer_helper import LayerHelper
+
+    helper = LayerHelper("load")
+    helper.append_op(
+        type="load", inputs={}, outputs={"Out": [out]},
+        attrs={"file_path": file_path},
+    )
+    return out
+
+
+def read_file(reader):
+    """reference: layers/io.py read_file — the file-reader op family is
+    replaced by host readers feeding the compiled step; use
+    paddle_tpu.reader / fluid_dataset instead."""
+    raise NotImplementedError(
+        "read_file: use paddle_tpu.reader readers or DatasetFactory "
+        "(the TPU input path is host-side, reader.py)"
+    )
+
+
+def open_files(filenames, shapes, lod_levels, dtypes, thread_num=None,
+               buffer_size=None, pass_num=1, is_test=None):
+    """reference: layers/io.py open_files (see read_file)."""
+    raise NotImplementedError(
+        "open_files: use DatasetFactory (fluid_dataset.py) or "
+        "paddle_tpu.reader file readers"
+    )
+
+
+def random_data_generator(low, high, shapes, lod_levels, for_parallel=True):
+    """reference: layers/io.py random_data_generator (see read_file)."""
+    raise NotImplementedError(
+        "random_data_generator: feed numpy batches or use "
+        "layers.uniform_random_batch_size_like inside the program"
+    )
+
+
+class Preprocessor:
+    """reference: layers/io.py Preprocessor — graph-side reader
+    preprocessing; host readers own preprocessing on this build."""
+
+    def __init__(self, reader, name=None):
+        raise NotImplementedError(
+            "Preprocessor: preprocess in the host reader (reader.py) — "
+            "XLA fuses any in-program math anyway"
+        )

@@ -1,6 +1,8 @@
 """Tensor layers (reference: python/paddle/fluid/layers/tensor.py)."""
 from __future__ import annotations
 
+import builtins
+
 import numpy as np
 
 from paddle_tpu import framework
@@ -137,7 +139,7 @@ def split(input, num_or_sections, dim=-1, name=None):
     else:
         attrs = {"num": 0, "axis": dim, "sections": list(num_or_sections)}
         n_out = len(num_or_sections)
-    outs = [helper.create_variable_for_type_inference(input.dtype) for _ in range(n_out)]
+    outs = [helper.create_variable_for_type_inference(input.dtype) for _ in builtins.range(n_out)]
     helper.append_op(type="split", inputs={"X": [input]}, outputs={"Out": outs}, attrs=attrs)
     return outs
 
@@ -278,7 +280,7 @@ def stack(x, axis=0):
 def unstack(x, axis=0, num=None):
     helper = LayerHelper("unstack")
     num = num or x.shape[axis]
-    outs = [helper.create_variable_for_type_inference(x.dtype) for _ in range(num)]
+    outs = [helper.create_variable_for_type_inference(x.dtype) for _ in builtins.range(num)]
     helper.append_op(type="unstack", inputs={"X": [x]}, outputs={"Y": outs}, attrs={"axis": axis, "num": num})
     return outs
 

@@ -995,3 +995,63 @@ def deformable_psroi_pooling(inputs, attrs):
             outs.append(vals.mean(axis=(2, 3)).T)  # [R, od]
     out = jnp.stack(outs, axis=-1).reshape(R, od, ph, pw)
     return {"Output": out, "TopCount": jnp.ones((R, od, ph, pw))}
+
+
+# ---------------------------------------------------------------------------
+# tensor tail: diag, reverse, has_inf/has_nan, print
+# ---------------------------------------------------------------------------
+@register_op("diag")
+def diag(inputs, attrs):
+    """reference: diag_op.cc."""
+    jnp = _jnp()
+    return {"Out": jnp.diag(one(inputs, "Diagonal").reshape(-1))}
+
+
+@register_op("reverse")
+def reverse_op(inputs, attrs):
+    """reference: reverse_op.cc."""
+    jnp = _jnp()
+    x = one(inputs, "X")
+    out = x
+    for ax in attrs.get("axis", [0]):
+        out = jnp.flip(out, axis=int(ax))
+    return {"Out": out}
+
+
+@register_op("has_inf", differentiable=False)
+def has_inf(inputs, attrs):
+    jnp = _jnp()
+    return {"Out": jnp.isinf(one(inputs, "X")).any()}
+
+
+@register_op("has_nan", differentiable=False)
+def has_nan(inputs, attrs):
+    jnp = _jnp()
+    return {"Out": jnp.isnan(one(inputs, "X")).any()}
+
+
+@register_op("print", differentiable=False)
+def print_op(inputs, attrs):
+    """reference: print_op.cc — host-side debug print of the tensor at
+    this position in the step (jax.debug.print keeps it in-graph);
+    forwards the input unchanged."""
+    import jax
+
+    x = one(inputs, "X")
+    msg = attrs.get("message", "") or "print_op"
+    jax.debug.print(msg + " {x}", x=x)
+    return {"Out": x}
+
+
+@register_op("load", differentiable=False)
+def load_op(inputs, attrs):
+    """reference: load_op.cc — fill the output var from a save_vars
+    file.  The file reads at TRACE time (the value becomes a module
+    constant), matching startup-program load-once semantics."""
+    jnp = _jnp()
+    path = attrs["file_path"]
+    try:
+        arr = np.load(path)
+    except FileNotFoundError:
+        arr = np.load(path + ".npy")
+    return {"Out": jnp.asarray(arr)}

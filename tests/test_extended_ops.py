@@ -516,3 +516,87 @@ def test_deformable_roi_pooling_no_trans():
          "pooled_width": 1, "output_dim": 4, "sample_per_part": 4})["Output"]
     np.testing.assert_allclose(np.asarray(out).ravel(), [1, 2, 3, 4],
                                rtol=1e-5)
+
+
+def test_tensor_tail_and_print():
+    rng = np.random.RandomState(14)
+
+    def build():
+        d = fluid.layers.data("d", [4], append_batch_size=False)
+        dg = fluid.layers.diag(d)
+        ey = fluid.layers.eye(3)
+        ls = fluid.layers.linspace(0.0, 1.0, 5)
+        x = fluid.layers.data("x", [2, 3])
+        rv = fluid.layers.reverse(x, axis=1)
+        hi = fluid.layers.has_inf(x)
+        hn = fluid.layers.has_nan(x)
+        pr = fluid.layers.Print(x, message="dbg")
+        return dg, ey, ls, rv, hi, hn, pr
+
+    d = np.array([1.0, 2, 3, 4], "float32")
+    x = rng.rand(2, 2, 3).astype("float32")
+    dg, ey, ls, rv, hi, hn, pr = _run(build, {"d": d, "x": x})
+    np.testing.assert_allclose(np.asarray(dg), np.diag(d))
+    np.testing.assert_allclose(np.asarray(ey), np.eye(3))
+    np.testing.assert_allclose(np.asarray(ls), np.linspace(0, 1, 5), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(rv), x[:, ::-1])
+    assert not bool(np.asarray(hi)) and not bool(np.asarray(hn))
+    np.testing.assert_allclose(np.asarray(pr), x)
+
+
+def test_nets_blocks_compose(tmp_path):
+    """fluid.nets blocks (reference: nets.py) + layers.load round-trip."""
+    rng = np.random.RandomState(15)
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 6
+    with framework.program_guard(prog, startup):
+        img = fluid.layers.data("img", [1, 12, 12])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        conv = fluid.nets.simple_img_conv_pool(
+            img, 4, 3, pool_size=2, pool_stride=2, act="relu")
+        logits = fluid.layers.fc(conv, 4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {"img": rng.rand(8, 1, 12, 12).astype("float32"),
+            "y": rng.randint(0, 4, (8, 1)).astype("int64")}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = [float(np.asarray(exe.run(prog, feed=feed,
+                                           fetch_list=[loss])[0]))
+                  for _ in range(6)]
+    assert losses[-1] < losses[0], losses
+
+    # layers.load: a startup-style program fills a var from a saved file
+    val = rng.rand(3, 2).astype("float32")
+    path = str(tmp_path / "w.npy")
+    np.save(path, val)
+    p2, s2 = framework.Program(), framework.Program()
+    with framework.program_guard(p2, s2):
+        block = p2.global_block()
+        v = block.create_var(name="loaded_w", shape=[3, 2], dtype="float32",
+                             persistable=True)
+        fluid.layers.load(v, path)
+        copy = fluid.layers.assign(v)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(s2)
+        (o,) = exe.run(p2, feed={}, fetch_list=[copy])
+    np.testing.assert_allclose(np.asarray(o), val)
+
+
+def test_reader_decorators_surface():
+    import pytest
+
+    def rdr():
+        for i in range(7):
+            yield [np.full((2,), i, "float32")]
+
+    batched = fluid.layers.batch(fluid.layers.shuffle(rdr, 4), 2)
+    n = sum(1 for _ in batched())
+    assert n >= 3
+    assert fluid.layers.double_buffer(rdr) is rdr
+    with pytest.raises(NotImplementedError):
+        fluid.layers.read_file(None)
+    with pytest.raises(NotImplementedError):
+        fluid.layers.open_files([], [], [], [])
