@@ -15,7 +15,12 @@ __all__ = ["prior_box", "box_coder", "iou_similarity", "yolo_box", "multiclass_n
            "bipartite_match", "target_assign", "yolov3_loss", "ssd_loss",
            "mine_hard_examples", "density_prior_box", "sigmoid_focal_loss",
            "multi_box_head", "detection_output", "rpn_target_assign",
-           "generate_proposals", "detection_map"]
+           "generate_proposals", "detection_map",
+           "polygon_box_transform", "distribute_fpn_proposals",
+           "collect_fpn_proposals", "box_decoder_and_assign",
+           "generate_proposal_labels", "generate_mask_labels",
+           "retinanet_target_assign", "retinanet_detection_output",
+           "roi_perspective_transform"]
 
 
 def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
@@ -581,3 +586,222 @@ def detection_map(detect_res, label, class_num, gt_box=None,
     )
     m.stop_gradient = True
     return m
+
+
+# ---------------------------------------------------------------------------
+# FPN / Mask R-CNN / RetinaNet tail (reference: layers/detection.py
+# distribute_fpn_proposals, collect_fpn_proposals, box_decoder_and_assign,
+# generate_proposal_labels:2148, generate_mask_labels,
+# retinanet_target_assign, retinanet_detection_output,
+# polygon_box_transform, roi_perspective_transform)
+# ---------------------------------------------------------------------------
+def polygon_box_transform(input, name=None):
+    helper = LayerHelper("polygon_box_transform", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="polygon_box_transform", inputs={"Input": [input]},
+                     outputs={"Output": [out]}, attrs={})
+    return out
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, name=None, rois_num=None):
+    """Returns ([rois_level_min..max], restore_index); each level tensor
+    is the full padded shape with its real count packed to the top (the
+    ``.level_counts`` attr var holds the counts)."""
+    helper = LayerHelper("distribute_fpn_proposals", name=name)
+    n = max_level - min_level + 1
+    outs = {"MultiFpnRois%d" % i: [helper.create_variable_for_type_inference(
+        fpn_rois.dtype)] for i in range(n)}
+    restore = helper.create_variable_for_type_inference("int32")
+    counts = helper.create_variable_for_type_inference("int32")
+    outs["RestoreIndex"] = [restore]
+    outs["LevelCounts"] = [counts]
+    ins = {"FpnRois": [fpn_rois]}
+    if rois_num is not None:
+        ins["RoisNum"] = [rois_num]
+    helper.append_op(
+        type="distribute_fpn_proposals", inputs=ins, outputs=outs,
+        attrs={"min_level": int(min_level), "max_level": int(max_level),
+               "refer_level": int(refer_level), "refer_scale": int(refer_scale)},
+    )
+    multi = [outs["MultiFpnRois%d" % i][0] for i in range(n)]
+    for v in multi:
+        v.stop_gradient = True
+        v.level_counts = counts
+    restore.stop_gradient = True
+    return multi, restore
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, name=None):
+    helper = LayerHelper("collect_fpn_proposals", name=name)
+    out = helper.create_variable_for_type_inference(multi_rois[0].dtype)
+    num = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="collect_fpn_proposals",
+        inputs={"MultiLevelRois": list(multi_rois),
+                "MultiLevelScores": list(multi_scores)},
+        outputs={"FpnRois": [out], "RoisNum": [num]},
+        attrs={"post_nms_topN": int(post_nms_top_n)},
+    )
+    out.stop_gradient = True
+    out.rois_num = num
+    return out
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip, name=None):
+    helper = LayerHelper("box_decoder_and_assign", name=name)
+    decoded = helper.create_variable_for_type_inference(prior_box.dtype)
+    assigned = helper.create_variable_for_type_inference(prior_box.dtype)
+    helper.append_op(
+        type="box_decoder_and_assign",
+        inputs={"PriorBox": [prior_box], "PriorBoxVar": [prior_box_var],
+                "TargetBox": [target_box], "BoxScore": [box_score]},
+        outputs={"DecodeBox": [decoded], "OutputAssignBox": [assigned]},
+        attrs={"box_clip": float(box_clip)},
+    )
+    return decoded, assigned
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256, fg_fraction=0.25,
+                             fg_thresh=0.25, bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=[0.1, 0.1, 0.2, 0.2],
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False):
+    """reference: layers/detection.py:2148.  Single-image static-shape
+    sampler; returns (rois, labels_int32, bbox_targets,
+    bbox_inside_weights, bbox_outside_weights); the matched-gt index var
+    rides on ``rois.matched_gt`` for generate_mask_labels."""
+    from paddle_tpu import framework as fw
+
+    helper = LayerHelper("generate_proposal_labels")
+    prog = helper.main_program
+    outs = {
+        s: [helper.create_variable_for_type_inference(
+            "int32" if "Int" in s or s == "MatchedGtIndex" else rpn_rois.dtype)]
+        for s in ["Rois", "LabelsInt32", "BboxTargets", "BboxInsideWeights",
+                  "BboxOutsideWeights", "MatchedGtIndex"]
+    }
+    ins = {"RpnRois": [rpn_rois], "GtClasses": [gt_classes],
+           "GtBoxes": [gt_boxes]}
+    if is_crowd is not None:
+        ins["IsCrowd"] = [is_crowd]
+    if im_info is not None:
+        ins["ImInfo"] = [im_info]
+    helper.append_op(
+        type="generate_proposal_labels", inputs=ins, outputs=outs,
+        attrs={"batch_size_per_im": int(batch_size_per_im),
+               "fg_fraction": float(fg_fraction), "fg_thresh": float(fg_thresh),
+               "bg_thresh_hi": float(bg_thresh_hi),
+               "bg_thresh_lo": float(bg_thresh_lo),
+               "bbox_reg_weights": list(bbox_reg_weights),
+               "class_nums": int(class_nums or 81),
+               "use_random": bool(use_random),
+               "is_cls_agnostic": bool(is_cls_agnostic),
+               "seed": prog.next_seed()},
+    )
+    rois = outs["Rois"][0]
+    for slot in outs:
+        outs[slot][0].stop_gradient = True
+    rois.matched_gt = outs["MatchedGtIndex"][0]
+    return (rois, outs["LabelsInt32"][0], outs["BboxTargets"][0],
+            outs["BboxInsideWeights"][0], outs["BboxOutsideWeights"][0])
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution):
+    """reference: layers/detection.py generate_mask_labels.  DIVERGENCE:
+    ``gt_segms`` is a [G, Hm, Wm] binary-mask tensor (rasterize COCO
+    polygons host-side), not a polygon LoD; ``rois`` must come from
+    generate_proposal_labels (carries .matched_gt)."""
+    helper = LayerHelper("generate_mask_labels")
+    mask_rois = helper.create_variable_for_type_inference(rois.dtype)
+    has_mask = helper.create_variable_for_type_inference("int32")
+    mask_int32 = helper.create_variable_for_type_inference("int32")
+    matched = getattr(rois, "matched_gt", None)
+    if matched is None:
+        raise ValueError(
+            "generate_mask_labels needs rois from generate_proposal_labels "
+            "(the matched-gt index rides on the rois var)")
+    ins = {"Rois": [rois], "LabelsInt32": [labels_int32],
+           "MatchedGtIndex": [matched], "GtSegms": [gt_segms]}
+    if im_info is not None:
+        ins["ImInfo"] = [im_info]
+    helper.append_op(
+        type="generate_mask_labels", inputs=ins,
+        outputs={"MaskRois": [mask_rois], "RoiHasMaskInt32": [has_mask],
+                 "MaskInt32": [mask_int32]},
+        attrs={"resolution": int(resolution), "num_classes": int(num_classes)},
+    )
+    for v in (mask_rois, has_mask, mask_int32):
+        v.stop_gradient = True
+    return mask_rois, has_mask, mask_int32
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd, im_info,
+                            num_classes=1, positive_overlap=0.5,
+                            negative_overlap=0.4):
+    """reference: layers/detection.py retinanet_target_assign.  Padded
+    analog: returns full-anchor masks (score labels, class targets,
+    bbox targets, inside weights, fg count) instead of gathered compact
+    tensors — see rpn_target_assign's docstring."""
+    helper = LayerHelper("retinanet_target_assign")
+    score_idx = helper.create_variable_for_type_inference("int32")
+    tgt_lbl = helper.create_variable_for_type_inference("int32")
+    tgt_bbox = helper.create_variable_for_type_inference(anchor_box.dtype)
+    in_w = helper.create_variable_for_type_inference(anchor_box.dtype)
+    s_w = helper.create_variable_for_type_inference(anchor_box.dtype)
+    fg_num = helper.create_variable_for_type_inference("int32")
+    ins = {"Anchor": [anchor_box], "GtBoxes": [gt_boxes]}
+    if gt_labels is not None:
+        ins["GtLabels"] = [gt_labels]
+    helper.append_op(
+        type="retinanet_target_assign", inputs=ins,
+        outputs={"ScoreIndex": [score_idx], "TargetLabel": [tgt_lbl],
+                 "TargetBBox": [tgt_bbox], "BBoxInsideWeight": [in_w],
+                 "ScoreWeight": [s_w], "ForegroundNumber": [fg_num]},
+        attrs={"positive_overlap": float(positive_overlap),
+               "negative_overlap": float(negative_overlap)},
+    )
+    for v in (score_idx, tgt_lbl, tgt_bbox, in_w, s_w, fg_num):
+        v.stop_gradient = True
+    return score_idx, tgt_lbl, tgt_bbox, in_w, s_w, fg_num
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info=None,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    """reference: layers/detection.py retinanet_detection_output."""
+    helper = LayerHelper("retinanet_detection_output")
+    out = helper.create_variable_for_type_inference(bboxes[0].dtype)
+    helper.append_op(
+        type="retinanet_detection_output",
+        inputs={"BBoxes": list(bboxes), "Scores": list(scores),
+                "Anchors": list(anchors)},
+        outputs={"Out": [out]},
+        attrs={"score_threshold": float(score_threshold),
+               "nms_top_k": int(nms_top_k), "keep_top_k": int(keep_top_k),
+               "nms_threshold": float(nms_threshold)},
+    )
+    out.stop_gradient = True
+    return out
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0):
+    """reference: layers/detection.py roi_perspective_transform."""
+    helper = LayerHelper("roi_perspective_transform")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="roi_perspective_transform",
+        inputs={"X": [input], "ROIs": [rois]},
+        outputs={"Out": [out]},
+        attrs={"transformed_height": int(transformed_height),
+               "transformed_width": int(transformed_width),
+               "spatial_scale": float(spatial_scale)},
+    )
+    return out

@@ -1096,3 +1096,470 @@ def detection_map(inputs, attrs):
     aps, has = jax.vmap(for_class)(jnp.arange(class_num))
     m_ap = jnp.sum(aps) / jnp.maximum(jnp.sum(has), 1.0)
     return {"MAP": m_ap.reshape(1)}
+
+
+# ---------------------------------------------------------------------------
+# FPN / Mask R-CNN / RetinaNet tail (reference: operators/detection/
+# polygon_box_transform_op.cc, distribute_fpn_proposals_op.cc,
+# collect_fpn_proposals_op.cc, box_decoder_and_assign_op.cc,
+# generate_proposal_labels_op.cc, generate_mask_labels_op.cc,
+# retinanet_target_assign (rpn_target_assign_op.cc variant),
+# retinanet_detection_output_op.cc, roi_perspective_transform_op.cc)
+# ---------------------------------------------------------------------------
+@register_op("polygon_box_transform", differentiable=False)
+def polygon_box_transform(inputs, attrs):
+    """reference: polygon_box_transform_op.cc — EAST geo-map decode:
+    even channels hold x-offsets (out = 4*w - in), odd channels
+    y-offsets (out = 4*h - in)."""
+    jnp = _jnp()
+    x = one(inputs, "Input")  # [N, G, H, W]
+    N, G, H, W = x.shape
+    wcoord = 4.0 * jnp.arange(W, dtype=x.dtype)[None, None, None, :]
+    hcoord = 4.0 * jnp.arange(H, dtype=x.dtype)[None, None, :, None]
+    even = (jnp.arange(G) % 2 == 0)[None, :, None, None]
+    return {"Output": jnp.where(even, wcoord - x, hcoord - x)}
+
+
+@register_op("distribute_fpn_proposals", differentiable=False)
+def distribute_fpn_proposals(inputs, attrs):
+    """reference: distribute_fpn_proposals_op.cc — route each roi to
+    level clip(floor(refer + log2(sqrt(area)/refer_scale)), min, max).
+    Static-shape analog: every level output is [R, 4] with that level's
+    rois packed to the top (RoisNum<level> counts the real rows);
+    RestoreIndex maps the level-concatenated packed order back to the
+    original order."""
+    jnp = _jnp()
+    rois = one(inputs, "FpnRois")  # [R, 4]
+    valid = maybe(inputs, "RoisNum")
+    min_l = int(attrs["min_level"])
+    max_l = int(attrs["max_level"])
+    refer_l = int(attrs["refer_level"])
+    refer_s = int(attrs["refer_scale"])
+    R = rois.shape[0]
+    n_levels = max_l - min_l + 1
+    w = jnp.maximum(rois[:, 2] - rois[:, 0] + 1.0, 0.0)
+    h = jnp.maximum(rois[:, 3] - rois[:, 1] + 1.0, 0.0)
+    scale = jnp.sqrt(w * h)
+    is_valid = (jnp.arange(R) < valid.reshape(())) if valid is not None \
+        else (w * h > 1e-6)
+    lvl = jnp.floor(refer_l + jnp.log2(scale / refer_s + 1e-6))
+    lvl = jnp.clip(lvl, min_l, max_l).astype("int32")
+    outs = {}
+    counts = []
+    restore_src = []
+    for li in range(n_levels):
+        mask = (lvl == min_l + li) & is_valid
+        order = jnp.argsort((~mask).astype("int32"), stable=True)
+        packed = jnp.where(
+            (jnp.arange(R) < mask.sum())[:, None], rois[order], 0.0)
+        outs["MultiFpnRois%d" % li] = packed
+        counts.append(mask.sum().astype("int32"))
+        restore_src.append(jnp.where(jnp.arange(R) < mask.sum(), order, R))
+    outs["LevelCounts"] = jnp.stack(counts)
+    # restore index: for each original roi, its position in the packed
+    # concatenation (levels stacked with their own padding stripped is
+    # dynamic; we emit positions within the PADDED concat instead)
+    concat_src = jnp.concatenate(restore_src)  # [n_levels*R] original idx or R
+    restore = jnp.full((R,), -1, "int32")
+    pos = jnp.arange(n_levels * R, dtype="int32")
+    restore = restore.at[jnp.clip(concat_src, 0, R - 1)].max(
+        jnp.where(concat_src < R, pos, -1))
+    outs["RestoreIndex"] = restore.reshape(-1, 1)
+    return outs
+
+
+@register_op("collect_fpn_proposals", differentiable=False)
+def collect_fpn_proposals(inputs, attrs):
+    """reference: collect_fpn_proposals_op.cc — concat per-level
+    (rois, scores), keep the post_nms_topN highest-scoring (padding
+    rows carry score -inf)."""
+    jnp = _jnp()
+    rois = jnp.concatenate(inputs["MultiLevelRois"], axis=0)
+    scores = jnp.concatenate(
+        [s.reshape(-1) for s in inputs["MultiLevelScores"]], axis=0)
+    topn = int(attrs["post_nms_topN"])
+    area = (rois[:, 2] - rois[:, 0]) * (rois[:, 3] - rois[:, 1])
+    scores = jnp.where(area > 1e-6, scores, -jnp.inf)
+    import jax
+
+    top_s, idx = jax.lax.top_k(scores, min(topn, scores.shape[0]))
+    keep = top_s > -jnp.inf
+    return {"FpnRois": jnp.where(keep[:, None], rois[idx], 0.0),
+            "RoisNum": keep.sum().astype("int32")}
+
+
+@register_op("box_decoder_and_assign", differentiable=False)
+def box_decoder_and_assign(inputs, attrs):
+    """reference: box_decoder_and_assign_op.h — decode per-class deltas
+    [R, 4C] against PriorBox with variances, clip, then assign each roi
+    the box of its argmax-score class (background column 0 excluded)."""
+    jnp = _jnp()
+    prior = one(inputs, "PriorBox")  # [R, 4]
+    pvar = one(inputs, "PriorBoxVar").reshape(-1)  # [4]
+    tb = one(inputs, "TargetBox")  # [R, 4C]
+    score = one(inputs, "BoxScore")  # [R, C]
+    clip = attrs.get("box_clip", 4.135166556742356)
+    R = tb.shape[0]
+    C = tb.shape[1] // 4
+    pw = prior[:, 2] - prior[:, 0] + 1.0
+    ph = prior[:, 3] - prior[:, 1] + 1.0
+    px = prior[:, 0] + pw * 0.5
+    py = prior[:, 1] + ph * 0.5
+    d = tb.reshape(R, C, 4) * pvar[None, None, :]
+    dx, dy, dw, dh = d[..., 0], d[..., 1], d[..., 2], d[..., 3]
+    dw = jnp.minimum(dw, clip)
+    dh = jnp.minimum(dh, clip)
+    cx = px[:, None] + dx * pw[:, None]
+    cy = py[:, None] + dy * ph[:, None]
+    w = jnp.exp(dw) * pw[:, None]
+    h = jnp.exp(dh) * ph[:, None]
+    boxes = jnp.stack([cx - w / 2, cy - h / 2,
+                       cx + w / 2 - 1.0, cy + h / 2 - 1.0], axis=-1)
+    best = jnp.argmax(score[:, 1:], axis=1) + 1  # skip background col
+    assign = jnp.take_along_axis(
+        boxes, best[:, None, None].astype("int32").repeat(4, -1), axis=1
+    ).squeeze(1)
+    return {"DecodeBox": boxes.reshape(R, C * 4), "OutputAssignBox": assign}
+
+
+@register_op("generate_proposal_labels", differentiable=False)
+def generate_proposal_labels(inputs, attrs):
+    """reference: generate_proposal_labels_op.cc — the Fast R-CNN
+    fg/bg sampler.  Static-shape analog (single image): rois+gt merge,
+    IoU match, sample fg (iou>=fg_thresh) up to fg_fraction*B and bg
+    (bg_lo<=iou<bg_hi) to fill B = batch_size_per_im; random sampling
+    uses the op's seed, use_random=False takes highest-IoU first.
+    Outputs are [B, ...] with Labels -1 on unfilled slots."""
+    import jax
+
+    jnp = _jnp()
+    rois = one(inputs, "RpnRois")  # [R, 4]
+    gt_classes = one(inputs, "GtClasses").reshape(-1)  # [G]
+    is_crowd = maybe(inputs, "IsCrowd")
+    gt_boxes = one(inputs, "GtBoxes")  # [G, 4]
+    B = int(attrs.get("batch_size_per_im", 256))
+    fg_fraction = float(attrs.get("fg_fraction", 0.25))
+    fg_thresh = float(attrs.get("fg_thresh", 0.25))
+    bg_hi = float(attrs.get("bg_thresh_hi", 0.5))
+    bg_lo = float(attrs.get("bg_thresh_lo", 0.0))
+    weights = attrs.get("bbox_reg_weights", [0.1, 0.1, 0.2, 0.2])
+    class_nums = int(attrs.get("class_nums", 81))
+    use_random = attrs.get("use_random", True)
+    fg_max = int(B * fg_fraction)
+
+    all_rois = jnp.concatenate([rois, gt_boxes], axis=0)  # [R+G, 4]
+    valid_roi = (all_rois[:, 2] - all_rois[:, 0] > 1e-6) & (
+        all_rois[:, 3] - all_rois[:, 1] > 1e-6)
+    valid_gt = (gt_boxes[:, 2] - gt_boxes[:, 0] > 1e-6) & (
+        gt_boxes[:, 3] - gt_boxes[:, 1] > 1e-6)
+    if is_crowd is not None:
+        valid_gt = valid_gt & (is_crowd.reshape(-1) == 0)
+
+    def iou(a, b):
+        ix = jnp.minimum(a[:, None, 2], b[None, :, 2]) - jnp.maximum(
+            a[:, None, 0], b[None, :, 0]) + 1.0
+        iy = jnp.minimum(a[:, None, 3], b[None, :, 3]) - jnp.maximum(
+            a[:, None, 1], b[None, :, 1]) + 1.0
+        inter = jnp.maximum(ix, 0.0) * jnp.maximum(iy, 0.0)
+        aa = (a[:, 2] - a[:, 0] + 1) * (a[:, 3] - a[:, 1] + 1)
+        bb = (b[:, 2] - b[:, 0] + 1) * (b[:, 3] - b[:, 1] + 1)
+        return inter / jnp.maximum(aa[:, None] + bb[None, :] - inter, 1e-6)
+
+    overlaps = jnp.where(valid_gt[None, :], iou(all_rois, gt_boxes), -1.0)
+    max_iou = overlaps.max(axis=1)
+    argmax_gt = overlaps.argmax(axis=1)
+    fg_mask = (max_iou >= fg_thresh) & valid_roi
+    bg_mask = (max_iou < bg_hi) & (max_iou >= bg_lo) & valid_roi & ~fg_mask
+
+    if use_random:
+        key = jax.random.key(np.uint32(int(attrs.get("seed", 0)) or 12345))
+        k1, k2 = jax.random.split(key)
+        fg_pri = jnp.where(fg_mask, jax.random.uniform(k1, fg_mask.shape), -1.0)
+        bg_pri = jnp.where(bg_mask, jax.random.uniform(k2, bg_mask.shape), -1.0)
+    else:
+        fg_pri = jnp.where(fg_mask, max_iou, -1.0)
+        bg_pri = jnp.where(bg_mask, 1.0 - max_iou, -1.0)
+    n_cand = int(fg_pri.shape[0])
+    bg_needed = B - fg_max
+
+    def take(pri, k):
+        # top-k capped at the candidate count, padded to k slots
+        kk = min(k, n_cand)
+        vals, idx = jax.lax.top_k(pri, kk)
+        if kk < k:
+            vals = jnp.concatenate([vals, jnp.full((k - kk,), -1.0)])
+            idx = jnp.concatenate([idx, jnp.zeros((k - kk,), idx.dtype)])
+        return idx, vals > 0
+
+    fg_idx, fg_take = take(fg_pri, fg_max)
+    bg_idx, bg_take = take(bg_pri, bg_needed)
+    # final layout: [fg slots (fg_max), bg slots (B - fg_max)]
+    sel_idx = jnp.concatenate([fg_idx, bg_idx])
+    sel_is_fg = jnp.concatenate([fg_take, jnp.zeros((bg_needed,), bool)])
+    sel_valid = jnp.concatenate([fg_take, bg_take])
+    out_rois = jnp.where(sel_valid[:, None], all_rois[sel_idx], 0.0)
+    matched = argmax_gt[sel_idx]
+    labels = jnp.where(
+        sel_is_fg, gt_classes[matched].astype("int32"), 0)
+    labels = jnp.where(sel_valid, labels, -1)
+
+    # bbox regression targets for fg slots (encode_center_size with the
+    # reg weights), scattered into the per-class layout
+    g = gt_boxes[matched]
+    pw = out_rois[:, 2] - out_rois[:, 0] + 1.0
+    ph = out_rois[:, 3] - out_rois[:, 1] + 1.0
+    px = out_rois[:, 0] + pw * 0.5
+    py = out_rois[:, 1] + ph * 0.5
+    gw = g[:, 2] - g[:, 0] + 1.0
+    gh = g[:, 3] - g[:, 1] + 1.0
+    gx = g[:, 0] + gw * 0.5
+    gy = g[:, 1] + gh * 0.5
+    wts = jnp.asarray(weights, out_rois.dtype)
+    t = jnp.stack([
+        (gx - px) / jnp.maximum(pw, 1.0) / wts[0],
+        (gy - py) / jnp.maximum(ph, 1.0) / wts[1],
+        jnp.log(jnp.maximum(gw, 1.0) / jnp.maximum(pw, 1.0)) / wts[2],
+        jnp.log(jnp.maximum(gh, 1.0) / jnp.maximum(ph, 1.0)) / wts[3],
+    ], axis=1)  # [B, 4]
+    ncls = 1 if attrs.get("is_cls_agnostic", False) else class_nums
+    cls_slot = jnp.where(attrs.get("is_cls_agnostic", False), 1, labels)
+    bbox_targets = jnp.zeros((B, 4 * ncls), out_rois.dtype)
+    col = jnp.clip(cls_slot, 0, ncls - 1) * 4
+    rows = jnp.arange(B)
+    for k in range(4):
+        bbox_targets = bbox_targets.at[rows, col + k].set(
+            jnp.where(sel_is_fg, t[:, k], 0.0))
+    inside_w = jnp.zeros_like(bbox_targets)
+    for k in range(4):
+        inside_w = inside_w.at[rows, col + k].set(
+            jnp.where(sel_is_fg, 1.0, 0.0))
+    return {
+        "Rois": out_rois,
+        "LabelsInt32": labels,
+        "BboxTargets": bbox_targets,
+        "BboxInsideWeights": inside_w,
+        "BboxOutsideWeights": inside_w,
+        "MatchedGtIndex": jnp.where(sel_is_fg, matched, -1).astype("int32"),
+    }
+
+
+@register_op("generate_mask_labels", differentiable=False)
+def generate_mask_labels(inputs, attrs):
+    """reference: generate_mask_labels_op.cc.  Divergence (documented):
+    ground-truth segmentations arrive as BINARY MASKS GtSegms
+    [G, Hm, Wm] aligned to the image extent (the reference takes COCO
+    polygons via LoD — rasterize host-side first); each fg roi crops its
+    matched gt's mask and bilinear-resizes to resolution^2, thresholded
+    at 0.5, scattered into the per-class layout."""
+    jnp = _jnp()
+    rois = one(inputs, "Rois")  # [B, 4]
+    labels = one(inputs, "LabelsInt32").reshape(-1)  # [B]
+    matched = one(inputs, "MatchedGtIndex").reshape(-1)  # [B]
+    segms = one(inputs, "GtSegms")  # [G, Hm, Wm] float 0/1
+    im_info = maybe(inputs, "ImInfo")
+    M = int(attrs.get("resolution", 14))
+    num_classes = int(attrs.get("num_classes", 81))
+    B = rois.shape[0]
+    G, Hm, Wm = segms.shape
+    if im_info is not None:
+        sy = Hm / im_info.reshape(-1)[0]
+        sx = Wm / im_info.reshape(-1)[1]
+    else:
+        sy = sx = 1.0
+    is_fg = labels > 0
+    gidx = jnp.clip(matched, 0, G - 1)
+    ys = (rois[:, 1] * sy)[:, None] + (
+        (rois[:, 3] - rois[:, 1]) * sy)[:, None] * (
+        (jnp.arange(M) + 0.5) / M)[None, :]
+    xs = (rois[:, 0] * sx)[:, None] + (
+        (rois[:, 2] - rois[:, 0]) * sx)[:, None] * (
+        (jnp.arange(M) + 0.5) / M)[None, :]
+    yi = jnp.clip(ys, 0, Hm - 1).astype("int32")  # nearest sample
+    xi = jnp.clip(xs, 0, Wm - 1).astype("int32")
+    crop = segms[gidx[:, None, None], yi[:, :, None], xi[:, None, :]]
+    mask = (crop >= 0.5).astype("int32")  # [B, M, M]
+    # per-class scatter: class c occupies [c*M*M, (c+1)*M*M)
+    flat = mask.reshape(B, M * M)
+    cls = jnp.clip(labels, 0, num_classes - 1)
+    out = jnp.full((B, num_classes * M * M), -1, "int32")
+    rows = jnp.arange(B)[:, None]
+    cols = cls[:, None] * (M * M) + jnp.arange(M * M)[None, :]
+    out = out.at[rows, cols].set(jnp.where(is_fg[:, None], flat, -1))
+    return {
+        "MaskRois": jnp.where(is_fg[:, None], rois, 0.0),
+        "RoiHasMaskInt32": is_fg.astype("int32"),
+        "MaskInt32": out,
+    }
+
+
+@register_op("retinanet_target_assign", differentiable=False)
+def retinanet_target_assign(inputs, attrs):
+    """reference: retinanet_target_assign (rpn_target_assign_op.cc:577
+    variant) — every anchor labels fg (iou>=positive_overlap) or bg
+    (max_iou<negative_overlap), no subsampling (focal loss handles the
+    imbalance), plus ForegroundNumber for the loss normalizer."""
+    jnp = _jnp()
+    anchor = one(inputs, "Anchor")  # [A, 4]
+    gt = one(inputs, "GtBoxes")  # [N, B, 4]
+    gt_labels = maybe(inputs, "GtLabels")  # [N, B]
+    pos = float(attrs.get("positive_overlap", 0.5))
+    neg = float(attrs.get("negative_overlap", 0.4))
+    A = anchor.shape[0]
+    N = gt.shape[0]
+    valid_gt = (gt[..., 2] - gt[..., 0] > 1e-6) & (gt[..., 3] - gt[..., 1] > 1e-6)
+
+    def iou(a, b):
+        ix = jnp.minimum(a[:, None, 2], b[None, :, 2]) - jnp.maximum(
+            a[:, None, 0], b[None, :, 0]) + 1.0
+        iy = jnp.minimum(a[:, None, 3], b[None, :, 3]) - jnp.maximum(
+            a[:, None, 1], b[None, :, 1]) + 1.0
+        inter = jnp.maximum(ix, 0.0) * jnp.maximum(iy, 0.0)
+        aa = (a[:, 2] - a[:, 0] + 1) * (a[:, 3] - a[:, 1] + 1)
+        bb = (b[:, 2] - b[:, 0] + 1) * (b[:, 3] - b[:, 1] + 1)
+        return inter / jnp.maximum(aa[:, None] + bb[None, :] - inter, 1e-6)
+
+    def per_image(gt_i, valid_i, lab_i):
+        ov = jnp.where(valid_i[None, :], iou(anchor, gt_i), -1.0)
+        max_iou = ov.max(axis=1)
+        arg = ov.argmax(axis=1)
+        fg = max_iou >= pos
+        bg = (max_iou < neg) & ~fg
+        label = jnp.where(fg, 1, jnp.where(bg, 0, -1))
+        cls = jnp.where(
+            fg, (lab_i[arg] if lab_i is not None else jnp.ones_like(arg)), -1)
+        g = gt_i[arg]
+        pw = anchor[:, 2] - anchor[:, 0] + 1.0
+        ph = anchor[:, 3] - anchor[:, 1] + 1.0
+        px = anchor[:, 0] + pw * 0.5
+        py = anchor[:, 1] + ph * 0.5
+        gw = g[:, 2] - g[:, 0] + 1.0
+        gh = g[:, 3] - g[:, 1] + 1.0
+        gx = g[:, 0] + gw * 0.5
+        gy = g[:, 1] + gh * 0.5
+        t = jnp.stack([(gx - px) / pw, (gy - py) / ph,
+                       jnp.log(gw / pw), jnp.log(gh / ph)], axis=1)
+        return label, cls.astype("int32"), t, fg.sum().astype("int32")
+
+    import jax
+
+    labels, cls, tgt, fg_num = jax.vmap(
+        per_image, in_axes=(0, 0, 0 if gt_labels is not None else None)
+    )(gt, valid_gt, gt_labels)
+    weight = (labels >= 0).astype("float32")
+    return {
+        "ScoreIndex": labels,  # [N, A] 1 fg / 0 bg / -1 ignore
+        "TargetLabel": cls,  # [N, A] class id for fg, -1 otherwise
+        "TargetBBox": tgt,  # [N, A, 4]
+        "BBoxInsideWeight": (labels == 1).astype("float32")[..., None] *
+                            jnp.ones((1, 1, 4), "float32"),
+        "ScoreWeight": weight,
+        "ForegroundNumber": jnp.maximum(fg_num, 1).reshape(N, 1),
+    }
+
+
+@register_op("retinanet_detection_output", differentiable=False)
+def retinanet_detection_output(inputs, attrs):
+    """reference: retinanet_detection_output_op.cc — decode per-level
+    (bbox, score) against per-level anchors, keep nms_top_k by score,
+    then class-wise NMS to keep_top_k (delegates to the multiclass_nms
+    kernel on the merged candidates)."""
+    jnp = _jnp()
+    bboxes = inputs["BBoxes"]  # list of [A_l, 4] deltas... merged below
+    scores = inputs["Scores"]  # list of [A_l, C] sigmoid scores
+    anchors = inputs["Anchors"]  # list of [A_l, 4]
+    score_thresh = attrs.get("score_threshold", 0.05)
+    nms_thresh = attrs.get("nms_threshold", 0.3)
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+    decoded = []
+    for d, a in zip(bboxes, anchors):
+        pw = a[:, 2] - a[:, 0] + 1.0
+        ph = a[:, 3] - a[:, 1] + 1.0
+        px = a[:, 0] + pw * 0.5
+        py = a[:, 1] + ph * 0.5
+        cx = px + d[:, 0] * pw
+        cy = py + d[:, 1] * ph
+        w = jnp.exp(d[:, 2]) * pw
+        h = jnp.exp(d[:, 3]) * ph
+        decoded.append(jnp.stack(
+            [cx - w / 2, cy - h / 2,
+             cx + w / 2 - 1.0, cy + h / 2 - 1.0], axis=1))
+    allb = jnp.concatenate(decoded, axis=0)  # [A, 4]
+    alls = jnp.concatenate(scores, axis=0)  # [A, C]
+    from paddle_tpu.core.registry import get_kernel
+
+    nms = get_kernel("multiclass_nms")
+    out = nms(
+        {"BBoxes": [allb[None]], "Scores": [alls.T[None]]},
+        {"score_threshold": score_thresh, "nms_threshold": nms_thresh,
+         "keep_top_k": keep_top_k, "nms_top_k": int(attrs.get("nms_top_k", 1000)),
+         "background_label": -1, "normalized": False},
+    )
+    return {"Out": out["Out"]}
+
+
+@register_op("roi_perspective_transform", no_grad_set={"ROIs"})
+def roi_perspective_transform(inputs, attrs):
+    """reference: roi_perspective_transform_op.cc — warp each quad ROI
+    [x1..y4] (clockwise from top-left) to a [transformed_height,
+    transformed_width] patch: per-roi homography from the 4 point
+    pairs (vmapped linear solve) + bilinear sampling."""
+    import jax
+
+    jnp = _jnp()
+    x = one(inputs, "X")  # [1, C, H, W]
+    rois = one(inputs, "ROIs")  # [R, 8]
+    th = int(attrs["transformed_height"])
+    tw = int(attrs["transformed_width"])
+    scale = attrs.get("spatial_scale", 1.0)
+    N, C, H, W = x.shape
+    quad = rois.reshape(-1, 4, 2) * scale  # [(R), (tl,tr,br,bl), (x,y)]
+    dst = jnp.asarray(
+        [[0.0, 0.0], [tw - 1.0, 0.0], [tw - 1.0, th - 1.0], [0.0, th - 1.0]])
+
+    def homography(src):
+        # solve dst -> src mapping: 8 equations a*h = b
+        rows = []
+        bs = []
+        for i in range(4):
+            X, Y = dst[i, 0], dst[i, 1]
+            u, v = src[i, 0], src[i, 1]
+            rows.append(jnp.stack(
+                [X, Y, jnp.asarray(1.0), jnp.asarray(0.0), jnp.asarray(0.0),
+                 jnp.asarray(0.0), -u * X, -u * Y]))
+            bs.append(u)
+            rows.append(jnp.stack(
+                [jnp.asarray(0.0), jnp.asarray(0.0), jnp.asarray(0.0),
+                 X, Y, jnp.asarray(1.0), -v * X, -v * Y]))
+            bs.append(v)
+        Amat = jnp.stack(rows)
+        bvec = jnp.stack(bs)
+        h = jnp.linalg.solve(Amat, bvec)
+        return jnp.concatenate([h, jnp.ones((1,))]).reshape(3, 3)
+
+    Hmats = jax.vmap(homography)(quad)  # [R, 3, 3]
+    gy, gx = jnp.meshgrid(jnp.arange(th, dtype=jnp.float32),
+                          jnp.arange(tw, dtype=jnp.float32), indexing="ij")
+    ones = jnp.ones_like(gx)
+    grid = jnp.stack([gx, gy, ones], axis=-1).reshape(-1, 3)  # [th*tw, 3]
+
+    def warp(Hm):
+        src = grid @ Hm.T  # [th*tw, 3]
+        sx = src[:, 0] / jnp.maximum(src[:, 2], 1e-6)
+        sy = src[:, 1] / jnp.maximum(src[:, 2], 1e-6)
+        x0 = jnp.floor(sx)
+        y0 = jnp.floor(sy)
+        wx = sx - x0
+        wy = sy - y0
+
+        def g(yi, xi):
+            inb = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+            yc = jnp.clip(yi, 0, H - 1).astype("int32")
+            xc = jnp.clip(xi, 0, W - 1).astype("int32")
+            return x[0][:, yc, xc] * inb  # [C, th*tw]
+
+        v = (g(y0, x0) * (1 - wy) * (1 - wx) + g(y0, x0 + 1) * (1 - wy) * wx
+             + g(y0 + 1, x0) * wy * (1 - wx) + g(y0 + 1, x0 + 1) * wy * wx)
+        return v.reshape(C, th, tw)
+
+    out = jax.vmap(warp)(Hmats)  # [R, C, th, tw]
+    return {"Out": out}

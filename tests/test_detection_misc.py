@@ -147,3 +147,181 @@ def test_nan_inf_flag(monkeypatch):
 
         with pytest.raises(RuntimeError, match="nan/inf"):
             exe.run(prog, feed={"x": np.array([[-1.0, 1.0]], "float32")}, fetch_list=[out])
+
+
+def test_polygon_box_transform():
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import registry
+
+    x = np.zeros((1, 4, 2, 3), "float32")
+    out = np.asarray(registry.get_kernel("polygon_box_transform")(
+        {"Input": [jnp.asarray(x)]}, {})["Output"])
+    # even channels: 4*w; odd: 4*h
+    np.testing.assert_allclose(out[0, 0], [[0, 4, 8], [0, 4, 8]])
+    np.testing.assert_allclose(out[0, 1], [[0, 0, 0], [4, 4, 4]])
+
+
+def test_fpn_distribute_and_collect_roundtrip():
+    """distribute routes by sqrt(area) level; collect merges by score."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import registry
+
+    # areas 224^2 -> level 4 (refer), 112^2 -> level 3, 448^2 -> level 5
+    rois = np.array([
+        [0, 0, 223, 223],
+        [0, 0, 111, 111],
+        [0, 0, 447, 447],
+        [0, 0, 223, 223],
+    ], "float32")
+    outs = registry.get_kernel("distribute_fpn_proposals")(
+        {"FpnRois": [jnp.asarray(rois)]},
+        {"min_level": 2, "max_level": 5, "refer_level": 4, "refer_scale": 224})
+    counts = np.asarray(outs["LevelCounts"])
+    np.testing.assert_array_equal(counts, [0, 1, 2, 1])  # lv2..lv5
+    lv3 = np.asarray(outs["MultiFpnRois1"])
+    np.testing.assert_allclose(lv3[0], rois[1])
+    lv4 = np.asarray(outs["MultiFpnRois2"])
+    np.testing.assert_allclose(lv4[:2], rois[[0, 3]])
+
+    scores = [np.array([0.9, 0.1, 0.0, 0.0], "float32"),
+              np.array([0.8, 0.5, 0.0, 0.0], "float32")]
+    multi = [jnp.asarray(rois), jnp.asarray(rois + 1000.0)]
+    col = registry.get_kernel("collect_fpn_proposals")(
+        {"MultiLevelRois": multi,
+         "MultiLevelScores": [jnp.asarray(s) for s in scores]},
+        {"post_nms_topN": 3})
+    got = np.asarray(col["FpnRois"])
+    np.testing.assert_allclose(got[0], rois[0])          # 0.9
+    np.testing.assert_allclose(got[1], rois[0] + 1000.0)  # 0.8
+    np.testing.assert_allclose(got[2], rois[1] + 1000.0)  # 0.5
+    assert int(np.asarray(col["RoisNum"])) == 3
+
+
+def test_generate_proposal_labels_sampler():
+    """Fast R-CNN sampler: fg above thresh gets the gt class, bg in the
+    band gets 0, unfilled slots -1; fg regression targets only."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import registry
+
+    rois = np.array([
+        [0, 0, 10, 10],     # iou 1.0 with gt0 -> fg
+        [0, 0, 9, 9],       # high iou with gt0 -> fg
+        [20, 20, 30, 30],   # iou 0 -> bg (bg_lo=0)
+        [100, 100, 110, 110],  # iou 0 -> bg
+    ], "float32")
+    gt_boxes = np.array([[0, 0, 10, 10]], "float32")
+    gt_classes = np.array([7], "int32")
+    outs = registry.get_kernel("generate_proposal_labels")(
+        {"RpnRois": [jnp.asarray(rois)], "GtClasses": [jnp.asarray(gt_classes)],
+         "GtBoxes": [jnp.asarray(gt_boxes)]},
+        {"batch_size_per_im": 8, "fg_fraction": 0.5, "fg_thresh": 0.5,
+         "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0, "class_nums": 10,
+         "use_random": False, "seed": 3})
+    labels = np.asarray(outs["LabelsInt32"])
+    assert labels.shape == (8,)
+    # fg slots: rois 0,1 and the appended gt box itself = 3 fg of max 4
+    assert (labels == 7).sum() == 3
+    assert (labels == 0).sum() == 2  # the two bg rois
+    assert (labels == -1).sum() == 3  # unfilled
+    bt = np.asarray(outs["BboxTargets"])
+    iw = np.asarray(outs["BboxInsideWeights"])
+    # fg targets land in class-7 columns
+    fg_rows = labels == 7
+    assert iw[fg_rows][:, 7 * 4:8 * 4].all()
+    assert not iw[~fg_rows].any()
+    # perfect-match roi has ~zero deltas
+    r0 = np.where(fg_rows)[0][0]
+    np.testing.assert_allclose(bt[r0, 28:32], 0.0, atol=1e-5)
+
+
+def test_generate_mask_labels_crops_matched_mask():
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import registry
+
+    segms = np.zeros((1, 20, 20), "float32")
+    segms[0, :10, :10] = 1.0  # gt mask = top-left quadrant
+    rois = np.array([[0, 0, 9, 9], [10, 10, 19, 19]], "float32")
+    labels = np.array([3, -1], "int32")
+    matched = np.array([0, -1], "int32")
+    outs = registry.get_kernel("generate_mask_labels")(
+        {"Rois": [jnp.asarray(rois)], "LabelsInt32": [jnp.asarray(labels)],
+         "MatchedGtIndex": [jnp.asarray(matched)],
+         "GtSegms": [jnp.asarray(segms)]},
+        {"resolution": 4, "num_classes": 5})
+    m = np.asarray(outs["MaskInt32"])
+    # fg roi covers the all-ones region -> class-3 block all ones
+    blk = m[0, 3 * 16:4 * 16]
+    np.testing.assert_array_equal(blk, np.ones(16, "int32"))
+    assert (m[1] == -1).all()
+    np.testing.assert_array_equal(np.asarray(outs["RoiHasMaskInt32"]), [1, 0])
+
+
+def test_retinanet_target_assign_and_output():
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import registry
+
+    anchors = np.array([[0, 0, 10, 10], [50, 50, 60, 60], [0, 0, 30, 30]],
+                       "float32")
+    gt = np.array([[[0, 0, 10, 10]]], "float32")
+    gt_labels = np.array([[2]], "int32")
+    outs = registry.get_kernel("retinanet_target_assign")(
+        {"Anchor": [jnp.asarray(anchors)], "GtBoxes": [jnp.asarray(gt)],
+         "GtLabels": [jnp.asarray(gt_labels)]},
+        {"positive_overlap": 0.5, "negative_overlap": 0.4})
+    np.testing.assert_array_equal(np.asarray(outs["ScoreIndex"])[0], [1, 0, 0])
+    np.testing.assert_array_equal(np.asarray(outs["TargetLabel"])[0], [2, -1, -1])
+    assert int(np.asarray(outs["ForegroundNumber"])[0, 0]) == 1
+
+    # detection output: zero deltas decode back to the anchors
+    dec = registry.get_kernel("retinanet_detection_output")(
+        {"BBoxes": [jnp.zeros((3, 4))], "Scores": [jnp.asarray(
+            np.array([[0.9], [0.8], [0.01]], "float32"))],
+         "Anchors": [jnp.asarray(anchors)]},
+        {"score_threshold": 0.05, "nms_threshold": 0.3, "keep_top_k": 4})
+    out = np.asarray(dec["Out"])
+    kept = out[0][out[0, :, 0] >= 0]
+    assert len(kept) == 2  # third anchor below score threshold
+    np.testing.assert_allclose(kept[0, 2:], anchors[0], atol=1e-4)
+
+
+def test_roi_perspective_transform_axis_aligned_identity():
+    """An axis-aligned quad matching the output size reproduces the
+    region (homography == identity translation)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import registry
+
+    rng = np.random.RandomState(21)
+    x = rng.rand(1, 2, 8, 8).astype("float32")
+    # quad = rect from (2,1) spanning 4x3, warped to 3 rows x 4 cols
+    rois = np.array([[2, 1, 5, 1, 5, 3, 2, 3]], "float32")
+    out = registry.get_kernel("roi_perspective_transform")(
+        {"X": [jnp.asarray(x)], "ROIs": [jnp.asarray(rois)]},
+        {"transformed_height": 3, "transformed_width": 4,
+         "spatial_scale": 1.0})["Out"]
+    np.testing.assert_allclose(np.asarray(out)[0], x[0, :, 1:4, 2:6],
+                               atol=1e-4)
+
+
+def test_box_decoder_and_assign_golden():
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import registry
+
+    prior = np.array([[0, 0, 10, 10]], "float32")
+    pvar = np.array([1.0, 1.0, 1.0, 1.0], "float32")
+    tb = np.zeros((1, 8), "float32")  # 2 classes, zero deltas
+    score = np.array([[0.1, 0.9]], "float32")
+    outs = registry.get_kernel("box_decoder_and_assign")(
+        {"PriorBox": [jnp.asarray(prior)], "PriorBoxVar": [jnp.asarray(pvar)],
+         "TargetBox": [jnp.asarray(tb)], "BoxScore": [jnp.asarray(score)]},
+        {"box_clip": 4.135})
+    np.testing.assert_allclose(np.asarray(outs["DecodeBox"])[0, :4],
+                               prior[0], atol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs["OutputAssignBox"])[0],
+                               prior[0], atol=1e-5)
