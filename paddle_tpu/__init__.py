@@ -61,6 +61,10 @@ from paddle_tpu import io
 from paddle_tpu import reader
 from paddle_tpu import dataset
 from paddle_tpu import nets
+from paddle_tpu import dygraph_grad_clip
+from paddle_tpu import recordio_writer
+from paddle_tpu.parallel.compiled_program import ParallelExecutor
+from paddle_tpu.optimizer import ExponentialMovingAverage
 from paddle_tpu import install_check
 from paddle_tpu.layers import learning_rate_scheduler as learning_rate_decay
 

@@ -1,0 +1,62 @@
+"""Dygraph gradient clipping (reference: python/paddle/fluid/
+dygraph_grad_clip.py — GradClipByValue/Norm/GlobalNorm applied to
+(param, grad) lists in eager mode)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["GradClipByValue", "GradClipByNorm", "GradClipByGlobalNorm"]
+
+
+class GradClipByValue:
+    """reference: dygraph_grad_clip.py GradClipByValue."""
+
+    def __init__(self, min_value, max_value):
+        self.min_value = float(min_value)
+        self.max_value = float(max_value)
+
+    def __call__(self, params_grads):
+        import jax.numpy as jnp
+
+        return [
+            (p, None if g is None else jnp.clip(g, self.min_value, self.max_value))
+            for p, g in params_grads
+        ]
+
+
+class GradClipByNorm:
+    """reference: dygraph_grad_clip.py GradClipByNorm — per-grad L2 cap."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        import jax.numpy as jnp
+
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(g * g))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, g * scale))
+        return out
+
+
+class GradClipByGlobalNorm:
+    """reference: dygraph_grad_clip.py GradClipByGlobalNorm."""
+
+    def __init__(self, max_global_norm):
+        self.max_global_norm = float(max_global_norm)
+
+    def __call__(self, params_grads):
+        import jax.numpy as jnp
+
+        sq = [jnp.sum(g * g) for _, g in params_grads if g is not None]
+        if not sq:
+            return params_grads
+        global_norm = jnp.sqrt(sum(sq))
+        scale = jnp.minimum(
+            self.max_global_norm / jnp.maximum(global_norm, 1e-12), 1.0)
+        return [(p, None if g is None else g * scale) for p, g in params_grads]

@@ -13,6 +13,7 @@ import math
 import numpy as np
 
 __all__ = [
+    "Bilinear", "BilinearInitializer", "init_on_cpu",
     "Constant",
     "Uniform",
     "Normal",
@@ -177,3 +178,42 @@ Normal = NormalInitializer
 TruncatedNormal = TruncatedNormalInitializer
 Xavier = XavierInitializer
 MSRA = MSRAInitializer
+
+
+class BilinearInitializer(Initializer):
+    """reference: initializer.py BilinearInitializer — seeds a
+    conv_transpose filter [C_out, C_in, kh, kw] with bilinear
+    upsampling kernels (used to warm-start learnable upsampling)."""
+
+    def __call__(self, var, block):
+        shape = [int(s) for s in var.shape]
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D filter")
+        weight = np.zeros(shape, dtype="float32")
+        kh, kw = shape[2], shape[3]
+        f_h, f_w = np.ceil(kh / 2.0), np.ceil(kw / 2.0)
+        c_h, c_w = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h), (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        yy, xx = np.meshgrid(np.arange(kh), np.arange(kw), indexing="ij")
+        kern = (1 - np.abs(yy / f_h - c_h)) * (1 - np.abs(xx / f_w - c_w))
+        for i in range(shape[0]):
+            for j in range(shape[1]):
+                weight[i, j] = kern
+        return block.append_op(
+            type="assign_value",
+            outputs={"Out": [var.name]},
+            attrs={"shape": shape, "dtype": "float32",
+                   "values": weight.flatten().tolist()},
+        )
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def init_on_cpu():
+    """reference: initializer.py init_on_cpu — placement hint; XLA owns
+    placement on this build, so this is a documented no-op context."""
+    yield
+
+
+Bilinear = BilinearInitializer

@@ -3,7 +3,7 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["MetricBase", "Accuracy", "Auc", "Precision", "Recall", "CompositeMetric", "ChunkEvaluator", "DetectionMAP"]
+__all__ = ["MetricBase", "Accuracy", "Auc", "Precision", "Recall", "CompositeMetric", "ChunkEvaluator", "DetectionMAP", "EditDistance"]
 
 
 class MetricBase:
@@ -263,3 +263,32 @@ class DetectionMAP(MetricBase):
                 ap = float(np.sum(precision * drecall))
             aps.append(float(ap))
         return float(np.mean(aps)) if n_classes else 0.0
+
+
+class EditDistance(MetricBase):
+    """Streaming average edit distance (reference: fluid/metrics.py
+    EditDistance) — feed the edit_distance op's (distances,
+    seq_num) per batch."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num):
+        import numpy as np
+
+        d = np.asarray(distances).reshape(-1)
+        self.total_distance += float(d.sum())
+        self.seq_num += int(np.asarray(seq_num))
+        self.instance_error += int((d > 0).sum())
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError("no data fed to EditDistance")
+        return (self.total_distance / self.seq_num,
+                self.instance_error / self.seq_num)

@@ -139,3 +139,31 @@ class CompiledProgram:
     def __repr__(self):
         ax = dict(zip(self.mesh.axis_names, self.mesh.devices.shape)) if self._mesh else {}
         return "CompiledProgram(mesh=%s)" % (ax,)
+
+
+class ParallelExecutor:
+    """Legacy multi-device executor (reference: parallel_executor.py) —
+    thin facade over CompiledProgram.with_data_parallel + Executor; the
+    `run` signature matches the reference (fetch_list of names/vars,
+    feed dict split across the dp mesh by the compiled program)."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None, build_strategy=None,
+                 num_trainers=1, trainer_id=0, scope=None):
+        from paddle_tpu import framework
+        from paddle_tpu.executor import Executor
+        from paddle_tpu.framework import CPUPlace, TPUPlace
+
+        program = main_program or framework.default_main_program()
+        self._compiled = CompiledProgram(program).with_data_parallel(
+            loss_name=loss_name, build_strategy=build_strategy,
+            exec_strategy=exec_strategy,
+        )
+        self._exe = Executor(TPUPlace(0) if use_cuda else CPUPlace())
+        self._scope = scope
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        return self._exe.run(
+            self._compiled, feed=feed or feed_dict, fetch_list=fetch_list,
+            scope=self._scope, return_numpy=return_numpy,
+        )
