@@ -1,0 +1,56 @@
+"""Book test: semantic role labeling with a CRF head (reference:
+python/paddle/fluid/tests/book/test_label_semantic_roles.py — embeddings
+-> hidden -> linear_chain_crf cost, crf_decoding for inference).
+Synthetic conll05-style data; the tagger must beat the trivial
+majority-tag baseline on its training set."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import framework
+
+
+def test_label_semantic_roles():
+    V, T, D, K = 40, 8, 16, 5  # vocab, max len, emb, tags
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 91
+    with framework.program_guard(prog, startup):
+        word = fluid.layers.data("word", [T], dtype="int64", lod_level=1)
+        block = prog.global_block()
+        seq_len = block.var("word_seq_len")
+        target = fluid.layers.data("target", [T], dtype="int64")
+        emb = fluid.layers.embedding(word, size=[V, D])
+        hidden = fluid.layers.fc(emb, 32, num_flatten_dims=2, act="tanh")
+        feature = fluid.layers.fc(hidden, K, num_flatten_dims=2)
+        crf_cost = fluid.layers.linear_chain_crf(
+            feature, target, param_attr=fluid.ParamAttr(name="crfw_srl"),
+            seq_len=seq_len,
+        )
+        avg_cost = fluid.layers.mean(crf_cost)
+        decode = fluid.layers.crf_decoding(
+            feature, fluid.ParamAttr(name="crfw_srl"), seq_len=seq_len)
+        fluid.optimizer.SGDOptimizer(0.1).minimize(avg_cost)
+
+    # synthetic SRL: tag is a deterministic function of the word id
+    rng = np.random.RandomState(0)
+    words = rng.randint(1, V, (64, T)).astype("int64")
+    tags = (words * 7 % K).astype("int64")
+    lens = rng.randint(3, T + 1, (64,)).astype("int32")
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        costs = []
+        for _ in range(30):
+            c, d = exe.run(
+                prog,
+                feed={"word": words, "word_seq_len": lens, "target": tags},
+                fetch_list=[avg_cost, decode],
+            )
+            costs.append(float(np.asarray(c)))
+        path = np.asarray(d)
+    assert costs[-1] < costs[0] * 0.5, (costs[0], costs[-1])
+    # decode accuracy over valid positions beats the 1/K chance baseline
+    mask = np.arange(T)[None, :] < lens[:, None]
+    acc = (path == tags)[mask].mean()
+    assert acc > 0.5, acc

@@ -1,0 +1,53 @@
+"""Book test: recommender system (reference:
+python/paddle/fluid/tests/book/test_recommender_system.py — user/movie
+feature towers -> cos_sim -> scaled rating regression).  Synthetic
+movielens-style ids; loss must fall and predictions track ratings."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import framework
+
+
+def test_recommender_system():
+    USERS, MOVIES, D = 30, 40, 16
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 92
+    with framework.program_guard(prog, startup):
+        uid = fluid.layers.data("uid", [1], dtype="int64")
+        mid = fluid.layers.data("mid", [1], dtype="int64")
+        score = fluid.layers.data("score", [1])
+        uemb = fluid.layers.embedding(uid, size=[USERS, D])
+        memb = fluid.layers.embedding(mid, size=[MOVIES, D])
+        ufeat = fluid.layers.fc(
+            fluid.layers.reshape(uemb, shape=[-1, D]), 32, act="tanh")
+        mfeat = fluid.layers.fc(
+            fluid.layers.reshape(memb, shape=[-1, D]), 32, act="tanh")
+        sim = fluid.layers.cos_sim(ufeat, mfeat)
+        pred = fluid.layers.scale(sim, scale=5.0)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, score))
+        fluid.optimizer.AdamOptimizer(0.02).minimize(loss)
+
+    rng = np.random.RandomState(1)
+    n = 128
+    uids = rng.randint(0, USERS, (n, 1)).astype("int64")
+    mids = rng.randint(0, MOVIES, (n, 1)).astype("int64")
+    # latent structure: rating from user/movie id parity interaction
+    scores = (1.0 + 4.0 * ((uids + mids) % 2 == 0)).astype("float32")
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for _ in range(40):
+            (l,) = exe.run(
+                prog, feed={"uid": uids, "mid": mids, "score": scores},
+                fetch_list=[loss])
+            losses.append(float(np.asarray(l)))
+        (p,) = exe.run(prog, feed={"uid": uids, "mid": mids, "score": scores},
+                       fetch_list=[pred])
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    # predictions correlate with ratings
+    p = np.asarray(p).ravel()
+    corr = np.corrcoef(p, scores.ravel())[0, 1]
+    assert corr > 0.5, corr

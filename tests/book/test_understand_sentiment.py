@@ -1,0 +1,52 @@
+"""Book test: sentiment classification (reference:
+python/paddle/fluid/tests/book/notest_understand_sentiment.py —
+convolution_net: embedding -> parallel sequence_conv_pool windows ->
+softmax).  Synthetic imdb-style data with a planted keyword signal."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import framework, nets
+
+
+def test_understand_sentiment_conv():
+    V, T, D = 60, 12, 16
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 93
+    with framework.program_guard(prog, startup):
+        words = fluid.layers.data("words", [T], dtype="int64", lod_level=1)
+        block = prog.global_block()
+        seq_len = block.var("words_seq_len")
+        label = fluid.layers.data("label", [1], dtype="int64")
+        emb = fluid.layers.embedding(words, size=[V, D])
+        conv3 = nets.sequence_conv_pool(emb, 16, 3, act="tanh",
+                                        pool_type="max", seq_len=seq_len)
+        conv4 = nets.sequence_conv_pool(emb, 16, 4, act="tanh",
+                                        pool_type="max", seq_len=seq_len)
+        merged = fluid.layers.concat([conv3, conv4], axis=1)
+        prob = fluid.layers.fc(merged, 2, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(prob, label))
+        acc = fluid.layers.accuracy(prob, label)
+        fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+
+    # planted signal: token 7 anywhere in the sequence => positive
+    rng = np.random.RandomState(2)
+    n = 96
+    wordsv = rng.randint(8, V, (n, T)).astype("int64")
+    labels = rng.randint(0, 2, (n, 1)).astype("int64")
+    for i in range(n):
+        if labels[i, 0] == 1:
+            wordsv[i, rng.randint(0, T)] = 7
+    lens = np.full((n,), T, "int32")
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        accs = []
+        for _ in range(30):
+            l, a = exe.run(
+                prog,
+                feed={"words": wordsv, "words_seq_len": lens, "label": labels},
+                fetch_list=[loss, acc])
+            accs.append(float(np.asarray(a)))
+    assert accs[-1] > 0.9, accs[-5:]
