@@ -48,5 +48,5 @@ def test_understand_sentiment_conv():
                 prog,
                 feed={"words": wordsv, "words_seq_len": lens, "label": labels},
                 fetch_list=[loss, acc])
-            accs.append(float(np.asarray(a)))
+            accs.append(np.asarray(a).item())
     assert accs[-1] > 0.9, accs[-5:]
