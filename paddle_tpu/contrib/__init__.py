@@ -1,3 +1,4 @@
 """Contrib namespace (reference: python/paddle/fluid/contrib/)."""
 from paddle_tpu.contrib import mixed_precision  # noqa: F401
 from paddle_tpu.contrib import slim  # noqa: F401
+from paddle_tpu.contrib import float16  # noqa: F401,E402

@@ -1,0 +1,50 @@
+"""Low-precision inference transpiler (reference: paddle/contrib/float16/
+float16_transpiler.py Float16Transpiler).
+
+TPU-native: the low-precision type is **bfloat16** (same exponent range
+as fp32 — no loss-scale machinery needed, and the MXU computes in bf16
+natively).  ``Float16Transpiler.transpile`` casts an inference program's
+weights in the scope to bf16 and marks the program so feeds cast down
+and fetches cast back up — users keep feeding/fetching fp32 like the
+reference describes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Float16Transpiler", "Bfloat16Transpiler"]
+
+# params that keep full precision (normalization statistics/affine — the
+# same keep-fp32 set as contrib.mixed_precision)
+_KEEP_FP32_SUBSTR = ("_mean", "_variance", "batch_norm", "_bn_")
+
+
+class Float16Transpiler:
+    def transpile(self, program, place=None, scope=None):
+        """Cast the program's parameters (in ``scope``) to bfloat16 and
+        rewrite the program's parameter dtypes; feed vars stay fp32 (the
+        executor casts feeds to each var's dtype on entry, and fetched
+        values convert via np.asarray).  Returns the set of cast params."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.scope import global_scope
+
+        scope = scope or global_scope()
+        cast = set()
+        for p in program.all_parameters():
+            if any(k in p.name for k in _KEEP_FP32_SUBSTR):
+                continue
+            val = scope.get(p.name)
+            if val is None:
+                raise RuntimeError(
+                    "param %r not in scope — run startup / load first" % p.name)
+            if not np.issubdtype(np.asarray(val).dtype, np.floating):
+                continue
+            scope.set(p.name, jnp.asarray(val, jnp.bfloat16))
+            p.dtype = "bfloat16"
+            cast.add(p.name)
+        program.version += 1
+        return cast
+
+
+Bfloat16Transpiler = Float16Transpiler

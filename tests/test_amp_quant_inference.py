@@ -274,3 +274,33 @@ def test_sanas_searches_and_trains_candidates():
     nas2 = NegLossSANAS(space, search_steps=5, constraint=flops_ok, seed=3)
     best2 = nas2.search(train_feeds, eval_feeds, train_epochs=1)
     assert WIDTHS[best2[0]] <= 2, best2
+
+
+def test_float16_inference_transpiler():
+    """contrib.float16 (reference: paddle/contrib/float16/
+    float16_transpiler.py): weights cast to bf16 in the scope, program
+    dtypes rewritten, fp32 feeds/fetches keep working, outputs within
+    bf16 tolerance of the fp32 run."""
+    from paddle_tpu.contrib.float16 import Float16Transpiler
+
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 9
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [8])
+        h = fluid.layers.fc(x, 16, act="relu")
+        bn = fluid.layers.batch_norm(h)
+        out = fluid.layers.fc(bn, 4, act="softmax")
+    infer = prog.clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.Scope()
+    rng = np.random.RandomState(0)
+    xb = rng.rand(4, 8).astype("float32")
+    with fluid.scope_guard(sc):
+        exe.run(startup)
+        (ref,) = exe.run(infer, feed={"x": xb}, fetch_list=[out])
+        cast = Float16Transpiler().transpile(infer, scope=sc)
+        (low,) = exe.run(infer, feed={"x": xb}, fetch_list=[out])
+    assert any("fc" in c for c in cast)
+    # bn statistics stay fp32 (the keep-fp32 set)
+    assert not any("batch_norm" in c for c in cast)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(low), atol=2e-2)
