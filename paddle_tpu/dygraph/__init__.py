@@ -9,13 +9,27 @@ grad kernels the static graph uses — one autodiff implementation for
 both modes.
 """
 from paddle_tpu.dygraph import nn  # noqa: F401
-from paddle_tpu.dygraph.base import guard, enabled, no_grad, to_variable  # noqa: F401
+from paddle_tpu.dygraph.base import Tracer, guard, enabled, no_grad, to_variable  # noqa: F401
+from paddle_tpu.dygraph import learning_rate_scheduler  # noqa: F401
+from paddle_tpu.dygraph.learning_rate_scheduler import (  # noqa: F401
+    CosineDecay,
+    ExponentialDecay,
+    InverseTimeDecay,
+    LearningRateDecay,
+    NaturalExpDecay,
+    NoamDecay,
+    PiecewiseDecay,
+    PolynomialDecay,
+)
 from paddle_tpu.dygraph.layers import Layer  # noqa: F401
 from paddle_tpu.dygraph.nn import (  # noqa: F401
     BatchNorm,
     BilinearTensorProduct,
     Conv2D,
     Conv2DTranspose,
+    Conv3D,
+    Conv3DTranspose,
+    TreeConv,
     Embedding,
     FC,
     GroupNorm,
@@ -28,4 +42,24 @@ from paddle_tpu.dygraph.nn import (  # noqa: F401
     SpectralNorm,
 )
 from paddle_tpu.dygraph.parallel import DataParallel, prepare_context  # noqa: F401
-from paddle_tpu.dygraph.checkpoint import load_dygraph, save_dygraph  # noqa: F401
+from paddle_tpu.dygraph.checkpoint import (  # noqa: F401
+    load_dygraph,
+    load_persistables,
+    save_dygraph,
+    save_persistables,
+)
+
+
+def start_gperf_profiler():
+    """reference: dygraph/profiler.py start_gperf_profiler — maps to a
+    jax.profiler trace (gperftools is CPU-host-only; the TPU story is
+    the xplane trace)."""
+    import jax
+
+    jax.profiler.start_trace("/tmp/paddle_tpu_gperf")
+
+
+def stop_gperf_profiler():
+    import jax
+
+    jax.profiler.stop_trace()

@@ -18,3 +18,14 @@ def save_dygraph(state_dict, model_path: str):
 def load_dygraph(model_path: str):
     data = np.load(model_path + ".pdparams.npz")
     return {k: data[k] for k in data.files}, None
+
+
+def save_persistables(model_dict, dirname="save_dir", optimizers=None):
+    """reference: dygraph/checkpoint.py save_persistables (legacy alias
+    of save_dygraph over a state dict)."""
+    return save_dygraph(model_dict, dirname)
+
+
+def load_persistables(dirname="save_dir"):
+    """reference: dygraph/checkpoint.py load_persistables."""
+    return load_dygraph(dirname)

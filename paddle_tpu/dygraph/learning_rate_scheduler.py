@@ -1,0 +1,124 @@
+"""Dygraph learning-rate decay objects (reference: python/paddle/fluid/
+dygraph/learning_rate_scheduler.py — NoamDecay, PiecewiseDecay, ...).
+
+Each object is passed as ``learning_rate=`` to an optimizer; the
+optimizer calls it once per minimize() and the schedule advances
+(reference: optimizer calls LearningRateDecay.__call__ which steps)."""
+from __future__ import annotations
+
+import math
+
+__all__ = [
+    "LearningRateDecay", "PiecewiseDecay", "NaturalExpDecay",
+    "ExponentialDecay", "InverseTimeDecay", "PolynomialDecay",
+    "CosineDecay", "NoamDecay",
+]
+
+
+class LearningRateDecay:
+    def __init__(self, begin=0, step=1):
+        self.step_num = int(begin)
+        self.step_size = int(step)
+
+    def __call__(self):
+        lr = self.step()
+        self.step_num += self.step_size
+        return float(lr)
+
+    def __float__(self):
+        return float(self.step())
+
+    def step(self):
+        raise NotImplementedError
+
+
+class PiecewiseDecay(LearningRateDecay):
+    """reference: dygraph/learning_rate_scheduler.py PiecewiseDecay."""
+
+    def __init__(self, boundaries, values, begin=0, step=1):
+        super().__init__(begin, step)
+        self.boundaries = [int(b) for b in boundaries]
+        self.values = [float(v) for v in values]
+
+    def step(self):
+        for i, b in enumerate(self.boundaries):
+            if self.step_num < b:
+                return self.values[i]
+        return self.values[len(self.boundaries)]
+
+
+class NaturalExpDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1):
+        super().__init__(begin, step)
+        self.base = float(learning_rate)
+        self.decay_steps = int(decay_steps)
+        self.decay_rate = float(decay_rate)
+        self.staircase = staircase
+
+    def step(self):
+        p = self.step_num / self.decay_steps
+        if self.staircase:
+            p = math.floor(p)
+        return self.base * math.exp(-self.decay_rate * p)
+
+
+class ExponentialDecay(NaturalExpDecay):
+    def step(self):
+        p = self.step_num / self.decay_steps
+        if self.staircase:
+            p = math.floor(p)
+        return self.base * (self.decay_rate ** p)
+
+
+class InverseTimeDecay(NaturalExpDecay):
+    def step(self):
+        p = self.step_num / self.decay_steps
+        if self.staircase:
+            p = math.floor(p)
+        return self.base / (1.0 + self.decay_rate * p)
+
+
+class PolynomialDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, end_learning_rate=1e-4,
+                 power=1.0, cycle=False, begin=0, step=1):
+        super().__init__(begin, step)
+        self.base = float(learning_rate)
+        self.decay_steps = int(decay_steps)
+        self.end_lr = float(end_learning_rate)
+        self.power = float(power)
+        self.cycle = cycle
+
+    def step(self):
+        n = self.step_num
+        d = self.decay_steps
+        if self.cycle:
+            mult = max(1.0, math.ceil(n / d)) if n else 1.0
+            d = d * mult
+        else:
+            n = min(n, d)
+        return (self.base - self.end_lr) * (1 - n / d) ** self.power + self.end_lr
+
+
+class CosineDecay(LearningRateDecay):
+    def __init__(self, learning_rate, step_each_epoch, epochs, begin=0, step=1):
+        super().__init__(begin, step)
+        self.base = float(learning_rate)
+        self.step_each_epoch = int(step_each_epoch)
+        self.epochs = int(epochs)
+
+    def step(self):
+        epoch = self.step_num // self.step_each_epoch
+        return self.base * (math.cos(epoch * math.pi / self.epochs) + 1) / 2
+
+
+class NoamDecay(LearningRateDecay):
+    def __init__(self, d_model, warmup_steps, begin=1, step=1):
+        super().__init__(begin, step)
+        self.d_model = float(d_model)
+        self.warmup_steps = float(warmup_steps)
+
+    def step(self):
+        n = max(self.step_num, 1)
+        return self.d_model ** -0.5 * min(n ** -0.5,
+                                          n * self.warmup_steps ** -1.5)

@@ -14,7 +14,8 @@ from paddle_tpu.dygraph.layers import Layer
 __all__ = [
     "Conv2D", "FC", "Linear", "BatchNorm", "Embedding", "LayerNorm",
     "Pool2D", "Conv2DTranspose", "GroupNorm", "PRelu", "SpectralNorm",
-    "GRUUnit", "NCE", "BilinearTensorProduct",
+    "GRUUnit", "NCE", "BilinearTensorProduct", "Conv3D",
+    "Conv3DTranspose", "TreeConv",
 ]
 
 
@@ -588,4 +589,144 @@ class BilinearTensorProduct(Layer):
             ins["Bias"] = [self.bias]
         helper.append_op(type="bilinear_tensor_product", inputs=ins,
                          outputs={"Out": [out]}, attrs={})
+        return helper.append_activation(out)
+
+
+class Conv3D(Layer):
+    """reference: dygraph/nn.py Conv3D — NCDHW."""
+
+    def __init__(self, name_scope=None, num_filters=None, filter_size=None,
+                 stride=1, padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._a = dict(num_filters=num_filters, filter_size=filter_size,
+                       stride=stride, padding=padding, dilation=dilation,
+                       groups=groups)
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act = act
+
+    def forward(self, input):
+        from paddle_tpu.layer_helper import LayerHelper
+
+        a = self._a
+        if not hasattr(self, "weight"):
+            c = int(input.shape[1])
+            fs = (a["filter_size"] if isinstance(a["filter_size"], (list, tuple))
+                  else [a["filter_size"]] * 3)
+            helper = LayerHelper(self._full_name, param_attr=self._param_attr,
+                                 bias_attr=self._bias_attr)
+            self.weight = helper.create_parameter(
+                self._param_attr,
+                shape=[a["num_filters"], c // a["groups"]] + list(fs),
+                dtype=self._dtype)
+            self.bias = helper.create_parameter(
+                self._bias_attr, shape=[a["num_filters"]], dtype=self._dtype,
+                is_bias=True)
+        helper = LayerHelper(self._full_name, act=self._act)
+        out = helper.create_variable_for_type_inference(self._dtype)
+        helper.append_op(
+            type="conv3d", inputs={"Input": [input], "Filter": [self.weight]},
+            outputs={"Output": [out]},
+            attrs={"strides": a["stride"], "paddings": a["padding"],
+                   "dilations": a["dilation"], "groups": a["groups"]})
+        if self.bias is not None:
+            tmp = helper.create_variable_for_type_inference(self._dtype)
+            helper.append_op(type="elementwise_add",
+                             inputs={"X": [out], "Y": [self.bias]},
+                             outputs={"Out": [tmp]}, attrs={"axis": 1})
+            out = tmp
+        return helper.append_activation(out)
+
+
+class Conv3DTranspose(Layer):
+    """reference: dygraph/nn.py Conv3DTranspose."""
+
+    def __init__(self, name_scope=None, num_filters=None, filter_size=None,
+                 stride=1, padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._a = dict(num_filters=num_filters, filter_size=filter_size,
+                       stride=stride, padding=padding, dilation=dilation,
+                       groups=groups)
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act = act
+
+    def forward(self, input):
+        from paddle_tpu.layer_helper import LayerHelper
+
+        a = self._a
+        if not hasattr(self, "weight"):
+            c = int(input.shape[1])
+            fs = (a["filter_size"] if isinstance(a["filter_size"], (list, tuple))
+                  else [a["filter_size"]] * 3)
+            helper = LayerHelper(self._full_name, param_attr=self._param_attr,
+                                 bias_attr=self._bias_attr)
+            self.weight = helper.create_parameter(
+                self._param_attr,
+                shape=[c, a["num_filters"] // a["groups"]] + list(fs),
+                dtype=self._dtype)
+            self.bias = helper.create_parameter(
+                self._bias_attr, shape=[a["num_filters"]], dtype=self._dtype,
+                is_bias=True)
+        helper = LayerHelper(self._full_name, act=self._act)
+        out = helper.create_variable_for_type_inference(self._dtype)
+        helper.append_op(
+            type="conv3d_transpose",
+            inputs={"Input": [input], "Filter": [self.weight]},
+            outputs={"Output": [out]},
+            attrs={"strides": a["stride"], "paddings": a["padding"],
+                   "dilations": a["dilation"], "groups": a["groups"]})
+        if self.bias is not None:
+            tmp = helper.create_variable_for_type_inference(self._dtype)
+            helper.append_op(type="elementwise_add",
+                             inputs={"X": [out], "Y": [self.bias]},
+                             outputs={"Out": [tmp]}, attrs={"axis": 1})
+            out = tmp
+        return helper.append_activation(out)
+
+
+class TreeConv(Layer):
+    """reference: dygraph/nn.py TreeConv — TBCNN over (nodes, edges)."""
+
+    def __init__(self, name_scope=None, output_size=None, num_filters=1,
+                 max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+                 name=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._output_size = output_size
+        self._num_filters = num_filters
+        self._max_depth = max_depth
+        self._act = act
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+
+    def forward(self, nodes_vector, edge_set):
+        from paddle_tpu.layer_helper import LayerHelper
+
+        if not hasattr(self, "weight"):
+            f = int(nodes_vector.shape[-1])
+            helper = LayerHelper(self._full_name, param_attr=self._param_attr,
+                                 bias_attr=self._bias_attr)
+            self.weight = helper.create_parameter(
+                self._param_attr,
+                shape=[f, 3, self._output_size, self._num_filters],
+                dtype=self._dtype)
+            self.bias = helper.create_parameter(
+                self._bias_attr, shape=[self._num_filters], dtype=self._dtype,
+                is_bias=True)
+        helper = LayerHelper(self._full_name, act=self._act)
+        out = helper.create_variable_for_type_inference(self._dtype)
+        helper.append_op(
+            type="tree_conv",
+            inputs={"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+                    "Filter": [self.weight]},
+            outputs={"Out": [out]},
+            attrs={"max_depth": int(self._max_depth)})
+        if self.bias is not None:
+            tmp = helper.create_variable_for_type_inference(self._dtype)
+            helper.append_op(type="elementwise_add",
+                             inputs={"X": [out], "Y": [self.bias]},
+                             outputs={"Out": [tmp]}, attrs={"axis": 3})
+            out = tmp
         return helper.append_activation(out)

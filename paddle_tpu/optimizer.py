@@ -65,6 +65,29 @@ class Optimizer:
         if isinstance(self._learning_rate, Variable):
             self._lr_var = self._learning_rate
             return
+        if callable(self._learning_rate) and not isinstance(
+            self._learning_rate, (int, float)
+        ):
+            # dygraph LearningRateDecay object (dygraph/
+            # learning_rate_scheduler.py): calling it returns the current
+            # lr AND advances the schedule — eager mode re-creates the lr
+            # var each minimize, so the decay steps per call like the
+            # reference
+            if not framework.in_dygraph_mode():
+                raise ValueError(
+                    "LearningRateDecay objects are dygraph-only; use "
+                    "layers.learning_rate_scheduler in static graphs"
+                )
+            from paddle_tpu.layers import tensor as ltensor
+
+            self._lr_var = ltensor.create_global_var(
+                shape=[1],
+                value=float(self._learning_rate()),
+                dtype="float32",
+                persistable=True,
+                name=unique_name.generate("learning_rate"),
+            )
+            return
         if self._lr_var is not None:
             return
         from paddle_tpu.layers import tensor as ltensor

@@ -218,3 +218,42 @@ def test_bilinear_tensor_product_layer_trains():
             losses.append(float(loss.numpy()))
     assert losses[-1] < losses[0]
     assert tuple(btp.weight.shape) == (4, 3, 5)
+
+
+def test_dygraph_lr_decay_and_3d_layers():
+    """LearningRateDecay objects advance per minimize() (reference:
+    dygraph/learning_rate_scheduler.py), and the Conv3D/Conv3DTranspose/
+    TreeConv dygraph layers train."""
+    from paddle_tpu.dygraph import PiecewiseDecay, NoamDecay
+
+    sched = PiecewiseDecay([2, 4], [0.1, 0.01, 0.001])
+    assert [sched() for _ in range(5)] == [0.1, 0.1, 0.01, 0.01, 0.001]
+    noam = NoamDecay(d_model=64, warmup_steps=10)
+    vals = [noam() for _ in range(12)]
+    assert vals[9] == max(vals)  # peak at warmup boundary
+
+    rng = np.random.RandomState(8)
+    xb = rng.randn(2, 2, 4, 4, 4).astype("float32")
+    with dygraph.guard():
+        c3 = dygraph.Conv3D(num_filters=3, filter_size=2)
+        u3 = dygraph.Conv3DTranspose(num_filters=2, filter_size=2, stride=2)
+        opt = fluid.optimizer.SGDOptimizer(
+            learning_rate=dygraph.ExponentialDecay(0.1, 10, 0.9))
+        losses = []
+        for _ in range(4):
+            h = c3(to_variable(xb))
+            o = u3(h)
+            loss = fluid.layers.mean(o * o)
+            loss.backward()
+            opt.minimize(loss, parameter_list=c3.parameters() + u3.parameters())
+            c3.clear_gradients()
+            u3.clear_gradients()
+            losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+    feats = rng.randn(1, 5, 6).astype("float32")
+    edges = np.array([[[1, 2], [1, 3], [3, 4], [0, 0], [0, 0]]], "int64")
+    with dygraph.guard():
+        tc = dygraph.TreeConv(output_size=4, num_filters=2)
+        out = tc(to_variable(feats), to_variable(edges))
+        assert tuple(out.numpy().shape) == (1, 5, 4, 2)
