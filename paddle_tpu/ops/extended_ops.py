@@ -1055,3 +1055,287 @@ def load_op(inputs, attrs):
     except FileNotFoundError:
         arr = np.load(path + ".npy")
     return {"Out": jnp.asarray(arr)}
+
+
+# ---------------------------------------------------------------------------
+# registry tail: aliases + small kernels closing the REGISTER_OPERATOR
+# diff vs the reference (fusion/infra/PS-wire ops are subsumed by
+# XLA/the executor architecture and stay unregistered by design)
+# ---------------------------------------------------------------------------
+def _alias(new, old):
+    from paddle_tpu.core.registry import _REGISTRY
+
+    if old in _REGISTRY and new not in _REGISTRY:
+        _REGISTRY[new] = _REGISTRY[old]
+
+
+_alias("squeeze", "squeeze2")
+_alias("unsqueeze", "unsqueeze2")
+_alias("flatten", "flatten2")
+_alias("fill_zeros_like2", "fill_zeros_like")
+_alias("lstm", "dynamic_lstm")
+_alias("lstmp", "dynamic_lstmp")
+_alias("gru", "dynamic_gru")
+_alias("fill", "fill_constant")
+_alias("depthwise_conv2d_transpose", "conv2d_transpose")
+
+
+@register_op("minus")
+def minus(inputs, attrs):
+    """reference: minus_op.cc — x - y."""
+    return {"Out": one(inputs, "X") - one(inputs, "Y")}
+
+
+@register_op("fill_any_like")
+def fill_any_like(inputs, attrs):
+    jnp = _jnp()
+    return {"Out": jnp.full_like(one(inputs, "X"), attrs.get("value", 0.0))}
+
+
+@register_op("hinge_loss", no_grad_set={"Labels"})
+def hinge_loss(inputs, attrs):
+    """reference: hinge_loss_op.cc — max(1 - pred*(2*label-1), 0)."""
+    jnp = _jnp()
+    pred = one(inputs, "Logits")
+    label = one(inputs, "Labels")
+    return {"Loss": jnp.maximum(1.0 - pred * (2.0 * label - 1.0), 0.0)}
+
+
+@register_op("modified_huber_loss", no_grad_set={"Y"})
+def modified_huber_loss(inputs, attrs):
+    """reference: modified_huber_loss_op.cc — z = y_pred*(2y-1);
+    loss = max(0,1-z)^2 for z>=-1 else -4z."""
+    jnp = _jnp()
+    pred = one(inputs, "X")
+    y = one(inputs, "Y")
+    z = pred * (2.0 * y - 1.0)
+    sq = jnp.square(jnp.maximum(1.0 - z, 0.0))
+    return {"Out": jnp.where(z >= -1.0, sq, -4.0 * z),
+            "IntermediateVal": z}
+
+
+@register_op("l1_norm")
+def l1_norm(inputs, attrs):
+    jnp = _jnp()
+    return {"Out": jnp.sum(jnp.abs(one(inputs, "X")))}
+
+
+@register_op("squared_l2_norm")
+def squared_l2_norm(inputs, attrs):
+    jnp = _jnp()
+    x = one(inputs, "X")
+    return {"Out": jnp.sum(x * x)}
+
+
+@register_op("squared_l2_distance")
+def squared_l2_distance(inputs, attrs):
+    """reference: squared_l2_distance_op.cc — rowwise ||x - y||^2."""
+    jnp = _jnp()
+    x = one(inputs, "X")
+    y = one(inputs, "Y")
+    sub = x - y
+    return {"Out": jnp.sum(sub * sub, axis=tuple(range(1, x.ndim)),
+                           keepdims=True).reshape(-1, 1),
+            "sub_result": sub}
+
+
+@register_op("conv_shift")
+def conv_shift(inputs, attrs):
+    """reference: conv_shift_op.cc — circular 1-D correlation:
+    out[i] = sum_j x[(i + j - M/2) mod N] * y[j]."""
+    jnp = _jnp()
+    x = one(inputs, "X")  # [B, N]
+    y = one(inputs, "Y")  # [B, M]
+    B, N = x.shape
+    M = y.shape[1]
+    half = M // 2
+    out = jnp.zeros_like(x)
+    for j in range(M):
+        out = out + jnp.roll(x, half - j, axis=1) * y[:, j:j + 1]
+    return {"Out": out}
+
+
+@register_op("proximal_gd", differentiable=False)
+def proximal_gd(inputs, attrs):
+    """reference: proximal_gd_op.cc — prox step with l1/l2 shrinkage."""
+    jnp = _jnp()
+    p = one(inputs, "Param")
+    g = one(inputs, "Grad")
+    lr = one(inputs, "LearningRate").reshape(())
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    prox = p - lr * g
+    if l1 > 0:
+        prox = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+    return {"ParamOut": prox / (1.0 + lr * l2)}
+
+
+@register_op("proximal_adagrad", differentiable=False)
+def proximal_adagrad(inputs, attrs):
+    """reference: proximal_adagrad_op.cc."""
+    jnp = _jnp()
+    p = one(inputs, "Param")
+    g = one(inputs, "Grad")
+    m = one(inputs, "Moment")
+    lr = one(inputs, "LearningRate").reshape(())
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    m_new = m + g * g
+    eff_lr = lr / jnp.sqrt(m_new)
+    prox = p - eff_lr * g
+    if l1 > 0:
+        prox = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - eff_lr * l1, 0.0)
+    return {"ParamOut": prox / (1.0 + eff_lr * l2), "MomentOut": m_new}
+
+
+@register_op("dgc_clip_by_norm")
+def dgc_clip_by_norm(inputs, attrs):
+    """reference: dgc_clip_by_norm_op.cc — clip_by_norm gated on
+    current_step >= rampup_begin_step (before rampup DGC sends dense,
+    no local clip)."""
+    jnp = _jnp()
+    x = one(inputs, "X")
+    step = one(inputs, "current_step").reshape(())
+    rampup = attrs.get("rampup_begin_step", 0.0)
+    max_norm = attrs.get("max_norm", 1.0)
+    norm = jnp.sqrt(jnp.sum(x * x))
+    clipped = x * jnp.minimum(max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": jnp.where(step < rampup, x, clipped)}
+
+
+@register_op("max_pool2d_with_index")
+def max_pool2d_with_index(inputs, attrs):
+    """reference: pool_with_index_op.cc — max pool + flat argmax index
+    per window (feeds unpool)."""
+    jax, jnp = _jax(), _jnp()
+    x = one(inputs, "X")
+    ks = attrs.get("ksize", [2, 2])
+    st = attrs.get("strides", ks)
+    N, C, H, W = x.shape
+    kh, kw = int(ks[0]), int(ks[1])
+    sh, sw = int(st[0]), int(st[1])
+    oh = (H - kh) // sh + 1
+    ow = (W - kw) // sw + 1
+    # window extraction: [N, C, oh, ow, kh*kw]
+    wins = []
+    for i in range(kh):
+        for j in range(kw):
+            wins.append(x[:, :, i:i + oh * sh:sh, j:j + ow * sw:sw])
+    stack = jnp.stack(wins, axis=-1)
+    out = stack.max(axis=-1)
+    local = stack.argmax(axis=-1)  # index into kh*kw
+    li = local // kw
+    lj = local % kw
+    gy = jnp.arange(oh)[None, None, :, None] * sh + li
+    gx = jnp.arange(ow)[None, None, None, :] * sw + lj
+    return {"Out": out, "Mask": (gy * W + gx).astype("int32")}
+
+
+@register_op("unpool", no_grad_set={"Indices"})
+def unpool(inputs, attrs):
+    """reference: unpool_op.cc — scatter pooled values back to the
+    argmax positions recorded by max_pool2d_with_index."""
+    jnp = _jnp()
+    x = one(inputs, "X")  # [N, C, oh, ow]
+    idx = one(inputs, "Indices").astype("int32")
+    out_h, out_w = attrs.get("unpooled_size", None) or (
+        x.shape[2] * attrs.get("ksize", [2, 2])[0],
+        x.shape[3] * attrs.get("ksize", [2, 2])[1])
+    N, C, oh, ow = x.shape
+    flat = jnp.zeros((N, C, int(out_h) * int(out_w)), x.dtype)
+    n_i = jnp.arange(N)[:, None, None]
+    c_i = jnp.arange(C)[None, :, None]
+    out = flat.at[n_i, c_i, idx.reshape(N, C, -1)].add(
+        x.reshape(N, C, -1))
+    return {"Out": out.reshape(N, C, int(out_h), int(out_w))}
+
+
+@register_op("spp")
+def spp(inputs, attrs):
+    """reference: spp_op.cc — spatial pyramid pooling: concat bins of
+    adaptive 1x1, 2x2, ... 2^(L-1) pools."""
+    jnp = _jnp()
+    from paddle_tpu.core.registry import get_kernel
+
+    x = one(inputs, "X")
+    levels = int(attrs.get("pyramid_height", 2))
+    ptype = attrs.get("pooling_type", "max")
+    ap = get_kernel("adaptive_pool2d")
+    feats = []
+    N, C = x.shape[:2]
+    for l in range(levels):
+        bins = 2 ** l
+        pooled = ap({"X": [x]}, {"pool_size": [bins, bins],
+                                 "pooling_type": ptype})["Out"]
+        feats.append(pooled.reshape(N, -1))
+    return {"Out": jnp.concatenate(feats, axis=1)}
+
+
+@register_op("sample_logits", differentiable=False, no_grad_set={"Labels"})
+def sample_logits(inputs, attrs):
+    """reference: sample_logits_op.cc — gather true-label logits plus
+    num_samples uniformly-sampled negative logits (the sampled-softmax
+    front half)."""
+    import jax as j
+
+    jnp = _jnp()
+    logits = one(inputs, "Logits")  # [B, C]
+    labels = one(inputs, "Labels").reshape(-1).astype("int32")  # [B]
+    num = int(attrs.get("num_samples", 5))
+    B, C = logits.shape
+    key = prng(int(attrs.get("seed", 0)) or 7919)
+    samples = j.random.randint(key, (B, num), 0, C)
+    all_idx = jnp.concatenate([labels[:, None], samples], axis=1)  # [B, 1+num]
+    sampled = jnp.take_along_axis(logits, all_idx, axis=1)
+    return {"SampledLogits": sampled, "Samples": all_idx.astype("int64"),
+            "SampledLabels": jnp.zeros((B,), "int64")}
+
+
+@register_op("precision_recall", differentiable=False)
+def precision_recall(inputs, attrs):
+    """reference: precision_recall_op.cc — per-class macro/micro
+    precision/recall/F1 from predictions+labels (+ running state)."""
+    jnp = _jnp()
+    pred = one(inputs, "Indices").reshape(-1).astype("int32")
+    label = one(inputs, "Labels").reshape(-1).astype("int32")
+    k = int(attrs["class_number"])
+    states = maybe(inputs, "StatesInfo")
+    tp = jnp.zeros((k,)).at[pred].add((pred == label).astype("float32"))
+    fp = jnp.zeros((k,)).at[pred].add((pred != label).astype("float32"))
+    fn = jnp.zeros((k,)).at[label].add((pred != label).astype("float32"))
+    state = jnp.stack([tp, fp, jnp.zeros((k,)), fn], axis=1)  # [k, 4]
+    if states is not None:
+        state = state + states
+    tp_a, fp_a, fn_a = state[:, 0], state[:, 1], state[:, 3]
+    prec = jnp.where(tp_a + fp_a > 0, tp_a / jnp.maximum(tp_a + fp_a, 1), 0.0)
+    rec = jnp.where(tp_a + fn_a > 0, tp_a / jnp.maximum(tp_a + fn_a, 1), 0.0)
+    f1 = jnp.where(prec + rec > 0, 2 * prec * rec / jnp.maximum(prec + rec, 1e-9), 0.0)
+    macro = jnp.stack([prec.mean(), rec.mean(), f1.mean()])
+    tps, fps, fns = tp_a.sum(), fp_a.sum(), fn_a.sum()
+    mp = tps / jnp.maximum(tps + fps, 1.0)
+    mr = tps / jnp.maximum(tps + fns, 1.0)
+    micro = jnp.stack([mp, mr, jnp.where(mp + mr > 0, 2 * mp * mr / jnp.maximum(mp + mr, 1e-9), 0.0)])
+    return {"BatchMetrics": jnp.concatenate([macro, micro]),
+            "AccumMetrics": jnp.concatenate([macro, micro]),
+            "AccumStatesInfo": state}
+
+
+@register_op("positive_negative_pair", differentiable=False)
+def positive_negative_pair(inputs, attrs):
+    """reference: positive_negative_pair_op.cc — ranking PN-pair stat
+    per query: pairs where a higher-labeled item scores higher (pos),
+    lower (neg), equal (neutral)."""
+    jnp = _jnp()
+    score = one(inputs, "Score").reshape(-1)
+    label = one(inputs, "Label").reshape(-1)
+    qid = one(inputs, "QueryID").reshape(-1)
+    same_q = qid[:, None] == qid[None, :]
+    higher = label[:, None] > label[None, :]
+    valid = same_q & higher
+    s_diff = score[:, None] - score[None, :]
+    pos = jnp.sum(valid & (s_diff > 0))
+    neg = jnp.sum(valid & (s_diff < 0))
+    neu = jnp.sum(valid & (s_diff == 0))
+    f = lambda v: v.astype("float32").reshape(1, 1)
+    return {"PositivePair": f(pos), "NegativePair": f(neg),
+            "NeutralPair": f(neu)}

@@ -600,3 +600,74 @@ def test_reader_decorators_surface():
         fluid.layers.read_file(None)
     with pytest.raises(NotImplementedError):
         fluid.layers.open_files([], [], [], [])
+
+
+def test_registry_tail_kernels():
+    """Small-op registry tail (reference: hinge_loss_op.cc,
+    modified_huber_loss_op.cc, conv_shift_op.cc, pool_with_index_op.cc,
+    unpool_op.cc, spp_op.cc, precision_recall_op.cc,
+    positive_negative_pair_op.cc, proximal_*_op.cc + aliases)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import registry
+
+    K = registry.get_kernel
+    rng = np.random.RandomState(0)
+
+    # pool-with-index -> unpool scatters maxima back to argmax positions
+    x = rng.rand(1, 2, 4, 4).astype("float32")
+    o = K("max_pool2d_with_index")({"X": [jnp.asarray(x)]},
+                                   {"ksize": [2, 2], "strides": [2, 2]})
+    up = np.asarray(K("unpool")({"X": [o["Out"]], "Indices": [o["Mask"]]},
+                                {"unpooled_size": [4, 4]})["Out"])
+    assert np.isclose(up.sum(), np.asarray(o["Out"]).sum())
+    for c in range(2):
+        for i in range(2):
+            for j in range(2):
+                win = x[0, c, 2 * i:2 * i + 2, 2 * j:2 * j + 2]
+                pos = int(np.asarray(o["Mask"])[0, c, i, j])
+                assert abs(up[0, c].ravel()[pos] - win.max()) < 1e-6
+
+    # modified huber golden
+    pred = np.array([0.5, -2.0, 0.2], "float32")
+    y = np.array([1.0, 1.0, 0.0], "float32")
+    z = pred * (2 * y - 1)
+    out = np.asarray(K("modified_huber_loss")(
+        {"X": [jnp.asarray(pred)], "Y": [jnp.asarray(y)]}, {})["Out"])
+    np.testing.assert_allclose(
+        out, np.where(z >= -1, np.maximum(1 - z, 0) ** 2, -4 * z), rtol=1e-6)
+
+    # circular conv_shift golden
+    xs = rng.rand(2, 5).astype("float32")
+    ys = rng.rand(2, 3).astype("float32")
+    out = np.asarray(K("conv_shift")(
+        {"X": [jnp.asarray(xs)], "Y": [jnp.asarray(ys)]}, {})["Out"])
+    exp = np.zeros_like(xs)
+    for b in range(2):
+        for i in range(5):
+            for j in range(3):
+                exp[b, i] += xs[b, (i + j - 1) % 5] * ys[b, j]
+    np.testing.assert_allclose(out, exp, atol=1e-5)
+
+    # spp concat size; precision_recall micro; pn pairs
+    o = K("spp")({"X": [jnp.asarray(rng.rand(2, 3, 8, 8).astype("float32"))]},
+                 {"pyramid_height": 3})
+    assert o["Out"].shape == (2, 3 * 21)
+    pr = K("precision_recall")(
+        {"Indices": [jnp.asarray(np.array([0, 1, 2, 1]))],
+         "Labels": [jnp.asarray(np.array([0, 2, 2, 1]))]},
+        {"class_number": 3})
+    assert abs(float(np.asarray(pr["BatchMetrics"])[3]) - 0.75) < 1e-6
+    pn = K("positive_negative_pair")(
+        {"Score": [jnp.asarray(np.array([0.9, 0.2, 0.5, 0.6], "float32"))],
+         "Label": [jnp.asarray(np.array([1.0, 0.0, 1.0, 0.0], "float32"))],
+         "QueryID": [jnp.asarray(np.array([1, 1, 2, 2], "int32"))]}, {})
+    assert float(np.asarray(pn["PositivePair"])[0, 0]) == 1.0
+    assert float(np.asarray(pn["NegativePair"])[0, 0]) == 1.0
+
+    # aliases resolve to kernels
+    for n in ["squeeze", "flatten", "lstm", "gru", "fill", "minus",
+              "hinge_loss", "l1_norm", "squared_l2_distance",
+              "sample_logits", "dgc_clip_by_norm", "proximal_gd",
+              "proximal_adagrad", "fill_any_like", "squared_l2_norm"]:
+        registry.get_kernel(n)
