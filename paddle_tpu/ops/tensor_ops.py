@@ -168,20 +168,35 @@ def _reshape(x, shape):
 
 
 def _reshape_infer(op, block):
-    """Compile-time shape for reshape: a -1 target dim stays -1 when the
-    input has dynamic dims (eval_shape would bake the dummy batch
-    stand-in into a STATIC wrong dim and poison downstream inference —
-    e.g. reshaping [B, S] lengths to [-1] next to a [B*S, W, D] tensor)."""
+    """Compile-time shape for reshape.
+
+    A -1 target dim resolves statically when it is independent of the
+    input's dynamic dims — i.e. every -1 input dim is copied through to
+    the output via a ``0`` target at the same position (then
+    -1 = prod(static in dims) / prod(static out dims)).  Otherwise the
+    -1 stays dynamic: the old eval_shape fallback baked the dummy-batch
+    stand-in into a STATIC wrong dim (e.g. reshaping [B, S] lengths to
+    [-1] next to a [B*S, W, D] tensor), and downstream ops like concat
+    then fabricated sums of dummy dims."""
     x = block.var(op.inputs["X"][0])
     if x.shape is None:
         return
     xshape = list(x.shape)
     tgt = [int(s) for s in op.attrs["shape"]]
     out = [xshape[i] if s == 0 and i < len(xshape) else s for i, s in enumerate(tgt)]
-    if -1 in out and not any(s == -1 for s in xshape):
-        total = int(np.prod(xshape))
-        known = int(np.prod([s for s in out if s != -1])) or 1
-        out[out.index(-1)] = total // known
+    if -1 in out:
+        dyn_in = [i for i, s in enumerate(xshape) if s == -1]
+        copied = all(
+            i < len(tgt) and tgt[i] == 0 for i in dyn_in
+        )
+        if copied:
+            neg = [i for i, s in enumerate(tgt) if s == -1]
+            if len(neg) == 1:
+                known_in = int(np.prod([s for s in xshape if s != -1])) or 1
+                known_out = int(np.prod(
+                    [s for i, s in enumerate(out) if s > 0 and i != neg[0]]
+                )) or 1
+                out[neg[0]] = known_in // known_out
     v = block._find_var_recursive(op.outputs["Out"][0])
     if v is not None:
         v.shape = tuple(out)
