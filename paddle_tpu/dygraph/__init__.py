@@ -39,6 +39,8 @@ from paddle_tpu.dygraph.nn import (  # noqa: F401
     NCE,
     Pool2D,
     PRelu,
+    RowConv,
+    SequenceConv,
     SpectralNorm,
 )
 from paddle_tpu.dygraph.parallel import DataParallel, prepare_context  # noqa: F401

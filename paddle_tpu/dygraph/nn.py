@@ -15,7 +15,7 @@ __all__ = [
     "Conv2D", "FC", "Linear", "BatchNorm", "Embedding", "LayerNorm",
     "Pool2D", "Conv2DTranspose", "GroupNorm", "PRelu", "SpectralNorm",
     "GRUUnit", "NCE", "BilinearTensorProduct", "Conv3D",
-    "Conv3DTranspose", "TreeConv",
+    "Conv3DTranspose", "TreeConv", "RowConv", "SequenceConv",
 ]
 
 
@@ -728,5 +728,79 @@ class TreeConv(Layer):
             helper.append_op(type="elementwise_add",
                              inputs={"X": [out], "Y": [self.bias]},
                              outputs={"Out": [tmp]}, attrs={"axis": 3})
+            out = tmp
+        return helper.append_activation(out)
+
+
+class RowConv(Layer):
+    """reference: dygraph/nn.py RowConv — lookahead conv over padded
+    sequences [B, T, D]."""
+
+    def __init__(self, name_scope=None, future_context_size=2,
+                 param_attr=None, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._k = int(future_context_size)
+        self._param_attr = param_attr
+        self._act = act
+
+    def forward(self, input, seq_len=None):
+        from paddle_tpu.layer_helper import LayerHelper
+
+        if not hasattr(self, "weight"):
+            d = int(input.shape[-1])
+            helper = LayerHelper(self._full_name, param_attr=self._param_attr)
+            self.weight = helper.create_parameter(
+                self._param_attr, shape=[self._k + 1, d], dtype=self._dtype)
+        helper = LayerHelper(self._full_name, act=self._act)
+        out = helper.create_variable_for_type_inference(self._dtype)
+        ins = {"X": [input], "Filter": [self.weight]}
+        if seq_len is not None:
+            ins["SeqLen"] = [seq_len]
+        helper.append_op(type="row_conv", inputs=ins,
+                         outputs={"Out": [out]}, attrs={})
+        return helper.append_activation(out)
+
+
+class SequenceConv(Layer):
+    """reference: dygraph/nn.py SequenceConv — context-window conv over
+    padded sequences [B, T, D]."""
+
+    def __init__(self, name_scope=None, num_filters=None, filter_size=3,
+                 filter_stride=1, padding=None, bias_attr=None,
+                 param_attr=None, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._num_filters = num_filters
+        self._filter_size = int(filter_size)
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act = act
+
+    def forward(self, input, seq_len=None):
+        from paddle_tpu.layer_helper import LayerHelper
+
+        if not hasattr(self, "weight"):
+            d = int(input.shape[-1])
+            helper = LayerHelper(self._full_name, param_attr=self._param_attr,
+                                 bias_attr=self._bias_attr)
+            self.weight = helper.create_parameter(
+                self._param_attr, shape=[self._filter_size * d, self._num_filters],
+                dtype=self._dtype)
+            self.bias = helper.create_parameter(
+                self._bias_attr, shape=[self._num_filters], dtype=self._dtype,
+                is_bias=True)
+        helper = LayerHelper(self._full_name, act=self._act)
+        out = helper.create_variable_for_type_inference(self._dtype)
+        ins = {"X": [input], "Filter": [self.weight]}
+        if seq_len is not None:
+            ins["SeqLen"] = [seq_len]
+        helper.append_op(
+            type="sequence_conv", inputs=ins, outputs={"Out": [out]},
+            attrs={"contextStart": -(self._filter_size // 2),
+                   "contextLength": self._filter_size, "contextStride": 1})
+        if self.bias is not None:
+            tmp = helper.create_variable_for_type_inference(self._dtype)
+            helper.append_op(type="elementwise_add",
+                             inputs={"X": [out], "Y": [self.bias]},
+                             outputs={"Out": [tmp]}, attrs={"axis": 2})
             out = tmp
         return helper.append_activation(out)

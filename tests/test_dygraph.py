@@ -257,3 +257,23 @@ def test_dygraph_lr_decay_and_3d_layers():
         tc = dygraph.TreeConv(output_size=4, num_filters=2)
         out = tc(to_variable(feats), to_variable(edges))
         assert tuple(out.numpy().shape) == (1, 5, 4, 2)
+
+
+def test_rowconv_seqconv_layers_train():
+    rng = np.random.RandomState(11)
+    xb = rng.randn(3, 6, 5).astype("float32")
+    with dygraph.guard():
+        rc = dygraph.RowConv(future_context_size=2)
+        sc = dygraph.SequenceConv(num_filters=4, filter_size=3)
+        opt = fluid.optimizer.SGDOptimizer(0.05)
+        losses = []
+        for _ in range(4):
+            h = sc(rc(to_variable(xb)))
+            assert tuple(h.numpy().shape) == (3, 6, 4)
+            loss = fluid.layers.mean(h * h)
+            loss.backward()
+            opt.minimize(loss, parameter_list=rc.parameters() + sc.parameters())
+            rc.clear_gradients()
+            sc.clear_gradients()
+            losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
