@@ -60,6 +60,8 @@ from paddle_tpu import incubate
 from paddle_tpu import io
 from paddle_tpu import reader
 from paddle_tpu import dataset
+from paddle_tpu import flags
+from paddle_tpu.flags import get_flags, set_flags
 from paddle_tpu import nets
 from paddle_tpu import dygraph_grad_clip
 from paddle_tpu import recordio_writer

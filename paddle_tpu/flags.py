@@ -1,0 +1,122 @@
+"""Unified runtime flag registry (reference: the gflags tier —
+paddle/fluid/platform/init.cc InitGflags + python/paddle/fluid/
+__init__.py __bootstrap__'s read_env_flags list; flags are set via
+``FLAGS_*`` environment variables or programmatically).
+
+Every flag has a typed default and a docstring; point-of-use code reads
+through ``flags.get_flags`` so environment overrides, ``set_flags``
+calls, and defaults resolve in one place.  The reference's GPU-specific
+allocator/cudnn knobs map onto their XLA/PJRT equivalents where one
+exists and are accepted-but-inert (with their mapping documented)
+otherwise — the same contract as BuildStrategy's XLA-subsumed knobs.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+__all__ = ["DEFINE_flag", "get_flags", "set_flags", "flag_doc"]
+
+_REGISTRY: Dict[str, dict] = {}
+_OVERRIDES: Dict[str, Any] = {}
+
+
+def DEFINE_flag(name: str, default, doc: str, mapping: str = ""):
+    """Register a flag (the gflags DEFINE_* analog)."""
+    _REGISTRY[name] = {"default": default, "doc": doc, "mapping": mapping,
+                       "type": type(default)}
+
+
+def _coerce(value, ty):
+    if ty is bool:
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes")
+        return bool(value)
+    return ty(value)
+
+
+def get_flags(names):
+    """Resolve flags: set_flags() override > FLAGS_* env > default.
+    Accepts one name or a list; returns {name: value}."""
+    single = isinstance(names, str)
+    out = {}
+    for name in [names] if single else names:
+        key = name if name.startswith("FLAGS_") else "FLAGS_" + name
+        if key not in _REGISTRY:
+            raise KeyError("unknown flag %r (known: %s)"
+                           % (key, sorted(_REGISTRY)))
+        spec = _REGISTRY[key]
+        if key in _OVERRIDES:
+            out[key] = _OVERRIDES[key]
+        elif key in os.environ:
+            out[key] = _coerce(os.environ[key], spec["type"])
+        else:
+            out[key] = spec["default"]
+    return out
+
+
+def set_flags(flags: Dict[str, Any]):
+    """Programmatic override (reference: fluid.set_flags).  Also mirrors
+    into the environment so point-of-use os.environ reads agree."""
+    for name, value in flags.items():
+        key = name if name.startswith("FLAGS_") else "FLAGS_" + name
+        if key not in _REGISTRY:
+            raise KeyError("unknown flag %r" % key)
+        spec = _REGISTRY[key]
+        _OVERRIDES[key] = _coerce(value, spec["type"])
+        if spec["type"] is bool:
+            os.environ[key] = "1" if _OVERRIDES[key] else "0"
+        else:
+            os.environ[key] = str(_OVERRIDES[key])
+
+
+def flag_doc(name: str) -> str:
+    key = name if name.startswith("FLAGS_") else "FLAGS_" + name
+    spec = _REGISTRY[key]
+    extra = (" [maps to: %s]" % spec["mapping"]) if spec["mapping"] else ""
+    return "%s (default %r)%s" % (spec["doc"], spec["default"], extra)
+
+
+# ---------------------------------------------------------------------------
+# the registry (reference list: python/paddle/fluid/__init__.py
+# __bootstrap__ read_env_flags + gpu-only tail)
+# ---------------------------------------------------------------------------
+DEFINE_flag("FLAGS_check_nan_inf", False,
+            "check every fetched/updated tensor for nan/inf after the "
+            "compiled step (module-boundary analog of the per-op check)",
+            "executor.py run()")
+DEFINE_flag("FLAGS_allow_place_fallback", False,
+            "silently fall back to CPU when the requested device is "
+            "unavailable instead of raising",
+            "executor.py _device()")
+DEFINE_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.9,
+            "fraction of device memory the process may claim",
+            "XLA_PYTHON_CLIENT_MEM_FRACTION (memory.py seeds it)")
+DEFINE_flag("FLAGS_eager_delete_tensor_gb", 0.0,
+            "reference GC threshold; XLA buffer assignment owns tensor "
+            "lifetime on this build (accepted, inert)")
+DEFINE_flag("FLAGS_allocator_strategy", "auto_growth",
+            "reference allocator choice; PJRT's BFC allocator is the "
+            "only allocator here (accepted, inert)")
+DEFINE_flag("FLAGS_cudnn_deterministic", True,
+            "deterministic kernels; XLA is deterministic by default "
+            "(accepted, inert)")
+DEFINE_flag("FLAGS_benchmark", False,
+            "reference per-op benchmark mode; use profiler.py / "
+            "jax.profiler traces (accepted, inert)")
+DEFINE_flag("FLAGS_use_mkldnn", False,
+            "reference CPU fastpath; XLA owns CPU codegen "
+            "(accepted, inert)")
+DEFINE_flag("FLAGS_paddle_num_threads", 1,
+            "reference CPU op threads; maps to host batch-prefetch "
+            "depth (TrainerDesc.set_thread)")
+DEFINE_flag("FLAGS_init_allocated_mem", False,
+            "poison fresh allocations; XLA buffers are always "
+            "zero/overwritten before read (accepted, inert)")
+DEFINE_flag("FLAGS_limit_of_tmp_allocation", -1,
+            "reference temp-allocator cap (accepted, inert)")
+DEFINE_flag("FLAGS_rpc_deadline", 180000,
+            "PS RPC deadline in ms", "distributed/ps.py socket timeouts")
+DEFINE_flag("FLAGS_rpc_retry_times", 3,
+            "PS send retries before surfacing the error",
+            "distributed/communicator.py max_retries")
