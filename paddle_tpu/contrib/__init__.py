@@ -2,3 +2,15 @@
 from paddle_tpu.contrib import mixed_precision  # noqa: F401
 from paddle_tpu.contrib import slim  # noqa: F401
 from paddle_tpu.contrib import float16  # noqa: F401,E402
+from paddle_tpu.contrib import memory_usage_calc  # noqa: F401,E402
+from paddle_tpu.contrib import model_stat  # noqa: F401,E402
+from paddle_tpu.contrib import op_frequence  # noqa: F401,E402
+from paddle_tpu.contrib import extend_optimizer  # noqa: F401,E402
+from paddle_tpu.contrib import quantize  # noqa: F401,E402
+from paddle_tpu.contrib import reader  # noqa: F401,E402
+from paddle_tpu.contrib import utils  # noqa: F401,E402
+from paddle_tpu.contrib import decoder  # noqa: F401,E402
+from paddle_tpu.contrib import layers  # noqa: F401,E402
+from paddle_tpu.contrib.memory_usage_calc import memory_usage  # noqa: F401,E402
+from paddle_tpu.contrib.op_frequence import op_freq_statistic  # noqa: F401,E402
+from paddle_tpu.contrib.model_stat import summary  # noqa: F401,E402

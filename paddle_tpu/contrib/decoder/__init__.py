@@ -1,0 +1,35 @@
+"""Beam-search decoder API surface (reference: contrib/decoder/
+beam_search_decoder.py — InitState/StateCell/TrainingDecoder/
+BeamSearchDecoder built on the reference's While-op machinery).
+
+The TPU-native decode path is ``paddle_tpu.decoding.beam_search`` — the
+whole search compiled as one lax.scan (tests/test_seq2seq_decode.py);
+these classes raise with that pointer instead of half-implementing the
+While-op state-cell protocol."""
+from __future__ import annotations
+
+__all__ = ["InitState", "StateCell", "TrainingDecoder", "BeamSearchDecoder"]
+
+_MSG = ("the While-op decoder protocol is replaced by the compiled "
+        "whole-search paddle_tpu.decoding.beam_search / greedy_search "
+        "(see tests/test_seq2seq_decode.py)")
+
+
+class InitState:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("InitState: " + _MSG)
+
+
+class StateCell:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("StateCell: " + _MSG)
+
+
+class TrainingDecoder:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("TrainingDecoder: " + _MSG)
+
+
+class BeamSearchDecoder:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("BeamSearchDecoder: " + _MSG)

@@ -1,0 +1,52 @@
+"""Decoupled weight decay wrapper (reference: contrib/extend_optimizer/
+extend_optimizer_with_weight_decay.py — subtracts lr*coeff*param_prev
+after the base optimizer's update, AdamW-style)."""
+from __future__ import annotations
+
+__all__ = ["extend_with_decoupled_weight_decay"]
+
+
+def extend_with_decoupled_weight_decay(base_optimizer):
+    """Returns a subclass of ``base_optimizer`` taking an extra
+    ``weight_decay`` argument; the decay applies to the PRE-update param
+    value, decoupled from the gradient (reference semantics)."""
+    from paddle_tpu import framework
+    from paddle_tpu.layer_helper import LayerHelper
+
+    class OptimizerWithDecoupledWeightDecay(base_optimizer):
+        def __init__(self, weight_decay=0.0, *args, **kwargs):
+            super().__init__(*args, **kwargs)
+            self._decoupled_weight_decay = float(weight_decay)
+
+        def _append_optimize_op(self, block, param_and_grad):
+            param = param_and_grad[0]
+            coeff = self._decoupled_weight_decay
+            if not coeff:
+                return super()._append_optimize_op(block, param_and_grad)
+            helper = LayerHelper("decoupled_wd")
+            # snapshot the pre-update value
+            snap = helper.create_variable_for_type_inference(param.dtype)
+            block.append_op(type="assign", inputs={"X": [param.name]},
+                            outputs={"Out": [snap.name]}, attrs={})
+            op = super()._append_optimize_op(block, param_and_grad)
+            # param -= lr * coeff * snapshot
+            lr = self._create_param_lr(param)
+            scaled = helper.create_variable_for_type_inference(param.dtype)
+            block.append_op(
+                type="elementwise_mul",
+                inputs={"X": [snap.name], "Y": [lr.name]},
+                outputs={"Out": [scaled.name]}, attrs={})
+            dec = helper.create_variable_for_type_inference(param.dtype)
+            block.append_op(
+                type="scale", inputs={"X": [scaled.name]},
+                outputs={"Out": [dec.name]}, attrs={"scale": coeff})
+            block.append_op(
+                type="elementwise_sub",
+                inputs={"X": [param.name], "Y": [dec.name]},
+                outputs={"Out": [param.name]},
+                attrs={"op_role": "optimize"})
+            return op
+
+    OptimizerWithDecoupledWeightDecay.__name__ = (
+        "DecoupledWeightDecay" + base_optimizer.__name__)
+    return OptimizerWithDecoupledWeightDecay

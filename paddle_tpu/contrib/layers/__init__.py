@@ -1,0 +1,4 @@
+"""contrib layers (reference: contrib/layers/nn.py + metric_op.py)."""
+from paddle_tpu.contrib.layers.nn import fused_elemwise_activation  # noqa: F401
+
+__all__ = ["fused_elemwise_activation"]

@@ -1,0 +1,35 @@
+"""contrib layer fns (reference: contrib/layers/nn.py)."""
+from __future__ import annotations
+
+__all__ = ["fused_elemwise_activation"]
+
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=True):
+    """reference: contrib/layers/nn.py fused_elemwise_activation — the
+    fused CUDA kernel is an XLA-fusion no-op here: compose the named
+    functors (e.g. ['elementwise_add', 'scale']) and let the compiler
+    fuse them into one kernel."""
+    from paddle_tpu.layers import tensor as ltensor
+
+    supported = {"elementwise_add", "elementwise_sub", "elementwise_mul",
+                 "scale", "relu", "tanh", "sigmoid"}
+    unknown = [f for f in functor_list if f not in supported]
+    if unknown:
+        raise NotImplementedError(
+            "fused_elemwise_activation functors %s (supported: %s)"
+            % (unknown, sorted(supported)))
+    out = None
+    for f in functor_list:
+        if f.startswith("elementwise_"):
+            a = out if out is not None else x
+            out = getattr(ltensor, f)(a, y, axis=axis)
+        elif f == "scale":
+            a = out if out is not None else x
+            out = ltensor.scale(a, scale=scale)
+        else:
+            from paddle_tpu.layers import nn as lnn
+
+            a = out if out is not None else x
+            out = getattr(lnn, f)(a)
+    return out

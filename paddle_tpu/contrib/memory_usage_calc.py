@@ -1,0 +1,30 @@
+"""Memory-usage estimator (reference: contrib/memory_usage_calc.py —
+sums var element sizes, batch dim substituted, returns a (low, high)
+estimate range in the requested unit)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["memory_usage"]
+
+_DTYPE_BYTES = {"float16": 2, "bfloat16": 2, "float32": 4, "float64": 8,
+                "int8": 1, "int16": 2, "int32": 4, "int64": 8, "uint8": 1,
+                "bool": 1}
+
+
+def memory_usage(program, batch_size=1, unit="MB"):
+    """Estimate activation+parameter memory for one iteration.  Returns
+    (low, high) in ``unit`` — the reference brackets its estimate with
+    +/-30% for workspace variance; XLA fusion usually lands below the
+    low bound, so treat this as the reference-comparable ceiling."""
+    div = {"B": 1, "KB": 1 << 10, "MB": 1 << 20, "GB": 1 << 30}[unit]
+    total = 0
+    for var in program.list_vars():
+        if var.shape is None:
+            continue
+        n = 1
+        for s in var.shape:
+            n *= batch_size if int(s) == -1 else int(s)
+        total += n * _DTYPE_BYTES.get(str(var.dtype), 4)
+    est = total / div
+    return est * 0.7, est * 1.3

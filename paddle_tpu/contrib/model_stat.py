@@ -1,0 +1,27 @@
+"""Model summary (reference: contrib/model_stat.py summary — per-layer
+param counts + FLOPs table printed for a Program)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["summary"]
+
+
+def summary(main_prog, batch_size=1):
+    """Print a param/FLOPs table; returns (total_params, total_flops)."""
+    from paddle_tpu.contrib.slim.nas import program_flops
+
+    total_params = 0
+    rows = []
+    for p in main_prog.all_parameters():
+        n = int(np.prod([abs(int(s)) for s in p.shape]))
+        total_params += n
+        rows.append((p.name, tuple(p.shape), n))
+    flops = program_flops(main_prog)
+    print("%-40s %-20s %s" % ("param", "shape", "count"))
+    for name, shape, n in rows:
+        print("%-40s %-20s %d" % (name, shape, n))
+    print("total params: %d (%.2f M)" % (total_params, total_params / 1e6))
+    print("total FLOPs (matmul/conv, batch=%d): %.3f GFLOPs"
+          % (batch_size, flops / 1e9))
+    return total_params, flops

@@ -1,0 +1,20 @@
+"""Op-frequency statistics (reference: contrib/op_frequence.py
+op_freq_statistic — op-type histogram plus adjacent-pair counts)."""
+from __future__ import annotations
+
+from collections import Counter
+
+__all__ = ["op_freq_statistic"]
+
+
+def op_freq_statistic(program):
+    """Returns (single_op_counter, pair_op_counter) over all blocks."""
+    singles, pairs = Counter(), Counter()
+    for block in program.blocks:
+        prev = None
+        for op in block.ops:
+            singles[op.type] += 1
+            if prev is not None:
+                pairs[(prev, op.type)] += 1
+            prev = op.type
+    return singles, pairs

@@ -1,0 +1,33 @@
+"""Legacy quantize transpiler surface (reference: contrib/quantize/
+quantize_transpiler.py QuantizeTranspiler) — delegates to the slim QAT
+rewrite (contrib/slim/quantization.py), which is the maintained path."""
+from __future__ import annotations
+
+__all__ = ["QuantizeTranspiler"]
+
+
+class QuantizeTranspiler:
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="abs_max",
+                 weight_quantize_type="abs_max", window_size=10000):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+
+    def training_transpile(self, program=None, startup_program=None):
+        from paddle_tpu import framework
+        from paddle_tpu.contrib.slim.quantization import quantize_program
+
+        program = program or framework.default_main_program()
+        return quantize_program(
+            program, weight_bits=self.weight_bits,
+            activation_bits=self.activation_bits,
+        )
+
+    def freeze_program(self, program, place=None, scope=None):
+        # QAT fake-quant nodes simulate int8 at train time; freezing to a
+        # real int8 engine is an inference-engine concern out of scope
+        # here (document rather than silently no-op)
+        raise NotImplementedError(
+            "freeze_program: the QAT rewrite keeps fake-quant semantics; "
+            "int8 engine export is not part of this build"
+        )

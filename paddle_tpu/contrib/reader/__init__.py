@@ -1,0 +1,21 @@
+"""Distributed reader decorator (reference: contrib/reader/
+distributed_reader.py — each trainer yields its 1/Nth slice by
+PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["distributed_batch_reader"]
+
+
+def distributed_batch_reader(batch_reader):
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    trainers = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                  os.environ.get("PADDLE_TRAINERS", "1")))
+
+    def decorated():
+        for i, batch in enumerate(batch_reader()):
+            if i % trainers == trainer_id:
+                yield batch
+
+    return decorated

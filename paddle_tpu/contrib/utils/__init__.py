@@ -1,0 +1,84 @@
+"""contrib utils (reference: contrib/utils/ — HDFSClient over the
+hadoop CLI + lookup-table checkpoint helpers).
+
+HDFSClient delegates to io_fs's hadoop-CLI shim; the lookup-table
+helpers operate on the sparse PS via PSClient.save (the reference
+mutates pserver checkpoint dirs on disk)."""
+from __future__ import annotations
+
+__all__ = ["HDFSClient", "load_persistables_for_increment",
+           "load_persistables_for_inference"]
+
+
+class HDFSClient:
+    """reference: contrib/utils/hdfs_utils.py HDFSClient — thin verbs
+    over the hadoop CLI (io_fs implements the subprocess plumbing)."""
+
+    def __init__(self, hadoop_home=None, configs=None):
+        from paddle_tpu import io_fs
+
+        self._fs = io_fs
+
+    def is_exist(self, path):
+        return self._fs.fs_exists(path)
+
+    def is_dir(self, path):
+        try:
+            self._fs.fs_ls(path)
+            return True
+        except Exception:  # noqa: BLE001 — CLI error = not a dir
+            return False
+
+    def delete(self, path):
+        return self._fs.fs_rm(path)
+
+    def upload(self, hdfs_path, local_path, overwrite=False, retry_times=5):
+        with open(local_path, "rb") as src,                 self._fs.open_write(hdfs_path, "wb") as dst:
+            dst.write(src.read())
+
+    def download(self, hdfs_path, local_path, overwrite=False, retry_times=5):
+        with self._fs.open_read(hdfs_path, "rb") as src,                 open(local_path, "wb") as dst:
+            dst.write(src.read())
+
+    def ls(self, path):
+        return self._fs.fs_ls(path)
+
+    def lsr(self, path):
+        return self._fs.fs_ls(path)
+
+    def make_local_dirs(self, local_path):
+        import os
+
+        os.makedirs(local_path, exist_ok=True)
+
+    def makedirs(self, path):
+        return self._fs.fs_mkdir(path)
+
+    def rename(self, src, dst):
+        return self._fs.fs_mv(src, dst)
+
+
+def load_persistables_for_increment(dirname, executor, program,
+                                    lookup_table_var=None,
+                                    lookup_table_var_path=None):
+    """reference: contrib/utils/lookup_table_utils.py — resume training:
+    dense persistables from the checkpoint dir + sparse rows back onto
+    the PS (program._ps_client.push from the saved (ids, rows))."""
+    import numpy as np
+
+    from paddle_tpu import io as io_mod
+
+    io_mod.load_persistables(executor, dirname, main_program=program)
+    client = getattr(program, "_ps_client", None)
+    if client is not None and lookup_table_var_path:
+        data = np.load(lookup_table_var_path, allow_pickle=False)
+        client.push_sparse(lookup_table_var, data["ids"], data["rows"])
+
+
+def load_persistables_for_inference(dirname, executor, program,
+                                    lookup_table_var_name=None):
+    """reference: lookup_table_utils.py — inference: dense persistables
+    only; distributed lookups must be bound to a serving PS."""
+    from paddle_tpu import io as io_mod
+
+    io_mod.load_persistables(executor, dirname, main_program=program)

@@ -266,3 +266,84 @@ class DataLoader:
     @staticmethod
     def from_generator(feed_list=None, capacity=4, use_double_buffer=True, iterable=True, return_list=False):
         return PyReader(feed_list, capacity, use_double_buffer, iterable, return_list)
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """reference: python/paddle/reader/decorator.py xmap_readers — map
+    ``mapper`` over reader samples with a worker pool (threads here: the
+    mappers are numpy-bound and jax arrays must stay in-process)."""
+    import queue as _q
+    import threading
+
+    def decorated():
+        in_q: "_q.Queue" = _q.Queue(buffer_size)
+        out_q: "_q.Queue" = _q.Queue(buffer_size)
+        END = object()
+
+        def feed():
+            for i, sample in enumerate(reader()):
+                in_q.put((i, sample))
+            for _ in range(process_num):
+                in_q.put(END)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is END:
+                    out_q.put(END)
+                    return
+                i, sample = item
+                out_q.put((i, mapper(sample)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+        done = 0
+        pending = {}
+        next_i = 0
+        while done < process_num:
+            item = out_q.get()
+            if item is END:
+                done += 1
+                continue
+            if not order:
+                yield item[1]
+                continue
+            pending[item[0]] = item[1]
+            while next_i in pending:
+                yield pending.pop(next_i)
+                next_i += 1
+        if order:
+            for i in sorted(pending):
+                yield pending[i]
+
+    return decorated
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """reference: decorator.py multiprocess_reader — interleave several
+    readers concurrently.  Worker THREADS here instead of processes:
+    sample generation is numpy/IO-bound and fork would break the jax
+    runtime; the interleaving contract is the same."""
+    import queue as _q
+    import threading
+
+    def decorated():
+        out_q: "_q.Queue" = _q.Queue(queue_size)
+        END = object()
+
+        def work(r):
+            for sample in r():
+                out_q.put(sample)
+            out_q.put(END)
+
+        for r in readers:
+            threading.Thread(target=work, args=(r,), daemon=True).start()
+        done = 0
+        while done < len(readers):
+            item = out_q.get()
+            if item is END:
+                done += 1
+            else:
+                yield item
+
+    return decorated

@@ -411,3 +411,65 @@ def test_unified_flags_tier():
         assert fluid.get_flags("rpc_retry_times")["FLAGS_rpc_retry_times"] == 5
     finally:
         del os.environ["FLAGS_rpc_retry_times"]
+
+
+def test_contrib_tail_surface():
+    """contrib modules (reference: contrib/ memory_usage_calc,
+    op_frequence, model_stat, extend_optimizer, quantize, reader,
+    layers, utils, decoder)."""
+    import pytest
+
+    from paddle_tpu import framework, reader as R
+
+    prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [8])
+        out = fluid.layers.fc(fluid.layers.fc(x, 16, act="relu"), 4)
+    lo, hi = fluid.contrib.memory_usage(prog, batch_size=32)
+    assert 0 < lo < hi
+    singles, pairs = fluid.contrib.op_freq_statistic(prog)
+    assert singles["mul"] == 2 and pairs
+    n, _ = fluid.contrib.summary(prog)
+    assert n == 8 * 16 + 16 + 16 * 4 + 4
+
+    # AdamW: with zero grads the decoupled decay shrinks params by
+    # exactly lr*coeff*param
+    from paddle_tpu.contrib.extend_optimizer import (
+        extend_with_decoupled_weight_decay,
+    )
+
+    AdamW = extend_with_decoupled_weight_decay(fluid.optimizer.AdamOptimizer)
+    p2, s2 = framework.Program(), framework.Program()
+    p2.random_seed = s2.random_seed = 3
+    with framework.program_guard(p2, s2):
+        x = fluid.layers.data("x", [6])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1, bias_attr=False,
+                               param_attr=fluid.ParamAttr(name="aw_w"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        AdamW(weight_decay=0.1, learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.Scope()
+    with fluid.scope_guard(sc):
+        exe.run(s2)
+        w0 = np.asarray(sc.get("aw_w")).copy()
+        exe.run(p2, feed={"x": np.zeros((4, 6), "float32"),
+                          "y": np.zeros((4, 1), "float32")},
+                fetch_list=[loss])
+        w1 = np.asarray(sc.get("aw_w"))
+    np.testing.assert_allclose(w1, w0 - 0.01 * 0.1 * w0, atol=1e-5)
+
+    # reader decorators
+    def rdr():
+        for i in range(6):
+            yield i
+
+    assert list(R.xmap_readers(lambda v: v * 2, rdr, 2, 4, order=True)()) \
+        == [0, 2, 4, 6, 8, 10]
+    assert sorted(R.multiprocess_reader([rdr, rdr])()) == sorted(list(rdr()) * 2)
+
+    # honest raises
+    with pytest.raises(NotImplementedError):
+        fluid.contrib.decoder.BeamSearchDecoder()
+    with pytest.raises(NotImplementedError):
+        fluid.contrib.quantize.QuantizeTranspiler().freeze_program(prog)
