@@ -31,9 +31,14 @@ def _is_float_var(v: Variable) -> bool:
     return core_types.is_float_dtype(v.dtype)
 
 
-def _requires_grad_vars(block, extra_no_grad: Set[str]) -> Set[str]:
-    """Forward sweep: which vars can carry gradient back to a trainable leaf."""
-    req: Set[str] = set()
+def _requires_grad_vars(block, extra_no_grad: Set[str], extra_leaves: Set[str] = frozenset()) -> Set[str]:
+    """Forward sweep: which vars can carry gradient back to a trainable leaf.
+
+    ``extra_leaves``: var names treated as grad-carrying leaves regardless of
+    their stop_gradient flag (gradients()' ``inputs``, reference
+    backward.py:939 calc_gradient marks them the same way).
+    """
+    req: Set[str] = set(extra_leaves)
     for v in block.vars.values():
         if v.name in extra_no_grad:
             continue
@@ -122,49 +127,83 @@ def append_backward(
     Matches the reference contract (backward.py:558): loss must be a scalar
     (or shape-[1]) var in the main program's global block.
     """
-    block = loss.block
+    result, _ = _append_backward_impl([loss], [None], parameter_list, no_grad_set)
+    return result
+
+
+def _append_backward_impl(
+    targets: Sequence[Variable],
+    target_gradients: Sequence[Optional[Variable]],
+    parameter_list: Optional[Sequence],
+    no_grad_set: Optional[Set[str]],
+    extra_leaves: Set[str] = frozenset(),
+):
+    """Shared core of append_backward (single scalar loss) and gradients()
+    (multi-target calc_gradient, reference backward.py:821,939): seed each
+    target's output-grad (ones, or the caller's target_gradients var), walk
+    the block's ops once in reverse accumulating contributions, and return
+    [(param, grad)] for the trainable parameters.
+    """
+    block = targets[0].block
     program = block.program
+    for t in targets[1:]:
+        if t.block is not block:
+            raise ValueError("all gradient targets must live in one block")
+    target_names = {t.name for t in targets}
     extra_no_grad = set(no_grad_set or ())
     for v in program.list_vars():
         if v.stop_gradient and not isinstance(v, Parameter):
             extra_no_grad.add(v.name)
         if isinstance(v, Parameter) and not v.trainable:
             extra_no_grad.add(v.name)
-    extra_no_grad.discard(loss.name)
+    extra_no_grad -= target_names
+    extra_no_grad -= set(extra_leaves)
 
-    req = _requires_grad_vars(block, extra_no_grad - {loss.name})
-    if loss.name not in req:
-        raise ValueError(
-            "loss %r does not depend on any trainable parameter" % loss.name
-        )
+    req = _requires_grad_vars(block, extra_no_grad - target_names, extra_leaves)
+    for t in targets:
+        if t.name not in req:
+            raise ValueError(
+                "target %r does not depend on any trainable parameter or "
+                "requested input" % t.name
+            )
 
-    # locate the op producing the loss
+    # locate the last op producing any target
     loss_op_idx = None
     for i in reversed(range(len(block.ops))):
-        if loss.name in block.ops[i].output_arg_names:
+        if target_names & set(block.ops[i].output_arg_names):
             loss_op_idx = i
             break
     if loss_op_idx is None:
-        raise ValueError("loss %r is not produced by any op" % loss.name)
+        raise ValueError("no gradient target is produced by any op")
 
-    # init d(loss)/d(loss) = 1
-    loss_grad = grad_var_name(loss.name)
-    block.create_var(
-        name=loss_grad, shape=loss.shape or (1,), dtype=loss.dtype, stop_gradient=True
-    )
-    block.append_op(
-        type="fill_constant",
-        outputs={"Out": [loss_grad]},
-        attrs={
-            "shape": list(loss.shape or (1,)),
-            "value": 1.0,
-            "dtype": loss.dtype,
-            "op_role": "backward",
-        },
-    )
+    # seed d(target)/d(target): ones, or the caller-provided grad var
+    contributions: Dict[str, List[str]] = {}
+    for t, tg in zip(targets, target_gradients):
+        if tg is not None:
+            if tuple(tg.shape or ()) != tuple(t.shape or ()):
+                raise ValueError(
+                    "target_gradient %r shape %s != target %r shape %s"
+                    % (tg.name, tg.shape, t.name, t.shape)
+                )
+            contributions.setdefault(t.name, []).append(tg.name)
+            continue
+        loss_grad = grad_var_name(t.name)
+        block.create_var(
+            name=loss_grad, shape=t.shape or (1,), dtype=t.dtype, stop_gradient=True
+        )
+        block.append_op(
+            type="fill_constant",
+            outputs={"Out": [loss_grad]},
+            attrs={
+                "shape": list(t.shape or (1,)),
+                "value": 1.0,
+                "dtype": t.dtype,
+                "op_role": "backward",
+            },
+        )
+        contributions.setdefault(t.name, []).append(loss_grad)
 
     # reverse walk, accumulating grad contributions per forward var
-    contributions: Dict[str, List[str]] = {loss.name: [loss_grad]}
     finalized: Dict[str, str] = {}
 
     def aggregate(name: str) -> Optional[str]:
@@ -283,20 +322,49 @@ def append_backward(
             continue
         gvar = block._find_var_recursive(g)
         result.append((p, gvar))
+    # aggregate the requested input leaves (gradients()' inputs): multiple
+    # targets contribute separately-named grads; the summed var is what the
+    # caller must read, so hand its name back explicitly
+    leaf_grads: Dict[str, Optional[str]] = {
+        name: aggregate(name) for name in extra_leaves
+    }
     program.version += 1
-    return result
+    return result, leaf_grads
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
-    """reference: backward.py:939 — d(targets)/d(inputs)."""
-    targets = targets if isinstance(targets, (list, tuple)) else [targets]
-    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-    if len(targets) != 1:
-        raise NotImplementedError("gradients() currently supports one target")
-    pg = append_backward(targets[0], no_grad_set=no_grad_set, parameter_list=None)
+    """reference: backward.py:939 calc_gradient — d(sum of targets)/d(inputs).
+
+    Multiple targets are supported: each target's output-grad is seeded
+    (ones, or the matching ``target_gradients`` entry) and contributions
+    from all targets are summed into each input's grad, matching the
+    reference's multi-target semantics (backward.py:821).
+    """
+    targets = list(targets) if isinstance(targets, (list, tuple)) else [targets]
+    inputs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+    if target_gradients is None:
+        target_gradients = [None] * len(targets)
+    else:
+        target_gradients = (
+            list(target_gradients)
+            if isinstance(target_gradients, (list, tuple))
+            else [target_gradients]
+        )
+    if len(target_gradients) != len(targets):
+        raise ValueError(
+            "target_gradients length %d != targets length %d"
+            % (len(target_gradients), len(targets))
+        )
+    _, leaf_grads = _append_backward_impl(
+        targets,
+        target_gradients,
+        parameter_list=None,
+        no_grad_set=no_grad_set,
+        extra_leaves={iv.name for iv in inputs},
+    )
     block = targets[0].block
     out = []
     for iv in inputs:
-        g = block._find_var_recursive(grad_var_name(iv.name))
-        out.append(g)
+        g = leaf_grads.get(iv.name)
+        out.append(block._find_var_recursive(g) if g else None)
     return out

@@ -140,18 +140,12 @@ def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
 
 def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
                     name=None):
-    """reference: layers/nn.py adaptive_pool3d — via global/strided pool3d
-    when the input is divisible, else NotImplementedError (rare shapes)."""
-    d, h, w = (pool_size if isinstance(pool_size, (list, tuple))
-               else [pool_size] * 3)
-    D, H, W = (int(s) for s in input.shape[2:])
-    if (d, h, w) == (1, 1, 1):
-        return pool3d(input, pool_type=pool_type, global_pooling=True)
-    if D % d or H % h or W % w:
-        raise NotImplementedError(
-            "adaptive_pool3d needs divisible spatial dims on this build")
-    ks = [D // d, H // h, W // w]
-    return pool3d(input, pool_size=ks, pool_type=pool_type, pool_stride=ks)
+    """reference: layers/nn.py adaptive_pool3d — exact torch-style bins
+    (floor/ceil window edges), non-divisible shapes included."""
+    if require_index:
+        raise NotImplementedError("adaptive_pool3d require_index")
+    return _simple("adaptive_pool3d", {"X": [input]},
+                   {"pool_size": pool_size, "pooling_type": pool_type})[0]
 
 
 def resize_trilinear(input, out_shape=None, scale=None, name=None,
@@ -670,33 +664,89 @@ def lod_append(x, level):
 # -- decode / eval wrappers ------------------------------------------------
 def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
                 level=0, is_accumulated=True, name=None, return_parent_idx=False):
-    """reference: layers/nn.py beam_search.  The per-step expand/prune
-    op only makes sense inside the reference's While-op decode loop; the
-    TPU-native decode is the whole-search lax.scan in decoding.beam_search
-    (same beams, one compiled module) — use that instead."""
-    raise NotImplementedError(
-        "per-step beam_search: use paddle_tpu.decoding.beam_search (the "
-        "compiled whole-search TPU path, tests/test_seq2seq_decode.py)"
+    """Per-step beam selection inside a While decode loop (reference:
+    layers/nn.py beam_search:4406, beam_search_op.cc).  Static-shape
+    mapping: every source keeps a fixed beam_size lane width and finished
+    beams persist via end_id masking (see the op docstring); seed the
+    first step by feeding lane 0 score 0 and the other lanes -1e9.  The
+    whole-search alternative is paddle_tpu.decoding.beam_search (one
+    lax.scan module)."""
+    helper = LayerHelper("beam_search")
+    sel_ids = helper.create_variable_for_type_inference("int64")
+    sel_sc = helper.create_variable_for_type_inference(scores.dtype)
+    parent = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="beam_search",
+        inputs={"pre_ids": [pre_ids], "pre_scores": [pre_scores],
+                "ids": [ids], "scores": [scores]},
+        outputs={"selected_ids": [sel_ids], "selected_scores": [sel_sc],
+                 "parent_idx": [parent]},
+        attrs={"beam_size": int(beam_size), "end_id": int(end_id),
+               "level": int(level), "is_accumulated": bool(is_accumulated)},
     )
+    if return_parent_idx:
+        return sel_ids, sel_sc, parent
+    return sel_ids, sel_sc
 
 
-def beam_search_decode(ids, scores, beam_size, end_id, name=None):
-    """reference: layers/nn.py beam_search_decode (see beam_search)."""
-    raise NotImplementedError(
-        "beam_search_decode: paddle_tpu.decoding.beam_search returns the "
-        "decoded ids/scores directly"
+def beam_search_decode(ids, scores, beam_size, end_id, name=None,
+                       parents=None):
+    """Backtrack the per-step arrays into full sequences (reference:
+    layers/nn.py beam_search_decode, beam_search_decode_op.cc).
+
+    ``ids``/``scores`` are the stacked tensor-arrays [T, B*K, 1] the
+    decode loop array_write'd; ``parents`` [T, B*K] is the matching array
+    of beam_search parent_idx writes — the static encoding's replacement
+    for the reference's LoD-encoded parentage (pass it; only a loop that
+    never reorders beams could omit it).  Returns SentenceIds [B, K, T]
+    and SentenceScores [B, K], best-first."""
+    if parents is None:
+        raise ValueError(
+            "beam_search_decode on the static encoding needs the parents "
+            "array (array_write each step's beam_search parent_idx)"
+        )
+    helper = LayerHelper("beam_search_decode")
+    sent = helper.create_variable_for_type_inference("int64")
+    sc = helper.create_variable_for_type_inference(scores.dtype)
+    helper.append_op(
+        type="beam_search_decode",
+        inputs={"Ids": [ids], "Scores": [scores], "Parents": [parents]},
+        outputs={"SentenceIds": [sent], "SentenceScores": [sc]},
+        attrs={"beam_size": int(beam_size), "end_id": int(end_id)},
     )
+    return sent, sc
 
 
 def chunk_eval(input, label, chunk_scheme, num_chunk_types,
                excluded_chunk_types=None, seq_length=None):
-    """reference: layers/nn.py chunk_eval — host-side streaming metric
-    (metrics.ChunkEvaluator) fed via py_func is the supported path on
-    this build; the op surface raises to avoid silently wrong counts."""
-    raise NotImplementedError(
-        "chunk_eval: use paddle_tpu.metrics.ChunkEvaluator on fetched "
-        "predictions (host-side streaming metric)"
+    """reference: layers/nn.py chunk_eval (chunk_eval_op.h) — in-graph
+    chunk-level precision/recall/F1 on padded [B, T] predictions+labels
+    (+ optional per-row seq_length).  Returns the reference's 6-tuple
+    (precision, recall, f1, num_infer, num_label, num_correct); feed the
+    counts to metrics.ChunkEvaluator for streaming aggregation."""
+    helper = LayerHelper("chunk_eval")
+    outs = {
+        n: helper.create_variable_for_type_inference(
+            "float32" if i < 3 else "int64"
+        )
+        for i, n in enumerate(
+            ["Precision", "Recall", "F1-Score", "NumInferChunks",
+             "NumLabelChunks", "NumCorrectChunks"]
+        )
+    }
+    ins = {"Inference": [input], "Label": [label]}
+    if seq_length is not None:
+        ins["SeqLength"] = [seq_length]
+    helper.append_op(
+        type="chunk_eval", inputs=ins,
+        outputs={k: [v] for k, v in outs.items()},
+        attrs={"chunk_scheme": chunk_scheme,
+               "num_chunk_types": int(num_chunk_types),
+               "excluded_chunk_types": list(excluded_chunk_types or [])},
     )
+    return (outs["Precision"], outs["Recall"], outs["F1-Score"],
+            outs["NumInferChunks"], outs["NumLabelChunks"],
+            outs["NumCorrectChunks"])
 
 
 def sampled_softmax_with_cross_entropy(logits, label, num_samples,
@@ -705,13 +755,30 @@ def sampled_softmax_with_cross_entropy(logits, label, num_samples,
                                        customized_samples=None,
                                        customized_probabilities=None,
                                        seed=0):
-    """reference: layers/nn.py sampled_softmax_with_cross_entropy — the
-    NCE/sampled family; the nce op covers the sampled-loss use case on
-    this build, full sampled-softmax raises for honesty."""
-    raise NotImplementedError(
-        "sampled_softmax_with_cross_entropy: use layers.nce (sampled "
-        "loss) or full softmax_with_cross_entropy"
+    """reference: layers/nn.py sampled_softmax_with_cross_entropy
+    (sample_logits_op.cc + softmax CE) — fused kernel, see the op's
+    docstring.  Returns the [N, 1] loss."""
+    if use_customized_samples and (
+        customized_samples is None or customized_probabilities is None
+    ):
+        raise ValueError(
+            "sampled_softmax: use_customized_samples=True needs both "
+            "customized_samples and customized_probabilities"
+        )
+    helper = LayerHelper("sampled_softmax_with_cross_entropy")
+    loss = helper.create_variable_for_type_inference(logits.dtype)
+    ins = {"Logits": [logits], "Labels": [label]}
+    if use_customized_samples:
+        ins["CustomizedSamples"] = [customized_samples]
+        ins["CustomizedProbabilities"] = [customized_probabilities]
+    helper.append_op(
+        type="sampled_softmax_with_cross_entropy", inputs=ins,
+        outputs={"Loss": [loss]},
+        attrs={"num_samples": int(num_samples), "num_true": int(num_true),
+               "remove_accidental_hits": bool(remove_accidental_hits),
+               "seed": int(seed)},
     )
+    return loss
 
 
 # -- CTR / distillation / deformable / LSTM family -------------------------

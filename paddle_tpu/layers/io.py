@@ -37,21 +37,19 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True, stop
             stop_gradient=True,
             is_data=True,
         )
-    if lod_level > 1:
-        # nested (2-level) LoD: docs -> sentences -> words
-        # (reference: lod_tensor.h:110 multi-level offsets).  Padded
-        # encoding adds a per-outer-position inner length matrix
-        # [B, S1max]; rows past a doc's sentence count are zero.
+    # nested (N-level) LoD (reference: lod_tensor.h:110,:229 — recursively
+    # nested offsets).  Padded encoding: level k's companion length tensor
+    # has one entry per unit at level k-1, so its shape is [B, S1..Sk]
+    # (entries past a unit's child count are zero).  Level 1 keeps the
+    # historical ``_inner_len`` name; deeper levels are ``_inner_len_k``.
+    for level in range(1, lod_level):
+        suffix = "_inner_len" if level == 1 else "_inner_len_%d" % level
         block.create_var(
-            name=name + "_inner_len",
-            shape=[-1, -1],
+            name=name + suffix,
+            shape=[-1] * (level + 1),
             dtype="int32",
             stop_gradient=True,
             is_data=True,
-        )
-    if lod_level > 2:
-        raise NotImplementedError(
-            "padded LoD shim supports lod_level<=2 (docs->sents->words)"
         )
     return var
 

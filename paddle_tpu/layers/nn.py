@@ -786,14 +786,18 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
 def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
         bias_attr=None, num_neg_samples=10, name=None, sampler="uniform",
         custom_dist=None, seed=0, is_sparse=False):
-    """Noise-contrastive estimation loss -> [B, 1] cost.  uniform and
-    log_uniform (Zipfian) samplers with their log(k*P) corrections;
-    custom_dist remains open."""
-    if sampler not in ("uniform", "log_uniform") or custom_dist is not None or sample_weight is not None:
-        raise NotImplementedError(
-            "nce supports sampler='uniform'|'log_uniform' without "
-            "custom_dist/sample_weight"
-        )
+    """Noise-contrastive estimation loss -> [B, 1] cost.  uniform,
+    log_uniform (Zipfian), and custom_dist (a length-num_total_classes
+    probability sequence — the reference's CustomSampler,
+    operators/math/sampler.cc) samplers with their log(k*P) corrections;
+    ``sample_weight`` [B, 1] scales each example's cost
+    (reference: operators/nce_op.h sample_weight)."""
+    if custom_dist is not None:
+        sampler = "custom_dist"
+    if sampler not in ("uniform", "log_uniform", "custom_dist"):
+        raise ValueError("nce: unknown sampler %r" % sampler)
+    if sampler == "custom_dist" and custom_dist is None:
+        raise ValueError("nce: sampler='custom_dist' requires custom_dist")
     helper = LayerHelper("nce", param_attr=param_attr, bias_attr=bias_attr, name=name)
     dim = input.shape[-1]
     w = helper.create_parameter(param_attr, shape=[num_total_classes, dim], dtype=input.dtype)
@@ -802,10 +806,20 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
     ins = {"Input": [input], "Label": [label], "Weight": [w]}
     if b is not None:
         ins["Bias"] = [b]
-    helper.append_op(
-        type="nce", inputs=ins, outputs={"Cost": [cost]},
-        attrs={"num_neg_samples": num_neg_samples, "seed": seed, "sampler": sampler},
-    )
+    if sample_weight is not None:
+        ins["SampleWeight"] = [sample_weight]
+    attrs = {"num_neg_samples": num_neg_samples, "seed": seed, "sampler": sampler}
+    if custom_dist is not None:
+        import numpy as _np
+
+        dist = _np.asarray(custom_dist, dtype=_np.float32).reshape(-1)
+        if dist.shape[0] != num_total_classes:
+            raise ValueError(
+                "nce: custom_dist length %d != num_total_classes %d"
+                % (dist.shape[0], num_total_classes)
+            )
+        attrs["custom_dist"] = dist
+    helper.append_op(type="nce", inputs=ins, outputs={"Cost": [cost]}, attrs=attrs)
     return cost
 
 
@@ -1231,24 +1245,35 @@ def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0):
 
 def nested_sequence_pool(input, outer_len, inner_len, pool_type="sum",
                          inner_pool_type=None):
-    """Two-level LoD pooling on the padded nested encoding (reference:
-    nested-sequence semantics of lod_tensor.h:110 — a doc is a sequence
-    of sentences, each a sequence of words).
+    """N-level LoD pooling on the padded nested encoding (reference:
+    nested-sequence semantics of lod_tensor.h:110,:229 — recursively
+    nested sequences, e.g. doc -> sentence -> word).
 
-    input [B, S, W, D]; outer_len [B] docs' sentence counts; inner_len
-    [B, S] per-sentence word counts.  Pools words per sentence (level 1)
-    then sentences per doc (level 0); returns [B, D].  Implemented as
-    reshape to [B*S, W, D] + the standard sequence_pool twice — the
+    ``inner_len`` is one length tensor (2-level) or a list ordered
+    outer->inner (N-level): level k's tensor has shape [B, S1..Sk].
+    For input [B, S1, ..., SL, D...], pools the innermost level with
+    ``inner_pool_type`` (defaults to ``pool_type``), then each enclosing
+    level with ``pool_type``; returns [B, D...].  Each level is a
+    flatten-to-[prod, Sk, D] + standard masked sequence_pool — the
     static-shape equivalent of the reference's per-level LoD walk."""
     from paddle_tpu.layers import tensor as ltensor
 
-    inner_pool_type = inner_pool_type or pool_type
-    B, S = int(input.shape[0]), int(input.shape[1])
-    shape2 = [B * S if B > 0 else -1, int(input.shape[2])] + [
-        int(s) for s in input.shape[3:]
-    ]
-    flat = ltensor.reshape(input, shape=[-1] + shape2[1:])
-    flat_len = ltensor.reshape(inner_len, shape=[-1])
-    sent = sequence_pool(flat, inner_pool_type, seq_len=flat_len)  # [B*S, D]
-    docs = ltensor.reshape(sent, shape=[-1, S] + [int(s) for s in sent.shape[1:]])
-    return sequence_pool(docs, pool_type, seq_len=outer_len)
+    inners = list(inner_len) if isinstance(inner_len, (list, tuple)) else [inner_len]
+    lengths = [outer_len] + inners  # index k = level-k lengths, [B, S1..Sk]
+    L = len(lengths)
+    x = input
+    for k in range(L, 0, -1):
+        tail = [int(s) for s in x.shape[k:]]  # [Sk, D...]
+        flat = ltensor.reshape(x, shape=[-1] + tail)
+        ln = lengths[k - 1]
+        ln_flat = ltensor.reshape(ln, shape=[-1]) if k > 1 else ln
+        ptype = (inner_pool_type or pool_type) if k == L else pool_type
+        pooled = sequence_pool(flat, ptype, seq_len=ln_flat)  # [prod, D...]
+        if k > 1:
+            lead = [int(s) for s in input.shape[1:k]]
+            x = ltensor.reshape(
+                pooled, shape=[-1] + lead + [int(s) for s in pooled.shape[1:]]
+            )
+        else:
+            x = pooled
+    return x

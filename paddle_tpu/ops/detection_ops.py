@@ -400,10 +400,15 @@ def roi_align(inputs, attrs):
 
 @register_op("roi_pool", no_grad_set={"ROIs", "BatchIndex"})
 def roi_pool(inputs, attrs):
-    """reference: operators/roi_pool_op.cc — max pooling inside bins;
-    approximated by a dense 4x-oversampled bilinear grid + max (exact for
-    integer-aligned rois, differentiable everywhere)."""
-    jax = _jax()
+    """reference: operators/roi_pool_op.cc — EXACT argmax pooling: integer
+    bin edges hstart=floor(i*bin_h), hend=ceil((i+1)*bin_h) offset by the
+    rounded roi origin, max over each window, 0 for empty bins.
+
+    TPU-native: windows are runtime values, so instead of the reference's
+    per-bin gather loops the max is computed separably through boolean
+    row/column window masks (-inf outside) — XLA fuses the masked
+    broadcasts into the two reduces, and the max's vjp routes gradients to
+    the argmax element exactly like the reference's saved-argmax path."""
     jnp = _jnp()
     x = one(inputs, "X")
     rois = one(inputs, "ROIs")
@@ -413,7 +418,6 @@ def roi_pool(inputs, attrs):
     ph = int(attrs.get("pooled_height", 1))
     pw = int(attrs.get("pooled_width", 1))
     scale = float(attrs.get("spatial_scale", 1.0))
-    ratio = 4
     bidx = jnp.zeros((R,), jnp.int32) if bidx is None else bidx.reshape(R).astype(jnp.int32)
     x1 = jnp.round(rois[:, 0] * scale)
     y1 = jnp.round(rois[:, 1] * scale)
@@ -421,19 +425,28 @@ def roi_pool(inputs, attrs):
     y2 = jnp.round(rois[:, 3] * scale)
     rw = jnp.maximum(x2 - x1 + 1, 1.0)
     rh = jnp.maximum(y2 - y1 + 1, 1.0)
-    gy = (jnp.arange(ph * ratio, dtype=jnp.float32) + 0.5) / (ph * ratio)
-    gx = (jnp.arange(pw * ratio, dtype=jnp.float32) + 0.5) / (pw * ratio)
-    ys = y1[:, None] + gy[None, :] * rh[:, None] - 0.5
-    xs = x1[:, None] + gx[None, :] * rw[:, None] - 0.5
+    bin_h = rh / ph
+    bin_w = rw / pw
 
-    def per_roi(b, ys_r, xs_r):
-        img = x[b]
-        yi = jnp.clip(jnp.round(ys_r), 0, H - 1).astype(int)
-        xi = jnp.clip(jnp.round(xs_r), 0, W - 1).astype(int)
-        sampled = img[:, yi][:, :, xi]  # [C, ph*ratio, pw*ratio]
-        return sampled.reshape(C, ph, ratio, pw, ratio).max(axis=(2, 4))
+    def edges(start, bins, bin_sz, limit):
+        i = jnp.arange(bins, dtype=jnp.float32)
+        lo = jnp.clip(jnp.floor(i[None, :] * bin_sz[:, None]) + start[:, None], 0, limit)
+        hi = jnp.clip(jnp.ceil((i[None, :] + 1) * bin_sz[:, None]) + start[:, None], 0, limit)
+        return lo, hi  # [R, bins]
 
-    out = jax.vmap(per_roi)(bidx, ys, xs)
+    hlo, hhi = edges(y1, ph, bin_h, H)
+    wlo, whi = edges(x1, pw, bin_w, W)
+    yy = jnp.arange(H, dtype=jnp.float32)
+    xx = jnp.arange(W, dtype=jnp.float32)
+    ymask = (yy[None, None, :] >= hlo[:, :, None]) & (yy[None, None, :] < hhi[:, :, None])  # [R, ph, H]
+    wmask = (xx[None, None, :] >= wlo[:, :, None]) & (xx[None, None, :] < whi[:, :, None])  # [R, pw, W]
+
+    NEG = jnp.asarray(-3.0e38, x.dtype)
+    img = x[bidx]  # [R, C, H, W]
+    t = jnp.where(ymask[:, None, :, :, None], img[:, :, None, :, :], NEG).max(axis=3)  # [R, C, ph, W]
+    out = jnp.where(wmask[:, None, None, :, :], t[:, :, :, None, :], NEG).max(axis=4)  # [R, C, ph, pw]
+    empty = (hhi <= hlo)[:, None, :, None] | (whi <= wlo)[:, None, None, :]
+    out = jnp.where(empty | (out <= NEG), jnp.zeros_like(out), out)
     return {"Out": out}
 
 

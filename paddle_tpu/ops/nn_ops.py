@@ -600,15 +600,70 @@ def sequence_conv(inputs, attrs):
     return {"Out": out}
 
 
+@register_op("fused_attention", no_grad_set={"Mask"})
+def fused_attention(inputs, attrs):
+    """Fused scaled-dot-product attention: Q/K/V [N, H, S, D] -> ctx
+    [N, H, S, D].
+
+    TPU path: the pallas flash-attention kernel
+    (jax.experimental.pallas.ops.tpu.flash_attention) — online-softmax
+    tiling, no [N, H, S, S] score tensor in HBM.  Padding comes in as
+    ``Mask`` [N, S] (1 = token) and is lowered to segment ids (pad
+    positions form their own segment, so real tokens never attend them;
+    pad rows' outputs are garbage-by-construction in BOTH impls and must
+    be masked downstream, as the reference's padded attention does).
+    Non-TPU backends (and PADDLE_TPU_FLASH_ATTENTION=0) fall back to the
+    plain einsum+softmax math with the equivalent additive bias.
+    """
+    import os as _os
+
+    import jax
+    jnp = _jnp()
+
+    q = one(inputs, "Q")
+    k = one(inputs, "K")
+    v = one(inputs, "V")
+    mask = maybe(inputs, "Mask")
+    causal = bool(attrs.get("causal", False))
+    scale = float(attrs.get("scale", 1.0))
+    use_flash = (
+        jax.default_backend() == "tpu"
+        and _os.environ.get("PADDLE_TPU_FLASH_ATTENTION", "1") == "1"
+    )
+    if use_flash:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            SegmentIds, flash_attention)
+
+        seg = None
+        if mask is not None:
+            m = mask.astype(jnp.int32)
+            seg = SegmentIds(q=m, kv=m)
+        out = flash_attention(q, k, v, segment_ids=seg, causal=causal,
+                              sm_scale=scale)
+        return {"Out": out.astype(q.dtype)}
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    S = q.shape[2]
+    if causal:
+        cm = jnp.where(jnp.arange(S)[None, :] <= jnp.arange(S)[:, None], 0.0, -1e9)
+        s = s + cm
+    if mask is not None:
+        s = s + ((mask.astype(jnp.float32) - 1.0) * 1e9)[:, None, None, :]
+    w = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return {"Out": jnp.einsum("bhqk,bhkd->bhqd", w, v)}
+
+
 # ---------------------------------------------------------------------------
 # NCE (reference: operators/nce_op.cc) — noise-contrastive estimation with
 # a uniform sampler compiled into the step
 # ---------------------------------------------------------------------------
-@register_op("nce", no_grad_set={"Label"})
+@register_op("nce", no_grad_set={"Label", "SampleWeight"})
 def nce(inputs, attrs):
-    """Input [B, D], Label [B, 1], Weight [V, D], Bias [V] optional.
-    Uniform negative sampler (num_neg_samples), logistic NCE loss with
-    the log(k*P) correction.  Cost [B, 1]."""
+    """Input [B, D], Label [B, 1], Weight [V, D], Bias [V] optional,
+    SampleWeight [B, 1] optional (per-example cost scale).  Uniform,
+    log_uniform, or custom (attr ``custom_dist``, a length-V probability
+    vector — the reference's CustomSampler, operators/math/sampler.cc)
+    negative sampler (num_neg_samples), logistic NCE loss with the
+    log(k*P) correction.  Cost [B, 1]."""
     jax = _jax()
     jnp = _jnp()
     from paddle_tpu.ops.common import maybe, prng
@@ -617,6 +672,7 @@ def nce(inputs, attrs):
     label = one(inputs, "Label").reshape(-1).astype(jnp.int32)
     w = one(inputs, "Weight")
     b = maybe(inputs, "Bias")
+    sw = maybe(inputs, "SampleWeight")
     V = w.shape[0]
     k = int(attrs.get("num_neg_samples", 10))
     sampler = attrs.get("sampler", "uniform")
@@ -626,7 +682,18 @@ def nce(inputs, attrs):
     key = jax.random.fold_in(
         prng(int(attrs.get("seed", 0))), jnp.sum(label).astype(jnp.uint32)
     )
-    if sampler == "log_uniform":
+    if sampler == "custom_dist":
+        # inverse-CDF draw from the user distribution; alias-free and
+        # static-shape (the reference builds an alias table host-side)
+        probs = jnp.asarray(attrs["custom_dist"], dtype=jnp.float32).reshape(-1)
+        probs = probs / jnp.sum(probs)
+        cdf = jnp.cumsum(probs)
+        u = jax.random.uniform(key, (k,))
+        neg = jnp.clip(jnp.searchsorted(cdf, u), 0, V - 1).astype(jnp.int32)
+        logp_all = jnp.log(jnp.maximum(probs, 1e-30))
+        log_kp_true = jnp.log(float(k)) + logp_all[label]
+        log_kp_neg = jnp.log(float(k)) + logp_all[neg]
+    elif sampler == "log_uniform":
         # Zipfian P(c) = log((c+2)/(c+1)) / log(V+1); inverse-CDF draw
         # c = floor(exp(u*log(V+1))) - 1 (the reference's LogUniformSampler,
         # operators/math/sampler.cc)
@@ -656,6 +723,8 @@ def nce(inputs, attrs):
     pos_cost = jax.nn.softplus(-(true_logit - log_kp_true))
     neg_cost = jnp.sum(jax.nn.softplus(neg_logit - log_kp_neg[None, :]), axis=-1)
     cost = pos_cost + neg_cost
+    if sw is not None:
+        cost = cost * sw.reshape(-1)
     return {"Cost": cost.reshape(-1, 1)}
 
 
