@@ -410,3 +410,92 @@ def reorder_lod_tensor_by_rank(inputs, attrs):
     x = one(inputs, "X")
     idx = one(inputs, "RankTable").reshape(-1)
     return {"Out": x[idx]}
+
+
+@register_op("beam_search", differentiable=False,
+             no_grad_set={"pre_ids", "pre_scores", "ids", "scores"})
+def beam_search(inputs, attrs):
+    """Per-step beam selection (reference: beam_search_op.cc + layers/nn.py
+    beam_search:4406).
+
+    TPU-native static-shape design: the reference shrinks beams through
+    LoD pruning; here every source keeps a FIXED ``beam_size`` lane width.
+    A beam that has emitted ``end_id`` is finished: it contributes exactly
+    one candidate (end_id, its own accumulated score) so it persists
+    through top-k, and its other candidates are masked to -1e9 — the
+    static equivalent of the reference's pruned-and-carried beams.
+
+    pre_ids [B*K, 1] int, pre_scores [B*K, 1], ids [B*K, K] candidate
+    tokens, scores [B*K, K] accumulated candidate scores
+    (``is_accumulated=False``: step probabilities, accumulated here as
+    pre + log(score)).  Outputs: selected_ids [B*K, 1], selected_scores
+    [B*K, 1], parent_idx [B*K] int32 (global row of each selection's
+    source beam — the reference's return_parent_idx output, used to
+    gather decoder states).
+    """
+    import jax
+
+    jnp = _jnp()
+    pre_ids = one(inputs, "pre_ids").reshape(-1)
+    pre_sc = one(inputs, "pre_scores").reshape(-1)
+    cand_ids = one(inputs, "ids")
+    cand_sc = one(inputs, "scores")
+    K = int(attrs["beam_size"])
+    end_id = int(attrs["end_id"])
+    BK = cand_sc.shape[0]
+    B = BK // K
+    NEG = jnp.asarray(-1e9, cand_sc.dtype)
+    if not bool(attrs.get("is_accumulated", True)):
+        cand_sc = pre_sc[:, None] + jnp.log(jnp.maximum(cand_sc, 1e-30))
+    fin = pre_ids.astype(jnp.int32) == end_id
+    slot0 = jnp.arange(cand_sc.shape[1]) == 0
+    cand_sc = jnp.where(
+        fin[:, None], jnp.where(slot0[None, :], pre_sc[:, None], NEG), cand_sc
+    )
+    cand_ids = jnp.where(fin[:, None], end_id, cand_ids.astype(jnp.int32))
+    flat_sc = cand_sc.reshape(B, -1)
+    top_sc, top_ix = jax.lax.top_k(flat_sc, K)  # [B, K]
+    parent_local = top_ix // cand_sc.shape[1]
+    parent_idx = (jnp.arange(B) * K)[:, None] + parent_local
+    sel_ids = jnp.take_along_axis(cand_ids.reshape(B, -1), top_ix, axis=1)
+    return {
+        "selected_ids": sel_ids.reshape(-1, 1).astype("int64"),
+        "selected_scores": top_sc.reshape(-1, 1),
+        "parent_idx": parent_idx.reshape(-1).astype("int32"),
+    }
+
+
+@register_op("beam_search_decode", differentiable=False,
+             no_grad_set={"Ids", "Scores", "Parents"})
+def beam_search_decode(inputs, attrs):
+    """Backtrack beam-search arrays into full sequences (reference:
+    beam_search_decode_op.cc).
+
+    The reference recovers parentage from each step's LoD; the static
+    encoding carries it explicitly: Ids/Scores [T, B*K, 1] stacked
+    tensor-arrays and Parents [T, B*K] (beam_search's parent_idx written
+    per step; step 0's parents are ignored).  Outputs the padded
+    equivalents of the reference's LoD results: SentenceIds [B, K, T]
+    (finished rows tail-padded with end_id) and SentenceScores [B, K]
+    (each lane's final accumulated score), lanes sorted by score as the
+    reference's sorted candidate lists are.
+    """
+    jnp = _jnp()
+    ids = one(inputs, "Ids")  # [T, BK, 1]
+    scores = one(inputs, "Scores")
+    parents = one(inputs, "Parents")  # [T, BK]
+    K = int(attrs["beam_size"])
+    T, BK = ids.shape[0], ids.shape[1]
+    B = BK // K
+    cur = jnp.arange(BK)
+    toks = []
+    for t in range(T - 1, -1, -1):  # static backtrack, unrolled by XLA
+        toks.append(ids[t].reshape(-1)[cur])
+        if t > 0:
+            cur = parents[t].reshape(-1)[cur]
+    sent = jnp.stack(toks[::-1], axis=-1).reshape(B, K, T).astype("int64")
+    final_sc = scores[T - 1].reshape(B, K)
+    order = jnp.argsort(-final_sc, axis=1, stable=True)
+    sent = jnp.take_along_axis(sent, order[:, :, None], axis=1)
+    final_sc = jnp.take_along_axis(final_sc, order, axis=1)
+    return {"SentenceIds": sent, "SentenceScores": final_sc}

@@ -1,0 +1,200 @@
+"""Book test: machine translation (reference:
+python/paddle/fluid/tests/book/test_machine_translation.py).
+
+Train: encoder (embedding -> 4-gate fc -> dynamic_lstm -> last step)
+feeding a DynamicRNN decoder, cross-entropy on next words — the
+reference's train_main.
+
+Decode: the reference's While-loop beam decode ported onto the static
+encoding — array_write/array_read tensor arrays, per-step
+layers.beam_search (fixed beam lanes, end_id carry), decoder-state gather
+by parent_idx (return_parent_idx, replacing the reference's
+sequence_expand-over-LoD), and layers.beam_search_decode backtracking the
+arrays into [B, K, T] sequences.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import framework
+
+V = 30          # shared src/tgt dict size
+D = 8           # word embedding dim
+H = 16          # decoder/encoder hidden
+K = 2           # beam width
+T_SRC = 6
+T_TGT = 5
+MAX_LEN = 6
+START_ID = 1
+END_ID = 2
+
+
+def _encoder(src, src_len):
+    emb = fluid.layers.embedding(
+        src, size=[V, D], param_attr=fluid.ParamAttr(name="mt_vemb"))
+    fc1 = fluid.layers.fc(emb, H * 4, num_flatten_dims=2, act="tanh",
+                          param_attr=fluid.ParamAttr(name="mt_enc_fc"))
+    hidden, _ = fluid.layers.dynamic_lstm(
+        fc1, size=H * 4, seq_len=src_len,
+        param_attr=fluid.ParamAttr(name="mt_enc_lstm"))
+    return fluid.layers.sequence_last_step(hidden, seq_len=src_len)  # [B, H]
+
+
+def _decoder_step(word_emb, state, name_prefix="mt_dec"):
+    cur = fluid.layers.fc(
+        [word_emb, state], H, act="tanh",
+        param_attr=[fluid.ParamAttr(name=name_prefix + "_word_fc"),
+                    fluid.ParamAttr(name=name_prefix + "_state_fc")])
+    logits = fluid.layers.fc(
+        cur, V, param_attr=fluid.ParamAttr(name=name_prefix + "_score_fc"))
+    return cur, logits
+
+
+def test_machine_translation_trains():
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 77
+    with framework.program_guard(prog, startup):
+        src = fluid.layers.data("src", [T_SRC], dtype="int64", lod_level=1)
+        src_len = prog.global_block().var("src_seq_len")
+        trg = fluid.layers.data("trg", [T_TGT], dtype="int64")
+        nxt = fluid.layers.data("nxt", [T_TGT, 1], dtype="int64")
+        context = _encoder(src, src_len)
+
+        trg_emb = fluid.layers.embedding(
+            trg, size=[V, D], param_attr=fluid.ParamAttr(name="mt_vemb_t"))
+        trg_len = fluid.layers.fill_constant_batch_size_like(
+            context, shape=[-1], dtype="int32", value=T_TGT)
+        rnn = fluid.layers.DynamicRNN()
+        with rnn.block():
+            cur_word = rnn.step_input(trg_emb, seq_len=trg_len)
+            pre_state = rnn.memory(init=context)
+            cur_state, logits = _decoder_step(cur_word, pre_state)
+            rnn.update_memory(pre_state, cur_state)
+            rnn.output(logits)
+        logits = rnn()  # [B, T_TGT, V]
+        cost = fluid.layers.softmax_with_cross_entropy(logits, nxt)
+        avg_cost = fluid.layers.mean(cost)
+        fluid.optimizer.AdamOptimizer(0.02).minimize(avg_cost)
+
+    rng = np.random.RandomState(0)
+    B = 16
+    srcv = rng.randint(3, V, (B, T_SRC)).astype("int64")
+    lens = rng.randint(2, T_SRC + 1, (B,)).astype("int32")
+    # learnable synthetic translation: next word = f(prev word)
+    trgv = np.empty((B, T_TGT), "int64")
+    trgv[:, 0] = START_ID
+    for t in range(1, T_TGT):
+        trgv[:, t] = (trgv[:, t - 1] * 7 + 3) % V
+    nxtv = ((trgv * 7 + 3) % V)[:, :, None].astype("int64")
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(30):
+            (l,) = exe.run(
+                prog,
+                feed={"src": srcv, "src_seq_len": lens, "trg": trgv,
+                      "nxt": nxtv},
+                fetch_list=[avg_cost])
+            losses.append(float(np.asarray(l)))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_machine_translation_beam_decode():
+    """The reference decoder_decode While loop, ported: tensor arrays +
+    per-step beam_search + parent-idx state gather + beam_search_decode."""
+    B = 3
+    BK = B * K
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 78
+    with framework.program_guard(prog, startup):
+        src = fluid.layers.data("src", [T_SRC], dtype="int64", lod_level=1)
+        src_len = prog.global_block().var("src_seq_len")
+        init_ids = fluid.layers.data("init_ids", [1], dtype="int64")
+        init_scores = fluid.layers.data("init_scores", [1])
+
+        context = _encoder(src, src_len)  # [B, H]
+        # beam lanes: each source row fans out to K identical states
+        state0 = fluid.layers.reshape(
+            fluid.layers.expand(
+                fluid.layers.reshape(context, shape=[-1, 1, H]), [1, K, 1]),
+            shape=[BK, H])
+
+        counter = fluid.layers.zeros(shape=[1], dtype="int64")
+        array_len = fluid.layers.fill_constant([1], "int64", MAX_LEN)
+        state_arr = fluid.layers.create_array(MAX_LEN + 1, [BK, H])
+        ids_arr = fluid.layers.create_array(MAX_LEN + 1, [BK, 1], "int64")
+        score_arr = fluid.layers.create_array(MAX_LEN + 1, [BK, 1])
+        parent_arr = fluid.layers.create_array(MAX_LEN + 1, [BK], "int32")
+        state_arr = fluid.layers.array_write(state0, counter, state_arr)
+        ids_arr = fluid.layers.array_write(
+            fluid.layers.reshape(init_ids, shape=[BK, 1]), counter, ids_arr)
+        score_arr = fluid.layers.array_write(
+            fluid.layers.reshape(init_scores, shape=[BK, 1]), counter,
+            score_arr)
+
+        cond = fluid.layers.less_than(counter, array_len)
+        loop = fluid.layers.While(cond, max_trip_count=MAX_LEN)
+        with loop.block():
+            # reshape pins the static element shapes on the array reads
+            # (shape inference inside a While sub-block is deferred)
+            pre_ids = fluid.layers.reshape(
+                fluid.layers.array_read(ids_arr, counter), shape=[BK, 1])
+            pre_state = fluid.layers.reshape(
+                fluid.layers.array_read(state_arr, counter), shape=[BK, H])
+            pre_score = fluid.layers.reshape(
+                fluid.layers.array_read(score_arr, counter), shape=[BK, 1])
+
+            emb = fluid.layers.reshape(
+                fluid.layers.embedding(
+                    pre_ids, size=[V, D],
+                    param_attr=fluid.ParamAttr(name="mt_vemb_t")),
+                shape=[BK, D])
+            cur_state, logits = _decoder_step(emb, pre_state)
+            probs = fluid.layers.softmax(logits)
+            topk_scores, topk_indices = fluid.layers.topk(probs, k=K)
+            accu = fluid.layers.elementwise_add(
+                fluid.layers.log(topk_scores), pre_score)
+            sel_ids, sel_sc, parent = fluid.layers.beam_search(
+                pre_ids, pre_score, topk_indices, accu, K, END_ID,
+                return_parent_idx=True)
+            # the reference expands states over the LoD (sequence_expand);
+            # static lanes gather by parent instead
+            new_state = fluid.layers.gather(cur_state, parent)
+
+            fluid.layers.increment(counter, value=1, in_place=True)
+            fluid.layers.array_write(new_state, counter, state_arr)
+            fluid.layers.array_write(sel_ids, counter, ids_arr)
+            fluid.layers.array_write(sel_sc, counter, score_arr)
+            fluid.layers.array_write(parent, counter, parent_arr)
+            fluid.layers.less_than(counter, array_len, cond=cond)
+
+        trans_ids, trans_scores = fluid.layers.beam_search_decode(
+            ids_arr, score_arr, beam_size=K, end_id=END_ID,
+            parents=parent_arr)
+
+    rng = np.random.RandomState(1)
+    srcv = rng.randint(3, V, (B, T_SRC)).astype("int64")
+    lens = rng.randint(2, T_SRC + 1, (B,)).astype("int32")
+    iidv = np.full((BK, 1), START_ID, "int64")
+    iscv = np.where(np.arange(BK) % K == 0, 0.0, -1e9).astype(
+        "float32").reshape(BK, 1)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        tids, tscores = exe.run(
+            prog,
+            feed={"src": srcv, "src_seq_len": lens, "init_ids": iidv,
+                  "init_scores": iscv},
+            fetch_list=[trans_ids, trans_scores])
+    tids = np.asarray(tids)
+    tscores = np.asarray(tscores)
+    assert tids.shape == (B, K, MAX_LEN + 1)
+    assert tscores.shape == (B, K)
+    # sequences start at the start token and stay inside the vocab
+    np.testing.assert_array_equal(tids[:, :, 0], START_ID)
+    assert (tids >= 0).all() and (tids < V).all()
+    # lanes are sorted best-first and carry finite log-prob scores
+    assert (np.diff(tscores, axis=1) <= 1e-6).all()
+    assert np.isfinite(tscores).all() and (tscores <= 0).all()
