@@ -206,5 +206,46 @@ class DistributedOptimizer:
         self._fleet._compiled_program = compiled
         return ops, pgs
 
+    def build_hybrid_train_step(self, mesh=None):
+        """User-facing route to the 5D hybrid-parallel engine
+        (``DistributedStrategy.hybrid`` = HybridConfig kwargs): builds the
+        engine step wired to THIS optimizer's registered kernel — the
+        reference reaches its parallel modes through the fleet optimizer
+        the same way (incubate/fleet/collective/__init__.py:157,
+        optimizer.py:2665 pipeline).
+
+        Returns ``(step, helpers)``: ``step(params, aux, tokens, labels)
+        -> (loss, new_params, new_aux)`` and ``helpers`` with
+        ``init_params()/init_opt_state(params)/place(params, tokens,
+        labels)/place_aux(aux)/mesh/config``.
+        """
+        from paddle_tpu.parallel import hybrid
+
+        if not self._strategy.hybrid:
+            raise ValueError(
+                "build_hybrid_train_step needs DistributedStrategy.hybrid "
+                "= dict of HybridConfig kwargs (dp/pp/tp/sp/ep + dims)"
+            )
+        cfg = hybrid.HybridConfig(**self._strategy.hybrid)
+        step, place, mesh = hybrid.make_train_step(
+            cfg, mesh=mesh, optimizer=self._optimizer
+        )
+
+        class _Helpers:
+            config = cfg
+
+            @staticmethod
+            def init_params(seed=0):
+                return hybrid.init_params(cfg, seed=seed)
+
+            @staticmethod
+            def init_opt_state(params):
+                return hybrid.init_opt_state(cfg, params, self._optimizer)
+
+        _Helpers.place = staticmethod(place)
+        _Helpers.place_aux = staticmethod(step.place_aux)
+        _Helpers.mesh = mesh
+        return step, _Helpers
+
 
 fleet = Fleet()

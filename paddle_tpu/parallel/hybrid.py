@@ -219,10 +219,87 @@ def reference_loss(params: Dict[str, Any], tokens, labels, cfg: HybridConfig):
 # ---------------------------------------------------------------------------
 # The sharded engine
 # ---------------------------------------------------------------------------
-def make_train_step(cfg: HybridConfig, mesh=None):
-    """Build ``step(params, tokens, labels) -> (loss, new_params)`` — a
-    single jitted XLA module implementing the full 5D-parallel training
-    step (fwd + bwd + SGD update)."""
+def _optimizer_plan(optimizer):
+    """Map a fluid optimizer object onto its registered op kernel
+    (reference: each Optimizer's _append_optimize_op emits the same op).
+
+    Returns (op_type, attrs, moment_slots, pow_slots, lr, l2_decay).
+    moment_slots are per-param aux tensors shaped like the param (sharded
+    with the param's spec); pow_slots are per-param scalars (replicated).
+    """
+    if optimizer is None:
+        return ("sgd", {}, [], {}, None, 0.0)
+    decay = 0.0
+    reg = getattr(optimizer, "regularization", None)
+    if reg is not None:
+        if type(reg).__name__ != "L2DecayRegularizer":
+            raise ValueError(
+                "hybrid engine: only L2 decay regularization is supported "
+                "(got %s)" % type(reg).__name__
+            )
+        decay = float(reg._coeff)
+    lr = optimizer._learning_rate
+    if not isinstance(lr, (int, float)):
+        raise ValueError(
+            "hybrid engine: optimizer must have a float learning rate "
+            "(LR schedules run program-side)"
+        )
+    t = type(optimizer).__name__
+    if "Adam" in t and "Adamax" not in t:
+        return (
+            "adam",
+            {"beta1": optimizer._beta1, "beta2": optimizer._beta2,
+             "epsilon": optimizer._epsilon},
+            ["Moment1", "Moment2"],
+            {"Beta1Pow": optimizer._beta1, "Beta2Pow": optimizer._beta2},
+            float(lr), decay,
+        )
+    if "Momentum" in t:
+        return (
+            "momentum",
+            {"mu": optimizer._momentum,
+             "use_nesterov": optimizer._use_nesterov},
+            ["Velocity"], {}, float(lr), decay,
+        )
+    if "SGD" in t:
+        return ("sgd", {}, [], {}, float(lr), decay)
+    raise ValueError(
+        "hybrid engine supports SGD/Momentum/Adam optimizers (got %s); "
+        "route other optimizers through the Program path" % t
+    )
+
+
+def init_opt_state(cfg: HybridConfig, params, optimizer):
+    """Optimizer aux state for ``make_train_step(..., optimizer=)``:
+    '<param>@<Slot>' -> zeros_like(param) moments and scalar beta pows
+    (the reference's per-param accumulators, optimizer.py
+    _add_accumulator)."""
+    _, _, moment_slots, pow_slots, _, _ = _optimizer_plan(optimizer)
+    aux = {}
+    for n, p in params.items():
+        for slot in moment_slots:
+            aux["%s@%s" % (n, slot)] = np.zeros_like(p)
+        for slot, v0 in pow_slots.items():
+            aux["%s@%s" % (n, slot)] = np.full((1,), v0, np.float32)
+    return aux
+
+
+def make_train_step(cfg: HybridConfig, mesh=None, optimizer=None):
+    """Build the single jitted XLA module implementing the full
+    5D-parallel training step (fwd + bwd + optimizer update).
+
+    ``optimizer=None``: plain SGD at ``cfg.lr``;
+    ``step(params, tokens, labels) -> (loss, new_params)``.
+
+    ``optimizer=`` a fluid SGD/Momentum/Adam optimizer object (with
+    optional L2 regularization): the update replays the optimizer's
+    REGISTERED op kernel per parameter — the same kernels the Program
+    path runs (parallel/pipeline_program.py does the same for pipeline
+    sections) — and the step signature becomes
+    ``step(params, aux, tokens, labels) -> (loss, new_params, new_aux)``
+    with ``aux`` from :func:`init_opt_state`.  Moments shard with their
+    parameter's spec; beta pows replicate.
+    """
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -230,6 +307,13 @@ def make_train_step(cfg: HybridConfig, mesh=None):
     if mesh is None:
         mesh = mesh_lib.make_mesh(cfg.mesh_axes())
     specs = _param_specs(cfg)
+    opt_op, opt_attrs, moment_slots, pow_slots, opt_lr, l2_decay = _optimizer_plan(optimizer)
+    aux_spec_of = {}
+    for n in specs:
+        for slot in moment_slots:
+            aux_spec_of["%s@%s" % (n, slot)] = specs[n]
+        for slot in pow_slots:
+            aux_spec_of["%s@%s" % (n, slot)] = P()
 
     D, H, T, V, E, F = cfg.d_model, cfg.n_head, cfg.seq_len, cfg.vocab_size, cfg.n_experts, cfg.d_ff
     assert H % cfg.tp == 0 and D % cfg.tp == 0 and F % cfg.tp == 0
@@ -358,22 +442,54 @@ def make_train_step(cfg: HybridConfig, mesh=None):
         # (the loss is computed redundantly on those ranks)
         return jax.lax.pmean(loss, ("tp", "ep"))
 
-    def sharded_step(params, tokens, labels):
+    def apply_optimizer(params, grads, aux):
+        """Replay the registered optimizer kernel per parameter (the same
+        kernels Executor programs run; pipeline_program.py's pattern)."""
+        from paddle_tpu.core.registry import get_kernel
+
+        kern = get_kernel(opt_op)
+        lr_arr = jnp.asarray([opt_lr], jnp.float32)
+        new_p, new_aux = {}, dict(aux)
+        for n in params:
+            g = grads[n]
+            if l2_decay:
+                g = g + l2_decay * params[n]
+            ins = {"Param": [params[n]], "Grad": [g.astype(params[n].dtype)],
+                   "LearningRate": [lr_arr]}
+            for slot in moment_slots + list(pow_slots):
+                ins[slot] = [aux["%s@%s" % (n, slot)]]
+            outs = kern(ins, opt_attrs)
+            new_p[n] = outs["ParamOut"]
+            for slot in moment_slots + list(pow_slots):
+                out = outs.get(slot + "Out")
+                if out is not None:
+                    new_aux["%s@%s" % (n, slot)] = out
+        return new_p, new_aux
+
+    def sharded_step(params, aux, tokens, labels):
         # Gradient reduction over each param's replication axes (the
         # reference's NCCL allreduce, details/all_reduce_op_handle.cc) is
         # inserted by shard_map's transpose: under check_vma=True the
         # cotangent of an input that is invariant over an axis is psum'd
         # over that axis automatically.
         loss, grads = jax.value_and_grad(local_loss)(params, tokens, labels)
-        new_params = {n: params[n] - cfg.lr * grads[n] for n in params}
-        return loss, new_params
+        if optimizer is None:
+            new_params = {n: params[n] - cfg.lr * grads[n] for n in params}
+            return loss, new_params, aux
+        new_params, new_aux = apply_optimizer(params, grads, aux)
+        return loss, new_params, new_aux
 
     in_specs = (
         {n: specs[n] for n in specs},
+        {n: aux_spec_of[n] for n in aux_spec_of},
         P("dp"),
         P("dp"),
     )
-    out_specs = (P(), {n: specs[n] for n in specs})
+    out_specs = (
+        P(),
+        {n: specs[n] for n in specs},
+        {n: aux_spec_of[n] for n in aux_spec_of},
+    )
 
     smapped = jax.shard_map(
         sharded_step,
@@ -382,6 +498,13 @@ def make_train_step(cfg: HybridConfig, mesh=None):
         out_specs=out_specs,
         check_vma=True,
     )
+    jitted = jax.jit(smapped)
+
+    def place_aux(aux):
+        return {
+            n: jax.device_put(v, NamedSharding(mesh, aux_spec_of[n]))
+            for n, v in aux.items()
+        }
 
     def place(params, tokens, labels):
         params = {
@@ -391,4 +514,16 @@ def make_train_step(cfg: HybridConfig, mesh=None):
         labels = jax.device_put(labels, NamedSharding(mesh, P("dp")))
         return params, tokens, labels
 
-    return jax.jit(smapped), place, mesh
+    if optimizer is None:
+        # legacy signature: step(params, tokens, labels) -> (loss, params)
+        def step(params, tokens, labels):
+            loss, new_params, _ = jitted(params, {}, tokens, labels)
+            return loss, new_params
+
+        return step, place, mesh
+
+    def step(params, aux, tokens, labels):
+        return jitted(params, aux, tokens, labels)
+
+    step.place_aux = place_aux
+    return step, place, mesh

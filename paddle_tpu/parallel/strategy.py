@@ -12,7 +12,7 @@ steer jit shardings / the transpilers instead.
 from __future__ import annotations
 
 import warnings
-from typing import Dict
+from typing import Dict, Optional
 
 __all__ = ["BuildStrategy", "ExecutionStrategy", "DistributedStrategy"]
 
@@ -112,4 +112,8 @@ class DistributedStrategy(BuildStrategy):
         self.exec_strategy = ExecutionStrategy()
         self.use_amp = False
         self.num_microbatches = 1
+        # 5D hybrid-parallel engine config (HybridConfig kwargs: dp/pp/tp/
+        # sp/ep + model dims); consumed by
+        # fleet.distributed_optimizer(...).build_hybrid_train_step()
+        self.hybrid: Optional[Dict] = None
         self._init_done = True
