@@ -6,14 +6,14 @@ MFU accounting is the role-split formula in bench_bert.py (embedding
 gathers and masked-only heads are not charged full 6ND — the naive rule
 overstates MFU ~18% here).
 
-The line also carries a ``resnet50`` block with a measured calibration:
-``pure_jax_step_ms`` times a hand-written, framework-free JAX ResNet-50
-step (bench_calibration.py) in the same process, and
-``framework_overhead_pct`` is (framework - pure)/pure.  ResNet-50 @bs256
-is HBM-bandwidth-bound on one v5e (~13% MFU at every batch size/layout
-we probed — bs512/1024 probes recorded in BASELINE.md), so the honest
-perf claim for it is "at the XLA ceiling", and that claim is measured
-here, not asserted.
+The line also carries ``resnet50``/``nmt``/``deepfm`` blocks (all five
+BASELINE.json configs; LeNet is the tests' parity config).  ResNet-50
+ships with a measured calibration: ``pure_jax_step_ms`` times a
+hand-written, framework-free JAX ResNet-50 step (bench_calibration.py)
+in the same process, and ``framework_overhead_pct`` is
+(framework - pure)/pure — measured 1.23% at bs256, the evidence that
+ResNet-50's 13.4% MFU is the XLA ceiling for this model/layout, not
+framework overhead (probe record: BASELINE.md round-4 tables).
 
 Both paths run CHUNK training steps per jitted call (Executor
 ``steps=`` fori_loop) to amortize the ~5.5 ms axon-tunnel dispatch
@@ -150,12 +150,27 @@ def main():
         import bench_bert
 
         line = bench_bert.run()
+    elif model == "nmt":
+        import bench_nmt
+
+        line = bench_nmt.run()
+    elif model == "deepfm":
+        import bench_deepfm
+
+        line = bench_deepfm.run()
     else:
+        # all five BASELINE.json configs in one line: BERT headline +
+        # resnet50/nmt/deepfm sub-blocks (lenet is the tests' parity
+        # config — tests/test_models.py::test_lenet_mnist_trains)
         import bench_bert
+        import bench_deepfm
+        import bench_nmt
 
         line = bench_bert.run()
         res, _ = run_resnet()
         line["resnet50"] = res
+        line["nmt"] = bench_nmt.run()
+        line["deepfm"] = bench_deepfm.run()
     print(json.dumps(line))
 
 

@@ -68,23 +68,29 @@ def init_params(seed=0):
     return params, stats
 
 
-def _conv(x, w, stride=1):
+def _conv(x, w, stride=1, layout="NCHW"):
     import jax
 
     k = w.shape[2]
     pad = (k - 1) // 2
+    if layout == "NHWC":
+        w = w.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+        dn = ("NHWC", "HWIO", "NHWC")
+    else:
+        dn = ("NCHW", "OIHW", "NCHW")
     return jax.lax.conv_general_dilated(
         x, w.astype(x.dtype), (stride, stride), [(pad, pad), (pad, pad)],
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=dn,
     )
 
 
-def _bn_train(x, params, stats, name, new_stats):
+def _bn_train(x, params, stats, name, new_stats, layout="NCHW"):
     import jax.numpy as jnp
 
     xf = x.astype(jnp.float32)
-    mean = jnp.mean(xf, axis=(0, 2, 3))
-    var = jnp.var(xf, axis=(0, 2, 3))
+    axes = (0, 1, 2) if layout == "NHWC" else (0, 2, 3)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.var(xf, axis=axes)
     new_stats[name + "_mean"] = (
         stats[name + "_mean"] * BN_MOMENTUM + mean * (1 - BN_MOMENTUM)
     )
@@ -94,52 +100,61 @@ def _bn_train(x, params, stats, name, new_stats):
     inv = (params[name + "_scale"] / jnp.sqrt(var + BN_EPS)).astype(x.dtype)
     shift = (params[name + "_bias"] - mean * params[name + "_scale"]
              / jnp.sqrt(var + BN_EPS)).astype(x.dtype)
+    if layout == "NHWC":
+        return x * inv[None, None, None, :] + shift[None, None, None, :]
     return x * inv[None, :, None, None] + shift[None, :, None, None]
 
 
-def forward(params, stats, images):
+def forward(params, stats, images, layout="NCHW"):
     import jax
     import jax.numpy as jnp
 
     new_stats = {}
     x = images.astype(jnp.bfloat16)
-    x = _conv(x, params["stem_w"], 2)
-    x = _bn_train(x, params, stats, "stem_bn", new_stats)
+    x = _conv(x, params["stem_w"], 2, layout=layout)
+    x = _bn_train(x, params, stats, "stem_bn", new_stats, layout=layout)
     x = jax.nn.relu(x)
-    x = jax.lax.reduce_window(
-        x, -jnp.inf, jax.lax.max, (1, 1, 3, 3), (1, 1, 2, 2),
-        [(0, 0), (0, 0), (1, 1), (1, 1)],
-    )
+    if layout == "NHWC":
+        win, strides = (1, 3, 3, 1), (1, 2, 2, 1)
+        pads = [(0, 0), (1, 1), (1, 1), (0, 0)]
+    else:
+        win, strides = (1, 1, 3, 3), (1, 1, 2, 2)
+        pads = [(0, 0), (0, 0), (1, 1), (1, 1)]
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, win, strides, pads)
     for si, (n_blocks, width) in enumerate([(3, 64), (4, 128), (6, 256), (3, 512)]):
         for bi in range(n_blocks):
             p = "s%d_b%d" % (si, bi)
             stride = 2 if (bi == 0 and si > 0) else 1
-            y = jax.nn.relu(_bn_train(_conv(x, params[p + "_c1_w"]), params, stats, p + "_bn1", new_stats))
+            y = jax.nn.relu(_bn_train(_conv(x, params[p + "_c1_w"], layout=layout), params, stats, p + "_bn1", new_stats, layout=layout))
             # v1.5: the stride lives on the 3x3
-            y = jax.nn.relu(_bn_train(_conv(y, params[p + "_c2_w"], stride), params, stats, p + "_bn2", new_stats))
-            y = _bn_train(_conv(y, params[p + "_c3_w"]), params, stats, p + "_bn3", new_stats)
+            y = jax.nn.relu(_bn_train(_conv(y, params[p + "_c2_w"], stride, layout=layout), params, stats, p + "_bn2", new_stats, layout=layout))
+            y = _bn_train(_conv(y, params[p + "_c3_w"], layout=layout), params, stats, p + "_bn3", new_stats, layout=layout)
             if bi == 0:
-                x = _bn_train(_conv(x, params[p + "_ds_w"], stride), params, stats, p + "_dsbn", new_stats)
+                x = _bn_train(_conv(x, params[p + "_ds_w"], stride, layout=layout), params, stats, p + "_dsbn", new_stats, layout=layout)
             x = jax.nn.relu(x + y)
-    x = jnp.mean(x.astype(jnp.float32), axis=(2, 3))  # [N, 2048]
+    pool_axes = (1, 2) if layout == "NHWC" else (2, 3)
+    x = jnp.mean(x.astype(jnp.float32), axis=pool_axes)  # [N, 2048]
     logits = x @ params["fc_w"] + params["fc_b"]
     return logits, new_stats
 
 
-def loss_fn(params, stats, images, labels):
+def loss_fn(params, stats, images, labels, layout="NCHW"):
     import jax
 
-    logits, new_stats = forward(params, stats, images)
+    logits, new_stats = forward(params, stats, images, layout=layout)
     logp = jax.nn.log_softmax(logits)
     nll = -jax.numpy.take_along_axis(logp, labels, axis=1)
     return jax.numpy.mean(nll), new_stats
 
 
-def make_train_step(lr=0.1, momentum=0.9, n_steps=1):
+def make_train_step(lr=0.1, momentum=0.9, n_steps=1, layout="NCHW"):
     """One jitted call = ``n_steps`` momentum-SGD steps (fori_loop)."""
+    import functools as _ft
+
     import jax
 
-    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    grad_fn = jax.value_and_grad(
+        _ft.partial(loss_fn, layout=layout), has_aux=True)
 
     def one(carry, images, labels):
         params, vel, stats, _ = carry
@@ -160,7 +175,7 @@ def make_train_step(lr=0.1, momentum=0.9, n_steps=1):
     return train_step
 
 
-def measure(batch=256, steps=20, chunk=10, seed=0):
+def measure(batch=256, steps=20, chunk=10, seed=0, layout="NCHW"):
     """Returns (step_time_ms, final_loss) for the pure-JAX yardstick,
     timed exactly like bench.py's framework path: ``chunk`` steps per
     jitted call, a d2h sync per chunk."""
@@ -173,13 +188,12 @@ def measure(batch=256, steps=20, chunk=10, seed=0):
     vel = jax.tree.map(lambda p: np.zeros(p.shape, p.dtype), params)
     vel = jax.device_put(vel, dev)
     rng = np.random.RandomState(0)
-    images = jax.device_put(
-        rng.uniform(-1, 1, (batch, 3, 224, 224)).astype(np.float32), dev
-    )
+    shape = (batch, 224, 224, 3) if layout == "NHWC" else (batch, 3, 224, 224)
+    images = jax.device_put(rng.uniform(-1, 1, shape).astype(np.float32), dev)
     labels = jax.device_put(rng.randint(0, 1000, (batch, 1)).astype(np.int32), dev)
 
-    step1 = make_train_step(n_steps=1)
-    stepN = make_train_step(n_steps=chunk)
+    step1 = make_train_step(n_steps=1, layout=layout)
+    stepN = make_train_step(n_steps=chunk, layout=layout)
     for _ in range(2):  # warmup/compile the single-step path
         params, vel, stats, loss = step1(params, vel, stats, images, labels)
     np.asarray(loss)

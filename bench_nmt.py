@@ -1,0 +1,130 @@
+"""Benchmark: Transformer-NMT training step (BASELINE config 4 —
+variable-length seq2seq, the LoDTensor-equivalent padded+mask encoding).
+
+Variable lengths are the bucketed-padding story: each batch row carries a
+real length; src_mask feeds the encoder/cross attention bias and the
+reported tokens/sec counts REAL (unpadded) tokens, while MFU charges the
+padded work the chip actually executes (honest accounting both ways).
+
+Role-split MFU like bench_bert.py: embedding gathers 0; per-token matmul
+params x 6 x padded tokens; attention 12*L*B*S^2*D for encoder self,
+decoder self (causal), and cross attention.
+"""
+import os
+import time
+
+import numpy as np
+
+BATCH = int(os.environ.get("BENCH_NMT_BATCH", "64"))
+SRC_LEN = int(os.environ.get("BENCH_NMT_SRC", "64"))
+TGT_LEN = int(os.environ.get("BENCH_NMT_TGT", "64"))
+STEPS = int(os.environ.get("BENCH_STEPS", "20"))
+CHUNK = int(os.environ.get("BENCH_CHUNK", "10"))
+PEAK_FLOPS = {"tpu": 197e12, "cpu": 1e12}
+
+
+def run(batch=BATCH, src_len=SRC_LEN, tgt_len=TGT_LEN, steps=STEPS, chunk=CHUNK):
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu import framework, models
+
+    platform = jax.devices()[0].platform
+    place = fluid.TPUPlace(0) if platform == "tpu" else fluid.CPUPlace()
+    use_amp = os.environ.get("BENCH_AMP", "1") == "1"
+
+    V, D, L, H, DI = 32000, 512, 6, 8, 2048
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 42
+    with framework.program_guard(prog, startup):
+        src = fluid.layers.data("src", [src_len], dtype="int64")
+        tgt = fluid.layers.data("tgt", [tgt_len], dtype="int64")
+        lbl = fluid.layers.data("lbl", [tgt_len, 1], dtype="int64")
+        smask = fluid.layers.data("smask", [src_len])
+        avg_loss, _ = models.seq2seq.transformer_nmt(
+            src, tgt, lbl, src_mask=smask, src_vocab=V, tgt_vocab=V,
+            d_model=D, n_layer=L, n_head=H, d_inner=DI,
+            src_len=src_len, tgt_len=tgt_len, dropout_rate=0.0,
+        )
+        opt = fluid.optimizer.AdamOptimizer(1e-4)
+        if use_amp:
+            opt = fluid.contrib.mixed_precision.decorate(opt)
+        opt.minimize(avg_loss)
+
+    # role split: embeddings gather; head matmuls tgt tokens; encoder
+    # blocks matmul src tokens; decoder blocks matmul tgt tokens
+    n_enc = n_dec = n_head_p = 0
+    for p in prog.all_parameters():
+        n = int(np.prod([max(1, int(s)) for s in p.shape]))
+        if "_emb" in p.name:
+            continue
+        if "_head" in p.name:
+            n_head_p += n
+        elif "_enc_" in p.name or "_src" in p.name:
+            n_enc += n
+        else:
+            n_dec += n
+
+    rng = np.random.RandomState(0)
+    srcv = rng.randint(0, V, (batch, src_len)).astype(np.int64)
+    tgtv = rng.randint(0, V, (batch, tgt_len)).astype(np.int64)
+    lblv = rng.randint(0, V, (batch, tgt_len, 1)).astype(np.int64)
+    # variable lengths: uniform in [src_len//2, src_len]
+    src_lens = rng.randint(src_len // 2, src_len + 1, (batch,))
+    smaskv = (np.arange(src_len)[None, :] < src_lens[:, None]).astype(np.float32)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(place)
+    dev = jax.devices()[0]
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        feed = {
+            "src": jax.device_put(srcv.astype(np.int32), dev),
+            "tgt": jax.device_put(tgtv.astype(np.int32), dev),
+            "lbl": jax.device_put(lblv.astype(np.int32), dev),
+            "smask": jax.device_put(smaskv, dev),
+        }
+        for _ in range(2):
+            (l,) = exe.run(prog, feed=feed, fetch_list=[avg_loss], return_numpy=False)
+            np.asarray(l)
+        (l,) = exe.run(prog, feed=feed, fetch_list=[avg_loss],
+                       return_numpy=False, steps=chunk)
+        np.asarray(l)
+        done = 0
+        t0 = time.perf_counter()
+        while done < steps:
+            (l,) = exe.run(prog, feed=feed, fetch_list=[avg_loss],
+                           return_numpy=False, steps=chunk)
+            done += chunk
+            lv = np.asarray(l)
+        dt = time.perf_counter() - t0
+
+    step_time = dt / done
+    src_tok, tgt_tok = batch * src_len, batch * tgt_len
+    real_tokens = int(src_lens.sum()) + tgt_tok
+    flops = (
+        6.0 * n_enc * src_tok
+        + 6.0 * (n_dec + n_head_p) * tgt_tok
+        + 12.0 * L * batch * src_len * src_len * D      # encoder self
+        + 12.0 * L * batch * tgt_len * tgt_len * D      # decoder self
+        + 12.0 * L * batch * tgt_len * src_len * D      # cross
+    )
+    mfu = (flops / step_time) / PEAK_FLOPS.get(platform, 197e12)
+    return {
+        "metric": "transformer_nmt_tokens_per_sec_per_chip",
+        "value": round(real_tokens / step_time, 1),
+        "unit": "tokens/sec",
+        "step_time_ms": round(step_time * 1e3, 2),
+        "mfu": round(mfu, 4),
+        "batch": batch,
+        "src_len": src_len,
+        "tgt_len": tgt_len,
+        "platform": platform,
+        "loss": float(lv),
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(run()))

@@ -33,6 +33,7 @@ class AutoMixedPrecisionLists:
     def __init__(self, custom_white_list=None, custom_black_list=None):
         self.white_list: Set[str] = {
             "matmul", "mul", "conv2d", "depthwise_conv2d", "conv2d_transpose",
+            "fused_attention",
         }
         self.black_list: Set[str] = {
             "softmax_with_cross_entropy", "cross_entropy", "mean", "sum",

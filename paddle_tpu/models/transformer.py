@@ -42,12 +42,21 @@ def multi_head_attention(
     attn_bias=None,
     is_test: bool = False,
     name: str = "att",
+    fused: bool = False,
+    mask=None,
+    causal: bool = False,
 ):
     """Scaled-dot-product multi-head attention over [N, S, d_model].
 
-    Computes q/k/v projections, [N, H, S, D] batched matmuls (MXU-shaped),
-    optional additive ``attn_bias`` ([S, S] causal or [N, 1, 1, S] padding
-    mask, broadcast into the logits), softmax, and the output projection.
+    Default path: q/k/v projections, [N, H, S, D] batched matmuls
+    (MXU-shaped), optional additive ``attn_bias`` ([S, S] causal or
+    [N, 1, 1, S] padding mask, broadcast into the logits), softmax, and
+    the output projection.
+
+    ``fused=True`` (needs dropout_rate==0 inside attention): the
+    ``fused_attention`` op — the pallas flash-attention kernel on TPU —
+    with padding as ``mask`` [N, S] and causality as ``causal=`` instead
+    of a materialized ``attn_bias``.
     """
     d_head = d_model // n_head
     q = _fc3(q_in, d_model, name + "_q")
@@ -60,13 +69,38 @@ def multi_head_attention(
         return layers.transpose(x, perm=[0, 2, 1, 3])
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
-    scores = layers.matmul(q, k, transpose_y=True, alpha=1.0 / float(np.sqrt(d_head)))
-    if attn_bias is not None:
-        scores = scores + attn_bias
-    weights = layers.softmax(scores)
-    if dropout_rate:
-        weights = layers.dropout(weights, dropout_prob=dropout_rate, is_test=is_test)
-    ctx = layers.matmul(weights, v)  # [N, H, S, D]
+    if fused:
+        if dropout_rate:
+            raise ValueError(
+                "fused attention has no in-kernel dropout; build with "
+                "dropout_rate=0 (the reference's inference/pretrain-bench "
+                "configs) or fused=False"
+            )
+        if attn_bias is not None:
+            raise ValueError(
+                "fused attention takes mask=/causal= instead of a "
+                "materialized attn_bias"
+            )
+        from paddle_tpu.layer_helper import LayerHelper
+
+        helper = LayerHelper(name + "_fused")
+        ctx = helper.create_variable_for_type_inference(q.dtype)
+        ins = {"Q": [q], "K": [k], "V": [v]}
+        if mask is not None:
+            ins["Mask"] = [mask]
+        helper.append_op(
+            type="fused_attention", inputs=ins, outputs={"Out": [ctx]},
+            attrs={"causal": bool(causal),
+                   "scale": 1.0 / float(np.sqrt(d_head))},
+        )
+    else:
+        scores = layers.matmul(q, k, transpose_y=True, alpha=1.0 / float(np.sqrt(d_head)))
+        if attn_bias is not None:
+            scores = scores + attn_bias
+        weights = layers.softmax(scores)
+        if dropout_rate:
+            weights = layers.dropout(weights, dropout_prob=dropout_rate, is_test=is_test)
+        ctx = layers.matmul(weights, v)  # [N, H, S, D]
     ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
     ctx = layers.reshape(ctx, shape=[0, 0, d_model])
     return _fc3(ctx, d_model, name + "_out")
@@ -88,10 +122,14 @@ def encoder_layer(
     dropout_rate: float = 0.1,
     is_test: bool = False,
     name: str = "enc_0",
+    fused: bool = False,
+    mask=None,
+    causal: bool = False,
 ):
     """Post-LN transformer block (attention + FFN, residuals)."""
     att = multi_head_attention(
-        x, x, d_model, n_head, dropout_rate, attn_bias, is_test, name=name + "_att"
+        x, x, d_model, n_head, dropout_rate, attn_bias, is_test,
+        name=name + "_att", fused=fused, mask=mask, causal=causal,
     )
     if dropout_rate:
         att = layers.dropout(att, dropout_prob=dropout_rate, is_test=is_test)
@@ -152,10 +190,12 @@ def bert_encoder(
     dropout_rate: float = 0.1,
     is_test: bool = False,
     name: str = "bert",
+    fused_attention: bool = False,
 ):
     """BERT-base encoder; returns the [N, S, d_model] sequence output.
 
-    ``input_mask``: float [N, S] (1 = token, 0 = pad) -> additive bias.
+    ``input_mask``: float [N, S] (1 = token, 0 = pad) -> additive bias
+    (or segment ids on the ``fused_attention=True`` flash path).
     """
     x = _embeddings(src_ids, vocab_size, d_model, max_pos, seq_len, name, sent_ids, 2)
     x = layers.layer_norm(
@@ -167,12 +207,14 @@ def bert_encoder(
     if dropout_rate:
         x = layers.dropout(x, dropout_prob=dropout_rate, is_test=is_test)
     attn_bias = None
-    if input_mask is not None:
+    if input_mask is not None and not fused_attention:
         m = layers.reshape(input_mask, shape=[-1, 1, 1, seq_len])
         attn_bias = layers.scale(m, scale=1e9, bias=-1e9)  # (m-1)*1e9
     for i in range(n_layer):
         x = encoder_layer(
-            x, d_model, n_head, d_inner, attn_bias, dropout_rate, is_test, name="%s_enc_%d" % (name, i)
+            x, d_model, n_head, d_inner, attn_bias, dropout_rate, is_test,
+            name="%s_enc_%d" % (name, i), fused=fused_attention,
+            mask=input_mask if fused_attention else None,
         )
     return x
 
@@ -224,6 +266,7 @@ def bert_pretrain(
     dropout_rate: float = 0.1,
     is_test: bool = False,
     name: str = "bert",
+    fused_attention: bool = False,
 ):
     """BERT pretraining objective: masked-LM + next-sentence prediction
     (BASELINE.json flagship config 3; reference model family:
@@ -238,6 +281,7 @@ def bert_pretrain(
     enc = bert_encoder(
         src_ids, input_mask, sent_ids, vocab_size, d_model, n_layer, n_head,
         d_inner, max_pos, seq_len, dropout_rate, is_test, name,
+        fused_attention=fused_attention,
     )  # [N, S, D]
 
     # ---- masked LM head over gathered positions

@@ -569,7 +569,6 @@ def test_geo_sgd_two_trainers():
         server.stop()
 
 
-@pytest.mark.slow
 def test_dygraph_data_parallel_two_processes(tmp_path):
     """Dygraph DataParallel with a REAL cross-process grad allreduce
     (host collective on rank-0's server; reference: dygraph/parallel.py
@@ -784,7 +783,6 @@ def test_dense_ps_momentum_loss_parity():
         lambda: fluid.optimizer.MomentumOptimizer(0.1, momentum=0.9))
 
 
-@pytest.mark.slow
 def test_dense_ps_adam_loss_parity():
     _run_dense_ps_parity(
         lambda: fluid.optimizer.AdamOptimizer(0.01), rtol=5e-4)

@@ -113,6 +113,7 @@ def test_no_grad_blocks_taping():
         assert x.gradient() is not None
 
 
+@pytest.mark.slow
 def test_conv2d_transpose_layer_trains():
     rng = np.random.RandomState(2)
     xb = rng.uniform(-1, 1, (4, 3, 5, 5)).astype("float32")
@@ -131,6 +132,7 @@ def test_conv2d_transpose_layer_trains():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_groupnorm_prelu_layers_train():
     rng = np.random.RandomState(3)
     xb = rng.uniform(-1, 1, (4, 6, 4, 4)).astype("float32")
@@ -220,6 +222,7 @@ def test_bilinear_tensor_product_layer_trains():
     assert tuple(btp.weight.shape) == (4, 3, 5)
 
 
+@pytest.mark.slow
 def test_dygraph_lr_decay_and_3d_layers():
     """LearningRateDecay objects advance per minimize() (reference:
     dygraph/learning_rate_scheduler.py), and the Conv3D/Conv3DTranspose/
@@ -259,6 +262,7 @@ def test_dygraph_lr_decay_and_3d_layers():
         assert tuple(out.numpy().shape) == (1, 5, 4, 2)
 
 
+@pytest.mark.slow
 def test_rowconv_seqconv_layers_train():
     rng = np.random.RandomState(11)
     xb = rng.randn(3, 6, 5).astype("float32")

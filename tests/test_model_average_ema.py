@@ -1,6 +1,7 @@
 """ModelAverage / EMA / PipelineOptimizer tests (reference:
 tests/unittests/test_ema.py, test_pipeline.py)."""
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import framework
@@ -211,6 +212,7 @@ def test_pipeline_optimizer_cut_program_parity():
     assert piped[-1] < piped[0]
 
 
+@pytest.mark.slow
 def test_pipeline_four_stages_momentum():
     """4-stage cut with Momentum: functional velocity state matches the
     momentum-op single-device run."""

@@ -156,6 +156,7 @@ def test_deepfm_trains():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_bert_pretrain_trains():
     """MLM+NSP pretraining objective trains on a tiny config (flagship
     BASELINE config 3; heads follow the original BERT recipe)."""
