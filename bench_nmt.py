@@ -52,14 +52,18 @@ def run(batch=BATCH, src_len=SRC_LEN, tgt_len=TGT_LEN, steps=STEPS, chunk=CHUNK)
         opt.minimize(avg_loss)
 
     # role split: embeddings gather; head matmuls tgt tokens; encoder
-    # blocks matmul src tokens; decoder blocks matmul tgt tokens
-    n_enc = n_dec = n_head_p = 0
+    # blocks matmul src tokens; decoder blocks matmul tgt tokens — EXCEPT
+    # the cross-attention K/V projections, which consume the encoder
+    # output (src tokens)
+    n_enc = n_dec = n_head_p = n_cross_kv = 0
     for p in prog.all_parameters():
         n = int(np.prod([max(1, int(s)) for s in p.shape]))
         if "_emb" in p.name:
             continue
         if "_head" in p.name:
             n_head_p += n
+        elif "_cross_k" in p.name or "_cross_v" in p.name:
+            n_cross_kv += n
         elif "_enc_" in p.name or "_src" in p.name:
             n_enc += n
         else:
@@ -103,7 +107,7 @@ def run(batch=BATCH, src_len=SRC_LEN, tgt_len=TGT_LEN, steps=STEPS, chunk=CHUNK)
     src_tok, tgt_tok = batch * src_len, batch * tgt_len
     real_tokens = int(src_lens.sum()) + tgt_tok
     flops = (
-        6.0 * n_enc * src_tok
+        6.0 * (n_enc + n_cross_kv) * src_tok
         + 6.0 * (n_dec + n_head_p) * tgt_tok
         + 12.0 * L * batch * src_len * src_len * D      # encoder self
         + 12.0 * L * batch * tgt_len * tgt_len * D      # decoder self

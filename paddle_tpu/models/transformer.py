@@ -94,6 +94,12 @@ def multi_head_attention(
                    "scale": 1.0 / float(np.sqrt(d_head))},
         )
     else:
+        if mask is not None or causal:
+            raise ValueError(
+                "mask=/causal= are the fused-path inputs; the unfused path "
+                "takes a materialized attn_bias (silently ignoring them "
+                "would drop the masking)"
+            )
         scores = layers.matmul(q, k, transpose_y=True, alpha=1.0 / float(np.sqrt(d_head)))
         if attn_bias is not None:
             scores = scores + attn_bias

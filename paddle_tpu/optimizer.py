@@ -834,12 +834,31 @@ class PipelineOptimizer:
         prog = loss.block.program
         if self._cut_list:
             opt = self._optimizer
-            if opt.regularization is not None:
+            # program-level regularization ops land on the grad side,
+            # which the AD-replay schedule skips; record the decay rule
+            # per param and apply it functionally in the replay
+            # (parallel/pipeline_program.py local_step)
+            def _decay_rule(reg):
+                from paddle_tpu import regularizer as reg_mod
+
+                if reg is None:
+                    return None
+                if isinstance(reg, reg_mod.L2DecayRegularizer):
+                    return ("l2", float(reg._coeff))
+                if isinstance(reg, reg_mod.L1DecayRegularizer):
+                    return ("l1", float(reg._coeff))
                 raise NotImplementedError(
-                    "pipeline path computes grads via AD through the "
-                    "schedule; program-level regularization ops would be "
-                    "skipped — fold decay into the optimizer or use hybrid"
+                    "pipeline path supports L1/L2 decay regularization "
+                    "(got %s)" % type(reg).__name__
                 )
+
+            decay_map = {}
+            for p in prog.all_parameters():
+                rule = _decay_rule(
+                    getattr(p, "regularizer", None) or opt.regularization
+                )
+                if rule is not None and p.trainable:
+                    decay_map[p.name] = rule
             if parameter_list is not None or no_grad_set:
                 raise NotImplementedError("pipeline path updates all trainable params")
             for p in prog.all_parameters():
@@ -882,6 +901,7 @@ class PipelineOptimizer:
                 "num_microbatches": self._num_microbatches,
                 "loss_name": loss.name,
                 "update_descs": update_descs,
+                "decay": decay_map,
             }
             return ops, params_grads
         ops, pgs = self._optimizer.minimize(loss, startup_program, parameter_list, no_grad_set)

@@ -211,6 +211,14 @@ def build_pipeline_step(program, loss_name: str, plan: Dict[str, Any], mesh):
             loss_local, grads = jax.value_and_grad(run_local)(params, feeds_mb)
             loss = jax.lax.psum(loss_local, "pp")
             grads = {n: jax.lax.psum(g, "pp") for n, g in grads.items()}
+            # weight decay (the program's regularization ops run on the
+            # grad side, which AD-replay skips; reference:
+            # regularizer.py append_regularization_ops grad += decay)
+            for pname, (kind, coeff) in plan.get("decay", {}).items():
+                if pname in grads:
+                    p = params[pname]
+                    extra = coeff * (jnp.sign(p) if kind == "l1" else p)
+                    grads[pname] = grads[pname] + extra
             new_state = dict(state)
             for desc in update_descs:
                 pname = desc["inputs"]["Param"][0]

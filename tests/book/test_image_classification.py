@@ -1,0 +1,106 @@
+"""Book test: image classification on CIFAR-shaped data (reference:
+python/paddle/fluid/tests/book/test_image_classification.py — vgg16_bn_drop
+and resnet_cifar10 nets, both trained with Adam on cross-entropy).
+
+Synthetic 32x32 data (no-egress box); both nets must beat their initial
+loss on a learnable color-rule task.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import framework
+
+
+def _resnet_cifar10(x, depth=8, class_num=4):
+    """reference: test_image_classification.py resnet_cifar10 — 6n+2
+    basicblock stack (conv_bn + shortcut)."""
+    assert (depth - 2) % 6 == 0
+    n = (depth - 2) // 6
+
+    def conv_bn(x, ch, k, stride, pad, act="relu"):
+        c = fluid.layers.conv2d(x, ch, k, stride=stride, padding=pad,
+                                bias_attr=False)
+        return fluid.layers.batch_norm(c, act=act)
+
+    def shortcut(x, ch_in, ch_out, stride):
+        if ch_in != ch_out:
+            return conv_bn(x, ch_out, 1, stride, 0, act=None)
+        return x
+
+    def basicblock(x, ch_in, ch_out, stride):
+        y = conv_bn(x, ch_out, 3, stride, 1)
+        y = conv_bn(y, ch_out, 3, 1, 1, act=None)
+        return fluid.layers.elementwise_add(
+            y, shortcut(x, ch_in, ch_out, stride), act="relu")
+
+    def layer_warp(x, ch_in, ch_out, count, stride):
+        x = basicblock(x, ch_in, ch_out, stride)
+        for _ in range(count - 1):
+            x = basicblock(x, ch_out, ch_out, 1)
+        return x
+
+    x = conv_bn(x, 16, 3, 1, 1)
+    x = layer_warp(x, 16, 16, n, 1)
+    x = layer_warp(x, 16, 32, n, 2)
+    x = layer_warp(x, 32, 64, n, 2)
+    pool = fluid.layers.pool2d(x, pool_type="avg", global_pooling=True)
+    return fluid.layers.fc(pool, class_num, act="softmax")
+
+
+def _vgg_bn_drop(x, class_num=4):
+    """reference: test_image_classification.py vgg16_bn_drop, thinned to
+    two conv blocks for the tiny synthetic task."""
+    def conv_block(x, ch, groups):
+        for _ in range(groups):
+            c = fluid.layers.conv2d(x, ch, 3, padding=1, bias_attr=False)
+            x = fluid.layers.batch_norm(c, act="relu")
+        return fluid.layers.pool2d(x, pool_size=2, pool_stride=2)
+
+    x = conv_block(x, 16, 2)
+    x = conv_block(x, 32, 1)
+    fc1 = fluid.layers.fc(x, 64, act=None)
+    bn = fluid.layers.batch_norm(fc1, act="relu")
+    fc2 = fluid.layers.fc(bn, 64, act=None)
+    return fluid.layers.fc(fc2, class_num, act="softmax")
+
+
+def _train(net_fn, seed):
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = seed
+    with framework.program_guard(prog, startup):
+        img = fluid.layers.data("img", [3, 16, 16])
+        lbl = fluid.layers.data("lbl", [1], dtype="int64")
+        predict = net_fn(img)
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(predict, lbl))
+        acc = fluid.layers.accuracy(predict, lbl)
+        fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+
+    # learnable rule: the dominant color channel is the class
+    rng = np.random.RandomState(0)
+    B = 32
+    imgs = rng.rand(B, 3, 16, 16).astype("float32") * 0.1
+    lbls = rng.randint(0, 3, (B, 1)).astype("int64")
+    for i in range(B):
+        imgs[i, lbls[i, 0]] += 0.8
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(12):
+            l, a = exe.run(prog, feed={"img": imgs, "lbl": lbls},
+                           fetch_list=[loss, acc])
+            losses.append(float(np.asarray(l)))
+    assert losses[-1] < losses[0] * 0.6, losses
+    return float(np.asarray(a))
+
+
+@pytest.mark.slow
+def test_image_classification_resnet():
+    _train(_resnet_cifar10, seed=61)
+
+
+@pytest.mark.slow
+def test_image_classification_vgg():
+    _train(_vgg_bn_drop, seed=62)

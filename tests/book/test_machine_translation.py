@@ -13,6 +13,7 @@ sequence_expand-over-LoD), and layers.beam_search_decode backtracking the
 arrays into [B, K, T] sequences.
 """
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import framework
@@ -49,6 +50,7 @@ def _decoder_step(word_emb, state, name_prefix="mt_dec"):
     return cur, logits
 
 
+@pytest.mark.slow
 def test_machine_translation_trains():
     prog, startup = framework.Program(), framework.Program()
     prog.random_seed = startup.random_seed = 77

@@ -1,0 +1,117 @@
+"""Book test: RNN encoder-decoder seq2seq (reference:
+python/paddle/fluid/tests/book/test_rnn_encoder_decoder.py — bi-LSTM
+encoder -> hand-written lstm_step inside a DynamicRNN decoder with a
+static context input, cross-entropy on next words).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import framework
+
+V = 30          # shared dict size
+D = 8           # word embedding dim
+H = 12          # encoder hidden (per direction)
+DEC = 16        # decoder size
+T_SRC = 6
+T_TGT = 5
+
+
+def _bi_lstm_encoder(input_seq, src_len):
+    fwd_proj = fluid.layers.fc(input_seq, H * 4, num_flatten_dims=2,
+                               bias_attr=True)
+    forward, _ = fluid.layers.dynamic_lstm(fwd_proj, size=H * 4,
+                                           seq_len=src_len)
+    bwd_proj = fluid.layers.fc(input_seq, H * 4, num_flatten_dims=2,
+                               bias_attr=True)
+    backward, _ = fluid.layers.dynamic_lstm(bwd_proj, size=H * 4,
+                                            is_reverse=True, seq_len=src_len)
+    forward_last = fluid.layers.sequence_last_step(forward, seq_len=src_len)
+    backward_first = fluid.layers.sequence_first_step(backward,
+                                                      seq_len=src_len)
+    return forward_last, backward_first
+
+
+def _lstm_step(x_t, hidden_prev, cell_prev, size):
+    def linear(inputs):
+        return fluid.layers.fc(inputs, size, bias_attr=True)
+
+    forget_gate = fluid.layers.sigmoid(linear([hidden_prev, x_t]))
+    input_gate = fluid.layers.sigmoid(linear([hidden_prev, x_t]))
+    output_gate = fluid.layers.sigmoid(linear([hidden_prev, x_t]))
+    cell_tilde = fluid.layers.tanh(linear([hidden_prev, x_t]))
+    cell_t = fluid.layers.sums([
+        fluid.layers.elementwise_mul(forget_gate, cell_prev),
+        fluid.layers.elementwise_mul(input_gate, cell_tilde),
+    ])
+    hidden_t = fluid.layers.elementwise_mul(
+        output_gate, fluid.layers.tanh(cell_t))
+    return hidden_t, cell_t
+
+
+@pytest.mark.slow
+def test_rnn_encoder_decoder_trains():
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 83
+    with framework.program_guard(prog, startup):
+        src = fluid.layers.data("src", [T_SRC], dtype="int64", lod_level=1)
+        src_len = prog.global_block().var("src_seq_len")
+        trg = fluid.layers.data("trg", [T_TGT], dtype="int64")
+        nxt = fluid.layers.data("nxt", [T_TGT, 1], dtype="int64")
+
+        src_emb = fluid.layers.embedding(
+            src, size=[V, D], param_attr=fluid.ParamAttr(name="red_src_emb"))
+        fwd_last, bwd_first = _bi_lstm_encoder(src_emb, src_len)
+        encoded = fluid.layers.concat([fwd_last, bwd_first], axis=1)
+        decoder_boot = fluid.layers.fc(encoded, DEC, act="tanh",
+                                       bias_attr=False)
+        context = fluid.layers.fc(encoded, DEC, bias_attr=False)
+
+        trg_emb = fluid.layers.embedding(
+            trg, size=[V, D], param_attr=fluid.ParamAttr(name="red_trg_emb"))
+        cell_init = fluid.layers.fill_constant_batch_size_like(
+            decoder_boot, shape=[-1, DEC], dtype="float32", value=0.0)
+        cell_init.stop_gradient = False
+        trg_len = fluid.layers.fill_constant_batch_size_like(
+            decoder_boot, shape=[-1], dtype="int32", value=T_TGT)
+
+        rnn = fluid.layers.DynamicRNN()
+        with rnn.block():
+            current_word = rnn.step_input(trg_emb, seq_len=trg_len)
+            ctx = rnn.static_input(context)
+            hidden_mem = rnn.memory(init=decoder_boot, need_reorder=True)
+            cell_mem = rnn.memory(init=cell_init)
+            decoder_inputs = fluid.layers.concat([ctx, current_word], axis=1)
+            h, c = _lstm_step(decoder_inputs, hidden_mem, cell_mem, DEC)
+            rnn.update_memory(hidden_mem, h)
+            rnn.update_memory(cell_mem, c)
+            out = fluid.layers.fc(h, V, bias_attr=True, act="softmax")
+            rnn.output(out)
+        probs = rnn()  # [B, T_TGT, V]
+        cost = fluid.layers.cross_entropy(
+            fluid.layers.reshape(probs, shape=[-1, V]),
+            fluid.layers.reshape(nxt, shape=[-1, 1]))
+        avg_cost = fluid.layers.mean(cost)
+        fluid.optimizer.AdagradOptimizer(0.05).minimize(avg_cost)
+
+    rng = np.random.RandomState(0)
+    B = 16
+    srcv = rng.randint(3, V, (B, T_SRC)).astype("int64")
+    lens = rng.randint(2, T_SRC + 1, (B,)).astype("int32")
+    trgv = np.empty((B, T_TGT), "int64")
+    trgv[:, 0] = 1
+    for t in range(1, T_TGT):
+        trgv[:, t] = (trgv[:, t - 1] * 7 + 3) % V
+    nxtv = ((trgv * 7 + 3) % V)[:, :, None].astype("int64")
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(30):
+            (l,) = exe.run(
+                prog, feed={"src": srcv, "src_seq_len": lens, "trg": trgv,
+                            "nxt": nxtv},
+                fetch_list=[avg_cost])
+            losses.append(float(np.asarray(l)))
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])

@@ -241,6 +241,7 @@ class TestBipartiteMatchOp(OpTest):
         self.check_output()
 
 
+@pytest.mark.slow
 def test_nce_and_hsigmoid_train():
     """NCE (uniform + log_uniform samplers) and hierarchical sigmoid
     train a small classifier (loss decreases) — the reference's

@@ -165,6 +165,7 @@ def test_hybrid_engine_adam_parity():
             err_msg="param %s diverged under Adam + %s" % (k, axes))
 
 
+@pytest.mark.slow
 def test_fleet_api_reaches_hybrid_engine():
     """fleet.distributed_optimizer(...).build_hybrid_train_step() — one
     user-facing API reaches 5D parallelism with the user's optimizer

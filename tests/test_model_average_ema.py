@@ -331,3 +331,59 @@ def test_pipeline_any_optimizer_adam_parity_and_weight_fetch():
     np.testing.assert_allclose(w_piped, w_single, rtol=2e-3, atol=1e-5)
     # the fetched weight is the post-step value
     np.testing.assert_allclose(np.asarray(w_fetch), w_piped, rtol=1e-6)
+
+
+def test_pipeline_with_l2_regularization_parity():
+    """Pipeline replay applies the program's weight decay functionally
+    (the grad-side regularization ops the AD schedule skips; VERDICT r3
+    known-gap): 2-stage momentum + L2 decay == single-device trajectory,
+    whose program DOES run the regularization ops."""
+    import jax
+
+    if len(jax.devices("cpu")) < 2:
+        import pytest
+        pytest.skip("needs 2 virtual devices")
+
+    B, D, H = 16, 6, 5
+
+    def build(pipelined, decay=0.05):
+        prog, startup = framework.Program(), framework.Program()
+        prog.random_seed = startup.random_seed = 37
+        with framework.program_guard(prog, startup):
+            x = fluid.layers.data("x", [D])
+            y = fluid.layers.data("y", [1])
+            h = fluid.layers.fc(x, H, act="tanh", name="ppr_fc0")
+            pred = fluid.layers.fc(h, 1, name="ppr_fc1")
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+            inner = fluid.optimizer.MomentumOptimizer(
+                0.05, 0.9,
+                regularization=(fluid.regularizer.L2Decay(decay)
+                                if decay else None))
+            if pipelined:
+                opt = fluid.optimizer.PipelineOptimizer(
+                    inner, cut_list=[h], num_microbatches=4)
+            else:
+                opt = inner
+            opt.minimize(loss)
+        return prog, startup, loss
+
+    rng = np.random.RandomState(11)
+    xb = rng.uniform(-1, 1, (B, D)).astype("float32")
+    yb = xb.sum(1, keepdims=True).astype("float32") * 0.4
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    def train(prog, startup, loss):
+        losses = []
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            for _ in range(6):
+                (l,) = exe.run(prog, feed={"x": xb, "y": yb}, fetch_list=[loss])
+                losses.append(float(np.asarray(l)))
+        return losses
+
+    single = train(*build(False))
+    piped = train(*build(True))
+    np.testing.assert_allclose(piped, single, rtol=2e-4)
+    # decay actually bites: a no-decay run must diverge from both
+    nodecay = train(*build(False, decay=0.0))
+    assert abs(nodecay[-1] - single[-1]) > 1e-5
