@@ -46,19 +46,23 @@ def run_resnet(batch=BATCH, steps=STEPS, chunk=CHUNK):
     place = fluid.TPUPlace(0) if platform == "tpu" else fluid.CPUPlace()
 
     use_amp = os.environ.get("BENCH_AMP", "1") == "1"
+    layout = os.environ.get("BENCH_LAYOUT", "NCHW").upper()  # NHWC = channels-last probe
+    if layout not in ("NCHW", "NHWC"):
+        raise ValueError("BENCH_LAYOUT must be NCHW or NHWC (got %r)" % layout)
+    img_shape = [3, 224, 224] if layout == "NCHW" else [224, 224, 3]
     prog, startup = framework.Program(), framework.Program()
     prog.random_seed = startup.random_seed = 42
     with framework.program_guard(prog, startup):
-        img = fluid.layers.data("img", [3, 224, 224])
+        img = fluid.layers.data("img", img_shape)
         lbl = fluid.layers.data("lbl", [1], dtype="int64")
-        avg_loss, acc, _ = models.resnet50(img, lbl)
+        avg_loss, acc, _ = models.resnet50(img, lbl, data_format=layout)
         opt = fluid.optimizer.MomentumOptimizer(learning_rate=0.1, momentum=0.9)
         if use_amp:
             opt = fluid.contrib.mixed_precision.decorate(opt)
         opt.minimize(avg_loss)
 
     rng = np.random.RandomState(0)
-    imgs = rng.uniform(-1, 1, (batch, 3, 224, 224)).astype(np.float32)
+    imgs = rng.uniform(-1, 1, tuple([batch] + img_shape)).astype(np.float32)
     lbls = rng.randint(0, 1000, (batch, 1)).astype(np.int64)
 
     scope = fluid.Scope()
@@ -96,6 +100,7 @@ def run_resnet(batch=BATCH, steps=STEPS, chunk=CHUNK):
     mfu = (flops_per_step / step_time) / PEAK_FLOPS.get(platform, 197e12)
     out = {
         "images_per_sec": round(ips, 2),
+        "layout": layout,
         "step_time_ms": round(step_time * 1e3, 2),
         "mfu": round(mfu, 4),
         "batch": batch,
@@ -108,7 +113,7 @@ def run_resnet(batch=BATCH, steps=STEPS, chunk=CHUNK):
         for cal_chunk in (chunk, 1):  # tunnel compile of the chunked
             try:                      # module can flake; 1-step fallback
                 pure_ms, _ = bench_calibration.measure(
-                    batch=batch, steps=steps, chunk=cal_chunk
+                    batch=batch, steps=steps, chunk=cal_chunk, layout=layout
                 )
                 used_chunk = cal_chunk
                 break

@@ -13,8 +13,8 @@ import time
 import numpy as np
 
 BATCH = int(os.environ.get("BENCH_DEEPFM_BATCH", "4096"))
-STEPS = int(os.environ.get("BENCH_STEPS", "20"))
-CHUNK = int(os.environ.get("BENCH_CHUNK", "10"))
+STEPS = int(os.environ.get("BENCH_DEEPFM_STEPS", "10"))
+CHUNK = int(os.environ.get("BENCH_DEEPFM_CHUNK", "5"))
 PEAK_FLOPS = {"tpu": 197e12, "cpu": 1e12}
 NUM_FEATURES = int(os.environ.get("BENCH_DEEPFM_FEATURES", "1000000"))
 FIELDS = 39

@@ -18,8 +18,8 @@ import numpy as np
 BATCH = int(os.environ.get("BENCH_NMT_BATCH", "64"))
 SRC_LEN = int(os.environ.get("BENCH_NMT_SRC", "64"))
 TGT_LEN = int(os.environ.get("BENCH_NMT_TGT", "64"))
-STEPS = int(os.environ.get("BENCH_STEPS", "20"))
-CHUNK = int(os.environ.get("BENCH_CHUNK", "10"))
+STEPS = int(os.environ.get("BENCH_NMT_STEPS", "10"))
+CHUNK = int(os.environ.get("BENCH_NMT_CHUNK", "5"))
 PEAK_FLOPS = {"tpu": 197e12, "cpu": 1e12}
 
 

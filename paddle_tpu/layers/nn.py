@@ -195,10 +195,18 @@ def conv2d(
     use_cudnn=True,
     act=None,
     name=None,
+    data_format="NCHW",
 ):
-    """reference: layers/nn.py conv2d (cuDNN dispatch dropped — XLA owns codegen)."""
+    """reference: layers/nn.py conv2d (cuDNN dispatch dropped — XLA owns
+    codegen).  ``data_format="NHWC"`` runs channels-last, the
+    TPU-preferred activation layout."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(
+            "conv2d data_format must be 'NCHW' or 'NHWC' (got %r)"
+            % (data_format,)
+        )
     helper = LayerHelper("conv2d", param_attr=param_attr, bias_attr=bias_attr, act=act, name=name)
-    num_channels = input.shape[1]
+    num_channels = input.shape[1] if data_format == "NCHW" else input.shape[-1]
     fsize = filter_size if isinstance(filter_size, (list, tuple)) else [filter_size] * 2
     filter_shape = [num_filters, num_channels // groups] + list(fsize)
     from paddle_tpu import initializer
@@ -221,24 +229,26 @@ def conv2d(
             "paddings": list(padding) if isinstance(padding, (list, tuple)) else [padding] * 2,
             "dilations": list(dilation) if isinstance(dilation, (list, tuple)) else [dilation] * 2,
             "groups": groups,
+            "data_format": data_format,
         },
     )
-    pre_act = _conv_bias(helper, pre_bias)
+    pre_act = _conv_bias(helper, pre_bias, data_format)
     return helper.append_activation(pre_act)
 
 
-def _conv_bias(helper, pre_bias):
+def _conv_bias(helper, pre_bias, data_format="NCHW"):
     bias_attr = helper.bias_attr
     if bias_attr is False:
         return pre_bias
-    num_filters = pre_bias.shape[1]
+    caxis = 1 if data_format == "NCHW" else len(pre_bias.shape) - 1
+    num_filters = pre_bias.shape[caxis]
     b = helper.create_parameter(bias_attr, shape=[num_filters], dtype=pre_bias.dtype, is_bias=True)
     tmp = helper.create_variable_for_type_inference(pre_bias.dtype)
     helper.append_op(
         type="elementwise_add",
         inputs={"X": [pre_bias], "Y": [b]},
         outputs={"Out": [tmp]},
-        attrs={"axis": 1},
+        attrs={"axis": caxis},
     )
     return tmp
 
@@ -289,6 +299,7 @@ def pool2d(
     ceil_mode=False,
     exclusive=True,
     name=None,
+    data_format="NCHW",
 ):
     helper = LayerHelper("pool2d", name=name)
     out = helper.create_variable_for_type_inference(input.dtype)
@@ -302,6 +313,7 @@ def pool2d(
             "strides": list(pool_stride) if isinstance(pool_stride, (list, tuple)) else [pool_stride] * 2,
             "paddings": list(pool_padding) if isinstance(pool_padding, (list, tuple)) else [pool_padding] * 2,
             "global_pooling": global_pooling,
+            "data_format": data_format,
             "ceil_mode": ceil_mode,
             "exclusive": exclusive,
         },

@@ -91,6 +91,10 @@ def _pair(v):
 
 @register_op("conv2d")
 def conv2d(inputs, attrs):
+    """reference: conv_op.cc.  ``data_format``: NCHW (reference default)
+    or NHWC — the TPU-preferred channels-last layout (weights stay OIHW
+    in both; XLA relayouts internally either way, but NHWC activations
+    skip the boundary transposes)."""
     jax = _jax()
     x = one(inputs, "Input")
     w = one(inputs, "Filter")
@@ -98,6 +102,7 @@ def conv2d(inputs, attrs):
     pads = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
     groups = attrs.get("groups", 1)
+    fmt = attrs.get("data_format", "NCHW")
     out = jax.lax.conv_general_dilated(
         x,
         w,
@@ -105,11 +110,11 @@ def conv2d(inputs, attrs):
         padding=[(pads[0], pads[0]), (pads[1], pads[1])],
         rhs_dilation=dilations,
         feature_group_count=groups,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=(fmt, "OIHW", fmt),
     )
     b = one(inputs, "Bias")
     if b is not None:
-        out = out + b.reshape((1, -1, 1, 1))
+        out = out + b.reshape((1, -1, 1, 1) if fmt == "NCHW" else (1, 1, 1, -1))
     return {"Output": out}
 
 
@@ -117,7 +122,8 @@ def conv2d(inputs, attrs):
 def depthwise_conv2d(inputs, attrs):
     attrs = dict(attrs)
     x = one(inputs, "Input")
-    attrs["groups"] = x.shape[1]
+    fmt = attrs.get("data_format", "NCHW")
+    attrs["groups"] = x.shape[1] if fmt == "NCHW" else x.shape[-1]
     return conv2d(inputs, attrs)
 
 
@@ -160,13 +166,20 @@ def pool2d(inputs, attrs):
     ksize = _pair(attrs.get("ksize", [2, 2]))
     strides = _pair(attrs.get("strides", [2, 2]))
     pads = _pair(attrs.get("paddings", [0, 0]))
+    fmt = attrs.get("data_format", "NCHW")
+    sp = (2, 3) if fmt == "NCHW" else (1, 2)  # spatial axes
     if attrs.get("global_pooling", False) or attrs.get("adaptive", False) and tuple(attrs.get("ksize")) == (1, 1):
         if ptype == "max":
-            return {"Out": jnp.max(x, axis=(2, 3), keepdims=True)}
-        return {"Out": jnp.mean(x, axis=(2, 3), keepdims=True)}
-    window = (1, 1) + ksize
-    strides4 = (1, 1) + strides
-    padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+            return {"Out": jnp.max(x, axis=sp, keepdims=True)}
+        return {"Out": jnp.mean(x, axis=sp, keepdims=True)}
+    if fmt == "NCHW":
+        window = (1, 1) + ksize
+        strides4 = (1, 1) + strides
+        padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    else:
+        window = (1,) + ksize + (1,)
+        strides4 = (1,) + strides + (1,)
+        padding = ((0, 0), (pads[0], pads[0]), (pads[1], pads[1]), (0, 0))
     if ptype == "max":
         init = -jnp.inf
         out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides4, padding)
