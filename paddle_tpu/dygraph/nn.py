@@ -522,11 +522,12 @@ class NCE(Layer):
     def __init__(self, name_scope=None, num_total_classes=None, dim=None,
                  sample_weight=None, param_attr=None, bias_attr=None,
                  num_neg_samples=10, sampler="uniform", seed=0,
-                 is_sparse=False, dtype="float32"):
+                 is_sparse=False, dtype="float32", custom_dist=None):
         super().__init__(name_scope, dtype)
-        if sampler not in ("uniform", "log_uniform") or sample_weight is not None:
-            raise NotImplementedError(
-                "NCE supports sampler='uniform'|'log_uniform' without sample_weight")
+        if custom_dist is not None:
+            sampler = "custom_dist"
+        if sampler not in ("uniform", "log_uniform", "custom_dist"):
+            raise ValueError("NCE: unknown sampler %r" % sampler)
         from paddle_tpu.layer_helper import LayerHelper
 
         helper = LayerHelper(self._full_name, param_attr=param_attr,
@@ -535,10 +536,20 @@ class NCE(Layer):
             param_attr, shape=[num_total_classes, dim], dtype=dtype)
         self.bias = helper.create_parameter(
             bias_attr, shape=[num_total_classes], dtype=dtype, is_bias=True)
+        self._sample_weight = sample_weight
         self._attrs = {"num_neg_samples": num_neg_samples, "seed": seed,
                        "sampler": sampler}
+        if custom_dist is not None:
+            import numpy as _np
 
-    def forward(self, input, label):
+            dist = _np.asarray(custom_dist, dtype=_np.float32).reshape(-1)
+            if dist.shape[0] != num_total_classes:
+                raise ValueError(
+                    "NCE: custom_dist length %d != num_total_classes %d"
+                    % (dist.shape[0], num_total_classes))
+            self._attrs["custom_dist"] = dist
+
+    def forward(self, input, label, sample_weight=None):
         from paddle_tpu.layer_helper import LayerHelper
 
         helper = LayerHelper(self._full_name)
@@ -546,6 +557,9 @@ class NCE(Layer):
         ins = {"Input": [input], "Label": [label], "Weight": [self.weight]}
         if self.bias is not None:
             ins["Bias"] = [self.bias]
+        sw = sample_weight if sample_weight is not None else self._sample_weight
+        if sw is not None:
+            ins["SampleWeight"] = [sw]
         helper.append_op(type="nce", inputs=ins, outputs={"Cost": [cost]},
                          attrs=dict(self._attrs))
         return cost
