@@ -80,44 +80,48 @@ def run(batch=BATCH, seq=SEQ, steps=STEPS, chunk=CHUNK):
             n_head += n
     n_enc = n_params - n_embed - n_mlm - n_head
 
+    # CHUNK *distinct* batches, stacked on a leading axis and consumed one
+    # per fori_loop iteration (Executor per_step_feed, VERDICT r4 weakness
+    # #3: the 57.1% headline was a same-batch number).  BENCH_FRESH=0
+    # restores the old same-batch regime for A/B comparison.
+    import bench_common
+
+    fresh = bench_common.fresh_enabled()
+    n_b = chunk if fresh else 1
     rng = np.random.RandomState(0)
-    srcv = rng.randint(0, V, (batch, S)).astype(np.int64)
-    sentv = rng.randint(0, 2, (batch, S)).astype(np.int64)
-    maskv = np.ones((batch, S), np.float32)
+    srcv = rng.randint(0, V, (n_b, batch, S)).astype(np.int32)
+    sentv = rng.randint(0, 2, (n_b, batch, S)).astype(np.int32)
+    maskv = np.ones((n_b, batch, S), np.float32)
     # flattened positions into [N*S]
     mposv = (
-        np.arange(batch)[:, None] * S
-        + rng.randint(0, S, (batch, masks))
-    ).reshape(-1, 1).astype(np.int64)
-    mlabv = rng.randint(0, V, (batch * masks, 1)).astype(np.int64)
-    nlabv = rng.randint(0, 2, (batch, 1)).astype(np.int64)
+        np.arange(batch)[None, :, None] * S
+        + rng.randint(0, S, (n_b, batch, masks))
+    ).reshape(n_b, -1, 1).astype(np.int32)
+    mlabv = rng.randint(0, V, (n_b, batch * masks, 1)).astype(np.int32)
+    nlabv = rng.randint(0, 2, (n_b, batch, 1)).astype(np.int32)
 
     scope = fluid.Scope()
     exe = fluid.Executor(place)
     dev = jax.devices()[0]
     with fluid.scope_guard(scope):
         exe.run(startup)
-        feed = {
-            "src": jax.device_put(srcv.astype(np.int32), dev),
-            "sent": jax.device_put(sentv.astype(np.int32), dev),
-            "mask": jax.device_put(maskv, dev),
-            "mpos": jax.device_put(mposv.astype(np.int32), dev),
-            "mlab": jax.device_put(mlabv.astype(np.int32), dev),
-            "nlab": jax.device_put(nlabv.astype(np.int32), dev),
+        stacked = {
+            "src": srcv, "sent": sentv, "mask": maskv,
+            "mpos": mposv, "mlab": mlabv, "nlab": nlabv,
         }
+        feed, feed1, run_kw = bench_common.stage_feeds(
+            stacked, fresh, chunk, dev)
         # warmup: 2 single-step runs settle the state avals, then one
         # chunked (steps=CHUNK fori_loop) call compiles the timed module
         for _ in range(2):
-            (l,) = exe.run(prog, feed=feed, fetch_list=[total], return_numpy=False)
+            (l,) = exe.run(prog, feed=feed1, fetch_list=[total], return_numpy=False)
             np.asarray(l)
-        (l,) = exe.run(prog, feed=feed, fetch_list=[total],
-                       return_numpy=False, steps=chunk)
+        (l,) = exe.run(prog, feed=feed, fetch_list=[total], **run_kw)
         np.asarray(l)
         done = 0
         t0 = time.perf_counter()
         while done < steps:
-            (l,) = exe.run(prog, feed=feed, fetch_list=[total],
-                           return_numpy=False, steps=chunk)
+            (l,) = exe.run(prog, feed=feed, fetch_list=[total], **run_kw)
             done += chunk
             lv = np.asarray(l)
         dt = time.perf_counter() - t0
@@ -142,6 +146,8 @@ def run(batch=BATCH, seq=SEQ, steps=STEPS, chunk=CHUNK):
         "seq_len": S,
         "n_params": n_params,
         "n_embed_params": n_embed,
+        "per_step_feed": fresh,
+        "chunk": chunk,
         "platform": platform,
         "loss": float(lv),
     }

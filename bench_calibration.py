@@ -147,8 +147,14 @@ def loss_fn(params, stats, images, labels, layout="NCHW"):
     return jax.numpy.mean(nll), new_stats
 
 
-def make_train_step(lr=0.1, momentum=0.9, n_steps=1, layout="NCHW"):
-    """One jitted call = ``n_steps`` momentum-SGD steps (fori_loop)."""
+def make_train_step(lr=0.1, momentum=0.9, n_steps=1, layout="NCHW",
+                    fresh=False):
+    """One jitted call = ``n_steps`` momentum-SGD steps (fori_loop).
+
+    ``fresh=True``: images/labels carry a leading ``n_steps`` axis and
+    each iteration consumes its own slice — the same fresh-batch regime
+    as the framework path's ``per_step_feed`` (bench.py), so the
+    overhead comparison stays apples-to-apples."""
     import functools as _ft
 
     import jax
@@ -165,20 +171,30 @@ def make_train_step(lr=0.1, momentum=0.9, n_steps=1, layout="NCHW"):
 
     @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def train_step(params, vel, stats, images, labels):
-        carry = one((params, vel, stats, np.float32(0)), images, labels)
+        def batch(i):
+            if not fresh:
+                return images, labels
+            return (
+                jax.lax.dynamic_index_in_dim(images, i, 0, keepdims=False),
+                jax.lax.dynamic_index_in_dim(labels, i, 0, keepdims=False),
+            )
+
+        carry = one((params, vel, stats, np.float32(0)), *batch(0))
         if n_steps > 1:
             carry = jax.lax.fori_loop(
-                0, n_steps - 1, lambda i, c: one(c, images, labels), carry
+                1, n_steps, lambda i, c: one(c, *batch(i)), carry
             )
         return carry
 
     return train_step
 
 
-def measure(batch=256, steps=20, chunk=10, seed=0, layout="NCHW"):
+def measure(batch=256, steps=20, chunk=10, seed=0, layout="NCHW",
+            fresh=False):
     """Returns (step_time_ms, final_loss) for the pure-JAX yardstick,
     timed exactly like bench.py's framework path: ``chunk`` steps per
-    jitted call, a d2h sync per chunk."""
+    jitted call, a d2h sync per chunk; ``fresh=True`` feeds ``chunk``
+    distinct batches per call (matching per_step_feed)."""
     import jax
 
     dev = jax.devices()[0]
@@ -189,13 +205,19 @@ def measure(batch=256, steps=20, chunk=10, seed=0, layout="NCHW"):
     vel = jax.device_put(vel, dev)
     rng = np.random.RandomState(0)
     shape = (batch, 224, 224, 3) if layout == "NHWC" else (batch, 3, 224, 224)
-    images = jax.device_put(rng.uniform(-1, 1, shape).astype(np.float32), dev)
-    labels = jax.device_put(rng.randint(0, 1000, (batch, 1)).astype(np.int32), dev)
+    fresh = bool(fresh) and chunk > 1
+    n_b = chunk if fresh else 1
+    imgs = rng.uniform(-1, 1, (n_b,) + shape).astype(np.float32)
+    lbls = rng.randint(0, 1000, (n_b, batch, 1)).astype(np.int32)
+    images = jax.device_put(imgs if fresh else imgs[0], dev)
+    labels = jax.device_put(lbls if fresh else lbls[0], dev)
+    images1 = jax.device_put(imgs[0], dev)
+    labels1 = jax.device_put(lbls[0], dev)
 
     step1 = make_train_step(n_steps=1, layout=layout)
-    stepN = make_train_step(n_steps=chunk, layout=layout)
+    stepN = make_train_step(n_steps=chunk, layout=layout, fresh=fresh)
     for _ in range(2):  # warmup/compile the single-step path
-        params, vel, stats, loss = step1(params, vel, stats, images, labels)
+        params, vel, stats, loss = step1(params, vel, stats, images1, labels1)
     np.asarray(loss)
     params, vel, stats, loss = stepN(params, vel, stats, images, labels)
     np.asarray(loss)  # compile + warm the chunked path

@@ -1,0 +1,40 @@
+"""Shared bench plumbing: the stacked fresh-batch feed regime.
+
+Every model bench runs CHUNK optimizer steps per jitted call
+(``Executor.run(steps=CHUNK)``), and by default feeds CHUNK *distinct*
+batches per call via ``per_step_feed`` (VERDICT r4 weakness #3: a
+same-batch chunk is a different HBM/infeed regime than a real input
+pipeline).  ``BENCH_FRESH=0`` restores the same-batch regime for A/B
+comparison.  This helper owns the env parse, leading-axis sizing, and
+device staging so the four benches can't drift.
+"""
+import os
+
+__all__ = ["fresh_enabled", "stage_feeds"]
+
+
+def fresh_enabled(default="1"):
+    return os.environ.get("BENCH_FRESH", default) == "1"
+
+
+def stage_feeds(stacked, fresh, chunk, device):
+    """``stacked``: dict name -> np array of shape (chunk,) + batch_shape
+    (callers may build it with n_b = chunk if fresh else 1 to avoid
+    allocating unused host batches).
+
+    Returns (feed, feed1, run_kw):
+      * feed  — device-staged chunked feed (stacked when fresh, else
+        the single batch), for ``exe.run(**run_kw)``
+      * feed1 — device-staged single batch, for single-step warmup
+      * run_kw — dict(return_numpy=False, steps=chunk,
+        per_step_feed=fresh)
+    """
+    import jax
+
+    feed = {
+        k: jax.device_put(v if fresh else v[0], device)
+        for k, v in stacked.items()
+    }
+    feed1 = {k: jax.device_put(v[0], device) for k, v in stacked.items()}
+    run_kw = dict(return_numpy=False, steps=chunk, per_step_feed=fresh)
+    return feed, feed1, run_kw

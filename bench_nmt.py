@@ -69,43 +69,45 @@ def run(batch=BATCH, src_len=SRC_LEN, tgt_len=TGT_LEN, steps=STEPS, chunk=CHUNK)
         else:
             n_dec += n
 
+    # chunk distinct batches per jitted call (per_step_feed; VERDICT r4
+    # weak #3); BENCH_FRESH=0 restores the same-batch regime
+    import bench_common
+
+    fresh = bench_common.fresh_enabled()
+    n_b = chunk if fresh else 1
     rng = np.random.RandomState(0)
-    srcv = rng.randint(0, V, (batch, src_len)).astype(np.int64)
-    tgtv = rng.randint(0, V, (batch, tgt_len)).astype(np.int64)
-    lblv = rng.randint(0, V, (batch, tgt_len, 1)).astype(np.int64)
+    srcv = rng.randint(0, V, (n_b, batch, src_len)).astype(np.int32)
+    tgtv = rng.randint(0, V, (n_b, batch, tgt_len)).astype(np.int32)
+    lblv = rng.randint(0, V, (n_b, batch, tgt_len, 1)).astype(np.int32)
     # variable lengths: uniform in [src_len//2, src_len]
-    src_lens = rng.randint(src_len // 2, src_len + 1, (batch,))
-    smaskv = (np.arange(src_len)[None, :] < src_lens[:, None]).astype(np.float32)
+    src_lens = rng.randint(src_len // 2, src_len + 1, (n_b, batch))
+    smaskv = (np.arange(src_len)[None, None, :]
+              < src_lens[:, :, None]).astype(np.float32)
 
     scope = fluid.Scope()
     exe = fluid.Executor(place)
     dev = jax.devices()[0]
     with fluid.scope_guard(scope):
         exe.run(startup)
-        feed = {
-            "src": jax.device_put(srcv.astype(np.int32), dev),
-            "tgt": jax.device_put(tgtv.astype(np.int32), dev),
-            "lbl": jax.device_put(lblv.astype(np.int32), dev),
-            "smask": jax.device_put(smaskv, dev),
-        }
+        stacked = {"src": srcv, "tgt": tgtv, "lbl": lblv, "smask": smaskv}
+        feed, feed1, run_kw = bench_common.stage_feeds(
+            stacked, fresh, chunk, dev)
         for _ in range(2):
-            (l,) = exe.run(prog, feed=feed, fetch_list=[avg_loss], return_numpy=False)
+            (l,) = exe.run(prog, feed=feed1, fetch_list=[avg_loss], return_numpy=False)
             np.asarray(l)
-        (l,) = exe.run(prog, feed=feed, fetch_list=[avg_loss],
-                       return_numpy=False, steps=chunk)
+        (l,) = exe.run(prog, feed=feed, fetch_list=[avg_loss], **run_kw)
         np.asarray(l)
         done = 0
         t0 = time.perf_counter()
         while done < steps:
-            (l,) = exe.run(prog, feed=feed, fetch_list=[avg_loss],
-                           return_numpy=False, steps=chunk)
+            (l,) = exe.run(prog, feed=feed, fetch_list=[avg_loss], **run_kw)
             done += chunk
             lv = np.asarray(l)
         dt = time.perf_counter() - t0
 
     step_time = dt / done
     src_tok, tgt_tok = batch * src_len, batch * tgt_len
-    real_tokens = int(src_lens.sum()) + tgt_tok
+    real_tokens = int(src_lens.sum() / n_b) + tgt_tok  # per-step mean
     flops = (
         6.0 * (n_enc + n_cross_kv) * src_tok
         + 6.0 * (n_dec + n_head_p) * tgt_tok
@@ -123,6 +125,8 @@ def run(batch=BATCH, src_len=SRC_LEN, tgt_len=TGT_LEN, steps=STEPS, chunk=CHUNK)
         "batch": batch,
         "src_len": src_len,
         "tgt_len": tgt_len,
+        "per_step_feed": fresh,
+        "chunk": chunk,
         "platform": platform,
         "loss": float(lv),
     }

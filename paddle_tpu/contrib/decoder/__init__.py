@@ -1,35 +1,567 @@
-"""Beam-search decoder API surface (reference: contrib/decoder/
-beam_search_decoder.py — InitState/StateCell/TrainingDecoder/
-BeamSearchDecoder built on the reference's While-op machinery).
+"""Decoder API (reference: contrib/decoder/beam_search_decoder.py —
+InitState/StateCell/TrainingDecoder/BeamSearchDecoder built on the
+reference's While-op + LoDTensorArray machinery; usage sample:
+python/paddle/fluid/tests/test_beam_search_decoder.py).
 
-The TPU-native decode path is ``paddle_tpu.decoding.beam_search`` — the
-whole search compiled as one lax.scan (tests/test_seq2seq_decode.py);
-these classes raise with that pointer instead of half-implementing the
-While-op state-cell protocol."""
+TPU-native design: the same four model-facing classes, but
+``TrainingDecoder`` lowers onto the compiled ``layers.DynamicRNN`` (one
+lax.scan over the padded time axis) and ``BeamSearchDecoder.decode``
+builds the static-lane While-loop search — fixed ``[B*beam]`` lanes,
+per-step ``layers.beam_search`` selection with ``parent_idx`` state
+gather (replacing the reference's LoD ``sequence_expand``), and
+``layers.beam_search_decode`` backtracking the arrays into dense
+``[B, beam, T]`` results.  The whole loop compiles into the program like
+any other op; for the one-call functional form see
+``paddle_tpu.decoding.beam_search``.
+"""
 from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from paddle_tpu import layers
 
 __all__ = ["InitState", "StateCell", "TrainingDecoder", "BeamSearchDecoder"]
 
-_MSG = ("the While-op decoder protocol is replaced by the compiled "
-        "whole-search paddle_tpu.decoding.beam_search / greedy_search "
-        "(see tests/test_seq2seq_decode.py)")
+
+class InitState(object):
+    """Initial hidden state (reference: beam_search_decoder.py:43).
+
+    Either wraps an existing ``init`` variable or creates a constant
+    ``[batch, *shape]`` tensor batch-sized like ``init_boot``.  On the
+    static-lane encoding ``need_reorder`` is recorded but moot — beam
+    reordering is the explicit ``parent_idx`` gather in the decode loop,
+    correct for any batch size.
+    """
+
+    def __init__(self, init=None, shape=None, value=0.0, init_boot=None,
+                 need_reorder=False, dtype="float32"):
+        if init is not None:
+            self._init = init
+        elif init_boot is None:
+            raise ValueError(
+                "init_boot must be provided to infer the shape of InitState"
+            )
+        else:
+            tail = [int(s) for s in (shape or init_boot.shape[1:])]
+            self._init = layers.fill_constant_batch_size_like(
+                input=init_boot, shape=[-1] + tail, dtype=dtype,
+                value=float(value),
+            )
+        self._shape = shape
+        self._value = value
+        self._need_reorder = need_reorder
+        self._dtype = dtype
+
+    @property
+    def value(self):
+        return self._init
+
+    @property
+    def need_reorder(self):
+        return self._need_reorder
 
 
-class InitState:
-    def __init__(self, *a, **k):
-        raise NotImplementedError("InitState: " + _MSG)
+class _MemoryState(object):
+    """State backed by a DynamicRNN memory (reference:
+    beam_search_decoder.py:100)."""
+
+    def __init__(self, state_name, rnn_obj, init_state):
+        self._state_name = state_name
+        self._rnn_obj = rnn_obj
+        self._state_mem = rnn_obj.memory(
+            init=init_state.value, need_reorder=init_state.need_reorder
+        )
+
+    def get_state(self):
+        return self._state_mem
+
+    def update_state(self, state):
+        self._rnn_obj.update_memory(self._state_mem, state)
 
 
-class StateCell:
-    def __init__(self, *a, **k):
-        raise NotImplementedError("StateCell: " + _MSG)
+class _LaneState(object):
+    """State on the beam-search static lanes (replaces the reference's
+    _ArrayState, beam_search_decoder.py:114): the decoder holds the
+    current ``[B*beam, ...]`` value; committing stages the new value for
+    the decoder to gather by ``parent_idx`` and array_write at the end
+    of the step."""
+
+    def __init__(self, state_name, decoder, init_state):
+        self._state_name = state_name
+        self._decoder = decoder
+        self._cur = decoder._register_state(state_name, init_state)
+
+    def get_state(self):
+        return self._cur
+
+    def update_state(self, state):
+        self._decoder._stage_state(self._state_name, state)
 
 
-class TrainingDecoder:
-    def __init__(self, *a, **k):
-        raise NotImplementedError("TrainingDecoder: " + _MSG)
+class StateCell(object):
+    """Hidden-state container + update rule for a decoder step
+    (reference: beam_search_decoder.py:159).
+
+    ``inputs``: dict name -> Variable (or None for step-provided inputs
+    like the current word embedding); ``states``: dict name ->
+    ``InitState``; ``out_state``: the state name whose value feeds the
+    scoring layer.  Register the per-step recurrence with the
+    ``@state_cell.state_updater`` decorator; inside it use
+    ``get_input`` / ``get_state`` / ``set_state``.
+    """
+
+    def __init__(self, inputs, states, out_state, name=None):
+        self._inputs = dict(inputs)
+        self._init_states = dict(states)
+        self._state_names = list(states)
+        self._out_state_name = out_state
+        if out_state not in self._init_states:
+            raise ValueError("out_state %r is not a declared state" % out_state)
+        self._updater = None
+        self._cur_states = {}
+        self._states_holder = {}   # state name -> {id(decoder): backing}
+        self._cur_decoder_obj = None
+        self._switched_decoder = False
+
+    # -- decoder attach protocol (reference: _enter_decoder/_leave_decoder)
+    def _enter_decoder(self, decoder_obj):
+        if self._cur_decoder_obj is not None:
+            raise ValueError("StateCell is already inside a decoder block")
+        self._cur_decoder_obj = decoder_obj
+        self._switched_decoder = False
+        self._cur_states = {}
+
+    def _leave_decoder(self, decoder_obj):
+        if self._cur_decoder_obj is not decoder_obj:
+            raise ValueError("leaving a decoder the StateCell never entered")
+        self._cur_decoder_obj = None
+        self._switched_decoder = False
+
+    def _switch_decoder(self):
+        """Lazily bind each declared state to the current decoder's
+        backing (rnn memory / beam lanes) on first use inside the block."""
+        if self._cur_decoder_obj is None:
+            raise ValueError("StateCell must be used inside a decoder block")
+        if self._switched_decoder:
+            return
+        dec = self._cur_decoder_obj
+        for name in self._state_names:
+            holder = self._states_holder.setdefault(name, {})
+            if id(dec) not in holder:
+                if isinstance(dec, TrainingDecoder):
+                    holder[id(dec)] = _MemoryState(
+                        name, dec._rnn, self._init_states[name]
+                    )
+                elif isinstance(dec, BeamSearchDecoder):
+                    holder[id(dec)] = _LaneState(
+                        name, dec, self._init_states[name]
+                    )
+                else:
+                    raise ValueError("unknown decoder type %r" % type(dec))
+            self._cur_states[name] = holder[id(dec)].get_state()
+        self._switched_decoder = True
+
+    # -- user surface
+    def state_updater(self, updater):
+        self._updater = updater
+        return updater
+
+    def get_input(self, input_name):
+        if input_name not in self._inputs:
+            raise ValueError("input %r not found in the StateCell" % input_name)
+        val = self._inputs[input_name]
+        if val is None:
+            raise ValueError(
+                "input %r has no bound value — pass it via "
+                "compute_state(inputs={...})" % input_name
+            )
+        return val
+
+    def get_state(self, state_name):
+        if state_name not in self._init_states:
+            raise ValueError("state %r not declared" % state_name)
+        self._switch_decoder()
+        return self._cur_states[state_name]
+
+    def set_state(self, state_name, state_value):
+        if state_name not in self._init_states:
+            raise ValueError("state %r not declared" % state_name)
+        self._cur_states[state_name] = state_value
+
+    def compute_state(self, inputs):
+        """Bind this step's inputs and run the registered updater."""
+        if self._updater is None:
+            raise ValueError(
+                "no state updater registered — decorate one with "
+                "@state_cell.state_updater"
+            )
+        self._switch_decoder()
+        for name, value in inputs.items():
+            if name not in self._inputs:
+                raise ValueError("unknown input %r in compute_state" % name)
+            self._inputs[name] = value
+        self._updater(self)
+
+    def update_states(self):
+        """Commit the staged states back to the decoder's backing."""
+        self._switch_decoder()
+        dec = self._cur_decoder_obj
+        for name in self._state_names:
+            self._states_holder[name][id(dec)].update_state(
+                self._cur_states[name]
+            )
+
+    def out_state(self):
+        return self._cur_states[self._out_state_name]
 
 
-class BeamSearchDecoder:
-    def __init__(self, *a, **k):
-        raise NotImplementedError("BeamSearchDecoder: " + _MSG)
+class TrainingDecoder(object):
+    """Teacher-forced decoder for training (reference:
+    beam_search_decoder.py:384), lowered onto ``layers.DynamicRNN`` —
+    the whole recurrence is one compiled lax.scan.
+
+    ::
+
+        decoder = TrainingDecoder(state_cell)
+        with decoder.block():
+            word = decoder.step_input(trg_embedding)
+            decoder.state_cell.compute_state(inputs={'x': word})
+            score = layers.fc(decoder.state_cell.get_state('h'),
+                              size=V, act='softmax')
+            decoder.state_cell.update_states()
+            decoder.output(score)
+        outputs = decoder()   # [B, T, V]
+
+    ``seq_len`` (TPU-native extension): [B] int lengths of the padded
+    target sequences; the reference reads them from the LoD.  When
+    omitted, every row is assumed full length (dense padded batch).
+    """
+
+    BEFORE_DECODER = 0
+    IN_DECODER = 1
+    AFTER_DECODER = 2
+
+    def __init__(self, state_cell, name=None, seq_len=None):
+        self._name = name
+        self._state_cell = state_cell
+        self._status = TrainingDecoder.BEFORE_DECODER
+        self._rnn = layers.DynamicRNN(name=name)
+        self._seq_len = seq_len
+
+    @contextlib.contextmanager
+    def block(self):
+        if self._status != TrainingDecoder.BEFORE_DECODER:
+            raise ValueError("decoder.block() can only be entered once")
+        self._status = TrainingDecoder.IN_DECODER
+        self._state_cell._enter_decoder(self)
+        with self._rnn.block():
+            yield
+        self._status = TrainingDecoder.AFTER_DECODER
+        self._state_cell._leave_decoder(self)
+
+    @property
+    def state_cell(self):
+        self._assert_in_decoder_block("state_cell")
+        return self._state_cell
+
+    @property
+    def dynamic_rnn(self):
+        return self._rnn
+
+    def step_input(self, x):
+        """Mark a [B, T, ...] sequence as a per-step input; returns the
+        [B, ...] step slice."""
+        self._assert_in_decoder_block("step_input")
+        seq_len = self._seq_len
+        if seq_len is None and self._rnn._seq_len is None:
+            T = x.shape[1] if len(x.shape or ()) > 1 else None
+            if T is None or int(T) < 0:
+                raise ValueError(
+                    "step_input needs seq_len= on the TrainingDecoder for "
+                    "dynamic-length input %r" % x.name
+                )
+            # the lengths vector is read by the dynamic_rnn op in the
+            # PARENT block, so build it there (we're inside the sub-block)
+            from paddle_tpu import unique_name
+
+            parent = self._rnn.sub_block.parent_block
+            seq_len = parent.create_var(
+                name=unique_name.generate("training_decoder_seq_len"),
+                shape=[-1], dtype="int32",
+            )
+            parent.append_op(
+                type="fill_constant_batch_size_like",
+                inputs={"Input": [x]},
+                outputs={"Out": [seq_len]},
+                attrs={"shape": [-1], "value": float(int(T)),
+                       "dtype": "int32", "input_dim_idx": 0,
+                       "output_dim_idx": 0},
+            )
+        return self._rnn.step_input(x, seq_len=seq_len)
+
+    def static_input(self, x):
+        """Whole-sequence input visible unchanged at every step."""
+        self._assert_in_decoder_block("static_input")
+        return self._rnn.static_input(x)
+
+    def output(self, *outputs):
+        self._assert_in_decoder_block("output")
+        self._rnn.output(*outputs)
+
+    def __call__(self, *args, **kwargs):
+        if self._status != TrainingDecoder.AFTER_DECODER:
+            raise ValueError("decoder() called before its block completed")
+        return self._rnn(*args, **kwargs)
+
+    def _assert_in_decoder_block(self, method):
+        if self._status != TrainingDecoder.IN_DECODER:
+            raise ValueError(
+                "%s should be invoked inside decoder.block()" % method
+            )
+
+
+class BeamSearchDecoder(object):
+    """Beam-search decoder for inference (reference:
+    beam_search_decoder.py:523).
+
+    Static-lane TPU design: every source row keeps ``beam_size`` fixed
+    lanes (``[B*beam]`` rows end to end) instead of the reference's
+    shrinking LoD beams.  ``decode()`` builds a ``layers.While`` loop —
+    per step: embed previous ids, run the StateCell, score with an
+    fc+softmax to ``target_dict_dim``, select with ``layers.beam_search``
+    (finished lanes persist via ``end_id`` masking), gather every state
+    by ``parent_idx``, and array_write ids/scores/parents.  Calling the
+    decoder returns ``(translation_ids [B, beam, T+1], translation_scores
+    [B, beam])`` best-first via ``layers.beam_search_decode``.
+
+    Feed contract (static lanes; see ``seed_init_feeds``): ``init_ids``
+    is ``[B*beam, 1]`` int64 start tokens and ``init_scores`` is
+    ``[B*beam, 1]`` float32 with lane 0 of each source at 0.0 and the
+    other lanes at -1e9 (step 1 then expands from one live lane per
+    source, matching the reference's single-seed LoD feed).
+
+    TPU-native extensions: ``emb_param_attr`` / ``score_param_attr`` /
+    ``score_bias_attr`` name the decode-side embedding / scoring weights
+    so they can share trained parameters with the training program
+    explicitly (the reference relies on unique-name counters lining up
+    across programs).
+    """
+
+    BEFORE_DECODER = 0
+    IN_DECODER = 1
+    AFTER_DECODER = 2
+
+    def __init__(self, state_cell, init_ids, init_scores, target_dict_dim,
+                 word_dim, input_var_dict=None, topk_size=50,
+                 sparse_emb=True, max_len=100, beam_size=1, end_id=1,
+                 name=None, emb_param_attr=None, score_param_attr=None,
+                 score_bias_attr=None, batch_size=None):
+        self._name = name
+        self._state_cell = state_cell
+        self._init_ids = init_ids
+        self._init_scores = init_scores
+        self._target_dict_dim = int(target_dict_dim)
+        self._word_dim = int(word_dim)
+        self._input_var_dict = dict(input_var_dict or {})
+        self._topk_size = int(topk_size)
+        self._sparse_emb = sparse_emb
+        self._max_len = int(max_len)
+        self._beam_size = int(beam_size)
+        self._end_id = int(end_id)
+        self._emb_param_attr = emb_param_attr
+        self._score_param_attr = score_param_attr
+        self._score_bias_attr = score_bias_attr
+        self._batch_size = batch_size
+        self._status = BeamSearchDecoder.BEFORE_DECODER
+        # populated while building the loop
+        self._cur_states = {}      # state name -> in-loop current var
+        self._staged_states = {}   # state name -> staged new var
+        self._ids_array = None
+        self._scores_array = None
+        self._parents_array = None
+        self._translation_ids = None
+        self._translation_scores = None
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def seed_init_feeds(batch_size, beam_size, start_id):
+        """Numpy feed values for (init_ids, init_scores) on the static
+        lanes: every lane starts at ``start_id``; lane 0 of each source
+        scores 0, the rest -1e9."""
+        bk = batch_size * beam_size
+        ids = np.full((bk, 1), start_id, dtype="int64")
+        scores = np.where(
+            np.arange(bk) % beam_size == 0, 0.0, -1e9
+        ).astype("float32").reshape(bk, 1)
+        return ids, scores
+
+    # -- _LaneState protocol -------------------------------------------
+    def _register_state(self, name, init_state):
+        if self._status != BeamSearchDecoder.IN_DECODER:
+            raise ValueError("states bind inside decode()")
+        return self._cur_states[name]
+
+    def _stage_state(self, name, value):
+        self._staged_states[name] = value
+
+    # ------------------------------------------------------------------
+    def _tile_to_lanes(self, v, nlanes):
+        """[B, D...] -> [B*beam, D...] (each source row repeated beam
+        times — the static analog of the reference's sequence_expand
+        over the init LoD)."""
+        K = self._beam_size
+        shp = [int(s) for s in v.shape[1:]]
+        expanded = layers.expand(
+            layers.reshape(v, shape=[-1, 1] + shp), [1, K] + [1] * len(shp)
+        )
+        return layers.reshape(expanded, shape=[nlanes] + shp)
+
+    def _nlanes(self):
+        """Static lane count B*beam — XLA arrays need it at build time
+        (the reference's LoD arrays are host-dynamic instead)."""
+        if self._batch_size is not None:
+            return int(self._batch_size) * self._beam_size
+        ids_b = (self._init_ids.shape or [-1])[0]
+        if ids_b is not None and int(ids_b) > 0:
+            return int(ids_b)
+        raise ValueError(
+            "BeamSearchDecoder needs a static lane count: pass "
+            "batch_size= (TPU-native extension; the compiled search "
+            "needs static shapes) or give init_ids a static batch dim"
+        )
+
+    def decode(self):
+        """Build the beam-search loop (reference:
+        beam_search_decoder.py:653).  Override for a custom decoder."""
+        if self._status != BeamSearchDecoder.BEFORE_DECODER:
+            raise ValueError("decode() can only be called once")
+        self._status = BeamSearchDecoder.IN_DECODER
+        cell = self._state_cell
+        cell._enter_decoder(self)
+        K = self._beam_size
+        ML = self._max_len
+
+        init_states = {n: cell._init_states[n] for n in cell._state_names}
+        counter = layers.zeros(shape=[1], dtype="int64")
+        array_len = layers.fill_constant([1], "int64", ML)
+        nlanes = self._nlanes()
+        state0 = {
+            n: self._tile_to_lanes(s.value, nlanes)
+            for n, s in init_states.items()
+        }
+        ids0 = layers.reshape(self._init_ids, shape=[nlanes, 1])
+        scores0 = layers.reshape(self._init_scores, shape=[nlanes, 1])
+        lane_inputs = {
+            name: self._tile_to_lanes(var, nlanes)
+            for name, var in self._input_var_dict.items()
+        }
+        for name in lane_inputs:
+            if name not in cell._inputs:
+                raise ValueError("Variable %s not found in StateCell" % name)
+
+        arrays = {}
+        for n, v in state0.items():
+            arr = layers.create_array(
+                ML + 1, [int(s) for s in v.shape], dtype=v.dtype
+            )
+            arrays[n] = layers.array_write(v, counter, arr)
+        ids_arr = layers.array_write(
+            ids0, counter, layers.create_array(ML + 1, [nlanes, 1], "int64")
+        )
+        score_arr = layers.array_write(
+            scores0, counter,
+            layers.create_array(ML + 1, [nlanes, 1], "float32"),
+        )
+        parent_arr = layers.create_array(ML + 1, [nlanes], "int32")
+
+        cond = layers.less_than(counter, array_len)
+        loop = layers.While(cond, max_trip_count=ML)
+        with loop.block():
+            # reshape pins static element shapes on the array reads
+            # (shape inference inside a While sub-block is deferred)
+            prev_ids = layers.reshape(
+                layers.array_read(ids_arr, counter), shape=[nlanes, 1]
+            )
+            prev_scores = layers.reshape(
+                layers.array_read(score_arr, counter), shape=[nlanes, 1]
+            )
+            self._cur_states = {
+                n: layers.reshape(
+                    layers.array_read(arrays[n], counter),
+                    shape=[int(s) for s in state0[n].shape],
+                )
+                for n in init_states
+            }
+            self._staged_states = {}
+            prev_ids_embedding = layers.reshape(
+                layers.embedding(
+                    prev_ids,
+                    size=[self._target_dict_dim, self._word_dim],
+                    dtype="float32",
+                    is_sparse=self._sparse_emb,
+                    param_attr=self._emb_param_attr,
+                ),
+                shape=[nlanes, self._word_dim],
+            )
+
+            feed_dict = dict(lane_inputs)
+            for input_name in cell._inputs:
+                if input_name not in feed_dict:
+                    feed_dict[input_name] = prev_ids_embedding
+
+            cell.compute_state(inputs=feed_dict)
+            current_state = cell.out_state()
+            scores = layers.fc(
+                current_state,
+                size=self._target_dict_dim,
+                act="softmax",
+                param_attr=self._score_param_attr,
+                bias_attr=self._score_bias_attr,
+            )
+            topk_scores, topk_indices = layers.topk(
+                scores, k=min(self._topk_size, self._target_dict_dim)
+            )
+            accu_scores = layers.elementwise_add(
+                layers.log(topk_scores), layers.reshape(prev_scores, [-1, 1])
+            )
+            sel_ids, sel_scores, parent = layers.beam_search(
+                prev_ids, prev_scores, topk_indices, accu_scores,
+                K, end_id=self._end_id, return_parent_idx=True,
+            )
+
+            cell.update_states()
+            layers.increment(counter, value=1, in_place=True)
+            # beam reorder = explicit parent gather (the reference's
+            # sequence_expand over LoD), then persist for the next step
+            for n in init_states:
+                new_state = self._staged_states.get(n, self._cur_states[n])
+                layers.array_write(
+                    layers.gather(new_state, parent), counter, arrays[n]
+                )
+            layers.array_write(sel_ids, counter, ids_arr)
+            layers.array_write(sel_scores, counter, score_arr)
+            layers.array_write(parent, counter, parent_arr)
+            layers.less_than(counter, array_len, cond=cond)
+
+        self._ids_array = ids_arr
+        self._scores_array = score_arr
+        self._parents_array = parent_arr
+        self._translation_ids, self._translation_scores = (
+            layers.beam_search_decode(
+                ids_arr, score_arr, beam_size=K, end_id=self._end_id,
+                parents=parent_arr,
+            )
+        )
+        self._status = BeamSearchDecoder.AFTER_DECODER
+        cell._leave_decoder(self)
+
+    def __call__(self):
+        if self._status != BeamSearchDecoder.AFTER_DECODER:
+            raise ValueError("decoder() must follow decode()")
+        return self._translation_ids, self._translation_scores
+
+    @property
+    def state_cell(self):
+        return self._state_cell

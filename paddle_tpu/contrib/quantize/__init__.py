@@ -24,10 +24,16 @@ class QuantizeTranspiler:
         )
 
     def freeze_program(self, program, place=None, scope=None):
-        # QAT fake-quant nodes simulate int8 at train time; freezing to a
-        # real int8 engine is an inference-engine concern out of scope
-        # here (document rather than silently no-op)
-        raise NotImplementedError(
-            "freeze_program: the QAT rewrite keeps fake-quant semantics; "
-            "int8 engine export is not part of this build"
+        """Fold trained fake-quant scales into real int8 weights
+        (reference: quantize_transpiler.py freeze_program →
+        slim QuantizationFreezePass, quantization_pass.py:541)."""
+        from paddle_tpu.contrib.slim.quantization import (
+            QuantizationFreezePass,
         )
+        from paddle_tpu.scope import global_scope
+
+        scope = scope or global_scope()
+        QuantizationFreezePass(
+            scope, place, weight_bits=self.weight_bits
+        ).apply(program)
+        return program

@@ -17,7 +17,8 @@ from paddle_tpu import framework, unique_name
 from paddle_tpu.core.registry import register_op
 from paddle_tpu.ops.common import one
 
-__all__ = ["QuantizationTransformPass", "quantize_program"]
+__all__ = ["QuantizationTransformPass", "QuantizationFreezePass",
+           "quantize_program", "freeze_program"]
 
 
 @register_op("fake_quantize_dequantize_abs_max")
@@ -39,6 +40,116 @@ def fake_quantize_dequantize_abs_max(inputs, attrs):
     # straight-through: out = x + stop_grad(quantized - x)
     out = x + jax.lax.stop_gradient(out - x)
     return {"Out": out, "OutScale": scale.reshape(1)}
+
+
+@register_op("dequantize_abs_max", differentiable=False)
+def dequantize_abs_max(inputs, attrs):
+    """reference: operators/fake_dequantize_op.cc fake_dequantize_max_abs
+    — Out = Scale * X / max_range.  In a frozen program X is a real int8
+    weight parameter; the product reproduces the QAT fake-quant values
+    bit-for-bit (same scale, same rounding), so frozen inference matches
+    the fake-quant program exactly."""
+    import jax.numpy as jnp
+
+    x = one(inputs, "X")
+    scale = one(inputs, "Scale")
+    max_range = float(attrs.get("max_range", 127.0))
+    return {"Out": x.astype(jnp.float32) * (scale.reshape(()) / max_range)}
+
+
+class QuantizationFreezePass:
+    """reference: slim/quantization/quantization_pass.py:541
+    QuantizationFreezePass — fold trained fake-quant scales into REAL
+    int8 weight tensors for inference.
+
+    For every ``fake_quantize_dequantize_abs_max`` op whose input is a
+    Parameter: quantize the trained fp32 weight to an int8 persistable
+    (``<w>.int8``, 4x smaller on disk and in HBM), store its scale
+    (``<w>.dequant_scale``), and replace the fake op with
+    ``dequantize_abs_max`` feeding the consumer — XLA folds the dequant
+    multiply into the consuming matmul/conv.  Activation fake-quant ops
+    are kept as dynamic abs-max quant-dequant (this build's QAT computes
+    activation scales in-graph rather than persisting a moving average,
+    so freezing them would change semantics; the kept op IS the trained
+    behavior).  Frozen output therefore matches the fake-quant program
+    exactly, and the program stays AnalysisPredictor-loadable.
+    """
+
+    def __init__(self, scope, place=None, weight_bits: int = 8):
+        self._scope = scope
+        self._place = place
+        self._weight_bits = weight_bits
+
+    def apply(self, program) -> None:
+        import numpy as np
+
+        block = program.global_block()
+        frozen = 0
+        for i, op in enumerate(list(block.ops)):
+            if op.type != "fake_quantize_dequantize_abs_max":
+                continue
+            xname = op.inputs["X"][0]
+            var = block._find_var_recursive(xname)
+            if not isinstance(var, framework.Parameter):
+                continue  # activation quant stays dynamic (see docstring)
+            # the bits the op actually trained with (stamped by
+            # QuantizationTransformPass) — NOT this pass's default, or
+            # non-8-bit QAT would silently re-quantize at the wrong
+            # width and break the exact-parity contract
+            bits = int(op.attrs.get("bit_length", self._weight_bits))
+            if bits > 8:
+                raise ValueError(
+                    "freeze: weight %r trained with bit_length=%d; int8 "
+                    "storage holds at most 8 bits" % (xname, bits)
+                )
+            qmax = float(2 ** (bits - 1) - 1)
+            wv = self._scope.get(xname)
+            if wv is None:
+                raise RuntimeError(
+                    "freeze: weight %r is not initialized in the scope — "
+                    "train (or run startup) before freezing" % xname
+                )
+            w = np.asarray(wv)
+            scale = max(float(np.max(np.abs(w))), 1e-8)
+            wq = np.clip(np.round(w / scale * qmax), -qmax, qmax).astype(
+                np.int8
+            )
+            qname = xname + ".int8"
+            sname = xname + ".dequant_scale"
+            block.create_var(
+                name=qname, shape=list(w.shape), dtype="int8",
+                persistable=True, stop_gradient=True,
+            )
+            block.create_var(
+                name=sname, shape=[1], dtype="float32",
+                persistable=True, stop_gradient=True,
+            )
+            self._scope.set(qname, wq)
+            self._scope.set(sname, np.asarray([scale], np.float32))
+            out_name = op.outputs["Out"][0]
+            idx = block.ops.index(op)
+            block._remove_op(idx)
+            block._insert_op(
+                idx,
+                type="dequantize_abs_max",
+                inputs={"X": [qname], "Scale": [sname]},
+                outputs={"Out": [out_name]},
+                attrs={"max_range": qmax,
+                       "op_role": op.attrs.get("op_role", "forward")},
+            )
+            frozen += 1
+        if frozen == 0:
+            raise ValueError(
+                "freeze: no weight fake-quant ops found — apply "
+                "QuantizationTransformPass (QAT) before freezing"
+            )
+        program.version += 1
+
+
+def freeze_program(program, scope, place=None, weight_bits=8):
+    """Convenience wrapper: freeze a QAT program in place and return it."""
+    QuantizationFreezePass(scope, place, weight_bits).apply(program)
+    return program
 
 
 class QuantizationTransformPass:

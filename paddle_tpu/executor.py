@@ -84,13 +84,21 @@ class Executor:
         return_numpy: bool = True,
         use_program_cache: bool = True,
         steps: int = 1,
+        per_step_feed: bool = False,
     ):
         """``steps`` (TPU-native extension): run N optimizer steps inside ONE
-        jitted call (a ``lax.fori_loop`` over the compiled step, same feed
-        each iteration), returning the last step's fetches.  Amortizes the
-        per-dispatch host->device overhead — the analog of the reference's
-        multi-iteration DeviceWorker loop (device_worker.h TrainFiles runs
-        many batches per Run call)."""
+        jitted call (a ``lax.fori_loop`` over the compiled step), returning
+        the last step's fetches.  Amortizes the per-dispatch host->device
+        overhead — the analog of the reference's multi-iteration DeviceWorker
+        loop (device_worker.h TrainFiles runs many batches per Run call).
+
+        By default every iteration re-consumes the same feed (a pure
+        compute benchmark regime).  With ``per_step_feed=True`` each feed
+        value carries an extra leading ``steps`` axis (shape
+        ``(steps,) + per_batch_shape``) and iteration ``i`` consumes slice
+        ``i`` via ``lax.dynamic_index_in_dim`` — N *distinct* batches per
+        jitted call, the compiled analog of the reference's buffered reader
+        feeding the train loop (operators/reader/buffered_reader.cc)."""
         import jax
 
         compiled = None
@@ -173,6 +181,17 @@ class Executor:
                 "incompatible with distributed lookup tables (the PS "
                 "pull/push is host-side per batch)" % steps
             )
+        if per_step_feed:
+            bad = {
+                n: np.shape(v)
+                for n, v in feed.items()
+                if np.shape(v)[:1] != (steps,)
+            }
+            if bad:
+                raise ValueError(
+                    "per_step_feed=True: every feed needs a leading "
+                    "steps=%d axis; got %s" % (steps, bad)
+                )
 
         feed_names = tuple(sorted(feed.keys()))
         state_mut = tuple(sorted((read & written & persistable)))
@@ -224,6 +243,7 @@ class Executor:
             getattr(self.place, "backend", None),
             id(compiled) if compiled is not None else None,
             steps,
+            per_step_feed,
         )
 
         entry = self._cache.get(key) if use_program_cache else None
@@ -234,6 +254,8 @@ class Executor:
                 def stepfn(mut_state, ro_state, feed_dict):
                     state = dict(mut_state)
                     state.update(ro_state)
+                    if per_step_feed:
+                        feed_dict = {n: v[0] for n, v in feed_dict.items()}
                     return fn(state, feed_dict)
             else:
                 def stepfn(mut_state, ro_state, feed_dict):
@@ -241,19 +263,29 @@ class Executor:
                     # not-carried state, so no array appears twice in the
                     # loop carry (a duplicated param forces a copy per
                     # iteration)
-                    def one(mut):
+                    def step_feed(i):
+                        if not per_step_feed:
+                            return feed_dict
+                        return {
+                            n: jax.lax.dynamic_index_in_dim(
+                                v, i, axis=0, keepdims=False
+                            )
+                            for n, v in feed_dict.items()
+                        }
+
+                    def one(i, mut):
                         state = dict(mut)
                         state.update(ro_state)
-                        fetches, new_state = fn(state, feed_dict)
+                        fetches, new_state = fn(state, step_feed(i))
                         nxt = {n: new_state.get(n, mut[n]) for n in mut}
                         extras = {
                             n: v for n, v in new_state.items() if n not in mut
                         }
                         return nxt, fetches, extras
 
-                    carry = one(mut_state)
+                    carry = one(0, mut_state)
                     mut, fetches, extras = jax.lax.fori_loop(
-                        0, steps - 1, lambda i, c: one(c[0]), carry
+                        1, steps, lambda i, c: one(i, c[0]), carry
                     )
                     return fetches, {**mut, **extras}
 
@@ -261,7 +293,8 @@ class Executor:
             if compiled is not None:
                 jit_kwargs.update(
                     compiled._jit_kwargs(
-                        block, feed_names, fetch_names, state_mut, state_ro, state_out
+                        block, feed_names, fetch_names, state_mut, state_ro,
+                        state_out, per_step_feed=per_step_feed,
                     )
                 )
             entry = jax.jit(stepfn, **jit_kwargs)
@@ -272,7 +305,7 @@ class Executor:
         ro_state = {n: scope.get(n) for n in state_ro}
         if compiled is not None:
             feed_arrays, mut_state, ro_state = compiled._shard_inputs(
-                feed_arrays, mut_state, ro_state
+                feed_arrays, mut_state, ro_state, per_step_feed=per_step_feed
             )
         fetches, new_state = entry(mut_state, ro_state, feed_arrays)
         for n, v in new_state.items():

@@ -548,16 +548,21 @@ def create_array(size, shape, dtype="float32", name=None):
 
 
 def array_write(x, i, array):
-    """reference: layers/control_flow.py array_write."""
+    """reference: layers/control_flow.py array_write.
+
+    Writes OVER the array var (Out == Array), matching the reference's
+    in-place LoDTensorArray mutation — critical inside a While sub-block,
+    where only vars the sub-block *writes* become loop-carried state
+    (``_analyze_sub_block``); an SSA fresh-var output would silently drop
+    every write on the next iteration."""
     helper = LayerHelper("array_write")
-    out = helper.create_variable_for_type_inference(array.dtype)
     helper.append_op(
         type="write_to_array",
         inputs={"Array": [array], "I": [i], "X": [x]},
-        outputs={"Out": [out]},
+        outputs={"Out": [array]},
         attrs={},
     )
-    return out
+    return array
 
 
 def array_read(array, i):

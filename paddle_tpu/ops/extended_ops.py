@@ -639,8 +639,17 @@ def hash_op(inputs, attrs):
     x64-disabled).  Exactness holds for ids in int32 range — the
     x64-disabled feed path has already truncated wider int64 ids before
     any kernel sees them, so ids >= 2^31 hash the wrapped value (a global
-    framework constraint, not special to this op).  Out shape =
-    X.shape[:-1] + (num_hash, 1), matching HashOutputSize."""
+    framework constraint, not special to this op).
+
+    Dtype-width assumption (ADVICE r4): rows are ALWAYS serialized as
+    8-byte little-endian int64 lanes, i.e. this is the reference's
+    ``HashKernel<int64_t>``.  The reference also registers
+    ``HashKernel<int>`` which hashes 4 bytes per element and yields
+    different digests for int32-declared vars; that variant is not
+    reproduced — the kernel only sees the post-feed int32 values, not
+    the declared var width, so an int32-declared input gets
+    int64-width digests here.  Out shape = X.shape[:-1] + (num_hash, 1),
+    matching HashOutputSize."""
     jnp = _jnp()
     x = one(inputs, "X")
     num_hash = int(attrs.get("num_hash", 1))

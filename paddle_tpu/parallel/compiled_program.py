@@ -106,7 +106,10 @@ class CompiledProgram:
     # ------------------------------------------------------------------
     # Executor integration
     # ------------------------------------------------------------------
-    def _jit_kwargs(self, block, feed_names, fetch_names, state_mut, state_ro, state_out):
+    def _jit_kwargs(self, block, feed_names, fetch_names, state_mut, state_ro,
+                    state_out, per_step_feed=False):
+        from jax.sharding import PartitionSpec as P
+
         mut_sh = {n: self._sharding(self._spec_for_state(n)) for n in state_mut}
         ro_sh = {n: self._sharding(self._spec_for_state(n)) for n in state_ro}
 
@@ -114,11 +117,18 @@ class CompiledProgram:
         for n in feed_names:
             var = block._find_var_recursive(n)
             ndim = len(var.shape) if var is not None and var.shape is not None else 1
-            feed_sh[n] = self._sharding(self._spec_for_feed(n, ndim))
+            spec = self._spec_for_feed(n, ndim)
+            if per_step_feed:
+                # Executor.run(steps=N, per_step_feed=True) stacks a
+                # leading steps axis on every feed; keep it replicated and
+                # shift the batch/seq sharding one axis right
+                spec = P(None, *spec)
+            feed_sh[n] = self._sharding(spec)
         return {"in_shardings": (mut_sh, ro_sh, feed_sh)}
 
-    def _shard_inputs(self, feed_arrays, mut_state, ro_state):
+    def _shard_inputs(self, feed_arrays, mut_state, ro_state, per_step_feed=False):
         import jax
+        from jax.sharding import PartitionSpec as P
 
         def put(arrs, spec_fn):
             out = {}
@@ -127,7 +137,12 @@ class CompiledProgram:
                 out[n] = jax.device_put(a, sh)
             return out
 
-        feed_arrays = put(feed_arrays, lambda n, d: self._spec_for_feed(n, d))
+        def feed_spec(n, d):
+            if per_step_feed:
+                return P(None, *self._spec_for_feed(n, d - 1))
+            return self._spec_for_feed(n, d)
+
+        feed_arrays = put(feed_arrays, feed_spec)
         mut_state = put(mut_state, lambda n, d: self._spec_for_state(n))
         ro_state = put(ro_state, lambda n, d: self._spec_for_state(n))
         return feed_arrays, mut_state, ro_state

@@ -244,8 +244,14 @@ def _optimizer_plan(optimizer):
             "hybrid engine: optimizer must have a float learning rate "
             "(LR schedules run program-side)"
         )
-    t = type(optimizer).__name__
-    if "Adam" in t and "Adamax" not in t:
+    # exact-class whitelist (ADVICE r4): a wrapper/subclass like
+    # DGCMomentumOptimizer or LarsMomentumOptimizer carries extra update
+    # semantics a substring match would silently drop — those must raise
+    # and route through the Program path instead
+    from paddle_tpu import optimizer as opt_mod
+
+    cls = type(optimizer)
+    if cls is opt_mod.AdamOptimizer:
         return (
             "adam",
             {"beta1": optimizer._beta1, "beta2": optimizer._beta2,
@@ -254,18 +260,20 @@ def _optimizer_plan(optimizer):
             {"Beta1Pow": optimizer._beta1, "Beta2Pow": optimizer._beta2},
             float(lr), decay,
         )
-    if "Momentum" in t:
+    if cls is opt_mod.MomentumOptimizer:
         return (
             "momentum",
             {"mu": optimizer._momentum,
              "use_nesterov": optimizer._use_nesterov},
             ["Velocity"], {}, float(lr), decay,
         )
-    if "SGD" in t:
+    if cls is opt_mod.SGDOptimizer:
         return ("sgd", {}, [], {}, float(lr), decay)
     raise ValueError(
-        "hybrid engine supports SGD/Momentum/Adam optimizers (got %s); "
-        "route other optimizers through the Program path" % t
+        "hybrid engine supports exactly SGDOptimizer/MomentumOptimizer/"
+        "AdamOptimizer (got %s — subclasses and wrappers carry extra "
+        "update semantics); route other optimizers through the Program "
+        "path" % cls.__name__
     )
 
 

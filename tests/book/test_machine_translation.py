@@ -200,3 +200,8 @@ def test_machine_translation_beam_decode():
     # lanes are sorted best-first and carry finite log-prob scores
     assert (np.diff(tscores, axis=1) <= 1e-6).all()
     assert np.isfinite(tscores).all() and (tscores <= 0).all()
+    # the loop really ran: accumulated log-probs are strictly negative
+    # and steps past the seed emit real tokens (an all-zero array —
+    # the lost-array-write bug this test once masked — fails here)
+    assert (tscores < -1e-3).all(), tscores
+    assert (tids[:, :, 1:] != 0).any(), tids

@@ -81,6 +81,135 @@ def test_qat_rewrite_trains():
     assert losses[-1] < losses[0], losses
 
 
+def test_qat_freeze_int8_matches_fake_quant(tmp_path):
+    """QuantizationFreezePass (reference: quantization_pass.py:541):
+    train with QAT, freeze weights to REAL int8 params + dequantize ops,
+    and (a) the frozen program's output matches the fake-quant program
+    exactly, (b) the frozen program round-trips through
+    save_inference_model -> AnalysisPredictor with matching output."""
+    from paddle_tpu.contrib.slim.quantization import (
+        QuantizationTransformPass, freeze_program,
+    )
+
+    prog, startup, loss, pred = _mlp_program(seed=31)
+    with framework.program_guard(prog, startup):
+        QuantizationTransformPass().apply(prog)
+        fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+
+    rng = np.random.RandomState(3)
+    feed = {
+        "x": rng.uniform(-1, 1, (32, 16)).astype("float32"),
+        "y": rng.randint(0, 4, (32, 1)).astype("int64"),
+    }
+    xb = rng.uniform(-1, 1, (4, 16)).astype("float32")
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(6):
+            exe.run(prog, feed=feed, fetch_list=[loss])
+
+        test_prog = prog.clone(for_test=True)
+        (want,) = exe.run(
+            test_prog, feed={"x": xb, "y": np.zeros((4, 1), "int64")},
+            fetch_list=[pred])
+
+        frozen = freeze_program(
+            prog.clone(for_test=True), scope, fluid.CPUPlace())
+        types = [op.type for op in frozen.global_block().ops]
+        assert "dequantize_abs_max" in types
+        # every weight fake-quant became an int8 parameter in the scope
+        int8_names = [
+            op.inputs["X"][0] for op in frozen.global_block().ops
+            if op.type == "dequantize_abs_max"
+        ]
+        assert len(int8_names) == 2  # two fc weights
+        for n in int8_names:
+            assert str(np.asarray(scope.get(n)).dtype) == "int8", n
+            v = frozen.global_block()._find_var_recursive(n)
+            assert v.persistable and v.dtype == "int8"
+        (got,) = exe.run(
+            frozen, feed={"x": xb, "y": np.zeros((4, 1), "int64")},
+            fetch_list=[pred])
+        # same scales + same rounding -> bit-identical dequantized weights
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-7)
+
+        fluid.save_inference_model(
+            str(tmp_path / "q"), ["x"], [pred], exe, frozen)
+
+    from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+
+    cfg = AnalysisConfig(str(tmp_path / "q"))
+    cfg.disable_gpu()
+    predictor = create_paddle_predictor(cfg)
+    (got2,) = predictor.run({"x": xb})
+    np.testing.assert_allclose(
+        np.asarray(got2), np.asarray(want), rtol=1e-6, atol=1e-7)
+
+
+def test_qat_freeze_respects_trained_bit_length():
+    """Freeze must re-quantize with the bits each op TRAINED with (the
+    stamped bit_length attr), not the pass default — 4-bit QAT frozen at
+    8 bits silently diverges from the fake-quant program (review r5)."""
+    from paddle_tpu.contrib.slim.quantization import (
+        QuantizationTransformPass, freeze_program,
+    )
+
+    prog, startup, loss, pred = _mlp_program(seed=33)
+    with framework.program_guard(prog, startup):
+        QuantizationTransformPass(weight_bits=4).apply(prog)
+        fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+    rng = np.random.RandomState(5)
+    feed = {
+        "x": rng.uniform(-1, 1, (16, 16)).astype("float32"),
+        "y": rng.randint(0, 4, (16, 1)).astype("int64"),
+    }
+    xb = rng.uniform(-1, 1, (4, 16)).astype("float32")
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(prog, feed=feed, fetch_list=[loss])
+        tp = prog.clone(for_test=True)
+        (want,) = exe.run(
+            tp, feed={"x": xb, "y": np.zeros((4, 1), "int64")},
+            fetch_list=[pred])
+        frozen = freeze_program(prog.clone(for_test=True), scope)
+        (got,) = exe.run(
+            frozen, feed={"x": xb, "y": np.zeros((4, 1), "int64")},
+            fetch_list=[pred])
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-7)
+    # 4-bit range really used: |q| <= 7
+    for op in frozen.global_block().ops:
+        if op.type == "dequantize_abs_max":
+            q = np.asarray(scope.get(op.inputs["X"][0]))
+            assert np.abs(q).max() <= 7, op.inputs["X"][0]
+            assert op.attrs["max_range"] == 7.0
+
+
+def test_quantize_transpiler_freeze_surface():
+    """contrib.quantize.QuantizeTranspiler.freeze_program reaches the
+    slim freeze pass (reference: quantize_transpiler.py)."""
+    from paddle_tpu.contrib.quantize import QuantizeTranspiler
+
+    prog, startup, loss, pred = _mlp_program(seed=32)
+    qt = QuantizeTranspiler()
+    with framework.program_guard(prog, startup):
+        qt.training_transpile(prog)
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        frozen = qt.freeze_program(
+            prog.clone(for_test=True), scope=scope)
+    assert any(op.type == "dequantize_abs_max"
+               for op in frozen.global_block().ops)
+
+
 def test_analysis_predictor_roundtrip(tmp_path):
     prog, startup, loss, pred = _mlp_program(seed=23)
     scope = fluid.Scope()

@@ -278,6 +278,65 @@ def test_executor_multi_step_parity():
         )
 
 
+def test_executor_per_step_feed_parity():
+    """run(steps=N, per_step_feed=True) feeds N *distinct* batches inside
+    one jitted fori_loop (stacked leading axis + dynamic_index_in_dim) and
+    must match N single-step run() calls on those same batches — the
+    compiled analog of the reference's buffered reader
+    (operators/reader/buffered_reader.cc)."""
+    import pytest
+
+    import paddle_tpu as fluid
+    from paddle_tpu import framework
+
+    def build():
+        prog, startup = framework.Program(), framework.Program()
+        prog.random_seed = startup.random_seed = 7
+        with framework.program_guard(prog, startup):
+            x = fluid.layers.data("x", [4])
+            y = fluid.layers.data("y", [1])
+            h = fluid.layers.fc(x, size=8, act="relu")
+            p = fluid.layers.fc(h, size=1)
+            loss = fluid.layers.mean(fluid.layers.square(p - y))
+            fluid.optimizer.AdamOptimizer(0.05).minimize(loss)
+        return prog, startup, loss
+
+    rng = np.random.RandomState(1)
+    xs = rng.randn(5, 16, 4).astype(np.float32)
+    ys = rng.randn(5, 16, 1).astype(np.float32)
+    prog, startup, loss = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    scope_a = fluid.Scope()
+    with fluid.scope_guard(scope_a):
+        exe.run(startup)
+        for i in range(5):
+            (la,) = exe.run(prog, feed={"x": xs[i], "y": ys[i]},
+                            fetch_list=[loss])
+    params_a = {
+        p.name: np.asarray(scope_a.get(p.name)) for p in prog.all_parameters()
+    }
+
+    scope_b = fluid.Scope()
+    with fluid.scope_guard(scope_b):
+        exe.run(startup)
+        (lb,) = exe.run(prog, feed={"x": xs, "y": ys}, fetch_list=[loss],
+                        steps=5, per_step_feed=True)
+    np.testing.assert_allclose(la, lb, rtol=1e-5, atol=1e-6)
+    for n, want in params_a.items():
+        np.testing.assert_allclose(
+            np.asarray(scope_b.get(n)), want, rtol=1e-5, atol=1e-6, err_msg=n
+        )
+
+    # a feed whose leading axis isn't `steps` is a loud error, not a
+    # silent broadcast
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with pytest.raises(ValueError, match="leading"):
+            exe.run(prog, feed={"x": xs[0], "y": ys[0]}, fetch_list=[loss],
+                    steps=5, per_step_feed=True)
+
+
 def test_prune_late_writer_guard():
     """An op that writes a pruned param after its mask op raises instead
     of silently resurrecting pruned weights (ADVICE r2)."""
@@ -468,8 +527,13 @@ def test_contrib_tail_surface():
         == [0, 2, 4, 6, 8, 10]
     assert sorted(R.multiprocess_reader([rdr, rdr])()) == sorted(list(rdr()) * 2)
 
-    # honest raises
-    with pytest.raises(NotImplementedError):
-        fluid.contrib.decoder.BeamSearchDecoder()
-    with pytest.raises(NotImplementedError):
-        fluid.contrib.quantize.QuantizeTranspiler().freeze_program(prog)
+    # implemented in r5 (full tests: tests/test_contrib_decoder.py,
+    # tests/test_amp_quant_inference.py::test_qat_freeze_*): here just
+    # the import surface + loud argument validation
+    assert callable(fluid.contrib.decoder.BeamSearchDecoder)
+    with pytest.raises(ValueError, match="out_state"):
+        fluid.contrib.decoder.StateCell(inputs={}, states={}, out_state="h")
+    with pytest.raises(ValueError, match="no weight fake-quant"):
+        # freezing a program that was never QAT-rewritten is a loud error
+        fluid.contrib.quantize.QuantizeTranspiler().freeze_program(
+            p2, scope=sc)

@@ -1,0 +1,282 @@
+"""contrib/decoder tests (reference usage sample:
+python/paddle/fluid/tests/test_beam_search_decoder.py — a simple MT
+model trained through TrainingDecoder and decoded through
+BeamSearchDecoder).
+
+Correctness bar beyond the reference test (which only smoke-runs):
+* TrainingDecoder == hand-built DynamicRNN, identical loss trajectory
+  on shared param names.
+* BeamSearchDecoder at beam 1 == a host-side greedy loop stepping a
+  single-step program over the same trained weights (exact id parity).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import framework
+from paddle_tpu.contrib.decoder import (
+    BeamSearchDecoder, InitState, StateCell, TrainingDecoder,
+)
+
+V = 20          # target dict size
+D = 8           # word embedding dim
+H = 12          # decoder hidden
+B = 3
+T_TGT = 5
+MAX_LEN = 6
+START_ID = 0
+END_ID = 1
+
+
+def _named(n):
+    return fluid.ParamAttr(name=n)
+
+
+def _make_state_cell(ctx):
+    h = InitState(init=ctx, need_reorder=True)
+    cell = StateCell(inputs={"x": None}, states={"h": h}, out_state="h")
+
+    @cell.state_updater
+    def updater(state_cell):
+        cur_word = state_cell.get_input("x")
+        prev_h = state_cell.get_state("h")
+        new_h = fluid.layers.fc(
+            [prev_h, cur_word], size=H, act="tanh",
+            param_attr=[_named("cell_h_w"), _named("cell_x_w")],
+            bias_attr=_named("cell_b"),
+        )
+        state_cell.set_state("h", new_h)
+
+    return cell
+
+
+def _train_program(use_contrib):
+    """Next-word model: ctx feature + teacher-forced target decode."""
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 91
+    with framework.program_guard(prog, startup):
+        ctx = fluid.layers.data("ctx", [H])
+        trg = fluid.layers.data("trg", [T_TGT], dtype="int64")
+        nxt = fluid.layers.data("nxt", [T_TGT, 1], dtype="int64")
+        trg_emb = fluid.layers.embedding(
+            trg, size=[V, D], param_attr=_named("dec_emb"))
+
+        if use_contrib:
+            cell = _make_state_cell(ctx)
+            decoder = TrainingDecoder(cell)
+            with decoder.block():
+                word = decoder.step_input(trg_emb)
+                decoder.state_cell.compute_state(inputs={"x": word})
+                score = fluid.layers.fc(
+                    decoder.state_cell.get_state("h"), size=V, act="softmax",
+                    param_attr=_named("score_w"), bias_attr=_named("score_b"))
+                decoder.state_cell.update_states()
+                decoder.output(score)
+            probs = decoder()
+        else:
+            trg_len = fluid.layers.fill_constant_batch_size_like(
+                trg_emb, shape=[-1], dtype="int32", value=T_TGT)
+            rnn = fluid.layers.DynamicRNN()
+            with rnn.block():
+                word = rnn.step_input(trg_emb, seq_len=trg_len)
+                prev_h = rnn.memory(init=ctx)
+                new_h = fluid.layers.fc(
+                    [prev_h, word], size=H, act="tanh",
+                    param_attr=[_named("cell_h_w"), _named("cell_x_w")],
+                    bias_attr=_named("cell_b"))
+                score = fluid.layers.fc(
+                    new_h, size=V, act="softmax",
+                    param_attr=_named("score_w"), bias_attr=_named("score_b"))
+                rnn.update_memory(prev_h, new_h)
+                rnn.output(score)
+            probs = rnn()
+
+        cost = fluid.layers.cross_entropy(
+            fluid.layers.reshape(probs, shape=[-1, V]),
+            fluid.layers.reshape(nxt, shape=[-1, 1]))
+        avg = fluid.layers.mean(cost)
+        fluid.optimizer.AdagradOptimizer(learning_rate=0.5).minimize(avg)
+    return prog, startup, avg
+
+
+def _feeds():
+    rng = np.random.RandomState(4)
+    ctxv = rng.uniform(-1, 1, (B, H)).astype("float32")
+    trgv = np.empty((B, T_TGT), "int64")
+    trgv[:, 0] = START_ID
+    for t in range(1, T_TGT):
+        trgv[:, t] = (trgv[:, t - 1] * 3 + 2) % V
+    nxtv = ((trgv * 3 + 2) % V)[:, :, None].astype("int64")
+    return ctxv, trgv, nxtv
+
+
+def _train(prog, startup, avg, scope, steps=25):
+    ctxv, trgv, nxtv = _feeds()
+    exe = fluid.Executor(fluid.CPUPlace())
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            (l,) = exe.run(
+                prog, feed={"ctx": ctxv, "trg": trgv, "nxt": nxtv},
+                fetch_list=[avg])
+            losses.append(float(np.asarray(l)))
+    return losses
+
+
+def test_training_decoder_matches_dynamic_rnn():
+    """The contrib TrainingDecoder lowers to the same compiled recurrence
+    as a hand-built DynamicRNN: identical loss trajectory on shared
+    param names + seeds."""
+    losses = {}
+    for contrib in (False, True):
+        prog, startup, avg = _train_program(contrib)
+        losses[contrib] = _train(prog, startup, avg, fluid.Scope(), steps=12)
+    np.testing.assert_allclose(losses[True], losses[False],
+                               rtol=1e-6, atol=1e-7)
+    assert losses[True][-1] < losses[True][0]
+
+
+def _decode_program(beam_size, topk_size=V):
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 92
+    with framework.program_guard(prog, startup):
+        ctx = fluid.layers.data("ctx", [H])
+        init_ids = fluid.layers.data("init_ids", [1], dtype="int64")
+        init_scores = fluid.layers.data("init_scores", [1])
+        cell = _make_state_cell(ctx)
+        decoder = BeamSearchDecoder(
+            state_cell=cell,
+            init_ids=init_ids,
+            init_scores=init_scores,
+            target_dict_dim=V,
+            word_dim=D,
+            input_var_dict={},
+            topk_size=topk_size,
+            sparse_emb=True,
+            max_len=MAX_LEN,
+            beam_size=beam_size,
+            end_id=END_ID,
+            emb_param_attr=_named("dec_emb"),
+            score_param_attr=_named("score_w"),
+            score_bias_attr=_named("score_b"),
+            batch_size=B,
+        )
+        decoder.decode()
+        trans_ids, trans_scores = decoder()
+    return prog, startup, trans_ids, trans_scores
+
+
+def _step_program():
+    """Single decode step over the same named weights, for the host-side
+    greedy yardstick."""
+    prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(prog, startup):
+        prev_id = fluid.layers.data("prev_id", [1], dtype="int64")
+        prev_h = fluid.layers.data("prev_h", [H])
+        emb = fluid.layers.reshape(
+            fluid.layers.embedding(
+                prev_id, size=[V, D], param_attr=_named("dec_emb")),
+            shape=[-1, D])
+        new_h = fluid.layers.fc(
+            [prev_h, emb], size=H, act="tanh",
+            param_attr=[_named("cell_h_w"), _named("cell_x_w")],
+            bias_attr=_named("cell_b"))
+        probs = fluid.layers.fc(
+            new_h, size=V, act="softmax",
+            param_attr=_named("score_w"), bias_attr=_named("score_b"))
+    return prog, new_h, probs
+
+
+def test_beam_search_decoder_decodes_trained_model():
+    """Train through the contrib API, then decode in the SAME scope via
+    explicitly shared weight names; check the result contract and exact
+    greedy (beam=1) parity with a host-side argmax loop."""
+    scope = fluid.Scope()
+    prog_t, startup_t, avg = _train_program(True)
+    losses = _train(prog_t, startup_t, avg, scope)
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+    ctxv, _, _ = _feeds()
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    # the decode program's scoring fc reuses score_w/score_b from
+    # training; its bias var name comes from the shared bias_attr, so no
+    # decode-side startup run is needed (all persistables are trained)
+    K = 2
+    prog_d, _, trans_ids, trans_scores = _decode_program(K)
+    iid, isc = BeamSearchDecoder.seed_init_feeds(B, K, START_ID)
+    with fluid.scope_guard(scope):
+        tids, tscores = exe.run(
+            prog_d,
+            feed={"ctx": ctxv, "init_ids": iid, "init_scores": isc},
+            fetch_list=[trans_ids, trans_scores])
+    tids, tscores = np.asarray(tids), np.asarray(tscores)
+    assert tids.shape == (B, K, MAX_LEN + 1)
+    assert tscores.shape == (B, K)
+    np.testing.assert_array_equal(tids[:, :, 0], START_ID)
+    assert (tids >= 0).all() and (tids < V).all()
+    assert (np.diff(tscores, axis=1) <= 1e-6).all()   # best-first
+    assert np.isfinite(tscores).all() and (tscores <= 0).all()
+
+    # ---- beam=1 == host-side greedy over the single-step program
+    prog_g, _, g_ids, g_scores = _decode_program(1)
+    iid1, isc1 = BeamSearchDecoder.seed_init_feeds(B, 1, START_ID)
+    with fluid.scope_guard(scope):
+        gids, gscores = exe.run(
+            prog_g,
+            feed={"ctx": ctxv, "init_ids": iid1, "init_scores": isc1},
+            fetch_list=[g_ids, g_scores])
+    gids = np.asarray(gids)[:, 0]          # [B, MAX_LEN+1]
+    gscores = np.asarray(gscores)[:, 0]
+
+    step_prog, h_var, p_var = _step_program()
+    ids = np.full((B, 1), START_ID, "int64")
+    h = ctxv.copy()
+    want = [ids.copy()]
+    score_acc = np.zeros(B)
+    finished = np.zeros(B, bool)
+    with fluid.scope_guard(scope):
+        for _ in range(MAX_LEN):
+            hv, pv = exe.run(
+                step_prog, feed={"prev_id": ids, "prev_h": h},
+                fetch_list=[h_var, p_var])
+            hv, pv = np.asarray(hv), np.asarray(pv)
+            nxt = pv.argmax(axis=1)
+            step_lp = np.log(pv[np.arange(B), nxt])
+            nxt = np.where(finished, END_ID, nxt)
+            score_acc = np.where(finished, score_acc, score_acc + step_lp)
+            finished |= nxt == END_ID
+            ids = nxt[:, None].astype("int64")
+            h = hv
+            want.append(ids.copy())
+    want = np.concatenate(want, axis=1)    # [B, MAX_LEN+1]
+    np.testing.assert_array_equal(gids, want)
+    np.testing.assert_allclose(gscores, score_acc, rtol=1e-4, atol=1e-5)
+
+    # the 2-beam best lane is at least as good as greedy
+    assert (tscores[:, 0] >= gscores - 1e-5).all()
+
+
+def test_state_cell_validation():
+    prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(prog, startup):
+        ctx = fluid.layers.data("ctx", [H])
+        with pytest.raises(ValueError, match="out_state"):
+            StateCell(inputs={}, states={"h": InitState(init=ctx)},
+                      out_state="missing")
+        cell = _make_state_cell(ctx)
+        with pytest.raises(ValueError, match="decoder block"):
+            cell.get_state("h")
+        with pytest.raises(ValueError, match="not declared"):
+            cell.set_state("zz", ctx)
+
+
+def test_init_state_from_boot():
+    prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(prog, startup):
+        boot = fluid.layers.data("boot", [7])
+        st = InitState(init_boot=boot, shape=[H], value=0.5)
+        assert [int(s) for s in st.value.shape[1:]] == [H]
+        with pytest.raises(ValueError, match="init_boot"):
+            InitState(shape=[H])

@@ -581,6 +581,13 @@ def test_dygraph_data_parallel_two_processes(tmp_path):
         import os, sys, json
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         sys.path.insert(0, os.environ["PADDLE_TPU_REPO"])
+        # the axon sitecustomize force-sets jax_platforms via jax.config
+        # at interpreter start, BEATING the env var above — and a downed
+        # tunnel then hangs backend init forever (same trap as
+        # conftest.py / __graft_entry__.py); re-pin via the config
+        # channel before anything touches a backend
+        import jax
+        jax.config.update("jax_platforms", "cpu")
         import numpy as np
         import paddle_tpu as fluid
         from paddle_tpu.dygraph import parallel as dp
