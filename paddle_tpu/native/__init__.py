@@ -231,3 +231,141 @@ def _parse_multislot_py(text: bytes, n_slots: int):
         (np.asarray(values[s], np.float32), np.asarray(counts[s], np.int32))
         for s in range(n_slots)
     ]
+
+
+# ---------------------------------------------------------------------------
+# Native (C++) inference predictor — the Python-free deployment path
+# (reference: inference/api/api_impl.h NativePaddlePredictor + the
+# train/demo pure-C++ story).  predictor.cc parses __model__ JSON + .npy
+# weights itself; this wrapper only builds/loads the .so and marshals
+# buffers, so the same library is usable from any C program.
+# ---------------------------------------------------------------------------
+_pred_lib = None
+_pred_tried = False
+
+
+def _predictor_lib():
+    global _pred_lib, _pred_tried
+    if _pred_tried:
+        return _pred_lib
+    _pred_tried = True
+    src = os.path.join(os.path.dirname(__file__), "predictor.cc")
+    cache = os.environ.get(
+        "PADDLE_TPU_CACHE", os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu")
+    )
+    os.makedirs(cache, exist_ok=True)
+    so_path = os.path.join(cache, "libpaddle_tpu_predictor.so")
+    if not os.path.exists(so_path) or os.path.getmtime(so_path) < os.path.getmtime(src):
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src, "-o", so_path],
+                check=True,
+                capture_output=True,
+            )
+        except (OSError, subprocess.CalledProcessError) as e:
+            sys.stderr.write(
+                "paddle_tpu.native: predictor build failed (%s)\n" % e
+            )
+            return None
+    try:
+        lib = ctypes.CDLL(so_path)
+    except OSError:
+        return None
+    lib.ptp_predictor_create.restype = ctypes.c_void_p
+    lib.ptp_predictor_create.argtypes = [ctypes.c_char_p]
+    lib.ptp_predictor_error.restype = ctypes.c_char_p
+    lib.ptp_predictor_error.argtypes = [ctypes.c_void_p]
+    lib.ptp_predictor_set_input.restype = ctypes.c_int
+    lib.ptp_predictor_set_input.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+    ]
+    lib.ptp_predictor_set_input_i64.restype = ctypes.c_int
+    lib.ptp_predictor_set_input_i64.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+    ]
+    lib.ptp_predictor_run.restype = ctypes.c_int
+    lib.ptp_predictor_run.argtypes = [ctypes.c_void_p]
+    lib.ptp_predictor_num_outputs.restype = ctypes.c_int
+    lib.ptp_predictor_num_outputs.argtypes = [ctypes.c_void_p]
+    lib.ptp_predictor_get_output.restype = ctypes.c_int64
+    lib.ptp_predictor_get_output.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int),
+        ctypes.c_int,
+    ]
+    lib.ptp_predictor_destroy.restype = None
+    lib.ptp_predictor_destroy.argtypes = [ctypes.c_void_p]
+    _pred_lib = lib
+    return lib
+
+
+class NativePredictor:
+    """C++ inference over a saved inference model (no jax, no Python op
+    kernels).  Covers the host inference op subset — see predictor.cc;
+    unsupported ops raise with the supported list.  For full-op or TPU
+    inference use ``paddle_tpu.inference.AnalysisPredictor``."""
+
+    def __init__(self, model_dir: str):
+        lib = _predictor_lib()
+        if lib is None:
+            raise RuntimeError(
+                "native predictor unavailable (g++ build failed)"
+            )
+        self._lib = lib
+        self._h = lib.ptp_predictor_create(str(model_dir).encode())
+        err = lib.ptp_predictor_error(self._h)
+        if err:
+            msg = err.decode()
+            lib.ptp_predictor_destroy(self._h)
+            self._h = None
+            raise RuntimeError("native predictor load: " + msg)
+
+    def run(self, feeds: dict):
+        lib = self._lib
+        for name, arr in feeds.items():
+            arr = np.ascontiguousarray(arr)
+            shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+            if np.issubdtype(arr.dtype, np.integer):
+                a64 = np.ascontiguousarray(arr, dtype=np.int64)
+                lib.ptp_predictor_set_input_i64(
+                    self._h, name.encode(),
+                    a64.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    shape, arr.ndim,
+                )
+            else:
+                a32 = np.ascontiguousarray(arr, dtype=np.float32)
+                lib.ptp_predictor_set_input(
+                    self._h, name.encode(),
+                    a32.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    shape, arr.ndim,
+                )
+        if lib.ptp_predictor_run(self._h) != 0:
+            raise RuntimeError(
+                "native predictor run: "
+                + lib.ptp_predictor_error(self._h).decode()
+            )
+        outs = []
+        for i in range(lib.ptp_predictor_num_outputs(self._h)):
+            shape = (ctypes.c_int64 * 16)()
+            ndim = ctypes.c_int()
+            n = lib.ptp_predictor_get_output(
+                self._h, i, None, shape, ctypes.byref(ndim), 16)
+            if n < 0:
+                raise RuntimeError("native predictor: missing output %d" % i)
+            buf = np.empty(int(n), np.float32)
+            lib.ptp_predictor_get_output(
+                self._h, i,
+                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                shape, ctypes.byref(ndim), 16,
+            )
+            outs.append(buf.reshape([int(shape[d]) for d in range(ndim.value)]))
+        return outs
+
+    def __del__(self):
+        if getattr(self, "_h", None) is not None:
+            self._lib.ptp_predictor_destroy(self._h)
+
+
+__all__.append("NativePredictor")

@@ -1,0 +1,771 @@
+// Native (C++) inference predictor for the JSON Program format.
+//
+// Reference analog: paddle/fluid/inference/api/api_impl.h (NativePaddle-
+// Predictor — load a saved inference model and run it without Python)
+// and the pure-C++ deployment story of paddle/fluid/train/demo.
+//
+// This executor covers the CPU inference op subset (fc decomposition:
+// mul/elementwise_add/activations, softmax, batch_norm is_test, scale,
+// reshape2, dropout is_test, lookup_table, int8 dequantize_abs_max from
+// the QAT freeze pass).  The TPU compute path stays XLA/JAX — this is
+// the Python-free DEPLOYMENT path for host-side serving, exercised from
+// Python via ctypes (paddle_tpu/native/__init__.py NativePredictor) and
+// buildable as a standalone CLI (-DPTP_MAIN).
+//
+// File formats consumed (written by paddle_tpu.io.save_inference_model):
+//   <dir>/__model__           JSON: {program:{blocks:[{vars,ops}]},
+//                                    feed_names, fetch_names}
+//   <dir>/<var>.npy           NPY v1/v2, '/'->'%2F' escaped names;
+//                             dtypes f4/f8/i1/i4/i8
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace ptp {
+
+// ---------------------------------------------------------------------------
+// Minimal JSON parser (objects/arrays/strings/numbers/bools/null).
+// ---------------------------------------------------------------------------
+struct Json {
+  enum Kind { kNull, kBool, kNum, kStr, kArr, kObj } kind = kNull;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::vector<Json> arr;
+  std::vector<std::pair<std::string, Json>> obj;
+
+  const Json* get(const std::string& key) const {
+    for (auto& kv : obj)
+      if (kv.first == key) return &kv.second;
+    return nullptr;
+  }
+  int64_t as_int() const { return static_cast<int64_t>(num); }
+};
+
+struct JsonParser {
+  const char* p;
+  const char* end;
+  std::string err;
+
+  explicit JsonParser(const std::string& s) : p(s.data()), end(s.data() + s.size()) {}
+
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) ++p;
+  }
+  bool fail(const char* m) {
+    if (err.empty()) err = m;
+    return false;
+  }
+  bool parse(Json* out) {
+    skip_ws();
+    if (p >= end) return fail("eof");
+    switch (*p) {
+      case '{': return parse_obj(out);
+      case '[': return parse_arr(out);
+      case '"': out->kind = Json::kStr; return parse_str(&out->str);
+      case 't':
+        if (end - p >= 4 && !strncmp(p, "true", 4)) {
+          out->kind = Json::kBool; out->b = true; p += 4; return true;
+        }
+        return fail("bad literal");
+      case 'f':
+        if (end - p >= 5 && !strncmp(p, "false", 5)) {
+          out->kind = Json::kBool; out->b = false; p += 5; return true;
+        }
+        return fail("bad literal");
+      case 'n':
+        if (end - p >= 4 && !strncmp(p, "null", 4)) {
+          out->kind = Json::kNull; p += 4; return true;
+        }
+        return fail("bad literal");
+      default: return parse_num(out);
+    }
+  }
+  bool parse_str(std::string* out) {
+    ++p;  // opening quote
+    out->clear();
+    while (p < end && *p != '"') {
+      if (*p == '\\') {
+        ++p;
+        if (p >= end) return fail("bad escape");
+        switch (*p) {
+          case 'n': out->push_back('\n'); break;
+          case 't': out->push_back('\t'); break;
+          case 'r': out->push_back('\r'); break;
+          case 'b': out->push_back('\b'); break;
+          case 'f': out->push_back('\f'); break;
+          case 'u': {
+            if (end - p < 5) return fail("bad \\u");
+            int code = 0;
+            for (int i = 1; i <= 4; ++i) {
+              char c = p[i];
+              code <<= 4;
+              if (c >= '0' && c <= '9') code |= c - '0';
+              else if (c >= 'a' && c <= 'f') code |= c - 'a' + 10;
+              else if (c >= 'A' && c <= 'F') code |= c - 'A' + 10;
+              else return fail("bad \\u digit");
+            }
+            // UTF-8 encode (BMP only; surrogate pairs unsupported)
+            if (code < 0x80) out->push_back(static_cast<char>(code));
+            else if (code < 0x800) {
+              out->push_back(static_cast<char>(0xC0 | (code >> 6)));
+              out->push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            } else {
+              out->push_back(static_cast<char>(0xE0 | (code >> 12)));
+              out->push_back(static_cast<char>(0x80 | ((code >> 6) & 0x3F)));
+              out->push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            }
+            p += 4;
+            break;
+          }
+          default: out->push_back(*p);
+        }
+        ++p;
+      } else {
+        out->push_back(*p++);
+      }
+    }
+    if (p >= end) return fail("unterminated string");
+    ++p;  // closing quote
+    return true;
+  }
+  bool parse_num(Json* out) {
+    char* q = nullptr;
+    out->kind = Json::kNum;
+    out->num = strtod(p, &q);
+    if (q == p) return fail("bad number");
+    p = q;
+    return true;
+  }
+  bool parse_arr(Json* out) {
+    out->kind = Json::kArr;
+    ++p;
+    skip_ws();
+    if (p < end && *p == ']') { ++p; return true; }
+    while (true) {
+      out->arr.emplace_back();
+      if (!parse(&out->arr.back())) return false;
+      skip_ws();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == ']') { ++p; return true; }
+      return fail("bad array");
+    }
+  }
+  bool parse_obj(Json* out) {
+    out->kind = Json::kObj;
+    ++p;
+    skip_ws();
+    if (p < end && *p == '}') { ++p; return true; }
+    while (true) {
+      skip_ws();
+      if (p >= end || *p != '"') return fail("bad key");
+      std::string key;
+      if (!parse_str(&key)) return false;
+      skip_ws();
+      if (p >= end || *p != ':') return fail("missing colon");
+      ++p;
+      out->obj.emplace_back(key, Json());
+      if (!parse(&out->obj.back().second)) return false;
+      skip_ws();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == '}') { ++p; return true; }
+      return fail("bad object");
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Tensors (fp32 compute; int ids kept as double-free fp32 copies is NOT ok
+// for lookup ids, so an int64 side buffer is carried when integral).
+// ---------------------------------------------------------------------------
+struct Tensor {
+  std::vector<int64_t> shape;
+  std::vector<float> f;        // fp32 payload (compute path)
+  std::vector<int64_t> i;      // integral payload (lookup ids)
+  bool is_int = false;
+
+  int64_t numel() const {
+    int64_t n = 1;
+    for (auto s : shape) n *= s;
+    return n;
+  }
+};
+
+// NPY reader (v1/v2, little-endian, C order).
+static bool read_npy(const std::string& path, Tensor* out, std::string* err) {
+  std::ifstream fin(path, std::ios::binary);
+  if (!fin) { *err = "cannot open " + path; return false; }
+  char magic[8];
+  fin.read(magic, 8);
+  if (!fin || strncmp(magic, "\x93NUMPY", 6) != 0) {
+    *err = "bad npy magic in " + path;
+    return false;
+  }
+  uint32_t hlen = 0;
+  if (magic[6] == 1) {
+    uint16_t h16 = 0;
+    fin.read(reinterpret_cast<char*>(&h16), 2);
+    hlen = h16;
+  } else {
+    fin.read(reinterpret_cast<char*>(&hlen), 4);
+  }
+  std::string header(hlen, '\0');
+  fin.read(&header[0], hlen);
+  auto find_val = [&](const char* key) -> std::string {
+    auto k = header.find(key);
+    if (k == std::string::npos) return "";
+    k = header.find(':', k);
+    auto e = header.find_first_of(",}", k);
+    return header.substr(k + 1, e - k - 1);
+  };
+  std::string descr = find_val("'descr'");
+  bool fortran = find_val("'fortran_order'").find("True") != std::string::npos;
+  if (fortran) { *err = "fortran order unsupported: " + path; return false; }
+  // shape is a tuple — "(4, 6)" contains commas, so span the parens
+  // instead of using the comma-terminated find_val
+  std::string shape_s;
+  {
+    auto k = header.find("'shape'");
+    if (k == std::string::npos) { *err = "npy header missing shape: " + path; return false; }
+    auto o = header.find('(', k);
+    auto c = header.find(')', o);
+    if (o == std::string::npos || c == std::string::npos) {
+      *err = "bad npy shape header: " + path;
+      return false;
+    }
+    shape_s = header.substr(o, c - o + 1);
+  }
+  out->shape.clear();
+  for (size_t i = 0; i < shape_s.size();) {
+    if (isdigit(shape_s[i])) {
+      char* q = nullptr;
+      out->shape.push_back(strtol(shape_s.c_str() + i, &q, 10));
+      i = q - shape_s.c_str();
+    } else {
+      ++i;
+    }
+  }
+  int64_t n = 1;
+  for (auto s : out->shape) n *= s;
+  auto load = [&](auto sample, bool integral) {
+    using T = decltype(sample);
+    std::vector<T> buf(n);
+    fin.read(reinterpret_cast<char*>(buf.data()), n * sizeof(T));
+    out->is_int = integral;
+    if (integral) {
+      out->i.resize(n);
+      for (int64_t k = 0; k < n; ++k) out->i[k] = static_cast<int64_t>(buf[k]);
+    } else {
+      out->f.resize(n);
+      for (int64_t k = 0; k < n; ++k) out->f[k] = static_cast<float>(buf[k]);
+    }
+  };
+  if (descr.find("f4") != std::string::npos) load(float{}, false);
+  else if (descr.find("f8") != std::string::npos) load(double{}, false);
+  else if (descr.find("i1") != std::string::npos) load(int8_t{}, false);
+  else if (descr.find("i4") != std::string::npos) load(int32_t{}, true);
+  else if (descr.find("i8") != std::string::npos) load(int64_t{}, true);
+  else { *err = "unsupported npy dtype " + descr + " in " + path; return false; }
+  if (!fin) { *err = "truncated npy " + path; return false; }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Predictor
+// ---------------------------------------------------------------------------
+struct Predictor {
+  Json model;
+  std::map<std::string, Tensor> vars;   // persistables + intermediates
+  std::vector<std::string> feed_names;
+  std::vector<std::string> fetch_names;
+  const Json* ops = nullptr;
+  std::string err;
+
+  static std::string escape_name(const std::string& n) {
+    std::string out;
+    for (char c : n) {
+      if (c == '/') out += "%2F";
+      else out.push_back(c);
+    }
+    return out;
+  }
+
+  bool load(const std::string& dir) {
+    std::ifstream fin(dir + "/__model__");
+    if (!fin) { err = "no __model__ in " + dir; return false; }
+    std::stringstream ss;
+    ss << fin.rdbuf();
+    std::string text = ss.str();
+    JsonParser jp(text);
+    if (!jp.parse(&model)) { err = "model json: " + jp.err; return false; }
+    // every get() is null-checked: a structurally valid but incomplete
+    // __model__ must surface through err, never a null dereference
+    const Json* prog = model.get("program");
+    if (!prog) { err = "no program"; return false; }
+    const Json* blocks = prog->get("blocks");
+    if (!blocks || blocks->arr.empty()) { err = "no blocks"; return false; }
+    const Json* block = &blocks->arr[0];
+    ops = block->get("ops");
+    if (!ops) { err = "no ops"; return false; }
+    const Json* jfeed = model.get("feed_names");
+    const Json* jfetch = model.get("fetch_names");
+    if (!jfeed || !jfetch) { err = "missing feed/fetch names"; return false; }
+    for (auto& v : jfeed->arr) feed_names.push_back(v.str);
+    for (auto& v : jfetch->arr) fetch_names.push_back(v.str);
+    for (auto& op : ops->arr) {
+      if (!op.get("type") || !op.get("inputs") || !op.get("outputs")) {
+        err = "malformed op entry in program";
+        return false;
+      }
+    }
+    // load persistables
+    const Json* jvars = block->get("vars");
+    if (!jvars) { err = "no vars"; return false; }
+    for (auto& v : jvars->arr) {
+      const Json* pers = v.get("persistable");
+      const Json* jname = v.get("name");
+      if (!pers || !pers->b || !jname) continue;
+      const std::string name = jname->str;
+      Tensor t;
+      std::string e;
+      if (!read_npy(dir + "/" + escape_name(name) + ".npy", &t, &e)) {
+        err = e;
+        return false;
+      }
+      vars[name] = std::move(t);
+    }
+    return true;
+  }
+
+  const Tensor& in(const Json& op, const char* slot, int idx = 0) {
+    const Json* names = op.get("inputs")->get(slot);
+    return vars[names->arr[idx].str];
+  }
+  Tensor& out(const Json& op, const char* slot, int idx = 0) {
+    const Json* names = op.get("outputs")->get(slot);
+    return vars[names->arr[idx].str];
+  }
+  static double attr_num(const Json& op, const char* key, double dflt) {
+    const Json* a = op.get("attrs");
+    const Json* v = a ? a->get(key) : nullptr;
+    return v ? (v->kind == Json::kBool ? (v->b ? 1 : 0) : v->num) : dflt;
+  }
+
+  bool run() {
+    // pre-flight: every op input must be a loaded persistable, a set
+    // feed, or an earlier op's output — a typo'd feed name must error
+    // here, not read a default-constructed empty Tensor (UB)
+    std::map<std::string, bool> known;
+    for (auto& kv : vars) known[kv.first] = true;
+    for (auto& op : ops->arr) {
+      const std::string& type = op.get("type")->str;
+      if (type == "feed" || type == "fetch") continue;
+      for (auto& slot : op.get("inputs")->obj)
+        for (auto& n : slot.second.arr)
+          if (!n.str.empty() && !known.count(n.str)) {
+            err = "input var '" + n.str + "' for op '" + type +
+                  "' is not set — missing feed? (feeds: ";
+            for (size_t i = 0; i < feed_names.size(); ++i)
+              err += (i ? ", " : "") + feed_names[i];
+            err += ")";
+            return false;
+          }
+      for (auto& slot : op.get("outputs")->obj)
+        for (auto& n : slot.second.arr)
+          if (!n.str.empty()) known[n.str] = true;
+    }
+    for (auto& op : ops->arr) {
+      const std::string& type = op.get("type")->str;
+      if (type == "feed" || type == "fetch") continue;
+      if (!exec(op, type)) return false;
+    }
+    return true;
+  }
+
+  bool exec(const Json& op, const std::string& type) {
+    if (type == "mul") return op_mul(op);
+    if (type == "elementwise_add") return op_ewise(op, '+');
+    if (type == "elementwise_sub") return op_ewise(op, '-');
+    if (type == "elementwise_mul") return op_ewise(op, '*');
+    if (type == "elementwise_div") return op_ewise(op, '/');
+    if (type == "relu") return op_unary(op, [](float x) { return x > 0 ? x : 0; });
+    if (type == "tanh") return op_unary(op, [](float x) { return std::tanh(x); });
+    if (type == "sigmoid")
+      return op_unary(op, [](float x) { return 1.0f / (1.0f + std::exp(-x)); });
+    if (type == "exp") return op_unary(op, [](float x) { return std::exp(x); });
+    if (type == "sqrt") return op_unary(op, [](float x) { return std::sqrt(x); });
+    if (type == "softmax") return op_softmax(op);
+    if (type == "scale") return op_scale(op);
+    if (type == "reshape2" || type == "reshape") return op_reshape(op);
+    if (type == "dropout") return op_dropout(op);
+    if (type == "batch_norm") return op_batch_norm(op);
+    if (type == "lookup_table" || type == "lookup_table_v2")
+      return op_lookup(op);
+    if (type == "dequantize_abs_max") return op_dequant(op);
+    if (type == "fake_quantize_dequantize_abs_max") return op_fake_quant(op);
+    if (type == "cast") return op_cast(op);
+    err = "native predictor: unsupported op '" + type +
+          "' (supported: mul, elementwise_{add,sub,mul,div}, relu, tanh, "
+          "sigmoid, exp, sqrt, softmax, scale, reshape2, dropout[is_test], "
+          "batch_norm[is_test], lookup_table, dequantize_abs_max, cast; "
+          "use the Python AnalysisPredictor for the full op set)";
+    return false;
+  }
+
+  // mul: collapse x to 2D at x_num_col_dims, y at y_num_col_dims
+  bool op_mul(const Json& op) {
+    const Tensor& x = in(op, "X");
+    const Tensor& y = in(op, "Y");
+    int xd = static_cast<int>(attr_num(op, "x_num_col_dims", 1));
+    int yd = static_cast<int>(attr_num(op, "y_num_col_dims", 1));
+    int64_t m = 1, k = 1, k2 = 1, n = 1;
+    for (int i = 0; i < xd; ++i) m *= x.shape[i];
+    for (size_t i = xd; i < x.shape.size(); ++i) k *= x.shape[i];
+    for (int i = 0; i < yd; ++i) k2 *= y.shape[i];
+    for (size_t i = yd; i < y.shape.size(); ++i) n *= y.shape[i];
+    if (k != k2) { err = "mul: K mismatch"; return false; }
+    Tensor& o = out(op, "Out");
+    o.shape.assign(x.shape.begin(), x.shape.begin() + xd);
+    o.shape.insert(o.shape.end(), y.shape.begin() + yd, y.shape.end());
+    o.f.assign(m * n, 0.0f);
+    o.is_int = false;
+    for (int64_t i = 0; i < m; ++i)
+      for (int64_t kk = 0; kk < k; ++kk) {
+        float xv = x.f[i * k + kk];
+        if (xv == 0.0f) continue;
+        const float* yrow = &y.f[kk * n];
+        float* orow = &o.f[i * n];
+        for (int64_t j = 0; j < n; ++j) orow[j] += xv * yrow[j];
+      }
+    return true;
+  }
+
+  // elementwise with trailing/bcast-at-axis Y (reference elementwise_op.h)
+  bool op_ewise(const Json& op, char kind) {
+    const Tensor& x = in(op, "X");
+    const Tensor& y = in(op, "Y");
+    int axis = static_cast<int>(attr_num(op, "axis", -1));
+    Tensor& o = out(op, "Out");
+    o.shape = x.shape;
+    o.f.resize(x.f.size());
+    o.is_int = false;
+    int64_t ny = 1;
+    for (auto s : y.shape) ny *= s;
+    if (axis < 0) axis = static_cast<int>(x.shape.size() - y.shape.size());
+    int64_t pre = 1, mid = 1, post = 1;
+    for (int i = 0; i < axis; ++i) pre *= x.shape[i];
+    for (size_t i = axis; i < axis + y.shape.size() && i < x.shape.size(); ++i)
+      mid *= x.shape[i];
+    post = static_cast<int64_t>(x.f.size()) / (pre * mid);
+    if (mid != ny) { err = "elementwise: shape mismatch"; return false; }
+    for (int64_t a = 0; a < pre; ++a)
+      for (int64_t b = 0; b < mid; ++b)
+        for (int64_t c = 0; c < post; ++c) {
+          int64_t idx = (a * mid + b) * post + c;
+          float xv = x.f[idx], yv = y.f[b];
+          o.f[idx] = kind == '+' ? xv + yv
+                     : kind == '-' ? xv - yv
+                     : kind == '*' ? xv * yv
+                                   : xv / yv;
+        }
+    return true;
+  }
+
+  template <typename F>
+  bool op_unary(const Json& op, F fn) {
+    const Tensor& x = in(op, "X");
+    Tensor& o = out(op, "Out");
+    o.shape = x.shape;
+    o.is_int = false;
+    o.f.resize(x.f.size());
+    for (size_t i = 0; i < x.f.size(); ++i) o.f[i] = fn(x.f[i]);
+    return true;
+  }
+
+  bool op_softmax(const Json& op) {
+    const Tensor& x = in(op, "X");
+    Tensor& o = out(op, "Out");
+    o.shape = x.shape;
+    o.is_int = false;
+    o.f.resize(x.f.size());
+    int64_t d = x.shape.back();
+    int64_t rows = static_cast<int64_t>(x.f.size()) / d;
+    for (int64_t r = 0; r < rows; ++r) {
+      const float* xi = &x.f[r * d];
+      float* oi = &o.f[r * d];
+      float mx = xi[0];
+      for (int64_t j = 1; j < d; ++j) mx = std::max(mx, xi[j]);
+      float sum = 0;
+      for (int64_t j = 0; j < d; ++j) { oi[j] = std::exp(xi[j] - mx); sum += oi[j]; }
+      for (int64_t j = 0; j < d; ++j) oi[j] /= sum;
+    }
+    return true;
+  }
+
+  bool op_scale(const Json& op) {
+    float s = static_cast<float>(attr_num(op, "scale", 1.0));
+    float b = static_cast<float>(attr_num(op, "bias", 0.0));
+    bool after = attr_num(op, "bias_after_scale", 1.0) != 0.0;
+    return op_unary(op, [=](float x) { return after ? x * s + b : (x + b) * s; });
+  }
+
+  bool op_reshape(const Json& op) {
+    const Tensor& x = in(op, "X");
+    Tensor& o = out(op, "Out");
+    const Json* shp = op.get("attrs")->get("shape");
+    o.f = x.f;
+    o.i = x.i;
+    o.is_int = x.is_int;
+    o.shape.clear();
+    int64_t known = 1, minus = -1;
+    for (size_t i = 0; i < shp->arr.size(); ++i) {
+      int64_t v = shp->arr[i].as_int();
+      if (v == -1) minus = static_cast<int64_t>(i);
+      else if (v == 0) v = x.shape[i];
+      o.shape.push_back(v);
+      if (v > 0) known *= v;
+    }
+    if (minus >= 0) o.shape[minus] = x.numel() / known;
+    return true;
+  }
+
+  bool op_dropout(const Json& op) {
+    if (attr_num(op, "is_test", 0.0) == 0.0) {
+      err = "dropout: only is_test=True supported in the native predictor";
+      return false;
+    }
+    std::string impl = "downgrade_in_infer";
+    const Json* a = op.get("attrs")->get("dropout_implementation");
+    if (a) impl = a->str;
+    float keep = 1.0f - static_cast<float>(attr_num(op, "dropout_prob", 0.5));
+    float mul = impl == "upscale_in_train" ? 1.0f : keep;
+    return op_unary(op, [=](float x) { return x * mul; });
+  }
+
+  bool op_batch_norm(const Json& op) {
+    if (attr_num(op, "is_test", 0.0) == 0.0) {
+      err = "batch_norm: only is_test=True supported in the native predictor";
+      return false;
+    }
+    const Tensor& x = in(op, "X");
+    const Tensor& scale = in(op, "Scale");
+    const Tensor& bias = in(op, "Bias");
+    const Tensor& mean = in(op, "Mean");
+    const Tensor& var = in(op, "Variance");
+    float eps = static_cast<float>(attr_num(op, "epsilon", 1e-5));
+    Tensor& o = out(op, "Y");
+    o.shape = x.shape;
+    o.is_int = false;
+    o.f.resize(x.f.size());
+    // NCHW: channel axis 1
+    int64_t c = x.shape.size() > 1 ? x.shape[1] : x.shape[0];
+    int64_t pre = x.shape[0];
+    int64_t post = static_cast<int64_t>(x.f.size()) / (pre * c);
+    for (int64_t a = 0; a < pre; ++a)
+      for (int64_t ch = 0; ch < c; ++ch) {
+        float inv = scale.f[ch] / std::sqrt(var.f[ch] + eps);
+        float sh = bias.f[ch] - mean.f[ch] * inv;
+        float* row = &o.f[(a * c + ch) * post];
+        const float* xr = &x.f[(a * c + ch) * post];
+        for (int64_t j = 0; j < post; ++j) row[j] = xr[j] * inv + sh;
+      }
+    return true;
+  }
+
+  bool op_lookup(const Json& op) {
+    const Tensor& w = in(op, "W");
+    const Tensor& ids = in(op, "Ids");
+    Tensor& o = out(op, "Out");
+    int64_t d = w.shape[1];
+    int64_t n = ids.is_int ? static_cast<int64_t>(ids.i.size())
+                           : static_cast<int64_t>(ids.f.size());
+    o.shape = ids.shape;
+    if (!o.shape.empty() && o.shape.back() == 1) o.shape.pop_back();
+    o.shape.push_back(d);
+    o.is_int = false;
+    o.f.resize(n * d);
+    for (int64_t k = 0; k < n; ++k) {
+      int64_t id = ids.is_int ? ids.i[k] : static_cast<int64_t>(ids.f[k]);
+      if (id < 0 || id >= w.shape[0]) { err = "lookup: id out of range"; return false; }
+      std::copy(&w.f[id * d], &w.f[(id + 1) * d], &o.f[k * d]);
+    }
+    return true;
+  }
+
+  bool op_dequant(const Json& op) {
+    const Tensor& x = in(op, "X");     // int8 weights loaded as fp32
+    const Tensor& scale = in(op, "Scale");
+    float max_range = static_cast<float>(attr_num(op, "max_range", 127.0));
+    float mul = scale.f[0] / max_range;
+    Tensor& o = out(op, "Out");
+    o.shape = x.shape;
+    o.is_int = false;
+    o.f.resize(x.f.size());
+    for (size_t i = 0; i < x.f.size(); ++i) o.f[i] = x.f[i] * mul;
+    return true;
+  }
+
+  // QAT's dynamic activation quantization (kept at inference — the
+  // trained behavior; see contrib/slim/quantization.py freeze docs)
+  bool op_fake_quant(const Json& op) {
+    const Tensor& x = in(op, "X");
+    int bits = static_cast<int>(attr_num(op, "bit_length", 8));
+    float qmax = static_cast<float>((1 << (bits - 1)) - 1);
+    float scale = 1e-8f;
+    for (float v : x.f) scale = std::max(scale, std::fabs(v));
+    Tensor& o = out(op, "Out");
+    o.shape = x.shape;
+    o.is_int = false;
+    o.f.resize(x.f.size());
+    for (size_t i = 0; i < x.f.size(); ++i) {
+      float q = std::nearbyint(x.f[i] / scale * qmax);
+      q = std::max(-qmax, std::min(qmax, q));
+      o.f[i] = q * scale / qmax;
+    }
+    const Json* snames = op.get("outputs")->get("OutScale");
+    if (snames && !snames->arr.empty()) {
+      Tensor& s = vars[snames->arr[0].str];
+      s.shape = {1};
+      s.is_int = false;
+      s.f = {scale};
+    }
+    return true;
+  }
+
+  bool op_cast(const Json& op) {
+    const Tensor& x = in(op, "X");
+    Tensor& o = out(op, "Out");
+    // fp32 compute path: any cast lands on float — an integral input
+    // must be CONVERTED, not copied with its empty float payload
+    o.shape = x.shape;
+    o.is_int = false;
+    if (x.is_int) {
+      o.f.resize(x.i.size());
+      for (size_t k = 0; k < x.i.size(); ++k)
+        o.f[k] = static_cast<float>(x.i[k]);
+      o.i.clear();
+    } else {
+      o.f = x.f;
+      o.i.clear();
+    }
+    return true;
+  }
+};
+
+}  // namespace ptp
+
+// ---------------------------------------------------------------------------
+// C API (ctypes surface)
+// ---------------------------------------------------------------------------
+extern "C" {
+
+void* ptp_predictor_create(const char* model_dir) {
+  auto* p = new ptp::Predictor();
+  if (!p->load(model_dir)) return p;  // error readable via ptp_predictor_error
+  return p;
+}
+
+const char* ptp_predictor_error(void* h) {
+  return static_cast<ptp::Predictor*>(h)->err.c_str();
+}
+
+int ptp_predictor_set_input(void* h, const char* name, const float* data,
+                            const int64_t* shape, int ndim) {
+  auto* p = static_cast<ptp::Predictor*>(h);
+  ptp::Tensor t;
+  t.shape.assign(shape, shape + ndim);
+  t.f.assign(data, data + t.numel());
+  p->vars[name] = std::move(t);
+  return 0;
+}
+
+int ptp_predictor_set_input_i64(void* h, const char* name, const int64_t* data,
+                                const int64_t* shape, int ndim) {
+  auto* p = static_cast<ptp::Predictor*>(h);
+  ptp::Tensor t;
+  t.shape.assign(shape, shape + ndim);
+  t.is_int = true;
+  t.i.assign(data, data + t.numel());
+  p->vars[name] = std::move(t);
+  return 0;
+}
+
+int ptp_predictor_run(void* h) {
+  auto* p = static_cast<ptp::Predictor*>(h);
+  if (!p->err.empty()) return 1;
+  return p->run() ? 0 : 1;
+}
+
+int ptp_predictor_num_outputs(void* h) {
+  return static_cast<int>(static_cast<ptp::Predictor*>(h)->fetch_names.size());
+}
+
+// Returns numel; fills shape (up to max_ndim) and *ndim.  Call with
+// data=nullptr first to size the buffer.
+int64_t ptp_predictor_get_output(void* h, int idx, float* data,
+                                 int64_t* shape, int* ndim, int max_ndim) {
+  auto* p = static_cast<ptp::Predictor*>(h);
+  const std::string& name = p->fetch_names[idx];
+  auto it = p->vars.find(name);
+  if (it == p->vars.end()) return -1;
+  const ptp::Tensor& t = it->second;
+  *ndim = static_cast<int>(t.shape.size());
+  for (int i = 0; i < *ndim && i < max_ndim; ++i) shape[i] = t.shape[i];
+  if (data) std::copy(t.f.begin(), t.f.end(), data);
+  return t.numel();
+}
+
+void ptp_predictor_destroy(void* h) { delete static_cast<ptp::Predictor*>(h); }
+
+}  // extern "C"
+
+#ifdef PTP_MAIN
+// Standalone CLI: predictor_demo <model_dir> <input_name:input.npy> ...
+// Prints each fetch as "name shape: v0 v1 ..." — the demo_trainer.cc
+// deployment analog (inference; training stays on the XLA path).
+#include <cstdio>
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <model_dir> <name:input.npy>...\n", argv[0]);
+    return 2;
+  }
+  ptp::Predictor p;
+  if (!p.load(argv[1])) {
+    fprintf(stderr, "load: %s\n", p.err.c_str());
+    return 1;
+  }
+  for (int a = 2; a < argc; ++a) {
+    std::string arg = argv[a];
+    auto colon = arg.find(':');
+    std::string name = arg.substr(0, colon), path = arg.substr(colon + 1);
+    ptp::Tensor t;
+    std::string e;
+    if (!ptp::read_npy(path, &t, &e)) {
+      fprintf(stderr, "input: %s\n", e.c_str());
+      return 1;
+    }
+    p.vars[name] = std::move(t);
+  }
+  if (!p.run()) {
+    fprintf(stderr, "run: %s\n", p.err.c_str());
+    return 1;
+  }
+  for (auto& name : p.fetch_names) {
+    const ptp::Tensor& t = p.vars[name];
+    printf("%s [", name.c_str());
+    for (size_t i = 0; i < t.shape.size(); ++i)
+      printf("%s%lld", i ? "," : "", static_cast<long long>(t.shape[i]));
+    printf("]:");
+    for (int64_t i = 0; i < t.numel() && i < 16; ++i) printf(" %g", t.f[i]);
+    printf("\n");
+  }
+  return 0;
+}
+#endif
