@@ -87,6 +87,7 @@ from paddle_tpu.io import (
     save_inference_model,
     save_params,
     save_persistables,
+    save_program,
     save_vars,
 )
 from paddle_tpu.parallel.compiled_program import CompiledProgram

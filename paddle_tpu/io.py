@@ -31,6 +31,7 @@ __all__ = [
     "load_persistables",
     "save_inference_model",
     "load_inference_model",
+    "save_program",
 ]
 
 _MANIFEST = "__manifest__.json"
@@ -169,6 +170,52 @@ def _prune_program(program: Program, feed_names: Sequence[str], fetch_names: Seq
     return pruned
 
 
+def _save_model(dirname, program, feed_names, fetch_names, executor,
+                model_filename=None, params_filename=None):
+    """Shared save path for save_inference_model / save_program: the
+    ``__model__`` JSON + persistable ``.npy`` layout consumed by both
+    load_inference_model and the native C++ runtime (predictor.cc)."""
+    os.makedirs(dirname, exist_ok=True)
+    model = {
+        "format_version": 1,
+        "program": json.loads(program.to_json()),
+        "feed_names": list(feed_names),
+        "fetch_names": list(fetch_names),
+    }
+    with open(os.path.join(dirname, model_filename or _MODEL_FILE), "w") as f:
+        json.dump(model, f)
+    save_vars(
+        executor, dirname, program,
+        predicate=_is_persistable,
+        filename=params_filename,
+    )
+    return list(fetch_names)
+
+
+def save_program(
+    dirname,
+    feeded_var_names: Sequence[str],
+    target_vars: Sequence,
+    executor,
+    main_program: Optional[Program] = None,
+    model_filename=None,
+    params_filename=None,
+):
+    """Save a FULL program — including backward and optimizer ops — plus
+    its persistable state in the same ``__model__`` JSON + ``.npy``
+    format ``save_inference_model`` uses.  This is the export side of the
+    pure-C++ training path (native/predictor.cc runs the saved train
+    program's forward+grad+sgd ops without Python — the analog of the
+    reference's demo_trainer.cc, which loads a serialized train program
+    and runs it through the C++ executor).  Unlike
+    ``save_inference_model`` nothing is pruned, so the optimizer state
+    (learning rate var, accumulators) rides along."""
+    program = main_program or framework.default_main_program()
+    fetch_names = [t.name if isinstance(t, Variable) else str(t) for t in target_vars]
+    return _save_model(dirname, program, feeded_var_names, fetch_names,
+                       executor, model_filename, params_filename)
+
+
 def save_inference_model(
     dirname,
     feeded_var_names: Sequence[str],
@@ -182,21 +229,8 @@ def save_inference_model(
     program = main_program or framework.default_main_program()
     fetch_names = [t.name if isinstance(t, Variable) else str(t) for t in target_vars]
     pruned = _prune_program(program, feeded_var_names, fetch_names)
-    os.makedirs(dirname, exist_ok=True)
-    model = {
-        "format_version": 1,
-        "program": json.loads(pruned.to_json()),
-        "feed_names": list(feeded_var_names),
-        "fetch_names": fetch_names,
-    }
-    with open(os.path.join(dirname, model_filename or _MODEL_FILE), "w") as f:
-        json.dump(model, f)
-    save_vars(
-        executor, dirname, pruned,
-        predicate=lambda v: isinstance(v, Parameter) or (_is_persistable(v)),
-        filename=params_filename,
-    )
-    return fetch_names
+    return _save_model(dirname, pruned, feeded_var_names, fetch_names,
+                       executor, model_filename, params_filename)
 
 
 def load_inference_model(dirname, executor, model_filename=None, params_filename=None):

@@ -285,7 +285,10 @@ struct Predictor {
   std::map<std::string, Tensor> vars;   // persistables + intermediates
   std::vector<std::string> feed_names;
   std::vector<std::string> fetch_names;
+  std::map<std::string, bool> persist_names;  // loaded persistables
+  std::map<std::string, bool> fed;            // feeds set since last run
   const Json* ops = nullptr;
+  bool load_ok = false;
   std::string err;
 
   static std::string escape_name(const std::string& n) {
@@ -340,7 +343,9 @@ struct Predictor {
         return false;
       }
       vars[name] = std::move(t);
+      persist_names[name] = true;
     }
+    load_ok = true;
     return true;
   }
 
@@ -359,6 +364,19 @@ struct Predictor {
   }
 
   bool run() {
+    err.clear();  // a failed run must not replay its error on the next
+    // drop stale intermediates (incl. previous runs' feeds) so the
+    // missing-feed pre-flight stays effective on EVERY run — without
+    // this, a typo'd feed on run 2 would silently reuse run 1's tensor
+    // and serve the previous request's result.  Persistables stay:
+    // they are the model state (sgd updates them in place).
+    for (auto it = vars.begin(); it != vars.end();) {
+      if (!persist_names.count(it->first) && !fed.count(it->first))
+        it = vars.erase(it);
+      else
+        ++it;
+    }
+    fed.clear();
     // pre-flight: every op input must be a loaded persistable, a set
     // feed, or an earlier op's output — a typo'd feed name must error
     // here, not read a default-constructed empty Tensor (UB)
@@ -411,12 +429,29 @@ struct Predictor {
     if (type == "dequantize_abs_max") return op_dequant(op);
     if (type == "fake_quantize_dequantize_abs_max") return op_fake_quant(op);
     if (type == "cast") return op_cast(op);
+    // training subset (the pure-C++ train demo analog, demo_trainer.cc)
+    if (type == "fill_constant") return op_fill_constant(op);
+    if (type == "mean") return op_mean(op);
+    if (type == "square_error_cost") return op_sec(op);
+    if (type == "mean_grad") return op_mean_grad(op);
+    if (type == "square_error_cost_grad") return op_sec_grad(op);
+    if (type == "relu_grad") return op_relu_grad(op);
+    if (type == "elementwise_add_grad") return op_ewise_add_grad(op);
+    if (type == "mul_grad") return op_mul_grad(op);
+    if (type == "sgd") return op_sgd(op);
     err = "native predictor: unsupported op '" + type +
           "' (supported: mul, elementwise_{add,sub,mul,div}, relu, tanh, "
           "sigmoid, exp, sqrt, softmax, scale, reshape2, dropout[is_test], "
-          "batch_norm[is_test], lookup_table, dequantize_abs_max, cast; "
+          "batch_norm[is_test], lookup_table, dequantize_abs_max, cast, "
+          "and the train set fill_constant/mean/square_error_cost/"
+          "{mean,square_error_cost,relu,elementwise_add,mul}_grad/sgd; "
           "use the Python AnalysisPredictor for the full op set)";
     return false;
+  }
+
+  bool has_out(const Json& op, const char* slot) {
+    const Json* names = op.get("outputs")->get(slot);
+    return names && !names->arr.empty() && !names->arr[0].str.empty();
   }
 
   // mul: collapse x to 2D at x_num_col_dims, y at y_num_col_dims
@@ -586,6 +621,14 @@ struct Predictor {
     int64_t d = w.shape[1];
     int64_t n = ids.is_int ? static_cast<int64_t>(ids.i.size())
                            : static_cast<int64_t>(ids.f.size());
+    // padding_idx rows come back zero, matching the Python kernel
+    // (ops/tensor_ops.py lookup_table); absent/null/negative = disabled
+    // (the attr may be JSON null — Python None — which attr_num would
+    // misread as 0 and zero the id-0 rows)
+    int64_t pad = -1;
+    const Json* attrs = op.get("attrs");
+    const Json* jpad = attrs ? attrs->get("padding_idx") : nullptr;
+    if (jpad && jpad->kind == Json::kNum) pad = jpad->as_int();
     o.shape = ids.shape;
     if (!o.shape.empty() && o.shape.back() == 1) o.shape.pop_back();
     o.shape.push_back(d);
@@ -594,7 +637,10 @@ struct Predictor {
     for (int64_t k = 0; k < n; ++k) {
       int64_t id = ids.is_int ? ids.i[k] : static_cast<int64_t>(ids.f[k]);
       if (id < 0 || id >= w.shape[0]) { err = "lookup: id out of range"; return false; }
-      std::copy(&w.f[id * d], &w.f[(id + 1) * d], &o.f[k * d]);
+      if (pad >= 0 && id == pad)
+        std::fill(&o.f[k * d], &o.f[(k + 1) * d], 0.0f);
+      else
+        std::copy(&w.f[id * d], &w.f[(id + 1) * d], &o.f[k * d]);
     }
     return true;
   }
@@ -609,6 +655,181 @@ struct Predictor {
     o.is_int = false;
     o.f.resize(x.f.size());
     for (size_t i = 0; i < x.f.size(); ++i) o.f[i] = x.f[i] * mul;
+    return true;
+  }
+
+  // --- training subset --------------------------------------------------
+  bool op_fill_constant(const Json& op) {
+    Tensor& o = out(op, "Out");
+    const Json* shp = op.get("attrs")->get("shape");
+    o.shape.clear();
+    int64_t n = 1;
+    if (shp)
+      for (auto& s : shp->arr) { o.shape.push_back(s.as_int()); n *= s.as_int(); }
+    o.is_int = false;
+    o.f.assign(n, static_cast<float>(attr_num(op, "value", 0.0)));
+    return true;
+  }
+
+  bool op_mean(const Json& op) {
+    const Tensor& x = in(op, "X");
+    Tensor& o = out(op, "Out");
+    double s = 0;
+    for (float v : x.f) s += v;
+    o.shape = {1};
+    o.is_int = false;
+    o.f = {static_cast<float>(s / std::max<size_t>(x.f.size(), 1))};
+    return true;
+  }
+
+  bool op_sec(const Json& op) {  // square_error_cost: (x - y)^2
+    const Tensor& x = in(op, "X");
+    const Tensor& y = in(op, "Y");
+    if (x.f.size() != y.f.size()) { err = "square_error_cost: shape mismatch"; return false; }
+    Tensor& o = out(op, "Out");
+    o.shape = x.shape;
+    o.is_int = false;
+    o.f.resize(x.f.size());
+    for (size_t i = 0; i < x.f.size(); ++i) {
+      float d = x.f[i] - y.f[i];
+      o.f[i] = d * d;
+    }
+    return true;
+  }
+
+  bool op_mean_grad(const Json& op) {
+    const Tensor& x = in(op, "X");
+    const Tensor& og = in(op, "Out@GRAD");
+    Tensor& xg = out(op, "X@GRAD");
+    xg.shape = x.shape;
+    xg.is_int = false;
+    float g = og.f.empty() ? 1.0f : og.f[0];
+    xg.f.assign(x.f.size(), g / std::max<size_t>(x.f.size(), 1));
+    return true;
+  }
+
+  bool op_sec_grad(const Json& op) {
+    const Tensor& x = in(op, "X");
+    const Tensor& y = in(op, "Y");
+    const Tensor& og = in(op, "Out@GRAD");
+    // both grad slots are optional per the backward pass's grad-op
+    // contract (Y is often a label with stop_gradient, but may be a
+    // trainable branch); d/dx = 2(x-y)·og, d/dy = -2(x-y)·og
+    if (has_out(op, "X@GRAD")) {
+      Tensor& xg = out(op, "X@GRAD");
+      xg.shape = x.shape;
+      xg.is_int = false;
+      xg.f.resize(x.f.size());
+      for (size_t i = 0; i < x.f.size(); ++i)
+        xg.f[i] = 2.0f * (x.f[i] - y.f[i]) * og.f[i];
+    }
+    if (has_out(op, "Y@GRAD")) {
+      Tensor& yg = out(op, "Y@GRAD");
+      yg.shape = y.shape;
+      yg.is_int = false;
+      yg.f.resize(y.f.size());
+      for (size_t i = 0; i < y.f.size(); ++i)
+        yg.f[i] = -2.0f * (x.f[i] - y.f[i]) * og.f[i];
+    }
+    return true;
+  }
+
+  bool op_relu_grad(const Json& op) {
+    const Tensor& x = in(op, "X");  // pre-activation input
+    const Tensor& og = in(op, "Out@GRAD");
+    Tensor& xg = out(op, "X@GRAD");
+    xg.shape = x.shape;
+    xg.is_int = false;
+    xg.f.resize(x.f.size());
+    for (size_t i = 0; i < x.f.size(); ++i)
+      xg.f[i] = x.f[i] > 0 ? og.f[i] : 0.0f;
+    return true;
+  }
+
+  bool op_ewise_add_grad(const Json& op) {
+    const Tensor& x = in(op, "X");
+    const Tensor& y = in(op, "Y");
+    const Tensor& og = in(op, "Out@GRAD");
+    int axis = static_cast<int>(attr_num(op, "axis", -1));
+    if (axis < 0) axis = static_cast<int>(x.shape.size() - y.shape.size());
+    if (has_out(op, "X@GRAD")) {
+      Tensor& xg = out(op, "X@GRAD");
+      xg.shape = x.shape;
+      xg.is_int = false;
+      xg.f = og.f;
+    }
+    if (has_out(op, "Y@GRAD")) {
+      int64_t ny = 1;
+      for (auto s : y.shape) ny *= s;
+      int64_t pre = 1, mid = 1;
+      for (int i = 0; i < axis; ++i) pre *= x.shape[i];
+      for (size_t i = axis; i < axis + y.shape.size() && i < x.shape.size(); ++i)
+        mid *= x.shape[i];
+      int64_t post = static_cast<int64_t>(og.f.size()) / (pre * mid);
+      if (mid != ny) { err = "elementwise_add_grad: shape mismatch"; return false; }
+      Tensor& yg = out(op, "Y@GRAD");
+      yg.shape = y.shape;
+      yg.is_int = false;
+      yg.f.assign(ny, 0.0f);
+      for (int64_t a = 0; a < pre; ++a)
+        for (int64_t b = 0; b < mid; ++b)
+          for (int64_t c = 0; c < post; ++c)
+            yg.f[b] += og.f[(a * mid + b) * post + c];
+    }
+    return true;
+  }
+
+  bool op_mul_grad(const Json& op) {
+    const Tensor& x = in(op, "X");
+    const Tensor& y = in(op, "Y");
+    const Tensor& og = in(op, "Out@GRAD");
+    int xd = static_cast<int>(attr_num(op, "x_num_col_dims", 1));
+    int yd = static_cast<int>(attr_num(op, "y_num_col_dims", 1));
+    int64_t m = 1, k = 1, n = 1;
+    for (int i = 0; i < xd; ++i) m *= x.shape[i];
+    for (size_t i = xd; i < x.shape.size(); ++i) k *= x.shape[i];
+    for (size_t i = yd; i < y.shape.size(); ++i) n *= y.shape[i];
+    if (has_out(op, "X@GRAD")) {  // og [m,n] x y^T [n,k]
+      Tensor& xg = out(op, "X@GRAD");
+      xg.shape = x.shape;
+      xg.is_int = false;
+      xg.f.assign(m * k, 0.0f);
+      for (int64_t i = 0; i < m; ++i)
+        for (int64_t j = 0; j < n; ++j) {
+          float g = og.f[i * n + j];
+          if (g == 0.0f) continue;
+          for (int64_t kk = 0; kk < k; ++kk)
+            xg.f[i * k + kk] += g * y.f[kk * n + j];
+        }
+    }
+    if (has_out(op, "Y@GRAD")) {  // x^T [k,m] x og [m,n]
+      Tensor& yg = out(op, "Y@GRAD");
+      yg.shape = y.shape;
+      yg.is_int = false;
+      yg.f.assign(k * n, 0.0f);
+      for (int64_t i = 0; i < m; ++i)
+        for (int64_t kk = 0; kk < k; ++kk) {
+          float xv = x.f[i * k + kk];
+          if (xv == 0.0f) continue;
+          for (int64_t j = 0; j < n; ++j)
+            yg.f[kk * n + j] += xv * og.f[i * n + j];
+        }
+    }
+    return true;
+  }
+
+  bool op_sgd(const Json& op) {
+    const Tensor& param = in(op, "Param");
+    const Tensor& grad = in(op, "Grad");
+    const Tensor& lr = in(op, "LearningRate");
+    if (param.f.size() != grad.f.size()) { err = "sgd: shape mismatch"; return false; }
+    float eta = lr.f.empty() ? 0.01f : lr.f[0];
+    Tensor next;
+    next.shape = param.shape;
+    next.f.resize(param.f.size());
+    for (size_t i = 0; i < param.f.size(); ++i)
+      next.f[i] = param.f[i] - eta * grad.f[i];
+    out(op, "ParamOut") = std::move(next);  // same name as Param: in-place
     return true;
   }
 
@@ -683,6 +904,7 @@ int ptp_predictor_set_input(void* h, const char* name, const float* data,
   t.shape.assign(shape, shape + ndim);
   t.f.assign(data, data + t.numel());
   p->vars[name] = std::move(t);
+  p->fed[name] = true;
   return 0;
 }
 
@@ -694,12 +916,13 @@ int ptp_predictor_set_input_i64(void* h, const char* name, const int64_t* data,
   t.is_int = true;
   t.i.assign(data, data + t.numel());
   p->vars[name] = std::move(t);
+  p->fed[name] = true;
   return 0;
 }
 
 int ptp_predictor_run(void* h) {
   auto* p = static_cast<ptp::Predictor*>(h);
-  if (!p->err.empty()) return 1;
+  if (!p->load_ok) return 1;  // load failed; err holds the load error
   return p->run() ? 0 : 1;
 }
 
@@ -718,7 +941,16 @@ int64_t ptp_predictor_get_output(void* h, int idx, float* data,
   const ptp::Tensor& t = it->second;
   *ndim = static_cast<int>(t.shape.size());
   for (int i = 0; i < *ndim && i < max_ndim; ++i) shape[i] = t.shape[i];
-  if (data) std::copy(t.f.begin(), t.f.end(), data);
+  if (data) {
+    if (t.is_int) {
+      // integral fetches come back as floats (the fp32 C API surface) —
+      // converted, never an uninitialized buffer
+      for (size_t k = 0; k < t.i.size(); ++k)
+        data[k] = static_cast<float>(t.i[k]);
+    } else {
+      std::copy(t.f.begin(), t.f.end(), data);
+    }
+  }
   return t.numel();
 }
 

@@ -86,13 +86,82 @@ def test_native_predictor_runs_frozen_int8(tmp_path):
     np.testing.assert_allclose(got, np.asarray(want), rtol=1e-4, atol=1e-5)
 
 
+def test_native_trainer_matches_python_trajectory(tmp_path):
+    """The pure-C++ training path (reference: train/demo/demo_trainer.cc
+    — load a serialized TRAIN program, run fwd+grad+sgd in C++): export
+    a full train program via save_program, run N steps natively, and
+    match the Python executor's loss trajectory and final weights."""
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 47
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [6])
+        y = fluid.layers.data("y", [1])
+        h = fluid.layers.fc(x, 10, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+
+    rng = np.random.RandomState(11)
+    xs = rng.uniform(-1, 1, (8, 16, 6)).astype("float32")
+    ys = xs.sum(2, keepdims=True).astype("float32") * 0.5
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.save_program(str(tmp_path / "t"), ["x", "y"], [loss], exe, prog)
+        py_losses = []
+        for i in range(8):
+            (l,) = exe.run(prog, feed={"x": xs[i], "y": ys[i]},
+                           fetch_list=[loss])
+            py_losses.append(float(np.asarray(l)))
+
+    trainer = NativePredictor(str(tmp_path / "t"))
+    c_losses = []
+    for i in range(8):
+        (l,) = trainer.run({"x": xs[i], "y": ys[i]})
+        c_losses.append(float(l.reshape(())))
+    np.testing.assert_allclose(c_losses, py_losses, rtol=1e-4, atol=1e-6)
+    assert c_losses[-1] < c_losses[0]
+
+
 def test_native_predictor_missing_feed_is_loud(tmp_path):
     """A typo'd/missing feed name errors with the expected feed list —
-    never computes on empty buffers (review r5)."""
+    never computes on empty buffers (review r5) — INCLUDING on a second
+    run, where run 1's stale feed must not silently serve run 1's
+    result (review r5 #2)."""
     _save_mlp(tmp_path / "f", seed=44)
     p = NativePredictor(str(tmp_path / "f"))
     with pytest.raises(RuntimeError, match="missing feed.*x"):
         p.run({"X_typo": np.zeros((2, 16), "float32")})
+    xb = np.random.RandomState(1).uniform(-1, 1, (2, 16)).astype("float32")
+    (first,) = p.run({"x": xb})
+    with pytest.raises(RuntimeError, match="missing feed"):
+        p.run({"X_typo": xb})
+    # and a correct run afterwards still works
+    (again,) = p.run({"x": xb})
+    np.testing.assert_allclose(again, first)
+
+
+def test_native_predictor_lookup_padding_idx(tmp_path):
+    """lookup_table honors padding_idx like the Python kernel: padded
+    rows come back zero (review r5 #3)."""
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 6
+    with framework.program_guard(prog, startup):
+        ids = fluid.layers.data("ids", [4, 1], dtype="int64")
+        emb = fluid.layers.embedding(ids, size=[10, 3], padding_idx=0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    idv = np.array([[[1], [0], [2], [0]]], dtype="int64")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (want,) = exe.run(prog, feed={"ids": idv}, fetch_list=[emb])
+        fluid.save_inference_model(str(tmp_path / "e"), ["ids"], [emb],
+                                   exe, prog)
+    (got,) = NativePredictor(str(tmp_path / "e")).run({"ids": idv})
+    want = np.asarray(want)
+    np.testing.assert_allclose(got, want.reshape(got.shape), rtol=1e-6)
+    assert np.all(got[0, 1] == 0) and np.all(got[0, 3] == 0)
 
 
 def test_native_predictor_unsupported_op_is_loud(tmp_path):
