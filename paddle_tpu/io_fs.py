@@ -2,38 +2,63 @@
 — local + HDFS file ops used by Dataset/Fleet file-sharding).
 
 Local paths work natively; ``hdfs://`` paths route through the ``hadoop
-fs`` CLI when present (the reference shells out the same way,
-io/shell.cc), else raise with a clear message.  The API mirrors fs.cc:
-``fs_ls / fs_exists / fs_mkdir / fs_rm / fs_mv / open_read /
-open_write / file_shard``.
+fs`` CLI — the reference's HDFS support is EXACTLY the same design
+(fs.cc:208 ``hdfs_command() = "hadoop fs"`` run via shell_popen; there
+is no native protocol client in the reference either).  When the CLI is
+absent the hdfs ops raise with a clear message.  The API mirrors fs.cc:
+``fs_ls / fs_exists / fs_mkdir / fs_rm / fs_mv / fs_tail /
+fs_file_size / open_read / open_write / file_shard /
+set_hdfs_command``, including fs.cc's converter behavior (``.gz`` reads
+decompress — ``-text`` on hdfs, gzip locally — and ``.gz`` writes
+compress) and the streaming ``-put -`` write pipe.
 """
 from __future__ import annotations
 
 import glob as _glob
+import gzip as _gzip
 import os
 import shutil
 import subprocess
 from typing import IO, List
 
 __all__ = [
-    "fs_ls", "fs_exists", "fs_mkdir", "fs_rm", "fs_mv",
-    "open_read", "open_write", "file_shard",
+    "fs_ls", "fs_exists", "fs_mkdir", "fs_rm", "fs_mv", "fs_tail",
+    "fs_file_size", "open_read", "open_write", "file_shard",
+    "set_hdfs_command",
 ]
+
+# reference: fs.cc:208 hdfs_command_internal() = "hadoop fs",
+# overridable via hdfs_set_command (e.g. to add -D options)
+_HDFS_COMMAND = ["hadoop", "fs"]
+
+
+def set_hdfs_command(cmd: str) -> None:
+    """reference: fs.cc:215 hdfs_set_command."""
+    global _HDFS_COMMAND
+    parts = cmd.split()
+    if not parts:
+        raise ValueError("empty hdfs command")
+    _HDFS_COMMAND = parts
 
 
 def _is_hdfs(path: str) -> bool:
     return path.startswith(("hdfs://", "afs://"))
 
 
-def _hadoop(*args: str) -> str:
-    exe = shutil.which("hadoop")
+def _hdfs_argv(*args: str) -> List[str]:
+    exe = shutil.which(_HDFS_COMMAND[0])
     if exe is None:
         raise RuntimeError(
-            "hdfs:// path requires the 'hadoop' CLI on PATH (reference "
-            "io/fs.cc shells out identically); not present in this image"
+            "hdfs:// path requires the %r CLI on PATH (the reference "
+            "shells out identically, io/fs.cc:208); not present in this "
+            "image" % _HDFS_COMMAND[0]
         )
+    return [exe, *_HDFS_COMMAND[1:], *args]
+
+
+def _hadoop(*args: str) -> str:
     return subprocess.run(
-        [exe, "fs", *args], check=True, capture_output=True, text=True
+        _hdfs_argv(*args), check=True, capture_output=True, text=True
     ).stdout
 
 
@@ -80,14 +105,38 @@ def fs_mv(src: str, dst: str) -> None:
     shutil.move(src, dst)
 
 
-class _ProcReader:
+def fs_file_size(path: str) -> int:
+    """reference: fs.cc fs_file_size (hdfs: -du first column)."""
+    if _is_hdfs(path):
+        out = _hadoop("-du", path)
+        lines = [ln.split() for ln in out.splitlines() if ln.strip()]
+        if not lines:
+            raise FileNotFoundError(path)
+        return sum(int(ln[0]) for ln in lines)
+    return os.path.getsize(path)
+
+
+def fs_tail(path: str) -> str:
+    """Last line of the file (reference: fs.cc fs_tail — hdfs pipes
+    ``-text path | tail -1``; here the stream is read incrementally so
+    only one line is held)."""
+    last = b""
+    with open_read(path, "rb") as f:
+        for line in f:
+            if line.strip():
+                last = line
+    return last.decode().rstrip("\n")
+
+
+class _ProcStream:
     """File-like over a subprocess pipe that reaps the process on close
     and surfaces a nonzero exit status (an empty stream must not be
-    mistaken for an empty file)."""
+    mistaken for an empty file; a failed write must not look flushed)."""
 
-    def __init__(self, proc: subprocess.Popen, stream):
+    def __init__(self, proc: subprocess.Popen, stream, what: str):
         self._proc = proc
         self._stream = stream
+        self._what = what
 
     def __getattr__(self, name):
         return getattr(self._stream, name)
@@ -103,28 +152,53 @@ class _ProcReader:
         return False
 
     def close(self):
-        self._stream.close()
+        # an early-exiting child makes the final flush raise
+        # BrokenPipeError — reap the process FIRST so (a) it never
+        # leaks unreaped and (b) the caller gets the exit-status
+        # RuntimeError this class documents, not the pipe error
+        flush_err = None
+        try:
+            self._stream.close()
+        except (BrokenPipeError, OSError) as e:
+            flush_err = e
         rc = self._proc.wait()
         if rc != 0:
-            raise RuntimeError("hadoop fs -cat exited with status %d" % rc)
+            raise RuntimeError("%s exited with status %d" % (self._what, rc))
+        if flush_err is not None:
+            raise flush_err
 
 
 def open_read(path: str, mode: str = "r") -> IO:
+    """reference: fs.cc fs_open_read — ``.gz`` paths decompress on the
+    way in (hdfs ``-text``; locally gzip)."""
     if _is_hdfs(path):
         import io as _iomod
 
-        exe = shutil.which("hadoop")
-        if exe is None:
-            raise RuntimeError("hdfs:// read requires the 'hadoop' CLI")
-        proc = subprocess.Popen([exe, "fs", "-cat", path], stdout=subprocess.PIPE)
+        op = "-text" if path.endswith(".gz") else "-cat"
+        proc = subprocess.Popen(_hdfs_argv(op, path), stdout=subprocess.PIPE)
         stream = proc.stdout if "b" in mode else _iomod.TextIOWrapper(proc.stdout)
-        return _ProcReader(proc, stream)
+        return _ProcStream(proc, stream, "hadoop fs %s" % op)
+    if path.endswith(".gz"):
+        return _gzip.open(path, mode if "b" in mode else mode + "t")
     return open(path, mode)
 
 
 def open_write(path: str, mode: str = "w") -> IO:
+    """reference: fs.cc fs_open_write — hdfs streams through
+    ``-put - <path>`` (fs.cc:234); ``.gz`` paths compress."""
     if _is_hdfs(path):
-        raise NotImplementedError("hdfs:// streaming write: stage locally, fs_mv after")
+        import io as _iomod
+
+        if path.endswith(".gz"):
+            raise NotImplementedError(
+                "hdfs .gz streaming write: stage locally (gzip), fs_mv after"
+            )
+        proc = subprocess.Popen(_hdfs_argv("-put", "-", path),
+                                stdin=subprocess.PIPE)
+        stream = proc.stdin if "b" in mode else _iomod.TextIOWrapper(proc.stdin)
+        return _ProcStream(proc, stream, "hadoop fs -put")
+    if path.endswith(".gz"):
+        return _gzip.open(path, mode if "b" in mode else mode + "t")
     return open(path, mode)
 
 

@@ -765,6 +765,13 @@ struct Predictor {
       for (int i = 0; i < axis; ++i) pre *= x.shape[i];
       for (size_t i = axis; i < axis + y.shape.size() && i < x.shape.size(); ++i)
         mid *= x.shape[i];
+      if (pre * mid == 0) {  // zero-sized dim: grads are zero, and the
+        Tensor& yg = out(op, "Y@GRAD");  // division below would SIGFPE
+        yg.shape = y.shape;
+        yg.is_int = false;
+        yg.f.assign(ny, 0.0f);
+        return true;
+      }
       int64_t post = static_cast<int64_t>(og.f.size()) / (pre * mid);
       if (mid != ny) { err = "elementwise_add_grad: shape mismatch"; return false; }
       Tensor& yg = out(op, "Y@GRAD");
@@ -984,6 +991,7 @@ int main(int argc, char** argv) {
       return 1;
     }
     p.vars[name] = std::move(t);
+    p.fed[name] = true;  // run()'s stale-var sweep keeps only fed+persistable
   }
   if (!p.run()) {
     fprintf(stderr, "run: %s\n", p.err.c_str());

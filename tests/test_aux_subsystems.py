@@ -35,6 +35,30 @@ def test_io_fs_local_roundtrip(tmp_path):
     assert not fs.fs_exists(d)
     assert fs.file_shard(["a", "b", "c", "d"], 0, 2) == ["a", "c"]
 
+    # fs.cc surface extensions: tail / file_size / .gz converters /
+    # hdfs command override (reference: fs.cc fs_tail, fs_file_size,
+    # converters, hdfs_set_command)
+    d2 = str(tmp_path / "y")
+    fs.fs_mkdir(d2)
+    p = os.path.join(d2, "log.txt")
+    with fs.open_write(p) as f:
+        f.write("first\nsecond\nlast\n")
+    assert fs.fs_tail(p) == "last"
+    assert fs.fs_file_size(p) == len("first\nsecond\nlast\n")
+    gz = os.path.join(d2, "c.txt.gz")
+    with fs.open_write(gz) as f:
+        f.write("compressed body")
+    with fs.open_read(gz) as f:
+        assert f.read() == "compressed body"
+    assert fs.fs_file_size(gz) == os.path.getsize(gz)
+    fs.set_hdfs_command("hadoop fs -Dfs.default.name=x")
+    assert fs._HDFS_COMMAND[-1] == "-Dfs.default.name=x"
+    fs.set_hdfs_command("hadoop fs")
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        fs.set_hdfs_command("")
+
 
 def test_data_generator_multislot_roundtrip():
     from paddle_tpu import native
