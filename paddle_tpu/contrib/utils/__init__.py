@@ -37,7 +37,10 @@ class HDFSClient:
             dst.write(src.read())
 
     def download(self, hdfs_path, local_path, overwrite=False, retry_times=5):
-        with self._fs.open_read(hdfs_path, "rb") as src,                 open(local_path, "wb") as dst:
+        # raw bytes (the reference downloads via -get): the .gz read
+        # converter must NOT decompress into a .gz-named local copy
+        with self._fs.open_read(hdfs_path, "rb", raw=True) as src, \
+                open(local_path, "wb") as dst:
             dst.write(src.read())
 
     def ls(self, path):

@@ -118,8 +118,23 @@ def fs_file_size(path: str) -> int:
 
 def fs_tail(path: str) -> str:
     """Last line of the file (reference: fs.cc fs_tail — hdfs pipes
-    ``-text path | tail -1``; here the stream is read incrementally so
-    only one line is held)."""
+    ``-text path | tail -1``).  Plain local files seek from the end
+    (milliseconds on multi-GB logs); hdfs/gz streams read incrementally
+    holding one line."""
+    if not _is_hdfs(path) and not path.endswith(".gz"):
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            chunk = b""
+            pos = size
+            while pos > 0:
+                step = min(65536, pos)
+                pos -= step
+                f.seek(pos)
+                chunk = f.read(step) + chunk
+                stripped = chunk.rstrip(b"\n")
+                if b"\n" in stripped:
+                    return stripped[stripped.rindex(b"\n") + 1:].decode()
+            return chunk.rstrip(b"\n").decode()
     last = b""
     with open_read(path, "rb") as f:
         for line in f:
@@ -133,10 +148,12 @@ class _ProcStream:
     and surfaces a nonzero exit status (an empty stream must not be
     mistaken for an empty file; a failed write must not look flushed)."""
 
-    def __init__(self, proc: subprocess.Popen, stream, what: str):
+    def __init__(self, proc: subprocess.Popen, stream, what: str,
+                 reader: bool = False):
         self._proc = proc
         self._stream = stream
         self._what = what
+        self._reader = reader
 
     def __getattr__(self, name):
         return getattr(self._stream, name)
@@ -162,23 +179,29 @@ class _ProcStream:
         except (BrokenPipeError, OSError) as e:
             flush_err = e
         rc = self._proc.wait()
-        if rc != 0:
+        # a READ stream closed before EOF kills the producer with
+        # SIGPIPE (rc -13 / 141) — that's a normal partial read of a
+        # large file, not a failure; writers still report every nonzero
+        if rc != 0 and not (self._reader and rc in (-13, 141)):
             raise RuntimeError("%s exited with status %d" % (self._what, rc))
         if flush_err is not None:
             raise flush_err
 
 
-def open_read(path: str, mode: str = "r") -> IO:
+def open_read(path: str, mode: str = "r", raw: bool = False) -> IO:
     """reference: fs.cc fs_open_read — ``.gz`` paths decompress on the
-    way in (hdfs ``-text``; locally gzip)."""
+    way in (hdfs ``-text``; locally gzip).  ``raw=True`` bypasses the
+    converter and returns the stored bytes verbatim (the ``-get``
+    semantics a byte-for-byte download needs — decompressing into a
+    ``.gz``-named local copy would corrupt it)."""
     if _is_hdfs(path):
         import io as _iomod
 
-        op = "-text" if path.endswith(".gz") else "-cat"
+        op = "-text" if (path.endswith(".gz") and not raw) else "-cat"
         proc = subprocess.Popen(_hdfs_argv(op, path), stdout=subprocess.PIPE)
         stream = proc.stdout if "b" in mode else _iomod.TextIOWrapper(proc.stdout)
-        return _ProcStream(proc, stream, "hadoop fs %s" % op)
-    if path.endswith(".gz"):
+        return _ProcStream(proc, stream, "hadoop fs %s" % op, reader=True)
+    if path.endswith(".gz") and not raw:
         return _gzip.open(path, mode if "b" in mode else mode + "t")
     return open(path, mode)
 

@@ -765,6 +765,9 @@ struct Predictor {
       for (int i = 0; i < axis; ++i) pre *= x.shape[i];
       for (size_t i = axis; i < axis + y.shape.size() && i < x.shape.size(); ++i)
         mid *= x.shape[i];
+      // shape consistency FIRST so a malformed program errors loudly
+      // even when a zero-sized dim would otherwise take the early-out
+      if (mid != ny) { err = "elementwise_add_grad: shape mismatch"; return false; }
       if (pre * mid == 0) {  // zero-sized dim: grads are zero, and the
         Tensor& yg = out(op, "Y@GRAD");  // division below would SIGFPE
         yg.shape = y.shape;
@@ -773,7 +776,6 @@ struct Predictor {
         return true;
       }
       int64_t post = static_cast<int64_t>(og.f.size()) / (pre * mid);
-      if (mid != ny) { err = "elementwise_add_grad: shape mismatch"; return false; }
       Tensor& yg = out(op, "Y@GRAD");
       yg.shape = y.shape;
       yg.is_int = false;

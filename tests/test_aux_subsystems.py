@@ -51,13 +51,18 @@ def test_io_fs_local_roundtrip(tmp_path):
     with fs.open_read(gz) as f:
         assert f.read() == "compressed body"
     assert fs.fs_file_size(gz) == os.path.getsize(gz)
-    fs.set_hdfs_command("hadoop fs -Dfs.default.name=x")
-    assert fs._HDFS_COMMAND[-1] == "-Dfs.default.name=x"
-    fs.set_hdfs_command("hadoop fs")
     import pytest as _pytest
 
-    with _pytest.raises(ValueError):
-        fs.set_hdfs_command("")
+    try:
+        fs.set_hdfs_command("hadoop fs -Dfs.default.name=x")
+        assert fs._HDFS_COMMAND[-1] == "-Dfs.default.name=x"
+        with _pytest.raises(ValueError):
+            fs.set_hdfs_command("")
+    finally:
+        fs.set_hdfs_command("hadoop fs")
+    # raw=True bypasses the .gz converter (byte-for-byte download path)
+    with fs.open_read(gz, "rb", raw=True) as f:
+        assert f.read() == open(gz, "rb").read()
 
 
 def test_data_generator_multislot_roundtrip():

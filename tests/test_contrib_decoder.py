@@ -258,6 +258,86 @@ def test_beam_search_decoder_decodes_trained_model():
     assert (tscores[:, 0] >= gscores - 1e-5).all()
 
 
+def test_beam_search_decoder_input_var_dict():
+    """Per-source inputs declared via input_var_dict ride the beam lanes
+    (the reference's read_array + sequence_expand of non-id inputs,
+    beam_search_decoder.py:677): a decode whose state update consumes a
+    per-source feature must run and differ from a decode without it."""
+    def build(with_feat):
+        prog, startup = framework.Program(), framework.Program()
+        prog.random_seed = startup.random_seed = 93
+        with framework.program_guard(prog, startup):
+            ctx = fluid.layers.data("ctx", [H])
+            feat = fluid.layers.data("feat", [H])
+            init_ids = fluid.layers.data("init_ids", [1], dtype="int64")
+            init_scores = fluid.layers.data("init_scores", [1])
+            inputs = {"x": None}
+            if with_feat:
+                inputs["feat"] = None
+            cell = StateCell(inputs=inputs,
+                             states={"h": InitState(init=ctx)},
+                             out_state="h")
+
+            @cell.state_updater
+            def updater(sc):
+                parts = [sc.get_state("h"), sc.get_input("x")]
+                attrs = [_named("ivh_w"), _named("ivx_w")]
+                if with_feat:
+                    parts.append(sc.get_input("feat"))
+                    attrs.append(_named("ivf_w"))
+                sc.set_state("h", fluid.layers.fc(
+                    parts, size=H, act="tanh",
+                    param_attr=attrs, bias_attr=_named("ivb")))
+
+            dec = BeamSearchDecoder(
+                cell, init_ids, init_scores, target_dict_dim=V, word_dim=D,
+                input_var_dict={"feat": feat} if with_feat else {},
+                topk_size=V, max_len=4, beam_size=2, end_id=END_ID,
+                emb_param_attr=_named("ive"), score_param_attr=_named("ivs_w"),
+                score_bias_attr=_named("ivs_b"), batch_size=B,
+            )
+            dec.decode()
+            tid, tsc = dec()
+        return prog, startup, tid, tsc
+
+    rng = np.random.RandomState(8)
+    ctxv = rng.uniform(-1, 1, (B, H)).astype("float32")
+    featv = rng.uniform(-1, 1, (B, H)).astype("float32")
+    iid, isc = BeamSearchDecoder.seed_init_feeds(B, 2, START_ID)
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    outs = {}
+    for with_feat in (False, True):
+        prog, startup, tid, tsc = build(with_feat)
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            ids, scs = exe.run(
+                prog,
+                feed={"ctx": ctxv, "feat": featv, "init_ids": iid,
+                      "init_scores": isc},
+                fetch_list=[tid, tsc])
+        outs[with_feat] = (np.asarray(ids), np.asarray(scs))
+    assert outs[True][0].shape == (B, 2, 5)
+    assert np.isfinite(outs[True][1]).all()
+    # the feature input actually participates: scores differ
+    assert not np.allclose(outs[True][1], outs[False][1])
+
+    # an input_var_dict name not declared in the StateCell is loud
+    with pytest.raises(ValueError, match="not found in StateCell"):
+        prog, startup = framework.Program(), framework.Program()
+        with framework.program_guard(prog, startup):
+            ctx = fluid.layers.data("ctx", [H])
+            feat = fluid.layers.data("feat", [H])
+            iidv = fluid.layers.data("init_ids", [1], dtype="int64")
+            iscv = fluid.layers.data("init_scores", [1])
+            cell = _make_state_cell(ctx)
+            dec = BeamSearchDecoder(
+                cell, iidv, iscv, target_dict_dim=V, word_dim=D,
+                input_var_dict={"not_an_input": feat},
+                max_len=3, beam_size=2, end_id=END_ID, batch_size=B)
+            dec.decode()
+
+
 def test_state_cell_validation():
     prog, startup = framework.Program(), framework.Program()
     with framework.program_guard(prog, startup):
