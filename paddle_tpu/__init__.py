@@ -46,7 +46,11 @@ from paddle_tpu import parallel
 from paddle_tpu import dygraph
 from paddle_tpu import distributed
 from paddle_tpu import transpiler
-from paddle_tpu.transpiler import DistributeTranspiler, DistributeTranspilerConfig
+from paddle_tpu.transpiler import (
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+    InferenceTranspiler,
+)
 from paddle_tpu import contrib
 from paddle_tpu import inference
 from paddle_tpu import native

@@ -131,10 +131,12 @@ def fs_tail(path: str) -> str:
                 pos -= step
                 f.seek(pos)
                 chunk = f.read(step) + chunk
-                stripped = chunk.rstrip(b"\n")
-                if b"\n" in stripped:
-                    return stripped[stripped.rindex(b"\n") + 1:].decode()
-            return chunk.rstrip(b"\n").decode()
+                # same semantics as the streaming branch: the last
+                # NON-blank line (whitespace-only tails are skipped)
+                lines = [ln for ln in chunk.split(b"\n") if ln.strip()]
+                if len(lines) > 1 or (lines and pos == 0):
+                    return lines[-1].decode().rstrip("\n")
+            return ""
     last = b""
     with open_read(path, "rb") as f:
         for line in f:

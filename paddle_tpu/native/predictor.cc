@@ -429,6 +429,8 @@ struct Predictor {
     if (type == "dequantize_abs_max") return op_dequant(op);
     if (type == "fake_quantize_dequantize_abs_max") return op_fake_quant(op);
     if (type == "cast") return op_cast(op);
+    if (type == "conv2d") return op_conv2d(op);
+    if (type == "pool2d") return op_pool2d(op);
     // training subset (the pure-C++ train demo analog, demo_trainer.cc)
     if (type == "fill_constant") return op_fill_constant(op);
     if (type == "mean") return op_mean(op);
@@ -440,9 +442,10 @@ struct Predictor {
     if (type == "mul_grad") return op_mul_grad(op);
     if (type == "sgd") return op_sgd(op);
     err = "native predictor: unsupported op '" + type +
-          "' (supported: mul, elementwise_{add,sub,mul,div}, relu, tanh, "
-          "sigmoid, exp, sqrt, softmax, scale, reshape2, dropout[is_test], "
-          "batch_norm[is_test], lookup_table, dequantize_abs_max, cast, "
+          "' (supported: mul, conv2d, pool2d, elementwise_{add,sub,mul,div}, "
+          "relu, tanh, sigmoid, exp, sqrt, softmax, scale, reshape2, "
+          "dropout[is_test], batch_norm[is_test], lookup_table, "
+          "dequantize_abs_max, cast, "
           "and the train set fill_constant/mean/square_error_cost/"
           "{mean,square_error_cost,relu,elementwise_add,mul}_grad/sgd; "
           "use the Python AnalysisPredictor for the full op set)";
@@ -655,6 +658,144 @@ struct Predictor {
     o.is_int = false;
     o.f.resize(x.f.size());
     for (size_t i = 0; i < x.f.size(); ++i) o.f[i] = x.f[i] * mul;
+    return true;
+  }
+
+  static int64_t attr_pair(const Json& op, const char* key, int idx,
+                           int64_t dflt) {
+    const Json* a = op.get("attrs");
+    const Json* v = a ? a->get(key) : nullptr;
+    if (!v) return dflt;
+    if (v->kind == Json::kArr)
+      return idx < static_cast<int>(v->arr.size()) ? v->arr[idx].as_int()
+                                                   : dflt;
+    return static_cast<int64_t>(v->num);
+  }
+
+  // NCHW direct convolution (inference serving sizes; groups=1,
+  // dilation=1 — InferenceTranspiler folds BN so conv+bias+act covers
+  // the exported CNN graphs)
+  bool data_format_is_nchw(const Json& op, const char* what) {
+    const Json* a = op.get("attrs");
+    const Json* v = a ? a->get("data_format") : nullptr;
+    if (v && v->kind == Json::kStr && v->str != "NCHW" && v->str != "AnyLayout") {
+      err = std::string(what) + ": only NCHW supported natively (got " +
+            v->str + ")";
+      return false;
+    }
+    return true;
+  }
+
+  bool op_conv2d(const Json& op) {
+    const Tensor& x = in(op, "Input");
+    const Tensor& w = in(op, "Filter");  // OIHW
+    if (!data_format_is_nchw(op, "conv2d")) return false;
+    if (attr_num(op, "groups", 1) != 1) {
+      err = "conv2d: only groups=1 supported natively";
+      return false;
+    }
+    int64_t dil_h = attr_pair(op, "dilations", 0, 1);
+    int64_t dil_w = attr_pair(op, "dilations", 1, 1);
+    if (dil_h != 1 || dil_w != 1) {
+      err = "conv2d: only dilation=1 supported natively";
+      return false;
+    }
+    int64_t n = x.shape[0], ci = x.shape[1], h = x.shape[2], wd = x.shape[3];
+    int64_t co = w.shape[0], kh = w.shape[2], kw = w.shape[3];
+    if (w.shape[1] != ci) { err = "conv2d: channel mismatch"; return false; }
+    int64_t sh = attr_pair(op, "strides", 0, 1);
+    int64_t sw = attr_pair(op, "strides", 1, 1);
+    int64_t ph = attr_pair(op, "paddings", 0, 0);
+    int64_t pw = attr_pair(op, "paddings", 1, 0);
+    int64_t oh = (h + 2 * ph - kh) / sh + 1;
+    int64_t ow = (wd + 2 * pw - kw) / sw + 1;
+    if (oh <= 0 || ow <= 0) {
+      err = "conv2d: kernel exceeds padded input (output dims <= 0)";
+      return false;
+    }
+    Tensor& o = out(op, "Output");
+    o.shape = {n, co, oh, ow};
+    o.is_int = false;
+    o.f.assign(n * co * oh * ow, 0.0f);
+    for (int64_t b = 0; b < n; ++b)
+      for (int64_t oc = 0; oc < co; ++oc)
+        for (int64_t ic = 0; ic < ci; ++ic) {
+          const float* wk = &w.f[((oc * ci) + ic) * kh * kw];
+          const float* xi = &x.f[(b * ci + ic) * h * wd];
+          float* oo = &o.f[(b * co + oc) * oh * ow];
+          for (int64_t yy = 0; yy < oh; ++yy)
+            for (int64_t xx = 0; xx < ow; ++xx) {
+              float acc = 0;
+              for (int64_t ky = 0; ky < kh; ++ky) {
+                int64_t iy = yy * sh - ph + ky;
+                if (iy < 0 || iy >= h) continue;
+                for (int64_t kx = 0; kx < kw; ++kx) {
+                  int64_t ix = xx * sw - pw + kx;
+                  if (ix < 0 || ix >= wd) continue;
+                  acc += xi[iy * wd + ix] * wk[ky * kw + kx];
+                }
+              }
+              oo[yy * ow + xx] += acc;
+            }
+        }
+    return true;
+  }
+
+  bool op_pool2d(const Json& op) {
+    const Tensor& x = in(op, "X");
+    if (!data_format_is_nchw(op, "pool2d")) return false;
+    std::string ptype = "max";
+    const Json* a = op.get("attrs");
+    const Json* pt = a ? a->get("pooling_type") : nullptr;
+    if (pt && pt->kind == Json::kStr) ptype = pt->str;
+    bool global = attr_num(op, "global_pooling", 0.0) != 0.0;
+    bool exclusive = attr_num(op, "exclusive", 1.0) != 0.0;
+    int64_t n = x.shape[0], c = x.shape[1], h = x.shape[2], wd = x.shape[3];
+    int64_t kh = global ? h : attr_pair(op, "ksize", 0, 2);
+    int64_t kw = global ? wd : attr_pair(op, "ksize", 1, 2);
+    int64_t sh = global ? 1 : attr_pair(op, "strides", 0, kh);
+    int64_t sw = global ? 1 : attr_pair(op, "strides", 1, kw);
+    int64_t ph = global ? 0 : attr_pair(op, "paddings", 0, 0);
+    int64_t pw = global ? 0 : attr_pair(op, "paddings", 1, 0);
+    int64_t oh = (h + 2 * ph - kh) / sh + 1;
+    int64_t ow = (wd + 2 * pw - kw) / sw + 1;
+    if (oh <= 0 || ow <= 0) {
+      err = "pool2d: kernel exceeds padded input (output dims <= 0)";
+      return false;
+    }
+    Tensor& o = out(op, "Out");
+    o.shape = {n, c, oh, ow};
+    o.is_int = false;
+    o.f.assign(n * c * oh * ow, 0.0f);
+    for (int64_t b = 0; b < n; ++b)
+      for (int64_t ch = 0; ch < c; ++ch) {
+        const float* xi = &x.f[(b * c + ch) * h * wd];
+        float* oo = &o.f[(b * c + ch) * oh * ow];
+        for (int64_t yy = 0; yy < oh; ++yy)
+          for (int64_t xx = 0; xx < ow; ++xx) {
+            float best = -3.4e38f;
+            double sum = 0;
+            int64_t cnt = 0;
+            for (int64_t ky = 0; ky < kh; ++ky) {
+              int64_t iy = yy * sh - ph + ky;
+              if (iy < 0 || iy >= h) continue;
+              for (int64_t kx = 0; kx < kw; ++kx) {
+                int64_t ix = xx * sw - pw + kx;
+                if (ix < 0 || ix >= wd) continue;
+                float v = xi[iy * wd + ix];
+                best = std::max(best, v);
+                sum += v;
+                ++cnt;
+              }
+            }
+            oo[yy * ow + xx] =
+                ptype == "max"
+                    ? best
+                    : static_cast<float>(
+                          sum / (exclusive ? std::max<int64_t>(cnt, 1)
+                                           : kh * kw));
+          }
+      }
     return true;
   }
 

@@ -21,6 +21,7 @@ __all__ = [
     "DistributeTranspiler",
     "DistributeTranspilerConfig",
     "GradAllReduce",
+    "InferenceTranspiler",
     "LocalSGD",
     "memory_optimize",
     "release_memory",
@@ -165,6 +166,140 @@ class DistributeTranspiler:
         # deterministic broadcast in executor.py _run_dense_ps), so the
         # pserver startup is empty on this build
         return framework.Program()
+
+
+class InferenceTranspiler:
+    """reference: transpiler/inference_transpiler.py:25 — fold batch
+    normalization into the preceding convolution for inference.
+
+    For every ``conv2d`` whose output feeds exactly one ``batch_norm``
+    (is_test), the BN affine transform is folded into the conv filter
+    (per-output-channel scale) and a bias (new or merged into an
+    existing channel bias), and the batch_norm op is removed.  On the
+    XLA path this is a no-op perf-wise (the compiler fuses), but it
+    halves the op count of exported models and lets the native C++
+    predictor (native/predictor.cc) serve conv nets without a BN kernel
+    in the hot loop.  Clone the program (``for_test=True``) before
+    transpiling — weights in the scope are rewritten in place.
+    """
+
+    def transpile(self, program, place=None, scope=None) -> int:
+        import numpy as np
+
+        from paddle_tpu import unique_name
+        from paddle_tpu.scope import global_scope
+
+        scope = scope or global_scope()
+        block = program.global_block()
+        # reader counts over EVERY block (a While/cond sub-block reading
+        # the conv output still needs the raw pre-BN values); only
+        # single-consumer chains are fused
+        readers: dict = {}
+        for blk in program.blocks:
+            for op in blk.ops:
+                for n in op.input_arg_names:
+                    readers[n] = readers.get(n, 0) + 1
+
+        def fold_pair(conv_op, bias_op, bn_op, bn_idx):
+            """Fold bn (and the optional existing channel-bias add)
+            into the conv filter; returns the replacement op spec."""
+            w_name = conv_op.inputs["Filter"][0]
+            scale_n, bias_n, mean_n, var_n = (
+                bn_op.inputs["Scale"][0], bn_op.inputs["Bias"][0],
+                bn_op.inputs["Mean"][0], bn_op.inputs["Variance"][0],
+            )
+            names = [w_name, scale_n, bias_n, mean_n, var_n]
+            if bias_op is not None:
+                names.append(bias_op.inputs["Y"][0])
+            vals = {n: scope.get(n) for n in names}
+            missing = [n for n, v in vals.items() if v is None]
+            if missing:
+                raise RuntimeError(
+                    "InferenceTranspiler: vars %s not initialized in "
+                    "scope — run startup / load params first" % missing
+                )
+            w = np.asarray(vals[w_name])            # OIHW
+            eps = float(bn_op.attrs.get("epsilon", 1e-5))
+            alpha = np.asarray(vals[scale_n]) / np.sqrt(
+                np.asarray(vals[var_n]) + eps
+            )                                        # [C_out]
+            scope.set(
+                w_name, (w * alpha[:, None, None, None]).astype(w.dtype)
+            )
+            if bias_op is not None:
+                # BN(conv + b) = alpha*conv + (alpha*(b - mean) + bnbias):
+                # merge into the EXISTING channel bias
+                b_name = bias_op.inputs["Y"][0]
+                b = np.asarray(vals[b_name]).reshape(-1)
+                beta = (
+                    alpha * (b - np.asarray(vals[mean_n]))
+                    + np.asarray(vals[bias_n])
+                )
+                scope.set(b_name, beta.astype(np.float32))
+                # the add now directly produces the BN output name
+                bias_op.outputs["Out"] = [bn_op.outputs["Y"][0]]
+                block._remove_op(bn_idx)
+                return
+            beta = np.asarray(vals[bias_n]) - np.asarray(vals[mean_n]) * alpha
+            bn_y = bn_op.outputs["Y"][0]
+            fused_bias = unique_name.generate(w_name + ".bn_fold_bias")
+            block.create_var(
+                name=fused_bias, shape=[int(alpha.shape[0])],
+                dtype="float32", persistable=True, stop_gradient=True,
+            )
+            scope.set(fused_bias, beta.astype(np.float32))
+            block._remove_op(bn_idx)
+            block._insert_op(
+                bn_idx,
+                type="elementwise_add",
+                inputs={"X": [conv_op.outputs["Output"][0]],
+                        "Y": [fused_bias]},
+                outputs={"Out": [bn_y]},
+                attrs={"axis": 1},
+            )
+
+        def is_channel_bias_add(op, src_name):
+            return (
+                op.type == "elementwise_add"
+                and op.inputs.get("X", [None])[0] == src_name
+                and op.attrs.get("axis") == 1
+                and len(op.inputs.get("Y", [])) == 1
+            )
+
+        i = 0
+        fused = 0
+        while i < len(block.ops) - 1:
+            op = block.ops[i]
+            if not (op.type == "conv2d"
+                    and op.attrs.get("data_format", "NCHW") == "NCHW"):
+                i += 1
+                continue
+            conv_out = op.outputs["Output"][0]
+            nxt = block.ops[i + 1]
+            bias_op = None
+            bn_idx = i + 1
+            if (is_channel_bias_add(nxt, conv_out)
+                    and readers.get(conv_out, 0) == 1
+                    and i + 2 < len(block.ops)):
+                bias_op = nxt
+                bn_idx = i + 2
+            bn_op = block.ops[bn_idx] if bn_idx < len(block.ops) else None
+            chain_in = (bias_op.outputs["Out"][0] if bias_op is not None
+                        else conv_out)
+            if not (
+                bn_op is not None
+                and bn_op.type == "batch_norm"
+                and bn_op.attrs.get("is_test")
+                and bn_op.inputs.get("X", [None])[0] == chain_in
+                and readers.get(chain_in, 0) == 1
+            ):
+                i += 1
+                continue
+            fold_pair(op, bias_op, bn_op, bn_idx)
+            fused += 1
+            i = bn_idx  # continue after the (now replaced) bn position
+        program.version += 1
+        return fused
 
 
 def memory_optimize(input_program=None, skip_opt_set=None, print_log=False, level=0, skip_grads=False):

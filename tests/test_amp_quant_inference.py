@@ -210,6 +210,115 @@ def test_quantize_transpiler_freeze_surface():
                for op in frozen.global_block().ops)
 
 
+def test_inference_transpiler_folds_conv_bn(tmp_path):
+    """InferenceTranspiler (reference: inference_transpiler.py:25) folds
+    batch_norm into the preceding conv: the batch_norm op disappears,
+    outputs match the unfused program, and the exported model serves
+    through the native C++ predictor (which has no BN in its op set for
+    the conv path)."""
+    def build():
+        prog, startup = framework.Program(), framework.Program()
+        prog.random_seed = startup.random_seed = 51
+        with framework.program_guard(prog, startup):
+            img = fluid.layers.data("img", [3, 8, 8])
+            y = fluid.layers.data("y", [1], dtype="int64")
+            c = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                                    padding=1, bias_attr=False)
+            c = fluid.layers.batch_norm(c)
+            c = fluid.layers.relu(c)
+            c = fluid.layers.pool2d(c, pool_size=2, pool_stride=2,
+                                    pool_type="max")
+            flat = fluid.layers.reshape(c, shape=[-1, 4 * 4 * 4])
+            pred = fluid.layers.fc(flat, 3, act="softmax")
+            loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+            fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+        return prog, startup, loss, pred
+
+    prog, startup, loss, pred = build()
+    rng = np.random.RandomState(9)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    xb = rng.uniform(-1, 1, (2, 3, 8, 8)).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(4):  # train so BN stats move off their init
+            exe.run(prog, feed={
+                "img": rng.uniform(-1, 1, (8, 3, 8, 8)).astype("float32"),
+                "y": rng.randint(0, 3, (8, 1)).astype("int64"),
+            }, fetch_list=[loss])
+        test_prog = prog.clone(for_test=True)
+        (want,) = exe.run(
+            test_prog, feed={"img": xb, "y": np.zeros((2, 1), "int64")},
+            fetch_list=[pred])
+
+        fused_prog = prog.clone(for_test=True)
+        t = fluid.InferenceTranspiler()
+        n = t.transpile(fused_prog, fluid.CPUPlace(), scope)
+        assert n == 1
+        types = [op.type for op in fused_prog.global_block().ops]
+        assert "batch_norm" not in types
+        (got,) = exe.run(
+            fused_prog, feed={"img": xb, "y": np.zeros((2, 1), "int64")},
+            fetch_list=[pred])
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
+
+        fluid.save_inference_model(
+            str(tmp_path / "cv"), ["img"], [pred], exe, fused_prog)
+
+    # the fused export serves through the native C++ predictor
+    # (conv2d + pool2d + the folded bias add; no BN kernel needed)
+    from paddle_tpu.native import NativePredictor, _predictor_lib
+
+    if _predictor_lib() is not None:
+        (ng,) = NativePredictor(str(tmp_path / "cv")).run({"img": xb})
+        np.testing.assert_allclose(
+            ng, np.asarray(want), rtol=1e-4, atol=1e-5)
+
+
+def test_inference_transpiler_folds_conv_with_bias():
+    """conv2d WITH a channel bias emits conv -> elementwise_add -> bn;
+    the fold merges BN into the EXISTING bias (reference:
+    inference_transpiler.py fuse_batch_norm with bias) — review r5."""
+    def build():
+        prog, startup = framework.Program(), framework.Program()
+        prog.random_seed = startup.random_seed = 52
+        with framework.program_guard(prog, startup):
+            img = fluid.layers.data("img", [2, 6, 6])
+            c = fluid.layers.conv2d(img, num_filters=3, filter_size=3,
+                                    padding=1)  # default bias_attr: ON
+            c = fluid.layers.batch_norm(c)
+            out = fluid.layers.relu(c)
+        return prog, startup, out
+
+    prog, startup, out = build()
+    rng = np.random.RandomState(12)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    xb = rng.uniform(-1, 1, (2, 2, 6, 6)).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # nudge BN stats + bias off init so the fold is non-trivial
+        for p in prog.all_parameters():
+            v = np.asarray(scope.get(p.name))
+            scope.set(p.name, v + rng.uniform(0.01, 0.1, v.shape)
+                      .astype(v.dtype))
+        test_prog = prog.clone(for_test=True)
+        (want,) = exe.run(test_prog, feed={"img": xb}, fetch_list=[out])
+
+        fused = prog.clone(for_test=True)
+        n = fluid.InferenceTranspiler().transpile(fused, fluid.CPUPlace(),
+                                                  scope)
+        assert n == 1
+        types = [op.type for op in fused.global_block().ops]
+        assert "batch_norm" not in types
+        # no NEW bias var: the existing one was merged in place
+        assert types.count("elementwise_add") == 1
+        (got,) = exe.run(fused, feed={"img": xb}, fetch_list=[out])
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
+
+
 def test_analysis_predictor_roundtrip(tmp_path):
     prog, startup, loss, pred = _mlp_program(seed=23)
     scope = fluid.Scope()
