@@ -250,6 +250,8 @@ def transformer_lm(
             x, d_model, n_head, d_inner, causal, dropout_rate, is_test, name="%s_dec_%d" % (name, i)
         )
     logits = _fc3(x, vocab_size, name + "_head")
+    if labels is None:  # inference/decoding program: logits only
+        return None, logits
     loss = layers.softmax_with_cross_entropy(logits, labels)
     avg_loss = layers.mean(loss)
     return avg_loss, logits

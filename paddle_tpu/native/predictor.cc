@@ -707,12 +707,16 @@ struct Predictor {
     int64_t sw = attr_pair(op, "strides", 1, 1);
     int64_t ph = attr_pair(op, "paddings", 0, 0);
     int64_t pw = attr_pair(op, "paddings", 1, 0);
-    int64_t oh = (h + 2 * ph - kh) / sh + 1;
-    int64_t ow = (wd + 2 * pw - kw) / sw + 1;
-    if (oh <= 0 || ow <= 0) {
-      err = "conv2d: kernel exceeds padded input (output dims <= 0)";
+    // check the numerators BEFORE dividing: C++ integer division
+    // truncates toward zero, so (-1)/2 + 1 == 1 would dodge an
+    // output-dim guard and silently emit partial-window results
+    int64_t num_h = h + 2 * ph - kh, num_w = wd + 2 * pw - kw;
+    if (num_h < 0 || num_w < 0) {
+      err = "conv2d: kernel exceeds padded input";
       return false;
     }
+    int64_t oh = num_h / sh + 1;
+    int64_t ow = num_w / sw + 1;
     Tensor& o = out(op, "Output");
     o.shape = {n, co, oh, ow};
     o.is_int = false;
@@ -757,12 +761,16 @@ struct Predictor {
     int64_t sw = global ? 1 : attr_pair(op, "strides", 1, kw);
     int64_t ph = global ? 0 : attr_pair(op, "paddings", 0, 0);
     int64_t pw = global ? 0 : attr_pair(op, "paddings", 1, 0);
-    int64_t oh = (h + 2 * ph - kh) / sh + 1;
-    int64_t ow = (wd + 2 * pw - kw) / sw + 1;
-    if (oh <= 0 || ow <= 0) {
-      err = "pool2d: kernel exceeds padded input (output dims <= 0)";
+    bool ceil_mode = attr_num(op, "ceil_mode", 0.0) != 0.0;
+    int64_t num_h = h + 2 * ph - kh, num_w = wd + 2 * pw - kw;
+    if (num_h < 0 || num_w < 0) {  // numerator check: see op_conv2d
+      err = "pool2d: kernel exceeds padded input";
       return false;
     }
+    // ceil_mode rounds partial windows IN (reference pool_op.h
+    // PoolOutputSize); the tap loops below already clamp to the input
+    int64_t oh = (ceil_mode ? (num_h + sh - 1) / sh : num_h / sh) + 1;
+    int64_t ow = (ceil_mode ? (num_w + sw - 1) / sw : num_w / sw) + 1;
     Tensor& o = out(op, "Out");
     o.shape = {n, c, oh, ow};
     o.is_int = false;

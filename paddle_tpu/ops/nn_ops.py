@@ -172,20 +172,33 @@ def pool2d(inputs, attrs):
         if ptype == "max":
             return {"Out": jnp.max(x, axis=sp, keepdims=True)}
         return {"Out": jnp.mean(x, axis=sp, keepdims=True)}
+    # ceil_mode rounds partial windows IN (reference pool_op.h
+    # PoolOutputSize with ceil): realized as extra high-side padding so
+    # reduce_window emits the ceil-count windows; avg-exclusive counts
+    # only real cells either way (padding contributes zeros)
+    extra = [0, 0]
+    if attrs.get("ceil_mode", False):
+        hw = (x.shape[2], x.shape[3]) if fmt == "NCHW" else (x.shape[1], x.shape[2])
+        for d in range(2):
+            num = hw[d] + 2 * pads[d] - ksize[d]
+            o_ceil = -(-num // strides[d]) + 1
+            extra[d] = (o_ceil - 1) * strides[d] + ksize[d] - hw[d] - 2 * pads[d]
     if fmt == "NCHW":
         window = (1, 1) + ksize
         strides4 = (1, 1) + strides
-        padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+        padding = ((0, 0), (0, 0), (pads[0], pads[0] + extra[0]),
+                   (pads[1], pads[1] + extra[1]))
     else:
         window = (1,) + ksize + (1,)
         strides4 = (1,) + strides + (1,)
-        padding = ((0, 0), (pads[0], pads[0]), (pads[1], pads[1]), (0, 0))
+        padding = ((0, 0), (pads[0], pads[0] + extra[0]),
+                   (pads[1], pads[1] + extra[1]), (0, 0))
     if ptype == "max":
         init = -jnp.inf
         out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides4, padding)
     else:
         summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides4, padding)
-        if attrs.get("exclusive", True) and (pads[0] or pads[1]):
+        if attrs.get("exclusive", True) and (pads[0] or pads[1] or extra[0] or extra[1]):
             ones = jnp.ones_like(x)
             counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides4, padding)
             out = summed / counts

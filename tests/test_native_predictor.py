@@ -164,6 +164,38 @@ def test_native_predictor_lookup_padding_idx(tmp_path):
     assert np.all(got[0, 1] == 0) and np.all(got[0, 3] == 0)
 
 
+def test_pool2d_ceil_mode_python_and_native_parity(tmp_path):
+    """ceil_mode pools round partial windows IN (reference pool_op.h):
+    the Python/XLA kernel and the native C++ kernel agree on shape and
+    values, max and avg (review r5)."""
+    prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [2, 5, 5])
+        mx = fluid.layers.pool2d(x, pool_size=2, pool_stride=2,
+                                 pool_type="max", ceil_mode=True)
+        av = fluid.layers.pool2d(x, pool_size=2, pool_stride=2,
+                                 pool_type="avg", ceil_mode=True)
+    rng = np.random.RandomState(3)
+    xb = rng.uniform(-1, 1, (2, 2, 5, 5)).astype("float32")
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        got_m, got_a = exe.run(prog, feed={"x": xb}, fetch_list=[mx, av])
+        fluid.save_inference_model(str(tmp_path / "p"), ["x"], [mx, av],
+                                   exe, prog)
+    got_m, got_a = np.asarray(got_m), np.asarray(got_a)
+    assert got_m.shape == (2, 2, 3, 3)  # ceil((5-2)/2)+1 = 3, not 2
+    # manual expectation: last window covers only column/row 4
+    assert np.allclose(got_m[:, :, 2, 2], xb[:, :, 4, 4])
+    assert np.allclose(got_a[:, :, 2, 2], xb[:, :, 4, 4])  # exclusive avg
+    assert np.allclose(
+        got_a[:, :, 0, 0], xb[:, :, :2, :2].mean(axis=(2, 3)))
+
+    (nm, na) = NativePredictor(str(tmp_path / "p")).run({"x": xb})
+    np.testing.assert_allclose(nm, got_m, rtol=1e-6)
+    np.testing.assert_allclose(na, got_a, rtol=1e-6)
+
+
 def test_native_predictor_unsupported_op_is_loud(tmp_path):
     """An op outside the native subset raises with the supported list,
     not a wrong answer."""

@@ -90,3 +90,59 @@ def test_nmt_copy_task_train_and_decode():
     assert bmatch >= match - 1e-6, (bmatch, match)
     # beams are score-sorted
     assert np.all(np.asarray(bscores)[:, 0] >= np.asarray(bscores)[:, -1])
+
+
+def test_cached_decode_matches_full_prefix():
+    """KV-cached decoding (decoding.beam_search_cached +
+    make_transformer_lm_step_fn) must produce exactly the same tokens —
+    and the same scores within tolerance — as the full-prefix re-run
+    path on the same transformer_lm weights.  O(T) per step vs O(T^2);
+    the beam reorder gathers cache rows by parent."""
+    V2, D, L, H, DI, ML = 24, 32, 2, 4, 64, 10
+    B, K = 3, 3
+
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 71
+    with framework.program_guard(prog, startup):
+        src = fluid.layers.data("src", [ML], dtype="int64")
+        _, logits = models.transformer.transformer_lm(
+            src, None, vocab_size=V2, d_model=D, n_layer=L, n_head=H,
+            d_inner=DI, seq_len=ML, max_pos=ML, dropout_rate=0.0,
+            is_test=True,
+        )
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        state = {
+            v.name: scope.get(v.name)
+            for v in prog.list_vars()
+            if v.persistable and scope.get(v.name) is not None
+        }
+
+    pfn = decoding.make_program_logits_fn(prog, state, ["src"], logits.name)
+
+    def logits_fn(feeds):
+        # decoder-only LM: the "target" prefix IS the model input
+        return pfn({"src": feeds["tgt"]})
+
+    dummy_src = np.zeros((B, 1), "int32")
+    toks_full, scores_full = decoding.beam_search(
+        logits_fn, dummy_src, BOS, EOS, beam_size=K, max_len=ML)
+
+    step_fn, make_cache = decoding.make_transformer_lm_step_fn(
+        state, V2, D, L, H, DI, ML)
+    toks_c, scores_c = decoding.beam_search_cached(
+        step_fn, make_cache(B * K), B, BOS, EOS, beam_size=K, max_len=ML)
+
+    np.testing.assert_array_equal(np.asarray(toks_c), np.asarray(toks_full))
+    np.testing.assert_allclose(
+        np.asarray(scores_c), np.asarray(scores_full), rtol=1e-4, atol=1e-4)
+
+    g_full, gs_full = decoding.greedy_search(
+        logits_fn, dummy_src, BOS, EOS, max_len=ML)
+    g_c, gs_c = decoding.greedy_search_cached(
+        step_fn, make_cache(B), B, BOS, EOS, max_len=ML)
+    np.testing.assert_array_equal(np.asarray(g_c), np.asarray(g_full))
+    np.testing.assert_allclose(
+        np.asarray(gs_c), np.asarray(gs_full), rtol=1e-4, atol=1e-4)
