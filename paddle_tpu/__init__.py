@@ -21,6 +21,9 @@ from paddle_tpu.framework import (
     Program,
     TPUPlace,
     cpu_places,
+    cuda_pinned_places,
+    cuda_places,
+    is_compiled_with_cuda,
     default_main_program,
     default_startup_program,
     in_dygraph_mode,

@@ -9,7 +9,14 @@ grad kernels the static graph uses — one autodiff implementation for
 both modes.
 """
 from paddle_tpu.dygraph import nn  # noqa: F401
-from paddle_tpu.dygraph.base import Tracer, guard, enabled, no_grad, to_variable  # noqa: F401
+from paddle_tpu.dygraph.base import (  # noqa: F401
+    BackwardStrategy,
+    Tracer,
+    enabled,
+    guard,
+    no_grad,
+    to_variable,
+)
 from paddle_tpu.dygraph import learning_rate_scheduler  # noqa: F401
 from paddle_tpu.dygraph.learning_rate_scheduler import (  # noqa: F401
     CosineDecay,

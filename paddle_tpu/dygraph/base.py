@@ -37,6 +37,18 @@ def _val(var):
     return v
 
 
+class BackwardStrategy:
+    """reference: dygraph/backward_strategy.py (core.BackwardStrategy).
+
+    ``sort_sum_gradient`` makes the reference's grad accumulation order
+    deterministic; this build's tape replay accumulates in fixed reverse
+    trace order, so execution is ALWAYS deterministic — the flag is
+    accepted for API parity and recorded on the instance."""
+
+    def __init__(self):
+        self.sort_sum_gradient = False
+
+
 class Tracer:
     """Eager executor + tape (reference: imperative/tracer.h:41)."""
 

@@ -86,6 +86,38 @@ def cpu_places(device_count=None):
     return [CPUPlace()]
 
 
+def cuda_places(device_ids=None):
+    """reference: framework.py cuda_places — accelerator places.  On
+    this build the accelerator is the TPU: returns one TPUPlace per
+    visible chip (or per requested id)."""
+    if device_ids is None:
+        try:
+            import jax
+
+            n = max(
+                1, len([d for d in jax.devices() if d.platform != "cpu"])
+            )
+        except Exception:  # noqa: BLE001 — no accelerator visible
+            n = 1
+        device_ids = range(n)
+    return [TPUPlace(int(i)) for i in device_ids]
+
+
+def cuda_pinned_places(device_count=None):
+    """reference: framework.py cuda_pinned_places — pinned host staging
+    memory.  PJRT owns transfer staging on TPU; host-side places are
+    plain CPUPlaces."""
+    return [CPUPlace() for _ in range(device_count or 1)]
+
+
+def is_compiled_with_cuda() -> bool:
+    """reference: framework.py is_compiled_with_cuda.  This build
+    targets TPU via XLA, never CUDA — always False (reference code
+    gating on it falls back to its portable path, which is correct
+    here)."""
+    return False
+
+
 # ---------------------------------------------------------------------------
 # Dygraph mode switch (reference: framework.py:60-110)
 # ---------------------------------------------------------------------------

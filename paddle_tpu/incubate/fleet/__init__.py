@@ -1,0 +1,3 @@
+"""Canonical fleet import paths (reference: python/paddle/fluid/incubate/
+fleet/) — shims over the one implementation in paddle_tpu/parallel/
+fleet.py so reference user code imports unchanged."""

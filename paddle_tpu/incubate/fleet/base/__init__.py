@@ -1,0 +1,1 @@
+from paddle_tpu.incubate.fleet.base import role_maker  # noqa: F401

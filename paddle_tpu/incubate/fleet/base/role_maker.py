@@ -1,0 +1,14 @@
+"""reference: incubate/fleet/base/role_maker.py — re-exports the role
+makers implemented in paddle_tpu/parallel/fleet.py."""
+from paddle_tpu.parallel.fleet import (  # noqa: F401
+    MPISymetricRoleMaker,
+    PaddleCloudRoleMaker,
+    Role,
+    RoleMakerBase,
+    UserDefinedCollectiveRoleMaker,
+    UserDefinedRoleMaker,
+)
+
+__all__ = ["Role", "RoleMakerBase", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker", "UserDefinedCollectiveRoleMaker",
+           "MPISymetricRoleMaker"]

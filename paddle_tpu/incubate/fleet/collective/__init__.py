@@ -1,0 +1,11 @@
+"""reference: incubate/fleet/collective/__init__.py — the collective
+(NCCL2-mode analog) fleet: on TPU, minimize() returns a CompiledProgram
+bound to the mesh (see paddle_tpu/parallel/fleet.py)."""
+from paddle_tpu.parallel.fleet import (  # noqa: F401
+    DistributedOptimizer,
+    Fleet,
+    fleet,
+)
+from paddle_tpu.parallel.strategy import DistributedStrategy  # noqa: F401
+
+__all__ = ["fleet", "Fleet", "DistributedOptimizer", "DistributedStrategy"]

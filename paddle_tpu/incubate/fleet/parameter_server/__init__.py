@@ -1,0 +1,16 @@
+"""reference: incubate/fleet/parameter_server/distribute_transpiler/ —
+the PS-mode fleet face: same fleet singleton; PS programs come from
+DistributeTranspiler (paddle_tpu/transpiler.py) + the host parameter
+server (paddle_tpu/distributed/ps.py)."""
+from paddle_tpu.parallel.fleet import (  # noqa: F401
+    DistributedOptimizer,
+    Fleet,
+    fleet,
+)
+from paddle_tpu.transpiler import (  # noqa: F401
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+)
+
+__all__ = ["fleet", "Fleet", "DistributedOptimizer",
+           "DistributeTranspiler", "DistributeTranspilerConfig"]

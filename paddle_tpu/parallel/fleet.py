@@ -26,6 +26,8 @@ __all__ = [
     "DistributedOptimizer",
     "PaddleCloudRoleMaker",
     "UserDefinedRoleMaker",
+    "UserDefinedCollectiveRoleMaker",
+    "MPISymetricRoleMaker",
     "Role",
 ]
 
@@ -101,6 +103,52 @@ class UserDefinedRoleMaker(RoleMakerBase):
         self._role = role
         self._worker_endpoints = ["127.0.0.1:%d" % (6170 + i) for i in range(worker_num)]
         self._server_endpoints = server_endpoints or []
+
+
+class UserDefinedCollectiveRoleMaker(RoleMakerBase):
+    """reference: role_maker.py UserDefinedCollectiveRoleMaker — all
+    ranks are workers (collective mode), endpoints given explicitly."""
+
+    def __init__(self, current_id=0, worker_endpoints=None):
+        super().__init__()
+        self._current_id = current_id
+        self._role = Role.WORKER
+        self._worker_endpoints = list(worker_endpoints or ["127.0.0.1:6170"])
+
+
+class MPISymetricRoleMaker(RoleMakerBase):
+    """reference: role_maker.py:87 MPISymetricRoleMaker — even MPI ranks
+    are workers, odd ranks servers.  Requires mpi4py at generate_role();
+    on TPU pods prefer PaddleCloudRoleMaker (env contract) — the jax
+    runtime bootstraps the slice without MPI."""
+
+    def __init__(self):
+        super().__init__()
+        self._generated = False
+
+    def generate_role(self):
+        try:
+            from mpi4py import MPI  # noqa: PLC0415
+        except ImportError as e:
+            raise RuntimeError(
+                "MPISymetricRoleMaker needs mpi4py (not in this image); "
+                "use PaddleCloudRoleMaker (PADDLE_* env contract) instead"
+            ) from e
+        comm = MPI.COMM_WORLD
+        rank = comm.Get_rank()
+        self._role = Role.WORKER if rank % 2 == 0 else Role.SERVER
+        self._current_id = rank // 2
+        # routable per-rank host (reference: get_ip() per node) — NOT
+        # 127.0.0.1, which would point every endpoint at localhost on a
+        # multi-host job
+        import socket
+
+        host = socket.gethostbyname(MPI.Get_processor_name() or
+                                    socket.gethostname())
+        hosts = comm.allgather("%s:%d" % (host, 6170 + rank))
+        self._worker_endpoints = hosts[0::2]
+        self._server_endpoints = hosts[1::2]
+        self._generated = True
 
 
 class Fleet:

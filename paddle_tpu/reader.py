@@ -33,8 +33,11 @@ __all__ = [
     "map_readers",
     "chain",
     "compose",
+    "ComposeNotAligned",
     "firstn",
     "cache",
+    "Fake",
+    "PipeReader",
 ]
 
 
@@ -114,16 +117,123 @@ def chain(*readers):
     return reader_
 
 
+class ComposeNotAligned(ValueError):
+    """reference: reader/decorator.py:145 — raised by ``compose`` when
+    ``check_alignment=True`` and the input readers have unequal length."""
+
+
 def compose(*readers, check_alignment: bool = True):
     def reader_():
         iters = [r() for r in readers]
-        for items in zip(*iters):
+        sentinel = object()
+        for items in itertools.zip_longest(*iters, fillvalue=sentinel):
+            if any(it is sentinel for it in items):
+                if check_alignment and not all(it is sentinel for it in items):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned"
+                    )
+                return
             out = []
             for it in items:
                 out.extend(it if isinstance(it, tuple) else (it,))
             yield tuple(out)
 
     return reader_
+
+
+class Fake:
+    """reference: reader/decorator.py:531 — cache the first sample and
+    replay it ``data_num`` times (pipeline speed testing)."""
+
+    def __init__(self):
+        self.data = None
+
+    def __call__(self, reader, data_num):
+        def fake_reader():
+            if self.data is None:
+                # explicit guard: a bare next() raising StopIteration
+                # inside a generator becomes a confusing PEP-479
+                # RuntimeError
+                it = iter(reader())
+                first = list(itertools.islice(it, 1))
+                if not first:
+                    raise ValueError(
+                        "Fake: the wrapped reader yielded no data"
+                    )
+                self.data = first[0]
+            for _ in range(data_num):
+                yield self.data
+
+        return fake_reader
+
+
+class PipeReader:
+    """reference: reader/decorator.py:460 — stream data from a shell
+    command's stdout (e.g. ``hadoop fs -cat ...``), optionally gzip
+    (multi-member streams supported — concatenated .gz files), yielding
+    lines via ``get_line``.  A command that exits nonzero raises instead
+    of ending the stream silently (a truncated dataset must not look
+    like EOF)."""
+
+    def __init__(self, command, bufsize=8192, file_type="plain"):
+        import subprocess
+        import zlib
+
+        if not isinstance(command, str):
+            raise TypeError("command must be a string")
+        if file_type == "gzip":
+            self._zlib = zlib
+            self.dec = zlib.decompressobj(32 + zlib.MAX_WBITS)
+        elif file_type != "plain":
+            raise TypeError("file_type %s is not allowed" % file_type)
+        self.file_type = file_type
+        self.bufsize = bufsize
+        self.process = subprocess.Popen(
+            command.split(" "), bufsize=bufsize, stdout=subprocess.PIPE
+        )
+
+    def _decompress(self, buff: bytes) -> bytes:
+        # a gzip stream of concatenated members (cat a.gz b.gz): each
+        # decompressobj stops at its member's end — chain through
+        # unused_data with fresh objects or everything after member 1
+        # silently vanishes
+        out = self.dec.decompress(buff)
+        while self.dec.eof and self.dec.unused_data:
+            tail = self.dec.unused_data
+            self.dec = self._zlib.decompressobj(32 + self._zlib.MAX_WBITS)
+            out += self.dec.decompress(tail)
+        return out
+
+    def get_line(self, cut_lines=True, line_break="\n"):
+        import codecs
+
+        # incremental decode: a multi-byte UTF-8 char split across a
+        # bufsize boundary must not raise mid-stream
+        decoder = codecs.getincrementaldecoder("utf-8")()
+        remained = ""
+        while True:
+            buff = self.process.stdout.read(self.bufsize)
+            if not buff:
+                break
+            raw = self._decompress(buff) if self.file_type == "gzip" else buff
+            text = decoder.decode(raw)
+            if not cut_lines:
+                if text:
+                    yield text
+                continue
+            parts = (remained + text).split(line_break)
+            remained = parts.pop()
+            yield from parts
+        tail = decoder.decode(b"", final=True)
+        remained += tail
+        if remained:
+            yield remained
+        rc = self.process.wait()
+        if rc != 0:
+            raise RuntimeError(
+                "PipeReader command exited with status %d — the stream "
+                "may be truncated" % rc
+            )
 
 
 def firstn(reader, n: int):

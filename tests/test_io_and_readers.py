@@ -134,3 +134,58 @@ def test_data_feeder_dense_and_ragged():
     assert d["x"].shape == (2, 4)
     assert d["seq"].shape == (2, 5, 3)
     np.testing.assert_array_equal(d["seq_seq_len"], [2, 5])
+
+
+def test_reader_decorator_tail_and_fleet_shims():
+    """Namespace-closure additions (r5 sweep): ComposeNotAligned / Fake /
+    PipeReader reader decorators, the canonical incubate.fleet import
+    paths, accelerator places, and dygraph BackwardStrategy."""
+    import pytest
+
+    from paddle_tpu import reader as R
+
+    def r3():
+        for i in range(3):
+            yield (i,)
+
+    def r4():
+        for i in range(4):
+            yield (i,)
+
+    with pytest.raises(R.ComposeNotAligned):
+        list(R.compose(r3, r4)())
+    assert list(R.compose(r3, r3)()) == [(0, 0), (1, 1), (2, 2)]
+    assert list(R.Fake()(r4, 4)()) == [(0,)] * 4
+    assert list(R.PipeReader("printf a\\nbb\\nccc").get_line()) == \
+        ["a", "bb", "ccc"]
+
+    from paddle_tpu.incubate.fleet.base import role_maker
+    from paddle_tpu.incubate.fleet.collective import fleet as col_fleet
+    from paddle_tpu.incubate.fleet.parameter_server import (
+        DistributeTranspiler as PSDT,
+    )
+
+    rm = role_maker.UserDefinedCollectiveRoleMaker(
+        current_id=1, worker_endpoints=["a:1", "b:2"])
+    assert rm.is_worker() and rm.worker_num() == 2 and rm.worker_index() == 1
+    with pytest.raises(RuntimeError, match="mpi4py"):
+        role_maker.MPISymetricRoleMaker().generate_role()
+    from paddle_tpu.parallel.fleet import fleet as canonical_fleet
+
+    assert col_fleet is canonical_fleet
+    assert PSDT is fluid.DistributeTranspiler
+
+    assert fluid.is_compiled_with_cuda() is False
+    assert len(fluid.cuda_places([0, 1])) == 2
+    assert all(isinstance(p, fluid.CPUPlace)
+               for p in fluid.cuda_pinned_places(2))
+
+    bs = fluid.dygraph.BackwardStrategy()
+    bs.sort_sum_gradient = True
+    with fluid.dygraph.guard():
+        x = fluid.dygraph.to_variable(np.ones((2, 2), "float32"))
+        x.stop_gradient = False
+        loss = fluid.layers.reduce_sum(fluid.layers.square(x))
+        loss.backward(bs)
+        np.testing.assert_allclose(x.gradient(), 2 * np.ones((2, 2)),
+                                   rtol=1e-6)
