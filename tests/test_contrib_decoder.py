@@ -338,6 +338,59 @@ def test_beam_search_decoder_input_var_dict():
             dec.decode()
 
 
+def test_training_decoder_static_input():
+    """static_input exposes a whole sequence unchanged at every step
+    (reference: beam_search_decoder.py TrainingDecoder.static_input —
+    e.g. attention over the full encoder output)."""
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 95
+    with framework.program_guard(prog, startup):
+        ctx = fluid.layers.data("ctx", [H])
+        trg = fluid.layers.data("trg", [T_TGT], dtype="int64")
+        enc_seq = fluid.layers.data("enc", [T_TGT, H])  # [B, T, H]
+        emb = fluid.layers.embedding(trg, size=[V, D], param_attr=_named("si_e"))
+        cell = StateCell(inputs={"x": None, "enc": None},
+                         states={"h": InitState(init=ctx)}, out_state="h")
+
+        @cell.state_updater
+        def up(sc):
+            # mean over the static encoder sequence joins the update
+            enc_mean = fluid.layers.reduce_mean(sc.get_input("enc"), dim=[1])
+            sc.set_state("h", fluid.layers.fc(
+                [sc.get_state("h"), sc.get_input("x"), enc_mean], size=H,
+                act="tanh",
+                param_attr=[_named("si_h"), _named("si_x"), _named("si_c")],
+                bias_attr=_named("si_b")))
+
+        dec = TrainingDecoder(cell)
+        with dec.block():
+            word = dec.step_input(emb)
+            enc_static = dec.static_input(enc_seq)
+            dec.state_cell.compute_state(inputs={"x": word, "enc": enc_static})
+            dec.state_cell.update_states()
+            dec.output(dec.state_cell.get_state("h"))
+        out = dec()
+
+    rng = np.random.RandomState(2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {
+        "ctx": rng.randn(B, H).astype("float32"),
+        "trg": rng.randint(0, V, (B, T_TGT)).astype("int64"),
+        "enc": rng.randn(B, T_TGT, H).astype("float32"),
+    }
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (o,) = exe.run(prog, feed=feed, fetch_list=[out])
+        # the static input really reaches the update: a different enc
+        # feed (same params, same other feeds) must change the output
+        feed2 = dict(feed, enc=rng.randn(B, T_TGT, H).astype("float32"))
+        (o2,) = exe.run(prog, feed=feed2, fetch_list=[out])
+    o, o2 = np.asarray(o), np.asarray(o2)
+    assert o.shape == (B, T_TGT, H)
+    assert np.isfinite(o).all() and (np.abs(o) > 1e-8).any()
+    assert not np.allclose(o, o2)
+
+
 def test_state_cell_validation():
     prog, startup = framework.Program(), framework.Program()
     with framework.program_guard(prog, startup):
