@@ -12,16 +12,21 @@ class QuantizeTranspiler:
                  weight_quantize_type="abs_max", window_size=10000):
         self.weight_bits = weight_bits
         self.activation_bits = activation_bits
+        self.activation_quantize_type = activation_quantize_type
 
     def training_transpile(self, program=None, startup_program=None):
         from paddle_tpu import framework
-        from paddle_tpu.contrib.slim.quantization import quantize_program
+        from paddle_tpu.contrib.slim.quantization import (
+            QuantizationTransformPass,
+        )
 
         program = program or framework.default_main_program()
-        return quantize_program(
-            program, weight_bits=self.weight_bits,
+        QuantizationTransformPass(
+            weight_bits=self.weight_bits,
             activation_bits=self.activation_bits,
-        )
+            activation_quantize_type=self.activation_quantize_type,
+        ).apply(program, startup_program=startup_program)
+        return program
 
     def freeze_program(self, program, place=None, scope=None):
         """Fold trained fake-quant scales into real int8 weights

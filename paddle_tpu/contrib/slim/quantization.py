@@ -42,6 +42,46 @@ def fake_quantize_dequantize_abs_max(inputs, attrs):
     return {"Out": out, "OutScale": scale.reshape(1)}
 
 
+@register_op("fake_quantize_dequantize_moving_average_abs_max",
+             no_grad_set={"InScale", "InState", "InAccum"})
+def fake_quantize_dequantize_moving_average_abs_max(inputs, attrs):
+    """reference: operators/fake_quantize_op.cc:513 + fake_quantize_op.h
+    FindMovingAverageAbsMaxFunctor — activation quantization with a
+    persisted moving-average scale:
+
+    train:  state = rate*state + 1; accum = rate*accum + max|x|;
+            scale = accum/state   (state/accum/scale write back to their
+            persistable vars through the executor's state path)
+    test:   scale = InScale (frozen; no state update)
+
+    Quant-dequant is the same symmetric abs-max rounding as the abs_max
+    op, straight-through under vjp."""
+    import jax
+    import jax.numpy as jnp
+
+    x = one(inputs, "X")
+    bits = attrs.get("bit_length", 8)
+    qmax = float(2 ** (bits - 1) - 1)
+    rate = float(attrs.get("moving_rate", 0.9))
+    is_test = bool(attrs.get("is_test", False))
+    if is_test:
+        scale = one(inputs, "InScale").reshape(())
+        scale = jnp.maximum(scale, 1e-8)
+        extra = {}
+    else:
+        cur = jnp.max(jnp.abs(x))
+        state = one(inputs, "InState").reshape(())
+        accum = one(inputs, "InAccum").reshape(())
+        state = rate * state + 1.0
+        accum = rate * accum + cur
+        scale = jnp.maximum(accum / state, 1e-8)
+        extra = {"OutState": state.reshape(1), "OutAccum": accum.reshape(1)}
+    q = jnp.clip(jnp.round(x / scale * qmax), -qmax, qmax)
+    out = q * scale / qmax
+    out = x + jax.lax.stop_gradient(out - x)
+    return {"Out": out, "OutScale": scale.reshape(1), **extra}
+
+
 @register_op("dequantize_abs_max", differentiable=False)
 def dequantize_abs_max(inputs, attrs):
     """reference: operators/fake_dequantize_op.cc fake_dequantize_max_abs
@@ -67,12 +107,14 @@ class QuantizationFreezePass:
     (``<w>.int8``, 4x smaller on disk and in HBM), store its scale
     (``<w>.dequant_scale``), and replace the fake op with
     ``dequantize_abs_max`` feeding the consumer — XLA folds the dequant
-    multiply into the consuming matmul/conv.  Activation fake-quant ops
-    are kept as dynamic abs-max quant-dequant (this build's QAT computes
-    activation scales in-graph rather than persisting a moving average,
-    so freezing them would change semantics; the kept op IS the trained
-    behavior).  Frozen output therefore matches the fake-quant program
-    exactly, and the program stays AnalysisPredictor-loadable.
+    multiply into the consuming matmul/conv.  Activation handling
+    depends on how QAT quantized them: ``abs_max`` (dynamic) ops are
+    kept as-is — the per-batch scale IS the trained behavior — while
+    ``moving_average_abs_max`` ops get their trained persisted scale
+    FIXED (``is_test=True``; no further state mutation), matching the
+    reference freeze's recorded-scale semantics.  Frozen output
+    therefore matches the fake-quant program exactly, and the program
+    stays AnalysisPredictor-loadable.
     """
 
     def __init__(self, scope, place=None, weight_bits: int = 8):
@@ -85,6 +127,13 @@ class QuantizationFreezePass:
 
         block = program.global_block()
         frozen = 0
+        # moving-average activation quantizers: fix the trained scale
+        # (is_test) so inference uses the converged value and never
+        # mutates state (reference freeze keeps the recorded scales)
+        for op in block.ops:
+            if op.type == "fake_quantize_dequantize_moving_average_abs_max":
+                op.attrs["is_test"] = True
+                frozen += 1
         for i, op in enumerate(list(block.ops)):
             if op.type != "fake_quantize_dequantize_abs_max":
                 continue
@@ -153,16 +202,78 @@ def freeze_program(program, scope, place=None, weight_bits=8):
 
 
 class QuantizationTransformPass:
-    """reference: quantization_pass.py QuantizationTransformPass."""
+    """reference: quantization_pass.py QuantizationTransformPass.
+
+    ``activation_quantize_type``:
+
+    * ``"abs_max"`` (default) — dynamic per-batch activation scales,
+      computed in-graph (nothing persisted).
+    * ``"moving_average_abs_max"`` — the reference's trainable-scale
+      mode: per-activation persistable scale/state/accum vars updated
+      by the moving-average op each step (init scale 0.001, state and
+      accum 1, matching _insert_quant_moving_average_abs_max_op); pass
+      ``startup_program=`` to ``apply`` so the state vars get their
+      initializers.  The freeze pass then fixes activation scales to
+      the trained values (is_test).
+    """
 
     def __init__(self, quantizable_op_type=("conv2d", "depthwise_conv2d", "mul", "matmul"),
-                 weight_bits: int = 8, activation_bits: int = 8):
+                 weight_bits: int = 8, activation_bits: int = 8,
+                 activation_quantize_type: str = "abs_max",
+                 moving_rate: float = 0.9):
+        if activation_quantize_type not in ("abs_max", "moving_average_abs_max"):
+            raise ValueError(
+                "activation_quantize_type must be abs_max or "
+                "moving_average_abs_max (got %r)" % activation_quantize_type
+            )
         self.quantizable = set(quantizable_op_type)
         self.weight_bits = weight_bits
         self.activation_bits = activation_bits
+        self.activation_quantize_type = activation_quantize_type
+        self.moving_rate = moving_rate
 
-    def apply(self, program) -> None:
+    def _insert_moving_average(self, block, startup, i, n, v, bits):
+        qname = unique_name.generate(n + ".quantized")
+        sname = unique_name.generate(n + ".quant_scale")
+        state_n = unique_name.generate(n + ".quant_state")
+        accum_n = unique_name.generate(n + ".quant_accum")
+        block.create_var(name=qname, shape=v.shape, dtype="float32")
+        for var_n, init in ((sname, 0.001), (state_n, 1.0), (accum_n, 1.0)):
+            block.create_var(name=var_n, shape=[1], dtype="float32",
+                             persistable=True, stop_gradient=True)
+            if startup is not None:
+                sb = startup.global_block()
+                sb.create_var(name=var_n, shape=[1], dtype="float32",
+                              persistable=True, stop_gradient=True)
+                sb.append_op(
+                    type="fill_constant", inputs={},
+                    outputs={"Out": [var_n]},
+                    attrs={"shape": [1], "value": float(init),
+                           "dtype": "float32"},
+                )
+        block._insert_op(
+            i,
+            type="fake_quantize_dequantize_moving_average_abs_max",
+            inputs={"X": [n], "InScale": [sname], "InState": [state_n],
+                    "InAccum": [accum_n]},
+            outputs={"Out": [qname], "OutScale": [sname],
+                     "OutState": [state_n], "OutAccum": [accum_n]},
+            attrs={"bit_length": bits, "moving_rate": self.moving_rate,
+                   "is_test": False, "op_role": "forward"},
+        )
+        return qname
+
+    def apply(self, program, startup_program=None) -> None:
         block = program.global_block()
+        use_ma = self.activation_quantize_type == "moving_average_abs_max"
+        if use_ma and startup_program is None:
+            raise ValueError(
+                "moving_average_abs_max needs startup_program= so the "
+                "scale/state/accum vars get initializers"
+            )
+        # one quantizer per VAR (reference: dequantized_vars cache) — an
+        # activation feeding two quantizable ops shares one scale/state
+        quantized: dict = {}
         i = 0
         while i < len(block.ops):
             op = block.ops[i]
@@ -177,20 +288,29 @@ class QuantizationTransformPass:
                     if v is None or v.dtype not in ("float32",):
                         new_names.append(n)
                         continue
+                    if n in quantized:
+                        new_names.append(quantized[n])
+                        continue
                     is_weight = isinstance(v, framework.Parameter)
                     bits = self.weight_bits if is_weight else self.activation_bits
-                    qname = unique_name.generate(n + ".quantized")
-                    sname = unique_name.generate(n + ".quant_scale")
-                    block.create_var(name=qname, shape=v.shape, dtype="float32")
-                    block.create_var(name=sname, shape=[1], dtype="float32", stop_gradient=True)
-                    block._insert_op(
-                        i + inserted,
-                        type="fake_quantize_dequantize_abs_max",
-                        inputs={"X": [n]},
-                        outputs={"Out": [qname], "OutScale": [sname]},
-                        attrs={"bit_length": bits, "op_role": op.attrs.get("op_role", "forward")},
-                    )
+                    if not is_weight and use_ma:
+                        qname = self._insert_moving_average(
+                            block, startup_program, i + inserted, n, v, bits
+                        )
+                    else:
+                        qname = unique_name.generate(n + ".quantized")
+                        sname = unique_name.generate(n + ".quant_scale")
+                        block.create_var(name=qname, shape=v.shape, dtype="float32")
+                        block.create_var(name=sname, shape=[1], dtype="float32", stop_gradient=True)
+                        block._insert_op(
+                            i + inserted,
+                            type="fake_quantize_dequantize_abs_max",
+                            inputs={"X": [n]},
+                            outputs={"Out": [qname], "OutScale": [sname]},
+                            attrs={"bit_length": bits, "op_role": op.attrs.get("op_role", "forward")},
+                        )
                     inserted += 1
+                    quantized[n] = qname
                     new_names.append(qname)
                 op.inputs[slot] = new_names
             i += inserted + 1

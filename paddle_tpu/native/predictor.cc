@@ -428,6 +428,8 @@ struct Predictor {
       return op_lookup(op);
     if (type == "dequantize_abs_max") return op_dequant(op);
     if (type == "fake_quantize_dequantize_abs_max") return op_fake_quant(op);
+    if (type == "fake_quantize_dequantize_moving_average_abs_max")
+      return op_fake_quant_ma(op);
     if (type == "cast") return op_cast(op);
     if (type == "conv2d") return op_conv2d(op);
     if (type == "pool2d") return op_pool2d(op);
@@ -1014,6 +1016,33 @@ struct Predictor {
       s.shape = {1};
       s.is_int = false;
       s.f = {scale};
+    }
+    return true;
+  }
+
+  // moving-average activation quantizer, inference form: the trained
+  // InScale is fixed (the freeze pass sets is_test); training-mode
+  // state updates are a Python-path concern
+  bool op_fake_quant_ma(const Json& op) {
+    if (attr_num(op, "is_test", 0.0) == 0.0) {
+      err = "fake_quantize_dequantize_moving_average_abs_max: only "
+            "is_test=True (frozen scales) supported natively — freeze "
+            "the program first";
+      return false;
+    }
+    const Tensor& x = in(op, "X");
+    const Tensor& in_scale = in(op, "InScale");
+    int bits = static_cast<int>(attr_num(op, "bit_length", 8));
+    float qmax = static_cast<float>((1 << (bits - 1)) - 1);
+    float scale = std::max(in_scale.f.empty() ? 1e-8f : in_scale.f[0], 1e-8f);
+    Tensor& o = out(op, "Out");
+    o.shape = x.shape;
+    o.is_int = false;
+    o.f.resize(x.f.size());
+    for (size_t i = 0; i < x.f.size(); ++i) {
+      float q = std::nearbyint(x.f[i] / scale * qmax);
+      q = std::max(-qmax, std::min(qmax, q));
+      o.f[i] = q * scale / qmax;
     }
     return true;
   }

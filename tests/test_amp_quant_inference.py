@@ -190,6 +190,71 @@ def test_qat_freeze_respects_trained_bit_length():
             assert op.attrs["max_range"] == 7.0
 
 
+def test_qat_moving_average_activation_scales(tmp_path):
+    """activation_quantize_type='moving_average_abs_max' (reference:
+    quantization_pass.py _insert_quant_moving_average_abs_max_op +
+    fake_quantize_op.h FindMovingAverageAbsMax): persisted activation
+    scales update per train step (state=rate*state+1,
+    accum=rate*accum+max|x|, scale=accum/state), freeze fixes them
+    (is_test), and the frozen export serves natively."""
+    from paddle_tpu.contrib.slim.quantization import (
+        QuantizationTransformPass, freeze_program,
+    )
+
+    prog, startup, loss, pred = _mlp_program(seed=34)
+    with framework.program_guard(prog, startup):
+        QuantizationTransformPass(
+            activation_quantize_type="moving_average_abs_max"
+        ).apply(prog, startup_program=startup)
+        fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+    types = [op.type for op in prog.global_block().ops]
+    assert "fake_quantize_dequantize_moving_average_abs_max" in types
+    ma_ops = [op for op in prog.global_block().ops
+              if op.type == "fake_quantize_dequantize_moving_average_abs_max"]
+    scale_var = ma_ops[0].inputs["InScale"][0]
+
+    rng = np.random.RandomState(6)
+    xb = rng.uniform(-1, 1, (4, 16)).astype("float32")
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        s0 = float(np.asarray(scope.get(scale_var)))
+        assert abs(s0 - 0.001) < 1e-8  # reference init
+        scales = []
+        for _ in range(6):
+            exe.run(prog, feed={
+                "x": rng.uniform(-1, 1, (16, 16)).astype("float32"),
+                "y": rng.randint(0, 4, (16, 1)).astype("int64"),
+            }, fetch_list=[loss])
+            scales.append(float(np.asarray(scope.get(scale_var))))
+        # the persisted scale moves toward the running abs-max (~1.0
+        # for U(-1,1) inputs) and keeps updating across steps
+        assert scales[0] > s0 and scales[-1] > 0.3, scales
+        assert len(set(round(s, 6) for s in scales)) > 1
+
+        frozen = freeze_program(prog.clone(for_test=True), scope)
+        for op in frozen.global_block().ops:
+            if op.type == "fake_quantize_dequantize_moving_average_abs_max":
+                assert op.attrs["is_test"] is True
+        (g1,) = exe.run(frozen, feed={"x": xb, "y": np.zeros((4, 1), "int64")},
+                        fetch_list=[pred])
+        s_after = float(np.asarray(scope.get(scale_var)))
+        (g2,) = exe.run(frozen, feed={"x": xb, "y": np.zeros((4, 1), "int64")},
+                        fetch_list=[pred])
+        # frozen: deterministic, and state no longer mutates
+        np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+        assert float(np.asarray(scope.get(scale_var))) == s_after
+        fluid.save_inference_model(str(tmp_path / "ma"), ["x"], [pred],
+                                   exe, frozen)
+
+    from paddle_tpu.native import NativePredictor, _predictor_lib
+
+    if _predictor_lib() is not None:
+        (ng,) = NativePredictor(str(tmp_path / "ma")).run({"x": xb})
+        np.testing.assert_allclose(ng, np.asarray(g1), rtol=1e-5, atol=1e-6)
+
+
 def test_quantize_transpiler_freeze_surface():
     """contrib.quantize.QuantizeTranspiler.freeze_program reaches the
     slim freeze pass (reference: quantize_transpiler.py)."""
