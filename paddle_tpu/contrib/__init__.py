@@ -14,3 +14,12 @@ from paddle_tpu.contrib import layers  # noqa: F401,E402
 from paddle_tpu.contrib.memory_usage_calc import memory_usage  # noqa: F401,E402
 from paddle_tpu.contrib.op_frequence import op_freq_statistic  # noqa: F401,E402
 from paddle_tpu.contrib.model_stat import summary  # noqa: F401,E402
+# star-level re-exports matching the reference contrib/__init__.py
+# (from .decoder import * / from .quantize import *)
+from paddle_tpu.contrib.decoder import (  # noqa: F401,E402
+    BeamSearchDecoder,
+    InitState,
+    StateCell,
+    TrainingDecoder,
+)
+from paddle_tpu.contrib.quantize import QuantizeTranspiler  # noqa: F401,E402

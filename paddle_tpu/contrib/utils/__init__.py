@@ -85,3 +85,110 @@ def load_persistables_for_inference(dirname, executor, program,
     from paddle_tpu import io as io_mod
 
     io_mod.load_persistables(executor, dirname, main_program=program)
+
+
+def convert_dist_to_sparse_program(program):
+    """reference: lookup_table_utils.py:85 — prepare a
+    distributed-lookup-table program for sparse (PS-side) storage.
+
+    TPU-native mapping: a table built with
+    ``layers.embedding(is_distributed=True)`` is ALREADY sparse on the
+    parameter server (distributed_lookup_table ops + per-program
+    metadata).  This helper (re)builds that metadata from the op graph —
+    the case that matters is a Program that lost its side-channel dict
+    (e.g. constructed by an older serializer); table heights are read
+    from the recorded metadata when present, else left at the reference
+    default of 0 meaning 'server decides'.  A program with only dense
+    ``lookup_table`` ops raises with guidance (build with
+    ``is_distributed=True``; there is no after-the-fact dense->sparse
+    rewrite on this architecture)."""
+    block = program.global_block()
+    dist_ops = [op for op in block.ops
+                if op.type == "distributed_lookup_table"]
+    if not dist_ops:
+        raise ValueError(
+            "convert_dist_to_sparse_program: no distributed lookup "
+            "tables in this program — build the embedding with "
+            "layers.embedding(..., is_distributed=True) (the sparse "
+            "PS-backed form; see distributed/ps.py)"
+        )
+    tables = dict(getattr(program, "_distributed_tables", {}) or {})
+    for op in dist_ops:
+        rows_name = op.inputs["Rows"][0]
+        if rows_name in tables:
+            continue
+        rows_var = block._find_var_recursive(rows_name)
+        ids_name = op.inputs["OrigIds"][0]
+        ids_var = block._find_var_recursive(ids_name)
+        ids_shape = tuple(ids_var.shape or ()) if ids_var is not None else ()
+        tables[rows_name] = {
+            "table": op.attrs["table"],
+            "dim": int(rows_var.shape[-1]) if rows_var is not None else 0,
+            "height": 0,  # server decides; exact height only via metadata
+            "ids_name": ids_name,
+            "rows_name": rows_name,
+            "local_name": op.inputs["Ids"][0],
+            "squeeze_last": bool(ids_shape and ids_shape[-1] == 1),
+        }
+    program._distributed_tables = tables
+    return program
+
+
+def multi_download(client, hdfs_path, local_path, trainer_id, trainers,
+                   multi_processes=5):
+    """reference: hdfs_utils.py:437 — download this trainer's round-robin
+    shard of the FILES under ``hdfs_path`` (directories are skipped, as
+    the reference's lsr(only_file=True) does) concurrently; returns the
+    local paths."""
+    import concurrent.futures
+    import os
+
+    from paddle_tpu import io_fs
+
+    os.makedirs(local_path, exist_ok=True)
+    files = io_fs.fs_ls(hdfs_path, files_only=True)
+    shard = io_fs.file_shard(files, trainer_id, trainers)
+
+    def fetch(src):
+        dst = os.path.join(local_path, os.path.basename(src))
+        client.download(src, dst)
+        return dst
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=multi_processes) as ex:
+        return list(ex.map(fetch, shard))
+
+
+def multi_upload(client, hdfs_path, local_path, multi_processes=5,
+                 overwrite=False, sync=True):
+    """reference: hdfs_utils.py:508 — upload every file under
+    ``local_path`` concurrently (destination dirs created once, before
+    the pool — not one mkdir subprocess per file)."""
+    import concurrent.futures
+    import os
+
+    files = []
+    parents = set()
+    for root, _, names in os.walk(local_path):
+        for n in names:
+            src = os.path.join(root, n)
+            files.append(src)
+            rel_dir = os.path.relpath(root, local_path)
+            dst_dir = hdfs_path.rstrip("/")
+            if rel_dir != ".":
+                dst_dir += "/" + rel_dir
+            parents.add(dst_dir)
+    for p in sorted(parents) or [hdfs_path]:
+        client.makedirs(p)
+
+    def put(src):
+        rel = os.path.relpath(src, local_path)
+        client.upload(hdfs_path.rstrip("/") + "/" + rel, src,
+                      overwrite=overwrite)
+        return rel
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=multi_processes) as ex:
+        return list(ex.map(put, files))
+
+
+__all__ += ["convert_dist_to_sparse_program", "multi_download",
+            "multi_upload"]

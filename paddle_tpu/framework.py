@@ -558,19 +558,26 @@ class Program:
     # --- serialization (the reference's ProgramDesc protobuf round-trip,
     # framework.proto:184; here a stable JSON encoding) ---
     def to_json(self) -> str:
-        return json.dumps(
-            {
-                "version": 1,
-                "random_seed": self.random_seed,
-                "blocks": [b.to_dict() for b in self.blocks],
-            }
-        )
+        payload = {
+            "version": 1,
+            "random_seed": self.random_seed,
+            "blocks": [b.to_dict() for b in self.blocks],
+        }
+        # distributed lookup-table metadata (layers.embedding
+        # is_distributed=True) must survive serde — without it a
+        # saved/loaded huge-table program can no longer prefetch/push
+        dist = getattr(self, "_distributed_tables", None)
+        if dist:
+            payload["distributed_tables"] = dist
+        return json.dumps(payload)
 
     @staticmethod
     def from_json(text: str) -> "Program":
         data = json.loads(text)
         prog = Program()
         prog.random_seed = data.get("random_seed", 0)
+        if data.get("distributed_tables"):
+            prog._distributed_tables = data["distributed_tables"]
         prog.blocks = []
         for bd in data["blocks"]:
             blk = Block(prog, bd["idx"], bd["parent_idx"])

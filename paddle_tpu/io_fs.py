@@ -62,13 +62,18 @@ def _hadoop(*args: str) -> str:
     ).stdout
 
 
-def fs_ls(path: str) -> List[str]:
+def fs_ls(path: str, files_only: bool = False) -> List[str]:
     if _is_hdfs(path):
         out = _hadoop("-ls", path)
-        return [ln.split()[-1] for ln in out.splitlines() if ln.startswith(("-", "d"))]
+        kinds = ("-",) if files_only else ("-", "d")
+        return [ln.split()[-1] for ln in out.splitlines() if ln.startswith(kinds)]
     if os.path.isdir(path):
-        return sorted(os.path.join(path, p) for p in os.listdir(path))
-    return sorted(_glob.glob(path))
+        entries = sorted(os.path.join(path, p) for p in os.listdir(path))
+    else:
+        entries = sorted(_glob.glob(path))
+    if files_only:
+        entries = [p for p in entries if os.path.isfile(p)]
+    return entries
 
 
 def fs_exists(path: str) -> bool:

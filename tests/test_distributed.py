@@ -982,3 +982,76 @@ def test_geo_sgd_three_trainer_staleness_contract():
         np.testing.assert_allclose(vals[0], np.full((2, 1), 2.0), rtol=1e-6)
     finally:
         server.stop()
+
+
+def test_distributed_table_metadata_serde_and_convert(tmp_path):
+    """Distributed lookup-table metadata survives Program.to_json /
+    from_json, and contrib.utils.convert_dist_to_sparse_program rebuilds
+    it from the op graph when absent (reference:
+    lookup_table_utils.py:85)."""
+    from paddle_tpu.contrib.utils import convert_dist_to_sparse_program
+
+    prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(prog, startup):
+        ids = fluid.layers.data("ids", [1], dtype="int64")
+        emb = fluid.layers.embedding(
+            ids, size=[1000, 8], is_distributed=True,
+            param_attr=fluid.ParamAttr(name="big_table"))
+        fluid.layers.mean(emb)
+    meta = prog._distributed_tables
+    assert meta and list(meta.values())[0]["table"] == "big_table"
+
+    # serde round-trip keeps the metadata
+    prog2 = framework.Program.from_json(prog.to_json())
+    assert prog2._distributed_tables == meta
+
+    # a program stripped of the side-channel dict: convert rebuilds it
+    prog3 = framework.Program.from_json(prog.to_json())
+    del prog3._distributed_tables
+    convert_dist_to_sparse_program(prog3)
+    rebuilt = list(prog3._distributed_tables.values())[0]
+    assert rebuilt["table"] == "big_table"
+    assert rebuilt["dim"] == 8
+    assert rebuilt["ids_name"] == "ids"
+
+    # dense-only programs raise with guidance
+    import pytest
+    dense, dstart = framework.Program(), framework.Program()
+    with framework.program_guard(dense, dstart):
+        ids2 = fluid.layers.data("ids", [1], dtype="int64")
+        fluid.layers.embedding(ids2, size=[10, 4])
+    with pytest.raises(ValueError, match="is_distributed=True"):
+        convert_dist_to_sparse_program(dense)
+
+
+def test_contrib_utils_multi_download_upload(tmp_path):
+    """multi_download shards files round-robin per trainer and fetches
+    concurrently; multi_upload mirrors a local tree (reference:
+    hdfs_utils.py:437/508 — exercised over the local-fs path of the
+    hadoop shim)."""
+    from paddle_tpu.contrib.utils import (
+        HDFSClient, multi_download, multi_upload,
+    )
+
+    src = tmp_path / "remote"
+    src.mkdir()
+    for i in range(5):
+        (src / ("part-%d.txt" % i)).write_text("data %d" % i)
+    (src / "a_subdir").mkdir()  # dirs are skipped, not downloaded
+    client = HDFSClient()
+    out0 = multi_download(client, str(src), str(tmp_path / "t0"), 0, 2)
+    out1 = multi_download(client, str(src), str(tmp_path / "t1"), 1, 2)
+    names0 = sorted(os.path.basename(p) for p in out0)
+    names1 = sorted(os.path.basename(p) for p in out1)
+    assert names0 == ["part-0.txt", "part-2.txt", "part-4.txt"]
+    assert names1 == ["part-1.txt", "part-3.txt"]
+    assert (tmp_path / "t0" / "part-2.txt").read_text() == "data 2"
+
+    up = tmp_path / "up"
+    (up / "sub").mkdir(parents=True)
+    (up / "a.txt").write_text("A")
+    (up / "sub" / "b.txt").write_text("B")
+    dst = tmp_path / "dest"
+    rels = sorted(multi_upload(client, str(dst), str(up)))
+    assert rels == ["a.txt", os.path.join("sub", "b.txt")]
+    assert (dst / "sub" / "b.txt").read_text() == "B"
