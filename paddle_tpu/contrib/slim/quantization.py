@@ -82,6 +82,43 @@ def fake_quantize_dequantize_moving_average_abs_max(inputs, attrs):
     return {"Out": out, "OutScale": scale.reshape(1), **extra}
 
 
+@register_op("fake_channel_wise_quantize_dequantize_abs_max")
+def fake_channel_wise_quantize_dequantize_abs_max(inputs, attrs):
+    """reference: operators/fake_quantize_op.cc:521
+    FakeChannelWiseQuantizeAbsMax (+ the pass's paired dequant) — one
+    abs-max scale PER OUTPUT CHANNEL (dim 0: conv OIHW filters), which
+    preserves accuracy for conv weights whose channels differ in range.
+    Straight-through under vjp like the tensor-wise op."""
+    import jax
+    import jax.numpy as jnp
+
+    x = one(inputs, "X")
+    bits = attrs.get("bit_length", 8)
+    qmax = float(2 ** (bits - 1) - 1)
+    flat = x.reshape(x.shape[0], -1)
+    scale = jnp.maximum(jnp.max(jnp.abs(flat), axis=1), 1e-8)  # [C]
+    bshape = (x.shape[0],) + (1,) * (x.ndim - 1)
+    s = scale.reshape(bshape)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    out = q * s / qmax
+    out = x + jax.lax.stop_gradient(out - x)
+    return {"Out": out, "OutScale": scale}
+
+
+@register_op("dequantize_channel_wise_abs_max", differentiable=False)
+def dequantize_channel_wise_abs_max(inputs, attrs):
+    """reference: operators/fake_dequantize_op.cc
+    FakeChannelWiseDequantizeMaxAbs — Out = X * Scale[c] / max_range,
+    scale broadcast over dim 0 (int8 per-channel frozen weights)."""
+    import jax.numpy as jnp
+
+    x = one(inputs, "X")
+    scale = one(inputs, "Scale")
+    max_range = float(attrs.get("max_range", 127.0))
+    bshape = (x.shape[0],) + (1,) * (x.ndim - 1)
+    return {"Out": x.astype(jnp.float32) * (scale.reshape(bshape) / max_range)}
+
+
 @register_op("dequantize_abs_max", differentiable=False)
 def dequantize_abs_max(inputs, attrs):
     """reference: operators/fake_dequantize_op.cc fake_dequantize_max_abs
@@ -134,9 +171,12 @@ class QuantizationFreezePass:
             if op.type == "fake_quantize_dequantize_moving_average_abs_max":
                 op.attrs["is_test"] = True
                 frozen += 1
+        weight_fake_types = ("fake_quantize_dequantize_abs_max",
+                             "fake_channel_wise_quantize_dequantize_abs_max")
         for i, op in enumerate(list(block.ops)):
-            if op.type != "fake_quantize_dequantize_abs_max":
+            if op.type not in weight_fake_types:
                 continue
+            channel_wise = op.type.startswith("fake_channel_wise")
             xname = op.inputs["X"][0]
             var = block._find_var_recursive(xname)
             if not isinstance(var, framework.Parameter):
@@ -159,8 +199,16 @@ class QuantizationFreezePass:
                     "train (or run startup) before freezing" % xname
                 )
             w = np.asarray(wv)
-            scale = max(float(np.max(np.abs(w))), 1e-8)
-            wq = np.clip(np.round(w / scale * qmax), -qmax, qmax).astype(
+            if channel_wise:
+                flat = np.abs(w.reshape(w.shape[0], -1))
+                scale = np.maximum(flat.max(axis=1), 1e-8)       # [C]
+                s_b = scale.reshape((w.shape[0],) + (1,) * (w.ndim - 1))
+                scale_arr = scale.astype(np.float32)
+            else:
+                scale = max(float(np.max(np.abs(w))), 1e-8)
+                s_b = scale
+                scale_arr = np.asarray([scale], np.float32)
+            wq = np.clip(np.round(w / s_b * qmax), -qmax, qmax).astype(
                 np.int8
             )
             qname = xname + ".int8"
@@ -170,17 +218,18 @@ class QuantizationFreezePass:
                 persistable=True, stop_gradient=True,
             )
             block.create_var(
-                name=sname, shape=[1], dtype="float32",
+                name=sname, shape=[int(scale_arr.shape[0])], dtype="float32",
                 persistable=True, stop_gradient=True,
             )
             self._scope.set(qname, wq)
-            self._scope.set(sname, np.asarray([scale], np.float32))
+            self._scope.set(sname, scale_arr)
             out_name = op.outputs["Out"][0]
             idx = block.ops.index(op)
             block._remove_op(idx)
             block._insert_op(
                 idx,
-                type="dequantize_abs_max",
+                type=("dequantize_channel_wise_abs_max" if channel_wise
+                      else "dequantize_abs_max"),
                 inputs={"X": [qname], "Scale": [sname]},
                 outputs={"Out": [out_name]},
                 attrs={"max_range": qmax,
@@ -220,16 +269,25 @@ class QuantizationTransformPass:
     def __init__(self, quantizable_op_type=("conv2d", "depthwise_conv2d", "mul", "matmul"),
                  weight_bits: int = 8, activation_bits: int = 8,
                  activation_quantize_type: str = "abs_max",
+                 weight_quantize_type: str = "abs_max",
                  moving_rate: float = 0.9):
         if activation_quantize_type not in ("abs_max", "moving_average_abs_max"):
             raise ValueError(
                 "activation_quantize_type must be abs_max or "
-                "moving_average_abs_max (got %r)" % activation_quantize_type
+                "moving_average_abs_max (got %r; the reference also "
+                "forbids channel_wise for activations)"
+                % activation_quantize_type
+            )
+        if weight_quantize_type not in ("abs_max", "channel_wise_abs_max"):
+            raise ValueError(
+                "weight_quantize_type must be abs_max or "
+                "channel_wise_abs_max (got %r)" % weight_quantize_type
             )
         self.quantizable = set(quantizable_op_type)
         self.weight_bits = weight_bits
         self.activation_bits = activation_bits
         self.activation_quantize_type = activation_quantize_type
+        self.weight_quantize_type = weight_quantize_type
         self.moving_rate = moving_rate
 
     def _insert_moving_average(self, block, startup, i, n, v, bits):
@@ -293,6 +351,14 @@ class QuantizationTransformPass:
                         continue
                     is_weight = isinstance(v, framework.Parameter)
                     bits = self.weight_bits if is_weight else self.activation_bits
+                    # channel-wise only for CONV weights (the reference
+                    # pass applies _insert_channel_quant_op to
+                    # conv/depthwise weights; mul weights stay abs_max)
+                    channel_wise = (
+                        is_weight
+                        and self.weight_quantize_type == "channel_wise_abs_max"
+                        and op.type in ("conv2d", "depthwise_conv2d")
+                    )
                     if not is_weight and use_ma:
                         qname = self._insert_moving_average(
                             block, startup_program, i + inserted, n, v, bits
@@ -300,11 +366,15 @@ class QuantizationTransformPass:
                     else:
                         qname = unique_name.generate(n + ".quantized")
                         sname = unique_name.generate(n + ".quant_scale")
+                        n_ch = int(v.shape[0]) if channel_wise else 1
                         block.create_var(name=qname, shape=v.shape, dtype="float32")
-                        block.create_var(name=sname, shape=[1], dtype="float32", stop_gradient=True)
+                        block.create_var(name=sname, shape=[n_ch],
+                                         dtype="float32", stop_gradient=True)
                         block._insert_op(
                             i + inserted,
-                            type="fake_quantize_dequantize_abs_max",
+                            type=("fake_channel_wise_quantize_dequantize_abs_max"
+                                  if channel_wise
+                                  else "fake_quantize_dequantize_abs_max"),
                             inputs={"X": [n]},
                             outputs={"Out": [qname], "OutScale": [sname]},
                             attrs={"bit_length": bits, "op_role": op.attrs.get("op_role", "forward")},

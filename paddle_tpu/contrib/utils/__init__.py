@@ -33,6 +33,13 @@ class HDFSClient:
         return self._fs.fs_rm(path)
 
     def upload(self, hdfs_path, local_path, overwrite=False, retry_times=5):
+        if not overwrite and self.is_exist(hdfs_path):
+            # reference semantics: hadoop -put fails on an existing
+            # destination unless the caller asked to overwrite
+            raise FileExistsError(
+                "upload: %s exists (pass overwrite=True to replace)"
+                % hdfs_path
+            )
         with open(local_path, "rb") as src,                 self._fs.open_write(hdfs_path, "wb") as dst:
             dst.write(src.read())
 
@@ -162,7 +169,13 @@ def multi_upload(client, hdfs_path, local_path, multi_processes=5,
                  overwrite=False, sync=True):
     """reference: hdfs_utils.py:508 — upload every file under
     ``local_path`` concurrently (destination dirs created once, before
-    the pool — not one mkdir subprocess per file)."""
+    the pool — not one mkdir subprocess per file).
+
+    ``overwrite=False`` keeps existing destination files: the colliding
+    upload raises FileExistsError (per-file; other files still upload).
+    ``sync=False`` returns immediately with a list of futures (call
+    ``.result()`` to join); ``sync=True`` blocks and returns the
+    uploaded relative paths."""
     import concurrent.futures
     import os
 
@@ -186,8 +199,15 @@ def multi_upload(client, hdfs_path, local_path, multi_processes=5,
                       overwrite=overwrite)
         return rel
 
-    with concurrent.futures.ThreadPoolExecutor(max_workers=multi_processes) as ex:
-        return list(ex.map(put, files))
+    ex = concurrent.futures.ThreadPoolExecutor(max_workers=multi_processes)
+    futures = [ex.submit(put, f) for f in files]
+    if not sync:
+        ex.shutdown(wait=False)
+        return futures
+    try:
+        return [f.result() for f in futures]
+    finally:
+        ex.shutdown(wait=True)
 
 
 __all__ += ["convert_dist_to_sparse_program", "multi_download",

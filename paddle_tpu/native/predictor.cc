@@ -427,6 +427,7 @@ struct Predictor {
     if (type == "lookup_table" || type == "lookup_table_v2")
       return op_lookup(op);
     if (type == "dequantize_abs_max") return op_dequant(op);
+    if (type == "dequantize_channel_wise_abs_max") return op_dequant_cw(op);
     if (type == "fake_quantize_dequantize_abs_max") return op_fake_quant(op);
     if (type == "fake_quantize_dequantize_moving_average_abs_max")
       return op_fake_quant_ma(op);
@@ -1016,6 +1017,30 @@ struct Predictor {
       s.shape = {1};
       s.is_int = false;
       s.f = {scale};
+    }
+    return true;
+  }
+
+  // per-output-channel int8 weight dequant (QAT channel_wise freeze)
+  bool op_dequant_cw(const Json& op) {
+    const Tensor& x = in(op, "X");     // int8 loaded as fp32, dim0 = C
+    const Tensor& scale = in(op, "Scale");
+    float max_range = static_cast<float>(attr_num(op, "max_range", 127.0));
+    int64_t c = x.shape.empty() ? 0 : x.shape[0];
+    if (c <= 0 || static_cast<int64_t>(scale.f.size()) != c) {
+      err = "dequantize_channel_wise_abs_max: scale/channel mismatch";
+      return false;
+    }
+    int64_t per = static_cast<int64_t>(x.f.size()) / c;
+    Tensor& o = out(op, "Out");
+    o.shape = x.shape;
+    o.is_int = false;
+    o.f.resize(x.f.size());
+    for (int64_t ch = 0; ch < c; ++ch) {
+      float mul = scale.f[ch] / max_range;
+      const float* xi = &x.f[ch * per];
+      float* oo = &o.f[ch * per];
+      for (int64_t j = 0; j < per; ++j) oo[j] = xi[j] * mul;
     }
     return true;
   }

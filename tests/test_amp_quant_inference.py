@@ -255,6 +255,83 @@ def test_qat_moving_average_activation_scales(tmp_path):
         np.testing.assert_allclose(ng, np.asarray(g1), rtol=1e-5, atol=1e-6)
 
 
+def test_qat_channel_wise_weight_quantization(tmp_path):
+    """weight_quantize_type='channel_wise_abs_max' (reference:
+    quantization_pass.py _insert_channel_quant_op +
+    FakeChannelWiseQuantizeAbsMaxKernel): conv weights get one scale per
+    output channel; mul weights stay tensor-wise; freeze emits int8
+    per-channel weights + dequantize_channel_wise_abs_max with EXACT
+    parity, served natively."""
+    from paddle_tpu.contrib.slim.quantization import (
+        QuantizationTransformPass, freeze_program,
+    )
+
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 36
+    with framework.program_guard(prog, startup):
+        img = fluid.layers.data("img", [2, 6, 6])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        c = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                                padding=1, bias_attr=False)
+        c = fluid.layers.relu(c)
+        flat = fluid.layers.reshape(c, shape=[-1, 4 * 6 * 6])
+        pred = fluid.layers.fc(flat, 3, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+        QuantizationTransformPass(
+            weight_quantize_type="channel_wise_abs_max"
+        ).apply(prog)
+        fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+    types = [op.type for op in prog.global_block().ops]
+    assert "fake_channel_wise_quantize_dequantize_abs_max" in types
+    # exactly one channel-wise op (the conv weight); the fc weight stays
+    # tensor-wise abs_max
+    assert types.count("fake_channel_wise_quantize_dequantize_abs_max") == 1
+
+    rng = np.random.RandomState(8)
+    xb = rng.uniform(-1, 1, (2, 2, 6, 6)).astype("float32")
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # scale channels apart so per-channel quantization is non-trivial
+        for p in prog.all_parameters():
+            if p.name.startswith("conv2d"):
+                w = np.asarray(scope.get(p.name))
+                mult = np.linspace(0.1, 3.0, w.shape[0]).reshape(
+                    -1, *([1] * (w.ndim - 1)))
+                scope.set(p.name, (w * mult).astype(w.dtype))
+        for _ in range(3):
+            exe.run(prog, feed={
+                "img": rng.uniform(-1, 1, (8, 2, 6, 6)).astype("float32"),
+                "y": rng.randint(0, 3, (8, 1)).astype("int64"),
+            }, fetch_list=[loss])
+        test_prog = prog.clone(for_test=True)
+        (want,) = exe.run(test_prog,
+                          feed={"img": xb, "y": np.zeros((2, 1), "int64")},
+                          fetch_list=[pred])
+        frozen = freeze_program(prog.clone(for_test=True), scope)
+        ftypes = [op.type for op in frozen.global_block().ops]
+        assert "dequantize_channel_wise_abs_max" in ftypes
+        cw_ops = [op for op in frozen.global_block().ops
+                  if op.type == "dequantize_channel_wise_abs_max"]
+        sc = np.asarray(scope.get(cw_ops[0].inputs["Scale"][0]))
+        assert sc.shape == (4,) and len(set(np.round(sc, 5))) > 1
+        (got,) = exe.run(frozen,
+                         feed={"img": xb, "y": np.zeros((2, 1), "int64")},
+                         fetch_list=[pred])
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-7)
+        fluid.save_inference_model(str(tmp_path / "cw"), ["img"], [pred],
+                                   exe, frozen)
+
+    from paddle_tpu.native import NativePredictor, _predictor_lib
+
+    if _predictor_lib() is not None:
+        (ng,) = NativePredictor(str(tmp_path / "cw")).run({"img": xb})
+        np.testing.assert_allclose(ng, np.asarray(want), rtol=1e-5,
+                                   atol=1e-6)
+
+
 def test_quantize_transpiler_freeze_surface():
     """contrib.quantize.QuantizeTranspiler.freeze_program reaches the
     slim freeze pass (reference: quantize_transpiler.py)."""
