@@ -13,6 +13,7 @@ class QuantizeTranspiler:
         self.weight_bits = weight_bits
         self.activation_bits = activation_bits
         self.activation_quantize_type = activation_quantize_type
+        self.weight_quantize_type = weight_quantize_type
 
     def training_transpile(self, program=None, startup_program=None):
         from paddle_tpu import framework
@@ -25,6 +26,7 @@ class QuantizeTranspiler:
             weight_bits=self.weight_bits,
             activation_bits=self.activation_bits,
             activation_quantize_type=self.activation_quantize_type,
+            weight_quantize_type=self.weight_quantize_type,
         ).apply(program, startup_program=startup_program)
         return program
 

@@ -431,6 +431,7 @@ struct Predictor {
     if (type == "fake_quantize_dequantize_abs_max") return op_fake_quant(op);
     if (type == "fake_quantize_dequantize_moving_average_abs_max")
       return op_fake_quant_ma(op);
+    if (type == "moving_average_abs_max_scale") return op_ma_scale(op);
     if (type == "cast") return op_cast(op);
     if (type == "conv2d") return op_conv2d(op);
     if (type == "pool2d") return op_pool2d(op);
@@ -1018,6 +1019,19 @@ struct Predictor {
       s.is_int = false;
       s.f = {scale};
     }
+    return true;
+  }
+
+  // out-scale recorder (ScaleForTrainingPass): identity passthrough at
+  // inference; the recorded threshold lives in the op attrs/scope
+  bool op_ma_scale(const Json& op) {
+    if (attr_num(op, "is_test", 0.0) == 0.0) {
+      err = "moving_average_abs_max_scale: only is_test=True supported "
+            "natively — apply ScaleForInferencePass before export";
+      return false;
+    }
+    const Tensor& x = in(op, "X");
+    out(op, "Out") = x;
     return true;
   }
 

@@ -686,3 +686,102 @@ def test_float16_inference_transpiler():
     # bn statistics stay fp32 (the keep-fp32 set)
     assert not any("batch_norm" in c for c in cast)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(low), atol=2e-2)
+
+
+def test_scale_passes_and_add_quant_dequant():
+    """ScaleForTrainingPass records per-op output thresholds via
+    moving_average_abs_max_scale recorders (identity forward),
+    ScaleForInferencePass stamps them as out_threshold attrs, and
+    AddQuantDequantPass quantizes non-matmul op inputs (reference:
+    quantization_pass.py ScaleForTrainingPass/ScaleForInferencePass/
+    AddQuantDequantPass)."""
+    from paddle_tpu.contrib.slim.quantization import (
+        AddQuantDequantPass, ConvertToInt8Pass, ScaleForInferencePass,
+        ScaleForTrainingPass,
+    )
+
+    prog, startup, loss, pred = _mlp_program(seed=38)
+    with framework.program_guard(prog, startup):
+        ScaleForTrainingPass().apply(prog, startup)
+        fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+    types = [op.type for op in prog.global_block().ops]
+    assert types.count("moving_average_abs_max_scale") == 2  # two muls
+
+    rng = np.random.RandomState(11)
+    feed = {
+        "x": rng.uniform(-1, 1, (16, 16)).astype("float32"),
+        "y": rng.randint(0, 4, (16, 1)).astype("int64"),
+    }
+    xb = rng.uniform(-1, 1, (4, 16)).astype("float32")
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # baseline program without recorders: identical numerics
+        ref_prog, ref_startup, ref_loss, _ = _mlp_program(seed=38)
+        with framework.program_guard(ref_prog, ref_startup):
+            fluid.optimizer.SGDOptimizer(0.05).minimize(ref_loss)
+        for _ in range(4):
+            (l,) = exe.run(prog, feed=feed, fetch_list=[loss])
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(ref_startup)
+            for _ in range(4):
+                (lr_,) = exe.run(ref_prog, feed=feed, fetch_list=[ref_loss])
+        np.testing.assert_allclose(np.asarray(l), np.asarray(lr_),
+                                   rtol=1e-5, atol=1e-6)
+
+        infer = prog.clone(for_test=True)
+        ScaleForInferencePass(scope).apply(infer)
+        stamped = [op.attrs.get("out_threshold")
+                   for op in infer.global_block().ops
+                   if op.type == "mul"]
+        assert len(stamped) == 2 and all(
+            t is not None and t > 0 for t in stamped), stamped
+        (w1,) = exe.run(infer, feed={"x": xb, "y": np.zeros((4, 1), "int64")},
+                        fetch_list=[pred])
+        (w2,) = exe.run(infer, feed={"x": xb, "y": np.zeros((4, 1), "int64")},
+                        fetch_list=[pred])
+        np.testing.assert_array_equal(np.asarray(w1), np.asarray(w2))
+
+    # AddQuantDequantPass: quantizes elementwise_add/pool2d ACTIVATION
+    # inputs only — a bias Parameter feeding elementwise_add (the fc
+    # bias-add) must NOT be fake-quantized (review r5)
+    p2, s2 = framework.Program(), framework.Program()
+    p2.random_seed = s2.random_seed = 39
+    with framework.program_guard(p2, s2):
+        a = fluid.layers.data("a", [2, 4, 4])
+        b = fluid.layers.data("b", [2, 4, 4])
+        c = fluid.layers.elementwise_add(a, b)
+        pooled = fluid.layers.pool2d(c, pool_size=2, pool_stride=2)
+        flat = fluid.layers.reshape(pooled, shape=[-1, 2 * 2 * 2])
+        h = fluid.layers.fc(flat, 4)  # emits elementwise_add(tmp, bias)
+        AddQuantDequantPass().apply(p2, s2)
+    blk2 = p2.global_block()
+    for op in blk2.ops:
+        if op.type == "fake_quantize_dequantize_moving_average_abs_max":
+            v = blk2._find_var_recursive(op.inputs["X"][0])
+            assert not isinstance(v, framework.Parameter), op.inputs
+    t2 = [op.type for op in blk2.ops]
+    assert t2.count("fake_quantize_dequantize_moving_average_abs_max") >= 3
+
+    # ConvertToInt8Pass: works standalone AND as the reference's
+    # freeze-then-convert sequence (second application is a no-op)
+    prog3, startup3, loss3, pred3 = _mlp_program(seed=40)
+    with framework.program_guard(prog3, startup3):
+        from paddle_tpu.contrib.slim.quantization import (
+            QuantizationFreezePass, QuantizationTransformPass,
+        )
+
+        QuantizationTransformPass().apply(prog3)
+        fluid.optimizer.SGDOptimizer(0.05).minimize(loss3)
+    sc3 = fluid.Scope()
+    with fluid.scope_guard(sc3):
+        exe.run(startup3)
+        frozen = prog3.clone(for_test=True)
+        QuantizationFreezePass(sc3).apply(frozen)
+        ConvertToInt8Pass(sc3).apply(frozen)  # no-op, must not raise
+        frozen2 = prog3.clone(for_test=True)
+        ConvertToInt8Pass(sc3).apply(frozen2)  # standalone convert
+    for f in (frozen, frozen2):
+        assert any(op.type == "dequantize_abs_max"
+                   for op in f.global_block().ops)
