@@ -29,7 +29,10 @@ import numpy as np
 
 CHUNK = int(os.environ.get("OPBENCH_CHUNK", "10"))
 REPEATS = int(os.environ.get("OPBENCH_REPEATS", "3"))
-REGRESSION_PCT = 25.0
+# --check threshold; override with OPBENCH_REGRESSION_PCT.  On a shared
+# CPU box expect 30-50% run-to-run noise (raise the threshold or bump
+# OPBENCH_REPEATS); TPU timings through the executor are far steadier.
+REGRESSION_PCT = float(os.environ.get("OPBENCH_REGRESSION_PCT", "25"))
 
 # (key, op_type, inputs {slot: [(name, shape, dtype)]}, attrs,
 #  output slots — FIRST one is fetched/timed)
