@@ -84,6 +84,39 @@ def fake_quantize_dequantize_moving_average_abs_max(inputs, attrs):
     return {"Out": out, "OutScale": scale.reshape(1), **extra}
 
 
+@register_op("fake_quantize_dequantize_range_abs_max",
+             no_grad_set={"InScale", "InScales", "Iter"})
+def fake_quantize_dequantize_range_abs_max(inputs, attrs):
+    """reference: operators/fake_quantize_op.cc FakeQuantizeRangeAbsMax
+    + FindRangeAbsMaxFunctor — activation scale = max over a sliding
+    WINDOW of per-batch abs-max values (window_size slots, ring-buffer
+    indexed by the step counter); test mode uses the stored InScale.
+    Straight-through under vjp."""
+    import jax
+    import jax.numpy as jnp
+
+    x = one(inputs, "X")
+    bits = attrs.get("bit_length", 8)
+    qmax = float(2 ** (bits - 1) - 1)
+    if bool(attrs.get("is_test", False)):
+        scale = jnp.maximum(one(inputs, "InScale").reshape(()), 1e-8)
+        extra = {}
+    else:
+        window = one(inputs, "InScales")
+        it = one(inputs, "Iter").reshape(()).astype(jnp.int32)
+        cur = jnp.max(jnp.abs(x))
+        idx = jnp.mod(it, window.shape[0])
+        window = window.at[idx].set(cur)
+        n_valid = jnp.minimum(it + 1, window.shape[0])
+        valid = jnp.arange(window.shape[0]) < n_valid
+        scale = jnp.maximum(jnp.max(jnp.where(valid, window, -jnp.inf)), 1e-8)
+        extra = {"OutScales": window,
+                 "IterOut": (it + 1).astype(jnp.int32).reshape(1)}
+    q = jnp.clip(jnp.round(x / scale * qmax), -qmax, qmax)
+    out = x + jax.lax.stop_gradient(q * scale / qmax - x)
+    return {"Out": out, "OutScale": scale.reshape(1), **extra}
+
+
 @register_op("fake_channel_wise_quantize_dequantize_abs_max")
 def fake_channel_wise_quantize_dequantize_abs_max(inputs, attrs):
     """reference: operators/fake_quantize_op.cc:521
@@ -136,23 +169,31 @@ def dequantize_abs_max(inputs, attrs):
     return {"Out": x.astype(jnp.float32) * (scale.reshape(()) / max_range)}
 
 
-def _create_ma_state_vars(block, startup_block, base_name):
-    """Create the (scale, state, accum) persistable triple with the
-    reference inits (0.001 / 1 / 1) plus their startup fill_constants;
-    shared by the MA quantizers and the out-scale recorders."""
+_MA_STATE_SPECS = (("scale", [1], 0.001, "float32"),
+                   ("state", [1], 1.0, "float32"),
+                   ("accum", [1], 1.0, "float32"))
+
+
+def _create_ma_state_vars(block, startup_block, base_name,
+                          specs=_MA_STATE_SPECS):
+    """Create persistable quantizer-state vars + their startup
+    fill_constant initializers; shared by the MA quantizers, the
+    out-scale recorders, and the range-window quantizer.  ``specs``:
+    (suffix, shape, init_value, dtype) tuples — defaults to the
+    reference MA triple (scale 0.001, state 1, accum 1)."""
     names = {}
-    for suffix, init in (("scale", 0.001), ("state", 1.0), ("accum", 1.0)):
+    for suffix, shape, init, dtype in specs:
         vn = unique_name.generate("%s.quant_%s" % (base_name, suffix))
-        block.create_var(name=vn, shape=[1], dtype="float32",
+        block.create_var(name=vn, shape=list(shape), dtype=dtype,
                          persistable=True, stop_gradient=True)
         if startup_block is not None:
-            startup_block.create_var(name=vn, shape=[1], dtype="float32",
+            startup_block.create_var(name=vn, shape=list(shape), dtype=dtype,
                                      persistable=True, stop_gradient=True)
             startup_block.append_op(
                 type="fill_constant", inputs={},
                 outputs={"Out": [vn]},
-                attrs={"shape": [1], "value": float(init),
-                       "dtype": "float32"},
+                attrs={"shape": list(shape), "value": float(init),
+                       "dtype": dtype},
             )
         names[suffix] = vn
     return names
@@ -171,9 +212,10 @@ class QuantizationFreezePass:
     multiply into the consuming matmul/conv.  Activation handling
     depends on how QAT quantized them: ``abs_max`` (dynamic) ops are
     kept as-is — the per-batch scale IS the trained behavior — while
-    ``moving_average_abs_max`` ops get their trained persisted scale
-    FIXED (``is_test=True``; no further state mutation), matching the
-    reference freeze's recorded-scale semantics.  Frozen output
+    ``moving_average_abs_max`` and ``range_abs_max`` ops get their
+    trained persisted scale FIXED (``is_test=True``; no further state
+    mutation), matching the reference freeze's recorded-scale
+    semantics.  Frozen output
     therefore matches the fake-quant program exactly, and the program
     stays AnalysisPredictor-loadable.
     """
@@ -192,7 +234,8 @@ class QuantizationFreezePass:
         # (is_test) so inference uses the converged value and never
         # mutates state (reference freeze keeps the recorded scales)
         for op in block.ops:
-            if op.type == "fake_quantize_dequantize_moving_average_abs_max":
+            if op.type in ("fake_quantize_dequantize_moving_average_abs_max",
+                           "fake_quantize_dequantize_range_abs_max"):
                 op.attrs["is_test"] = True
                 frozen += 1
         weight_fake_types = ("fake_quantize_dequantize_abs_max",
@@ -435,6 +478,10 @@ class QuantizationTransformPass:
       ``startup_program=`` to ``apply`` so the state vars get their
       initializers.  The freeze pass then fixes activation scales to
       the trained values (is_test).
+    * ``"range_abs_max"`` — scale = max over a sliding ``window_size``
+      window of per-batch abs-max values (persistable window + int32
+      step counter); also needs ``startup_program=`` and is fixed at
+      freeze like the moving-average mode.
     """
 
     def __init__(self, quantizable_op_type=("conv2d", "depthwise_conv2d", "mul", "matmul"),
@@ -442,12 +489,14 @@ class QuantizationTransformPass:
                  activation_quantize_type: str = "abs_max",
                  weight_quantize_type: str = "abs_max",
                  moving_rate: float = 0.9,
+                 window_size: int = 10000,
                  skip_weights: bool = False):
-        if activation_quantize_type not in ("abs_max", "moving_average_abs_max"):
+        if activation_quantize_type not in (
+                "abs_max", "moving_average_abs_max", "range_abs_max"):
             raise ValueError(
-                "activation_quantize_type must be abs_max or "
-                "moving_average_abs_max (got %r; the reference also "
-                "forbids channel_wise for activations)"
+                "activation_quantize_type must be abs_max, "
+                "moving_average_abs_max, or range_abs_max (got %r; the "
+                "reference also forbids channel_wise for activations)"
                 % activation_quantize_type
             )
         if weight_quantize_type not in ("abs_max", "channel_wise_abs_max"):
@@ -461,6 +510,7 @@ class QuantizationTransformPass:
         self.activation_quantize_type = activation_quantize_type
         self.weight_quantize_type = weight_quantize_type
         self.moving_rate = moving_rate
+        self.window_size = window_size
         # AddQuantDequantPass mode: quantize only ACTIVATION inputs —
         # a bias Parameter feeding elementwise_add must not be
         # fake-quantized (the reference pass skips persistables)
@@ -485,13 +535,40 @@ class QuantizationTransformPass:
         )
         return qname
 
+    def _insert_range(self, block, startup, i, n, v, bits):
+        qname = unique_name.generate(n + ".quantized")
+        block.create_var(name=qname, shape=v.shape, dtype="float32")
+        # Iter is int32 like the reference's integer tensor — a float32
+        # counter silently stops advancing at 2^24 steps, freezing the
+        # ring buffer on one slot
+        names = _create_ma_state_vars(
+            block, startup.global_block(), n,
+            specs=(("scale", [1], 0.001, "float32"),
+                   ("scales", [self.window_size], 0.0, "float32"),
+                   ("iter", [1], 0, "int32")),
+        )
+        block._insert_op(
+            i,
+            type="fake_quantize_dequantize_range_abs_max",
+            inputs={"X": [n], "InScale": [names["scale"]],
+                    "InScales": [names["scales"]], "Iter": [names["iter"]]},
+            outputs={"Out": [qname], "OutScale": [names["scale"]],
+                     "OutScales": [names["scales"]],
+                     "IterOut": [names["iter"]]},
+            attrs={"bit_length": bits, "window_size": self.window_size,
+                   "is_test": False, "op_role": "forward"},
+        )
+        return qname
+
     def apply(self, program, startup_program=None) -> None:
         block = program.global_block()
-        use_ma = self.activation_quantize_type == "moving_average_abs_max"
-        if use_ma and startup_program is None:
+        act_mode = self.activation_quantize_type
+        use_ma = act_mode == "moving_average_abs_max"
+        use_range = act_mode == "range_abs_max"
+        if (use_ma or use_range) and startup_program is None:
             raise ValueError(
-                "moving_average_abs_max needs startup_program= so the "
-                "scale/state/accum vars get initializers"
+                "%s needs startup_program= so the scale-state vars get "
+                "initializers" % act_mode
             )
         # one quantizer per VAR (reference: dequantized_vars cache) — an
         # activation feeding two quantizable ops shares one scale/state
@@ -528,6 +605,10 @@ class QuantizationTransformPass:
                     )
                     if not is_weight and use_ma:
                         qname = self._insert_moving_average(
+                            block, startup_program, i + inserted, n, v, bits
+                        )
+                    elif not is_weight and use_range:
+                        qname = self._insert_range(
                             block, startup_program, i + inserted, n, v, bits
                         )
                     else:

@@ -429,8 +429,9 @@ struct Predictor {
     if (type == "dequantize_abs_max") return op_dequant(op);
     if (type == "dequantize_channel_wise_abs_max") return op_dequant_cw(op);
     if (type == "fake_quantize_dequantize_abs_max") return op_fake_quant(op);
-    if (type == "fake_quantize_dequantize_moving_average_abs_max")
-      return op_fake_quant_ma(op);
+    if (type == "fake_quantize_dequantize_moving_average_abs_max" ||
+        type == "fake_quantize_dequantize_range_abs_max")
+      return op_fake_quant_ma(op);  // is_test form: fixed InScale
     if (type == "moving_average_abs_max_scale") return op_ma_scale(op);
     if (type == "cast") return op_cast(op);
     if (type == "conv2d") return op_conv2d(op);
@@ -1059,14 +1060,13 @@ struct Predictor {
     return true;
   }
 
-  // moving-average activation quantizer, inference form: the trained
-  // InScale is fixed (the freeze pass sets is_test); training-mode
-  // state updates are a Python-path concern
+  // stateful activation quantizers (moving-average / range), inference
+  // form: the trained InScale is fixed (the freeze pass sets is_test);
+  // training-mode state updates are a Python-path concern
   bool op_fake_quant_ma(const Json& op) {
     if (attr_num(op, "is_test", 0.0) == 0.0) {
-      err = "fake_quantize_dequantize_moving_average_abs_max: only "
-            "is_test=True (frozen scales) supported natively — freeze "
-            "the program first";
+      err = "stateful fake-quant op: only is_test=True (frozen scales) "
+            "supported natively — freeze the program first";
       return false;
     }
     const Tensor& x = in(op, "X");

@@ -785,3 +785,62 @@ def test_scale_passes_and_add_quant_dequant():
     for f in (frozen, frozen2):
         assert any(op.type == "dequantize_abs_max"
                    for op in f.global_block().ops)
+
+
+def test_qat_range_abs_max_activation_scales(tmp_path):
+    """activation_quantize_type='range_abs_max' (reference:
+    FakeQuantizeRangeAbsMax + FindRangeAbsMaxFunctor): the activation
+    scale is the max over a sliding window of per-batch abs-max values;
+    freeze fixes it (is_test) and the frozen export serves natively."""
+    from paddle_tpu.contrib.slim.quantization import (
+        QuantizationTransformPass, freeze_program,
+    )
+
+    prog, startup, loss, pred = _mlp_program(seed=44)
+    with framework.program_guard(prog, startup):
+        QuantizationTransformPass(
+            activation_quantize_type="range_abs_max", window_size=4
+        ).apply(prog, startup_program=startup)
+        fluid.optimizer.SGDOptimizer(0.02).minimize(loss)
+    types = [op.type for op in prog.global_block().ops]
+    assert "fake_quantize_dequantize_range_abs_max" in types
+    rq = [op for op in prog.global_block().ops
+          if op.type == "fake_quantize_dequantize_range_abs_max"][0]
+    scale_var, iter_var = rq.inputs["InScale"][0], rq.inputs["Iter"][0]
+
+    rng = np.random.RandomState(13)
+    xb = rng.uniform(-1, 1, (4, 16)).astype("float32")
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # feed batches with a decaying amplitude: the windowed max must
+        # FORGET the early large batches once they leave the window
+        amps = [4.0, 2.0, 1.0, 0.5, 0.5, 0.5, 0.5, 0.5]
+        scales = []
+        for a in amps:
+            exe.run(prog, feed={
+                "x": (a * rng.uniform(-1, 1, (16, 16))).astype("float32"),
+                "y": rng.randint(0, 4, (16, 1)).astype("int64"),
+            }, fetch_list=[loss])
+            scales.append(float(np.asarray(scope.get(scale_var))))
+        assert int(float(np.asarray(scope.get(iter_var)))) == len(amps)
+        # first step's scale reflects the 4.0-amp batch; by the end the
+        # window only holds ~0.5-amp batches
+        assert scales[0] > 2.0 and scales[-1] < 1.0, scales
+
+        frozen = freeze_program(prog.clone(for_test=True), scope)
+        (g1,) = exe.run(frozen, feed={"x": xb, "y": np.zeros((4, 1), "int64")},
+                        fetch_list=[pred])
+        (g2,) = exe.run(frozen, feed={"x": xb, "y": np.zeros((4, 1), "int64")},
+                        fetch_list=[pred])
+        np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+        assert int(float(np.asarray(scope.get(iter_var)))) == len(amps)
+        fluid.save_inference_model(str(tmp_path / "rg"), ["x"], [pred],
+                                   exe, frozen)
+
+    from paddle_tpu.native import NativePredictor, _predictor_lib
+
+    if _predictor_lib() is not None:
+        (ng,) = NativePredictor(str(tmp_path / "rg")).run({"x": xb})
+        np.testing.assert_allclose(ng, np.asarray(g1), rtol=1e-5, atol=1e-6)
