@@ -219,7 +219,7 @@ def test_qat_moving_average_activation_scales(tmp_path):
     exe = fluid.Executor(fluid.CPUPlace())
     with fluid.scope_guard(scope):
         exe.run(startup)
-        s0 = float(np.asarray(scope.get(scale_var)))
+        s0 = np.asarray(scope.get(scale_var)).item()
         assert abs(s0 - 0.001) < 1e-8  # reference init
         scales = []
         for _ in range(6):
@@ -227,7 +227,7 @@ def test_qat_moving_average_activation_scales(tmp_path):
                 "x": rng.uniform(-1, 1, (16, 16)).astype("float32"),
                 "y": rng.randint(0, 4, (16, 1)).astype("int64"),
             }, fetch_list=[loss])
-            scales.append(float(np.asarray(scope.get(scale_var))))
+            scales.append(np.asarray(scope.get(scale_var)).item())
         # the persisted scale moves toward the running abs-max (~1.0
         # for U(-1,1) inputs) and keeps updating across steps
         assert scales[0] > s0 and scales[-1] > 0.3, scales
@@ -239,12 +239,12 @@ def test_qat_moving_average_activation_scales(tmp_path):
                 assert op.attrs["is_test"] is True
         (g1,) = exe.run(frozen, feed={"x": xb, "y": np.zeros((4, 1), "int64")},
                         fetch_list=[pred])
-        s_after = float(np.asarray(scope.get(scale_var)))
+        s_after = np.asarray(scope.get(scale_var)).item()
         (g2,) = exe.run(frozen, feed={"x": xb, "y": np.zeros((4, 1), "int64")},
                         fetch_list=[pred])
         # frozen: deterministic, and state no longer mutates
         np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
-        assert float(np.asarray(scope.get(scale_var))) == s_after
+        assert np.asarray(scope.get(scale_var)).item() == s_after
         fluid.save_inference_model(str(tmp_path / "ma"), ["x"], [pred],
                                    exe, frozen)
 
@@ -823,8 +823,8 @@ def test_qat_range_abs_max_activation_scales(tmp_path):
                 "x": (a * rng.uniform(-1, 1, (16, 16))).astype("float32"),
                 "y": rng.randint(0, 4, (16, 1)).astype("int64"),
             }, fetch_list=[loss])
-            scales.append(float(np.asarray(scope.get(scale_var))))
-        assert int(float(np.asarray(scope.get(iter_var)))) == len(amps)
+            scales.append(np.asarray(scope.get(scale_var)).item())
+        assert int(np.asarray(scope.get(iter_var)).item()) == len(amps)
         # first step's scale reflects the 4.0-amp batch; by the end the
         # window only holds ~0.5-amp batches
         assert scales[0] > 2.0 and scales[-1] < 1.0, scales
@@ -835,7 +835,7 @@ def test_qat_range_abs_max_activation_scales(tmp_path):
         (g2,) = exe.run(frozen, feed={"x": xb, "y": np.zeros((4, 1), "int64")},
                         fetch_list=[pred])
         np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
-        assert int(float(np.asarray(scope.get(iter_var)))) == len(amps)
+        assert int(np.asarray(scope.get(iter_var)).item()) == len(amps)
         fluid.save_inference_model(str(tmp_path / "rg"), ["x"], [pred],
                                    exe, frozen)
 
