@@ -11,6 +11,8 @@ from paddle_tpu.contrib import reader  # noqa: F401,E402
 from paddle_tpu.contrib import utils  # noqa: F401,E402
 from paddle_tpu.contrib import decoder  # noqa: F401,E402
 from paddle_tpu.contrib import layers  # noqa: F401,E402
+from paddle_tpu.contrib import trainer  # noqa: F401,E402
+from paddle_tpu.contrib import inferencer  # noqa: F401,E402
 from paddle_tpu.contrib.memory_usage_calc import memory_usage  # noqa: F401,E402
 from paddle_tpu.contrib.op_frequence import op_freq_statistic  # noqa: F401,E402
 from paddle_tpu.contrib.model_stat import summary  # noqa: F401,E402

@@ -1,0 +1,5 @@
+"""reference: contrib/inferencer.py — re-export (the implementation
+lives beside Trainer in contrib/trainer.py)."""
+from paddle_tpu.contrib.trainer import Inferencer  # noqa: F401
+
+__all__ = ["Inferencer"]

@@ -1,0 +1,215 @@
+"""High-level Trainer/Inferencer API (reference: contrib/trainer.py +
+contrib/inferencer.py — the book chapters' train(num_epochs,
+event_handler, reader) loop with Begin/End Epoch/Step events and a
+param_path handoff to the Inferencer)."""
+from __future__ import annotations
+
+import contextlib
+
+from paddle_tpu import framework, io, unique_name
+from paddle_tpu.executor import Executor
+from paddle_tpu.framework import CPUPlace
+from paddle_tpu.scope import Scope, scope_guard
+
+__all__ = ["BeginEpochEvent", "EndEpochEvent", "BeginStepEvent",
+           "EndStepEvent", "CheckpointConfig", "Trainer", "Inferencer"]
+
+
+class BeginEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig:
+    """reference: trainer.py:122 — periodic persistable saves."""
+
+    def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
+                 epoch_interval=1, step_interval=10):
+        self.checkpoint_dir = checkpoint_dir
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = max(1, int(epoch_interval))
+        self.step_interval = max(1, int(step_interval))
+
+
+class Trainer:
+    """reference: contrib/trainer.py:169.
+
+    ``train_func`` builds the net and returns ``[loss]`` (or
+    ``[loss, *metrics]``); ``optimizer_func`` returns the optimizer.
+    ``train`` iterates ``reader()`` batches in ``feed_order``, firing
+    the event objects above through ``event_handler``.
+    """
+
+    def __init__(self, train_func, optimizer_func, param_path=None,
+                 place=None, parallel=False, checkpoint_config=None):
+        self.place = place or CPUPlace()
+        self.scope = Scope()
+        self._stopped = False
+        self.checkpoint_cfg = checkpoint_config
+
+        self._checkpoint_serial = 0
+        self.startup_program = framework.Program()
+        self.train_program = framework.Program()
+        with framework.program_guard(self.train_program,
+                                     self.startup_program):
+            with unique_name.guard():
+                outs = train_func()
+                if not isinstance(outs, (list, tuple)):
+                    outs = [outs]
+                self.train_func_outputs = list(outs)
+                self.loss = outs[0]
+                optimizer = optimizer_func()
+                optimizer.minimize(self.loss)
+        self.test_program = self.train_program.clone(for_test=True)
+
+        self.exe = Executor(self.place)
+        with self._prog_and_scope_guard():
+            self.exe.run(self.startup_program)
+            if param_path:
+                io.load_persistables(self.exe, param_path,
+                                     main_program=self.train_program)
+
+    @contextlib.contextmanager
+    def _prog_and_scope_guard(self):
+        with framework.program_guard(self.train_program,
+                                     self.startup_program):
+            with scope_guard(self.scope):
+                yield
+
+    def stop(self):
+        """Break out of ``train`` after the current step."""
+        self._stopped = True
+
+    def _feed(self, feed_order, batch):
+        if len(batch) != len(feed_order):
+            raise ValueError(
+                "feed_order has %d names but the reader batch has %d "
+                "elements (%s)" % (len(feed_order), len(batch),
+                                   list(feed_order))
+            )
+        return {name: data for name, data in zip(feed_order, batch)}
+
+    def _save_checkpoint(self):
+        """Numbered snapshots with rotation (reference: trainer.py
+        _save_checkpoint + clean_checkpoint)."""
+        import os
+        import shutil
+
+        cfg = self.checkpoint_cfg
+        serial = self._checkpoint_serial
+        self._checkpoint_serial += 1
+        path = os.path.join(cfg.checkpoint_dir, "checkpoint_%d" % serial)
+        io.save_persistables(self.exe, path, self.train_program)
+        drop = serial - cfg.max_num_checkpoints
+        if drop >= 0:
+            stale = os.path.join(cfg.checkpoint_dir, "checkpoint_%d" % drop)
+            shutil.rmtree(stale, ignore_errors=True)
+
+    def train(self, num_epochs, event_handler, reader=None, feed_order=None):
+        if reader is None or feed_order is None:
+            raise ValueError("train needs reader= and feed_order=")
+        self._stopped = False
+        fetch = [v.name for v in self.train_func_outputs]
+        with self._prog_and_scope_guard():
+            step = 0
+            for epoch_id in range(num_epochs):
+                event_handler(BeginEpochEvent(epoch_id))
+                for step_id, batch in enumerate(reader()):
+                    if self._stopped:
+                        return
+                    begin = BeginStepEvent(epoch_id, step_id)
+                    event_handler(begin)
+                    metrics = self.exe.run(
+                        self.train_program,
+                        feed=self._feed(feed_order, batch),
+                        fetch_list=fetch if begin.fetch_metrics else [],
+                    )
+                    event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                    step += 1
+                    cfg = self.checkpoint_cfg
+                    if (cfg and cfg.checkpoint_dir
+                            and step % cfg.step_interval == 0):
+                        self._save_checkpoint()
+                event_handler(EndEpochEvent(epoch_id))
+                cfg = self.checkpoint_cfg
+                if (cfg and cfg.checkpoint_dir
+                        and (epoch_id + 1) % cfg.epoch_interval == 0):
+                    self._save_checkpoint()
+
+    def test(self, reader, feed_order):
+        """Mean of each train_func output over the reader (the
+        reference's _test_by_executor path)."""
+        import numpy as np
+
+        fetch = [v.name for v in self.train_func_outputs]
+        sums, count = None, 0
+        with self._prog_and_scope_guard():
+            for batch in reader():
+                vals = self.exe.run(self.test_program,
+                                    feed=self._feed(feed_order, batch),
+                                    fetch_list=fetch)
+                vals = [np.asarray(v).mean() for v in vals]
+                sums = vals if sums is None else [a + b for a, b in
+                                                  zip(sums, vals)]
+                count += 1
+        return [s / max(count, 1) for s in (sums or [])]
+
+    def save_params(self, param_path):
+        with self._prog_and_scope_guard():
+            io.save_persistables(self.exe, param_path, self.train_program)
+
+    def save_inference_model(self, param_path, feeded_var_names,
+                             target_var_indexes):
+        with self._prog_and_scope_guard():
+            io.save_inference_model(
+                param_path, feeded_var_names,
+                [self.train_func_outputs[i] for i in target_var_indexes],
+                self.exe, self.test_program)
+
+
+class Inferencer:
+    """reference: contrib/inferencer.py:31 — rebuild the net via
+    ``infer_func`` (returns the predict var), load params from
+    ``param_path``, and ``infer({name: array})``."""
+
+    def __init__(self, infer_func, param_path, place=None, parallel=False):
+        self.place = place or CPUPlace()
+        self.scope = Scope()
+        self.inference_program = framework.Program()
+        startup = framework.Program()
+        with framework.program_guard(self.inference_program, startup):
+            with unique_name.guard():
+                self.predict_var = infer_func()
+        self.exe = Executor(self.place)
+        with scope_guard(self.scope):
+            io.load_params(self.exe, param_path,
+                           main_program=self.inference_program)
+        self.inference_program = self.inference_program.clone(for_test=True)
+
+    def infer(self, inputs, return_numpy=True):
+        if not isinstance(inputs, dict):
+            raise ValueError(
+                "inputs should be a map of {'input_name': input_var}")
+        with scope_guard(self.scope):
+            return self.exe.run(self.inference_program, feed=inputs,
+                                fetch_list=[self.predict_var.name],
+                                return_numpy=return_numpy)
