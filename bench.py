@@ -11,9 +11,10 @@ BASELINE.json configs; LeNet is the tests' parity config).  ResNet-50
 ships with a measured calibration: ``pure_jax_step_ms`` times a
 hand-written, framework-free JAX ResNet-50 step (bench_calibration.py)
 in the same process, and ``framework_overhead_pct`` is
-(framework - pure)/pure — measured 1.23% at bs256, the evidence that
-ResNet-50's 13.4% MFU is the XLA ceiling for this model/layout, not
-framework overhead (probe record: BASELINE.md round-4 tables).
+(framework - pure)/pure — measured -0.02% at bs256/chunk10-fresh in the
+matching regime (r5), the evidence that ResNet-50's ~13.5% MFU is the
+XLA ceiling for this model/layout, not framework overhead (probe
+record: BASELINE.md round-5 tables).
 
 Both paths run CHUNK training steps per jitted call (Executor
 ``steps=`` fori_loop) to amortize the ~5.5 ms axon-tunnel dispatch
@@ -59,7 +60,12 @@ def run_resnet(batch=BATCH, steps=STEPS, chunk=CHUNK):
     place = fluid.TPUPlace(0) if platform == "tpu" else fluid.CPUPlace()
 
     use_amp = os.environ.get("BENCH_AMP", "1") == "1"
-    layout = os.environ.get("BENCH_LAYOUT", "NCHW").upper()  # NHWC = channels-last probe
+    # NHWC default per the measured r5 sweep (BASELINE.md): 2172 img/s vs
+    # 2137 NCHW at bs256/chunk10-fresh.  chunk40 same-batch measured
+    # fastest (2281) but abandons the fresh-data regime; chunk20-fresh
+    # blew an 800 s compile budget and bs512+ measured slower — so the
+    # default stays bs256/chunk10 with fresh per-step batches.
+    layout = os.environ.get("BENCH_LAYOUT", "NHWC").upper()
     if layout not in ("NCHW", "NHWC"):
         raise ValueError("BENCH_LAYOUT must be NCHW or NHWC (got %r)" % layout)
     img_shape = [3, 224, 224] if layout == "NCHW" else [224, 224, 3]
@@ -297,7 +303,7 @@ def _resnet_block():
     if "error" not in res and os.environ.get("BENCH_CALIBRATE", "1") == "1":
         cal = _run_sub("cal", {
             "BENCH_BATCH": str(res.get("batch", BATCH)),
-            "BENCH_LAYOUT": res.get("layout", "NCHW"),
+            "BENCH_LAYOUT": res.get("layout", "NHWC"),
             "BENCH_FRESH": "1" if res.get("per_step_feed") else "0",
             "BENCH_CHUNK": str(res.get("chunk", CHUNK)),
         })
@@ -308,7 +314,7 @@ def _resnet_block():
 
 def _run_cal():
     """Subprocess worker for the pure-JAX ResNet-50 yardstick."""
-    layout = os.environ.get("BENCH_LAYOUT", "NCHW").upper()
+    layout = os.environ.get("BENCH_LAYOUT", "NHWC").upper()
     fresh = os.environ.get("BENCH_FRESH", "1") == "1"
     return _measure_cal(BATCH, layout, fresh, CHUNK)
 
