@@ -21,14 +21,16 @@ import time
 
 import numpy as np
 
-# batch/chunk probes (BASELINE.md round-4 table): bs64 44.1%, bs128 51.1%,
-# bs192 51.9%, bs256 46.7% at chunk=10; chunk=20: bs128 55.9%, bs160 55.6%,
-# bs192 55.2%; chunk=40 lifts bs128 to 57.1% — the shipped default.
+# batch/chunk probes (BASELINE.md round-4/5 tables): bs64 44.1%, bs128
+# 51.1%, bs192 51.9%, bs256 46.7% at chunk=10; chunk=20: bs128 55.9%;
+# chunk=40: 57.1% same-batch == 57.2% fresh (r5, measured); the r5
+# fresh-data chunk ladder continues 80 -> 58.1%, 160 -> 58.6% (bs160
+# gains nothing) — chunk=160 is the shipped default, ~77.5 ms/step.
 BATCH = int(os.environ.get("BENCH_BERT_BATCH", "128"))
 SEQ = int(os.environ.get("BENCH_BERT_SEQ", "128"))
 MASKS = max(1, int(SEQ * 0.15))
-STEPS = int(os.environ.get("BENCH_STEPS", "80"))
-CHUNK = int(os.environ.get("BENCH_CHUNK", "40"))
+STEPS = int(os.environ.get("BENCH_STEPS", "320"))
+CHUNK = int(os.environ.get("BENCH_CHUNK", "160"))
 PEAK_FLOPS = {"tpu": 197e12, "cpu": 1e12}
 
 
@@ -55,6 +57,13 @@ def run(batch=BATCH, seq=SEQ, steps=STEPS, chunk=CHUNK):
         mlab = fluid.layers.data("mlab", [1], dtype="int64")
         nlab = fluid.layers.data("nlab", [1], dtype="int64")
         fused = os.environ.get("BENCH_FUSED", "0") == "1"
+        if fused:
+            # BENCH_FUSED=1 measures the pallas flash kernel; the op's
+            # own default is the XLA-native path (faster at every S that
+            # fits HBM — see fused_attention's docstring / BASELINE.md).
+            # Force (not setdefault): a leftover =0 export would silently
+            # mislabel an XLA measurement as the pallas one.
+            os.environ["PADDLE_TPU_FLASH_ATTENTION"] = "1"
         total, mlm_loss, nsp_acc = models.bert_pretrain(
             src, sent, mask, mpos, mlab, nlab,
             vocab_size=V, d_model=D, n_layer=L, n_head=H, d_inner=DI,

@@ -54,9 +54,12 @@ def multi_head_attention(
     the output projection.
 
     ``fused=True`` (needs dropout_rate==0 inside attention): the
-    ``fused_attention`` op — the pallas flash-attention kernel on TPU —
-    with padding as ``mask`` [N, S] and causality as ``causal=`` instead
-    of a materialized ``attn_bias``.
+    ``fused_attention`` op, with padding as ``mask`` [N, S] and
+    causality as ``causal=`` instead of a materialized ``attn_bias``.
+    That op defaults to XLA's native fused attention (measured faster
+    at every S that fits HBM); set ``PADDLE_TPU_FLASH_ATTENTION=1`` for
+    the pallas flash kernel when S^2 score tensors would exceed HBM
+    (see the op docstring / BASELINE.md round-5 A/B table).
     """
     d_head = d_model // n_head
     q = _fc3(q_in, d_model, name + "_q")
@@ -201,7 +204,9 @@ def bert_encoder(
     """BERT-base encoder; returns the [N, S, d_model] sequence output.
 
     ``input_mask``: float [N, S] (1 = token, 0 = pad) -> additive bias
-    (or segment ids on the ``fused_attention=True`` flash path).
+    (or the ``Mask`` input of the fused_attention op when
+    ``fused_attention=True``; that op picks XLA-native vs pallas flash
+    via PADDLE_TPU_FLASH_ATTENTION — see its docstring).
     """
     x = _embeddings(src_ids, vocab_size, d_model, max_pos, seq_len, name, sent_ids, 2)
     x = layers.layer_norm(

@@ -631,15 +631,26 @@ def fused_attention(inputs, attrs):
     """Fused scaled-dot-product attention: Q/K/V [N, H, S, D] -> ctx
     [N, H, S, D].
 
-    TPU path: the pallas flash-attention kernel
+    Default path: plain einsum+softmax — XLA's native fused attention.
+    Measured on a v5e chip (r5, fwd+bwd, BERT-base shapes) it beats the
+    pallas flash kernel at every sequence length that fits in HBM:
+    16.5 vs 23.4 ms/call at B16 H12 S1024 D64, and 31.2% vs 12.2% MFU
+    end-to-end at S=1024 (20.7% vs 6.1% at S=4096) — XLA's own
+    softmax-matmul fusion already avoids materializing scores badly
+    enough to lose, and the stock pallas kernel's block schedule does
+    not win on this part.
+
+    PADDLE_TPU_FLASH_ATTENTION=1 opts in to the pallas flash kernel
     (jax.experimental.pallas.ops.tpu.flash_attention) — online-softmax
-    tiling, no [N, H, S, S] score tensor in HBM.  Padding comes in as
+    tiling, no [N, H, S, S] score tensor in HBM — which is the
+    memory-capability path: it admits sequence lengths where the
+    einsum path's S^2 tensors exceed HBM.  Padding comes in as
     ``Mask`` [N, S] (1 = token) and is lowered to segment ids (pad
     positions form their own segment, so real tokens never attend them;
     pad rows' outputs are garbage-by-construction in BOTH impls and must
     be masked downstream, as the reference's padded attention does).
-    Non-TPU backends (and PADDLE_TPU_FLASH_ATTENTION=0) fall back to the
-    plain einsum+softmax math with the equivalent additive bias.
+    Multi-chip long context goes through parallel/ring_attention.py
+    (sp axis), not this op.
     """
     import os as _os
 
@@ -654,7 +665,7 @@ def fused_attention(inputs, attrs):
     scale = float(attrs.get("scale", 1.0))
     use_flash = (
         jax.default_backend() == "tpu"
-        and _os.environ.get("PADDLE_TPU_FLASH_ATTENTION", "1") == "1"
+        and _os.environ.get("PADDLE_TPU_FLASH_ATTENTION", "0") == "1"
     )
     if use_flash:
         from jax.experimental.pallas.ops.tpu.flash_attention import (
