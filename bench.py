@@ -337,16 +337,16 @@ def main():
     if model != "all":
         # the env setdefault at module top is too late for a DIRECT
         # single-model run: the axon sitecustomize imports jax at
-        # interpreter start, and jax.config snapshots the env then — so
-        # pin the cache dir through the config channel too (subprocess
-        # stages spawned by the `all` orchestrator already have the env
-        # var at interpreter start and don't need this)
+        # interpreter start, and jax.config snapshots the env then — the
+        # helper pins the cache dir through the config channel too
+        # (subprocess stages spawned by the `all` orchestrator already
+        # have the env var at interpreter start and don't need this)
         import jax
 
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.environ.get("JAX_COMPILATION_CACHE_DIR") or None,
-        )
+        import bench_common
+
+        bench_common.configure_compile_cache(
+            os.environ["JAX_COMPILATION_CACHE_DIR"])
         plat = os.environ.get("BENCH_PLATFORM")
         if plat:
             # config channel (not env) for the same sitecustomize-beats-

@@ -10,7 +10,38 @@ device staging so the four benches can't drift.
 """
 import os
 
-__all__ = ["fresh_enabled", "stage_feeds"]
+__all__ = ["configure_compile_cache", "fresh_enabled", "stage_feeds"]
+
+# Shared default for test/dryrun harnesses (per-box, survives across
+# sessions); bench.py passes its own repo-local .jax_cache instead so the
+# bench cache travels with a repo checkout rather than the home dir.
+HOME_CACHE_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "paddle_tpu", "xla_cache")
+
+
+def configure_compile_cache(default_dir):
+    """Point jax's persistent compilation cache at
+    ``$JAX_COMPILATION_CACHE_DIR`` (seeded to ``default_dir`` when unset)
+    through BOTH channels: the env var, for subprocesses that import jax
+    fresh, and ``jax.config``, for THIS process — where the axon
+    sitecustomize has already imported jax at interpreter start, so a
+    late env write alone is invisible (same trap as jax_platforms).
+    An explicitly empty env var disables the cache.  Single definition
+    shared by bench.py, tests/conftest.py, and __graft_entry__.py so the
+    knob set can't drift (ADVICE/code-review r5).
+    """
+    import jax
+
+    cache_dir = os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR", default_dir) or None
+    min_secs = float(os.environ.setdefault(
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1"))
+    min_bytes = int(os.environ.setdefault(
+        "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0"))
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", min_secs)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", min_bytes)
+    return cache_dir
 
 
 def fresh_enabled(default="1"):

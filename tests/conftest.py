@@ -31,16 +31,13 @@ jax.config.update("jax_platforms", "cpu")
 # directly (the round-3 env-var-only version never took effect: 5 cache
 # entries after hundreds of compiles) — but subprocess workers
 # (distributed.launch two-process tests) import jax fresh and DO read
-# the env vars, so set both.
-_cache_dir = os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu", "xla_cache"),
-)
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
-jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+# the env vars; the shared helper sets both channels.
+import sys as _sys  # noqa: E402
+
+_sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench_common  # noqa: E402
+
+bench_common.configure_compile_cache(bench_common.HOME_CACHE_DIR)
 
 
 def pytest_addoption(parser):
