@@ -26,18 +26,21 @@ def configure_compile_cache(default_dir):
     fresh, and ``jax.config``, for THIS process — where the axon
     sitecustomize has already imported jax at interpreter start, so a
     late env write alone is invisible (same trap as jax_platforms).
-    An explicitly empty env var disables the cache.  Single definition
-    shared by bench.py, tests/conftest.py, and __graft_entry__.py so the
-    knob set can't drift (ADVICE/code-review r5).
+    An explicitly empty JAX_COMPILATION_CACHE_DIR disables the cache.
+    (Empty values for the two threshold vars are jax's problem, not
+    ours: jax's own env-backed flag parser rejects them at ``import
+    jax``, before this helper can run.)  Single definition shared by
+    bench.py, tests/conftest.py, and __graft_entry__.py so the knob set
+    can't drift (ADVICE/code-review r5).
     """
     import jax
 
     cache_dir = os.environ.setdefault(
         "JAX_COMPILATION_CACHE_DIR", default_dir) or None
     min_secs = float(os.environ.setdefault(
-        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1"))
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1") or "1")
     min_bytes = int(os.environ.setdefault(
-        "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0"))
+        "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0") or "0")
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", min_secs)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", min_bytes)

@@ -12,11 +12,12 @@ import time
 
 import numpy as np
 
-# chunk40 per the measured r5 probe (BASELINE.md): 127.3k examples/s at
-# chunk5 -> 227.4k at chunk40 (dispatch amortization dominates an 18 ms step)
+# measured r5 chunk ladder (BASELINE.md): 127.3k examples/s at chunk5 ->
+# 227.4k at chunk40 -> 238.4k at chunk80 (dispatch amortization dominates
+# a ~17 ms step); nmt bs256 was also probed and lost to bs128
 BATCH = int(os.environ.get("BENCH_DEEPFM_BATCH", "4096"))
-STEPS = int(os.environ.get("BENCH_DEEPFM_STEPS", "80"))
-CHUNK = int(os.environ.get("BENCH_DEEPFM_CHUNK", "40"))
+STEPS = int(os.environ.get("BENCH_DEEPFM_STEPS", "160"))
+CHUNK = int(os.environ.get("BENCH_DEEPFM_CHUNK", "80"))
 PEAK_FLOPS = {"tpu": 197e12, "cpu": 1e12}
 NUM_FEATURES = int(os.environ.get("BENCH_DEEPFM_FEATURES", "1000000"))
 FIELDS = 39
