@@ -24,13 +24,14 @@ import numpy as np
 # batch/chunk probes (BASELINE.md round-4/5 tables): bs64 44.1%, bs128
 # 51.1%, bs192 51.9%, bs256 46.7% at chunk=10; chunk=20: bs128 55.9%;
 # chunk=40: 57.1% same-batch == 57.2% fresh (r5, measured); the r5
-# fresh-data chunk ladder continues 80 -> 58.1%, 160 -> 58.6% (bs160
-# gains nothing) — chunk=160 is the shipped default, ~77.5 ms/step.
+# fresh-data chunk ladder continues 80 -> 58.1%, 160 -> 58.6%,
+# 320 -> 58.9% (bs160 gains nothing) — chunk=320 is the shipped
+# default, 77.1 ms/step.
 BATCH = int(os.environ.get("BENCH_BERT_BATCH", "128"))
 SEQ = int(os.environ.get("BENCH_BERT_SEQ", "128"))
 MASKS = max(1, int(SEQ * 0.15))
 STEPS = int(os.environ.get("BENCH_STEPS", "320"))
-CHUNK = int(os.environ.get("BENCH_CHUNK", "160"))
+CHUNK = int(os.environ.get("BENCH_CHUNK", "320"))
 PEAK_FLOPS = {"tpu": 197e12, "cpu": 1e12}
 
 
