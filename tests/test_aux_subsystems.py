@@ -682,3 +682,48 @@ def test_contrib_trainer_checkpoint_rotation(tmp_path):
     with pytest.raises(ValueError, match="feed_order has 2 names"):
         t.train(num_epochs=1, event_handler=lambda ev: None,
                 reader=bad_reader, feed_order=["x", "y"])
+
+
+def test_configure_compile_cache_subprocess_contract(tmp_path):
+    """bench_common.configure_compile_cache sets BOTH channels (env for
+    fresh-import subprocesses, jax.config for the current process) and
+    an explicitly empty JAX_COMPILATION_CACHE_DIR disables the cache —
+    checked in subprocesses so this test can't disturb the session's own
+    cache config (tests/conftest.py points it at the shared dir)."""
+    import json
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    prog = (
+        "import os, sys, json\n"
+        "sys.path.insert(0, %r)\n"
+        "import bench_common\n"
+        "import jax\n"
+        "got = bench_common.configure_compile_cache(sys.argv[1])\n"
+        "print(json.dumps({'ret': got,\n"
+        "  'env': os.environ.get('JAX_COMPILATION_CACHE_DIR'),\n"
+        "  'cfg': jax.config.jax_compilation_cache_dir}))\n" % repo
+    )
+
+    def run(env_override, default_dir):
+        env = {k: v for k, v in os.environ.items()
+               if k != "JAX_COMPILATION_CACHE_DIR"}
+        env["JAX_PLATFORMS"] = "cpu"
+        env.update(env_override)
+        out = subprocess.run(
+            [sys.executable, "-c", prog, default_dir],
+            env=env, capture_output=True, text=True, timeout=120, check=True)
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    want = str(tmp_path / "xc")
+    # unset env -> the default seeds both channels
+    got = run({}, want)
+    assert got == {"ret": want, "env": want, "cfg": want}
+    # explicit env beats the default
+    other = str(tmp_path / "explicit")
+    got = run({"JAX_COMPILATION_CACHE_DIR": other}, want)
+    assert got == {"ret": other, "env": other, "cfg": other}
+    # explicitly empty -> disabled (config None), env left empty
+    got = run({"JAX_COMPILATION_CACHE_DIR": ""}, want)
+    assert got == {"ret": None, "env": "", "cfg": None}
