@@ -13,11 +13,11 @@ import time
 import numpy as np
 
 # measured r5 chunk ladder (BASELINE.md): 127.3k examples/s at chunk5 ->
-# 227.4k at chunk40 -> 238.4k at chunk80 (dispatch amortization dominates
-# a ~17 ms step); nmt bs256 was also probed and lost to bs128
+# 227.4k at chunk40 -> 238.4k at chunk80 -> 249.6k at chunk160 (dispatch
+# amortization dominates a ~16 ms step)
 BATCH = int(os.environ.get("BENCH_DEEPFM_BATCH", "4096"))
-STEPS = int(os.environ.get("BENCH_DEEPFM_STEPS", "160"))
-CHUNK = int(os.environ.get("BENCH_DEEPFM_CHUNK", "80"))
+STEPS = int(os.environ.get("BENCH_DEEPFM_STEPS", "320"))
+CHUNK = int(os.environ.get("BENCH_DEEPFM_CHUNK", "160"))
 PEAK_FLOPS = {"tpu": 197e12, "cpu": 1e12}
 NUM_FEATURES = int(os.environ.get("BENCH_DEEPFM_FEATURES", "1000000"))
 FIELDS = 39

@@ -16,14 +16,15 @@ import time
 import numpy as np
 
 # defaults per the measured r5 chunk/batch probes (BASELINE.md): bs64
-# chunk5 165.7k tok/s (17.7% MFU) -> bs128 chunk40 307.0k tok/s (32.9%
-# MFU); the bs64 chunk40 probe blew a 700 s stage budget on compile, so
-# the bigger batch is also the safer compile
+# chunk5 165.7k tok/s (17.7% MFU) -> bs128 chunk40 307.0k (32.9%) ->
+# bs128 chunk80 320.2k (34.4%), the shipped default; bs256 measured
+# 302.1k (worse) and the bs64 chunk40 probe blew a 700 s stage budget
+# on compile, so the bigger batch is also the safer compile
 BATCH = int(os.environ.get("BENCH_NMT_BATCH", "128"))
 SRC_LEN = int(os.environ.get("BENCH_NMT_SRC", "64"))
 TGT_LEN = int(os.environ.get("BENCH_NMT_TGT", "64"))
-STEPS = int(os.environ.get("BENCH_NMT_STEPS", "80"))
-CHUNK = int(os.environ.get("BENCH_NMT_CHUNK", "40"))
+STEPS = int(os.environ.get("BENCH_NMT_STEPS", "160"))
+CHUNK = int(os.environ.get("BENCH_NMT_CHUNK", "80"))
 PEAK_FLOPS = {"tpu": 197e12, "cpu": 1e12}
 
 
