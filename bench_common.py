@@ -12,11 +12,51 @@ import os
 
 __all__ = ["configure_compile_cache", "fresh_enabled", "stage_feeds"]
 
-# Shared default for test/dryrun harnesses (per-box, survives across
-# sessions); bench.py passes its own repo-local .jax_cache instead so the
-# bench cache travels with a repo checkout rather than the home dir.
+def _host_cache_tag():
+    """Hostname + CPU-feature hash segment for the shared HOME cache dir.
+
+    XLA:CPU cache entries embed AOT-compiled executables keyed to the
+    compiling machine's CPU features; on an NFS-shared home dir mounted
+    across heterogeneous hosts a flat dir could hand host B an
+    executable compiled for host A's ISA extensions (SIGILL risk — the
+    MULTICHIP_r05 log showed the matching mismatch warnings).  Keying
+    the dir by host + cpuinfo-flags hash makes each hardware flavor its
+    own cache (ADVICE r5).
+    """
+    import hashlib
+    import platform
+
+    sig = platform.machine() or "unknown"
+    try:
+        with open("/proc/cpuinfo") as f:
+            flags = next((ln for ln in f if ln.startswith("flags")), "")
+        if flags:
+            sig += "-" + hashlib.sha1(flags.encode()).hexdigest()[:10]
+    except OSError:
+        pass
+    return "%s-%s" % (platform.node() or "host", sig)
+
+
+# Shared default for test/dryrun harnesses (survives across sessions);
+# keyed per host/CPU flavor — see _host_cache_tag.  bench.py passes its
+# own repo-local .jax_cache instead so the bench cache travels with a
+# repo checkout rather than the home dir.
 HOME_CACHE_DIR = os.path.join(
-    os.path.expanduser("~"), ".cache", "paddle_tpu", "xla_cache")
+    os.path.expanduser("~"), ".cache", "paddle_tpu", "xla_cache",
+    _host_cache_tag())
+
+
+def _env_threshold(name, fallback):
+    """Read an env threshold, treating unset OR empty as ``fallback`` —
+    and WRITE the fallback back through os.environ in both cases, so a
+    subprocess importing jax fresh parses the same value this process
+    configured (jax's env-backed flag parser rejects an empty string at
+    ``import jax``; leaving it in the environment would desync the two
+    channels — ADVICE r5)."""
+    val = os.environ.get(name)
+    if not val:
+        os.environ[name] = val = fallback
+    return val
 
 
 def configure_compile_cache(default_dir):
@@ -26,21 +66,23 @@ def configure_compile_cache(default_dir):
     fresh, and ``jax.config``, for THIS process — where the axon
     sitecustomize has already imported jax at interpreter start, so a
     late env write alone is invisible (same trap as jax_platforms).
-    An explicitly empty JAX_COMPILATION_CACHE_DIR disables the cache.
-    (Empty values for the two threshold vars are jax's problem, not
-    ours: jax's own env-backed flag parser rejects them at ``import
-    jax``, before this helper can run.)  Single definition shared by
-    bench.py, tests/conftest.py, and __graft_entry__.py so the knob set
-    can't drift (ADVICE/code-review r5).
+    An explicitly empty JAX_COMPILATION_CACHE_DIR disables the cache;
+    empty threshold vars are rewritten to their fallbacks
+    (_env_threshold).  Single definition shared by bench.py,
+    tests/conftest.py, __graft_entry__.py, and serving warmup so the
+    knob set can't drift (ADVICE/code-review r5).
     """
-    import jax
-
+    # sanitize the env BEFORE importing jax: on a box without a
+    # jax-importing sitecustomize, THIS import is where jax's flag
+    # parser would reject an empty threshold var
     cache_dir = os.environ.setdefault(
         "JAX_COMPILATION_CACHE_DIR", default_dir) or None
-    min_secs = float(os.environ.setdefault(
-        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1") or "1")
-    min_bytes = int(os.environ.setdefault(
-        "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0") or "0")
+    min_secs = float(_env_threshold(
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1"))
+    min_bytes = int(_env_threshold(
+        "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0"))
+
+    import jax
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", min_secs)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", min_bytes)

@@ -1,0 +1,179 @@
+"""Benchmark: dynamic-batching serving (paddle_tpu/serving/) sustained
+throughput + latency for two inference endpoints — LeNet (dense vision)
+and DeepFM (sparse CTR).
+
+Prints ONE JSON line like bench.py: per-endpoint sustained rows/sec,
+request p50/p99 latency, mean batch occupancy, warmup compile count,
+and the recompile counter (must stay 0 after warmup — the bucket
+ladder's whole point).  Traffic is an open-loop storm of concurrent
+submitters with mixed request sizes, so the DynamicBatcher actually
+coalesces rather than replaying fixed batches.
+
+Env knobs: BENCH_SERVING_THREADS (default 8), BENCH_SERVING_REQUESTS
+(per thread, default 100), BENCH_SERVING_MAX_BATCH (default 16),
+BENCH_SERVING_TIMEOUT_MS (batch window, default 2),
+BENCH_SERVING_TRACE (JSONL trace path, default off).
+"""
+import json
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+THREADS = int(os.environ.get("BENCH_SERVING_THREADS", "8"))
+REQUESTS = int(os.environ.get("BENCH_SERVING_REQUESTS", "100"))
+MAX_BATCH = int(os.environ.get("BENCH_SERVING_MAX_BATCH", "16"))
+TIMEOUT_MS = float(os.environ.get("BENCH_SERVING_TIMEOUT_MS", "2"))
+# request sizes cycle through this ladder so batches mix row counts
+REQ_SIZES = (1, 2, 3, 4)
+
+
+def _save_lenet(dirname):
+    import paddle_tpu as fluid
+    from paddle_tpu import framework, models
+
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 11
+    with framework.program_guard(prog, startup):
+        img = fluid.layers.data("img", [1, 28, 28])
+        lbl = fluid.layers.data("lbl", [1], dtype="int64")
+        _, _, pred = models.lenet5(img, lbl)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.save_inference_model(dirname, ["img"], [pred], exe, prog)
+
+    def make_rows(n, rng):
+        return {"img": rng.uniform(-1, 1, (n, 1, 28, 28)).astype(np.float32)}
+
+    return make_rows
+
+
+def _save_deepfm(dirname, num_features=10000, num_fields=39):
+    import paddle_tpu as fluid
+    from paddle_tpu import framework, models
+
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 13
+    with framework.program_guard(prog, startup):
+        ids = fluid.layers.data("feat_ids", [num_fields, 1], dtype="int64")
+        vals = fluid.layers.data("feat_vals", [num_fields])
+        lbl = fluid.layers.data("lbl", [1], dtype="int64")
+        _, prob = models.deepfm_ctr(
+            ids, vals, lbl, num_features=num_features, num_fields=num_fields,
+            embed_dim=8, deep_layers=(64, 64))
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.save_inference_model(dirname, ["feat_ids", "feat_vals"], [prob],
+                                   exe, prog)
+
+    def make_rows(n, rng):
+        return {
+            "feat_ids": rng.randint(0, num_features, (n, num_fields, 1)).astype(np.int64),
+            "feat_vals": rng.uniform(0, 1, (n, num_fields)).astype(np.float32),
+        }
+
+    return make_rows
+
+
+def _bench_endpoint(name, save_fn):
+    from paddle_tpu import serving
+    from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+
+    with tempfile.TemporaryDirectory() as tmp:
+        d = os.path.join(tmp, name)
+        make_rows = save_fn(d)
+        predictor = create_paddle_predictor(AnalysisConfig(d))
+        server = serving.InferenceServer(
+            predictor, max_batch_size=MAX_BATCH, batch_timeout_ms=TIMEOUT_MS,
+            queue_capacity=max(64, THREADS * 8), name=name)
+        t0 = time.perf_counter()
+        warmup_compiles = server.warmup()
+        warmup_s = time.perf_counter() - t0
+        cli = serving.Client(server)
+
+        total_rows = [0] * THREADS
+        shed = [0] * THREADS
+        start = threading.Barrier(THREADS + 1)
+
+        def storm(tid):
+            rng = np.random.RandomState(100 + tid)
+            start.wait()
+            for i in range(REQUESTS):
+                n = REQ_SIZES[(tid + i) % len(REQ_SIZES)]
+                try:
+                    cli.infer(make_rows(n, rng))
+                    total_rows[tid] += n
+                except serving.ServerOverloaded:
+                    shed[tid] += 1  # open-loop storm may outrun the queue
+
+        threads = [threading.Thread(target=storm, args=(t,)) for t in range(THREADS)]
+        for t in threads:
+            t.start()
+        start.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        server.stop(drain=True)
+        m = server.metrics()
+        rows = sum(total_rows)
+        return {
+            "rows_per_sec": round(rows / elapsed, 1),
+            "requests_per_sec": round(m["completed"] / elapsed, 1),
+            "latency_p50_ms": m["latency_p50_ms"],
+            "latency_p99_ms": m["latency_p99_ms"],
+            "mean_batch_occupancy": m["mean_batch_occupancy"],
+            "batches": m["batches"],
+            "completed": m["completed"],
+            "shed": m["shed"],
+            "expired": m["expired"],
+            "recompiles_after_warmup": m["recompiles"],
+            "warmup_compiles": warmup_compiles,
+            "warmup_s": round(warmup_s, 2),
+            "bucket_ladder": m["bucket_ladder"],
+            "elapsed_s": round(elapsed, 2),
+        }
+
+
+def run():
+    import jax
+
+    from paddle_tpu import profiler
+
+    import bench_common
+
+    bench_common.configure_compile_cache(bench_common.HOME_CACHE_DIR)
+    trace = os.environ.get("BENCH_SERVING_TRACE")
+    if trace:
+        profiler.start_jsonl_trace(trace)
+    try:
+        endpoints = {
+            "lenet": _bench_endpoint("lenet", _save_lenet),
+            "deepfm": _bench_endpoint("deepfm", _save_deepfm),
+        }
+    finally:
+        if trace:
+            profiler.stop_jsonl_trace()
+    return {
+        "metric": "serving_dynamic_batching",
+        "unit": "rows/sec",
+        "value": endpoints["lenet"]["rows_per_sec"],
+        "endpoints": endpoints,
+        "threads": THREADS,
+        "requests_per_thread": REQUESTS,
+        "max_batch_size": MAX_BATCH,
+        "batch_timeout_ms": TIMEOUT_MS,
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def main():
+    print(json.dumps(run()))
+
+
+if __name__ == "__main__":
+    main()

@@ -59,6 +59,7 @@ from paddle_tpu import inference
 from paddle_tpu import native
 from paddle_tpu.fluid_dataset import DatasetFactory, InMemoryDataset, QueueDataset
 from paddle_tpu import profiler
+from paddle_tpu import serving
 from paddle_tpu import memory
 from paddle_tpu import trainer_desc
 from paddle_tpu.trainer_desc import TrainerFactory

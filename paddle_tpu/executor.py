@@ -44,6 +44,12 @@ class Executor:
         # an explicit TPUPlace/CPUPlace is honored strictly (_device).
         self.place = place if place is not None else framework._DefaultPlace()
         self._cache: Dict[tuple, Any] = {}
+        # jit-cache accounting (serving reads this): a miss means a NEW
+        # jax.jit entry was built for a novel (program, feed-signature,
+        # ...) key — i.e. an XLA compile on first dispatch.  This is the
+        # ground truth behind serving's recompile counter, not an
+        # inference from timing.
+        self._cache_stats = {"hits": 0, "misses": 0}
 
     # ------------------------------------------------------------------
     def _device(self):
@@ -247,7 +253,10 @@ class Executor:
         )
 
         entry = self._cache.get(key) if use_program_cache else None
-        if entry is None:
+        if entry is not None:
+            self._cache_stats["hits"] += 1
+        else:
+            self._cache_stats["misses"] += 1
             fn = lowering.lower_block(block, feed_names, fetch_names, state_out)
 
             if steps == 1:
@@ -618,6 +627,22 @@ class Executor:
         return self.train_from_dataset(
             program, dataset, scope, thread, debug, fetch_list, fetch_info, print_period
         )
+
+    # ------------------------------------------------------------------
+    def jit_cache_stats(self) -> Dict[str, int]:
+        """Compile-cache accounting for this executor.
+
+        ``misses`` counts newly-built jitted entries (each one is an XLA
+        compile on its first dispatch); ``hits`` counts runs served by an
+        existing entry; ``entries`` is the live cache size.  Serving's
+        zero-recompiles-after-warmup assertion diffs ``misses`` across a
+        workload (paddle_tpu/serving/server.py).
+        """
+        return {
+            "entries": len(self._cache),
+            "hits": self._cache_stats["hits"],
+            "misses": self._cache_stats["misses"],
+        }
 
     # ------------------------------------------------------------------
     def close(self):

@@ -98,6 +98,63 @@ class AnalysisPredictor(PaddlePredictor):
 
     Run = run  # C++-style alias
 
+    # --- TPU-native serving surface (paddle_tpu/serving) ---
+    def run_padded(self, feed: Dict[str, np.ndarray], n_valid: Optional[int] = None):
+        """Batched-run entry for pre-padded bucket feeds.
+
+        The serving layer pads every coalesced batch up to a fixed
+        bucket ladder so the jit cache sees a closed set of batch
+        shapes; this entry runs one such padded batch and slices each
+        output back to the first ``n_valid`` rows (outputs whose
+        leading dim is not the padded batch — e.g. scalar fetches —
+        pass through untouched).  All feeds must agree on the padded
+        leading dim.
+        """
+        if not isinstance(feed, dict):
+            feed = dict(zip(self._feed_names, feed))
+        dims = {name: np.shape(v)[0] if np.ndim(v) else None
+                for name, v in feed.items()}
+        batch_dims = {d for d in dims.values() if d is not None}
+        if len(batch_dims) != 1:
+            raise ValueError(
+                "run_padded needs one consistent padded leading dim; got %s"
+                % dims)
+        (padded,) = batch_dims
+        if n_valid is None:
+            n_valid = padded
+        if not 0 < n_valid <= padded:
+            raise ValueError(
+                "n_valid=%r out of range for padded batch %d" % (n_valid, padded))
+        outs = self.run(feed)
+        if n_valid == padded:
+            return outs
+        return [
+            o[:n_valid] if np.ndim(o) >= 1 and np.shape(o)[0] == padded else o
+            for o in outs
+        ]
+
+    def jit_cache_stats(self) -> Dict[str, int]:
+        """Expose the wrapped executor's compile-cache accounting (see
+        Executor.jit_cache_stats) — serving's recompile counter."""
+        return self._exe.jit_cache_stats()
+
+    def input_specs(self) -> Dict[str, Any]:
+        """Per-row (batch-free) shape/dtype for every feed var, derived
+        from the loaded program: ``{name: (shape_tuple, np.dtype)}``.
+        Unknown (-1) non-batch dims come back as 1 — override via the
+        serving ``input_specs`` argument when that guess is wrong."""
+        from paddle_tpu.core import types as core_types
+
+        specs = {}
+        block = self._program.global_block()
+        for name in self._feed_names:
+            var = block.var(name)
+            shape = tuple(
+                1 if int(d) < 0 else int(d) for d in (var.shape or ())[1:]
+            )
+            specs[name] = (shape, core_types.np_dtype(var.dtype))
+        return specs
+
 
 def create_paddle_predictor(config: AnalysisConfig) -> AnalysisPredictor:
     """reference: CreatePaddlePredictor<AnalysisConfig>."""

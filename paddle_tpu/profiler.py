@@ -12,7 +12,11 @@ import time
 from collections import defaultdict
 from typing import Dict, List, Optional
 
-__all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler", "RecordEvent", "cuda_profiler"]
+__all__ = [
+    "profiler", "start_profiler", "stop_profiler", "reset_profiler",
+    "RecordEvent", "cuda_profiler", "start_jsonl_trace", "stop_jsonl_trace",
+    "emit_trace_event", "jsonl_trace",
+]
 
 _host_events: Dict[str, List[float]] = defaultdict(list)
 _active_trace_dir: Optional[str] = None
@@ -82,6 +86,69 @@ def profiler(state: str = "All", sorted_key: str = "total",
         yield
     finally:
         stop_profiler(sorted_key, profile_path)
+
+
+# ---------------------------------------------------------------------------
+# JSONL event trace — one JSON object per line, for host-side subsystems
+# that emit discrete events rather than RAII spans (serving batches, reader
+# stalls, PS rounds).  Complements RecordEvent: RecordEvent aggregates into
+# the stop_profiler() table, the JSONL sink keeps every event with its
+# wall-clock timestamp so latency tails and occupancy histograms can be
+# reconstructed offline.
+# ---------------------------------------------------------------------------
+_jsonl_sink = None  # (path, file handle, lock)
+
+
+def start_jsonl_trace(path: str):
+    """Open ``path`` and route emit_trace_event() lines to it (append
+    mode, one JSON object per line).  Returns the path."""
+    global _jsonl_sink
+    import threading
+
+    stop_jsonl_trace()
+    _jsonl_sink = (path, open(path, "a"), threading.Lock())
+    return path
+
+
+def stop_jsonl_trace() -> Optional[str]:
+    """Close the active JSONL sink; returns its path (or None)."""
+    global _jsonl_sink
+    if _jsonl_sink is None:
+        return None
+    path, fh, lock = _jsonl_sink
+    _jsonl_sink = None
+    with lock:
+        fh.close()
+    return path
+
+
+def emit_trace_event(event: dict) -> None:
+    """Write one event to the active JSONL sink (no-op when none is
+    active).  A wall-clock ``ts`` field is stamped in unless the caller
+    already provided one; the event must be JSON-serializable."""
+    sink = _jsonl_sink
+    if sink is None:
+        return
+    import json
+
+    _, fh, lock = sink
+    rec = dict(event)
+    rec.setdefault("ts", time.time())
+    line = json.dumps(rec)
+    with lock:
+        if not fh.closed:
+            fh.write(line + "\n")
+            fh.flush()
+
+
+@contextlib.contextmanager
+def jsonl_trace(path: str):
+    """Context manager form of start/stop_jsonl_trace."""
+    start_jsonl_trace(path)
+    try:
+        yield path
+    finally:
+        stop_jsonl_trace()
 
 
 @contextlib.contextmanager

@@ -1,0 +1,48 @@
+"""paddle_tpu.serving — dynamic-batching TPU inference serving.
+
+An Orca/Clipper-style in-process serving front end over
+AnalysisPredictor (inference.py):
+
+* ``InferenceServer`` — bounded request queue, DynamicBatcher
+  coalescing (max_batch_size / batch_timeout_ms), bucket-ladder batch
+  padding so the jit cache sees a closed shape set, per-request
+  deadlines, overload shedding, graceful drain;
+* ``Client`` — blocking in-process client helper;
+* ``BucketPolicy`` / ``DynamicBatcher`` / ``ServingMetrics`` — the
+  composable pieces;
+* typed errors: ``ServerOverloaded``, ``DeadlineExceeded``,
+  ``ServerClosed``.
+
+Quickstart::
+
+    pred = create_paddle_predictor(AnalysisConfig(model_dir))
+    server = serving.InferenceServer(pred, max_batch_size=16)
+    server.warmup()            # pre-compile every bucket; arms the
+                               # zero-recompile counter
+    out, = serving.Client(server).infer({"x": rows})
+    server.stop(drain=True)
+"""
+from paddle_tpu.serving.batching import DynamicBatcher, ServingRequest
+from paddle_tpu.serving.bucketing import BucketPolicy
+from paddle_tpu.serving.client import Client
+from paddle_tpu.serving.errors import (
+    DeadlineExceeded,
+    ServerClosed,
+    ServerOverloaded,
+    ServingError,
+)
+from paddle_tpu.serving.metrics import ServingMetrics
+from paddle_tpu.serving.server import InferenceServer
+
+__all__ = [
+    "InferenceServer",
+    "Client",
+    "DynamicBatcher",
+    "ServingRequest",
+    "BucketPolicy",
+    "ServingMetrics",
+    "ServingError",
+    "ServerOverloaded",
+    "DeadlineExceeded",
+    "ServerClosed",
+]

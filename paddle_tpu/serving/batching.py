@@ -1,0 +1,167 @@
+"""Request futures + the dynamic batcher.
+
+Orca/Clipper-style coalescing: concurrent submitters enqueue
+row-oriented requests into a BOUNDED queue; the server's worker pulls a
+first request, then keeps absorbing arrivals until either
+``max_batch_size`` rows are gathered or ``batch_timeout_ms`` has passed
+since the batch opened — whichever fires first.  A request that would
+overflow the open batch is carried into the next one (never split).
+
+Admission control lives at the queue: a full queue sheds the request
+with a typed ServerOverloaded at submit time, so overload back-pressure
+reaches the caller immediately instead of growing an unbounded backlog.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from paddle_tpu.serving.errors import DeadlineExceeded, ServerOverloaded
+
+__all__ = ["ServingRequest", "DynamicBatcher"]
+
+# granularity of the shutdown-check poll while blocked on an empty queue
+_IDLE_POLL_S = 0.02
+
+
+class ServingRequest:
+    """One submitted inference request: a row-oriented feed plus a
+    future the submitter waits on.  ``n_rows`` is the leading dim shared
+    by every feed array (validated by the server at submit)."""
+
+    def __init__(self, feed: Dict[str, np.ndarray], n_rows: int,
+                 deadline: Optional[float] = None):
+        self.feed = feed
+        self.n_rows = n_rows
+        self.deadline = deadline  # time.monotonic() deadline, or None
+        self.submit_t = time.perf_counter()
+        self._done = threading.Event()
+        self._value: Optional[List[np.ndarray]] = None
+        self._exc: Optional[BaseException] = None
+
+    # --- producer (worker) side ---
+    def complete(self, value: List[np.ndarray]) -> None:
+        if self._done.is_set():
+            return  # first completion wins (shutdown races)
+        self._value = value
+        self._done.set()
+
+    def fail(self, exc: BaseException) -> None:
+        if self._done.is_set():
+            return  # first completion wins (shutdown races)
+        self._exc = exc
+        self._done.set()
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return self.deadline is not None and (now or time.monotonic()) >= self.deadline
+
+    # --- consumer (submitter) side ---
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[np.ndarray]:
+        """Block for the result.  Honors the request deadline even when
+        the server never gets to this request (a deadline must surface
+        as a typed error, never a hang)."""
+        if timeout is None and self.deadline is not None:
+            timeout = max(0.0, self.deadline - time.monotonic())
+        if not self._done.wait(timeout):
+            raise DeadlineExceeded(
+                "no result within %.1f ms" % ((timeout or 0.0) * 1e3))
+        if self._exc is not None:
+            raise self._exc
+        assert self._value is not None
+        return self._value
+
+
+class DynamicBatcher:
+    """Bounded request queue + the coalescing policy."""
+
+    def __init__(self, max_batch_size: int, batch_timeout_ms: float,
+                 queue_capacity: int):
+        self.max_batch_size = int(max_batch_size)
+        self.batch_timeout_s = float(batch_timeout_ms) / 1e3
+        self._q: "queue.Queue[ServingRequest]" = queue.Queue(maxsize=queue_capacity)
+        self._carry: Optional[ServingRequest] = None  # worker-thread only
+
+    def qsize(self) -> int:
+        return self._q.qsize() + (1 if self._carry is not None else 0)
+
+    # --- submitter side ---
+    def offer(self, req: ServingRequest) -> None:
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            raise ServerOverloaded(
+                "request queue full (%d waiting); shedding" % self._q.qsize()
+            ) from None
+
+    def drain_pending(self) -> List[ServingRequest]:
+        """Pop and return every queued-but-unbatched request (shutdown
+        without drain: the server fails them with ServerClosed).  Does
+        not touch the carry slot — that one is the worker's."""
+        out: List[ServingRequest] = []
+        while True:
+            try:
+                out.append(self._q.get_nowait())
+            except queue.Empty:
+                return out
+
+    # --- worker side (single consumer) ---
+    def _take_first(self, stop: threading.Event, on_expired) -> Optional[ServingRequest]:
+        if self._carry is not None:
+            first, self._carry = self._carry, None
+            if not first.expired():
+                return first
+            on_expired(first)
+        while True:
+            try:
+                first = self._q.get_nowait()
+            except queue.Empty:
+                if stop.is_set():
+                    return None  # drained
+                try:
+                    first = self._q.get(timeout=_IDLE_POLL_S)
+                except queue.Empty:
+                    continue
+            if first.expired():
+                on_expired(first)
+                continue
+            return first
+
+    def next_batch(self, stop: threading.Event, on_expired) -> Optional[List[ServingRequest]]:
+        """Return the next coalesced batch, or None once stopped AND
+        drained.  ``on_expired`` is called with each request whose
+        deadline passed while queued (the server fails + counts it).
+
+        While draining (``stop`` set) the window is not awaited — only
+        already-queued requests coalesce, so shutdown latency is bounded
+        by the in-flight work, not by the timeout."""
+        first = self._take_first(stop, on_expired)
+        if first is None:
+            return None
+        batch = [first]
+        rows = first.n_rows
+        window_end = time.monotonic() + self.batch_timeout_s
+        while rows < self.max_batch_size:
+            wait = window_end - time.monotonic()
+            try:
+                if wait > 0 and not stop.is_set():
+                    req = self._q.get(timeout=wait)
+                else:
+                    req = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if req.expired():
+                on_expired(req)
+                continue
+            if rows + req.n_rows > self.max_batch_size:
+                self._carry = req  # never split a request across batches
+                break
+            batch.append(req)
+            rows += req.n_rows
+        return batch

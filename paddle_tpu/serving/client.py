@@ -1,0 +1,35 @@
+"""In-process client helper over InferenceServer.
+
+The test-and-bench-facing convenience surface: blocking single calls,
+scatter/gather for many requests, and named-output dicts.  A remote
+transport (RPC) would sit exactly where this class sits — everything
+below (submit/future) is transport-agnostic.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Client"]
+
+
+class Client:
+    def __init__(self, server):
+        self._server = server
+        self._fetch_names = list(server._predictor.get_output_names())
+
+    def infer(self, feed, timeout_ms: Optional[float] = None) -> List[np.ndarray]:
+        """Submit one request and block for its outputs (list ordered
+        like the predictor's fetch list)."""
+        return self._server.submit(feed, timeout_ms=timeout_ms).result()
+
+    def infer_named(self, feed, timeout_ms: Optional[float] = None) -> Dict[str, np.ndarray]:
+        """infer(), but keyed by the endpoint's output names."""
+        return dict(zip(self._fetch_names, self.infer(feed, timeout_ms)))
+
+    def infer_many(self, feeds, timeout_ms: Optional[float] = None) -> List[List[np.ndarray]]:
+        """Submit every feed first (so they can coalesce into shared
+        batches), then gather all results in order."""
+        futures = [self._server.submit(f, timeout_ms=timeout_ms) for f in feeds]
+        return [f.result() for f in futures]

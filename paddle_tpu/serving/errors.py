@@ -1,0 +1,36 @@
+"""Typed serving errors.
+
+The admission-control / deadline / lifecycle contract is error-typed so
+callers can distinguish "retry later" (ServerOverloaded), "client gave
+up" (DeadlineExceeded), and "stop sending" (ServerClosed) without
+string-matching — the Clipper/Orca-style front-end contract the
+reference stack leaves to the external serving system.
+"""
+from __future__ import annotations
+
+__all__ = [
+    "ServingError",
+    "ServerOverloaded",
+    "DeadlineExceeded",
+    "ServerClosed",
+]
+
+
+class ServingError(RuntimeError):
+    """Base class for all serving-layer errors."""
+
+
+class ServerOverloaded(ServingError):
+    """Admission control shed this request: the bounded request queue is
+    full.  The request was NOT enqueued; back off and retry."""
+
+
+class DeadlineExceeded(ServingError, TimeoutError):
+    """The request's deadline expired before a result was produced —
+    either while queued (the server sheds it instead of running stale
+    work) or while the client waited on the future."""
+
+
+class ServerClosed(ServingError):
+    """The server is shutting down (or already stopped) and no longer
+    admits new requests."""

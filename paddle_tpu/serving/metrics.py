@@ -1,0 +1,102 @@
+"""Serving metrics registry.
+
+Thread-safe counters + a bounded latency reservoir + a per-bucket
+occupancy histogram, exposed two ways:
+
+* ``snapshot()`` — a plain dict (QPS, p50/p99 latency, mean batch
+  occupancy, shed/expired counts, recompile counter) for tests, bench
+  drivers, and admin endpoints;
+* per-batch events routed through ``paddle_tpu.profiler`` — each
+  executed batch is timed under a ``RecordEvent`` (so it shows in the
+  stop_profiler() host table) and emitted to the active JSONL trace
+  sink via ``profiler.emit_trace_event`` for offline tail analysis.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict
+
+import numpy as np
+
+from paddle_tpu import profiler
+
+__all__ = ["ServingMetrics"]
+
+_RESERVOIR = 8192  # latencies kept for the percentile estimate
+
+
+class ServingMetrics:
+    def __init__(self, name: str = "server"):
+        self.name = name
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self._counters = {
+            "requests": 0,       # admitted into the queue
+            "completed": 0,      # results delivered
+            "failed": 0,         # completed with a non-deadline error
+            "shed": 0,           # rejected at admission (queue full)
+            "expired": 0,        # deadline passed before a result
+            "batches": 0,        # predictor executions
+            "warmup_compiles": 0,
+            "recompiles": 0,     # jit-cache misses AFTER warmup
+        }
+        self._latencies: deque = deque(maxlen=_RESERVOIR)  # seconds, per request
+        # bucket -> [n_batches, total_valid_rows]
+        self._occupancy: Dict[int, list] = {}
+
+    # ------------------------------------------------------------------
+    def count(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[key] += n
+
+    def observe_request(self, latency_s: float) -> None:
+        with self._lock:
+            self._counters["completed"] += 1
+            self._latencies.append(latency_s)
+
+    def observe_batch(self, valid: int, bucket: int, run_s: float,
+                      recompiled: bool = False) -> None:
+        """Record one executed batch and emit its trace event."""
+        with self._lock:
+            self._counters["batches"] += 1
+            if recompiled:
+                self._counters["recompiles"] += 1
+            ent = self._occupancy.setdefault(bucket, [0, 0])
+            ent[0] += 1
+            ent[1] += valid
+        profiler.emit_trace_event({
+            "event": "serving.batch",
+            "server": self.name,
+            "valid": int(valid),
+            "bucket": int(bucket),
+            "run_ms": round(run_s * 1e3, 3),
+            "recompiled": bool(recompiled),
+        })
+
+    # ------------------------------------------------------------------
+    def snapshot(self) -> Dict[str, object]:
+        """Point-in-time metrics dict (the admin/bench surface)."""
+        with self._lock:
+            counters = dict(self._counters)
+            lats = np.asarray(self._latencies, dtype=np.float64)
+            occupancy = {b: tuple(v) for b, v in self._occupancy.items()}
+            elapsed = time.perf_counter() - self._t0
+        snap: Dict[str, object] = dict(counters)
+        snap["elapsed_s"] = round(elapsed, 3)
+        snap["qps"] = round(counters["completed"] / elapsed, 2) if elapsed > 0 else 0.0
+        if lats.size:
+            snap["latency_p50_ms"] = round(float(np.percentile(lats, 50)) * 1e3, 3)
+            snap["latency_p99_ms"] = round(float(np.percentile(lats, 99)) * 1e3, 3)
+        else:
+            snap["latency_p50_ms"] = snap["latency_p99_ms"] = None
+        total_rows = sum(b * n for b, (n, _) in occupancy.items())
+        total_valid = sum(v for _, v in occupancy.values())
+        snap["mean_batch_occupancy"] = (
+            round(total_valid / total_rows, 4) if total_rows else None)
+        snap["batch_histogram"] = {
+            str(b): {"batches": n, "valid_rows": v}
+            for b, (n, v) in sorted(occupancy.items())
+        }
+        return snap

@@ -1,0 +1,275 @@
+"""InferenceServer: a dynamic-batching front end over AnalysisPredictor.
+
+The reference stack ships models to an external serving system
+(Paddle Serving); this repo's TPU-native answer is in-process: a
+single worker thread owns the predictor (the jitted XLA module is the
+"replica"), a bounded queue + DynamicBatcher coalesce concurrent
+requests, and a BucketPolicy pads every batch onto a fixed size ladder
+so the executor's jit cache sees a CLOSED shape set — after
+``warmup()`` pre-compiles each rung, steady-state serving performs
+zero XLA compiles (asserted through Executor.jit_cache_stats, not
+inferred from timing).
+
+Lifecycle: construct (worker starts) -> warmup() -> submit()/Client
+traffic -> stop(drain=True) for a graceful drain.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu import profiler
+from paddle_tpu.serving.batching import DynamicBatcher, ServingRequest
+from paddle_tpu.serving.bucketing import BucketPolicy
+from paddle_tpu.serving.errors import DeadlineExceeded, ServerClosed
+from paddle_tpu.serving.metrics import ServingMetrics
+
+__all__ = ["InferenceServer"]
+
+
+class InferenceServer:
+    """Wraps a predictor exposing ``run_padded`` / ``jit_cache_stats`` /
+    ``get_input_names`` (AnalysisPredictor) behind a batched, bucketed,
+    deadline-aware submit() API.
+
+    ``input_specs`` (``{name: (per_row_shape, dtype)}``) defaults to the
+    predictor's program-derived specs; pass it explicitly when a feed
+    var has dynamic non-batch dims.
+    """
+
+    def __init__(
+        self,
+        predictor,
+        max_batch_size: int = 32,
+        batch_timeout_ms: float = 5.0,
+        queue_capacity: int = 256,
+        bucket_ladder: Optional[Sequence[int]] = None,
+        input_specs: Optional[Dict[str, Tuple[tuple, Any]]] = None,
+        name: str = "server",
+    ):
+        self.name = name
+        self._predictor = predictor
+        self._policy = BucketPolicy(max_batch_size, bucket_ladder)
+        self._batcher = DynamicBatcher(
+            max_batch_size, batch_timeout_ms, queue_capacity)
+        self._metrics = ServingMetrics(name)
+        self._specs = dict(input_specs) if input_specs else predictor.input_specs()
+        self._feed_names = list(predictor.get_input_names())
+        self._stop = threading.Event()
+        self._closed = False           # admission gate (set before _stop on shutdown)
+        self._warmed = False
+        self._baseline_misses: Optional[int] = None
+        self._exec_lock = threading.Lock()  # warmup vs worker predictor use
+        self._worker = threading.Thread(
+            target=self._serve_loop, name="serving-%s" % name, daemon=True)
+        self._worker.start()
+
+    # ------------------------------------------------------------------
+    @property
+    def bucket_ladder(self) -> List[int]:
+        return list(self._policy.ladder)
+
+    @property
+    def max_batch_size(self) -> int:
+        return self._policy.max_batch_size
+
+    def metrics(self) -> Dict[str, object]:
+        snap = self._metrics.snapshot()
+        snap["queue_depth"] = self._batcher.qsize()
+        snap["bucket_ladder"] = self.bucket_ladder
+        snap["warmed_up"] = self._warmed
+        return snap
+
+    # ------------------------------------------------------------------
+    def warmup(self, cache_dir: Optional[str] = None,
+               configure_cache: bool = True) -> int:
+        """Pre-compile every bucket rung; returns the number of XLA
+        compiles the warmup performed.  Routes through jax's persistent
+        compilation cache (bench_common.configure_compile_cache) when the
+        repo-root helper is importable, so a warm disk cache makes repeat
+        server starts cheap; synthetic rows are zeros (always in-range
+        for int id feeds).  After warmup the recompile counter arms:
+        any further jit-cache miss increments ``metrics()['recompiles']``.
+
+        NOTE ``configure_cache=True`` mutates PROCESS-GLOBAL state (the
+        JAX_COMPILATION_CACHE_* env vars + jax.config); pass
+        ``configure_cache=False`` when the embedding application owns
+        its own jax cache configuration.  Any failure to wire the cache
+        (helper missing, or an unrelated ``bench_common`` shadowing it)
+        degrades to cold compiles, never a crashed warmup.
+        """
+        if configure_cache:
+            try:
+                import bench_common
+
+                bench_common.configure_compile_cache(
+                    cache_dir or bench_common.HOME_CACHE_DIR)
+            except (ImportError, AttributeError):
+                pass  # standalone use / foreign bench_common: compile cold
+        misses0 = self._predictor.jit_cache_stats()["misses"]
+        for bucket in self._policy.ladder:
+            feed = {
+                name: np.zeros((bucket,) + tuple(shape), dtype)
+                for name, (shape, dtype) in self._specs.items()
+            }
+            with self._exec_lock:
+                with profiler.RecordEvent("serving/%s/warmup" % self.name):
+                    self._predictor.run_padded(feed, n_valid=bucket)
+        compiles = self._predictor.jit_cache_stats()["misses"] - misses0
+        self._metrics.count("warmup_compiles", compiles)
+        self._baseline_misses = self._predictor.jit_cache_stats()["misses"]
+        self._warmed = True
+        return compiles
+
+    # ------------------------------------------------------------------
+    def submit(self, feed, timeout_ms: Optional[float] = None) -> ServingRequest:
+        """Enqueue one request; returns its future (ServingRequest).
+
+        ``feed``: dict (or positional sequence) of arrays whose shared
+        leading dim is the request's row count (1..max_batch_size).
+        Raises ServerOverloaded when the queue is full, ServerClosed
+        after stop(); the future raises DeadlineExceeded when
+        ``timeout_ms`` elapses first.
+        """
+        if self._closed:
+            raise ServerClosed("server %r is stopped" % self.name)
+        feed, n_rows = self._normalize_feed(feed)
+        deadline = (
+            time.monotonic() + float(timeout_ms) / 1e3
+            if timeout_ms is not None else None)
+        req = ServingRequest(feed, n_rows, deadline)
+        try:
+            self._batcher.offer(req)
+        except Exception:
+            self._metrics.count("shed")
+            raise
+        self._metrics.count("requests")
+        # close the submit-vs-stop race: if stop() won between the
+        # admission check above and the offer, the worker may already be
+        # gone — nothing would ever serve this queue, so fail the
+        # stragglers (first completion wins, so a request the worker DID
+        # pick up keeps its real result)
+        if self._stop.is_set() and not self._worker.is_alive():
+            self._fail_stragglers()
+            if req.done():
+                raise ServerClosed("server %r is stopped" % self.name)
+        return req
+
+    def _normalize_feed(self, feed) -> Tuple[Dict[str, np.ndarray], int]:
+        if not isinstance(feed, dict):
+            feed = dict(zip(self._feed_names, feed))
+        if set(feed) != set(self._feed_names):
+            raise ValueError(
+                "feed names %s != endpoint inputs %s"
+                % (sorted(feed), sorted(self._feed_names)))
+        out, n_rows = {}, None
+        for name, val in feed.items():
+            shape, dtype = self._specs[name]
+            # coerce to the spec dtype so every request produces the
+            # SAME compiled signature the warmup buckets did — a stray
+            # float64 feed must not become a novel compile
+            arr = np.asarray(val, dtype=dtype)
+            if arr.shape[1:] != tuple(shape):
+                raise ValueError(
+                    "feed %r rows have shape %s, endpoint expects %s"
+                    % (name, arr.shape[1:], tuple(shape)))
+            if n_rows is None:
+                n_rows = arr.shape[0]
+            elif arr.shape[0] != n_rows:
+                raise ValueError(
+                    "inconsistent request row counts: %r has %d rows, "
+                    "expected %d" % (name, arr.shape[0], n_rows))
+            out[name] = arr
+        if not n_rows:
+            raise ValueError("empty request (0 rows)")
+        if n_rows > self._policy.max_batch_size:
+            raise ValueError(
+                "request of %d rows exceeds max_batch_size=%d — split it"
+                % (n_rows, self._policy.max_batch_size))
+        return out, n_rows
+
+    # ------------------------------------------------------------------
+    def _fail_stragglers(self) -> None:
+        """Fail every request still queued once no worker will ever
+        serve it — stuck requests must surface as typed errors, never
+        hangs (the subsystem's core contract)."""
+        for req in self._batcher.drain_pending():
+            req.fail(ServerClosed("server %r stopped" % self.name))
+
+    def _on_expired(self, req: ServingRequest) -> None:
+        self._metrics.count("expired")
+        req.fail(DeadlineExceeded("deadline passed while queued"))
+
+    def _serve_loop(self) -> None:
+        while True:
+            batch = self._batcher.next_batch(self._stop, self._on_expired)
+            if batch is None:
+                return  # stopped and drained
+            self._execute(batch)
+
+    def _execute(self, batch: List[ServingRequest]) -> None:
+        valid = sum(r.n_rows for r in batch)
+        try:
+            merged = {
+                name: (
+                    np.concatenate([r.feed[name] for r in batch], axis=0)
+                    if len(batch) > 1 else batch[0].feed[name])
+                for name in self._feed_names
+            }
+            bucket = self._policy.bucket_for(valid)
+            padded = self._policy.pad_feed(merged, bucket)
+            misses0 = self._predictor.jit_cache_stats()["misses"]
+            t0 = time.perf_counter()
+            with self._exec_lock:
+                with profiler.RecordEvent("serving/%s/batch" % self.name):
+                    outs = self._predictor.run_padded(padded, n_valid=valid)
+            run_s = time.perf_counter() - t0
+            recompiled = self._predictor.jit_cache_stats()["misses"] > misses0
+            self._metrics.observe_batch(
+                valid, bucket, run_s,
+                recompiled=recompiled and self._warmed)
+        except BaseException as exc:  # noqa: BLE001 — fail the batch, keep serving
+            self._metrics.count("failed", len(batch))
+            for r in batch:
+                r.fail(exc)
+            return
+        outs = [np.asarray(o) for o in outs]
+        off = 0
+        now = time.perf_counter()
+        for r in batch:
+            per_req = [
+                o[off:off + r.n_rows]
+                if o.ndim >= 1 and o.shape[0] == valid else o
+                for o in outs
+            ]
+            off += r.n_rows
+            r.complete(per_req)
+            self._metrics.observe_request(now - r.submit_t)
+
+    # ------------------------------------------------------------------
+    def stop(self, drain: bool = True, timeout: Optional[float] = None) -> None:
+        """Shut down.  ``drain=True`` (graceful): stop admitting, finish
+        every queued request, then join the worker.  ``drain=False``:
+        queued-but-unstarted requests fail with ServerClosed."""
+        self._closed = True
+        if not drain:
+            # empty the queue before releasing the worker so it cannot
+            # start work we are abandoning
+            self._fail_stragglers()
+        self._stop.set()
+        self._worker.join(timeout)
+        # a submit() that raced past the admission check may have
+        # enqueued AFTER the worker drained and exited — fail it (and
+        # anything else left) rather than leaving its future pending
+        if not self._worker.is_alive():
+            self._fail_stragglers()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop(drain=exc == (None, None, None))
+        return False

@@ -1,0 +1,314 @@
+"""Serving subsystem tests (paddle_tpu/serving/): dynamic batching,
+bucket padding, deadlines, admission control, graceful drain, and the
+zero-recompiles-after-warmup guarantee (verified through the executor's
+jit-cache stats, not inferred from timing).
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import framework, profiler, serving
+from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+from paddle_tpu.serving import (
+    BucketPolicy,
+    Client,
+    DeadlineExceeded,
+    InferenceServer,
+    ServerClosed,
+    ServerOverloaded,
+)
+
+IN_DIM, OUT_DIM = 16, 4
+
+
+@pytest.fixture(scope="module")
+def predictor(tmp_path_factory):
+    """A small fc/relu/softmax endpoint saved + reloaded through the
+    real inference path (save_inference_model -> AnalysisPredictor)."""
+    d = str(tmp_path_factory.mktemp("serving") / "mlp")
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 7
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [IN_DIM])
+        h = fluid.layers.fc(x, 32, act="relu")
+        pred = fluid.layers.fc(h, OUT_DIM, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.save_inference_model(d, ["x"], [pred], exe, prog)
+    return create_paddle_predictor(AnalysisConfig(d))
+
+
+def _rows(n, seed=0):
+    return np.random.RandomState(seed).uniform(-1, 1, (n, IN_DIM)).astype("float32")
+
+
+class SlowPredictor:
+    """Predictor stub whose run blocks — deterministic worker stalls for
+    the deadline/overload/drain tests (no XLA in the hot loop)."""
+
+    def __init__(self, delay_s=0.0):
+        self.delay_s = delay_s
+        self.calls = 0
+
+    def get_input_names(self):
+        return ["x"]
+
+    def get_output_names(self):
+        return ["y"]
+
+    def input_specs(self):
+        return {"x": ((IN_DIM,), np.dtype("float32"))}
+
+    def jit_cache_stats(self):
+        return {"entries": 0, "hits": 0, "misses": 0}
+
+    def run_padded(self, feed, n_valid=None):
+        self.calls += 1
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return [np.asarray(feed["x"][:n_valid]).sum(axis=1, keepdims=True)]
+
+
+# ---------------------------------------------------------------------------
+# bucket policy unit behavior
+# ---------------------------------------------------------------------------
+def test_bucket_ladder_and_rounding():
+    p = BucketPolicy(12)
+    assert p.ladder == [1, 2, 4, 8, 12]
+    assert [p.bucket_for(n) for n in (1, 2, 3, 5, 8, 9, 12)] == [1, 2, 4, 8, 8, 12, 12]
+    with pytest.raises(ValueError):
+        p.bucket_for(13)
+    padded = p.pad_feed({"x": _rows(3)}, 4)
+    assert padded["x"].shape == (4, IN_DIM)
+    np.testing.assert_array_equal(padded["x"][3], padded["x"][2])  # last-row repeat
+
+
+# ---------------------------------------------------------------------------
+# coalescing + padding correctness on the real predictor
+# ---------------------------------------------------------------------------
+def test_batch_coalescing_under_concurrent_submitters(predictor):
+    server = InferenceServer(
+        predictor, max_batch_size=8, batch_timeout_ms=40, name="coalesce")
+    try:
+        server.warmup()
+        cli = Client(server)
+        xb = _rows(1, seed=3)
+        want = np.asarray(predictor.run({"x": xb})[0])
+        n_req, results = 16, [None] * 16
+        start = threading.Barrier(n_req)
+
+        def go(i):
+            start.wait()
+            (results[i],) = cli.infer({"x": xb})
+
+        threads = [threading.Thread(target=go, args=(i,)) for i in range(n_req)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for r in results:
+            np.testing.assert_array_equal(r, want)
+        m = server.metrics()
+        assert m["completed"] == n_req
+        # the whole point of the batcher: far fewer executions than requests
+        assert m["batches"] < n_req
+        assert m["mean_batch_occupancy"] is not None
+    finally:
+        server.stop()
+
+
+def test_bucket_padding_outputs_bitwise_equal(predictor):
+    """A 3-row request runs as a padded 4-row bucket; the real rows must
+    be BITWISE equal to the unpadded direct run (rows are independent
+    through fc/relu/softmax, so padding may not perturb them at all)."""
+    server = InferenceServer(
+        predictor, max_batch_size=8, batch_timeout_ms=1, name="pad")
+    try:
+        server.warmup()
+        xb = _rows(3, seed=5)
+        (got,) = server.submit({"x": xb}).result(timeout=30)
+        (want,) = predictor.run({"x": xb})
+        np.testing.assert_array_equal(got, np.asarray(want))
+        hist = server.metrics()["batch_histogram"]
+        assert hist["4"]["batches"] == 1 and hist["4"]["valid_rows"] == 3
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# deadlines, shedding, drain (stub predictor: deterministic stalls)
+# ---------------------------------------------------------------------------
+def test_deadline_expiry_is_timeout_error_not_hang():
+    slow = SlowPredictor(delay_s=0.3)
+    server = InferenceServer(
+        slow, max_batch_size=4, batch_timeout_ms=1, queue_capacity=8,
+        name="deadline")
+    try:
+        # first request occupies the worker for 300 ms...
+        blocker = server.submit({"x": _rows(1)})
+        time.sleep(0.1)  # worker is now inside the slow run, batch closed
+        # ...so this one's 40 ms deadline expires while it waits queued
+        fut = server.submit({"x": _rows(1)}, timeout_ms=40)
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            fut.result()
+        assert time.monotonic() - t0 < 5.0  # error, not a hang
+        blocker.result(timeout=5)
+        # the worker eventually pops the expired request and sheds it
+        deadline = time.monotonic() + 5
+        while server.metrics()["expired"] == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert server.metrics()["expired"] == 1
+    finally:
+        server.stop()
+
+
+def test_overload_shedding_raises_typed_error():
+    slow = SlowPredictor(delay_s=0.2)
+    server = InferenceServer(
+        slow, max_batch_size=1, batch_timeout_ms=1, queue_capacity=2,
+        name="overload")
+    try:
+        futs = [server.submit({"x": _rows(1)})]  # worker picks this up
+        time.sleep(0.05)  # let the worker start, freeing queue slots
+        with pytest.raises(ServerOverloaded):
+            for _ in range(16):
+                futs.append(server.submit({"x": _rows(1)}))
+        assert server.metrics()["shed"] >= 1
+        for f in futs:
+            f.result(timeout=10)
+    finally:
+        server.stop()
+
+
+def test_graceful_drain_completes_queued_work():
+    slow = SlowPredictor(delay_s=0.05)
+    server = InferenceServer(
+        slow, max_batch_size=2, batch_timeout_ms=1, queue_capacity=32,
+        name="drain")
+    futs = [server.submit({"x": _rows(1, seed=i)}) for i in range(6)]
+    server.stop(drain=True)
+    assert all(f.done() for f in futs)
+    for f in futs:
+        assert f.result(timeout=0)[0].shape == (1, 1)
+    assert not server._worker.is_alive()
+    with pytest.raises(ServerClosed):
+        server.submit({"x": _rows(1)})
+    assert server.metrics()["completed"] == 6
+
+
+def test_submit_racing_stop_fails_typed_not_hang():
+    """A submit that passed the admission check before stop() ran must
+    come back as ServerClosed, never a forever-pending future (the
+    worker is gone; nothing would serve the queue)."""
+    server = InferenceServer(
+        SlowPredictor(), max_batch_size=2, batch_timeout_ms=1, name="race")
+    server.stop(drain=True)
+    server._closed = False  # simulate losing the admission-check race
+    with pytest.raises(ServerClosed):
+        server.submit({"x": _rows(1)})
+
+
+def test_stop_without_drain_fails_queued_requests():
+    slow = SlowPredictor(delay_s=0.2)
+    server = InferenceServer(
+        slow, max_batch_size=1, batch_timeout_ms=1, queue_capacity=32,
+        name="abort")
+    running = server.submit({"x": _rows(1)})
+    time.sleep(0.05)  # worker is now inside the slow run
+    queued = [server.submit({"x": _rows(1)}) for _ in range(4)]
+    server.stop(drain=False)
+    running.result(timeout=10)  # in-flight work still completes
+    closed = 0
+    for f in queued:
+        try:
+            f.result(timeout=10)
+        except ServerClosed:
+            closed += 1
+    assert closed >= 1  # everything not yet started was failed, not run
+
+
+# ---------------------------------------------------------------------------
+# the headline guarantee: zero XLA compiles after warmup
+# ---------------------------------------------------------------------------
+def test_zero_recompiles_after_warmup_mixed_concurrent_sizes(predictor):
+    server = InferenceServer(
+        predictor, max_batch_size=8, batch_timeout_ms=10, name="warm")
+    try:
+        compiles = server.warmup()
+        assert compiles >= 0  # module-scope predictor may be pre-warmed
+        assert server.bucket_ladder == [1, 2, 4, 8]
+        misses0 = predictor.jit_cache_stats()["misses"]
+
+        cli = Client(server)
+        sizes = [1, 2, 3, 5, 7, 8, 4, 6, 1, 3, 2, 5]
+        errors = []
+
+        def go(i, n):
+            try:
+                (out,) = cli.infer({"x": _rows(n, seed=i)})
+                assert out.shape == (n, OUT_DIM)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=go, args=(i, n)) for i, n in enumerate(sizes)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        stats = predictor.jit_cache_stats()
+        assert stats["misses"] == misses0, (
+            "serving recompiled after warmup: %s" % stats)
+        m = server.metrics()
+        assert m["recompiles"] == 0
+        assert m["completed"] == len(sizes)
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# metrics + profiler JSONL trace integration
+# ---------------------------------------------------------------------------
+def test_metrics_snapshot_and_jsonl_trace(predictor, tmp_path):
+    trace = str(tmp_path / "serving_trace.jsonl")
+    with profiler.jsonl_trace(trace):
+        server = InferenceServer(
+            predictor, max_batch_size=4, batch_timeout_ms=1, name="traced")
+        try:
+            server.warmup()
+            for i in range(3):
+                server.submit({"x": _rows(2, seed=i)}).result(timeout=30)
+        finally:
+            server.stop()
+        m = server.metrics()
+    assert m["batches"] == 3 and m["completed"] == 3
+    assert m["latency_p50_ms"] > 0 and m["latency_p99_ms"] >= m["latency_p50_ms"]
+    assert m["qps"] > 0
+    assert m["mean_batch_occupancy"] == 1.0  # 2 rows in bucket 2, thrice
+    events = [json.loads(ln) for ln in open(trace)]
+    batches = [e for e in events if e["event"] == "serving.batch"]
+    assert len(batches) == 3
+    assert all(e["server"] == "traced" and e["bucket"] == 2 and e["valid"] == 2
+               for e in batches)
+    assert all("ts" in e and "run_ms" in e for e in batches)
+
+
+def test_feed_validation_is_loud(predictor):
+    server = InferenceServer(predictor, max_batch_size=4, name="valid")
+    try:
+        with pytest.raises(ValueError, match="feed names"):
+            server.submit({"nope": _rows(1)})
+        with pytest.raises(ValueError, match="expects"):
+            server.submit({"x": np.zeros((1, IN_DIM + 1), "float32")})
+        with pytest.raises(ValueError, match="exceeds max_batch_size"):
+            server.submit({"x": _rows(5)})
+    finally:
+        server.stop()
