@@ -9,8 +9,12 @@ comparison.  This helper owns the env parse, leading-axis sizing, and
 device staging so the four benches can't drift.
 """
 import os
+import sys
 
-__all__ = ["configure_compile_cache", "fresh_enabled", "stage_feeds"]
+__all__ = [
+    "configure_compile_cache", "fresh_enabled", "stage_feeds",
+    "metrics_out_path", "dump_metrics", "emit_result",
+]
 
 def _host_cache_tag():
     """Hostname + CPU-feature hash segment for the shared HOME cache dir.
@@ -91,6 +95,48 @@ def configure_compile_cache(default_dir):
 
 def fresh_enabled(default="1"):
     return os.environ.get("BENCH_FRESH", default) == "1"
+
+
+# ---------------------------------------------------------------------------
+# Metrics dump alongside the bench JSON line (paddle_tpu.monitor)
+# ---------------------------------------------------------------------------
+def metrics_out_path(argv=None):
+    """Opt-in registry dump target: ``--metrics-out PATH`` /
+    ``--metrics-out=PATH`` on the bench command line, or
+    ``$BENCH_METRICS_OUT``.  Returns None when not requested."""
+    argv = sys.argv[1:] if argv is None else list(argv)
+    for i, arg in enumerate(argv):
+        if arg == "--metrics-out" and i + 1 < len(argv):
+            return argv[i + 1]
+        if arg.startswith("--metrics-out="):
+            return arg.split("=", 1)[1]
+    return os.environ.get("BENCH_METRICS_OUT") or None
+
+
+def dump_metrics(path):
+    """Write the process-global monitor registry snapshot as JSON —
+    every counter/gauge/histogram the run touched (executor jit cache,
+    reader stalls, serving counters, predictor padding waste)."""
+    import json
+
+    from paddle_tpu import monitor
+
+    with open(path, "w") as f:
+        json.dump(monitor.snapshot(), f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def emit_result(result, argv=None):
+    """Print the bench's ONE JSON line; when ``--metrics-out`` (or
+    $BENCH_METRICS_OUT) is set, dump the registry snapshot next to it."""
+    import json
+
+    print(json.dumps(result))
+    path = metrics_out_path(argv)
+    if path:
+        dump_metrics(path)
+    return result
 
 
 def stage_feeds(stacked, fresh, chunk, device):

@@ -118,8 +118,22 @@ def _bench_endpoint(name, save_fn):
         for t in threads:
             t.join()
         elapsed = time.perf_counter() - t0
+        # the PR-1 zero-recompile guarantee, enforced IN the bench via
+        # the monitor registry (not just tests): after warmup the jit
+        # cache must never miss, or the rows/sec number is a lie that
+        # includes XLA compiles.  Read BEFORE stop(): every request has
+        # completed (cli.infer blocks), and stop() retires this server's
+        # series from the registry exposition.
+        from paddle_tpu import monitor
+
+        registry_recompiles = monitor.counter_value(
+            "serving_recompiles_total", default=-1, server=name)
         server.stop(drain=True)
         m = server.metrics()
+        if registry_recompiles != 0 or m["recompiles"] != 0:
+            raise AssertionError(
+                "endpoint %r recompiled after warmup: registry=%s snapshot=%s"
+                % (name, registry_recompiles, m["recompiles"]))
         rows = sum(total_rows)
         return {
             "rows_per_sec": round(rows / elapsed, 1),
@@ -172,7 +186,11 @@ def run():
 
 
 def main():
-    print(json.dumps(run()))
+    import bench_common
+
+    # --metrics-out <path> (or $BENCH_METRICS_OUT) dumps the monitor
+    # registry snapshot next to the JSON line
+    bench_common.emit_result(run())
 
 
 if __name__ == "__main__":

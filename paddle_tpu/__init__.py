@@ -58,6 +58,7 @@ from paddle_tpu import contrib
 from paddle_tpu import inference
 from paddle_tpu import native
 from paddle_tpu.fluid_dataset import DatasetFactory, InMemoryDataset, QueueDataset
+from paddle_tpu import monitor
 from paddle_tpu import profiler
 from paddle_tpu import serving
 from paddle_tpu import memory

@@ -11,10 +11,12 @@ vars) is threaded functionally and donated, giving in-place param updates.
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from paddle_tpu.core import registry
 from paddle_tpu.core.registry import EMPTY_VAR_NAME
+from paddle_tpu.monitor import spans as _mon_spans
 
 __all__ = ["lower_block", "trace_ops"]
 
@@ -71,11 +73,20 @@ def lower_block(
     ops = list(block.ops)
 
     def fn(state: Dict[str, Any], feed: Dict[str, Any]):
+        # the host-side cost of tracing the whole block through the op
+        # kernels — this runs under jax.jit tracing on the first dispatch
+        # of a cache key, so the span lands nested inside the executor's
+        # jit_compile span (run-phase observability, paddle_tpu/monitor)
+        _t0 = time.perf_counter() if _mon_spans.recording() else None
         env = dict(state)
         env.update(feed)
         trace_ops(ops, env, block)
         fetches = [env[n] for n in fetch_names]
         new_state = {n: env[n] for n in state_names if n in env}
+        if _t0 is not None:
+            _mon_spans.record_span(
+                "lowering/trace_block", _t0, time.perf_counter() - _t0,
+                cat="lower", n_ops=len(ops))
         return fetches, new_state
 
     return fn

@@ -22,6 +22,7 @@ via ParallelExecutor + NCCL op-handles (parallel_executor.cc:356).
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -29,9 +30,57 @@ import numpy as np
 from paddle_tpu import framework
 from paddle_tpu.core import lowering
 from paddle_tpu.core import types as core_types
+from paddle_tpu.monitor import registry as _mon_registry
+from paddle_tpu.monitor import spans as _mon_spans
 from paddle_tpu.scope import Scope, global_scope
 
 __all__ = ["Executor", "AsyncExecutor"]
+
+# run-phase observability (paddle_tpu/monitor).  The jit hit/miss/run
+# counters are COLLECT-ON-READ: every Executor's ``_cache_stats`` dict
+# registers here at construction and the registry sums them when a
+# consumer scrapes, so the run() hot path pays nothing beyond the dict
+# increments it already did (a locked registry counter costs ~1.5us per
+# inc — real money against a ~200us cached dispatch).  The per-phase
+# spans gate on _mon_spans.recording(), one flag check each when no
+# trace session is active.
+import threading as _threading
+import weakref as _weakref
+
+_exec_stats_lock = _threading.Lock()
+_exec_stats: List[Dict[str, int]] = []  # one _cache_stats dict per LIVE Executor
+_exec_retired = {"hits": 0, "misses": 0, "runs": 0}  # folded-in dead executors
+
+
+def _retire_exec_stats(stats: Dict[str, int]) -> None:
+    # weakref.finalize callback: fold a dead executor's totals into the
+    # retired base so the counters stay monotonic without pinning every
+    # stats dict (and paying O(all-executors-ever) per scrape) forever
+    with _exec_stats_lock:
+        try:
+            _exec_stats.remove(stats)
+        except ValueError:
+            return
+        for k in _exec_retired:
+            _exec_retired[k] += stats.get(k, 0)
+
+
+def _sum_exec_stats(key: str) -> int:
+    with _exec_stats_lock:
+        return _exec_retired[key] + sum(d.get(key, 0) for d in _exec_stats)
+
+
+_mon_registry.REGISTRY.counter_callback(
+    "executor_runs_total", "Executor.run invocations (all executors)",
+    fn=lambda: _sum_exec_stats("runs"))
+_mon_registry.REGISTRY.counter_callback(
+    "executor_jit_cache_hits_total",
+    "runs served by an existing compiled entry",
+    fn=lambda: _sum_exec_stats("hits"))
+_mon_registry.REGISTRY.counter_callback(
+    "executor_jit_cache_misses_total",
+    "newly built jitted entries (an XLA compile on first dispatch)",
+    fn=lambda: _sum_exec_stats("misses"))
 
 
 def _as_fetch_name(f) -> str:
@@ -48,8 +97,14 @@ class Executor:
         # jax.jit entry was built for a novel (program, feed-signature,
         # ...) key — i.e. an XLA compile on first dispatch.  This is the
         # ground truth behind serving's recompile counter, not an
-        # inference from timing.
-        self._cache_stats = {"hits": 0, "misses": 0}
+        # inference from timing.  The dict also feeds the registry's
+        # executor_* callback counters (summed across live executors at
+        # scrape time; a finalizer folds this executor's totals into the
+        # retired base on GC so the counters stay monotonic).
+        self._cache_stats = {"hits": 0, "misses": 0, "runs": 0}
+        with _exec_stats_lock:
+            _exec_stats.append(self._cache_stats)
+        _weakref.finalize(self, _retire_exec_stats, self._cache_stats)
 
     # ------------------------------------------------------------------
     def _device(self):
@@ -107,6 +162,8 @@ class Executor:
         feeding the train loop (operators/reader/buffered_reader.cc)."""
         import jax
 
+        self._cache_stats["runs"] += 1
+        _rec = _mon_spans.recording()
         compiled = None
         if program is not None and getattr(program, "_is_compiled_program", False):
             compiled = program
@@ -210,6 +267,8 @@ class Executor:
         # jax Arrays (e.g. a device-resident input pipeline, reader.py)
         # pass through untouched — no host round-trip
         device = self._device()
+        if _rec:
+            _t0 = time.perf_counter()
         feed_arrays = {}
         for name, val in feed.items():
             var = block._find_var_recursive(name)
@@ -226,6 +285,10 @@ class Executor:
                 continue
             arr = np.asarray(val, dtype=dtype)
             feed_arrays[name] = jax.device_put(arr, device)
+        if _rec:
+            _mon_spans.record_span(
+                "executor/h2d_feed", _t0, time.perf_counter() - _t0,
+                cat="transfer", n_feeds=len(feed_arrays))
 
         missing = [n for n in state_mut + state_ro if scope.get(n) is None]
         if missing:
@@ -253,10 +316,13 @@ class Executor:
         )
 
         entry = self._cache.get(key) if use_program_cache else None
+        first_dispatch = entry is None
         if entry is not None:
             self._cache_stats["hits"] += 1
         else:
             self._cache_stats["misses"] += 1
+            if _rec:
+                _t0 = time.perf_counter()
             fn = lowering.lower_block(block, feed_names, fetch_names, state_out)
 
             if steps == 1:
@@ -307,6 +373,13 @@ class Executor:
                     )
                 )
             entry = jax.jit(stepfn, **jit_kwargs)
+            if _rec:
+                # closure construction only; the block actually traces
+                # inside the first dispatch (the lowering/trace_block
+                # span nested in executor/jit_compile below)
+                _mon_spans.record_span(
+                    "executor/lower", _t0, time.perf_counter() - _t0,
+                    cat="lower", n_ops=len(block.ops))
             if use_program_cache:
                 self._cache[key] = entry
 
@@ -316,7 +389,19 @@ class Executor:
             feed_arrays, mut_state, ro_state = compiled._shard_inputs(
                 feed_arrays, mut_state, ro_state, per_step_feed=per_step_feed
             )
+        if _rec:
+            _t0 = time.perf_counter()
         fetches, new_state = entry(mut_state, ro_state, feed_arrays)
+        if _rec:
+            # the first dispatch of a novel cache key is where XLA
+            # compiles (jax.jit is lazy) — label it as the compile phase;
+            # steady-state dispatches are device execution
+            _mon_spans.record_span(
+                "executor/jit_compile" if first_dispatch
+                else "executor/device_execute",
+                _t0, time.perf_counter() - _t0,
+                cat="compile" if first_dispatch else "execute",
+                steps=steps)
         for n, v in new_state.items():
             scope.set(n, v)
         if n_dense_fetch:
@@ -365,7 +450,13 @@ class Executor:
                     "nan/inf detected in %s (FLAGS_check_nan_inf=1)" % bad
                 )
         if return_numpy:
+            if _rec:
+                _t0 = time.perf_counter()
             fetches = [np.asarray(f) for f in fetches]
+            if _rec:
+                _mon_spans.record_span(
+                    "executor/d2h_fetch", _t0, time.perf_counter() - _t0,
+                    cat="transfer", n_fetch=len(fetches))
         return fetches
 
     # ------------------------------------------------------------------

@@ -18,8 +18,22 @@ import numpy as np
 
 from paddle_tpu import framework, io
 from paddle_tpu.core import lowering
+from paddle_tpu.monitor import registry as _mon_registry
 
 __all__ = ["AnalysisConfig", "PaddlePredictor", "AnalysisPredictor", "create_paddle_predictor"]
+
+# predictor-level observability (paddle_tpu/monitor): padding waste is
+# the serving bucket ladder's rent — rows computed but sliced away.  A
+# waste ratio creeping toward 0.5 means the ladder is too coarse for the
+# traffic's size mix.
+_MON_PRED_RUNS = _mon_registry.REGISTRY.counter(
+    "predictor_runs_total", "AnalysisPredictor.run calls")
+_MON_PRED_PADDED_ROWS = _mon_registry.REGISTRY.counter(
+    "predictor_padded_rows_total",
+    "total rows in padded batches (valid + padding)")
+_MON_PRED_WASTE_ROWS = _mon_registry.REGISTRY.counter(
+    "predictor_padding_waste_rows_total",
+    "padding rows computed then sliced away (padded - valid)")
 
 
 class AnalysisConfig:
@@ -91,6 +105,7 @@ class AnalysisPredictor(PaddlePredictor):
 
         if not isinstance(feed, dict):
             feed = dict(zip(self._feed_names, feed))
+        _MON_PRED_RUNS.inc()
         with fluid.scope_guard(self._scope):
             return self._exe.run(
                 self._program, feed=feed, fetch_list=self._fetch_names
@@ -125,6 +140,8 @@ class AnalysisPredictor(PaddlePredictor):
         if not 0 < n_valid <= padded:
             raise ValueError(
                 "n_valid=%r out of range for padded batch %d" % (n_valid, padded))
+        _MON_PRED_PADDED_ROWS.inc(padded)
+        _MON_PRED_WASTE_ROWS.inc(padded - n_valid)
         outs = self.run(feed)
         if n_valid == padded:
             return outs

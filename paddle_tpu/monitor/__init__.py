@@ -1,0 +1,146 @@
+"""paddle_tpu.monitor — framework-wide observability.
+
+The reference framework's profiler stack (profiler.py + RecordEvent +
+CUPTI DeviceTracer + timeline.py) is a first-class subsystem; this is
+its TPU-native counterpart, shared by train, serving, and distributed
+paths:
+
+* **Metrics registry** (``registry.py``) — process-global Counter /
+  Gauge / Histogram with labels; ``snapshot()`` for programs,
+  ``render_text()`` for Prometheus scrapers (the serving ``/metrics``
+  endpoint).  Every subsystem registers at import and increments on the
+  hot path (a lock + an add; always on).
+* **Run-phase spans** (``spans.py``) — Executor.run emits per-phase
+  spans (lower / jit_compile on first dispatch per cache key / h2d feed
+  transfer / device execute / d2h fetch), RecordEvent blocks mirror in,
+  serving batches ride the profiler JSONL stream.  Recording is
+  opt-in; when off, instrumentation is a single flag check.
+* **Chrome-trace export** (``chrome_trace.py``) — merges spans + the
+  JSONL event stream into one ``trace.json`` loadable in
+  chrome://tracing / Perfetto (the ``timeline.py`` analog; device-side
+  XLA timelines stay in jax.profiler/xprof).
+
+Quickstart::
+
+    from paddle_tpu import monitor, profiler
+
+    with monitor.trace_session(path="trace.json",
+                               jsonl_path="events.jsonl") as sess:
+        profiler.start_jsonl_trace("events.jsonl")
+        ...train / serve...
+        profiler.stop_jsonl_trace()
+    # trace.json now loads in Perfetto; sess.spans holds the raw spans
+
+    print(monitor.render_text())        # Prometheus exposition
+    monitor.snapshot()                  # nested dict of every metric
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional
+
+from paddle_tpu.monitor.registry import (
+    DEFAULT_BUCKETS,
+    CallbackCounter,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    REGISTRY,
+)
+from paddle_tpu.monitor.spans import (
+    record_instant,
+    record_span,
+    recording,
+    span,
+    start_recording,
+    stop_recording,
+)
+from paddle_tpu.monitor.chrome_trace import export_chrome_trace
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "CallbackCounter", "MetricsRegistry",
+    "REGISTRY", "DEFAULT_BUCKETS",
+    "counter", "gauge", "histogram", "counter_callback",
+    "snapshot", "render_text", "counter_value",
+    "span", "record_span", "record_instant", "recording",
+    "start_recording", "stop_recording",
+    "export_chrome_trace", "trace_session", "TraceSession",
+]
+
+
+# -- process-default registry conveniences ------------------------------
+def counter(name: str, help: str = "", labelnames=()) -> Counter:
+    return REGISTRY.counter(name, help, labelnames)
+
+
+def gauge(name: str, help: str = "", labelnames=()) -> Gauge:
+    return REGISTRY.gauge(name, help, labelnames)
+
+
+def histogram(name: str, help: str = "", labelnames=(),
+              buckets=DEFAULT_BUCKETS) -> Histogram:
+    return REGISTRY.histogram(name, help, labelnames, buckets)
+
+
+def counter_callback(name: str, help: str = "", fn=None) -> CallbackCounter:
+    return REGISTRY.counter_callback(name, help, fn)
+
+
+def snapshot() -> Dict[str, object]:
+    return REGISTRY.snapshot()
+
+
+def render_text() -> str:
+    return REGISTRY.render_text()
+
+
+def counter_value(name: str, default: float = 0.0, **labels) -> float:
+    """Sum of the named counter/gauge's series matching the given label
+    subset (bench/test convenience)."""
+    return REGISTRY.value(name, default, **labels)
+
+
+# -- trace sessions -----------------------------------------------------
+class TraceSession:
+    """Handle yielded by ``trace_session``; after the block exits,
+    ``spans`` holds the recorded spans and ``export`` re-renders them."""
+
+    def __init__(self, path: Optional[str], jsonl_path: Optional[str]):
+        self.path = path
+        self.jsonl_path = jsonl_path
+        self.spans: List[Dict[str, object]] = []
+
+    def export(self, path: Optional[str] = None,
+               jsonl_path: Optional[str] = None) -> str:
+        target = path or self.path
+        if target is None:
+            raise ValueError("no trace path given")
+        return export_chrome_trace(
+            target, spans=self.spans,
+            jsonl_path=jsonl_path or self.jsonl_path)
+
+
+@contextlib.contextmanager
+def trace_session(path: Optional[str] = None,
+                  jsonl_path: Optional[str] = None):
+    """Record spans for the duration of the block; when ``path`` is
+    given, write the merged Chrome trace (spans + ``jsonl_path``) on
+    exit — including exceptional exit, so a failed run still leaves its
+    trace behind."""
+    start_recording()
+    sess = TraceSession(path, jsonl_path)
+    try:
+        yield sess
+    except BaseException:
+        sess.spans = stop_recording()
+        if path is not None:
+            try:
+                sess.export()
+            except Exception:
+                pass  # never mask the body's exception with an export error
+        raise
+    else:
+        sess.spans = stop_recording()
+        if path is not None:
+            sess.export()

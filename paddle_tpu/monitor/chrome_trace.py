@@ -1,0 +1,139 @@
+"""Chrome trace-event exporter (chrome://tracing / Perfetto).
+
+The TPU-native analog of the reference's ``timeline.py`` (which merged
+host RecordEvent profiles with CUPTI device records into one trace
+file): this merges
+
+* recorded host spans (``monitor.spans`` — Executor run phases,
+  lowering, RecordEvent blocks, serving batches), and
+* the profiler's JSONL event stream (``profiler.emit_trace_event`` —
+  discrete events like ``serving.batch`` with a wall ``ts`` and
+  optionally a ``run_ms`` duration)
+
+into a single ``trace.json`` in the trace-event format.  Device-side
+XLA traces stay in jax.profiler/xprof (XPlane); this file is the
+host-side story, viewable alongside it.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["export_chrome_trace"]
+
+
+def _jsonl_events(path: str) -> List[Dict[str, object]]:
+    events = []
+    try:
+        f = open(path)
+    except OSError:
+        # the sink may never have been started (e.g. the traced body
+        # failed early) — an absent stream must not kill the export
+        return events
+    with f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                continue  # a torn tail line must not kill the export
+    return events
+
+
+def export_chrome_trace(
+    path: str,
+    spans: Optional[Sequence[Dict[str, object]]] = None,
+    jsonl_path: Optional[str] = None,
+    pid: Optional[int] = None,
+) -> str:
+    """Write ``path`` as a chrome://tracing-loadable JSON object.
+
+    ``spans``: output of ``spans.stop_recording()`` (or any list in that
+    shape).  ``jsonl_path``: an ``emit_trace_event`` JSONL file to merge.
+    Timestamps from both sources share the wall-clock timebase; the
+    earliest event is rebased to t=0 so the viewer opens centered.
+    """
+    spans = list(spans or [])
+    jsonl = _jsonl_events(jsonl_path) if jsonl_path else []
+    pid = os.getpid() if pid is None else pid
+
+    starts = [float(s["ts"]) for s in spans]
+    for ev in jsonl:
+        ts = float(ev.get("ts", 0.0))
+        starts.append(ts - float(ev.get("run_ms", 0.0)) / 1e3)
+    base = min(starts) if starts else 0.0
+
+    events: List[Dict[str, object]] = []
+    tids = set()
+    for s in spans:
+        tid = int(s.get("tid", 0))
+        tids.add(tid)
+        args = dict(s.get("args") or {})
+        if s.get("error"):
+            args["error"] = True
+        ev = {
+            "name": str(s["name"]),
+            "cat": str(s.get("cat", "host")),
+            "ph": "X",
+            "ts": (float(s["ts"]) - base) * 1e6,  # microseconds
+            "dur": float(s.get("dur", 0.0)) * 1e6,
+            "pid": pid,
+            "tid": tid,
+        }
+        if args.pop("instant", None):
+            ev["ph"] = "i"
+            ev["s"] = "t"
+            ev.pop("dur")
+        if args:
+            ev["args"] = args
+        events.append(ev)
+
+    _JSONL_TID = 0  # dedicated lane for the discrete event stream
+    for rec in jsonl:
+        rec = dict(rec)
+        name = str(rec.pop("event", "event"))
+        ts = float(rec.pop("ts", base))
+        run_ms = rec.pop("run_ms", None)
+        ev = {
+            "name": name,
+            "cat": "jsonl",
+            "pid": pid,
+            "tid": _JSONL_TID,
+        }
+        if run_ms is not None:
+            # ts was stamped at emit time (batch END) — rebase to start
+            ev["ph"] = "X"
+            ev["ts"] = (ts - float(run_ms) / 1e3 - base) * 1e6
+            ev["dur"] = float(run_ms) * 1e3
+        else:
+            ev["ph"] = "i"
+            ev["s"] = "t"
+            ev["ts"] = (ts - base) * 1e6
+        if rec:
+            ev["args"] = rec
+        events.append(ev)
+
+    meta: List[Dict[str, object]] = [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": "paddle_tpu host"},
+    }]
+    if jsonl:
+        meta.append({
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": _JSONL_TID,
+            "args": {"name": "jsonl events"},
+        })
+    main_tid = threading.get_ident()
+    for tid in sorted(tids):
+        meta.append({
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+            "args": {"name": "main" if tid == main_tid else "thread-%d" % tid},
+        })
+
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    with open(path, "w") as f:
+        json.dump({"traceEvents": meta + events, "displayTimeUnit": "ms"}, f)
+    return path

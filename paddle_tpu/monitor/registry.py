@@ -1,0 +1,403 @@
+"""Process-global metrics registry: Counter / Gauge / Histogram with labels.
+
+The framework-wide analog of the reference's per-subsystem stat counters
+(platform/profiler.cc event totals, operators/reader queue stats): every
+subsystem registers named metrics here once at import, increments them on
+the hot path (a lock + an add — safe to leave on unconditionally), and
+any consumer reads the whole process through one of two surfaces:
+
+* ``snapshot()`` — a plain nested dict for tests, bench drivers, and the
+  serving ``/statusz`` endpoint;
+* ``render_text()`` — Prometheus text exposition (version 0.0.4) for the
+  serving ``/metrics`` endpoint or any scraper.
+
+Metrics are registered idempotently: re-registering the same name with
+the same type/labels returns the existing metric (so module reloads and
+multiple importers compose); a mismatch raises.
+"""
+from __future__ import annotations
+
+import math
+import re
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "CallbackCounter", "MetricsRegistry",
+    "REGISTRY", "DEFAULT_BUCKETS",
+]
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+# seconds-scale latency ladder (Prometheus client default, extended down
+# to 100us for host-side dispatch costs)
+DEFAULT_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class _CounterChild:
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, n: float = 1) -> None:
+        if n < 0:
+            raise ValueError("counters only go up (inc %r)" % (n,))
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class _GaugeChild:
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1) -> None:
+        with self._lock:
+            self._value -= n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class _HistogramChild:
+    __slots__ = ("_lock", "_buckets", "_counts", "_sum", "_count")
+
+    def __init__(self, buckets: Tuple[float, ...]):
+        self._lock = threading.Lock()
+        self._buckets = buckets
+        self._counts = [0] * len(buckets)  # per-bucket (non-cumulative)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self._sum += v
+            self._count += 1
+            for i, le in enumerate(self._buckets):
+                if v <= le:
+                    self._counts[i] += 1
+                    break
+
+    @property
+    def value(self) -> Dict[str, object]:
+        """Snapshot dict: count, sum, and CUMULATIVE bucket counts keyed
+        by upper bound (the exposition convention)."""
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        cum, out = 0, {}
+        for le, c in zip(self._buckets, counts):
+            cum += c
+            out[_fmt(le)] = cum
+        out["+Inf"] = total
+        return {"count": total, "sum": s, "buckets": out}
+
+
+class _BaseMetric:
+    kind = "untyped"
+    _child_cls = _CounterChild
+
+    def __init__(self, name: str, help: str = "", labelnames: Sequence[str] = ()):
+        if not _NAME_RE.match(name):
+            raise ValueError("invalid metric name %r" % name)
+        for ln in labelnames:
+            if not _LABEL_RE.match(ln) or ln == "le":
+                raise ValueError("invalid label name %r" % ln)
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], object] = {}
+        self._default_child = None  # cached no-label child (hot path)
+
+    def _new_child(self):
+        return self._child_cls()
+
+    def labels(self, **labelvalues):
+        if set(labelvalues) != set(self.labelnames):
+            raise ValueError(
+                "metric %r takes labels %s, got %s"
+                % (self.name, self.labelnames, tuple(sorted(labelvalues))))
+        key = tuple(str(labelvalues[n]) for n in self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = self._new_child()
+        return child
+
+    def _default(self):
+        """The no-label child (for unlabeled metrics used directly) —
+        cached so hot-path ``metric.inc()`` skips the labels() lookup."""
+        child = self._default_child
+        if child is None:
+            child = self._default_child = self.labels()
+        return child
+
+    def series(self) -> List[Tuple[Dict[str, str], object]]:
+        with self._lock:
+            items = list(self._children.items())
+        return [
+            (dict(zip(self.labelnames, key)), child) for key, child in items
+        ]
+
+    def remove_labels(self, **labelvalues) -> None:
+        """Drop one labeled child from the exposition (a holder of the
+        child object can keep using it; it just stops being scraped).
+        Lets short-lived owners — e.g. a stopped serving instance —
+        retire their series instead of growing the registry forever."""
+        key = tuple(str(labelvalues[n]) for n in self.labelnames)
+        with self._lock:
+            self._children.pop(key, None)
+            if key == ():
+                self._default_child = None
+
+    def signature(self):
+        return (type(self), self.labelnames)
+
+
+class Counter(_BaseMetric):
+    kind = "counter"
+    _child_cls = _CounterChild
+
+    def inc(self, n: float = 1) -> None:
+        self._default().inc(n)
+
+    @property
+    def value(self) -> float:
+        return self._default().value
+
+
+class Gauge(_BaseMetric):
+    kind = "gauge"
+    _child_cls = _GaugeChild
+
+    def set(self, v: float) -> None:
+        self._default().set(v)
+
+    def inc(self, n: float = 1) -> None:
+        self._default().inc(n)
+
+    def dec(self, n: float = 1) -> None:
+        self._default().dec(n)
+
+    @property
+    def value(self) -> float:
+        return self._default().value
+
+
+class Histogram(_BaseMetric):
+    kind = "histogram"
+
+    def __init__(self, name, help="", labelnames=(), buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help, labelnames)
+        b = tuple(sorted(float(x) for x in buckets))
+        if not b:
+            raise ValueError("histogram needs at least one bucket")
+        if math.isinf(b[-1]):
+            b = b[:-1]  # +Inf is implicit
+        self.buckets = b
+
+    def _new_child(self):
+        return _HistogramChild(self.buckets)
+
+    def observe(self, v: float) -> None:
+        self._default().observe(v)
+
+    def signature(self):
+        return (type(self), self.labelnames, self.buckets)
+
+
+class _CallbackChild:
+    """Read-only child whose value is computed at scrape time."""
+
+    __slots__ = ("_fn",)
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    @property
+    def value(self) -> float:
+        return float(self._fn())
+
+
+class CallbackCounter(_BaseMetric):
+    """Collect-on-read counter: the value is pulled from ``fn()`` when a
+    consumer snapshots/renders, so the producer's hot path pays NOTHING
+    beyond whatever bookkeeping it already does (the executor's
+    ``_cache_stats`` dicts are the canonical example).  ``fn`` must be
+    monotonically non-decreasing to honor counter semantics."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = "", fn=None):
+        super().__init__(name, help, ())
+        if fn is None:
+            raise ValueError("CallbackCounter %r needs a fn" % name)
+        self._fn = fn
+
+    def series(self):
+        return [({}, _CallbackChild(self._fn))]
+
+    @property
+    def value(self) -> float:
+        return float(self._fn())
+
+
+def _fmt(v: float) -> str:
+    return "%.10g" % v
+
+
+def _escape(s: str) -> str:
+    return s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _label_str(labels: Dict[str, str], extra: Optional[Tuple[str, str]] = None) -> str:
+    items = sorted(labels.items())
+    if extra is not None:
+        items.append(extra)
+    if not items:
+        return ""
+    return "{%s}" % ",".join('%s="%s"' % (k, _escape(str(v))) for k, v in items)
+
+
+class MetricsRegistry:
+    """A named collection of metrics (the process default is ``REGISTRY``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _BaseMetric] = {}
+
+    # -- registration (idempotent) -------------------------------------
+    def _register(self, cls, name, help, labelnames, **kw):
+        probe = cls(name, help, labelnames, **kw)
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if existing.signature() != probe.signature():
+                    raise ValueError(
+                        "metric %r already registered as %s%s"
+                        % (name, existing.kind, existing.labelnames))
+                return existing
+            self._metrics[name] = probe
+            return probe
+
+    def counter(self, name: str, help: str = "", labelnames: Sequence[str] = ()) -> Counter:
+        return self._register(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "", labelnames: Sequence[str] = ()) -> Gauge:
+        return self._register(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "", labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._register(Histogram, name, help, labelnames, buckets=buckets)
+
+    def counter_callback(self, name: str, help: str = "", fn=None) -> CallbackCounter:
+        """Register a collect-on-read counter (see CallbackCounter).
+        Re-registering rebinds ``fn`` (module-reload friendly)."""
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if type(existing) is not CallbackCounter:
+                    raise ValueError(
+                        "metric %r already registered as %s"
+                        % (name, existing.kind))
+                existing._fn = fn
+                return existing
+            m = CallbackCounter(name, help, fn=fn)
+            self._metrics[name] = m
+            return m
+
+    def get(self, name: str) -> Optional[_BaseMetric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._metrics.pop(name, None)
+
+    def reset(self) -> None:
+        """Drop every metric (tests on PRIVATE registries only: metrics
+        already handed out as module-level objects keep counting into
+        their detached children, so resetting the process default
+        silently forks the bookkeeping)."""
+        with self._lock:
+            self._metrics.clear()
+
+    # -- read surfaces --------------------------------------------------
+    def snapshot(self) -> Dict[str, object]:
+        """{name: {type, help, series: [{labels, value}, ...]}} — values
+        are scalars (counter/gauge) or {count, sum, buckets} dicts."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out: Dict[str, object] = {}
+        for m in sorted(metrics, key=lambda m: m.name):
+            out[m.name] = {
+                "type": m.kind,
+                "help": m.help,
+                "series": [
+                    {"labels": labels, "value": child.value}
+                    for labels, child in m.series()
+                ],
+            }
+        return out
+
+    def value(self, name: str, default: float = 0.0, **labels) -> float:
+        """Sum of a counter/gauge's series whose labels contain ``labels``
+        as a subset (convenience for tests / bench assertions)."""
+        m = self.get(name)
+        if m is None:
+            return default
+        if isinstance(m, Histogram):
+            raise TypeError("value() reads counters/gauges, %r is a histogram" % name)
+        total, seen = 0.0, False
+        for lbls, child in m.series():
+            if all(lbls.get(k) == str(v) for k, v in labels.items()):
+                total += child.value
+                seen = True
+        return total if seen else default
+
+    def render_text(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: List[str] = []
+        for m in sorted(metrics, key=lambda m: m.name):
+            if m.help:
+                lines.append("# HELP %s %s" % (m.name, m.help.replace("\n", " ")))
+            lines.append("# TYPE %s %s" % (m.name, m.kind))
+            for labels, child in sorted(m.series(), key=lambda s: sorted(s[0].items())):
+                if isinstance(child, _HistogramChild):
+                    v = child.value
+                    for le, c in v["buckets"].items():
+                        lines.append("%s_bucket%s %d" % (
+                            m.name, _label_str(labels, ("le", le)), c))
+                    lines.append("%s_sum%s %s" % (m.name, _label_str(labels), _fmt(v["sum"])))
+                    lines.append("%s_count%s %d" % (m.name, _label_str(labels), v["count"]))
+                else:
+                    lines.append("%s%s %s" % (m.name, _label_str(labels), _fmt(child.value)))
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = MetricsRegistry()

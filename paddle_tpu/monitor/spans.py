@@ -1,0 +1,119 @@
+"""Host-side span recording for run-phase tracing.
+
+Instrumentation sites (Executor.run phases, lowering, RecordEvent) call
+``record_span`` unconditionally; it is a no-op unless a recording session
+is active, and hot paths that want to skip even the timestamp read gate
+on the module flag directly::
+
+    rec = spans.recording()
+    if rec:
+        t0 = time.perf_counter()
+    ...work...
+    if rec:
+        spans.record_span("executor/h2d_feed", t0,
+                          time.perf_counter() - t0, cat="transfer")
+
+Spans carry a wall-clock start (mapped from perf_counter through the
+session epoch, so they merge cleanly with the profiler's JSONL events,
+which stamp ``time.time()``), a duration in seconds, the recording
+thread id, a category, an optional ``error`` flag, and free-form args.
+``chrome_trace.export_chrome_trace`` turns them into trace-event JSON.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, List
+
+__all__ = [
+    "recording", "start_recording", "stop_recording", "record_span",
+    "record_instant", "span",
+]
+
+_enabled = False
+_lock = threading.Lock()
+_buffer: List[Dict[str, object]] = []
+_epoch_pc = 0.0    # perf_counter at session start
+_epoch_wall = 0.0  # time.time at session start
+
+
+def recording() -> bool:
+    """True while a span-recording session is active."""
+    return _enabled
+
+
+def start_recording() -> None:
+    """Begin a session: clears the buffer, re-anchors the epoch.
+
+    Sessions are process-global and do NOT nest: starting a new one
+    supersedes (and discards the buffered spans of) any active session,
+    and the superseded ``trace_session`` will export empty.  One trace
+    session at a time is the contract."""
+    global _enabled, _epoch_pc, _epoch_wall
+    with _lock:
+        del _buffer[:]
+        _epoch_pc = time.perf_counter()
+        _epoch_wall = time.time()
+        _enabled = True
+
+
+def stop_recording() -> List[Dict[str, object]]:
+    """End the session; returns (and drains) the recorded spans."""
+    global _enabled
+    with _lock:
+        _enabled = False
+        out = list(_buffer)
+        del _buffer[:]
+    return out
+
+
+def record_span(name: str, t0: float, dur: float, cat: str = "host",
+                error: bool = False, **args) -> None:
+    """Record one completed span.  ``t0`` is the perf_counter value at
+    span start, ``dur`` the duration in seconds.  No-op when no session
+    is active."""
+    if not _enabled:
+        return
+    rec: Dict[str, object] = {
+        "name": name,
+        "cat": cat,
+        "dur": float(dur),
+        "tid": threading.get_ident(),
+    }
+    if error:
+        rec["error"] = True
+    if args:
+        rec["args"] = args
+    with _lock:
+        if _enabled:
+            # epoch read under the lock: a concurrent start_recording
+            # re-anchors both epochs atomically, so the ts can never mix
+            # an old perf_counter anchor with a new wall anchor
+            rec["ts"] = _epoch_wall + (t0 - _epoch_pc)  # wall-clock seconds
+            _buffer.append(rec)
+
+
+def record_instant(name: str, cat: str = "host", **args) -> None:
+    """Record a zero-duration marker event."""
+    if not _enabled:
+        return
+    record_span(name, time.perf_counter(), 0.0, cat=cat, instant=True, **args)
+
+
+@contextlib.contextmanager
+def span(name: str, cat: str = "host", **args):
+    """Context-manager form; spans that exit via exception are flagged
+    ``error=True``.  Near-zero-cost when no session is active."""
+    if not _enabled:
+        yield
+        return
+    t0 = time.perf_counter()
+    err = False
+    try:
+        yield
+    except BaseException:
+        err = True
+        raise
+    finally:
+        record_span(name, t0, time.perf_counter() - t0, cat=cat, error=err, **args)

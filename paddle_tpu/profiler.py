@@ -8,9 +8,12 @@ into xprof), host-side per-run timing is recorded by this module.
 from __future__ import annotations
 
 import contextlib
+import functools
 import time
 from collections import defaultdict
 from typing import Dict, List, Optional
+
+from paddle_tpu.monitor import spans as _mon_spans
 
 __all__ = [
     "profiler", "start_profiler", "stop_profiler", "reset_profiler",
@@ -20,30 +23,65 @@ __all__ = [
 
 _host_events: Dict[str, List[float]] = defaultdict(list)
 _active_trace_dir: Optional[str] = None
+_ERROR_SUFFIX = " (error)"  # table key for spans that exited via exception
 
 
 class RecordEvent:
-    """Host-side RAII timing marker (reference: profiler.h:81)."""
+    """Host-side RAII timing marker (reference: profiler.h:81).
+
+    Context manager OR decorator::
+
+        with RecordEvent("step"): ...
+
+        @RecordEvent("step")
+        def step(...): ...
+
+    Spans that exit via exception aggregate under ``"<name> (error)"``
+    in the stop_profiler() table and carry ``error=True`` in any active
+    monitor trace session, so failed runs are distinguishable.
+    """
 
     def __init__(self, name: str):
         self.name = name
+
+    def __call__(self, fn):
+        # a FRESH instance per invocation: the decorated function may be
+        # reentrant or called from several threads, and _t0 lives on self
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with RecordEvent(self.name):
+                return fn(*args, **kwargs)
+
+        return wrapper
 
     def __enter__(self):
         self._t0 = time.perf_counter()
         return self
 
-    def __exit__(self, *exc):
-        _host_events[self.name].append(time.perf_counter() - self._t0)
+    def __exit__(self, exc_type, exc, tb):
+        dur = time.perf_counter() - self._t0
+        error = exc_type is not None
+        _host_events[self.name + _ERROR_SUFFIX if error else self.name].append(dur)
+        _mon_spans.record_span(
+            self.name, self._t0, dur, cat="record_event", error=error)
         return False
 
 
 def start_profiler(state: str = "All", trace_dir: Optional[str] = None):
-    """reference: profiler.py start_profiler / EnableProfiler."""
+    """reference: profiler.py start_profiler / EnableProfiler.
+
+    Idempotent: a second start (or a start after a crashed run) first
+    stops any device trace this module previously started (via
+    reset_profiler), so jax.profiler never sees a double start.
+    """
     global _active_trace_dir
     reset_profiler()
     if trace_dir:
         import jax
 
+        # exception-safe: _active_trace_dir is only set AFTER the trace
+        # actually started, so a failed start leaves no dangling state
+        # for stop_profiler()/reset_profiler() to trip over
         jax.profiler.start_trace(trace_dir)
         _active_trace_dir = trace_dir
 
@@ -52,10 +90,13 @@ def stop_profiler(sorted_key: str = "total", profile_path: Optional[str] = None)
     """reference: profiler.py stop_profiler — prints the per-event table."""
     global _active_trace_dir
     if _active_trace_dir is not None:
+        _active_trace_dir = None
         import jax
 
-        jax.profiler.stop_trace()
-        _active_trace_dir = None
+        try:
+            jax.profiler.stop_trace()
+        except RuntimeError:
+            pass  # trace already gone (e.g. a reset raced us) — still print
     rows = []
     for name, ts in _host_events.items():
         rows.append((name, len(ts), sum(ts), max(ts), sum(ts) / len(ts)))
@@ -74,7 +115,25 @@ def stop_profiler(sorted_key: str = "total", profile_path: Optional[str] = None)
 
 
 def reset_profiler():
+    """Clear host events AND stop any device trace this module started.
+
+    The pre-fix behavior left ``_active_trace_dir`` dangling: a
+    ``start_profiler(trace_dir=...)`` + ``reset_profiler()`` +
+    ``stop_profiler()`` sequence (or two back-to-back starts) called
+    ``jax.profiler.stop_trace()``/``start_trace()`` against a trace the
+    reset never cleared.  Reset now owns the whole teardown, so start
+    and reset are idempotent and exception-safe.
+    """
+    global _active_trace_dir
     _host_events.clear()
+    if _active_trace_dir is not None:
+        _active_trace_dir = None
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:
+            pass  # a reset must never raise over a half-dead trace
 
 
 @contextlib.contextmanager

@@ -17,12 +17,32 @@ import itertools
 import queue
 import random
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from paddle_tpu import framework
 from paddle_tpu.core import types as core_types
+from paddle_tpu.monitor import registry as _mon_registry
+
+# pipeline health counters (paddle_tpu/monitor): a consumer stall means
+# the training loop outran the input pipeline (the batch was NOT ready
+# in HBM when asked for — the double-buffer failed its job); a producer
+# stall is backpressure (the pipeline outran the consumer, which is the
+# healthy direction).  Watch the stall seconds ratio on /statusz.
+_MON_CONSUMER_STALLS = _mon_registry.REGISTRY.counter(
+    "reader_consumer_stalls_total",
+    "consumer blocked on an empty prefetch queue (pipeline starved)")
+_MON_CONSUMER_STALL_S = _mon_registry.REGISTRY.counter(
+    "reader_consumer_stall_seconds_total",
+    "seconds the consumer spent waiting on an empty prefetch queue")
+_MON_PRODUCER_STALLS = _mon_registry.REGISTRY.counter(
+    "reader_producer_stalls_total",
+    "producer blocked on a full prefetch queue (backpressure)")
+_MON_PRODUCER_STALL_S = _mon_registry.REGISTRY.counter(
+    "reader_producer_stall_seconds_total",
+    "seconds the producer spent waiting on a full prefetch queue")
 
 __all__ = [
     "PyReader",
@@ -83,17 +103,32 @@ def buffered(reader, size: int):
     def reader_():
         q: queue.Queue = queue.Queue(maxsize=size)
 
+        def put(item):
+            try:
+                q.put_nowait(item)
+            except queue.Full:
+                _MON_PRODUCER_STALLS.inc()
+                t0 = time.perf_counter()
+                q.put(item)
+                _MON_PRODUCER_STALL_S.inc(time.perf_counter() - t0)
+
         def fill():
             try:
                 for item in reader():
-                    q.put(item)
+                    put(item)
             finally:
-                q.put(_End)
+                put(_End)
 
         t = threading.Thread(target=fill, daemon=True)
         t.start()
         while True:
-            item = q.get()
+            try:
+                item = q.get_nowait()
+            except queue.Empty:
+                _MON_CONSUMER_STALLS.inc()
+                t0 = time.perf_counter()
+                item = q.get()
+                _MON_CONSUMER_STALL_S.inc(time.perf_counter() - t0)
             if item is _End:
                 break
             yield item

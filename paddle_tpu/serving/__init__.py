@@ -9,7 +9,10 @@ AnalysisPredictor (inference.py):
   deadlines, overload shedding, graceful drain;
 * ``Client`` — blocking in-process client helper;
 * ``BucketPolicy`` / ``DynamicBatcher`` / ``ServingMetrics`` — the
-  composable pieces;
+  composable pieces (metrics delegate to the process-global
+  ``paddle_tpu.monitor`` registry, labeled ``server=<name>``);
+* ``server.start_admin()`` — localhost HTTP ``/metrics`` (Prometheus
+  text exposition) + ``/statusz`` (JSON snapshot) surface;
 * typed errors: ``ServerOverloaded``, ``DeadlineExceeded``,
   ``ServerClosed``.
 

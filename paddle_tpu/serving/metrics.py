@@ -1,18 +1,26 @@
-"""Serving metrics registry.
+"""Serving metrics — a view over the framework-wide registry.
 
-Thread-safe counters + a bounded latency reservoir + a per-bucket
-occupancy histogram, exposed two ways:
+Since the monitor refactor, counters and the latency histogram live in
+``paddle_tpu.monitor.REGISTRY`` (labeled ``server=<name>,
+instance=<k>``), so serving shows up in the same ``/metrics`` text
+exposition and ``monitor.snapshot()`` as the executor and reader
+metrics.  This class keeps the per-SERVER-INSTANCE bookkeeping exact:
 
 * ``snapshot()`` — a plain dict (QPS, p50/p99 latency, mean batch
   occupancy, shed/expired counts, recompile counter) for tests, bench
-  drivers, and admin endpoints;
+  drivers, and the ``/statusz`` endpoint, reading THIS instance's
+  registry children (two servers with the same name get distinct
+  ``instance`` labels, so counts never bleed across constructions);
+* a bounded latency reservoir for exact p50/p99 (the registry histogram
+  carries the bucketed exposition view of the same observations);
 * per-batch events routed through ``paddle_tpu.profiler`` — each
-  executed batch is timed under a ``RecordEvent`` (so it shows in the
-  stop_profiler() host table) and emitted to the active JSONL trace
-  sink via ``profiler.emit_trace_event`` for offline tail analysis.
+  executed batch is timed under a ``RecordEvent`` (visible in the
+  stop_profiler() table and any active monitor trace session) and
+  emitted to the active JSONL trace sink for offline tail analysis.
 """
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import deque
@@ -20,49 +28,86 @@ from typing import Dict
 
 import numpy as np
 
-from paddle_tpu import profiler
+from paddle_tpu import monitor, profiler
 
 __all__ = ["ServingMetrics"]
 
 _RESERVOIR = 8192  # latencies kept for the percentile estimate
 
+_COUNTER_HELP = {
+    "requests": "admitted into the queue",
+    "completed": "results delivered",
+    "failed": "completed with a non-deadline error",
+    "shed": "rejected at admission (queue full)",
+    "expired": "deadline passed before a result",
+    "batches": "predictor executions",
+    "warmup_compiles": "XLA compiles performed by warmup()",
+    "recompiles": "jit-cache misses AFTER warmup",
+}
+_LABELS = ("server", "instance")
+_COUNTERS = {
+    key: monitor.counter("serving_%s_total" % key, help, _LABELS)
+    for key, help in _COUNTER_HELP.items()
+}
+_LATENCY = monitor.histogram(
+    "serving_request_latency_seconds",
+    "submit-to-complete request latency", _LABELS)
+_BATCH_ROWS = monitor.counter(
+    "serving_batch_rows_total",
+    "rows in executed padded batches (bucket size x batches)", _LABELS)
+_BATCH_VALID_ROWS = monitor.counter(
+    "serving_batch_valid_rows_total",
+    "valid (non-padding) rows in executed batches", _LABELS)
+
+# distinguishes same-named servers constructed in one process
+_instance_seq = itertools.count()
+
 
 class ServingMetrics:
     def __init__(self, name: str = "server"):
         self.name = name
+        self.instance = str(next(_instance_seq))
+        lbl = {"server": name, "instance": self.instance}
+        self._c = {key: m.labels(**lbl) for key, m in _COUNTERS.items()}
+        self._latency = _LATENCY.labels(**lbl)
+        self._batch_rows = _BATCH_ROWS.labels(**lbl)
+        self._batch_valid = _BATCH_VALID_ROWS.labels(**lbl)
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
-        self._counters = {
-            "requests": 0,       # admitted into the queue
-            "completed": 0,      # results delivered
-            "failed": 0,         # completed with a non-deadline error
-            "shed": 0,           # rejected at admission (queue full)
-            "expired": 0,        # deadline passed before a result
-            "batches": 0,        # predictor executions
-            "warmup_compiles": 0,
-            "recompiles": 0,     # jit-cache misses AFTER warmup
-        }
         self._latencies: deque = deque(maxlen=_RESERVOIR)  # seconds, per request
         # bucket -> [n_batches, total_valid_rows]
         self._occupancy: Dict[int, list] = {}
 
     # ------------------------------------------------------------------
+    def close(self) -> None:
+        """Retire this instance's series from the registry exposition
+        (snapshot() keeps working off the detached children).  Called by
+        InferenceServer.stop() so a process that constructs servers
+        repeatedly doesn't grow /metrics without bound."""
+        lbl = {"server": self.name, "instance": self.instance}
+        for metric in list(_COUNTERS.values()) + [
+                _LATENCY, _BATCH_ROWS, _BATCH_VALID_ROWS]:
+            metric.remove_labels(**lbl)
+
+    # ------------------------------------------------------------------
     def count(self, key: str, n: int = 1) -> None:
-        with self._lock:
-            self._counters[key] += n
+        self._c[key].inc(n)
 
     def observe_request(self, latency_s: float) -> None:
+        self._c["completed"].inc()
+        self._latency.observe(latency_s)
         with self._lock:
-            self._counters["completed"] += 1
             self._latencies.append(latency_s)
 
     def observe_batch(self, valid: int, bucket: int, run_s: float,
                       recompiled: bool = False) -> None:
         """Record one executed batch and emit its trace event."""
+        self._c["batches"].inc()
+        if recompiled:
+            self._c["recompiles"].inc()
+        self._batch_rows.inc(bucket)
+        self._batch_valid.inc(valid)
         with self._lock:
-            self._counters["batches"] += 1
-            if recompiled:
-                self._counters["recompiles"] += 1
             ent = self._occupancy.setdefault(bucket, [0, 0])
             ent[0] += 1
             ent[1] += valid
@@ -78,8 +123,8 @@ class ServingMetrics:
     # ------------------------------------------------------------------
     def snapshot(self) -> Dict[str, object]:
         """Point-in-time metrics dict (the admin/bench surface)."""
+        counters = {key: int(c.value) for key, c in self._c.items()}
         with self._lock:
-            counters = dict(self._counters)
             lats = np.asarray(self._latencies, dtype=np.float64)
             occupancy = {b: tuple(v) for b, v in self._occupancy.items()}
             elapsed = time.perf_counter() - self._t0

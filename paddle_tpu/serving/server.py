@@ -12,16 +12,24 @@ inferred from timing).
 
 Lifecycle: construct (worker starts) -> warmup() -> submit()/Client
 traffic -> stop(drain=True) for a graceful drain.
+
+Observability: metrics live in the process-global registry
+(``paddle_tpu.monitor``); ``start_admin()`` binds a localhost HTTP
+surface exposing ``/metrics`` (Prometheus text exposition of the whole
+registry) and ``/statusz`` (JSON snapshot: this server's metrics incl.
+bucket-ladder occupancy and recompile counts, the predictor's jit-cache
+stats, and the full registry).
 """
 from __future__ import annotations
 
+import json
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from paddle_tpu import profiler
+from paddle_tpu import monitor, profiler
 from paddle_tpu.serving.batching import DynamicBatcher, ServingRequest
 from paddle_tpu.serving.bucketing import BucketPolicy
 from paddle_tpu.serving.errors import DeadlineExceeded, ServerClosed
@@ -60,6 +68,8 @@ class InferenceServer:
         self._feed_names = list(predictor.get_input_names())
         self._stop = threading.Event()
         self._closed = False           # admission gate (set before _stop on shutdown)
+        self._admin = None             # optional HTTP surface (start_admin)
+        self._admin_lock = threading.Lock()
         self._warmed = False
         self._baseline_misses: Optional[int] = None
         self._exec_lock = threading.Lock()  # warmup vs worker predictor use
@@ -82,6 +92,68 @@ class InferenceServer:
         snap["bucket_ladder"] = self.bucket_ladder
         snap["warmed_up"] = self._warmed
         return snap
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the WHOLE process registry
+        (this server's series are labeled ``server=<name>``)."""
+        return monitor.render_text()
+
+    def statusz(self) -> Dict[str, object]:
+        """JSON-serializable status snapshot: this server's metrics
+        (incl. bucket-ladder occupancy histogram and recompile counter),
+        the predictor's jit-cache stats, and the process registry."""
+        return {
+            "server": self.name,
+            "metrics": self.metrics(),
+            "jit_cache": self._predictor.jit_cache_stats(),
+            "registry": monitor.snapshot(),
+        }
+
+    # ------------------------------------------------------------------
+    def start_admin(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
+        """Serve ``/metrics`` (text exposition) and ``/statusz`` (JSON)
+        over HTTP on ``host:port`` (port 0 = ephemeral); returns the
+        bound ``(host, port)``.  Stopped by ``stop()``."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        server = self
+
+        class _AdminHandler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = server.metrics_text().encode("utf-8")
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path == "/statusz":
+                    body = json.dumps(
+                        server.statusz(), sort_keys=True, default=str
+                    ).encode("utf-8")
+                    ctype = "application/json"
+                else:
+                    self.send_error(404, "unknown path (try /metrics or /statusz)")
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # keep scrapes out of stderr
+                pass
+
+        with self._admin_lock:
+            if self._admin is not None:  # concurrent/repeat start: reuse
+                return self._admin.server_address
+            self._admin = ThreadingHTTPServer((host, port), _AdminHandler)
+            self._admin_thread = threading.Thread(
+                target=self._admin.serve_forever,
+                name="serving-admin-%s" % self.name, daemon=True)
+            self._admin_thread.start()
+            return self._admin.server_address
+
+    @property
+    def admin_address(self) -> Optional[Tuple[str, int]]:
+        return self._admin.server_address if self._admin is not None else None
 
     # ------------------------------------------------------------------
     def warmup(self, cache_dir: Optional[str] = None,
@@ -255,6 +327,11 @@ class InferenceServer:
         every queued request, then join the worker.  ``drain=False``:
         queued-but-unstarted requests fail with ServerClosed."""
         self._closed = True
+        with self._admin_lock:
+            admin, self._admin = self._admin, None
+        if admin is not None:
+            admin.shutdown()
+            admin.server_close()
         if not drain:
             # empty the queue before releasing the worker so it cannot
             # start work we are abandoning
@@ -266,6 +343,9 @@ class InferenceServer:
         # anything else left) rather than leaving its future pending
         if not self._worker.is_alive():
             self._fail_stragglers()
+        # retire this instance's series from the registry exposition;
+        # metrics()/statusz() keep working off the detached children
+        self._metrics.close()
 
     def __enter__(self):
         return self
