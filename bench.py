@@ -227,6 +227,33 @@ def _budget(name):
                               _active_budgets[name]))
 
 
+# --metrics-out PATH (or $BENCH_METRICS_OUT): each subprocess stage dumps
+# its own registry snapshot to PATH.<stage>.json, and the orchestrator
+# folds them into ONE merged {"stages": {...}} document at PATH after
+# every stage (so a killed driver still leaves the stages finished so
+# far).  Resolved lazily — bench_common imports no jax.
+_metrics_base = None
+
+
+def _stage_metrics_path(model):
+    return "%s.%s.json" % (_metrics_base, model)
+
+
+def _merge_stage_metrics():
+    merged = {}
+    for name in _BUDGETS:
+        p = _stage_metrics_path(name)
+        if os.path.exists(p):
+            try:
+                with open(p) as f:
+                    merged[name] = json.load(f)
+            except ValueError:
+                continue  # stage died mid-write; skip its partial dump
+    with open(_metrics_base, "w") as f:
+        json.dump({"stages": merged}, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
 def _run_sub(model, extra_env=None):
     """Run one sub-bench in a subprocess with a hard wall-clock budget and
     return its parsed JSON line, or an {"error"/"timeout": ...} block.  The
@@ -234,6 +261,10 @@ def _run_sub(model, extra_env=None):
     stage — never the final print.
     """
     env = dict(os.environ, BENCH_MODEL=model)
+    if _metrics_base and model != "probe":  # probe never touches the registry
+        env["BENCH_METRICS_OUT"] = _stage_metrics_path(model)
+    else:
+        env.pop("BENCH_METRICS_OUT", None)
     env.update(extra_env or {})
     budget = _budget(model)
     t0 = time.perf_counter()
@@ -260,8 +291,14 @@ def _run_sub(model, extra_env=None):
 def _emit(line):
     """Flush the current best line immediately — each emission is a superset
     of the previous, so whatever line is last on stdout when the driver's
-    clock runs out is complete up to that stage."""
+    clock runs out is complete up to that stage.  When --metrics-out is
+    set, the merged per-stage registry snapshot is refreshed alongside."""
     print(json.dumps(line), flush=True)
+    if _metrics_base:
+        try:
+            _merge_stage_metrics()
+        except OSError:
+            pass  # a metrics write must never take the bench line down
 
 
 def _orchestrate():
@@ -269,6 +306,18 @@ def _orchestrate():
     emission.  BERT is the headline; resnet50/nmt/deepfm ride as blocks
     (all five BASELINE.json configs; LeNet is the tests' parity config).
     """
+    global _metrics_base
+    import bench_common  # jax-free
+
+    _metrics_base = bench_common.metrics_out_path()
+    if _metrics_base:
+        # drop leftovers from a previous orchestrator run, or the merge
+        # would present last run's stage snapshots as this run's data
+        for name in _BUDGETS:
+            try:
+                os.remove(_stage_metrics_path(name))
+            except OSError:
+                pass
     # Bounded liveness probe first: if the backend (axon tunnel) is wedged,
     # emit a parseable failure line within ~90s — the driver is then
     # guaranteed evidence no matter what happens to the later stages, and
@@ -390,7 +439,14 @@ def main():
     else:
         _orchestrate()
         return
-    print(json.dumps(line), flush=True)
+    if model == "probe":
+        print(json.dumps(line), flush=True)  # stay jax-registry-free
+        return
+    import bench_common
+
+    # one JSON line; dumps the registry snapshot too when --metrics-out /
+    # $BENCH_METRICS_OUT is set (the orchestrator sets a per-stage path)
+    bench_common.emit_result(line)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,136 @@
+"""Micro-benchmark: steady-state dispatch cost of ``Executor.run``.
+
+The paper's claim is that one jitted XLA module subsumes Fluid's
+per-op dispatch; this bench pins what the HOST still pays per cached
+``run()`` call for a ~100-op block — the run-plan + jit cache hit path
+(plan lookup -> feed coercion -> jitted call).  Two numbers:
+
+* ``cached_overhead_us`` — median host-side overhead per run with the
+  run-plan cache hot (the steady-state number; regressions here are
+  regressions in every training step and serving request);
+* ``uncached_overhead_us`` — the same runs with the plan cache cleared
+  each call, i.e. the pre-PR-3 per-run O(n_ops) block re-analysis, with
+  the jit cache still hot (so the delta isolates the analysis cost).
+
+``speedup`` = uncached/cached (the PR-3 acceptance bar is >= 3x, pinned
+in tests/test_dispatch_fastpath.py).  Host overhead is read from the
+executor's ``dispatch_overhead_s`` accounting, not inferred from wall
+time, so device execution doesn't pollute the number.
+
+Env knobs: BENCH_DISPATCH_LAYERS (default 20 -> ~190 ops with backward
++ sgd), BENCH_DISPATCH_DIM (default 32), BENCH_DISPATCH_ITERS (default
+200), BENCH_DISPATCH_BATCH (default 8).
+"""
+import os
+import time
+
+import numpy as np
+
+LAYERS = int(os.environ.get("BENCH_DISPATCH_LAYERS", "20"))
+DIM = int(os.environ.get("BENCH_DISPATCH_DIM", "32"))
+ITERS = int(os.environ.get("BENCH_DISPATCH_ITERS", "200"))
+BATCH = int(os.environ.get("BENCH_DISPATCH_BATCH", "8"))
+
+
+def build_program(layers=LAYERS, dim=DIM):
+    import paddle_tpu as fluid
+    from paddle_tpu import framework
+
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 7
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [dim])
+        h = x
+        for _ in range(layers):
+            h = fluid.layers.fc(h, dim, act="relu")
+        loss = fluid.layers.mean(h)
+        fluid.optimizer.SGDOptimizer(0.01).minimize(loss)
+    return prog, startup, loss
+
+
+def median_overhead_s(exe, one_run, iters):
+    """Median per-run host dispatch overhead (seconds) over ``iters``
+    runs, read from the executor's own ``dispatch_overhead_s``
+    accounting (also used by tests/test_dispatch_fastpath.py — one
+    measurement definition for the bench and the acceptance bar)."""
+    stats = exe._cache_stats
+    samples = []
+    for _ in range(iters):
+        o0 = stats["dispatch_overhead_s"]
+        one_run()
+        samples.append(stats["dispatch_overhead_s"] - o0)
+    samples.sort()
+    return samples[len(samples) // 2]
+
+
+def run(layers=LAYERS, dim=DIM, iters=ITERS, batch=BATCH):
+    import jax
+
+    import paddle_tpu as fluid
+
+    platform = jax.devices()[0].platform
+    place = fluid.TPUPlace(0) if platform == "tpu" else fluid.CPUPlace()
+    prog, startup, loss = build_program(layers, dim)
+    n_ops = sum(len(b.ops) for b in prog.blocks)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(place)
+    dev = jax.devices()[0]
+    rng = np.random.RandomState(0)
+    # device-resident feed (the prefetch regime): h2d is a passthrough,
+    # so the measured overhead is pure dispatch rent
+    feed = {"x": jax.device_put(rng.rand(batch, dim).astype(np.float32), dev)}
+
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+
+        def one_run():
+            exe.run(prog, feed=feed, fetch_list=[loss], return_numpy=False)
+
+        for _ in range(3):  # warmup: compile + settle state avals
+            one_run()
+
+        h0 = exe._cache_stats["plan_hits"]
+        cached_us = median_overhead_s(exe, one_run, iters) * 1e6
+        plan_hits = exe._cache_stats["plan_hits"] - h0
+        m0 = exe.jit_cache_stats()["misses"]
+
+        # the pre-plan-cache regime: force the O(n_ops) re-analysis per
+        # run while keeping the jit cache hot (plan rebuilds land on the
+        # same jit key, so no recompiles pollute the comparison)
+        def uncached_run():
+            exe._plans.clear()
+            one_run()
+
+        uncached_us = median_overhead_s(exe, uncached_run, iters) * 1e6
+        recompiles = exe.jit_cache_stats()["misses"] - m0
+
+    from paddle_tpu import monitor
+
+    return {
+        "metric": "cached_dispatch_host_overhead_us",
+        "value": round(cached_us, 1),
+        "unit": "us",
+        "uncached_overhead_us": round(uncached_us, 1),
+        "speedup_vs_per_run_analysis": round(uncached_us / cached_us, 2),
+        "n_ops": n_ops,
+        "iters": iters,
+        "plan_cache_hits": int(plan_hits),
+        "plan_cache_hits_total": int(
+            monitor.counter_value("executor_plan_cache_hits_total")),
+        "recompiles_during_measure": int(recompiles),
+        "batch": batch,
+        "dim": dim,
+        "platform": platform,
+    }
+
+
+def main():
+    import bench_common
+
+    bench_common.configure_compile_cache(bench_common.HOME_CACHE_DIR)
+    bench_common.emit_result(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -9,6 +9,12 @@ ladder's whole point).  Traffic is an open-loop storm of concurrent
 submitters with mixed request sizes, so the DynamicBatcher actually
 coalesces rather than replaying fixed batches.
 
+Since PR 3 the server worker runs the non-blocking fetch path
+(AnalysisPredictor ``return_numpy=False``): batch N's d2h materialize
+overlaps batch N+1's merge/pad/dispatch, so the numbers here include
+the overlap discipline a production deployment would run with
+(``d2h_overlap`` in the line records it).
+
 Env knobs: BENCH_SERVING_THREADS (default 8), BENCH_SERVING_REQUESTS
 (per thread, default 100), BENCH_SERVING_MAX_BATCH (default 16),
 BENCH_SERVING_TIMEOUT_MS (batch window, default 2),
@@ -137,6 +143,7 @@ def _bench_endpoint(name, save_fn):
         rows = sum(total_rows)
         return {
             "rows_per_sec": round(rows / elapsed, 1),
+            "d2h_overlap": bool(server._nonblocking),
             "requests_per_sec": round(m["completed"] / elapsed, 1),
             "latency_p50_ms": m["latency_p50_ms"],
             "latency_p99_ms": m["latency_p99_ms"],

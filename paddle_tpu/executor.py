@@ -9,9 +9,11 @@ and python/paddle/fluid/executor.py:295.  The TPU-native design instead:
   subsumed by XLA buffer assignment;
 * persistable vars are functional state, donated so parameter updates are
   in-place in HBM;
-* compiled executables are cached by (program version, feed signature,
-  fetch list, state signature) — the per-shape compile cache that stands in
-  for the reference's ExecutorPrepareContext caching (executor.cc:351).
+* compiled executables are cached by (program uid+version+op count, feed
+  signature, fetch list, steps) — the per-shape compile cache that stands
+  in for the reference's ExecutorPrepareContext caching (executor.cc:351);
+  the per-run block analysis itself is cached too (_RunPlan), so a
+  steady-state run() is plan lookup -> feed coercion -> jitted call.
 
 Data-parallel/sharded execution: pass a CompiledProgram (see
 paddle_tpu/parallel/compiled_program.py); the executor consults it for a
@@ -49,7 +51,10 @@ import weakref as _weakref
 
 _exec_stats_lock = _threading.Lock()
 _exec_stats: List[Dict[str, int]] = []  # one _cache_stats dict per LIVE Executor
-_exec_retired = {"hits": 0, "misses": 0, "runs": 0}  # folded-in dead executors
+_exec_retired = {
+    "hits": 0, "misses": 0, "runs": 0,
+    "plan_hits": 0, "plan_misses": 0, "dispatch_overhead_s": 0.0,
+}  # folded-in dead executors
 
 
 def _retire_exec_stats(stats: Dict[str, int]) -> None:
@@ -81,10 +86,80 @@ _mon_registry.REGISTRY.counter_callback(
     "executor_jit_cache_misses_total",
     "newly built jitted entries (an XLA compile on first dispatch)",
     fn=lambda: _sum_exec_stats("misses"))
+_mon_registry.REGISTRY.counter_callback(
+    "executor_plan_cache_hits_total",
+    "runs served by a cached run plan (no per-run block re-analysis)",
+    fn=lambda: _sum_exec_stats("plan_hits"))
+_mon_registry.REGISTRY.counter_callback(
+    "executor_plan_cache_misses_total",
+    "run-plan builds (an O(n_ops) dataflow analysis each)",
+    fn=lambda: _sum_exec_stats("plan_misses"))
+_mon_registry.REGISTRY.counter_callback(
+    "executor_dispatch_overhead_seconds_total",
+    "host-side run() seconds spent before the jitted dispatch",
+    fn=lambda: _sum_exec_stats("dispatch_overhead_s"))
+# per-run distribution, observed only while a trace session is active —
+# a histogram observe is a lock + bucket scan (~2us), real money on a
+# hot path whose whole budget is "almost nothing"; the always-on totals
+# live in the callback counters above
+_MON_DISPATCH_HIST = _mon_registry.REGISTRY.histogram(
+    "executor_dispatch_overhead_seconds",
+    "per-run host dispatch overhead (recorded under trace sessions)")
 
 
 def _as_fetch_name(f) -> str:
     return f.name if isinstance(f, framework.Variable) else str(f)
+
+
+def _donate_kwargs(device) -> Dict[str, Any]:
+    """Buffer-donation jit kwargs for ``device``.
+
+    Donating the mutable state makes param updates in-place in HBM — the
+    point of the design on TPU.  On the CPU backend it buys nothing AND
+    is unsafe with jax's persistent compilation cache: an executable
+    compiled with input-output aliasing and then RELOADED from the disk
+    cache returns fetches that observe the in-place-mutated params
+    (reproduced: a DynamicRNN+Adam module fetches its rnn output
+    computed with POST-update weights on every warm-cache process;
+    cold compiles are always correct).  So: donate everywhere except
+    CPU (tests/test_dispatch_fastpath.py pins the policy)."""
+    if getattr(device, "platform", None) == "cpu":
+        return {}
+    return {"donate_argnums": (0,)}
+
+
+class _RunPlan:
+    """Hoisted per-(program, feed/fetch signature) block analysis.
+
+    Everything ``run()`` used to recompute per call that only depends on
+    the program STRUCTURE plus the feed/fetch name sets lives here: the
+    persistable scan over ``program.list_vars()``, the read/written
+    dataflow sets, the ``state_mut/ro/out`` tuples, the resolved fetch
+    list (including the hidden PS/dense-grad fetch tails), and the
+    per-feed dtype coercion table.  A steady-state run is then: plan
+    lookup -> coerce feeds -> jitted call.  Keyed (see ``run``) by
+    (program uid, version, op count, feed names, fetch names, steps,
+    per_step_feed, backend, compiled uid); the op count guards against
+    ops appended after a run without a version bump.
+    """
+
+    __slots__ = (
+        "feed_names", "fetch_names", "n_dense_fetch",
+        "state_mut", "state_ro", "state_out",
+        "feed_np_dtypes", "feed_jax_dtypes",
+    )
+
+    def __init__(self, feed_names, fetch_names, n_dense_fetch,
+                 state_mut, state_ro, state_out, feed_np_dtypes,
+                 feed_jax_dtypes):
+        self.feed_names = feed_names
+        self.fetch_names = fetch_names
+        self.n_dense_fetch = n_dense_fetch
+        self.state_mut = state_mut
+        self.state_ro = state_ro
+        self.state_out = state_out
+        self.feed_np_dtypes = feed_np_dtypes
+        self.feed_jax_dtypes = feed_jax_dtypes
 
 
 class Executor:
@@ -93,6 +168,8 @@ class Executor:
         # an explicit TPUPlace/CPUPlace is honored strictly (_device).
         self.place = place if place is not None else framework._DefaultPlace()
         self._cache: Dict[tuple, Any] = {}
+        self._plans: Dict[tuple, _RunPlan] = {}
+        self._dev = None  # resolved jax device (place is immutable)
         # jit-cache accounting (serving reads this): a miss means a NEW
         # jax.jit entry was built for a novel (program, feed-signature,
         # ...) key — i.e. an XLA compile on first dispatch.  This is the
@@ -101,7 +178,10 @@ class Executor:
         # executor_* callback counters (summed across live executors at
         # scrape time; a finalizer folds this executor's totals into the
         # retired base on GC so the counters stay monotonic).
-        self._cache_stats = {"hits": 0, "misses": 0, "runs": 0}
+        self._cache_stats = {
+            "hits": 0, "misses": 0, "runs": 0,
+            "plan_hits": 0, "plan_misses": 0, "dispatch_overhead_s": 0.0,
+        }
         with _exec_stats_lock:
             _exec_stats.append(self._cache_stats)
         _weakref.finalize(self, _retire_exec_stats, self._cache_stats)
@@ -135,6 +215,14 @@ class Executor:
                     ) from e
         return jax.devices()[0]
 
+    def _device_cached(self):
+        # the place never changes after construction, so resolving the
+        # jax device once keeps jax.devices() off the per-run hot path
+        dev = self._dev
+        if dev is None:
+            dev = self._dev = self._device()
+        return dev
+
     # ------------------------------------------------------------------
     def run(
         self,
@@ -162,8 +250,10 @@ class Executor:
         feeding the train loop (operators/reader/buffered_reader.cc)."""
         import jax
 
-        self._cache_stats["runs"] += 1
+        stats = self._cache_stats
+        stats["runs"] += 1
         _rec = _mon_spans.recording()
+        _t_run0 = time.perf_counter()
         compiled = None
         if program is not None and getattr(program, "_is_compiled_program", False):
             compiled = program
@@ -172,7 +262,6 @@ class Executor:
             program = framework.default_main_program()
         scope = scope or global_scope()
         feed = dict(feed or {})
-        fetch_names = [_as_fetch_name(f) for f in (fetch_list or [])]
 
         if getattr(program, "_pserver_ctx", None):
             return self._run_pserver(program)
@@ -181,7 +270,9 @@ class Executor:
             if steps != 1:
                 raise ValueError("steps>1 is not supported for pipeline programs")
             return self._run_pipeline(
-                program, feed, fetch_names, scope, return_numpy
+                program, feed,
+                [_as_fetch_name(f) for f in (fetch_list or [])],
+                scope, return_numpy,
             )
 
         dense_ps = getattr(program, "_dense_ps_ctx", None)
@@ -193,7 +284,6 @@ class Executor:
                 )
             self._dense_ps_init(dense_ps, scope)
 
-        block = program.global_block()
         if getattr(program, "_pruned_params", None):
             # a writer appended after prune() would resurrect pruned
             # weights (ADVICE r2); re-validate when the op count moved
@@ -203,41 +293,38 @@ class Executor:
 
                 _check_no_late_writers(program)
                 program._pruned_checked_ops = n_ops
+
         # distributed lookup tables: pull rows before the step, push the
         # sparse grads after (reference: parameter_prefetch.cc + the
-        # trainer-side send of SelectedRows grads)
-        ps_push = self._prefetch_distributed_tables(program, block, feed)
+        # trainer-side send of SelectedRows grads).  Host-side per batch;
+        # NOTE the plan key uses the PRE-expansion feed names — the
+        # rows/local names the prefetch adds are a deterministic function
+        # of them, so the expanded plan is safe to reuse.
+        plan_key = (
+            framework._program_uid(program),
+            program.version,
+            sum(len(b.ops) for b in program.blocks),
+            tuple(sorted(feed)),
+            tuple(_as_fetch_name(f) for f in (fetch_list or [])),
+            steps,
+            per_step_feed,
+            getattr(self.place, "backend", None),
+            framework._program_uid(compiled) if compiled is not None else None,
+        )
+        ps_push = ()
+        if getattr(program, "_distributed_tables", None):
+            ps_push = self._prefetch_distributed_tables(
+                program, program.global_block(), feed)
 
-        persistable = {
-            v.name for v in program.list_vars() if v.persistable
-        }
+        plan = self._plans.get(plan_key) if use_program_cache else None
+        if plan is not None:
+            stats["plan_hits"] += 1
+        else:
+            stats["plan_misses"] += 1
+            plan = self._analyze(program, feed, fetch_list, ps_push, dense_ps)
+            if use_program_cache:
+                self._plans[plan_key] = plan
 
-        # true dataflow reads: a name counts as read-from-outside only
-        # when some op reads it BEFORE any op writes it (a load/fill op
-        # producing a persistable must not demand scope pre-init)
-        read, written = set(), set()
-        for op in block.ops:
-            for n in op.input_arg_names:
-                if n not in written:
-                    read.add(n)
-            for n in op.output_arg_names:
-                written.add(n)
-        for fname in fetch_names:
-            if fname in persistable and fname not in written:
-                read.add(fname)
-
-        if ps_push:
-            # fetch each prefetched-rows grad so it can be pushed; hidden
-            # from the caller's fetch list (appended, sliced off below)
-            for _, _, gname in ps_push:
-                fetch_names.append(gname)
-        n_dense_fetch = 0
-        if dense_ps is not None:
-            # fetch each param's dense grad for the send (hidden like
-            # ps_push; sliced off before returning to the caller)
-            for desc in dense_ps["params"].values():
-                fetch_names.append(desc["grad"])
-                n_dense_fetch += 1
         if steps != 1 and (ps_push or steps < 1):
             raise ValueError(
                 "steps=%d: multi-step run() needs steps>=1 and is "
@@ -256,41 +343,45 @@ class Executor:
                     "steps=%d axis; got %s" % (steps, bad)
                 )
 
-        feed_names = tuple(sorted(feed.keys()))
-        state_mut = tuple(sorted((read & written & persistable)))
-        state_ro = tuple(
-            sorted((read & persistable) - set(state_mut) - set(feed_names))
-        )
-        state_out = tuple(sorted(written & persistable))
+        feed_names = plan.feed_names
+        fetch_names = plan.fetch_names
+        state_mut, state_ro = plan.state_mut, plan.state_ro
+        n_dense_fetch = plan.n_dense_fetch
 
         # materialize feed on the target device; values that are already
         # jax Arrays (e.g. a device-resident input pipeline, reader.py)
-        # pass through untouched — no host round-trip
-        device = self._device()
+        # pass through untouched — no host round-trip.  Dtype coercion
+        # tables were resolved once at plan build.
+        device = self._device_cached()
         if _rec:
             _t0 = time.perf_counter()
         feed_arrays = {}
+        np_dts, jax_dts = plan.feed_np_dtypes, plan.feed_jax_dtypes
         for name, val in feed.items():
-            var = block._find_var_recursive(name)
-            dtype = core_types.np_dtype(var.dtype) if var is not None else None
             if isinstance(val, jax.Array):
                 # coerce device-resident feeds too (cheap on-device cast,
                 # stays in HBM) so the compiled signature matches the
                 # program var — same contract as numpy feeds
-                if dtype is not None:
-                    want = jax.dtypes.canonicalize_dtype(dtype)
-                    if val.dtype != want:
-                        val = val.astype(want)
+                want = jax_dts.get(name)
+                if want is not None and val.dtype != want:
+                    val = val.astype(want)
                 feed_arrays[name] = val
                 continue
-            arr = np.asarray(val, dtype=dtype)
+            arr = np.asarray(val, dtype=np_dts.get(name))
             feed_arrays[name] = jax.device_put(arr, device)
         if _rec:
             _mon_spans.record_span(
                 "executor/h2d_feed", _t0, time.perf_counter() - _t0,
                 cat="transfer", n_feeds=len(feed_arrays))
 
-        missing = [n for n in state_mut + state_ro if scope.get(n) is None]
+        # gather state from scope (one pass doubles as the init check)
+        mut_state, ro_state, missing = {}, {}, None
+        for names, out in ((state_mut, mut_state), (state_ro, ro_state)):
+            for n in names:
+                v = scope.get(n)
+                if v is None:
+                    missing = (missing or []) + [n]
+                out[n] = v
         if missing:
             raise RuntimeError(
                 "Variables %s are not initialized in scope — run the startup "
@@ -298,29 +389,23 @@ class Executor:
             )
 
         feed_sig = tuple(
-            (n, tuple(np.shape(feed_arrays[n])), str(feed_arrays[n].dtype))
+            (n, feed_arrays[n].shape, feed_arrays[n].dtype)
             for n in feed_names
         )
-        key = (
-            id(program),
-            program.version,
-            feed_sig,
-            tuple(fetch_names),
-            state_mut,
-            state_ro,
-            state_out,
-            getattr(self.place, "backend", None),
-            id(compiled) if compiled is not None else None,
-            steps,
-            per_step_feed,
-        )
+        # plan_key already pins program identity/version/op-count, fetch
+        # list, steps/per_step_feed, backend, and compiled identity; the
+        # state tuples are a pure function of those, so the jit key only
+        # needs the per-run shape/dtype signature on top
+        key = (plan_key, feed_sig)
 
         entry = self._cache.get(key) if use_program_cache else None
         first_dispatch = entry is None
         if entry is not None:
-            self._cache_stats["hits"] += 1
+            stats["hits"] += 1
         else:
-            self._cache_stats["misses"] += 1
+            stats["misses"] += 1
+            block = program.global_block()
+            state_out = plan.state_out
             if _rec:
                 _t0 = time.perf_counter()
             fn = lowering.lower_block(block, feed_names, fetch_names, state_out)
@@ -364,7 +449,7 @@ class Executor:
                     )
                     return fetches, {**mut, **extras}
 
-            jit_kwargs = {"donate_argnums": (0,)}
+            jit_kwargs = dict(_donate_kwargs(device))
             if compiled is not None:
                 jit_kwargs.update(
                     compiled._jit_kwargs(
@@ -383,13 +468,17 @@ class Executor:
             if use_program_cache:
                 self._cache[key] = entry
 
-        mut_state = {n: scope.get(n) for n in state_mut}
-        ro_state = {n: scope.get(n) for n in state_ro}
         if compiled is not None:
             feed_arrays, mut_state, ro_state = compiled._shard_inputs(
                 feed_arrays, mut_state, ro_state, per_step_feed=per_step_feed
             )
+        # everything above is the host's per-dispatch rent; on a plan +
+        # jit cache hit it must stay "almost nothing" (the new
+        # bench_dispatch.py pins it)
+        _overhead = time.perf_counter() - _t_run0
+        stats["dispatch_overhead_s"] += _overhead
         if _rec:
+            _MON_DISPATCH_HIST.observe(_overhead)
             _t0 = time.perf_counter()
         fetches, new_state = entry(mut_state, ro_state, feed_arrays)
         if _rec:
@@ -428,7 +517,10 @@ class Executor:
             # background thread); sync mode: blocking push
             comm = getattr(program, "_ps_communicator", None)
             client = program._ps_client
-            n_user = len(fetch_names) - len(ps_push)
+            # fetch_names still carries the dense-grad tail even though
+            # those entries were sliced off `fetches` above — subtract
+            # both hidden tails or the sparse-grad zip walks user fetches
+            n_user = len(fetch_names) - len(ps_push) - n_dense_fetch
             for (table, uniq, _), grad in zip(ps_push, fetches[n_user:]):
                 if comm is not None:
                     comm.push(table, uniq, np.asarray(grad))
@@ -458,6 +550,72 @@ class Executor:
                     "executor/d2h_fetch", _t0, time.perf_counter() - _t0,
                     cat="transfer", n_fetch=len(fetches))
         return fetches
+
+    # ------------------------------------------------------------------
+    def _analyze(self, program, feed, fetch_list, ps_push, dense_ps) -> _RunPlan:
+        """The O(n_ops) block analysis ``run()`` used to repeat per call,
+        done once per plan-cache key.  ``feed`` must already carry any
+        distributed-table expansion (rows/local names) for this feed-name
+        set."""
+        import jax
+
+        block = program.global_block()
+        fetch_names = [_as_fetch_name(f) for f in (fetch_list or [])]
+
+        persistable = {
+            v.name for v in program.list_vars() if v.persistable
+        }
+
+        # true dataflow reads: a name counts as read-from-outside only
+        # when some op reads it BEFORE any op writes it (a load/fill op
+        # producing a persistable must not demand scope pre-init)
+        read, written = set(), set()
+        for op in block.ops:
+            for n in op.input_arg_names:
+                if n not in written:
+                    read.add(n)
+            for n in op.output_arg_names:
+                written.add(n)
+        for fname in fetch_names:
+            if fname in persistable and fname not in written:
+                read.add(fname)
+
+        if ps_push:
+            # fetch each prefetched-rows grad so it can be pushed; hidden
+            # from the caller's fetch list (appended, sliced off by run)
+            for _, _, gname in ps_push:
+                fetch_names.append(gname)
+        n_dense_fetch = 0
+        if dense_ps is not None:
+            # fetch each param's dense grad for the send (hidden like
+            # ps_push; sliced off before returning to the caller)
+            for desc in dense_ps["params"].values():
+                fetch_names.append(desc["grad"])
+                n_dense_fetch += 1
+
+        feed_names = tuple(sorted(feed.keys()))
+        state_mut = tuple(sorted(read & written & persistable))
+        state_ro = tuple(
+            sorted((read & persistable) - set(state_mut) - set(feed_names))
+        )
+        state_out = tuple(sorted(written & persistable))
+
+        # dtype coercion tables: program-var dtype per feed, both as the
+        # numpy target (host feeds) and the canonicalized jax target
+        # (device-resident feeds) — resolved here so the hot path never
+        # walks the var table or calls canonicalize_dtype
+        np_dts, jax_dts = {}, {}
+        for name in feed_names:
+            var = block._find_var_recursive(name)
+            if var is not None:
+                dt = core_types.np_dtype(var.dtype)
+                np_dts[name] = dt
+                jax_dts[name] = jax.dtypes.canonicalize_dtype(dt)
+
+        return _RunPlan(
+            feed_names, fetch_names, n_dense_fetch,
+            state_mut, state_ro, state_out, np_dts, jax_dts,
+        )
 
     # ------------------------------------------------------------------
     # Dense legacy PS (reference: distribute_transpiler.py trainer side +
@@ -543,7 +701,8 @@ class Executor:
              str(v.dtype if hasattr(v, "dtype") else np.asarray(v).dtype))
             for n, v in sorted(feed.items())
         )
-        key = ("pipeline", id(program), program.version, feed_sig)
+        key = ("pipeline", framework._program_uid(program), program.version,
+               feed_sig)
         entry = self._cache.get(key)
         if entry is None:
             # honor the executor's place like the main path (_device)
@@ -556,8 +715,11 @@ class Executor:
                 program, loss_name, run_plan, mesh
             )
             # donate state like the main path: param/velocity updates are
-            # in-place in HBM
-            entry = (jax.jit(step, donate_argnums=(0,)), state_names)
+            # in-place in HBM (skipped on CPU — see _donate_kwargs)
+            entry = (
+                jax.jit(step, **_donate_kwargs(mesh.devices.flat[0])),
+                state_names,
+            )
             self._cache[key] = entry
         step, state_names = entry
 
@@ -678,38 +840,32 @@ class Executor:
         if n_prefetch > 1:
             # the reference's reader threads feeding device workers
             # (trainer.h thread_num): a bounded background prefetcher
-            # overlaps host batch prep with the compiled step
-            import queue as _queue
-            import threading as _threading
+            # stages batches ON DEVICE ahead of the compiled step
+            # (reader.device_buffered), so the run() h2d phase is a
+            # passthrough.  The prefetcher shuts its producer down when
+            # the consumer exits early (exception or break) — the old
+            # inline queue left the thread blocked on q.put forever.
+            from paddle_tpu import reader as _reader
 
-            q: "_queue.Queue" = _queue.Queue(maxsize=n_prefetch)
-            _END = object()
-
-            def _fill(it):
-                try:
-                    for item in it:
-                        q.put(item)
-                finally:
-                    q.put(_END)
-
-            _threading.Thread(target=_fill, args=(batches,), daemon=True).start()
-
-            def _drain():
-                while True:
-                    item = q.get()
-                    if item is _END:
-                        return
-                    yield item
-
-            batches = _drain()
+            try:
+                device = self._device_cached()
+            except Exception:
+                device = None  # no jax backend: prefetch host-side only
+            batches = _reader.device_buffered(
+                batches, size=n_prefetch, device=device)()
         results = []
-        for i, feed in enumerate(batches):
-            out = self.run(program, feed=feed, fetch_list=fetch_list, scope=scope)
-            if fetch_list:
-                results.append(out)
-                if debug and i % print_period == 0:
-                    names = fetch_info or [ _as_fetch_name(f) for f in fetch_list]
-                    print("batch %d:" % i, dict(zip(names, [np.asarray(o) for o in out])))
+        try:
+            for i, feed in enumerate(batches):
+                out = self.run(program, feed=feed, fetch_list=fetch_list, scope=scope)
+                if fetch_list:
+                    results.append(out)
+                    if debug and i % print_period == 0:
+                        names = fetch_info or [ _as_fetch_name(f) for f in fetch_list]
+                        print("batch %d:" % i, dict(zip(names, [np.asarray(o) for o in out])))
+        finally:
+            closer = getattr(batches, "close", None)
+            if closer is not None:
+                closer()  # stop the prefetch producer (GeneratorExit path)
         return results
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
@@ -727,17 +883,25 @@ class Executor:
         compile on its first dispatch); ``hits`` counts runs served by an
         existing entry; ``entries`` is the live cache size.  Serving's
         zero-recompiles-after-warmup assertion diffs ``misses`` across a
-        workload (paddle_tpu/serving/server.py).
+        workload (paddle_tpu/serving/server.py).  ``plan_*`` mirror the
+        same accounting for the run-plan cache (the hoisted per-run block
+        analysis), and ``dispatch_overhead_s`` accumulates the host-side
+        seconds run() spent before each jitted dispatch.
         """
         return {
             "entries": len(self._cache),
             "hits": self._cache_stats["hits"],
             "misses": self._cache_stats["misses"],
+            "plan_entries": len(self._plans),
+            "plan_hits": self._cache_stats["plan_hits"],
+            "plan_misses": self._cache_stats["plan_misses"],
+            "dispatch_overhead_s": self._cache_stats["dispatch_overhead_s"],
         }
 
     # ------------------------------------------------------------------
     def close(self):
         self._cache.clear()
+        self._plans.clear()
 
 
 class AsyncExecutor:

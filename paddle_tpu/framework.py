@@ -13,6 +13,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import copy
+import itertools
 import json
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -180,6 +181,24 @@ class Variable:
         self.is_data = is_data
         # op that most recently produced this var (set by append_op)
         self.op: Optional["Operator"] = None
+
+    # --- persistable participates in the executor's cached run-plan
+    # (state_mut/ro/out derive from it), and the plan key is
+    # (uid, version, op count, ...) — so a flag toggle AFTER a run (the
+    # classic mark-before-save pattern) must bump the program version or
+    # the stale plan would keep routing the var around the scope
+    @property
+    def persistable(self) -> bool:
+        return self._persistable
+    @persistable.setter
+    def persistable(self, value) -> None:
+        value = bool(value)
+        if value == getattr(self, "_persistable", None):
+            return  # idempotent re-mark: no analysis change, no recompile
+        self._persistable = value
+        prog = getattr(getattr(self, "block", None), "program", None)
+        if prog is not None:
+            prog.version += 1
 
     # --- sugar mirroring the reference Variable API ---
     def astype(self, dtype):
@@ -481,6 +500,21 @@ class Block:
         }
 
 
+def _program_uid(obj) -> int:
+    """Monotonic identity for compile-cache keys (never-reused, unlike
+    ``id()``).  Programs get theirs at construction; any other cache
+    participant (e.g. a CompiledProgram wrapper) is stamped lazily on
+    first use."""
+    uid = getattr(obj, "_ptpu_uid", None)
+    if uid is None:
+        uid = next(Program._uid_counter)
+        try:
+            obj._ptpu_uid = uid
+        except AttributeError:
+            return id(obj)  # __slots__ object: fall back to id
+    return uid
+
+
 # ---------------------------------------------------------------------------
 # Program
 # ---------------------------------------------------------------------------
@@ -488,8 +522,14 @@ class Program:
     """A list of Blocks; block 0 is global (reference: framework.py:2782).
 
     ``version`` is bumped on structural edits and participates in the
-    executor's compile-cache key.
+    executor's compile-cache key, together with ``_ptpu_uid`` — a
+    process-monotonic program identity.  The executor used to key on
+    ``id(program)``, but CPython reuses ids after GC, so two programs
+    alive at different times could alias one jit-cache entry; the uid
+    can never collide.
     """
+
+    _uid_counter = itertools.count(1)
 
     def __init__(self):
         self.blocks: List[Block] = [Block(self, 0)]
@@ -498,6 +538,7 @@ class Program:
         self.random_seed = 0
         self._op_role = "forward"
         self._seed_counter = 0
+        self._ptpu_uid = next(Program._uid_counter)
 
     # --- block management ---
     def global_block(self) -> Block:
@@ -553,6 +594,9 @@ class Program:
                     kept.append(op)
                 blk.ops = kept
         p.version += 1
+        # deepcopy duplicated the source's uid; a clone is a DISTINCT
+        # program and must never share a compile-cache identity with it
+        p._ptpu_uid = next(Program._uid_counter)
         return p
 
     # --- serialization (the reference's ProgramDesc protobuf round-trip,

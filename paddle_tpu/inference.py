@@ -100,7 +100,14 @@ class AnalysisPredictor(PaddlePredictor):
     def get_output_names(self) -> List[str]:
         return list(self._fetch_names)
 
-    def run(self, feed: Dict[str, np.ndarray] | Sequence[np.ndarray]):
+    def run(self, feed: Dict[str, np.ndarray] | Sequence[np.ndarray],
+            return_numpy: bool = True):
+        """One predictor dispatch.  ``return_numpy=False`` is the
+        non-blocking fast path: outputs come back as device arrays
+        WITHOUT forcing a device-to-host sync, so the caller can
+        dispatch the next batch while this one's d2h transfer (a later
+        ``np.asarray``) overlaps it — the serving worker's double-buffer
+        discipline (paddle_tpu/serving/server.py)."""
         import paddle_tpu as fluid
 
         if not isinstance(feed, dict):
@@ -108,13 +115,15 @@ class AnalysisPredictor(PaddlePredictor):
         _MON_PRED_RUNS.inc()
         with fluid.scope_guard(self._scope):
             return self._exe.run(
-                self._program, feed=feed, fetch_list=self._fetch_names
+                self._program, feed=feed, fetch_list=self._fetch_names,
+                return_numpy=return_numpy,
             )
 
     Run = run  # C++-style alias
 
     # --- TPU-native serving surface (paddle_tpu/serving) ---
-    def run_padded(self, feed: Dict[str, np.ndarray], n_valid: Optional[int] = None):
+    def run_padded(self, feed: Dict[str, np.ndarray], n_valid: Optional[int] = None,
+                   return_numpy: bool = True):
         """Batched-run entry for pre-padded bucket feeds.
 
         The serving layer pads every coalesced batch up to a fixed
@@ -123,7 +132,8 @@ class AnalysisPredictor(PaddlePredictor):
         output back to the first ``n_valid`` rows (outputs whose
         leading dim is not the padded batch — e.g. scalar fetches —
         pass through untouched).  All feeds must agree on the padded
-        leading dim.
+        leading dim.  With ``return_numpy=False`` outputs stay device
+        arrays (the n_valid slice is a lazy device op) — no d2h sync.
         """
         if not isinstance(feed, dict):
             feed = dict(zip(self._feed_names, feed))
@@ -142,7 +152,7 @@ class AnalysisPredictor(PaddlePredictor):
                 "n_valid=%r out of range for padded batch %d" % (n_valid, padded))
         _MON_PRED_PADDED_ROWS.inc(padded)
         _MON_PRED_WASTE_ROWS.inc(padded - n_valid)
-        outs = self.run(feed)
+        outs = self.run(feed, return_numpy=return_numpy)
         if n_valid == padded:
             return outs
         return [

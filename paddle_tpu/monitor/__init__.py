@@ -48,6 +48,7 @@ from paddle_tpu.monitor.registry import (
     MetricsRegistry,
     REGISTRY,
 )
+from paddle_tpu.monitor import spans as _spans
 from paddle_tpu.monitor.spans import (
     record_instant,
     record_span,
@@ -57,6 +58,12 @@ from paddle_tpu.monitor.spans import (
     stop_recording,
 )
 from paddle_tpu.monitor.chrome_trace import export_chrome_trace
+
+# ring-buffer sessions (trace_session(max_spans=N)) count what they drop
+REGISTRY.counter_callback(
+    "trace_dropped_spans_total",
+    "spans dropped by ring-buffer trace sessions (drop-oldest)",
+    fn=_spans.dropped_total)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "CallbackCounter", "MetricsRegistry",
@@ -104,12 +111,15 @@ def counter_value(name: str, default: float = 0.0, **labels) -> float:
 # -- trace sessions -----------------------------------------------------
 class TraceSession:
     """Handle yielded by ``trace_session``; after the block exits,
-    ``spans`` holds the recorded spans and ``export`` re-renders them."""
+    ``spans`` holds the recorded spans (the last ``max_spans`` of them
+    in ring-buffer mode, with ``dropped`` counting the rest) and
+    ``export`` re-renders them."""
 
     def __init__(self, path: Optional[str], jsonl_path: Optional[str]):
         self.path = path
         self.jsonl_path = jsonl_path
         self.spans: List[Dict[str, object]] = []
+        self.dropped = 0
 
     def export(self, path: Optional[str] = None,
                jsonl_path: Optional[str] = None) -> str:
@@ -123,17 +133,24 @@ class TraceSession:
 
 @contextlib.contextmanager
 def trace_session(path: Optional[str] = None,
-                  jsonl_path: Optional[str] = None):
+                  jsonl_path: Optional[str] = None,
+                  max_spans: Optional[int] = None):
     """Record spans for the duration of the block; when ``path`` is
     given, write the merged Chrome trace (spans + ``jsonl_path``) on
     exit — including exceptional exit, so a failed run still leaves its
-    trace behind."""
-    start_recording()
+    trace behind.
+
+    ``max_spans=N`` bounds the buffer to a drop-oldest ring of N spans,
+    making always-on production tracing safe: the session keeps the N
+    most recent spans and ``sess.dropped`` (plus the registry's
+    ``trace_dropped_spans_total``) counts what fell off."""
+    start_recording(max_spans=max_spans)
     sess = TraceSession(path, jsonl_path)
     try:
         yield sess
     except BaseException:
         sess.spans = stop_recording()
+        sess.dropped = _spans.session_dropped()
         if path is not None:
             try:
                 sess.export()
@@ -142,5 +159,6 @@ def trace_session(path: Optional[str] = None,
         raise
     else:
         sess.spans = stop_recording()
+        sess.dropped = _spans.session_dropped()
         if path is not None:
             sess.export()

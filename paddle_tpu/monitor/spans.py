@@ -21,19 +21,23 @@ thread id, a category, an optional ``error`` flag, and free-form args.
 """
 from __future__ import annotations
 
+import collections
 import contextlib
 import threading
 import time
-from typing import Dict, List
+from typing import Deque, Dict, List, Optional
 
 __all__ = [
     "recording", "start_recording", "stop_recording", "record_span",
-    "record_instant", "span",
+    "record_instant", "span", "session_dropped", "dropped_total",
 ]
 
 _enabled = False
 _lock = threading.Lock()
-_buffer: List[Dict[str, object]] = []
+_buffer: Deque[Dict[str, object]] = collections.deque()
+_max_spans: Optional[int] = None  # ring-buffer capacity; None = unbounded
+_dropped = 0        # spans dropped by the ring in the current/last session
+_dropped_total = 0  # process-lifetime drop total (registry exposition)
 _epoch_pc = 0.0    # perf_counter at session start
 _epoch_wall = 0.0  # time.time at session start
 
@@ -43,29 +47,52 @@ def recording() -> bool:
     return _enabled
 
 
-def start_recording() -> None:
+def start_recording(max_spans: Optional[int] = None) -> None:
     """Begin a session: clears the buffer, re-anchors the epoch.
+
+    ``max_spans`` turns the buffer into a drop-oldest ring, so an
+    always-on production session holds the LAST N spans instead of
+    growing an unbounded list; drops are counted (``session_dropped`` /
+    the ``trace_dropped_spans_total`` registry counter).
 
     Sessions are process-global and do NOT nest: starting a new one
     supersedes (and discards the buffered spans of) any active session,
     and the superseded ``trace_session`` will export empty.  One trace
     session at a time is the contract."""
-    global _enabled, _epoch_pc, _epoch_wall
+    global _enabled, _epoch_pc, _epoch_wall, _max_spans, _dropped
+    if max_spans is not None and int(max_spans) < 1:
+        raise ValueError("max_spans must be >= 1 (got %r)" % (max_spans,))
     with _lock:
-        del _buffer[:]
+        _buffer.clear()
+        _max_spans = int(max_spans) if max_spans is not None else None
+        _dropped = 0
         _epoch_pc = time.perf_counter()
         _epoch_wall = time.time()
         _enabled = True
 
 
 def stop_recording() -> List[Dict[str, object]]:
-    """End the session; returns (and drains) the recorded spans."""
+    """End the session; returns (and drains) the recorded spans.  With a
+    ring-buffer session these are the LAST ``max_spans`` recorded —
+    ``session_dropped()`` says how many older ones fell off."""
     global _enabled
     with _lock:
         _enabled = False
         out = list(_buffer)
-        del _buffer[:]
+        _buffer.clear()
     return out
+
+
+def session_dropped() -> int:
+    """Spans dropped by the ring buffer in the current (or, after
+    ``stop_recording``, the most recent) session."""
+    return _dropped
+
+
+def dropped_total() -> int:
+    """Process-lifetime ring-buffer drop total (monotonic; backs the
+    ``trace_dropped_spans_total`` registry counter)."""
+    return _dropped_total
 
 
 def record_span(name: str, t0: float, dur: float, cat: str = "host",
@@ -85,12 +112,17 @@ def record_span(name: str, t0: float, dur: float, cat: str = "host",
         rec["error"] = True
     if args:
         rec["args"] = args
+    global _dropped, _dropped_total
     with _lock:
         if _enabled:
             # epoch read under the lock: a concurrent start_recording
             # re-anchors both epochs atomically, so the ts can never mix
             # an old perf_counter anchor with a new wall anchor
             rec["ts"] = _epoch_wall + (t0 - _epoch_pc)  # wall-clock seconds
+            if _max_spans is not None and len(_buffer) >= _max_spans:
+                _buffer.popleft()  # drop-oldest ring
+                _dropped += 1
+                _dropped_total += 1
             _buffer.append(rec)
 
 

@@ -50,6 +50,7 @@ __all__ = [
     "shuffle",
     "batch",
     "buffered",
+    "device_buffered",
     "map_readers",
     "chain",
     "compose",
@@ -59,6 +60,116 @@ __all__ = [
     "Fake",
     "PipeReader",
 ]
+
+
+# ---------------------------------------------------------------------------
+# Bounded background prefetch with clean shutdown
+# ---------------------------------------------------------------------------
+_END = object()  # producer-done sentinel
+
+# how often a blocked producer re-checks the stop flag; bounds both the
+# shutdown latency and the cost of a consumer that vanished without close()
+_STOP_POLL_S = 0.05
+
+
+class _Prefetcher:
+    """One producer thread filling a bounded queue, one consumer.
+
+    The building block behind ``buffered``/``device_buffered`` and the
+    executor's ``train_from_dataset`` prefetch.  Guarantees the producer
+    thread TERMINATES in every exit mode: source exhausted (sentinel),
+    producer exception (re-raised in the consumer), or consumer gone
+    (``close()`` sets the stop flag; a blocked ``put`` polls it).  The
+    old inline implementations blocked forever on ``q.put`` when the
+    consumer exited mid-epoch — a thread leak per abandoned epoch.
+
+    ``transform`` runs IN the producer thread (this is where
+    ``device_buffered`` stages batches onto the device, overlapping h2d
+    with the consumer's compute).
+    """
+
+    def __init__(self, source, size: int, transform: Optional[Callable] = None,
+                 name: str = "ptpu-prefetch"):
+        self._source = source
+        self._transform = transform
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, int(size)))
+        self._stop = threading.Event()
+        self._exc: Optional[BaseException] = None
+        self._finished = False
+        self._thread = threading.Thread(
+            target=self._fill, name=name, daemon=True)
+        self._thread.start()
+
+    # --- producer side ---
+    def _put(self, item) -> bool:
+        """Enqueue; returns False when the consumer closed us."""
+        try:
+            self._q.put_nowait(item)
+            return True
+        except queue.Full:
+            pass
+        _MON_PRODUCER_STALLS.inc()
+        t0 = time.perf_counter()
+        try:
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=_STOP_POLL_S)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+        finally:
+            _MON_PRODUCER_STALL_S.inc(time.perf_counter() - t0)
+
+    def _fill(self) -> None:
+        try:
+            src = self._source() if callable(self._source) else self._source
+            for item in src:
+                if self._transform is not None:
+                    item = self._transform(item)
+                if not self._put(item):
+                    return  # closed by the consumer
+        except BaseException as e:  # noqa: BLE001 — surfaced to the consumer
+            self._exc = e
+        finally:
+            if not self._stop.is_set():
+                self._put(_END)
+
+    # --- consumer side ---
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._finished:
+            raise StopIteration
+        try:
+            item = self._q.get_nowait()
+        except queue.Empty:
+            _MON_CONSUMER_STALLS.inc()
+            t0 = time.perf_counter()
+            item = self._q.get()  # the producer's finally guarantees _END
+            _MON_CONSUMER_STALL_S.inc(time.perf_counter() - t0)
+        if item is _END:
+            self._finished = True
+            self._thread.join(timeout=5.0)
+            if self._exc is not None:
+                raise self._exc
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        """Stop the producer and release its thread.  Idempotent; safe
+        to call with items still queued (they are dropped)."""
+        self._finished = True
+        self._stop.set()
+        # drain so a producer blocked in put() frees immediately rather
+        # than waiting out a poll interval
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)
 
 
 # ---------------------------------------------------------------------------
@@ -95,43 +206,103 @@ def batch(reader, batch_size: int, drop_last: bool = False):
 
 
 def buffered(reader, size: int):
-    """Prefetch into a bounded queue on a background thread."""
-
-    class _End:
-        pass
+    """Prefetch into a bounded queue on a background thread.  The
+    producer terminates when the consumer stops early (see _Prefetcher)."""
 
     def reader_():
-        q: queue.Queue = queue.Queue(maxsize=size)
+        p = _Prefetcher(reader, size)
+        try:
+            yield from p
+        finally:
+            p.close()
 
-        def put(item):
-            try:
-                q.put_nowait(item)
-            except queue.Full:
-                _MON_PRODUCER_STALLS.inc()
-                t0 = time.perf_counter()
-                q.put(item)
-                _MON_PRODUCER_STALL_S.inc(time.perf_counter() - t0)
+    return reader_
 
-        def fill():
-            try:
-                for item in reader():
-                    put(item)
-            finally:
-                put(_End)
 
-        t = threading.Thread(target=fill, daemon=True)
-        t.start()
-        while True:
+def _stack_group(group):
+    """Assemble one per_step_feed chunk: stack a group of batches on a
+    new leading ``steps`` axis.  Supports dict batches (name -> array),
+    sequence batches (positional arrays), and bare arrays."""
+    first = group[0]
+    if isinstance(first, dict):
+        return {k: np.stack([np.asarray(b[k]) for b in group]) for k in first}
+    if isinstance(first, (list, tuple)):
+        return [np.stack([np.asarray(b[i]) for b in group])
+                for i in range(len(first))]
+    return np.stack([np.asarray(b) for b in group])
+
+
+def _tree_device_put(item, device):
+    """``jax.device_put`` every array in a dict/sequence/bare batch; a
+    None device leaves the batch on host (no jax backend available)."""
+    if device is None:
+        return item
+    import jax
+
+    put = lambda a: jax.device_put(a, device)  # noqa: E731
+    if isinstance(item, dict):
+        return {k: put(v) for k, v in item.items()}
+    if isinstance(item, (list, tuple)):
+        return [put(v) for v in item]
+    return put(item)
+
+
+def device_buffered(reader, size: int = 2, device="auto",
+                    steps: Optional[int] = None, drop_last: bool = True):
+    """Device-side prefetch: a bounded background thread that
+    ``jax.device_put``s batches ahead of the consumer, so feeds arrive
+    as ``jax.Array``s and ``Executor.run``'s h2d phase is a passthrough
+    (the reference's reader/double_buffer prefetch op pair,
+    operators/reader/buffered_reader.cc).
+
+    ``reader``: a reader callable OR an iterable of batches (dicts,
+    sequences, or bare arrays).  ``device="auto"`` (default) resolves
+    the process-default jax device (degrading to host staging when no
+    backend is available); pass an explicit device to pin, or ``None``
+    to skip device staging entirely and prefetch host-side.
+    ``steps=N`` assembles per_step_feed chunks: N consecutive batches
+    stacked on a new leading axis, matching
+    ``Executor.run(steps=N, per_step_feed=True)``; a ragged tail of
+    fewer than N batches is dropped unless ``drop_last=False``.
+
+    Stalls report into the registry reader counters; the producer
+    thread shuts down when the consumer exits early (break/exception).
+    """
+
+    def reader_():
+        dev = device
+        if dev == "auto":
             try:
-                item = q.get_nowait()
-            except queue.Empty:
-                _MON_CONSUMER_STALLS.inc()
-                t0 = time.perf_counter()
-                item = q.get()
-                _MON_CONSUMER_STALL_S.inc(time.perf_counter() - t0)
-            if item is _End:
-                break
-            yield item
+                import jax
+
+                dev = jax.devices()[0]
+            except Exception:
+                dev = None
+
+        def source():
+            it = iter(reader() if callable(reader) else reader)
+            if steps is None:
+                yield from it
+                return
+            while True:
+                group = list(itertools.islice(it, int(steps)))
+                if len(group) < int(steps):
+                    if group and not drop_last:
+                        yield group
+                    return
+                yield group
+
+        def stage(item):
+            if steps is not None:
+                item = _stack_group(item)
+            return _tree_device_put(item, dev)
+
+        p = _Prefetcher(source, size, transform=stage,
+                        name="ptpu-prefetch-device")
+        try:
+            yield from p
+        finally:
+            p.close()
 
     return reader_
 
@@ -371,23 +542,16 @@ class PyReader:
     def _iter(self):
         if self._generator is None:
             raise RuntimeError("PyReader is not decorated with a generator")
-        import jax
-
-        device = None
-        if self._use_double_buffer:
-            try:
-                device = jax.devices()[0]
-            except Exception:
-                device = None
         names = [v.name for v in self._feed_vars]
 
-        def produce():
-            for arrays in self._generator():
-                if device is not None:
-                    arrays = [jax.device_put(a, device) for a in arrays]
-                yield arrays
-
-        src = buffered(produce, self._capacity)() if self._use_double_buffer else produce()
+        # double buffer = device-side prefetch: batches are device_put
+        # on the producer thread, so by the time the training step asks
+        # for batch N+1 it is already in HBM (and the producer shuts
+        # down cleanly if the consumer abandons the epoch)
+        src = (
+            device_buffered(self._generator, self._capacity)()
+            if self._use_double_buffer else self._generator()
+        )
         for arrays in src:
             if self._return_list:
                 yield list(arrays)

@@ -112,7 +112,8 @@ class DynamicBatcher:
                 return out
 
     # --- worker side (single consumer) ---
-    def _take_first(self, stop: threading.Event, on_expired) -> Optional[ServingRequest]:
+    def _take_first(self, stop: threading.Event, on_expired,
+                    block: bool = True) -> Optional[ServingRequest]:
         if self._carry is not None:
             first, self._carry = self._carry, None
             if not first.expired():
@@ -122,8 +123,8 @@ class DynamicBatcher:
             try:
                 first = self._q.get_nowait()
             except queue.Empty:
-                if stop.is_set():
-                    return None  # drained
+                if not block or stop.is_set():
+                    return None  # nothing ready / drained
                 try:
                     first = self._q.get(timeout=_IDLE_POLL_S)
                 except queue.Empty:
@@ -133,15 +134,20 @@ class DynamicBatcher:
                 continue
             return first
 
-    def next_batch(self, stop: threading.Event, on_expired) -> Optional[List[ServingRequest]]:
+    def next_batch(self, stop: threading.Event, on_expired,
+                   block: bool = True) -> Optional[List[ServingRequest]]:
         """Return the next coalesced batch, or None once stopped AND
         drained.  ``on_expired`` is called with each request whose
         deadline passed while queued (the server fails + counts it).
 
+        ``block=False``: a non-blocking poll — returns None immediately
+        when no live request is ready (the server uses this to finalize
+        an in-flight d2h batch before idling).
+
         While draining (``stop`` set) the window is not awaited — only
         already-queued requests coalesce, so shutdown latency is bounded
         by the in-flight work, not by the timeout."""
-        first = self._take_first(stop, on_expired)
+        first = self._take_first(stop, on_expired, block=block)
         if first is None:
             return None
         batch = [first]

@@ -66,6 +66,16 @@ class InferenceServer:
         self._metrics = ServingMetrics(name)
         self._specs = dict(input_specs) if input_specs else predictor.input_specs()
         self._feed_names = list(predictor.get_input_names())
+        # non-blocking fetch (AnalysisPredictor return_numpy=False) lets
+        # the worker overlap batch N's d2h with batch N+1's dispatch; a
+        # duck-typed predictor without the kwarg just runs synchronously
+        import inspect
+
+        try:
+            self._nonblocking = "return_numpy" in inspect.signature(
+                predictor.run_padded).parameters
+        except (TypeError, ValueError):
+            self._nonblocking = False
         self._stop = threading.Event()
         self._closed = False           # admission gate (set before _stop on shutdown)
         self._admin = None             # optional HTTP surface (start_admin)
@@ -276,13 +286,36 @@ class InferenceServer:
         req.fail(DeadlineExceeded("deadline passed while queued"))
 
     def _serve_loop(self) -> None:
+        # one batch of d2h kept in flight: dispatch batch N+1 (async jit
+        # call, return_numpy=False) BEFORE materializing batch N's
+        # outputs, so N's device compute + d2h overlap N+1's host-side
+        # merge/pad/dispatch.  With work in flight the batcher is only
+        # POLLED (block=False): if no live request is ready the pending
+        # batch finalizes immediately — never parked behind an idle (or
+        # all-expired) queue.
+        pending = None
         while True:
-            batch = self._batcher.next_batch(self._stop, self._on_expired)
+            batch = self._batcher.next_batch(
+                self._stop, self._on_expired, block=pending is None)
             if batch is None:
+                if pending is not None:
+                    self._finalize(*pending)
+                    pending = None
+                    continue  # re-enter blocking wait
                 return  # stopped and drained
-            self._execute(batch)
+            nxt = self._execute(batch)
+            if pending is not None:
+                self._finalize(*pending)
+            if nxt is not None and not self._nonblocking:
+                # synchronous predictor: outs are already materialized —
+                # deferring would just delay completions by one batch
+                self._finalize(*nxt)
+                nxt = None
+            pending = nxt
 
-    def _execute(self, batch: List[ServingRequest]) -> None:
+    def _execute(self, batch: List[ServingRequest]):
+        """Merge + pad + DISPATCH one batch (non-blocking fetch); returns
+        the pending tuple for _finalize, or None on failure."""
         valid = sum(r.n_rows for r in batch)
         try:
             merged = {
@@ -295,20 +328,36 @@ class InferenceServer:
             padded = self._policy.pad_feed(merged, bucket)
             misses0 = self._predictor.jit_cache_stats()["misses"]
             t0 = time.perf_counter()
+            kw = {"return_numpy": False} if self._nonblocking else {}
             with self._exec_lock:
                 with profiler.RecordEvent("serving/%s/batch" % self.name):
-                    outs = self._predictor.run_padded(padded, n_valid=valid)
-            run_s = time.perf_counter() - t0
+                    outs = self._predictor.run_padded(
+                        padded, n_valid=valid, **kw)
             recompiled = self._predictor.jit_cache_stats()["misses"] > misses0
-            self._metrics.observe_batch(
-                valid, bucket, run_s,
-                recompiled=recompiled and self._warmed)
         except BaseException as exc:  # noqa: BLE001 — fail the batch, keep serving
             self._metrics.count("failed", len(batch))
             for r in batch:
                 r.fail(exc)
+            return None
+        return (batch, outs, valid, bucket, t0, recompiled)
+
+    def _finalize(self, batch: List[ServingRequest], outs, valid: int,
+                  bucket: int, t0: float, recompiled: bool) -> None:
+        """Materialize a dispatched batch (the d2h sync) and complete its
+        requests.  Deferred XLA runtime errors surface here — fail the
+        batch, keep serving.  The batch is observed HERE so ``run_s``
+        spans dispatch -> outputs materialized (the real batch duration;
+        timing only the async dispatch call would report ~0)."""
+        try:
+            outs = [np.asarray(o) for o in outs]
+        except BaseException as exc:  # noqa: BLE001
+            self._metrics.count("failed", len(batch))
+            for r in batch:
+                r.fail(exc)
             return
-        outs = [np.asarray(o) for o in outs]
+        self._metrics.observe_batch(
+            valid, bucket, time.perf_counter() - t0,
+            recompiled=recompiled and self._warmed)
         off = 0
         now = time.perf_counter()
         for r in batch:

@@ -7,6 +7,7 @@ Reference style: book tests assert save/load inference model round-trips
 import os
 
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import framework
@@ -189,3 +190,171 @@ def test_reader_decorator_tail_and_fleet_shims():
         loss.backward(bs)
         np.testing.assert_allclose(x.gradient(), 2 * np.ones((2, 2)),
                                    rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# device-side prefetch (PR 3: reader.device_buffered)
+# ---------------------------------------------------------------------------
+def test_device_buffered_ordering_and_device_arrays():
+    import jax
+
+    from paddle_tpu import reader as R
+
+    def src():
+        for i in range(20):
+            yield {"x": np.full((2, 3), i, np.float32)}
+
+    out = list(R.device_buffered(src, size=3)())
+    assert len(out) == 20
+    for i, item in enumerate(out):
+        assert isinstance(item["x"], jax.Array)  # staged ahead, in HBM
+        np.testing.assert_array_equal(np.asarray(item["x"]), np.full((2, 3), i))
+
+
+def test_device_buffered_per_step_feed_chunks():
+    import jax
+
+    from paddle_tpu import reader as R
+
+    def src():
+        for i in range(10):
+            yield {"x": np.full((2,), i, np.float32)}
+
+    chunks = list(R.device_buffered(src, size=2, steps=4)())
+    # 10 batches / steps=4 -> 2 full chunks, ragged tail of 2 dropped
+    assert len(chunks) == 2
+    for c, base in zip(chunks, (0, 4)):
+        assert isinstance(c["x"], jax.Array)
+        assert c["x"].shape == (4, 2)  # leading steps axis
+        np.testing.assert_array_equal(
+            np.asarray(c["x"]),
+            np.stack([np.full((2,), base + j, np.float32) for j in range(4)]))
+
+    # drop_last=False keeps the ragged tail (a caller running a final
+    # short chunk passes a matching steps= to run())
+    tail = list(R.device_buffered(src, size=2, steps=4, drop_last=False)())
+    assert [np.asarray(c["x"]).shape[0] for c in tail] == [4, 4, 2]
+
+    # sequence batches assemble positionally
+    def seq_src():
+        for i in range(4):
+            yield [np.full((3,), i, np.float32), np.full((1,), -i, np.float32)]
+
+    (chunk,) = list(R.device_buffered(seq_src, size=2, steps=4)())
+    assert np.asarray(chunk[0]).shape == (4, 3)
+    np.testing.assert_array_equal(np.asarray(chunk[1])[:, 0], [0, -1, -2, -3])
+
+
+def test_device_buffered_chunks_feed_multi_step_run():
+    """End to end: per_step_feed chunks assembled by the reader drive
+    Executor.run(steps=N, per_step_feed=True) with zero recompiles
+    across chunks."""
+    from paddle_tpu import reader as R
+
+    prog, startup, loss, _ = _build_regression()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+
+    def batches():
+        for _ in range(8):
+            yield {"x": rng.rand(8, 13).astype(np.float32),
+                   "y": rng.rand(8, 1).astype(np.float32)}
+
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        chunks = list(R.device_buffered(batches, size=2, steps=4)())
+        assert len(chunks) == 2
+        losses = []
+        for feed in chunks:
+            (l,) = exe.run(prog, feed=feed, fetch_list=[loss],
+                           steps=4, per_step_feed=True)
+            losses.append(float(np.asarray(l)))
+        stats = exe.jit_cache_stats()
+        assert stats["misses"] >= 1 and stats["hits"] >= 1  # chunk 2 was a hit
+        assert np.isfinite(losses).all()
+
+
+def test_device_buffered_clean_shutdown_and_stall_counters():
+    import threading
+    import time as _time
+
+    from paddle_tpu import monitor, reader as R
+
+    def _prefetch_threads():
+        return [t for t in threading.enumerate()
+                if t.name.startswith("ptpu-prefetch")]
+
+    base = len(_prefetch_threads())
+    p0 = monitor.counter_value("reader_producer_stalls_total")
+
+    def src():
+        for i in range(1000):
+            yield i
+
+    gen = R.device_buffered(src, size=2, device=None)()
+    got = [next(gen), next(gen)]
+    assert got == [0, 1]
+    _time.sleep(0.2)  # queue full -> producer blocked (a counted stall)
+    gen.close()  # consumer abandons the epoch
+    deadline = _time.time() + 5
+    while len(_prefetch_threads()) > base and _time.time() < deadline:
+        _time.sleep(0.01)
+    assert len(_prefetch_threads()) == base, "prefetch producer leaked"
+    assert monitor.counter_value("reader_producer_stalls_total") > p0
+
+
+def test_train_from_dataset_prefetch_no_thread_leak():
+    """Consumer dying mid-epoch must terminate the prefetch producer —
+    the old inline queue left it blocked on q.put forever."""
+    import threading
+    import time as _time
+
+    prog, startup, loss, _ = _build_regression()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    feeds = [{"x": rng.rand(4, 13).astype(np.float32),
+              "y": rng.rand(4, 1).astype(np.float32)} for _ in range(50)]
+
+    calls = []
+    orig_run = exe.run
+
+    def run_then_boom(*args, **kwargs):
+        if len(calls) >= 3:
+            raise RuntimeError("consumer died mid-epoch")
+        calls.append(1)
+        return orig_run(*args, **kwargs)
+
+    def _prefetch_threads():
+        return [t for t in threading.enumerate()
+                if t.name.startswith("ptpu-prefetch")]
+
+    base = len(_prefetch_threads())
+    with fluid.scope_guard(scope):
+        orig_run(startup)
+        exe.run = run_then_boom
+        try:
+            with pytest.raises(RuntimeError, match="consumer died"):
+                exe.train_from_dataset(
+                    program=prog, dataset=feeds, scope=scope, thread=2,
+                    fetch_list=[loss])
+        finally:
+            exe.run = orig_run
+    deadline = _time.time() + 5
+    while len(_prefetch_threads()) > base and _time.time() < deadline:
+        _time.sleep(0.01)
+    assert len(_prefetch_threads()) == base, "producer thread leaked"
+
+
+def test_buffered_producer_exception_surfaces():
+    from paddle_tpu import reader as R
+
+    def src():
+        yield 1
+        raise ValueError("producer blew up")
+
+    it = R.buffered(src, 2)()
+    assert next(it) == 1
+    with pytest.raises(ValueError, match="producer blew up"):
+        list(it)

@@ -421,3 +421,58 @@ def test_reader_stall_counters():
     time.sleep(0.1)  # producer fills the size-2 queue and blocks
     assert monitor.counter_value("reader_producer_stalls_total") > bp0
     assert list(gen) == [1, 2, 3, 4, 5, 6, 7]
+
+
+# ---------------------------------------------------------------------------
+# PR 3: bounded span buffer + dispatch-overhead instrumentation
+# ---------------------------------------------------------------------------
+def test_trace_session_ring_buffer_drop_oldest():
+    from paddle_tpu.monitor import spans
+
+    before_total = spans.dropped_total()
+    with monitor.trace_session(max_spans=5) as sess:
+        for i in range(12):
+            monitor.record_span("s%d" % i, time.perf_counter(), 0.001)
+    assert len(sess.spans) == 5
+    assert [s["name"] for s in sess.spans] == ["s7", "s8", "s9", "s10", "s11"]
+    assert sess.dropped == 7  # drop-oldest, counted
+    assert spans.dropped_total() == before_total + 7
+    assert monitor.counter_value("trace_dropped_spans_total") >= 7
+
+    # unbounded sessions are unaffected
+    with monitor.trace_session() as sess2:
+        for i in range(12):
+            monitor.record_span("u%d" % i, time.perf_counter(), 0.001)
+    assert len(sess2.spans) == 12 and sess2.dropped == 0
+
+    with pytest.raises(ValueError):
+        monitor.start_recording(max_spans=0)
+
+
+def test_plan_cache_counters_and_dispatch_histogram():
+    """The executor's plan-cache counters reach the registry, and the
+    per-run dispatch-overhead histogram records under a trace session."""
+    prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [IN_DIM])
+        y = fluid.layers.fc(x, OUT_DIM)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {"x": np.ones((2, IN_DIM), np.float32)}
+
+    hist = monitor.REGISTRY.get("executor_dispatch_overhead_seconds")
+    h0 = hist.labels().value["count"]
+    p_hits0 = monitor.counter_value("executor_plan_cache_hits_total")
+    p_miss0 = monitor.counter_value("executor_plan_cache_misses_total")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(prog, feed=feed, fetch_list=[y])  # plan miss
+        with monitor.trace_session() as sess:
+            for _ in range(3):
+                exe.run(prog, feed=feed, fetch_list=[y])  # plan hits
+    assert monitor.counter_value("executor_plan_cache_misses_total") >= p_miss0 + 1
+    assert monitor.counter_value("executor_plan_cache_hits_total") >= p_hits0 + 3
+    # histogram observed only inside the session (hot path stays lean)
+    assert hist.labels().value["count"] == h0 + 3
+    assert monitor.counter_value("executor_dispatch_overhead_seconds_total") > 0
+    assert any(s["name"] == "executor/device_execute" for s in sess.spans)
