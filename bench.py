@@ -211,14 +211,16 @@ def _merge_cal(res, cal):
 # been seen exceeding 420 s (the repo-local .jax_cache is gitignored,
 # so fresh checkouts compile cold), which silently dropped
 # framework_overhead_pct from the driver line; deepfm finishes far
-# inside 480 s (ADVICE r5).
-_BUDGETS = {"probe": 90, "bert": 900, "resnet": 780, "cal": 540, "nmt": 780,
-            "deepfm": 480}
+# inside 480 s (ADVICE r5).  Rebalanced r7 (nmt 780->690): frees 90 s
+# for the new dispatch_sharded stage (a CPU-mesh micro-bench that
+# finishes in well under a minute even cold).
+_BUDGETS = {"probe": 90, "bert": 900, "resnet": 780, "cal": 540, "nmt": 690,
+            "deepfm": 480, "dispatch_sharded": 90}
 # set to a reduced table when the liveness probe fails: with the backend
 # known-wedged, burning every stage's full budget buys nothing — short
 # budgets still let a recovering tunnel produce numbers
 _DEGRADED_BUDGETS = {"probe": 90, "bert": 300, "resnet": 240, "cal": 150,
-                     "nmt": 150, "deepfm": 150}
+                     "nmt": 150, "deepfm": 150, "dispatch_sharded": 60}
 _active_budgets = _BUDGETS
 
 
@@ -350,6 +352,8 @@ def _orchestrate():
         _emit(line)
         line["deepfm"] = _run_sub("deepfm")
         _emit(line)
+        line["dispatch_sharded"] = _dispatch_sharded_block()
+        _emit(line)
         return
 
     _emit(line)  # headline secured before any other stage can hang
@@ -359,6 +363,8 @@ def _orchestrate():
     line["nmt"] = _run_sub("nmt")
     _emit(line)
     line["deepfm"] = _run_sub("deepfm")
+    _emit(line)
+    line["dispatch_sharded"] = _dispatch_sharded_block()
     _emit(line)
 
 
@@ -377,6 +383,23 @@ def _resnet_block():
         cal.pop("wall_s", None)
         _merge_cal(res, cal)
     return res
+
+
+def _dispatch_sharded_block():
+    """Multi-device dispatch-overhead micro-bench (bench_dispatch.py
+    --sharded) on a host-simulated 8-device CPU mesh — tracks whether
+    sharding the feed pipeline reintroduces per-device host work per
+    step.  Runs on CPU regardless of the accelerator under test: the
+    metric is HOST overhead, and the virtual mesh gives it 8 devices
+    everywhere the driver runs."""
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla_flags:
+        xla_flags = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
+    return _run_sub("dispatch_sharded", {
+        "BENCH_PLATFORM": "cpu",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": xla_flags,
+    })
 
 
 def _run_cal():
@@ -434,6 +457,10 @@ def main():
         import bench_deepfm
 
         line = bench_deepfm.run()
+    elif model == "dispatch_sharded":
+        import bench_dispatch
+
+        line = bench_dispatch.run_sharded()
     elif model == "cal":
         line = _run_cal()
     else:

@@ -139,12 +139,16 @@ def emit_result(result, argv=None):
     return result
 
 
-def prefetch_feeds(stacked, fresh, chunk, device, size=2):
+def prefetch_feeds(stacked, fresh, chunk, device, size=2, compiled=None):
     """Device-prefetch variant of ``stage_feeds``: instead of pinning one
     staged feed in HBM forever, a background thread ``jax.device_put``s
     chunk feeds ahead of the consumer (reader.device_buffered), so the
     bench exercises the real input-pipeline regime — h2d of chunk N+1
     overlaps device compute of chunk N, and run() sees jax Arrays.
+
+    ``compiled``: a CompiledProgram upgrades the staging to SHARDED
+    prefetch — each mesh replica's batch slice lands in its own HBM
+    (run the bench with ``exe.run(compiled, ...)`` to match).
 
     Returns (chunk_iter, close, feed1, run_kw): pull ``next(chunk_iter)``
     per ``exe.run(**run_kw)`` call and ``close()`` when done (stops the
@@ -154,13 +158,27 @@ def prefetch_feeds(stacked, fresh, chunk, device, size=2):
 
     from paddle_tpu import reader as _reader
 
-    host = {k: (v if fresh else v[0]) for k, v in stacked.items()}
+    if compiled is not None and fresh:
+        # sharded per_step_feed chunks: feed the per-step batches through
+        # device_buffered(steps=chunk) so the reader owns the stacking —
+        # the leading steps axis must stay REPLICATED while the batch
+        # axis shards (pre-stacked arrays would shard the wrong axis)
+        def stream():
+            while True:  # open-ended; the consumer closes us
+                for i in range(chunk):
+                    yield {k: v[i] for k, v in stacked.items()}
 
-    def stream():
-        while True:  # open-ended; the consumer closes us
-            yield host
+        gen = _reader.device_buffered(
+            stream, size=size, steps=chunk, compiled=compiled)()
+    else:
+        host = {k: (v if fresh else v[0]) for k, v in stacked.items()}
 
-    gen = _reader.device_buffered(stream, size=size, device=device)()
+        def stream():
+            while True:  # open-ended; the consumer closes us
+                yield host
+
+        gen = _reader.device_buffered(
+            stream, size=size, device=device, compiled=compiled)()
     feed1 = {k: jax.device_put(v[0], device) for k, v in stacked.items()}
     run_kw = dict(return_numpy=False, steps=chunk, per_step_feed=fresh)
     return iter(gen), gen.close, feed1, run_kw

@@ -22,6 +22,10 @@ PEAK_FLOPS = {"tpu": 197e12, "cpu": 1e12}
 NUM_FEATURES = int(os.environ.get("BENCH_DEEPFM_FEATURES", "1000000"))
 FIELDS = 39
 EMBED = 16
+# BENCH_DEEPFM_MESH=N: run data-parallel over N local devices with the
+# SHARDED device-prefetch pipeline (reader stages each replica's batch
+# slice straight into its own HBM).  0/unset = single device.
+MESH_DEVICES = int(os.environ.get("BENCH_DEEPFM_MESH", "0"))
 
 
 def run(batch=BATCH, steps=STEPS, chunk=CHUNK):
@@ -61,6 +65,17 @@ def run(batch=BATCH, steps=STEPS, chunk=CHUNK):
     valsv = rng.rand(n_b, batch, FIELDS).astype(np.float32)
     lblv = rng.randint(0, 2, (n_b, batch, 1)).astype(np.int32)
 
+    # BENCH_DEEPFM_MESH=N: data-parallel CompiledProgram; the prefetcher
+    # then stages each replica's slice per shard (the scale-out regime)
+    run_target = prog
+    compiled = None
+    if MESH_DEVICES > 1:
+        from paddle_tpu.parallel.compiled_program import CompiledProgram
+        from paddle_tpu.parallel import mesh as mesh_lib
+
+        mesh = mesh_lib.data_parallel_mesh(MESH_DEVICES)
+        run_target = compiled = CompiledProgram(prog).with_mesh(mesh)
+
     scope = fluid.Scope()
     exe = fluid.Executor(place)
     dev = jax.devices()[0]
@@ -72,12 +87,12 @@ def run(batch=BATCH, steps=STEPS, chunk=CHUNK):
         # consumer, so h2d of chunk N+1 overlaps compute of chunk N and
         # run() pays only the cached-dispatch rent
         chunks, close_chunks, feed1, run_kw = bench_common.prefetch_feeds(
-            stacked, fresh, chunk, dev)
+            stacked, fresh, chunk, dev, compiled=compiled)
         try:
             for _ in range(2):
-                (l,) = exe.run(prog, feed=feed1, fetch_list=[avg_loss], return_numpy=False)
+                (l,) = exe.run(run_target, feed=feed1, fetch_list=[avg_loss], return_numpy=False)
                 np.asarray(l)
-            (l,) = exe.run(prog, feed=next(chunks), fetch_list=[avg_loss], **run_kw)
+            (l,) = exe.run(run_target, feed=next(chunks), fetch_list=[avg_loss], **run_kw)
             np.asarray(l)
             # post-warmup the jit cache must never miss — a recompile in
             # the timed loop would fold XLA compile time into examples/sec
@@ -85,7 +100,7 @@ def run(batch=BATCH, steps=STEPS, chunk=CHUNK):
             done = 0
             t0 = time.perf_counter()
             while done < steps:
-                (l,) = exe.run(prog, feed=next(chunks), fetch_list=[avg_loss], **run_kw)
+                (l,) = exe.run(run_target, feed=next(chunks), fetch_list=[avg_loss], **run_kw)
                 done += chunk
                 lv = np.asarray(l)
             dt = time.perf_counter() - t0
@@ -116,6 +131,7 @@ def run(batch=BATCH, steps=STEPS, chunk=CHUNK):
         "per_step_feed": fresh,
         "chunk": chunk,
         "device_prefetch": True,
+        "mesh_devices": MESH_DEVICES,
         "recompiles_after_warmup": int(recompiles),
         "platform": platform,
         "loss": float(lv),

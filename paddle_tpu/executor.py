@@ -54,6 +54,8 @@ _exec_stats: List[Dict[str, int]] = []  # one _cache_stats dict per LIVE Executo
 _exec_retired = {
     "hits": 0, "misses": 0, "runs": 0,
     "plan_hits": 0, "plan_misses": 0, "dispatch_overhead_s": 0.0,
+    "plan_evictions": 0, "jit_evictions": 0,
+    "ps_pull_overlap_s": 0.0, "ps_pull_wait_s": 0.0,
 }  # folded-in dead executors
 
 
@@ -98,6 +100,24 @@ _mon_registry.REGISTRY.counter_callback(
     "executor_dispatch_overhead_seconds_total",
     "host-side run() seconds spent before the jitted dispatch",
     fn=lambda: _sum_exec_stats("dispatch_overhead_s"))
+_mon_registry.REGISTRY.counter_callback(
+    "executor_plan_cache_evictions_total",
+    "run plans evicted by the LRU capacity bound",
+    fn=lambda: _sum_exec_stats("plan_evictions"))
+_mon_registry.REGISTRY.counter_callback(
+    "executor_jit_cache_evictions_total",
+    "compiled jit entries evicted by the LRU capacity bound",
+    fn=lambda: _sum_exec_stats("jit_evictions"))
+_mon_registry.REGISTRY.counter_callback(
+    "executor_ps_pull_overlap_seconds_total",
+    "dense-PS pull seconds hidden behind device compute (overlapped "
+    "pull thread; train_from_dataset async mode)",
+    fn=lambda: _sum_exec_stats("ps_pull_overlap_s"))
+_mon_registry.REGISTRY.counter_callback(
+    "executor_ps_pull_wait_seconds_total",
+    "seconds run() blocked joining the overlapped dense-PS pull (the "
+    "NOT-hidden remainder of the pull latency)",
+    fn=lambda: _sum_exec_stats("ps_pull_wait_s"))
 # per-run distribution, observed only while a trace session is active —
 # a histogram observe is a lock + bucket scan (~2us), real money on a
 # hot path whose whole budget is "almost nothing"; the always-on totals
@@ -162,13 +182,77 @@ class _RunPlan:
         self.feed_jax_dtypes = feed_jax_dtypes
 
 
+class _LRUCache:
+    """Bounded mapping with least-recently-used eviction.
+
+    Long-lived multi-program processes (the serving server, a notebook
+    driving many programs through one executor) must not grow the plan
+    and jit caches without bound: a jit entry pins a compiled XLA
+    executable plus its HBM constants.  Capacity defaults are generous
+    (steady-state workloads never evict); ``on_evict`` feeds the
+    ``executor_*_cache_evictions_total`` counters so an eviction storm
+    — a capacity set too small for the program population — is visible
+    on /metrics rather than silently recompiling every run."""
+
+    __slots__ = ("_data", "capacity", "_on_evict")
+
+    def __init__(self, capacity: int, on_evict=None):
+        from collections import OrderedDict
+
+        self._data: "OrderedDict" = OrderedDict()
+        self.capacity = max(1, int(capacity))
+        self._on_evict = on_evict
+
+    def get(self, key, default=None):
+        try:
+            self._data.move_to_end(key)
+        except KeyError:
+            return default
+        return self._data[key]
+
+    def __setitem__(self, key, value):
+        data = self._data
+        data[key] = value
+        data.move_to_end(key)
+        while len(data) > self.capacity:
+            data.popitem(last=False)
+            if self._on_evict is not None:
+                self._on_evict()
+
+    def __contains__(self, key):
+        return key in self._data
+
+    def __len__(self):
+        return len(self._data)
+
+    def clear(self):
+        self._data.clear()
+
+
+# default cache bounds (env-overridable; constructor kwargs win).  Sized
+# so ordinary workloads — even a serving process hosting dozens of
+# endpoints x bucket rungs — never evict; the bound exists for the
+# pathological long-lived case (programs built in a loop forever).
+_PLAN_CACHE_CAPACITY = int(os.environ.get(
+    "PADDLE_TPU_PLAN_CACHE_CAPACITY", "1024"))
+_JIT_CACHE_CAPACITY = int(os.environ.get(
+    "PADDLE_TPU_JIT_CACHE_CAPACITY", "512"))
+
+
 class Executor:
-    def __init__(self, place=None):
+    def __init__(self, place=None, plan_cache_capacity: Optional[int] = None,
+                 jit_cache_capacity: Optional[int] = None):
         # place=None means "process default device" (jax.devices()[0]) —
         # an explicit TPUPlace/CPUPlace is honored strictly (_device).
         self.place = place if place is not None else framework._DefaultPlace()
-        self._cache: Dict[tuple, Any] = {}
-        self._plans: Dict[tuple, _RunPlan] = {}
+        self._cache = _LRUCache(
+            jit_cache_capacity if jit_cache_capacity is not None
+            else _JIT_CACHE_CAPACITY,
+            on_evict=lambda: self._bump("jit_evictions"))
+        self._plans = _LRUCache(
+            plan_cache_capacity if plan_cache_capacity is not None
+            else _PLAN_CACHE_CAPACITY,
+            on_evict=lambda: self._bump("plan_evictions"))
         self._dev = None  # resolved jax device (place is immutable)
         # jit-cache accounting (serving reads this): a miss means a NEW
         # jax.jit entry was built for a novel (program, feed-signature,
@@ -181,10 +265,15 @@ class Executor:
         self._cache_stats = {
             "hits": 0, "misses": 0, "runs": 0,
             "plan_hits": 0, "plan_misses": 0, "dispatch_overhead_s": 0.0,
+            "plan_evictions": 0, "jit_evictions": 0,
+            "ps_pull_overlap_s": 0.0, "ps_pull_wait_s": 0.0,
         }
         with _exec_stats_lock:
             _exec_stats.append(self._cache_stats)
         _weakref.finalize(self, _retire_exec_stats, self._cache_stats)
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        self._cache_stats[key] += n
 
     # ------------------------------------------------------------------
     def _device(self):
@@ -283,6 +372,10 @@ class Executor:
                     "send / param recv is host-side per batch)"
                 )
             self._dense_ps_init(dense_ps, scope)
+            # overlapped mode: install the params the background thread
+            # pulled while the PREVIOUS step's device compute ran (must
+            # land before this run's state gather)
+            self._dense_ps_join_pending(dense_ps, scope)
 
         if getattr(program, "_pruned_params", None):
             # a writer appended after prune() would resurrect pruned
@@ -348,6 +441,9 @@ class Executor:
         state_mut, state_ro = plan.state_mut, plan.state_ro
         n_dense_fetch = plan.n_dense_fetch
 
+        # hot-path: begin dispatch (plan hit -> feed coercion -> jitted call;
+        # no blocking device sync may appear in this region — enforced by
+        # tools/check_hot_path.py)
         # materialize feed on the target device; values that are already
         # jax Arrays (e.g. a device-resident input pipeline, reader.py)
         # pass through untouched — no host round-trip.  Dtype coercion
@@ -367,7 +463,7 @@ class Executor:
                     val = val.astype(want)
                 feed_arrays[name] = val
                 continue
-            arr = np.asarray(val, dtype=np_dts.get(name))
+            arr = np.asarray(val, dtype=np_dts.get(name))  # hot-ok: host ndarray feed, not a device array
             feed_arrays[name] = jax.device_put(arr, device)
         if _rec:
             _mon_spans.record_span(
@@ -469,9 +565,20 @@ class Executor:
                 self._cache[key] = entry
 
         if compiled is not None:
-            feed_arrays, mut_state, ro_state = compiled._shard_inputs(
-                feed_arrays, mut_state, ro_state, per_step_feed=per_step_feed
+            # the steady token is scoped to THIS executor (uid, not
+            # id() — CPython reuses ids after GC): two executors sharing
+            # a CompiledProgram have independent scopes, so one reaching
+            # steady state must not let the other skip placement
+            feed_arrays, mut_state, ro_state, restaged = compiled._shard_inputs(
+                feed_arrays, mut_state, ro_state, per_step_feed=per_step_feed,
+                steady_token=(framework._program_uid(self), key),
             )
+            for n, v in restaged.items():
+                # keep the resharded copy: a read-only param must be
+                # replicated onto the mesh ONCE, not per step (state_mut
+                # self-heals via out_shardings-pinned outputs, but ro
+                # state is never written back by the jitted call)
+                scope.set(n, v)
         # everything above is the host's per-dispatch rent; on a plan +
         # jit cache hit it must stay "almost nothing" (the new
         # bench_dispatch.py pins it)
@@ -481,6 +588,8 @@ class Executor:
             _MON_DISPATCH_HIST.observe(_overhead)
             _t0 = time.perf_counter()
         fetches, new_state = entry(mut_state, ro_state, feed_arrays)
+        # hot-path: end dispatch (the jitted call is async; everything
+        # below is allowed to sync)
         if _rec:
             # the first dispatch of a novel cache key is where XLA
             # compiles (jax.jit is lazy) — label it as the compile phase;
@@ -501,6 +610,18 @@ class Executor:
             # would deadlock this trainer against itself
             client = self._dense_ps_client(dense_ps)
             names = list(dense_ps["params"])
+            # overlapped pull (async mode, train_from_dataset): kick the
+            # NEXT step's param pull off on a background thread NOW,
+            # while this step's device compute is still in flight (the
+            # np.asarray(grad) below is the d2h sync point) — the pull
+            # latency hides behind the chip instead of serializing after
+            # it.  Hogwild semantics: the pulled copy misses this step's
+            # own push (bounded staleness 1), which async mode already
+            # tolerates by construction.  Sync mode keeps the strict
+            # push-all-then-pull-at-version ordering below.
+            overlap = bool(dense_ps.get("overlap_pull")) and not dense_ps["sync"]
+            if overlap:
+                self._dense_ps_spawn_pull(dense_ps, names)
             grads = fetches[len(fetches) - n_dense_fetch:]
             fetches = fetches[: len(fetches) - n_dense_fetch]
             for name, grad in zip(names, grads):
@@ -509,9 +630,10 @@ class Executor:
                 lr = float(np.asarray(lr_val)) if lr_val is not None else 0.1
                 client.push_dense(name, np.asarray(grad), lr)
             dense_ps["step"] += 1
-            min_v = dense_ps["step"] if dense_ps["sync"] else 0
-            for name in names:
-                scope.set(name, client.pull_dense(name, min_version=min_v))
+            if not overlap:
+                min_v = dense_ps["step"] if dense_ps["sync"] else 0
+                for name in names:
+                    scope.set(name, client.pull_dense(name, min_version=min_v))
         if ps_push:
             # async mode: enqueue on the Communicator (merge-before-send
             # background thread); sync mode: blocking push
@@ -628,6 +750,62 @@ class Executor:
 
             client = ctx["_client"] = PSClient(ctx["endpoints"])
         return client
+
+    def _dense_ps_pull_client(self, ctx):
+        # the overlapped pull runs on its own thread CONCURRENTLY with
+        # the main thread's push — PSClient sockets are not thread-safe
+        # (interleaved frames corrupt the wire), so the pull thread gets
+        # a dedicated client over the same endpoints
+        client = ctx.get("_pull_client")
+        if client is None:
+            from paddle_tpu.distributed.ps import PSClient
+
+            client = ctx["_pull_client"] = PSClient(ctx["endpoints"])
+        return client
+
+    def _dense_ps_spawn_pull(self, ctx, names) -> None:
+        """Start the next step's param pull on a background thread (one
+        in flight at a time — run() joins the previous before spawning)."""
+        import threading
+
+        client = self._dense_ps_pull_client(ctx)
+        result: Dict[str, Any] = {}
+
+        def _pull():
+            t0 = time.perf_counter()
+            try:
+                result["vals"] = {
+                    n: client.pull_dense(n, min_version=0) for n in names
+                }
+            except BaseException as e:  # noqa: BLE001 — re-raised at join
+                result["exc"] = e
+            finally:
+                result["dur"] = time.perf_counter() - t0
+
+        th = threading.Thread(target=_pull, name="ptpu-ps-pull", daemon=True)
+        ctx["_pull_pending"] = (th, result)
+        th.start()
+
+    def _dense_ps_join_pending(self, ctx, scope) -> None:
+        """Join the in-flight overlapped pull (if any) and install the
+        pulled params.  ``ps_pull_overlap_s`` accumulates the pull
+        seconds that hid behind device compute; ``ps_pull_wait_s`` the
+        remainder this join actually blocked for."""
+        pending = ctx.pop("_pull_pending", None)
+        if pending is None:
+            return
+        th, result = pending
+        t0 = time.perf_counter()
+        th.join()
+        wait = time.perf_counter() - t0
+        stats = self._cache_stats
+        stats["ps_pull_wait_s"] += wait
+        stats["ps_pull_overlap_s"] += max(0.0, result.get("dur", 0.0) - wait)
+        exc = result.get("exc")
+        if exc is not None:
+            raise exc
+        for n, v in result["vals"].items():
+            scope.set(n, v)
 
     def _dense_ps_init(self, ctx, scope):
         """First-run handshake: create the server-side entries, trainer 0
@@ -836,23 +1014,45 @@ class Executor:
             fetch_info = fetch_info or trainer_desc._fetch_info
             print_period = trainer_desc._print_period
             n_prefetch = n_prefetch or int(getattr(trainer_desc, "thread_num", 0))
+        compiled = (
+            program if program is not None
+            and getattr(program, "_is_compiled_program", False) else None)
+        prog_obj = compiled._program if compiled is not None else (
+            program if program is not None else framework.default_main_program())
         batches = iter(dataset)
         if n_prefetch > 1:
             # the reference's reader threads feeding device workers
             # (trainer.h thread_num): a bounded background prefetcher
             # stages batches ON DEVICE ahead of the compiled step
             # (reader.device_buffered), so the run() h2d phase is a
-            # passthrough.  The prefetcher shuts its producer down when
-            # the consumer exits early (exception or break) — the old
-            # inline queue left the thread blocked on q.put forever.
+            # passthrough.  A CompiledProgram upgrades this to SHARDED
+            # prefetch: each replica's batch slice is device_put straight
+            # into its own HBM, and run()'s _shard_inputs passes the
+            # pre-placed arrays through.  The prefetcher shuts its
+            # producer down when the consumer exits early (exception or
+            # break) — the old inline queue left the thread blocked on
+            # q.put forever.
             from paddle_tpu import reader as _reader
 
-            try:
-                device = self._device_cached()
-            except Exception:
-                device = None  # no jax backend: prefetch host-side only
-            batches = _reader.device_buffered(
-                batches, size=n_prefetch, device=device)()
+            if compiled is not None:
+                batches = _reader.device_buffered(
+                    batches, size=n_prefetch, compiled=compiled)()
+            else:
+                try:
+                    device = self._device_cached()
+                except Exception:
+                    device = None  # no jax backend: prefetch host-side only
+                batches = _reader.device_buffered(
+                    batches, size=n_prefetch, device=device)()
+        # dense-PS async mode: overlap each step's host param pull with
+        # the device compute (the pull thread runs while the chip works;
+        # ps_pull_overlap_s counts the hidden seconds).  Sync mode keeps
+        # the strict barrier ordering, so the flag only arms async runs.
+        ps_ctx = getattr(prog_obj, "_dense_ps_ctx", None)
+        overlap_prev = None
+        if ps_ctx is not None and not ps_ctx.get("sync", True):
+            overlap_prev = ps_ctx.get("overlap_pull")
+            ps_ctx["overlap_pull"] = True
         results = []
         try:
             for i, feed in enumerate(batches):
@@ -866,6 +1066,16 @@ class Executor:
             closer = getattr(batches, "close", None)
             if closer is not None:
                 closer()  # stop the prefetch producer (GeneratorExit path)
+            if ps_ctx is not None:
+                # drain the in-flight pull so the scope leaves with the
+                # freshest params and no dangling thread
+                try:
+                    self._dense_ps_join_pending(ps_ctx, scope or global_scope())
+                finally:
+                    if overlap_prev is None:
+                        ps_ctx.pop("overlap_pull", None)
+                    else:
+                        ps_ctx["overlap_pull"] = overlap_prev
         return results
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
@@ -892,10 +1102,14 @@ class Executor:
             "entries": len(self._cache),
             "hits": self._cache_stats["hits"],
             "misses": self._cache_stats["misses"],
+            "jit_evictions": self._cache_stats["jit_evictions"],
             "plan_entries": len(self._plans),
             "plan_hits": self._cache_stats["plan_hits"],
             "plan_misses": self._cache_stats["plan_misses"],
+            "plan_evictions": self._cache_stats["plan_evictions"],
             "dispatch_overhead_s": self._cache_stats["dispatch_overhead_s"],
+            "ps_pull_overlap_s": self._cache_stats["ps_pull_overlap_s"],
+            "ps_pull_wait_s": self._cache_stats["ps_pull_wait_s"],
         }
 
     # ------------------------------------------------------------------

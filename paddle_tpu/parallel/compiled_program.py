@@ -40,6 +40,18 @@ class CompiledProgram:
         self._build_strategy: Optional[BuildStrategy] = None
         self._exec_strategy: Optional[ExecutionStrategy] = None
         self._loss_name: Optional[str] = None
+        # resolved-sharding memos: NamedSharding construction walks the
+        # mesh, so the per-run _shard_inputs pass must not rebuild one
+        # per array per step (O(n_params) rent on the dispatch hot path)
+        self._sharding_memo: Dict[Any, Any] = {}
+        self._state_sh_memo: Dict[str, Any] = {}
+        self._feed_sh_memo: Dict[tuple, Any] = {}
+        # jit keys whose state reached the self-feeding steady state (a
+        # full placement pass with zero re-stages): state checks are
+        # skipped for them — outputs are out_shardings-pinned and flow
+        # back through the scope, so per-step state placement work drops
+        # to zero (see _shard_inputs)
+        self._steady_tokens: set = set()
 
     # ------------------------------------------------------------------
     def with_data_parallel(
@@ -56,6 +68,7 @@ class CompiledProgram:
         self._exec_strategy = exec_strategy or ExecutionStrategy()
         n = len(places) if places else None
         self._mesh = mesh_lib.data_parallel_mesh(n)
+        self._clear_sharding_memos()
         return self
 
     def with_strategy(self, strategy: DistributedStrategy, mesh=None) -> "CompiledProgram":
@@ -67,11 +80,30 @@ class CompiledProgram:
             self._mesh = mesh_lib.make_mesh(strategy.mesh_axes)
         else:
             self._mesh = mesh_lib.default_mesh()
+        self._clear_sharding_memos()
         return self
 
     def with_mesh(self, mesh) -> "CompiledProgram":
         self._mesh = mesh
+        self._clear_sharding_memos()
         return self
+
+    def _clear_sharding_memos(self) -> None:
+        self._sharding_memo.clear()
+        self._state_sh_memo.clear()
+        self._feed_sh_memo.clear()
+        # a re-bound mesh invalidates every steady-state conclusion: a
+        # stale token would skip state placement against the OLD layout
+        self._steady_tokens.clear()
+        # ...and every compiled executable: the executor's plan/jit keys
+        # carry this wrapper's uid, so stamping a FRESH uid orphans the
+        # entries jitted with the old mesh's in/out shardings (they age
+        # out of the LRU) instead of silently serving the old layout
+        if getattr(self, "_ptpu_uid", None) is not None:
+            from paddle_tpu import framework
+
+            self._ptpu_uid = None
+            framework._program_uid(self)
 
     # ------------------------------------------------------------------
     @property
@@ -101,51 +133,125 @@ class CompiledProgram:
     def _sharding(self, spec):
         from jax.sharding import NamedSharding
 
-        return NamedSharding(self.mesh, spec)
+        sh = self._sharding_memo.get(spec)
+        if sh is None:
+            sh = self._sharding_memo[spec] = NamedSharding(self.mesh, spec)
+        return sh
+
+    # ------------------------------------------------------------------
+    # Sharding resolution (memoized per name — the reader's sharded
+    # prefetcher and the executor's per-run _shard_inputs both resolve
+    # through here, so a steady-state step pays dict lookups only)
+    # ------------------------------------------------------------------
+    def state_sharding(self, name: str):
+        sh = self._state_sh_memo.get(name)
+        if sh is None:
+            sh = self._state_sh_memo[name] = self._sharding(
+                self._spec_for_state(name))
+        return sh
+
+    def feed_sharding(self, name: Optional[str], ndim: int,
+                      steps_axis: bool = False):
+        """NamedSharding for feed ``name`` with array rank ``ndim``.
+        ``steps_axis=True`` treats the leading axis as a replicated
+        per_step_feed ``steps`` axis and shifts the batch sharding one
+        axis right (reader.device_buffered chunk assembly)."""
+        from jax.sharding import PartitionSpec as P
+
+        key = (name, int(ndim), bool(steps_axis))
+        sh = self._feed_sh_memo.get(key)
+        if sh is None:
+            if steps_axis:
+                spec = P(None, *self._spec_for_feed(name, ndim - 1))
+            else:
+                spec = self._spec_for_feed(name, ndim)
+            sh = self._feed_sh_memo[key] = self._sharding(spec)
+        return sh
 
     # ------------------------------------------------------------------
     # Executor integration
     # ------------------------------------------------------------------
     def _jit_kwargs(self, block, feed_names, fetch_names, state_mut, state_ro,
                     state_out, per_step_feed=False):
-        from jax.sharding import PartitionSpec as P
-
-        mut_sh = {n: self._sharding(self._spec_for_state(n)) for n in state_mut}
-        ro_sh = {n: self._sharding(self._spec_for_state(n)) for n in state_ro}
+        mut_sh = {n: self.state_sharding(n) for n in state_mut}
+        ro_sh = {n: self.state_sharding(n) for n in state_ro}
 
         feed_sh = {}
         for n in feed_names:
             var = block._find_var_recursive(n)
             ndim = len(var.shape) if var is not None and var.shape is not None else 1
-            spec = self._spec_for_feed(n, ndim)
-            if per_step_feed:
-                # Executor.run(steps=N, per_step_feed=True) stacks a
-                # leading steps axis on every feed; keep it replicated and
-                # shift the batch/seq sharding one axis right
-                spec = P(None, *spec)
-            feed_sh[n] = self._sharding(spec)
-        return {"in_shardings": (mut_sh, ro_sh, feed_sh)}
+            # Executor.run(steps=N, per_step_feed=True) stacks a leading
+            # steps axis on every feed; keep it replicated and shift the
+            # batch/seq sharding one axis right (steps_axis)
+            feed_sh[n] = self.feed_sharding(
+                n, ndim + 1 if per_step_feed else ndim,
+                steps_axis=per_step_feed)
+        # pin state OUTPUT layouts to the state input shardings (None =
+        # compiler-chosen for the fetches subtree): the next step's
+        # _shard_inputs then recognizes every fed-back state array by
+        # identity and passes it through — without this the compiler may
+        # pick a different output layout and force a device_put per
+        # state array per step (O(n_params) hot-path rent)
+        out_sh = {n: self.state_sharding(n) for n in state_out}
+        return {"in_shardings": (mut_sh, ro_sh, feed_sh),
+                "out_shardings": (None, out_sh)}
 
-    def _shard_inputs(self, feed_arrays, mut_state, ro_state, per_step_feed=False):
+    # hot-path: begin shard_inputs (per-dispatch placement pass)
+    def _shard_inputs(self, feed_arrays, mut_state, ro_state,
+                      per_step_feed=False, steady_token=None):
+        """Place feeds/state for the mesh.  Returns (feeds, mut, ro,
+        restaged) where ``restaged`` holds the STATE arrays that had to
+        be re-placed — the executor writes those back to the scope so a
+        read-only param is resharded once, not per step.
+
+        The placement check is inlined and ordered cheapest-first: a
+        prefetcher-staged feed hits ``cur is sh`` (same memoized
+        sharding object).  State goes one step further: once a full
+        pass re-stages NOTHING under a ``steady_token`` (the executor's
+        jit key), that token is marked steady and state checks are
+        SKIPPED entirely — outputs are out_shardings-pinned, so the
+        state the scope feeds back is correctly placed by construction.
+        A scope var replaced behind our back surfaces as a loud pjit
+        device-mismatch error, not silent corruption."""
         import jax
-        from jax.sharding import PartitionSpec as P
+        from jax.sharding import NamedSharding
 
-        def put(arrs, spec_fn):
+        device_put = jax.device_put
+        feed_sharding = self.feed_sharding
+        state_sharding = self.state_sharding
+        restaged: Dict[str, Any] = {}
+
+        def put(arrs, sh_of, track=False):
             out = {}
             for n, a in arrs.items():
-                sh = self._sharding(spec_fn(n, np.ndim(a)))
-                out[n] = jax.device_put(a, sh)
+                sh = sh_of(n, a)
+                cur = getattr(a, "sharding", None)
+                if cur is not None and (
+                        cur is sh
+                        or (type(cur) is NamedSharding
+                            and cur.mesh is sh.mesh and cur.spec == sh.spec)):
+                    out[n] = a
+                else:
+                    out[n] = device_put(a, sh)
+                    if track:
+                        restaged[n] = out[n]
             return out
 
-        def feed_spec(n, d):
-            if per_step_feed:
-                return P(None, *self._spec_for_feed(n, d - 1))
-            return self._spec_for_feed(n, d)
-
-        feed_arrays = put(feed_arrays, feed_spec)
-        mut_state = put(mut_state, lambda n, d: self._spec_for_state(n))
-        ro_state = put(ro_state, lambda n, d: self._spec_for_state(n))
-        return feed_arrays, mut_state, ro_state
+        if per_step_feed:
+            feed_sh = lambda n, a: feed_sharding(  # noqa: E731
+                n, np.ndim(a), steps_axis=True)
+        else:
+            feed_sh = lambda n, a: feed_sharding(n, np.ndim(a))  # noqa: E731
+        feed_out = put(feed_arrays, feed_sh)
+        if steady_token is not None and steady_token in self._steady_tokens:
+            return feed_out, mut_state, ro_state, restaged
+        state_sh = lambda n, a: state_sharding(n)  # noqa: E731
+        mut_out = put(mut_state, state_sh, track=True)
+        ro_out = put(ro_state, state_sh, track=True)
+        if steady_token is not None and not restaged:
+            self._steady_tokens.add(steady_token)
+        return feed_out, mut_out, ro_out, restaged
+    # hot-path: end shard_inputs
 
     # parity helpers --------------------------------------------------
     def _compile_data_parallel(self, *a, **k):  # reference: compiler.py:241

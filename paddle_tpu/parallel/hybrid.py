@@ -343,10 +343,18 @@ def make_train_step(cfg: HybridConfig, mesh=None, optimizer=None):
 
     def lift_all(x):
         """pvary x over every mesh axis it isn't already varying on, so
-        downstream vma state is uniform regardless of axis sizes."""
-        vma = jax.typeof(x).vma
+        downstream vma state is uniform regardless of axis sizes.  On
+        jax releases predating the vma tracking (no jax.typeof /
+        lax.pvary) there is no varying-axis state to normalize — the
+        rep checker there is the coarser check_rep — so this is a
+        no-op."""
+        typeof = getattr(jax, "typeof", None)
+        pvary = getattr(jax.lax, "pvary", None)
+        if typeof is None or pvary is None:
+            return x
+        vma = typeof(x).vma
         missing = tuple(a for a in ALL_AXES if a not in vma)
-        return jax.lax.pvary(x, missing) if missing else x
+        return pvary(x, missing) if missing else x
 
     # ---------------- per-stage block (runs under shard_map) -------------
     def stage_fn(sp_idx, tp_idx, ep_idx, stage_params, x):
@@ -499,7 +507,7 @@ def make_train_step(cfg: HybridConfig, mesh=None, optimizer=None):
         {n: aux_spec_of[n] for n in aux_spec_of},
     )
 
-    smapped = jax.shard_map(
+    smapped = mesh_lib.shard_map(
         sharded_step,
         mesh=mesh,
         in_specs=in_specs,

@@ -22,7 +22,32 @@ from typing import Dict, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["make_mesh", "default_mesh", "data_parallel_mesh", "MeshGuard", "local_devices"]
+__all__ = ["make_mesh", "default_mesh", "data_parallel_mesh", "MeshGuard",
+           "local_devices", "shard_map"]
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` across jax versions: new jax exposes it at top
+    level with ``check_vma``; older releases only ship
+    ``jax.experimental.shard_map`` whose analogous knob is
+    ``check_rep``.  On those pre-vma releases the check is forced OFF:
+    without ``lax.pvary`` there is no way to annotate intentional
+    replication, so ``check_rep=True`` rejects valid programs the new
+    checker accepts (it is a static debugging aid, not semantics)."""
+    import jax
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        try:
+            return fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=check_vma)
+        except TypeError:  # top-level alias predating the check_vma rename
+            return fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=False)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
 
 _current_mesh = None
 

@@ -139,6 +139,7 @@ class _Prefetcher:
     def __iter__(self):
         return self
 
+    # hot-path: begin prefetch_next (consumer pop — batch must already be in HBM)
     def __next__(self):
         if self._finished:
             raise StopIteration
@@ -156,6 +157,7 @@ class _Prefetcher:
                 raise self._exc
             raise StopIteration
         return item
+    # hot-path: end prefetch_next
 
     def close(self) -> None:
         """Stop the producer and release its thread.  Idempotent; safe
@@ -247,8 +249,73 @@ def _tree_device_put(item, device):
     return put(item)
 
 
+class _MeshSharder:
+    """Minimal ``feed_sharding`` provider over a bare ``jax.sharding.Mesh``
+    (no CompiledProgram): every feed shards its batch dim over the
+    mesh's first axis, with a replicated leading ``steps`` axis for
+    per_step_feed chunks."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+        self._memo = {}
+
+    def feed_sharding(self, name, ndim, steps_axis=False):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        key = (int(ndim), bool(steps_axis))
+        sh = self._memo.get(key)
+        if sh is None:
+            batch = self.mesh.axis_names[0]
+            if steps_axis:
+                spec = P(None, batch) if ndim >= 2 else P(None)
+            else:
+                spec = P(batch) if ndim >= 1 else P()
+            sh = self._memo[key] = NamedSharding(self.mesh, spec)
+        return sh
+
+
+def _resolve_sharder(compiled):
+    """Accept a CompiledProgram (or anything exposing ``feed_sharding``)
+    or a bare jax Mesh."""
+    if compiled is None:
+        return None
+    if hasattr(compiled, "feed_sharding"):
+        return compiled
+    if hasattr(compiled, "axis_names") and hasattr(compiled, "devices"):
+        return _MeshSharder(compiled)
+    raise TypeError(
+        "device_buffered(compiled=...) wants a CompiledProgram or a "
+        "jax.sharding.Mesh; got %r" % type(compiled).__name__)
+
+
+def _tree_shard_put(item, sharder, steps_axis: bool, feed_names=None):
+    """Per-shard ``jax.device_put``: each array lands sliced across the
+    mesh (every replica's rows go straight to its own HBM — no
+    gather-then-scatter downstream).  Dict batches shard by key;
+    sequence batches need ``feed_names`` to map positions to feed vars
+    (falling back to batch-dim sharding when unnamed)."""
+    import jax
+
+    def put(name, a):
+        a = np.asarray(a) if not isinstance(a, jax.Array) else a
+        return jax.device_put(
+            a, sharder.feed_sharding(name, np.ndim(a), steps_axis=steps_axis))
+
+    if isinstance(item, dict):
+        return {k: put(k, v) for k, v in item.items()}
+    if isinstance(item, (list, tuple)):
+        names = list(feed_names) if feed_names else [None] * len(item)
+        if len(names) != len(item):
+            raise ValueError(
+                "sharded prefetch: %d feed_names for a %d-array batch"
+                % (len(names), len(item)))
+        return [put(n, v) for n, v in zip(names, item)]
+    return put(None, item)
+
+
 def device_buffered(reader, size: int = 2, device="auto",
-                    steps: Optional[int] = None, drop_last: bool = True):
+                    steps: Optional[int] = None, drop_last: bool = True,
+                    compiled=None, feed_names: Optional[Sequence[str]] = None):
     """Device-side prefetch: a bounded background thread that
     ``jax.device_put``s batches ahead of the consumer, so feeds arrive
     as ``jax.Array``s and ``Executor.run``'s h2d phase is a passthrough
@@ -265,13 +332,26 @@ def device_buffered(reader, size: int = 2, device="auto",
     ``Executor.run(steps=N, per_step_feed=True)``; a ragged tail of
     fewer than N batches is dropped unless ``drop_last=False``.
 
+    ``compiled`` (sharding-aware mode): a CompiledProgram — or a bare
+    ``jax.sharding.Mesh`` — makes the prefetcher stage each batch
+    PER SHARD: every feed is ``device_put`` with its resolved
+    NamedSharding so each replica's slice lands in its own HBM ahead of
+    dispatch, and ``Executor.run`` on that CompiledProgram passes the
+    arrays through untouched (no gather-then-scatter on the hot path).
+    Composes with ``steps=N``: the chunk's leading steps axis stays
+    replicated while the batch axis shards (steps axis x mesh axis).
+    ``feed_names`` maps positional (sequence) batches to feed vars.
+
     Stalls report into the registry reader counters; the producer
     thread shuts down when the consumer exits early (break/exception).
     """
+    sharder = _resolve_sharder(compiled)
 
     def reader_():
         dev = device
-        if dev == "auto":
+        if sharder is not None:
+            dev = None  # sharded staging owns placement
+        elif dev == "auto":
             try:
                 import jax
 
@@ -295,6 +375,10 @@ def device_buffered(reader, size: int = 2, device="auto",
         def stage(item):
             if steps is not None:
                 item = _stack_group(item)
+            if sharder is not None:
+                return _tree_shard_put(
+                    item, sharder, steps_axis=steps is not None,
+                    feed_names=feed_names)
             return _tree_device_put(item, dev)
 
         p = _Prefetcher(source, size, transform=stage,
@@ -547,9 +631,17 @@ class PyReader:
         # double buffer = device-side prefetch: batches are device_put
         # on the producer thread, so by the time the training step asks
         # for batch N+1 it is already in HBM (and the producer shuts
-        # down cleanly if the consumer abandons the epoch)
+        # down cleanly if the consumer abandons the epoch).  A
+        # CompiledProgram/Mesh passed as ``places`` upgrades this to the
+        # sharded mode: each replica's slice is staged in its own HBM.
+        compiled = None
+        try:
+            compiled = _resolve_sharder(self._places)
+        except TypeError:
+            pass  # legacy places list — single-device staging
         src = (
-            device_buffered(self._generator, self._capacity)()
+            device_buffered(self._generator, self._capacity,
+                            compiled=compiled, feed_names=names)()
             if self._use_double_buffer else self._generator()
         )
         for arrays in src:

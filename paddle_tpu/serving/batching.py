@@ -13,9 +13,9 @@ reaches the caller immediately instead of growing an unbounded backlog.
 """
 from __future__ import annotations
 
-import queue
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -24,8 +24,10 @@ from paddle_tpu.serving.errors import DeadlineExceeded, ServerOverloaded
 
 __all__ = ["ServingRequest", "DynamicBatcher"]
 
-# granularity of the shutdown-check poll while blocked on an empty queue
-_IDLE_POLL_S = 0.02
+# safety-net wait bound while parked on the empty-queue condition: every
+# real wakeup is a notify (offer() on arrival, wake() on shutdown), so
+# an idle server sleeps — this only bounds the damage of a lost notify
+_IDLE_WAIT_S = 0.5
 
 
 class ServingRequest:
@@ -79,37 +81,51 @@ class ServingRequest:
 
 
 class DynamicBatcher:
-    """Bounded request queue + the coalescing policy."""
+    """Bounded request queue + the coalescing policy.
+
+    The queue is a deque under one condition variable: submitters
+    ``notify`` on arrival and the (single) consuming worker WAITS on the
+    condition while idle — an idle server sleeps at ~0% CPU instead of
+    polling (the pre-CV version woke 50x/s to re-check a stop flag).
+    ``wake()`` nudges a parked consumer at shutdown."""
 
     def __init__(self, max_batch_size: int, batch_timeout_ms: float,
                  queue_capacity: int):
         self.max_batch_size = int(max_batch_size)
         self.batch_timeout_s = float(batch_timeout_ms) / 1e3
-        self._q: "queue.Queue[ServingRequest]" = queue.Queue(maxsize=queue_capacity)
+        # queue.Queue convention the pre-deque version had: <= 0 means
+        # unbounded, not "shed everything"
+        self._capacity = int(queue_capacity) if int(queue_capacity) > 0 else None
+        self._cv = threading.Condition()
+        self._dq: "deque[ServingRequest]" = deque()
         self._carry: Optional[ServingRequest] = None  # worker-thread only
 
     def qsize(self) -> int:
-        return self._q.qsize() + (1 if self._carry is not None else 0)
+        return len(self._dq) + (1 if self._carry is not None else 0)
 
     # --- submitter side ---
     def offer(self, req: ServingRequest) -> None:
-        try:
-            self._q.put_nowait(req)
-        except queue.Full:
-            raise ServerOverloaded(
-                "request queue full (%d waiting); shedding" % self._q.qsize()
-            ) from None
+        with self._cv:
+            if self._capacity is not None and len(self._dq) >= self._capacity:
+                raise ServerOverloaded(
+                    "request queue full (%d waiting); shedding"
+                    % len(self._dq)) from None
+            self._dq.append(req)
+            self._cv.notify()
+
+    def wake(self) -> None:
+        """Wake a consumer parked on the empty-queue wait (shutdown)."""
+        with self._cv:
+            self._cv.notify_all()
 
     def drain_pending(self) -> List[ServingRequest]:
         """Pop and return every queued-but-unbatched request (shutdown
         without drain: the server fails them with ServerClosed).  Does
         not touch the carry slot — that one is the worker's."""
-        out: List[ServingRequest] = []
-        while True:
-            try:
-                out.append(self._q.get_nowait())
-            except queue.Empty:
-                return out
+        with self._cv:
+            out = list(self._dq)
+            self._dq.clear()
+        return out
 
     # --- worker side (single consumer) ---
     def _take_first(self, stop: threading.Event, on_expired,
@@ -120,15 +136,14 @@ class DynamicBatcher:
                 return first
             on_expired(first)
         while True:
-            try:
-                first = self._q.get_nowait()
-            except queue.Empty:
-                if not block or stop.is_set():
-                    return None  # nothing ready / drained
-                try:
-                    first = self._q.get(timeout=_IDLE_POLL_S)
-                except queue.Empty:
-                    continue
+            with self._cv:
+                while not self._dq:
+                    if not block or stop.is_set():
+                        return None  # nothing ready / drained
+                    # sleeps until offer()/wake() notifies; the timeout
+                    # is only a lost-notify safety net, not a poll
+                    self._cv.wait(timeout=_IDLE_WAIT_S)
+                first = self._dq.popleft()
             if first.expired():
                 on_expired(first)
                 continue
@@ -141,8 +156,7 @@ class DynamicBatcher:
         deadline passed while queued (the server fails + counts it).
 
         ``block=False``: a non-blocking poll — returns None immediately
-        when no live request is ready (the server uses this to finalize
-        an in-flight d2h batch before idling).
+        when no live request is ready.
 
         While draining (``stop`` set) the window is not awaited — only
         already-queued requests coalesce, so shutdown latency is bounded
@@ -154,14 +168,15 @@ class DynamicBatcher:
         rows = first.n_rows
         window_end = time.monotonic() + self.batch_timeout_s
         while rows < self.max_batch_size:
-            wait = window_end - time.monotonic()
-            try:
-                if wait > 0 and not stop.is_set():
-                    req = self._q.get(timeout=wait)
-                else:
-                    req = self._q.get_nowait()
-            except queue.Empty:
-                break
+            with self._cv:
+                if not self._dq:
+                    wait = window_end - time.monotonic()
+                    if wait <= 0 or stop.is_set():
+                        break
+                    self._cv.wait(timeout=wait)
+                    if not self._dq:
+                        continue  # window re-checked at loop top
+                req = self._dq.popleft()
             if req.expired():
                 on_expired(req)
                 continue

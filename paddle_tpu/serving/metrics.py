@@ -43,6 +43,7 @@ _COUNTER_HELP = {
     "batches": "predictor executions",
     "warmup_compiles": "XLA compiles performed by warmup()",
     "recompiles": "jit-cache misses AFTER warmup",
+    "requeued": "batches re-routed off a failed/removed replica",
 }
 _LABELS = ("server", "instance")
 _COUNTERS = {
@@ -100,7 +101,8 @@ class ServingMetrics:
             self._latencies.append(latency_s)
 
     def observe_batch(self, valid: int, bucket: int, run_s: float,
-                      recompiled: bool = False) -> None:
+                      recompiled: bool = False,
+                      replica: str = None) -> None:
         """Record one executed batch and emit its trace event."""
         self._c["batches"].inc()
         if recompiled:
@@ -111,14 +113,17 @@ class ServingMetrics:
             ent = self._occupancy.setdefault(bucket, [0, 0])
             ent[0] += 1
             ent[1] += valid
-        profiler.emit_trace_event({
+        event = {
             "event": "serving.batch",
             "server": self.name,
             "valid": int(valid),
             "bucket": int(bucket),
             "run_ms": round(run_s * 1e3, 3),
             "recompiled": bool(recompiled),
-        })
+        }
+        if replica is not None:
+            event["replica"] = replica
+        profiler.emit_trace_event(event)
 
     # ------------------------------------------------------------------
     def snapshot(self) -> Dict[str, object]:

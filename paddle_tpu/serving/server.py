@@ -1,28 +1,35 @@
 """InferenceServer: a dynamic-batching front end over AnalysisPredictor.
 
 The reference stack ships models to an external serving system
-(Paddle Serving); this repo's TPU-native answer is in-process: a
-single worker thread owns the predictor (the jitted XLA module is the
-"replica"), a bounded queue + DynamicBatcher coalesce concurrent
-requests, and a BucketPolicy pads every batch onto a fixed size ladder
-so the executor's jit cache sees a CLOSED shape set — after
-``warmup()`` pre-compiles each rung, steady-state serving performs
-zero XLA compiles (asserted through Executor.jit_cache_stats, not
-inferred from timing).
+(Paddle Serving); this repo's TPU-native answer is in-process: N
+replica worker threads (one per predictor — typically one per device)
+sit behind ONE bounded queue + DynamicBatcher, a dispatcher routes each
+coalesced batch to the least-loaded live replica (per-replica in-flight
+accounting), and a BucketPolicy pads every batch onto a fixed size
+ladder so each replica's jit cache sees a CLOSED shape set — after
+``warmup()`` pre-compiles each rung on EVERY replica, steady-state
+serving performs zero XLA compiles fleet-wide (asserted through
+Executor.jit_cache_stats, not inferred from timing).
 
-Lifecycle: construct (worker starts) -> warmup() -> submit()/Client
+Replica fleet semantics: a batch whose replica fails is re-routed to a
+live replica (accepted requests never drop with a survivor available);
+a replica that fails repeatedly is retired from routing, and
+``remove_replica()`` drains one gracefully at runtime.
+
+Lifecycle: construct (workers start) -> warmup() -> submit()/Client
 traffic -> stop(drain=True) for a graceful drain.
 
 Observability: metrics live in the process-global registry
 (``paddle_tpu.monitor``); ``start_admin()`` binds a localhost HTTP
 surface exposing ``/metrics`` (Prometheus text exposition of the whole
 registry) and ``/statusz`` (JSON snapshot: this server's metrics incl.
-bucket-ladder occupancy and recompile counts, the predictor's jit-cache
-stats, and the full registry).
+bucket-ladder occupancy, per-replica health, and recompile counts, the
+predictors' jit-cache stats, and the full registry).
 """
 from __future__ import annotations
 
 import json
+import queue
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -32,20 +39,74 @@ import numpy as np
 from paddle_tpu import monitor, profiler
 from paddle_tpu.serving.batching import DynamicBatcher, ServingRequest
 from paddle_tpu.serving.bucketing import BucketPolicy
-from paddle_tpu.serving.errors import DeadlineExceeded, ServerClosed
+from paddle_tpu.serving.errors import (
+    DeadlineExceeded,
+    ServerClosed,
+    ServingError,
+)
 from paddle_tpu.serving.metrics import ServingMetrics
 
 __all__ = ["InferenceServer"]
 
+# dispatched-but-not-finalized batches a replica may hold: one executing
+# (async dispatch, d2h pending) + one queued behind it — the same
+# double-buffer depth the single-worker server ran, now per replica.
+# The batcher queue (NOT replica queues) stays the admission buffer, so
+# shedding and drain semantics are unchanged.
+_MAX_IN_FLIGHT = 2
+
+# consecutive batch failures before a replica is retired from routing
+_REPLICA_FAIL_LIMIT = 3
+
+# safety-net bound for the routing capacity wait (real wakeups are
+# notifies from _release/_retire/stop)
+_ROUTE_WAIT_S = 0.5
+
+
+class _Replica:
+    """One predictor behind the shared batcher: its own worker thread,
+    bounded in-flight accounting, and health state."""
+
+    __slots__ = ("idx", "name", "predictor", "nonblocking", "lock", "q",
+                 "thread", "alive", "in_flight", "executed", "failed",
+                 "consec_failures")
+
+    def __init__(self, idx: int, predictor):
+        self.idx = idx
+        self.name = "r%d" % idx
+        self.predictor = predictor
+        # non-blocking fetch (AnalysisPredictor return_numpy=False) lets
+        # the replica overlap batch N's d2h with batch N+1's dispatch; a
+        # duck-typed predictor without the kwarg runs synchronously
+        import inspect
+
+        try:
+            self.nonblocking = "return_numpy" in inspect.signature(
+                predictor.run_padded).parameters
+        except (TypeError, ValueError):
+            self.nonblocking = False
+        self.lock = threading.Lock()  # warmup vs worker predictor use
+        self.q: "queue.Queue" = queue.Queue()  # (batch, retries) | None
+        self.thread: Optional[threading.Thread] = None
+        self.alive = True
+        self.in_flight = 0  # guarded by the server's _route_cv
+        self.executed = 0
+        self.failed = 0
+        self.consec_failures = 0
+
 
 class InferenceServer:
-    """Wraps a predictor exposing ``run_padded`` / ``jit_cache_stats`` /
-    ``get_input_names`` (AnalysisPredictor) behind a batched, bucketed,
-    deadline-aware submit() API.
+    """Wraps one or more predictors exposing ``run_padded`` /
+    ``jit_cache_stats`` / ``get_input_names`` (AnalysisPredictor) behind
+    a batched, bucketed, deadline-aware submit() API.
+
+    ``predictor``: a single predictor, or a SEQUENCE of predictors —
+    one replica each (e.g. one AnalysisPredictor per device) — behind
+    the same queue with least-loaded routing.
 
     ``input_specs`` (``{name: (per_row_shape, dtype)}``) defaults to the
-    predictor's program-derived specs; pass it explicitly when a feed
-    var has dynamic non-batch dims.
+    first predictor's program-derived specs; pass it explicitly when a
+    feed var has dynamic non-batch dims.
     """
 
     def __init__(
@@ -59,32 +120,35 @@ class InferenceServer:
         name: str = "server",
     ):
         self.name = name
-        self._predictor = predictor
+        predictors = (
+            list(predictor) if isinstance(predictor, (list, tuple))
+            else [predictor])
+        if not predictors:
+            raise ValueError("InferenceServer needs at least one predictor")
+        self._replicas = [_Replica(i, p) for i, p in enumerate(predictors)]
+        self._predictor = predictors[0]  # single-replica compat surface
+        self._nonblocking = self._replicas[0].nonblocking
         self._policy = BucketPolicy(max_batch_size, bucket_ladder)
         self._batcher = DynamicBatcher(
             max_batch_size, batch_timeout_ms, queue_capacity)
         self._metrics = ServingMetrics(name)
-        self._specs = dict(input_specs) if input_specs else predictor.input_specs()
-        self._feed_names = list(predictor.get_input_names())
-        # non-blocking fetch (AnalysisPredictor return_numpy=False) lets
-        # the worker overlap batch N's d2h with batch N+1's dispatch; a
-        # duck-typed predictor without the kwarg just runs synchronously
-        import inspect
-
-        try:
-            self._nonblocking = "return_numpy" in inspect.signature(
-                predictor.run_padded).parameters
-        except (TypeError, ValueError):
-            self._nonblocking = False
+        self._specs = (
+            dict(input_specs) if input_specs else predictors[0].input_specs())
+        self._feed_names = list(predictors[0].get_input_names())
         self._stop = threading.Event()
         self._closed = False           # admission gate (set before _stop on shutdown)
+        self._abort = False            # stop(drain=False): fail instead of route
         self._admin = None             # optional HTTP surface (start_admin)
         self._admin_lock = threading.Lock()
         self._warmed = False
-        self._baseline_misses: Optional[int] = None
-        self._exec_lock = threading.Lock()  # warmup vs worker predictor use
+        self._route_cv = threading.Condition()  # replica in_flight/alive state
+        for rep in self._replicas:
+            rep.thread = threading.Thread(
+                target=self._replica_loop, args=(rep,),
+                name="serving-%s-%s" % (name, rep.name), daemon=True)
+            rep.thread.start()
         self._worker = threading.Thread(
-            target=self._serve_loop, name="serving-%s" % name, daemon=True)
+            target=self._dispatch_loop, name="serving-%s" % name, daemon=True)
         self._worker.start()
 
     # ------------------------------------------------------------------
@@ -96,11 +160,33 @@ class InferenceServer:
     def max_batch_size(self) -> int:
         return self._policy.max_batch_size
 
+    @property
+    def num_replicas(self) -> int:
+        """Live (routable) replica count."""
+        with self._route_cv:
+            return sum(1 for r in self._replicas if r.alive)
+
+    def replica_stats(self) -> Dict[str, Dict[str, object]]:
+        """Per-replica health/throughput snapshot (the in-flight
+        accounting behind least-loaded routing)."""
+        with self._route_cv:
+            return {
+                r.name: {
+                    "alive": r.alive,
+                    "in_flight": r.in_flight,
+                    "executed": r.executed,
+                    "failed": r.failed,
+                    "nonblocking": r.nonblocking,
+                }
+                for r in self._replicas
+            }
+
     def metrics(self) -> Dict[str, object]:
         snap = self._metrics.snapshot()
         snap["queue_depth"] = self._batcher.qsize()
         snap["bucket_ladder"] = self.bucket_ladder
         snap["warmed_up"] = self._warmed
+        snap["replicas"] = self.replica_stats()
         return snap
 
     def metrics_text(self) -> str:
@@ -110,12 +196,16 @@ class InferenceServer:
 
     def statusz(self) -> Dict[str, object]:
         """JSON-serializable status snapshot: this server's metrics
-        (incl. bucket-ladder occupancy histogram and recompile counter),
-        the predictor's jit-cache stats, and the process registry."""
+        (incl. bucket-ladder occupancy histogram, per-replica health,
+        and recompile counter), the predictors' jit-cache stats, and the
+        process registry."""
         return {
             "server": self.name,
             "metrics": self.metrics(),
             "jit_cache": self._predictor.jit_cache_stats(),
+            "replica_jit_cache": {
+                r.name: r.predictor.jit_cache_stats() for r in self._replicas
+            },
             "registry": monitor.snapshot(),
         }
 
@@ -168,13 +258,17 @@ class InferenceServer:
     # ------------------------------------------------------------------
     def warmup(self, cache_dir: Optional[str] = None,
                configure_cache: bool = True) -> int:
-        """Pre-compile every bucket rung; returns the number of XLA
-        compiles the warmup performed.  Routes through jax's persistent
-        compilation cache (bench_common.configure_compile_cache) when the
-        repo-root helper is importable, so a warm disk cache makes repeat
-        server starts cheap; synthetic rows are zeros (always in-range
-        for int id feeds).  After warmup the recompile counter arms:
-        any further jit-cache miss increments ``metrics()['recompiles']``.
+        """Pre-compile every bucket rung on EVERY replica (the
+        zero-recompile guarantee must hold fleet-wide — a cold replica
+        would compile on its first routed batch); returns the total
+        number of XLA compiles the warmup performed.  Routes through
+        jax's persistent compilation cache
+        (bench_common.configure_compile_cache) when the repo-root helper
+        is importable — replica 2..N of an identical model typically
+        loads replica 1's compiles from the disk cache; synthetic rows
+        are zeros (always in-range for int id feeds).  After warmup the
+        recompile counter arms: any further jit-cache miss on any
+        replica increments ``metrics()['recompiles']``.
 
         NOTE ``configure_cache=True`` mutates PROCESS-GLOBAL state (the
         JAX_COMPILATION_CACHE_* env vars + jax.config); pass
@@ -191,18 +285,20 @@ class InferenceServer:
                     cache_dir or bench_common.HOME_CACHE_DIR)
             except (ImportError, AttributeError):
                 pass  # standalone use / foreign bench_common: compile cold
-        misses0 = self._predictor.jit_cache_stats()["misses"]
-        for bucket in self._policy.ladder:
-            feed = {
-                name: np.zeros((bucket,) + tuple(shape), dtype)
-                for name, (shape, dtype) in self._specs.items()
-            }
-            with self._exec_lock:
-                with profiler.RecordEvent("serving/%s/warmup" % self.name):
-                    self._predictor.run_padded(feed, n_valid=bucket)
-        compiles = self._predictor.jit_cache_stats()["misses"] - misses0
+        compiles = 0
+        for rep in self._replicas:
+            misses0 = rep.predictor.jit_cache_stats()["misses"]
+            for bucket in self._policy.ladder:
+                feed = {
+                    name: np.zeros((bucket,) + tuple(shape), dtype)
+                    for name, (shape, dtype) in self._specs.items()
+                }
+                with rep.lock:
+                    with profiler.RecordEvent(
+                            "serving/%s/warmup" % self.name):
+                        rep.predictor.run_padded(feed, n_valid=bucket)
+            compiles += rep.predictor.jit_cache_stats()["misses"] - misses0
         self._metrics.count("warmup_compiles", compiles)
-        self._baseline_misses = self._predictor.jit_cache_stats()["misses"]
         self._warmed = True
         return compiles
 
@@ -230,10 +326,10 @@ class InferenceServer:
             raise
         self._metrics.count("requests")
         # close the submit-vs-stop race: if stop() won between the
-        # admission check above and the offer, the worker may already be
-        # gone — nothing would ever serve this queue, so fail the
-        # stragglers (first completion wins, so a request the worker DID
-        # pick up keeps its real result)
+        # admission check above and the offer, the dispatcher may already
+        # be gone — nothing would ever serve this queue, so fail the
+        # stragglers (first completion wins, so a request the dispatcher
+        # DID pick up keeps its real result)
         if self._stop.is_set() and not self._worker.is_alive():
             self._fail_stragglers()
             if req.done():
@@ -285,37 +381,219 @@ class InferenceServer:
         self._metrics.count("expired")
         req.fail(DeadlineExceeded("deadline passed while queued"))
 
-    def _serve_loop(self) -> None:
-        # one batch of d2h kept in flight: dispatch batch N+1 (async jit
-        # call, return_numpy=False) BEFORE materializing batch N's
-        # outputs, so N's device compute + d2h overlap N+1's host-side
-        # merge/pad/dispatch.  With work in flight the batcher is only
-        # POLLED (block=False): if no live request is ready the pending
-        # batch finalizes immediately — never parked behind an idle (or
-        # all-expired) queue.
+    # ------------------------------------------------------------------
+    # Dispatcher: one thread owns the batcher (single-consumer
+    # coalescing) and routes each batch to the least-loaded live replica
+    # ------------------------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        try:
+            while True:
+                batch = self._batcher.next_batch(
+                    self._stop, self._on_expired, block=True)
+                if batch is None:
+                    return  # stopped and drained
+                self._route(batch, retries=max(1, len(self._replicas)))
+        finally:
+            for rep in self._replicas:
+                rep.q.put(None)  # drain sentinel (idempotent)
+
+    def _pick_replica(self, exclude: Optional[_Replica]):
+        """Least-loaded live replica with capacity, or None.  Caller
+        holds ``_route_cv``."""
+        live = [r for r in self._replicas
+                if r.alive and r is not exclude
+                and r.in_flight < _MAX_IN_FLIGHT]
+        if not live:
+            return None
+        return min(live, key=lambda r: r.in_flight)
+
+    def _route(self, batch: List[ServingRequest], retries: int,
+               exclude: Optional[_Replica] = None) -> None:
+        """Hand a coalesced batch to a replica (least loaded wins);
+        blocks while every live replica is at its in-flight bound —
+        the batcher queue, not replica queues, is the admission buffer.
+        With no live replica (or an aborting stop) the batch fails
+        typed, never hangs.
+
+        The enqueue happens INSIDE the routing lock: a replica thread
+        marks itself dead under the same lock before its final queue
+        drain, so every put either targets a replica that will still
+        drain it or never picks the dead one — a batch can never strand
+        in a queue nobody serves."""
+        rep = None
+        with self._route_cv:
+            while True:
+                if self._abort:
+                    break
+                rep = self._pick_replica(exclude)
+                if rep is None and exclude is not None:
+                    # the excluded (failing) replica is the only one
+                    # left: routing back would loop, so give up
+                    if not any(r.alive and r is not exclude
+                               for r in self._replicas):
+                        break
+                if rep is not None:
+                    rep.in_flight += 1
+                    rep.q.put((batch, retries))
+                    return
+                if not any(r.alive for r in self._replicas):
+                    break
+                self._route_cv.wait(timeout=_ROUTE_WAIT_S)
+        exc: Exception
+        if self._abort or self._closed:
+            exc = ServerClosed("server %r is stopped" % self.name)
+        else:
+            exc = ServingError(
+                "no live replicas on server %r" % self.name)
+        self._metrics.count("failed", len(batch))
+        for r in batch:
+            r.fail(exc)
+
+    def _release(self, rep: _Replica) -> None:
+        with self._route_cv:
+            rep.in_flight -= 1
+            self._route_cv.notify_all()
+
+    def _retire_replica(self, rep: _Replica) -> None:
+        with self._route_cv:
+            rep.alive = False
+            self._route_cv.notify_all()
+
+    def _replica_exit(self, rep: _Replica) -> None:
+        """Terminal bookkeeping for a replica thread: mark dead under
+        the routing lock (so no further _route can pick it — the put is
+        inside the same lock), then drain anything that landed before
+        the mark.  Without this a late failure re-route could strand a
+        batch in an exited replica's queue forever."""
+        self._retire_replica(rep)
+        self._drain_replica_queue(rep)
+
+    # ------------------------------------------------------------------
+    def remove_replica(self, replica, timeout: float = 30.0) -> None:
+        """Gracefully remove one replica at runtime: stop routing to it,
+        wait for its in-flight work to finish (re-routing anything still
+        queued).  ``replica``: index or ``r<idx>`` name.  Refuses to
+        remove the last live replica (stop() the server instead).
+
+        The replica's thread parks as a cheap re-route forwarder until
+        the server stops — it must outlive the removal so a batch routed
+        concurrently with it cannot strand in a dead queue."""
+        if isinstance(replica, int):
+            rep = self._replicas[replica]
+        else:
+            matches = [r for r in self._replicas if r.name == str(replica)]
+            if not matches:
+                raise ValueError("unknown replica %r" % (replica,))
+            rep = matches[0]
+        with self._route_cv:
+            if not rep.alive:
+                return  # already retired/removed
+            if sum(1 for r in self._replicas if r.alive) <= 1:
+                raise ValueError(
+                    "cannot remove the last live replica of server %r"
+                    % self.name)
+            rep.alive = False
+            self._route_cv.notify_all()
+            deadline = time.monotonic() + timeout
+            while rep.in_flight > 0 and time.monotonic() < deadline:
+                self._route_cv.wait(timeout=0.1)
+
+    # ------------------------------------------------------------------
+    # Replica worker: per-replica double buffer — dispatch batch N+1
+    # (async jit call, return_numpy=False) BEFORE materializing batch
+    # N's outputs, so N's device compute + d2h overlap N+1's host-side
+    # merge/pad/dispatch.
+    # ------------------------------------------------------------------
+    def _replica_loop(self, rep: _Replica) -> None:
         pending = None
         while True:
-            batch = self._batcher.next_batch(
-                self._stop, self._on_expired, block=pending is None)
-            if batch is None:
+            if not rep.alive:
+                # retired (failure) or removed (remove_replica): finish
+                # the in-flight batch, re-route the rest, then PARK as a
+                # forwarder until the server-wide stop sentinel — a
+                # batch routed concurrently with the retirement can
+                # still land in this queue, and exiting early would
+                # strand it (the request would hang to its deadline)
                 if pending is not None:
-                    self._finalize(*pending)
+                    self._finalize(rep, *pending)
+                    pending = None
+                self._drain_replica_queue(rep)
+                item = rep.q.get()
+                if item is None:
+                    self._replica_exit(rep)
+                    return  # server stopping
+                batch, retries = item
+                self._release(rep)
+                self._metrics.count("requeued")
+                self._route(batch, retries, exclude=rep)
+                continue
+            if pending is None:
+                item = rep.q.get()
+            else:
+                try:
+                    item = rep.q.get_nowait()
+                except queue.Empty:
+                    self._finalize(rep, *pending)
                     pending = None
                     continue  # re-enter blocking wait
-                return  # stopped and drained
-            nxt = self._execute(batch)
+            if item is None:
+                if pending is not None:
+                    self._finalize(rep, *pending)
+                    pending = None
+                self._replica_exit(rep)
+                return  # server drained
+            batch, retries = item
+            live = []
+            for r in batch:
+                # deadlines are re-checked at the replica: a batch can
+                # sit behind a slow predecessor after routing
+                if r.expired():
+                    self._on_expired(r)
+                else:
+                    live.append(r)
+            if not live:
+                self._release(rep)
+                continue
+            nxt = self._execute(rep, live, retries)
             if pending is not None:
-                self._finalize(*pending)
-            if nxt is not None and not self._nonblocking:
+                self._finalize(rep, *pending)
+                pending = None
+            if nxt is not None and not rep.nonblocking:
                 # synchronous predictor: outs are already materialized —
                 # deferring would just delay completions by one batch
-                self._finalize(*nxt)
+                self._finalize(rep, *nxt)
                 nxt = None
             pending = nxt
 
-    def _execute(self, batch: List[ServingRequest]):
-        """Merge + pad + DISPATCH one batch (non-blocking fetch); returns
-        the pending tuple for _finalize, or None on failure."""
+    def _drain_replica_queue(self, rep: _Replica) -> None:
+        """Re-route (never drop) batches queued on a dead replica.  A
+        stop sentinel encountered mid-drain is RE-QUEUED, not swallowed
+        — it is the one-per-replica shutdown signal, and consuming it
+        here would park the forwarder loop's next ``rep.q.get()``
+        forever (stop() would hang on the join)."""
+        saw_sentinel = False
+        while True:
+            try:
+                item = rep.q.get_nowait()
+            except queue.Empty:
+                break
+            if item is None:
+                saw_sentinel = True
+                continue
+            batch, retries = item
+            self._release(rep)  # give up this replica's slot...
+            self._metrics.count("requeued")
+            self._route(batch, retries, exclude=rep)  # ...take one elsewhere
+        if saw_sentinel:
+            rep.q.put(None)
+
+    # hot-path: begin serve_execute (merge/pad/dispatch; the d2h sync lives
+    # in _finalize, one batch behind)
+    def _execute(self, rep: _Replica, batch: List[ServingRequest],
+                 retries: int):
+        """Merge + pad + DISPATCH one batch on ``rep`` (non-blocking
+        fetch); returns the pending tuple for _finalize, or None on
+        failure (the failure path re-routes or fails the requests)."""
         valid = sum(r.n_rows for r in batch)
         try:
             merged = {
@@ -326,38 +604,61 @@ class InferenceServer:
             }
             bucket = self._policy.bucket_for(valid)
             padded = self._policy.pad_feed(merged, bucket)
-            misses0 = self._predictor.jit_cache_stats()["misses"]
+            misses0 = rep.predictor.jit_cache_stats()["misses"]
             t0 = time.perf_counter()
-            kw = {"return_numpy": False} if self._nonblocking else {}
-            with self._exec_lock:
+            kw = {"return_numpy": False} if rep.nonblocking else {}
+            with rep.lock:
                 with profiler.RecordEvent("serving/%s/batch" % self.name):
-                    outs = self._predictor.run_padded(
+                    outs = rep.predictor.run_padded(
                         padded, n_valid=valid, **kw)
-            recompiled = self._predictor.jit_cache_stats()["misses"] > misses0
-        except BaseException as exc:  # noqa: BLE001 — fail the batch, keep serving
-            self._metrics.count("failed", len(batch))
-            for r in batch:
-                r.fail(exc)
+            recompiled = rep.predictor.jit_cache_stats()["misses"] > misses0
+        except BaseException as exc:  # noqa: BLE001 — reroute/fail, keep serving
+            self._replica_failure(rep, batch, retries, exc)
             return None
-        return (batch, outs, valid, bucket, t0, recompiled)
+        return (batch, outs, valid, bucket, t0, recompiled, retries)
+    # hot-path: end serve_execute
 
-    def _finalize(self, batch: List[ServingRequest], outs, valid: int,
-                  bucket: int, t0: float, recompiled: bool) -> None:
+    def _replica_failure(self, rep: _Replica, batch: List[ServingRequest],
+                         retries: int, exc: BaseException) -> None:
+        """A batch failed on ``rep``: retire the replica when it fails
+        repeatedly, and re-route the batch to a surviving replica so
+        accepted requests don't drop — only with no survivor (or no
+        retry budget) do the requests fail."""
+        rep.failed += 1
+        rep.consec_failures += 1
+        if rep.consec_failures >= _REPLICA_FAIL_LIMIT and rep.alive:
+            self._retire_replica(rep)
+        self._release(rep)
+        with self._route_cv:
+            survivors = any(
+                r.alive and r is not rep for r in self._replicas)
+        if retries > 0 and survivors:
+            self._metrics.count("requeued")
+            self._route(batch, retries - 1, exclude=rep)
+            return
+        self._metrics.count("failed", len(batch))
+        for r in batch:
+            r.fail(exc)
+
+    def _finalize(self, rep: _Replica, batch: List[ServingRequest], outs,
+                  valid: int, bucket: int, t0: float, recompiled: bool,
+                  retries: int) -> None:
         """Materialize a dispatched batch (the d2h sync) and complete its
-        requests.  Deferred XLA runtime errors surface here — fail the
-        batch, keep serving.  The batch is observed HERE so ``run_s``
-        spans dispatch -> outputs materialized (the real batch duration;
-        timing only the async dispatch call would report ~0)."""
+        requests.  Deferred XLA runtime errors surface here — same
+        reroute-or-fail handling as a dispatch failure.  The batch is
+        observed HERE so ``run_s`` spans dispatch -> outputs materialized
+        (the real batch duration; timing only the async dispatch call
+        would report ~0)."""
         try:
             outs = [np.asarray(o) for o in outs]
         except BaseException as exc:  # noqa: BLE001
-            self._metrics.count("failed", len(batch))
-            for r in batch:
-                r.fail(exc)
+            self._replica_failure(rep, batch, retries, exc)
             return
+        rep.executed += 1
+        rep.consec_failures = 0
         self._metrics.observe_batch(
             valid, bucket, time.perf_counter() - t0,
-            recompiled=recompiled and self._warmed)
+            recompiled=recompiled and self._warmed, replica=rep.name)
         off = 0
         now = time.perf_counter()
         for r in batch:
@@ -369,12 +670,15 @@ class InferenceServer:
             off += r.n_rows
             r.complete(per_req)
             self._metrics.observe_request(now - r.submit_t)
+        self._release(rep)
 
     # ------------------------------------------------------------------
     def stop(self, drain: bool = True, timeout: Optional[float] = None) -> None:
         """Shut down.  ``drain=True`` (graceful): stop admitting, finish
-        every queued request, then join the worker.  ``drain=False``:
-        queued-but-unstarted requests fail with ServerClosed."""
+        every queued request, then join the dispatcher and replicas.
+        ``drain=False``: queued-but-unstarted requests fail with
+        ServerClosed (batches already routed to a replica still
+        complete)."""
         self._closed = True
         with self._admin_lock:
             admin, self._admin = self._admin, None
@@ -382,14 +686,28 @@ class InferenceServer:
             admin.shutdown()
             admin.server_close()
         if not drain:
-            # empty the queue before releasing the worker so it cannot
-            # start work we are abandoning
+            # empty the queue before releasing the dispatcher so it
+            # cannot route work we are abandoning
+            self._abort = True
             self._fail_stragglers()
         self._stop.set()
-        self._worker.join(timeout)
+        self._batcher.wake()
+        with self._route_cv:
+            self._route_cv.notify_all()
+        # one shared deadline across every join — N wedged threads must
+        # not stretch the caller's bound to (1+N) x timeout
+        deadline = (time.monotonic() + timeout) if timeout is not None else None
+
+        def _remaining():
+            return (None if deadline is None
+                    else max(0.0, deadline - time.monotonic()))
+
+        self._worker.join(_remaining())
+        for rep in self._replicas:
+            rep.thread.join(_remaining())
         # a submit() that raced past the admission check may have
-        # enqueued AFTER the worker drained and exited — fail it (and
-        # anything else left) rather than leaving its future pending
+        # enqueued AFTER the dispatcher drained and exited — fail it
+        # (and anything else left) rather than leaving its future pending
         if not self._worker.is_alive():
             self._fail_stragglers()
         # retire this instance's series from the registry exposition;

@@ -181,8 +181,10 @@ def test_grad_allreduce_transpile_parity():
         loss_avg = jax.lax.pmean(fetches[0], "dp")
         return loss_avg, state[wname2]
 
+    from paddle_tpu.parallel import mesh as mesh_lib
+
     sharded = jax.jit(
-        jax.shard_map(
+        mesh_lib.shard_map(
             step, mesh=mesh,
             in_specs=(P(), P("dp"), P("dp")),
             out_specs=(P(), P()),
@@ -219,8 +221,11 @@ def test_c_allreduce_prod_signs_and_zeros():
         with penv.active_axes(["dp"]):
             return kernel({"X": [xs[0]]}, {"axis_name": "dp"})["Out"]
 
+    from paddle_tpu.parallel import mesh as mesh_lib
+
     out = jax.jit(
-        jax.shard_map(fn, mesh=mesh, in_specs=(P("dp"),), out_specs=P("dp"), check_vma=False)
+        mesh_lib.shard_map(fn, mesh=mesh, in_specs=(P("dp"),),
+                           out_specs=P("dp"), check_vma=False)
     )(x)
     # each rank emits the full reduced [4]-vector; out_specs=P("dp")
     # concatenates them -> [16]
@@ -1055,3 +1060,74 @@ def test_contrib_utils_multi_download_upload(tmp_path):
     rels = sorted(multi_upload(client, str(dst), str(up)))
     assert rels == ["a.txt", os.path.join("sub", "b.txt")]
     assert (dst / "sub" / "b.txt").read_text() == "B"
+
+
+def test_dense_ps_overlapped_pull_hides_latency_and_trains():
+    """PR 4: in train_from_dataset's async dense-PS mode the host param
+    pull for step i+1 runs on a background thread WHILE step i's device
+    compute is in flight (Hogwild staleness semantics).  Pins: (1) the
+    pull thread ran with its own PSClient (the shared client's sockets
+    are not thread-safe), (2) the overlap/wait counters account the pull
+    latency, (3) training still converges, (4) nothing dangles after the
+    loop, and (5) the overlap flag is scoped to train_from_dataset."""
+    import socket as _socket
+    import threading
+
+    from paddle_tpu import monitor
+    from paddle_tpu.trainer_desc import TrainerFactory
+    from paddle_tpu.transpiler import DistributeTranspiler
+
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    ep = "127.0.0.1:%d" % s.getsockname()[1]
+    s.close()
+
+    t = DistributeTranspiler()
+    p, st, _ = _dense_ps_model(lambda: fluid.optimizer.SGDOptimizer(0.2))
+    t.transpile(0, program=p, pservers=ep, trainers=1, sync_mode=False)
+    pprog = t.get_pserver_program(ep)
+    threading.Thread(target=fluid.Executor(fluid.CPUPlace()).run,
+                     args=(pprog,), daemon=True).start()
+
+    prog, startup, loss = _dense_ps_model(lambda: fluid.optimizer.SGDOptimizer(0.2))
+    t2 = DistributeTranspiler()
+    t2.transpile(0, program=prog, pservers=ep, trainers=1, sync_mode=True)
+    tprog = t2.get_trainer_program()
+    desc = TrainerFactory().create_trainer()  # Hogwild -> async rounds
+    desc.set_fetch_var_and_info([loss], ["loss"], 100)
+
+    rng = np.random.RandomState(3)
+    xb = rng.uniform(-1, 1, (16, 8)).astype("float32")
+    yb = rng.randint(0, 4, (16, 1)).astype("int64")
+    feeds = [{"x": xb, "y": yb} for _ in range(12)]
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    overlap0 = monitor.counter_value("executor_ps_pull_overlap_seconds_total")
+    try:
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            out = exe.train_from_dataset(program=tprog, dataset=feeds,
+                                         scope=scope, trainer_desc=desc)
+        ctx = tprog._dense_ps_ctx
+        assert ctx["sync"] is False
+        # the pull thread ran on a DEDICATED client and was drained
+        assert ctx.get("_pull_client") is not None
+        assert ctx.get("_pull_pending") is None
+        assert "overlap_pull" not in ctx  # flag restored after the loop
+        stats = exe.jit_cache_stats()
+        total_pull = stats["ps_pull_overlap_s"] + stats["ps_pull_wait_s"]
+        assert total_pull > 0, stats  # pulls happened off-thread
+        # registry counters see the same accounting (collect-on-read)
+        assert (monitor.counter_value("executor_ps_pull_overlap_seconds_total")
+                + monitor.counter_value("executor_ps_pull_wait_seconds_total")
+                ) >= overlap0 + total_pull * 0.99
+        losses = [float(np.asarray(o[0])) for o in out]
+        assert losses[-1] < losses[0] * 0.9, losses  # still learns
+        # a direct run() outside train_from_dataset stays synchronous
+        (l,) = exe.run(tprog, feed={"x": xb, "y": yb}, fetch_list=[loss],
+                       scope=scope)
+        assert ctx.get("_pull_pending") is None
+        assert np.isfinite(np.asarray(l))
+    finally:
+        if hasattr(pprog, "_pserver"):
+            pprog._pserver.stop()

@@ -1,0 +1,50 @@
+"""Tier-1 wiring for tools/check_hot_path.py: the annotated hot-path
+regions of executor/serving/reader/compiled_program must stay free of
+blocking host-device syncs, and the checker itself must actually catch
+one (a checker that silently matches nothing would pass forever).
+"""
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+
+import check_hot_path  # noqa: E402
+
+
+def test_repo_hot_paths_are_clean():
+    violations = check_hot_path.check_files(REPO_ROOT)
+    assert violations == [], (
+        "blocking host-sync calls crept into annotated hot-path regions:\n"
+        + "\n".join("%s:%d %s: %s" % v for v in violations))
+
+
+def test_every_checked_file_has_a_region():
+    """An accidentally deleted marker must not silently disable the
+    guard for a whole file."""
+    for rel in check_hot_path.CHECKED_FILES:
+        with open(os.path.join(REPO_ROOT, rel)) as f:
+            text = f.read()
+        assert check_hot_path._BEGIN.search(text), (
+            "%s has no hot-path region markers" % rel)
+
+
+def test_checker_catches_violations_and_waivers():
+    src = "\n".join([
+        "def f(x):",
+        "    # hot-path: begin demo",
+        "    y = np.asarray(x)",
+        "    z = np.asarray(x)  # hot-ok: host value",
+        "    x.block_until_ready()",
+        "    time.sleep(1)",
+        "    # hot-path: end demo",
+        "    return np.asarray(y)  # outside the region: allowed",
+    ])
+    v = check_hot_path.check_source(src, "demo.py")
+    tokens = sorted(t for _, _, t, _ in v)
+    assert tokens == [".block_until_ready", "np.asarray", "time.sleep"], v
+
+
+def test_checker_flags_unclosed_region():
+    v = check_hot_path.check_source("# hot-path: begin x\npass\n", "u.py")
+    assert any(t == "<unclosed>" for _, _, t, _ in v)

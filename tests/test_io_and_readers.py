@@ -358,3 +358,157 @@ def test_buffered_producer_exception_surfaces():
     assert next(it) == 1
     with pytest.raises(ValueError, match="producer blew up"):
         list(it)
+
+
+# ---------------------------------------------------------------------------
+# sharded device prefetch (PR 4: device_buffered(compiled=...))
+# ---------------------------------------------------------------------------
+def _dp_compiled(prog):
+    from paddle_tpu.parallel.compiled_program import CompiledProgram
+    from paddle_tpu.parallel import mesh as mesh_lib
+
+    return CompiledProgram(prog).with_mesh(mesh_lib.data_parallel_mesh())
+
+
+def test_sharded_prefetch_placement_and_ordering():
+    """Each prefetched batch must land SLICED across the mesh — every
+    replica's rows in its own memory — with iteration order preserved."""
+    import jax
+
+    from paddle_tpu import reader as R
+    from paddle_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.data_parallel_mesh()
+    n_dev = int(mesh.devices.size)
+    assert n_dev == 8  # conftest virtual CPU mesh
+
+    def src():
+        for i in range(12):
+            yield {"x": np.full((16, 3), i, np.float32) +
+                   np.arange(16, dtype=np.float32)[:, None]}
+
+    out = list(R.device_buffered(src, size=3, compiled=mesh)())
+    assert len(out) == 12
+    for i, item in enumerate(out):
+        arr = item["x"]
+        assert isinstance(arr, jax.Array)
+        assert len(arr.sharding.device_set) == n_dev  # spread over the mesh
+        # per-shard content: shard d holds rows [2d, 2d+2) of THIS batch
+        want = np.full((16, 3), i, np.float32) + \
+            np.arange(16, dtype=np.float32)[:, None]
+        for shard in arr.addressable_shards:
+            lo = shard.index[0].start or 0
+            np.testing.assert_array_equal(np.asarray(shard.data),
+                                          want[lo:lo + 2])
+        np.testing.assert_array_equal(np.asarray(arr), want)
+
+
+def test_sharded_prefetch_steps_chunk_shapes():
+    """steps=N chunks compose with sharding: the leading steps axis is
+    replicated, the batch axis shards (steps axis x mesh axis)."""
+    import jax
+
+    from paddle_tpu import reader as R
+
+    prog, startup, loss, _ = _build_regression()
+    cp = _dp_compiled(prog)
+
+    def src():
+        for i in range(8):
+            yield {"x": np.full((16, 13), i, np.float32),
+                   "y": np.full((16, 1), i, np.float32)}
+
+    chunks = list(R.device_buffered(src, size=2, steps=4, compiled=cp)())
+    assert len(chunks) == 2
+    for c, base in zip(chunks, (0, 4)):
+        arr = c["x"]
+        assert isinstance(arr, jax.Array)
+        assert arr.shape == (4, 16, 13)
+        # steps axis replicated, batch axis sharded 8 ways
+        for shard in arr.addressable_shards:
+            assert np.asarray(shard.data).shape == (4, 2, 13)
+        np.testing.assert_array_equal(
+            np.asarray(arr)[:, 0, 0], np.arange(base, base + 4))
+
+
+def test_sharded_prefetch_positional_batches_need_names():
+    from paddle_tpu import reader as R
+    from paddle_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.data_parallel_mesh()
+
+    def seq_src():
+        yield [np.zeros((8, 2), np.float32), np.zeros((8, 1), np.float32)]
+
+    with pytest.raises(ValueError, match="feed_names"):
+        list(R.device_buffered(seq_src, size=2, compiled=mesh,
+                               feed_names=["x"])())
+    out = list(R.device_buffered(seq_src, size=2, compiled=mesh,
+                                 feed_names=["x", "y"])())
+    assert len(out[0]) == 2
+
+
+def test_sharded_prefetch_clean_shutdown_mid_epoch():
+    import threading
+    import time as _time
+
+    from paddle_tpu import reader as R
+    from paddle_tpu.parallel import mesh as mesh_lib
+
+    def _prefetch_threads():
+        return [t for t in threading.enumerate()
+                if t.name.startswith("ptpu-prefetch")]
+
+    base = len(_prefetch_threads())
+    mesh = mesh_lib.data_parallel_mesh()
+
+    def src():
+        for i in range(1000):
+            yield {"x": np.full((8, 2), i, np.float32)}
+
+    gen = R.device_buffered(src, size=2, compiled=mesh)()
+    got = [next(gen), next(gen)]
+    assert np.asarray(got[1]["x"])[0, 0] == 1.0
+    gen.close()  # consumer abandons the epoch mid-stream
+    deadline = _time.time() + 5
+    while len(_prefetch_threads()) > base and _time.time() < deadline:
+        _time.sleep(0.01)
+    assert len(_prefetch_threads()) == base, "sharded prefetch producer leaked"
+
+
+def test_sharded_prefetch_zero_recompiles_after_warmup():
+    """End to end on the mesh: chunks from the sharded prefetcher drive
+    Executor.run(CompiledProgram, steps=N, per_step_feed=True) with
+    ZERO recompiles after the first chunk — the fleet-wide analog of
+    the single-device guarantee."""
+    from paddle_tpu import reader as R
+
+    prog, startup, loss, _ = _build_regression()
+    cp = _dp_compiled(prog)
+    rng = np.random.RandomState(0)
+
+    def batches():
+        for _ in range(12):
+            yield {"x": rng.rand(16, 13).astype(np.float32),
+                   "y": rng.rand(16, 1).astype(np.float32)}
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        chunks = R.device_buffered(batches, size=2, steps=4, compiled=cp)()
+        losses = []
+        warmed = False
+        misses_after_warmup = None
+        for feed in chunks:
+            (l,) = exe.run(cp, feed=feed, fetch_list=[loss],
+                           steps=4, per_step_feed=True)
+            losses.append(float(np.asarray(l)))
+            if not warmed:
+                warmed = True
+                misses_after_warmup = exe.jit_cache_stats()["misses"]
+        stats = exe.jit_cache_stats()
+        assert stats["misses"] == misses_after_warmup, (
+            "sharded path recompiled after warmup: %s" % stats)
+        assert stats["hits"] >= 2
+    assert np.isfinite(losses).all()

@@ -312,3 +312,236 @@ def test_feed_validation_is_loud(predictor):
             server.submit({"x": _rows(5)})
     finally:
         server.stop()
+
+
+# ---------------------------------------------------------------------------
+# multi-replica dispatch (PR 4): N predictors behind one batcher
+# ---------------------------------------------------------------------------
+class KillablePredictor(SlowPredictor):
+    """SlowPredictor that can be flipped into a hard-failing state —
+    the deterministic 'replica died' stand-in."""
+
+    def __init__(self, delay_s=0.0):
+        super().__init__(delay_s)
+        self.killed = False
+
+    def run_padded(self, feed, n_valid=None):
+        if self.killed:
+            raise RuntimeError("replica hardware lost")
+        return super().run_padded(feed, n_valid=n_valid)
+
+
+def _storm(server, n_req, start_val=0):
+    futs = []
+    for i in range(n_req):
+        row = np.full((1, IN_DIM), float(start_val + i), np.float32)
+        futs.append((start_val + i, server.submit({"x": row})))
+    return futs
+
+
+def _measure_throughput(n_replicas, n_req=20, delay=0.03):
+    preds = [SlowPredictor(delay) for _ in range(n_replicas)]
+    server = InferenceServer(
+        preds if n_replicas > 1 else preds[0], max_batch_size=1,
+        batch_timeout_ms=1, queue_capacity=128,
+        name="tp%d" % n_replicas)
+    try:
+        server.warmup(configure_cache=False)
+        t0 = time.perf_counter()
+        futs = [server.submit({"x": _rows(1, seed=i)}) for i in range(n_req)]
+        for f in futs:
+            f.result(timeout=30)
+        elapsed = time.perf_counter() - t0
+        m = server.metrics()
+        assert m["recompiles"] == 0  # zero recompiles after warmup
+        assert m["completed"] == n_req
+        return elapsed
+    finally:
+        server.stop()
+
+
+def test_two_replica_throughput_exceeds_1_5x_single():
+    """The scale-out acceptance bar: two replicas behind the one
+    batcher must beat 1.5x single-replica throughput on a synthetic
+    slow endpoint (the sleeps release the GIL like device compute
+    does), with zero recompiles after warmup."""
+    t1 = _measure_throughput(1)
+    t2 = _measure_throughput(2)
+    speedup = t1 / t2
+    assert speedup > 1.5, (
+        "2-replica speedup %.2fx (1 rep %.3fs vs 2 reps %.3fs)"
+        % (speedup, t1, t2))
+
+
+def test_killed_replica_drains_without_dropping_requests():
+    """A replica that starts failing mid-traffic is retired and its
+    batches re-route to the survivor: every ACCEPTED request completes
+    with its own correct result — none dropped, none failed."""
+    p0, p1 = KillablePredictor(0.02), KillablePredictor(0.02)
+    server = InferenceServer(
+        [p0, p1], max_batch_size=1, batch_timeout_ms=1,
+        queue_capacity=128, name="killtest")
+    try:
+        server.warmup(configure_cache=False)
+        futs = []
+        for i in range(30):
+            futs.append(_storm(server, 1, start_val=i)[0])
+            if i == 10:
+                p0.killed = True  # replica r0 dies mid-stream
+        for val, fut in futs:
+            (out,) = fut.result(timeout=30)
+            np.testing.assert_allclose(out[0, 0], val * IN_DIM, rtol=1e-5)
+        m = server.metrics()
+        assert m["completed"] == 30 and m["failed"] == 0
+        reps = m["replicas"]
+        # exactly one replica survived; batches were re-routed, and the
+        # failing replica was retired from routing after repeated faults
+        assert sorted(r["alive"] for r in reps.values()) == [False, True]
+        assert m["requeued"] >= 1
+        assert server.num_replicas == 1
+    finally:
+        server.stop(drain=True)
+
+
+def test_all_replicas_dead_fails_typed_not_hang():
+    p0, p1 = KillablePredictor(), KillablePredictor()
+    server = InferenceServer(
+        [p0, p1], max_batch_size=1, batch_timeout_ms=1, name="alldead")
+    try:
+        p0.killed = p1.killed = True
+        futs = [server.submit({"x": _rows(1)}) for _ in range(4)]
+        failed = 0
+        for f in futs:
+            try:
+                f.result(timeout=30)
+            except (serving.ServingError, RuntimeError):
+                failed += 1
+        assert failed == 4  # typed errors, never hangs
+    finally:
+        server.stop(drain=True)
+
+
+def test_remove_replica_graceful():
+    """remove_replica: stops routing, finishes queued work, refuses to
+    remove the last live replica."""
+    pa, pb = SlowPredictor(0.01), SlowPredictor(0.01)
+    server = InferenceServer(
+        [pa, pb], max_batch_size=1, batch_timeout_ms=1,
+        queue_capacity=128, name="rmtest")
+    try:
+        server.warmup(configure_cache=False)
+        futs = [server.submit({"x": _rows(1, seed=i)}) for i in range(10)]
+        server.remove_replica(0)
+        futs += [server.submit({"x": _rows(1, seed=i)}) for i in range(10)]
+        for f in futs:
+            f.result(timeout=30)
+        assert server.num_replicas == 1
+        assert server.metrics()["replicas"]["r0"]["alive"] is False
+        with pytest.raises(ValueError, match="last live replica"):
+            server.remove_replica("r1")
+        assert server.metrics()["completed"] == 20
+    finally:
+        server.stop(drain=True)
+
+
+def test_multi_replica_warmup_compiles_every_replica(predictor, tmp_path):
+    """The zero-recompile guarantee holds FLEET-wide: warmup touches
+    every replica, and mixed-size traffic after warmup never misses any
+    replica's jit cache (real AnalysisPredictors)."""
+    from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+
+    d = str(tmp_path / "mlp2")
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 7
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [IN_DIM])
+        h = fluid.layers.fc(x, 32, act="relu")
+        pred = fluid.layers.fc(h, OUT_DIM, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.save_inference_model(d, ["x"], [pred], exe, prog)
+    second = create_paddle_predictor(AnalysisConfig(d))
+
+    server = InferenceServer(
+        [predictor, second], max_batch_size=8, batch_timeout_ms=5,
+        name="fleetwarm")
+    try:
+        server.warmup()
+        misses0 = [predictor.jit_cache_stats()["misses"],
+                   second.jit_cache_stats()["misses"]]
+        cli = Client(server)
+        sizes = [1, 2, 3, 5, 7, 8, 4, 6, 1, 3, 2, 5, 8, 7]
+        errors = []
+
+        def go(i, n):
+            try:
+                (out,) = cli.infer({"x": _rows(n, seed=i)})
+                assert out.shape == (n, OUT_DIM)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=go, args=(i, n))
+                   for i, n in enumerate(sizes)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert [predictor.jit_cache_stats()["misses"],
+                second.jit_cache_stats()["misses"]] == misses0, (
+            "a replica recompiled after fleet warmup")
+        m = server.metrics()
+        assert m["recompiles"] == 0 and m["completed"] == len(sizes)
+        # both replicas actually served traffic (least-loaded routing)
+        executed = [r["executed"] for r in m["replicas"].values()]
+        assert sum(executed) == m["batches"]
+    finally:
+        server.stop()
+
+
+def test_idle_batcher_sleeps_on_condition_not_poll():
+    """The CV rewrite: a consumer parked on an empty queue wakes
+    promptly on offer() (no 20ms poll quantum), and wake() unparks it
+    at shutdown."""
+    from paddle_tpu.serving.batching import DynamicBatcher
+
+    b = DynamicBatcher(max_batch_size=4, batch_timeout_ms=1,
+                       queue_capacity=8)
+    stop = threading.Event()
+    got = []
+
+    def worker():
+        got.append(b.next_batch(stop, lambda r: None, block=True))
+
+    t = threading.Thread(target=worker)
+    t.start()
+    time.sleep(0.15)  # worker is parked on the condition
+    t0 = time.perf_counter()
+    b.offer(ServingRequestStub())
+    t.join(timeout=5)
+    latency = time.perf_counter() - t0
+    assert got and got[0] is not None and len(got[0]) == 1
+    assert latency < 0.1, "offer->wake latency %.3fs (poll, not CV?)" % latency
+
+    # wake() releases a parked consumer once stopped
+    got.clear()
+    t = threading.Thread(target=worker)
+    t.start()
+    time.sleep(0.05)
+    stop.set()
+    t0 = time.perf_counter()
+    b.wake()
+    t.join(timeout=5)
+    assert time.perf_counter() - t0 < 0.1
+    assert got == [None]
+
+
+class ServingRequestStub:
+    """Minimal live request for batcher-level tests."""
+
+    n_rows = 1
+    deadline = None
+
+    def expired(self, now=None):
+        return False

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Static hot-path guard: no blocking host-device syncs in annotated regions.
+
+The dispatch fast path's contract is that a steady-state step performs
+NO blocking device synchronization on the host thread — `np.asarray` of
+a device array, `jax.device_get`, `.block_until_ready()`, or a sleep
+anywhere inside the annotated regions would serialize the pipeline the
+whole PR series built (run-plan cache -> sharded prefetch -> async
+dispatch -> deferred d2h).  Those regressions are easy to introduce and
+invisible in unit tests (everything still passes, just slower), so this
+checker fails them statically.
+
+Regions are marked in the source:
+
+    # hot-path: begin <label>
+    ...code...
+    # hot-path: end <label>
+
+A line that legitimately needs a flagged token (e.g. `np.asarray` on a
+HOST value) carries an inline waiver comment: `# hot-ok: <reason>`.
+
+Wired into tier-1 via tests/test_hot_path.py; also runnable directly:
+
+    python tools/check_hot_path.py   # exits 1 and prints violations
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List, Tuple
+
+# files owning annotated hot regions (repo-root relative)
+CHECKED_FILES = [
+    "paddle_tpu/executor.py",
+    "paddle_tpu/serving/server.py",
+    "paddle_tpu/reader.py",
+    "paddle_tpu/parallel/compiled_program.py",
+]
+
+# blocking-sync tokens (substring match on code, not comments)
+BANNED_TOKENS = [
+    "jax.device_get",
+    ".block_until_ready",
+    "np.asarray",
+    "np.array(",
+    "time.sleep",
+    ".copy_to_host",
+]
+
+_BEGIN = re.compile(r"#\s*hot-path:\s*begin\b\s*(?P<label>[\w./-]*)")
+_END = re.compile(r"#\s*hot-path:\s*end\b")
+_WAIVER = "# hot-ok:"
+
+
+def check_source(text: str, path: str = "<string>") -> List[Tuple[str, int, str, str]]:
+    """Return [(path, lineno, token, line)] violations in ``text``."""
+    violations = []
+    label = None
+    opened_at = 0
+    for i, line in enumerate(text.splitlines(), start=1):
+        m = _BEGIN.search(line)
+        if m:
+            if label is not None:
+                violations.append(
+                    (path, i, "<nesting>",
+                     "hot-path region %r opened inside %r (line %d)"
+                     % (m.group("label"), label, opened_at)))
+            label = m.group("label") or "<anonymous>"
+            opened_at = i
+            continue
+        if _END.search(line):
+            if label is None:
+                violations.append(
+                    (path, i, "<orphan-end>", line.strip()))
+            label = None
+            continue
+        if label is None:
+            continue
+        code = line.split("#", 1)[0]
+        if _WAIVER in line:
+            continue
+        for token in BANNED_TOKENS:
+            if token in code:
+                violations.append((path, i, token, line.strip()))
+    if label is not None:
+        violations.append(
+            (path, opened_at, "<unclosed>",
+             "hot-path region %r never closed" % label))
+    return violations
+
+
+def check_files(repo_root: str = None) -> List[Tuple[str, int, str, str]]:
+    root = repo_root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    out = []
+    for rel in CHECKED_FILES:
+        path = os.path.join(root, rel)
+        with open(path) as f:
+            out.extend(check_source(f.read(), rel))
+    return out
+
+
+def main() -> int:
+    violations = check_files()
+    if not violations:
+        n = 0
+        for rel in CHECKED_FILES:
+            root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            with open(os.path.join(root, rel)) as f:
+                n += sum(1 for ln in f if _BEGIN.search(ln))
+        print("check_hot_path: OK (%d regions across %d files clean)"
+              % (n, len(CHECKED_FILES)))
+        return 0
+    for path, lineno, token, line in violations:
+        print("%s:%d: blocking call %r in hot-path region: %s"
+              % (path, lineno, token, line), file=sys.stderr)
+    print("check_hot_path: %d violation(s)" % len(violations), file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
