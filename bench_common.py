@@ -13,7 +13,8 @@ import sys
 
 __all__ = [
     "configure_compile_cache", "fresh_enabled", "stage_feeds",
-    "prefetch_feeds", "metrics_out_path", "dump_metrics", "emit_result",
+    "prefetch_feeds", "flag_path", "metrics_out_path", "dump_metrics",
+    "emit_result",
 ]
 
 def _host_cache_tag():
@@ -100,17 +101,24 @@ def fresh_enabled(default="1"):
 # ---------------------------------------------------------------------------
 # Metrics dump alongside the bench JSON line (paddle_tpu.monitor)
 # ---------------------------------------------------------------------------
+def flag_path(flag, env=None, argv=None):
+    """Opt-in path argument: ``--<flag> PATH`` / ``--<flag>=PATH`` on
+    the bench command line, falling back to ``$<env>``.  Returns None
+    when not requested (shared by ``--metrics-out``, ``--trace-out``)."""
+    argv = sys.argv[1:] if argv is None else list(argv)
+    for i, arg in enumerate(argv):
+        if arg == flag and i + 1 < len(argv):
+            return argv[i + 1]
+        if arg.startswith(flag + "="):
+            return arg.split("=", 1)[1]
+    return (os.environ.get(env) or None) if env else None
+
+
 def metrics_out_path(argv=None):
     """Opt-in registry dump target: ``--metrics-out PATH`` /
     ``--metrics-out=PATH`` on the bench command line, or
     ``$BENCH_METRICS_OUT``.  Returns None when not requested."""
-    argv = sys.argv[1:] if argv is None else list(argv)
-    for i, arg in enumerate(argv):
-        if arg == "--metrics-out" and i + 1 < len(argv):
-            return argv[i + 1]
-        if arg.startswith("--metrics-out="):
-            return arg.split("=", 1)[1]
-    return os.environ.get("BENCH_METRICS_OUT") or None
+    return flag_path("--metrics-out", "BENCH_METRICS_OUT", argv)
 
 
 def dump_metrics(path):

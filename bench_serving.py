@@ -19,6 +19,14 @@ Env knobs: BENCH_SERVING_THREADS (default 8), BENCH_SERVING_REQUESTS
 (per thread, default 100), BENCH_SERVING_MAX_BATCH (default 16),
 BENCH_SERVING_TIMEOUT_MS (batch window, default 2),
 BENCH_SERVING_TRACE (JSONL trace path, default off).
+
+``--trace-out PATH`` (or $BENCH_SERVING_TRACE_OUT) additionally runs
+the storm under a flight recorder and dumps the SLOWEST 1% of bench
+requests' full span trees (client -> queue wait -> batch -> executor
+phases, one trace id each) to PATH alongside the JSON line — the
+latency tail, explained.  Without it the bench asserts the recorder
+stays absent and every span gate off: zero recorder overhead on the
+measured warm path.
 """
 import json
 import os
@@ -85,9 +93,19 @@ def _save_deepfm(dirname, num_features=10000, num_fields=39):
     return make_rows
 
 
+def _trace_out_path(argv=None):
+    """Opt-in flight-recorder dump target: ``--trace-out PATH`` /
+    ``--trace-out=PATH`` on the command line, or $BENCH_SERVING_TRACE_OUT."""
+    import bench_common
+
+    return bench_common.flag_path(
+        "--trace-out", "BENCH_SERVING_TRACE_OUT", argv)
+
+
 def _bench_endpoint(name, save_fn):
-    from paddle_tpu import serving
+    from paddle_tpu import monitor, serving
     from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+    from paddle_tpu.monitor import flight as _flight
 
     with tempfile.TemporaryDirectory() as tmp:
         d = os.path.join(tmp, name)
@@ -100,6 +118,13 @@ def _bench_endpoint(name, save_fn):
         warmup_compiles = server.warmup()
         warmup_s = time.perf_counter() - t0
         cli = serving.Client(server)
+        if _flight.get() is None:
+            # recorder at defaults (absent): every span gate the serving
+            # and executor hot paths consult must be off, so the number
+            # below carries ZERO recorder overhead (the --trace-out mode
+            # opts into the capture cost explicitly)
+            assert not monitor.recording(), (
+                "span recording leaked into the bench warm path")
 
         total_rows = [0] * THREADS
         shed = [0] * THREADS
@@ -160,15 +185,40 @@ def _bench_endpoint(name, save_fn):
         }
 
 
+def _dump_flight_trace(recorder, path):
+    """Write the slowest 1% of bench requests (by client-observed
+    latency) with their full span trees — the /tracez document shape,
+    pre-filtered to the tail."""
+    recs = recorder.snapshot()
+    recs.sort(key=lambda r: r.get("latency_ms", 0.0), reverse=True)
+    n_keep = max(1, len(recs) // 100)
+    with open(path, "w") as f:
+        json.dump({
+            "metric": "serving_flight_trace",
+            "slowest_pct": 1,
+            "total_requests": len(recs),
+            "slow_ms": recorder.slow_ms,
+            "requests": recs[:n_keep],
+        }, f)
+    return n_keep
+
+
 def run():
     import jax
 
-    from paddle_tpu import profiler
+    from paddle_tpu import monitor, profiler
 
     import bench_common
 
     bench_common.configure_compile_cache(bench_common.HOME_CACHE_DIR)
     trace = os.environ.get("BENCH_SERVING_TRACE")
+    trace_out = _trace_out_path()
+    recorder = None
+    if trace_out:
+        # slow_ms=0 retains EVERY request so the slowest 1% is an exact
+        # post-hoc selection, not a guessed threshold
+        recorder = monitor.flight_recorder(
+            capacity=2 * THREADS * REQUESTS + 64, slow_ms=0.0)
     if trace:
         profiler.start_jsonl_trace(trace)
     try:
@@ -179,7 +229,7 @@ def run():
     finally:
         if trace:
             profiler.stop_jsonl_trace()
-    return {
+    result = {
         "metric": "serving_dynamic_batching",
         "unit": "rows/sec",
         "value": endpoints["lenet"]["rows_per_sec"],
@@ -190,6 +240,11 @@ def run():
         "batch_timeout_ms": TIMEOUT_MS,
         "platform": jax.devices()[0].platform,
     }
+    if recorder is not None:
+        result["trace_out"] = trace_out
+        result["trace_out_requests"] = _dump_flight_trace(recorder, trace_out)
+        recorder.close()
+    return result
 
 
 def main():

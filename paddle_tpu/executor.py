@@ -585,7 +585,12 @@ class Executor:
         _overhead = time.perf_counter() - _t_run0
         stats["dispatch_overhead_s"] += _overhead
         if _rec:
-            _MON_DISPATCH_HIST.observe(_overhead)
+            # a serving replica runs this under the batch's trace
+            # context — pin one of its trace ids to the bucket so the
+            # OpenMetrics exposition links overhead tails to requests
+            _ids = _mon_spans.current_trace_ids()
+            _MON_DISPATCH_HIST.observe(
+                _overhead, exemplar={"trace_id": _ids[0]} if _ids else None)
             _t0 = time.perf_counter()
         fetches, new_state = entry(mut_state, ro_state, feed_arrays)
         # hot-path: end dispatch (the jitted call is async; everything

@@ -12,6 +12,7 @@ dispatch.  Zero-copy = device arrays in/out (ZeroCopyTensor analog).
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -19,6 +20,7 @@ import numpy as np
 from paddle_tpu import framework, io
 from paddle_tpu.core import lowering
 from paddle_tpu.monitor import registry as _mon_registry
+from paddle_tpu.monitor import spans as _mon_spans
 
 __all__ = ["AnalysisConfig", "PaddlePredictor", "AnalysisPredictor", "create_paddle_predictor"]
 
@@ -152,7 +154,18 @@ class AnalysisPredictor(PaddlePredictor):
                 "n_valid=%r out of range for padded batch %d" % (n_valid, padded))
         _MON_PRED_PADDED_ROWS.inc(padded)
         _MON_PRED_WASTE_ROWS.inc(padded - n_valid)
+        # request-chain span: the predictor-level hop between the
+        # serving batch span and the executor's run phases (carries the
+        # batch's trace ids via the caller's trace context); one flag
+        # check when nothing records
+        _rec = _mon_spans.recording()
+        if _rec:
+            _t0 = time.perf_counter()
         outs = self.run(feed, return_numpy=return_numpy)
+        if _rec:
+            _mon_spans.record_span(
+                "predictor/run_padded", _t0, time.perf_counter() - _t0,
+                cat="predictor", padded=int(padded), n_valid=int(n_valid))
         if n_valid == padded:
             return outs
         return [

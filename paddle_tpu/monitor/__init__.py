@@ -15,10 +15,20 @@ paths:
   transfer / device execute / d2h fetch), RecordEvent blocks mirror in,
   serving batches ride the profiler JSONL stream.  Recording is
   opt-in; when off, instrumentation is a single flag check.
-* **Chrome-trace export** (``chrome_trace.py``) — merges spans + the
-  JSONL event stream into one ``trace.json`` loadable in
-  chrome://tracing / Perfetto (the ``timeline.py`` analog; device-side
-  XLA timelines stay in jax.profiler/xprof).
+* **Chrome-trace export** (``chrome_trace.py``) — merges spans, the
+  JSONL event stream, flight-recorder request trees, AND a
+  time-aligned ``jax.profiler`` device timeline into one ``trace.json``
+  loadable in chrome://tracing / Perfetto (the ``timeline.py`` analog,
+  device lanes included).
+* **Request-scoped tracing** (``flight.py`` + span trace contexts) —
+  ``new_trace_id()`` / ``trace_context()`` attribute spans to requests;
+  ``flight_recorder(capacity, slow_ms)`` tail-samples full span trees
+  for slow/errored/deadline-missed requests into a bounded ring served
+  by the serving ``/tracez`` endpoint.
+* **OpenMetrics + push** (``registry.py`` / ``push.py``) —
+  ``expose(openmetrics=True)`` renders OpenMetrics 1.0 with histogram
+  exemplars carrying ``trace_id``; ``push_gateway(url, interval_s)``
+  ships the registry to a Prometheus pushgateway for batch jobs.
 
 Quickstart::
 
@@ -48,14 +58,20 @@ from paddle_tpu.monitor.registry import (
     MetricsRegistry,
     REGISTRY,
 )
+from paddle_tpu.monitor import flight as _flight
 from paddle_tpu.monitor import spans as _spans
+from paddle_tpu.monitor.flight import FlightRecorder, new_trace_id
+from paddle_tpu.monitor.push import PushGateway, push_gateway
 from paddle_tpu.monitor.spans import (
+    current_trace_ids,
     record_instant,
     record_span,
     recording,
+    set_thread_lane,
     span,
     start_recording,
     stop_recording,
+    trace_context,
 )
 from paddle_tpu.monitor.chrome_trace import export_chrome_trace
 
@@ -69,9 +85,13 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "CallbackCounter", "MetricsRegistry",
     "REGISTRY", "DEFAULT_BUCKETS",
     "counter", "gauge", "histogram", "counter_callback",
-    "snapshot", "render_text", "counter_value",
+    "snapshot", "render_text", "render_openmetrics", "expose",
+    "counter_value",
     "span", "record_span", "record_instant", "recording",
     "start_recording", "stop_recording",
+    "trace_context", "current_trace_ids", "set_thread_lane",
+    "new_trace_id", "flight_recorder", "FlightRecorder",
+    "push_gateway", "PushGateway",
     "export_chrome_trace", "trace_session", "TraceSession",
 ]
 
@@ -102,6 +122,23 @@ def render_text() -> str:
     return REGISTRY.render_text()
 
 
+def render_openmetrics() -> str:
+    return REGISTRY.render_openmetrics()
+
+
+def expose(openmetrics: bool = False):
+    """(body, content_type) for a scrape endpoint — Prometheus 0.0.4 or
+    OpenMetrics 1.0 with histogram exemplars."""
+    return REGISTRY.expose(openmetrics=openmetrics)
+
+
+def flight_recorder(capacity: int = 256, slow_ms: float = 50.0) -> FlightRecorder:
+    """Install the process flight recorder (tail-sampled per-request
+    span trees; see ``monitor.flight``).  Returns the handle — usable as
+    a context manager; ``close()`` uninstalls."""
+    return _flight.install(capacity=capacity, slow_ms=slow_ms)
+
+
 def counter_value(name: str, default: float = 0.0, **labels) -> float:
     """Sum of the named counter/gauge's series matching the given label
     subset (bench/test convenience)."""
@@ -115,37 +152,47 @@ class TraceSession:
     in ring-buffer mode, with ``dropped`` counting the rest) and
     ``export`` re-renders them."""
 
-    def __init__(self, path: Optional[str], jsonl_path: Optional[str]):
+    def __init__(self, path: Optional[str], jsonl_path: Optional[str],
+                 device_trace_dir: Optional[str] = None):
         self.path = path
         self.jsonl_path = jsonl_path
+        self.device_trace_dir = device_trace_dir
         self.spans: List[Dict[str, object]] = []
         self.dropped = 0
 
     def export(self, path: Optional[str] = None,
-               jsonl_path: Optional[str] = None) -> str:
+               jsonl_path: Optional[str] = None,
+               device_trace_dir: Optional[str] = None) -> str:
         target = path or self.path
         if target is None:
             raise ValueError("no trace path given")
         return export_chrome_trace(
             target, spans=self.spans,
-            jsonl_path=jsonl_path or self.jsonl_path)
+            jsonl_path=jsonl_path or self.jsonl_path,
+            device_trace_dir=device_trace_dir or self.device_trace_dir)
 
 
 @contextlib.contextmanager
 def trace_session(path: Optional[str] = None,
                   jsonl_path: Optional[str] = None,
-                  max_spans: Optional[int] = None):
+                  max_spans: Optional[int] = None,
+                  device_trace_dir: Optional[str] = None):
     """Record spans for the duration of the block; when ``path`` is
-    given, write the merged Chrome trace (spans + ``jsonl_path``) on
-    exit — including exceptional exit, so a failed run still leaves its
-    trace behind.
+    given, write the merged Chrome trace (spans + ``jsonl_path`` +
+    ``device_trace_dir``) on exit — including exceptional exit, so a
+    failed run still leaves its trace behind.
+
+    ``device_trace_dir``: a ``jax.profiler`` log dir (the body runs
+    ``profiler.start_profiler(trace_dir=...)`` .. ``stop_profiler()``);
+    its exported device timeline is time-aligned and merged into the
+    trace — one file holds host spans AND the XLA device lanes.
 
     ``max_spans=N`` bounds the buffer to a drop-oldest ring of N spans,
     making always-on production tracing safe: the session keeps the N
     most recent spans and ``sess.dropped`` (plus the registry's
     ``trace_dropped_spans_total``) counts what fell off."""
     start_recording(max_spans=max_spans)
-    sess = TraceSession(path, jsonl_path)
+    sess = TraceSession(path, jsonl_path, device_trace_dir)
     try:
         yield sess
     except BaseException:

@@ -5,23 +5,38 @@ host RecordEvent profiles with CUPTI device records into one trace
 file): this merges
 
 * recorded host spans (``monitor.spans`` — Executor run phases,
-  lowering, RecordEvent blocks, serving batches), and
+  lowering, RecordEvent blocks, serving batches, each carrying its
+  request ``trace_ids`` when recorded under a trace context),
 * the profiler's JSONL event stream (``profiler.emit_trace_event`` —
   discrete events like ``serving.batch`` with a wall ``ts`` and
-  optionally a ``run_ms`` duration)
+  optionally a ``run_ms`` duration),
+* flight-recorder records (``requests=`` — tail-sampled slow/errored
+  request span trees), and
+* a ``jax.profiler`` trace directory (``device_trace_dir=`` — the
+  profiler's exported trace-event JSON, XPlane-derived), time-aligned
+  with the host spans,
 
-into a single ``trace.json`` in the trace-event format.  Device-side
-XLA traces stay in jax.profiler/xprof (XPlane); this file is the
-host-side story, viewable alongside it.
+into a single ``trace.json`` in the trace-event format — client span,
+queue wait, batch assembly, executor h2d/execute/d2h, and the
+device-side XLA timeline on one scroll, attributable to one trace id.
 """
 from __future__ import annotations
 
+import glob
+import gzip
 import json
 import os
 import threading
 from typing import Dict, List, Optional, Sequence
 
+from paddle_tpu.monitor import spans as _spans
+
 __all__ = ["export_chrome_trace"]
+
+# device-trace events keep their own pid topology (one pid per XLA
+# process/planes group), offset into a reserved range so they can never
+# collide with the exporting host process's pid
+_DEVICE_PID_BASE = 1 << 20
 
 
 def _jsonl_events(path: str) -> List[Dict[str, object]]:
@@ -44,37 +59,109 @@ def _jsonl_events(path: str) -> List[Dict[str, object]]:
     return events
 
 
+def _load_device_trace(trace_dir: str) -> List[Dict[str, object]]:
+    """Load trace events from a ``jax.profiler.start_trace`` log dir.
+
+    The profiler writes ``plugins/profile/<run>/`` containing the
+    XPlane proto plus its exported trace-event JSON
+    (``<host>.trace.json.gz``; ``perfetto_trace.json.gz`` when the
+    trace was started with ``create_perfetto_trace=True``).  The newest
+    run wins; a dir with no exported JSON yields [] (never raises — a
+    half-written profile must not kill the host-side export)."""
+    roots = [trace_dir]
+    profile_root = os.path.join(trace_dir, "plugins", "profile")
+    if os.path.isdir(profile_root):
+        runs = sorted(
+            d for d in glob.glob(os.path.join(profile_root, "*"))
+            if os.path.isdir(d))
+        roots = runs[-1:] + roots
+    for root in roots:
+        candidates = (
+            sorted(glob.glob(os.path.join(root, "*.trace.json.gz")))
+            + sorted(glob.glob(os.path.join(root, "perfetto_trace.json.gz")))
+            + sorted(glob.glob(os.path.join(root, "*.trace.json"))))
+        for cand in candidates:
+            try:
+                opener = gzip.open if cand.endswith(".gz") else open
+                with opener(cand, "rt") as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue
+            evs = doc.get("traceEvents") if isinstance(doc, dict) else doc
+            if isinstance(evs, list) and evs:
+                return evs
+    return []
+
+
+def _device_anchor_default(trace_dir: str) -> Optional[float]:
+    """Wall-clock seconds at device-trace t=0, from the profiler's own
+    bookkeeping when this process started the trace."""
+    try:
+        from paddle_tpu import profiler
+
+        last = profiler.last_device_trace()
+    except Exception:
+        return None
+    if last and os.path.abspath(last[0]) == os.path.abspath(trace_dir):
+        return last[1]
+    return None
+
+
 def export_chrome_trace(
     path: str,
     spans: Optional[Sequence[Dict[str, object]]] = None,
     jsonl_path: Optional[str] = None,
     pid: Optional[int] = None,
+    requests: Optional[Sequence[Dict[str, object]]] = None,
+    device_trace_dir: Optional[str] = None,
+    device_anchor: Optional[float] = None,
 ) -> str:
     """Write ``path`` as a chrome://tracing-loadable JSON object.
 
     ``spans``: output of ``spans.stop_recording()`` (or any list in that
     shape).  ``jsonl_path``: an ``emit_trace_event`` JSONL file to merge.
-    Timestamps from both sources share the wall-clock timebase; the
-    earliest event is rebased to t=0 so the viewer opens centered.
+    ``requests``: flight-recorder records (``FlightRecorder.snapshot()``)
+    whose span trees are merged in with their trace ids.
+    ``device_trace_dir``: a ``jax.profiler`` log dir whose exported
+    trace-event JSON is merged as device-side lanes; ``device_anchor``
+    is the wall-clock time at device-trace t=0 (defaulting to the
+    profiler module's recorded start time for that dir, else aligned to
+    the earliest host event).  Timestamps from every source share the
+    wall-clock timebase; the earliest event is rebased to t=0 so the
+    viewer opens centered.
     """
     spans = list(spans or [])
+    for rec in requests or ():
+        spans.extend(rec.get("spans") or ())
     jsonl = _jsonl_events(jsonl_path) if jsonl_path else []
+    device = _load_device_trace(device_trace_dir) if device_trace_dir else []
     pid = os.getpid() if pid is None else pid
 
-    starts = [float(s["ts"]) for s in spans]
+    starts = [float(s["ts"]) for s in spans if "ts" in s]
     for ev in jsonl:
         ts = float(ev.get("ts", 0.0))
         starts.append(ts - float(ev.get("run_ms", 0.0)) / 1e3)
+    if device:
+        if device_anchor is None:
+            device_anchor = _device_anchor_default(device_trace_dir)
+        if device_anchor is not None:
+            starts.append(device_anchor)
     base = min(starts) if starts else 0.0
+    if device and device_anchor is None:
+        device_anchor = base  # no anchor known: device t=0 at first host event
 
     events: List[Dict[str, object]] = []
     tids = set()
     for s in spans:
+        if "ts" not in s:
+            continue  # a torn/foreign span dict must not kill the export
         tid = int(s.get("tid", 0))
         tids.add(tid)
         args = dict(s.get("args") or {})
         if s.get("error"):
             args["error"] = True
+        if s.get("trace_ids"):
+            args["trace_ids"] = list(s["trace_ids"])
         ev = {
             "name": str(s["name"]),
             "cat": str(s.get("cat", "host")),
@@ -117,6 +204,31 @@ def export_chrome_trace(
             ev["args"] = rec
         events.append(ev)
 
+    # device-side lanes: the profiler's events are already trace-event
+    # dicts with µs timestamps relative to its session start — shift
+    # them onto the shared wall timebase and move their pids into the
+    # reserved device range (metadata rows ride along so Perfetto shows
+    # the XLA process/thread names)
+    device_meta: List[Dict[str, object]] = []
+    device_shift_us = ((device_anchor or 0.0) - base) * 1e6
+    for ev in device:
+        if not isinstance(ev, dict) or "ph" not in ev:
+            continue
+        ev = dict(ev)
+        if "pid" in ev:
+            try:
+                ev["pid"] = _DEVICE_PID_BASE + int(ev["pid"])
+            except (TypeError, ValueError):
+                continue
+        if ev.get("ph") == "M":
+            device_meta.append(ev)
+            continue
+        if "ts" not in ev:
+            continue
+        ev["ts"] = float(ev["ts"]) + device_shift_us
+        ev["cat"] = ev.get("cat", "device")
+        events.append(ev)
+
     meta: List[Dict[str, object]] = [{
         "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
         "args": {"name": "paddle_tpu host"},
@@ -127,11 +239,18 @@ def export_chrome_trace(
             "args": {"name": "jsonl events"},
         })
     main_tid = threading.get_ident()
-    for tid in sorted(tids):
+    lanes = _spans.thread_lanes()
+    # every REGISTERED lane gets its name row even when its thread
+    # recorded no span this session (an idle replica worker is still a
+    # track the fleet view should name; viewers ignore eventless tids)
+    for tid in sorted(tids | set(lanes)):
+        name = lanes.get(tid) or (
+            "main" if tid == main_tid else "thread-%d" % tid)
         meta.append({
             "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
-            "args": {"name": "main" if tid == main_tid else "thread-%d" % tid},
+            "args": {"name": name},
         })
+    meta.extend(device_meta)
 
     events.sort(key=lambda e: e.get("ts", 0.0))
     with open(path, "w") as f:

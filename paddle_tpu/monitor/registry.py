@@ -9,7 +9,12 @@ any consumer reads the whole process through one of two surfaces:
 * ``snapshot()`` — a plain nested dict for tests, bench drivers, and the
   serving ``/statusz`` endpoint;
 * ``render_text()`` — Prometheus text exposition (version 0.0.4) for the
-  serving ``/metrics`` endpoint or any scraper.
+  serving ``/metrics`` endpoint or any scraper;
+* ``render_openmetrics()`` / ``expose(openmetrics=True)`` — OpenMetrics
+  1.0 exposition, including histogram *exemplars*: ``observe(v,
+  exemplar={"trace_id": ...})`` pins the offending request's trace id to
+  the latency bucket it landed in, so a scraper can jump from a p99
+  bucket straight to the flight-recorder trace.
 
 Metrics are registered idempotently: re-registering the same name with
 the same type/labels returns the existing metric (so module reloads and
@@ -20,6 +25,7 @@ from __future__ import annotations
 import math
 import re
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
@@ -81,7 +87,8 @@ class _GaugeChild:
 
 
 class _HistogramChild:
-    __slots__ = ("_lock", "_buckets", "_counts", "_sum", "_count")
+    __slots__ = ("_lock", "_buckets", "_counts", "_sum", "_count",
+                 "_exemplars")
 
     def __init__(self, buckets: Tuple[float, ...]):
         self._lock = threading.Lock()
@@ -89,16 +96,34 @@ class _HistogramChild:
         self._counts = [0] * len(buckets)  # per-bucket (non-cumulative)
         self._sum = 0.0
         self._count = 0
+        # one exemplar slot per bucket + one for +Inf; latest wins.
+        # Allocated lazily: most histograms never see an exemplar and
+        # the observe() fast path must not pay for the possibility.
+        self._exemplars: Optional[List[Optional[tuple]]] = None
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[Dict[str, str]] = None) -> None:
         v = float(v)
         with self._lock:
             self._sum += v
             self._count += 1
+            idx = len(self._buckets)  # +Inf slot
             for i, le in enumerate(self._buckets):
                 if v <= le:
                     self._counts[i] += 1
+                    idx = i
                     break
+            if exemplar:
+                if self._exemplars is None:
+                    self._exemplars = [None] * (len(self._buckets) + 1)
+                self._exemplars[idx] = (dict(exemplar), v, time.time())
+
+    def exemplars(self) -> List[Optional[tuple]]:
+        """Per-bucket ``(labels, value, wall_ts)`` exemplars (index
+        ``len(buckets)`` is +Inf); None where none was ever attached."""
+        with self._lock:
+            if self._exemplars is None:
+                return [None] * (len(self._buckets) + 1)
+            return list(self._exemplars)
 
     @property
     def value(self) -> Dict[str, object]:
@@ -222,8 +247,8 @@ class Histogram(_BaseMetric):
     def _new_child(self):
         return _HistogramChild(self.buckets)
 
-    def observe(self, v: float) -> None:
-        self._default().observe(v)
+    def observe(self, v: float, exemplar: Optional[Dict[str, str]] = None) -> None:
+        self._default().observe(v, exemplar=exemplar)
 
     def signature(self):
         return (type(self), self.labelnames, self.buckets)
@@ -398,6 +423,57 @@ class MetricsRegistry:
                 else:
                     lines.append("%s%s %s" % (m.name, _label_str(labels), _fmt(child.value)))
         return "\n".join(lines) + "\n"
+
+    def render_openmetrics(self) -> str:
+        """OpenMetrics 1.0 text exposition.
+
+        Differences from the 0.0.4 format that matter here: counter
+        *family* names drop the ``_total`` suffix in HELP/TYPE lines
+        (samples keep it), the document ends with ``# EOF``, and
+        histogram bucket lines may carry an exemplar —
+        ``# {trace_id="..."} <value> <wall_ts>`` — linking the bucket to
+        the request that landed in it (the bridge from a p99 latency
+        bucket to the flight recorder / merged trace)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: List[str] = []
+        for m in sorted(metrics, key=lambda m: m.name):
+            family = m.name
+            if m.kind == "counter" and family.endswith("_total"):
+                family = family[: -len("_total")]
+            if m.help:
+                lines.append("# HELP %s %s" % (family, m.help.replace("\n", " ")))
+            lines.append("# TYPE %s %s" % (family, m.kind))
+            for labels, child in sorted(m.series(), key=lambda s: sorted(s[0].items())):
+                if isinstance(child, _HistogramChild):
+                    v = child.value
+                    exemplars = child.exemplars()
+                    for i, (le, c) in enumerate(v["buckets"].items()):
+                        line = "%s_bucket%s %d" % (
+                            family, _label_str(labels, ("le", le)), c)
+                        ex = exemplars[i] if i < len(exemplars) else None
+                        if ex is not None:
+                            ex_labels, ex_val, ex_ts = ex
+                            line += " # %s %s %.3f" % (
+                                _label_str(ex_labels), _fmt(ex_val), ex_ts)
+                        lines.append(line)
+                    lines.append("%s_sum%s %s" % (family, _label_str(labels), _fmt(v["sum"])))
+                    lines.append("%s_count%s %d" % (family, _label_str(labels), v["count"]))
+                else:
+                    sample = family + "_total" if m.kind == "counter" else family
+                    lines.append("%s%s %s" % (sample, _label_str(labels), _fmt(child.value)))
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+    def expose(self, openmetrics: bool = False) -> Tuple[str, str]:
+        """Scrape-ready ``(body, content_type)`` pair: Prometheus text
+        0.0.4 by default, OpenMetrics 1.0 (with exemplars) on request —
+        the serving ``/metrics`` endpoint negotiates via Accept."""
+        if openmetrics:
+            return (self.render_openmetrics(),
+                    "application/openmetrics-text; version=1.0.0; charset=utf-8")
+        return (self.render_text(),
+                "text/plain; version=0.0.4; charset=utf-8")
 
 
 REGISTRY = MetricsRegistry()

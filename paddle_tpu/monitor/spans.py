@@ -18,6 +18,18 @@ session epoch, so they merge cleanly with the profiler's JSONL events,
 which stamp ``time.time()``), a duration in seconds, the recording
 thread id, a category, an optional ``error`` flag, and free-form args.
 ``chrome_trace.export_chrome_trace`` turns them into trace-event JSON.
+
+Request attribution (trace-id propagation): a thread that is serving a
+specific request (or batch of requests) installs a *trace context* —
+``with spans.trace_context(ids):`` — and every span the thread records
+while inside it carries ``trace_ids``, so a merged Chrome trace (and the
+flight recorder) can attribute queue-wait / h2d / execute / d2h spans to
+the exact requests in flight.  Orthogonally, ``spans.capture(buf)``
+installs a thread-local side buffer: spans recorded by the thread are
+ALSO appended to ``buf`` even when no global session is active — the
+flight recorder's per-batch collection mechanism.  ``recording()``
+reports True when either sink is live, so hot-path gates stay a single
+call.
 """
 from __future__ import annotations
 
@@ -25,11 +37,13 @@ import collections
 import contextlib
 import threading
 import time
-from typing import Deque, Dict, List, Optional
+from typing import Deque, Dict, List, Optional, Sequence
 
 __all__ = [
     "recording", "start_recording", "stop_recording", "record_span",
     "record_instant", "span", "session_dropped", "dropped_total",
+    "trace_context", "current_trace_ids", "capture",
+    "set_thread_lane", "thread_lanes",
 ]
 
 _enabled = False
@@ -41,10 +55,25 @@ _dropped_total = 0  # process-lifetime drop total (registry exposition)
 _epoch_pc = 0.0    # perf_counter at session start
 _epoch_wall = 0.0  # time.time at session start
 
+# process-lifetime perf_counter->wall anchor for spans recorded OUTSIDE
+# a session (flight-recorder captures have no session epoch to map
+# through; drift over a process lifetime is irrelevant at trace-viewer
+# resolution)
+_anchor_pc = time.perf_counter()
+_anchor_wall = time.time()
+
+_tls = threading.local()  # .trace_ids (tuple) / .capture (list)
+
+# tid -> human lane name for the Chrome-trace export (replica workers,
+# dispatcher, prefetch producers register here so the fleet renders as
+# named parallel tracks)
+_thread_lanes: Dict[int, str] = {}
+
 
 def recording() -> bool:
-    """True while a span-recording session is active."""
-    return _enabled
+    """True while a span sink is live for the calling thread: a global
+    trace session, or a thread-local flight-recorder capture."""
+    return _enabled or getattr(_tls, "capture", None) is not None
 
 
 def start_recording(max_spans: Optional[int] = None) -> None:
@@ -98,9 +127,10 @@ def dropped_total() -> int:
 def record_span(name: str, t0: float, dur: float, cat: str = "host",
                 error: bool = False, **args) -> None:
     """Record one completed span.  ``t0`` is the perf_counter value at
-    span start, ``dur`` the duration in seconds.  No-op when no session
-    is active."""
-    if not _enabled:
+    span start, ``dur`` the duration in seconds.  No-op when neither a
+    session nor a thread-local capture is active."""
+    cap = getattr(_tls, "capture", None)
+    if not _enabled and cap is None:
         return
     rec: Dict[str, object] = {
         "name": name,
@@ -110,8 +140,19 @@ def record_span(name: str, t0: float, dur: float, cat: str = "host",
     }
     if error:
         rec["error"] = True
+    ids = getattr(_tls, "trace_ids", None)
+    if ids:
+        rec["trace_ids"] = list(ids)
     if args:
         rec["args"] = args
+    if cap is not None:
+        # capture-only spans map through the process anchor (no session
+        # epoch may exist); when a session IS live the dict is shared, so
+        # the session's epoch-mapped ts below overwrites this one
+        rec["ts"] = _anchor_wall + (t0 - _anchor_pc)
+        cap.append(rec)
+    if not _enabled:
+        return
     global _dropped, _dropped_total
     with _lock:
         if _enabled:
@@ -128,7 +169,7 @@ def record_span(name: str, t0: float, dur: float, cat: str = "host",
 
 def record_instant(name: str, cat: str = "host", **args) -> None:
     """Record a zero-duration marker event."""
-    if not _enabled:
+    if not recording():
         return
     record_span(name, time.perf_counter(), 0.0, cat=cat, instant=True, **args)
 
@@ -137,7 +178,7 @@ def record_instant(name: str, cat: str = "host", **args) -> None:
 def span(name: str, cat: str = "host", **args):
     """Context-manager form; spans that exit via exception are flagged
     ``error=True``.  Near-zero-cost when no session is active."""
-    if not _enabled:
+    if not recording():
         yield
         return
     t0 = time.perf_counter()
@@ -149,3 +190,67 @@ def span(name: str, cat: str = "host", **args):
         raise
     finally:
         record_span(name, t0, time.perf_counter() - t0, cat=cat, error=err, **args)
+
+
+# ---------------------------------------------------------------------------
+# request attribution: trace context + capture buffers + thread lanes
+# ---------------------------------------------------------------------------
+@contextlib.contextmanager
+def trace_context(trace_ids: Optional[Sequence[str]]):
+    """Attribute every span this thread records inside the block to the
+    given request trace ids (None/empty = no-op).  Nested contexts
+    shadow; the previous context is restored on exit."""
+    ids = tuple(i for i in (trace_ids or ()) if i)
+    if not ids:
+        yield
+        return
+    prev = getattr(_tls, "trace_ids", None)
+    _tls.trace_ids = ids
+    try:
+        yield
+    finally:
+        _tls.trace_ids = prev
+
+
+def current_trace_ids() -> tuple:
+    """The calling thread's active trace ids (empty tuple outside any
+    ``trace_context``)."""
+    return getattr(_tls, "trace_ids", None) or ()
+
+
+@contextlib.contextmanager
+def capture(buf: List[Dict[str, object]]):
+    """Thread-local span side-sink: spans recorded by this thread inside
+    the block are appended to ``buf`` — independent of (and in addition
+    to) any global trace session.  The flight recorder wraps each batch
+    execution in one of these; nesting shadows (innermost wins)."""
+    prev = getattr(_tls, "capture", None)
+    _tls.capture = buf
+    try:
+        yield buf
+    finally:
+        _tls.capture = prev
+
+
+def wall_ts(t0: float) -> float:
+    """Map a ``time.perf_counter()`` reading to wall-clock seconds via
+    the process anchor (the timebase capture-mode spans use)."""
+    return _anchor_wall + (t0 - _anchor_pc)
+
+
+def set_thread_lane(name: str) -> None:
+    """Name the calling thread's lane in Chrome-trace exports (replica
+    workers, dispatchers, prefetch producers).
+
+    Registrations deliberately outlive the thread: exports usually run
+    AFTER the server stopped, and the spans its dead workers recorded
+    must still carry their lane names.  The costs are bounded and
+    cosmetic — one small dict entry per named thread ever created, and
+    a later unnamed thread that reuses a dead thread's OS id inherits
+    its label until it registers its own (latest registration wins)."""
+    _thread_lanes[threading.get_ident()] = str(name)
+
+
+def thread_lanes() -> Dict[int, str]:
+    """Snapshot of tid -> lane-name registrations."""
+    return dict(_thread_lanes)

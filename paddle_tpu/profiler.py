@@ -18,11 +18,13 @@ from paddle_tpu.monitor import spans as _mon_spans
 __all__ = [
     "profiler", "start_profiler", "stop_profiler", "reset_profiler",
     "RecordEvent", "cuda_profiler", "start_jsonl_trace", "stop_jsonl_trace",
-    "emit_trace_event", "jsonl_trace",
+    "emit_trace_event", "jsonl_trace", "last_device_trace",
 ]
 
 _host_events: Dict[str, List[float]] = defaultdict(list)
 _active_trace_dir: Optional[str] = None
+_active_trace_anchor: Optional[float] = None  # wall clock at start_trace
+_last_trace: Optional[tuple] = None  # (dir, anchor) of the last finished trace
 _ERROR_SUFFIX = " (error)"  # table key for spans that exited via exception
 
 
@@ -74,23 +76,29 @@ def start_profiler(state: str = "All", trace_dir: Optional[str] = None):
     stops any device trace this module previously started (via
     reset_profiler), so jax.profiler never sees a double start.
     """
-    global _active_trace_dir
+    global _active_trace_dir, _active_trace_anchor
     reset_profiler()
     if trace_dir:
         import jax
 
         # exception-safe: _active_trace_dir is only set AFTER the trace
         # actually started, so a failed start leaves no dangling state
-        # for stop_profiler()/reset_profiler() to trip over
+        # for stop_profiler()/reset_profiler() to trip over.  The wall
+        # clock is read just before the start so device-trace timestamps
+        # (µs relative to session start) can be re-anchored onto the
+        # host span timebase by monitor.export_chrome_trace.
+        anchor = time.time()
         jax.profiler.start_trace(trace_dir)
         _active_trace_dir = trace_dir
+        _active_trace_anchor = anchor
 
 
 def stop_profiler(sorted_key: str = "total", profile_path: Optional[str] = None):
     """reference: profiler.py stop_profiler — prints the per-event table."""
-    global _active_trace_dir
+    global _active_trace_dir, _active_trace_anchor, _last_trace
     if _active_trace_dir is not None:
-        _active_trace_dir = None
+        _last_trace = (_active_trace_dir, _active_trace_anchor)
+        _active_trace_dir = _active_trace_anchor = None
         import jax
 
         try:
@@ -124,16 +132,28 @@ def reset_profiler():
     reset never cleared.  Reset now owns the whole teardown, so start
     and reset are idempotent and exception-safe.
     """
-    global _active_trace_dir
+    global _active_trace_dir, _active_trace_anchor, _last_trace
     _host_events.clear()
     if _active_trace_dir is not None:
-        _active_trace_dir = None
+        _last_trace = (_active_trace_dir, _active_trace_anchor)
+        _active_trace_dir = _active_trace_anchor = None
         try:
             import jax
 
             jax.profiler.stop_trace()
         except Exception:
             pass  # a reset must never raise over a half-dead trace
+
+
+def last_device_trace() -> Optional[tuple]:
+    """``(trace_dir, wall_anchor)`` for the most recently finished
+    jax.profiler trace this module started — the time-alignment hint
+    ``monitor.export_chrome_trace(device_trace_dir=...)`` consumes.
+    The running trace is reported too (export-while-tracing reads a
+    partial dir, which the loader tolerates)."""
+    if _active_trace_dir is not None:
+        return (_active_trace_dir, _active_trace_anchor)
+    return _last_trace
 
 
 @contextlib.contextmanager

@@ -7,12 +7,18 @@ AnalysisPredictor (inference.py):
   coalescing (max_batch_size / batch_timeout_ms), bucket-ladder batch
   padding so the jit cache sees a closed shape set, per-request
   deadlines, overload shedding, graceful drain;
-* ``Client`` — blocking in-process client helper;
+* ``Client`` — blocking in-process client helper; mints a per-request
+  trace id (Dapper-style) that propagates through the batcher, replica
+  worker, and executor span chain, so a ``monitor.trace_session`` or
+  ``monitor.flight_recorder`` attributes every span to its requests;
 * ``BucketPolicy`` / ``DynamicBatcher`` / ``ServingMetrics`` — the
   composable pieces (metrics delegate to the process-global
-  ``paddle_tpu.monitor`` registry, labeled ``server=<name>``);
+  ``paddle_tpu.monitor`` registry, labeled ``server=<name>``; the
+  request-latency histogram carries ``trace_id`` exemplars);
 * ``server.start_admin()`` — localhost HTTP ``/metrics`` (Prometheus
-  text exposition) + ``/statusz`` (JSON snapshot) surface;
+  text exposition; OpenMetrics 1.0 with exemplars via Accept) +
+  ``/statusz`` (JSON snapshot) + ``/tracez`` (tail-sampled
+  slow/errored request traces) surface;
 * typed errors: ``ServerOverloaded``, ``DeadlineExceeded``,
   ``ServerClosed``.
 

@@ -33,13 +33,20 @@ _IDLE_WAIT_S = 0.5
 class ServingRequest:
     """One submitted inference request: a row-oriented feed plus a
     future the submitter waits on.  ``n_rows`` is the leading dim shared
-    by every feed array (validated by the server at submit)."""
+    by every feed array (validated by the server at submit).
+
+    ``trace_id`` (optional) is the request's Dapper-style trace id:
+    every span recorded while the batch containing this request executes
+    carries it (``monitor.trace_context``), and the flight recorder keys
+    its tail-sampled record by it."""
 
     def __init__(self, feed: Dict[str, np.ndarray], n_rows: int,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 trace_id: Optional[str] = None):
         self.feed = feed
         self.n_rows = n_rows
         self.deadline = deadline  # time.monotonic() deadline, or None
+        self.trace_id = trace_id
         self.submit_t = time.perf_counter()
         self._done = threading.Event()
         self._value: Optional[List[np.ndarray]] = None

@@ -3,13 +3,28 @@
 The test-and-bench-facing convenience surface: blocking single calls,
 scatter/gather for many requests, and named-output dicts.  A remote
 transport (RPC) would sit exactly where this class sits — everything
-below (submit/future) is transport-agnostic.
+below (submit/future) is transport-agnostic, and the trace id minted
+here is exactly what a wire transport would carry in a header.
+
+Request-scoped tracing: every ``infer*`` call mints a trace id (or
+accepts one via ``trace_id=``), propagates it through submit() into the
+batcher/replica/executor span chain, and — when a flight recorder is
+installed — reports the client-side span (submit -> result, the
+latency the caller actually saw) so a tail-sampled record shows the
+full client->device chain under one id.
 """
 from __future__ import annotations
 
+import threading
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
+
+from paddle_tpu import monitor
+from paddle_tpu.monitor import flight as _flight
+from paddle_tpu.monitor import spans as _spans
+from paddle_tpu.serving.errors import DeadlineExceeded
 
 __all__ = ["Client"]
 
@@ -19,17 +34,78 @@ class Client:
         self._server = server
         self._fetch_names = list(server._predictor.get_output_names())
 
-    def infer(self, feed, timeout_ms: Optional[float] = None) -> List[np.ndarray]:
+    def infer(self, feed, timeout_ms: Optional[float] = None,
+              trace_id: Optional[str] = None) -> List[np.ndarray]:
         """Submit one request and block for its outputs (list ordered
-        like the predictor's fetch list)."""
-        return self._server.submit(feed, timeout_ms=timeout_ms).result()
+        like the predictor's fetch list).  ``trace_id`` joins the call
+        to an existing trace; by default a fresh id is minted — read it
+        back via ``last_trace_id``."""
+        tid = trace_id or monitor.new_trace_id()
+        self.last_trace_id = tid
+        fr = _flight.get()
+        rec = _spans.recording() or fr is not None
+        if not rec:
+            return self._server.submit(
+                feed, timeout_ms=timeout_ms, trace_id=tid).result()
+        t0 = time.perf_counter()
+        err: Optional[BaseException] = None
+        try:
+            with _spans.trace_context((tid,)):
+                return self._server.submit(
+                    feed, timeout_ms=timeout_ms, trace_id=tid).result()
+        except BaseException as e:  # noqa: BLE001 — observed, re-raised
+            err = e
+            raise
+        finally:
+            dur = time.perf_counter() - t0
+            with _spans.trace_context((tid,)):
+                _spans.record_span(
+                    "serving/client_infer", t0, dur, cat="client",
+                    error=err is not None)
+            if fr is not None:
+                self._flight_report(fr, tid, t0, dur, err)
 
-    def infer_named(self, feed, timeout_ms: Optional[float] = None) -> Dict[str, np.ndarray]:
+    @staticmethod
+    def _flight_report(fr, tid: str, t0: float, dur: float,
+                       err: Optional[BaseException]) -> None:
+        """Attach the client-side span to the request's tail-sampled
+        record — or, for a deadline the server never got to observe
+        (the future timed out client-side), create the record.  Other
+        client-side errors (shed at admission, validation, server
+        closed) are deliberately NOT retained: terminal server failures
+        are recorded server-side, and an overload storm of rejected
+        requests must not flood the bounded ring and evict the slow
+        traces tail sampling exists to keep."""
+        span = {
+            "name": "serving/client_infer", "cat": "client",
+            "ts": _spans.wall_ts(t0), "dur": dur,
+            "tid": threading.get_ident(), "trace_ids": [tid],
+        }
+        if err is not None:
+            span["error"] = True
+        if fr.add_span(tid, span):
+            return
+        if err is not None and not isinstance(err, DeadlineExceeded):
+            return
+        fr.consider(
+            tid, dur,
+            "deadline" if isinstance(err, DeadlineExceeded) else "ok",
+            [span])
+
+    def infer_named(self, feed, timeout_ms: Optional[float] = None,
+                    trace_id: Optional[str] = None) -> Dict[str, np.ndarray]:
         """infer(), but keyed by the endpoint's output names."""
-        return dict(zip(self._fetch_names, self.infer(feed, timeout_ms)))
+        return dict(zip(self._fetch_names,
+                        self.infer(feed, timeout_ms, trace_id=trace_id)))
 
     def infer_many(self, feeds, timeout_ms: Optional[float] = None) -> List[List[np.ndarray]]:
         """Submit every feed first (so they can coalesce into shared
-        batches), then gather all results in order."""
-        futures = [self._server.submit(f, timeout_ms=timeout_ms) for f in feeds]
+        batches), then gather all results in order.  Each request gets
+        its own trace id (``last_trace_ids`` after the call)."""
+        tids = [monitor.new_trace_id() for _ in feeds]
+        futures = [
+            self._server.submit(f, timeout_ms=timeout_ms, trace_id=t)
+            for f, t in zip(feeds, tids)
+        ]
+        self.last_trace_ids = tids
         return [f.result() for f in futures]

@@ -24,7 +24,7 @@ import itertools
 import threading
 import time
 from collections import deque
-from typing import Dict
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -94,9 +94,15 @@ class ServingMetrics:
     def count(self, key: str, n: int = 1) -> None:
         self._c[key].inc(n)
 
-    def observe_request(self, latency_s: float) -> None:
+    def observe_request(self, latency_s: float,
+                        trace_id: Optional[str] = None) -> None:
         self._c["completed"].inc()
-        self._latency.observe(latency_s)
+        # the exemplar pins THIS request's trace id to the latency
+        # bucket it landed in (OpenMetrics exposition) — the bridge from
+        # a p99 bucket to the flight recorder / merged trace
+        self._latency.observe(
+            latency_s,
+            exemplar={"trace_id": trace_id} if trace_id else None)
         with self._lock:
             self._latencies.append(latency_s)
 

@@ -22,12 +22,27 @@ traffic -> stop(drain=True) for a graceful drain.
 Observability: metrics live in the process-global registry
 (``paddle_tpu.monitor``); ``start_admin()`` binds a localhost HTTP
 surface exposing ``/metrics`` (Prometheus text exposition of the whole
-registry) and ``/statusz`` (JSON snapshot: this server's metrics incl.
-bucket-ladder occupancy, per-replica health, and recompile counts, the
-predictors' jit-cache stats, and the full registry).
+registry — or OpenMetrics 1.0 with exemplars when the scraper sends
+``Accept: application/openmetrics-text``), ``/statusz`` (JSON snapshot:
+this server's metrics incl. bucket-ladder occupancy, per-replica
+health, and recompile counts, the predictors' jit-cache stats, and the
+full registry), and ``/tracez`` (the flight recorder's tail-sampled
+slow/errored request traces).
+
+Request-scoped tracing: each request carries a trace id (minted by the
+Client or passed to ``submit(trace_id=...)``); while a batch executes,
+the replica worker installs a ``monitor.trace_context`` so every span
+in the chain — queue wait, merge/pad/dispatch, executor h2d /
+device_execute / d2h, materialize — is attributable to the requests in
+the batch, and replica workers register named thread lanes so the
+fleet renders as parallel tracks in the merged Chrome trace.  With a
+``monitor.flight_recorder()`` installed, batches additionally run under
+a span capture and slow/errored/deadline-missed requests retain their
+full span trees.
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import queue
 import threading
@@ -37,6 +52,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from paddle_tpu import monitor, profiler
+from paddle_tpu.monitor import flight as _flight
+from paddle_tpu.monitor import spans as _mon_spans
 from paddle_tpu.serving.batching import DynamicBatcher, ServingRequest
 from paddle_tpu.serving.bucketing import BucketPolicy
 from paddle_tpu.serving.errors import (
@@ -194,6 +211,17 @@ class InferenceServer:
         (this server's series are labeled ``server=<name>``)."""
         return monitor.render_text()
 
+    def tracez(self) -> Dict[str, object]:
+        """The ``/tracez`` document: the process flight recorder's
+        tail-sampled slow/errored/deadline-missed request traces (empty
+        shell when no recorder is installed)."""
+        rec = _flight.get()
+        if rec is None:
+            return {"recorder": False, "retained": 0, "requests": []}
+        doc = rec.statusz()
+        doc["recorder"] = True
+        return doc
+
     def statusz(self) -> Dict[str, object]:
         """JSON-serializable status snapshot: this server's metrics
         (incl. bucket-ladder occupancy histogram, per-replica health,
@@ -211,9 +239,12 @@ class InferenceServer:
 
     # ------------------------------------------------------------------
     def start_admin(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
-        """Serve ``/metrics`` (text exposition) and ``/statusz`` (JSON)
-        over HTTP on ``host:port`` (port 0 = ephemeral); returns the
-        bound ``(host, port)``.  Stopped by ``stop()``."""
+        """Serve ``/metrics`` (Prometheus text exposition; OpenMetrics
+        1.0 with exemplars when the scraper sends ``Accept:
+        application/openmetrics-text``), ``/statusz`` (JSON), and
+        ``/tracez`` (flight-recorder tail-sampled request traces) over
+        HTTP on ``host:port`` (port 0 = ephemeral); returns the bound
+        ``(host, port)``.  Stopped by ``stop()``."""
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         server = self
@@ -222,15 +253,24 @@ class InferenceServer:
             def do_GET(self):
                 path = self.path.split("?", 1)[0]
                 if path == "/metrics":
-                    body = server.metrics_text().encode("utf-8")
-                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                    om = "application/openmetrics-text" in (
+                        self.headers.get("Accept") or "")
+                    text, ctype = monitor.expose(openmetrics=om)
+                    body = text.encode("utf-8")
                 elif path == "/statusz":
                     body = json.dumps(
                         server.statusz(), sort_keys=True, default=str
                     ).encode("utf-8")
                     ctype = "application/json"
+                elif path == "/tracez":
+                    body = json.dumps(
+                        server.tracez(), sort_keys=True, default=str
+                    ).encode("utf-8")
+                    ctype = "application/json"
                 else:
-                    self.send_error(404, "unknown path (try /metrics or /statusz)")
+                    self.send_error(
+                        404,
+                        "unknown path (try /metrics, /statusz or /tracez)")
                     return
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
@@ -303,14 +343,17 @@ class InferenceServer:
         return compiles
 
     # ------------------------------------------------------------------
-    def submit(self, feed, timeout_ms: Optional[float] = None) -> ServingRequest:
+    def submit(self, feed, timeout_ms: Optional[float] = None,
+               trace_id: Optional[str] = None) -> ServingRequest:
         """Enqueue one request; returns its future (ServingRequest).
 
         ``feed``: dict (or positional sequence) of arrays whose shared
         leading dim is the request's row count (1..max_batch_size).
-        Raises ServerOverloaded when the queue is full, ServerClosed
-        after stop(); the future raises DeadlineExceeded when
-        ``timeout_ms`` elapses first.
+        ``trace_id`` joins the request to a caller-owned trace (the
+        Client mints one per call); spans recorded while its batch
+        executes carry it.  Raises ServerOverloaded when the queue is
+        full, ServerClosed after stop(); the future raises
+        DeadlineExceeded when ``timeout_ms`` elapses first.
         """
         if self._closed:
             raise ServerClosed("server %r is stopped" % self.name)
@@ -318,7 +361,7 @@ class InferenceServer:
         deadline = (
             time.monotonic() + float(timeout_ms) / 1e3
             if timeout_ms is not None else None)
-        req = ServingRequest(feed, n_rows, deadline)
+        req = ServingRequest(feed, n_rows, deadline, trace_id=trace_id)
         try:
             self._batcher.offer(req)
         except Exception:
@@ -379,6 +422,14 @@ class InferenceServer:
 
     def _on_expired(self, req: ServingRequest) -> None:
         self._metrics.count("expired")
+        fr = _flight.get()
+        if fr is not None:
+            # deadline-missed requests are always tail-sampled; the
+            # client's span attaches to this record when its future
+            # raises (flight merges by trace id)
+            fr.consider(
+                req.trace_id, time.perf_counter() - req.submit_t,
+                "deadline", (), server=self.name)
         req.fail(DeadlineExceeded("deadline passed while queued"))
 
     # ------------------------------------------------------------------
@@ -386,6 +437,7 @@ class InferenceServer:
     # coalescing) and routes each batch to the least-loaded live replica
     # ------------------------------------------------------------------
     def _dispatch_loop(self) -> None:
+        _mon_spans.set_thread_lane("serving/%s/dispatcher" % self.name)
         try:
             while True:
                 batch = self._batcher.next_batch(
@@ -459,6 +511,15 @@ class InferenceServer:
             rep.alive = False
             self._route_cv.notify_all()
 
+    def _count_requeue(self, rep: _Replica) -> None:
+        """One re-routed batch: the ``serving_requeued_total`` counter
+        and the timeline marker move together (tests assert they agree),
+        tagged with the replica the batch bounced off."""
+        self._metrics.count("requeued")
+        monitor.record_instant(
+            "serving/batch_requeue", cat="serving",
+            server=self.name, replica=rep.name)
+
     def _replica_exit(self, rep: _Replica) -> None:
         """Terminal bookkeeping for a replica thread: mark dead under
         the routing lock (so no further _route can pick it — the put is
@@ -492,6 +553,9 @@ class InferenceServer:
                 raise ValueError(
                     "cannot remove the last live replica of server %r"
                     % self.name)
+            monitor.record_instant(
+                "serving/replica_drain", cat="serving",
+                server=self.name, replica=rep.name)
             rep.alive = False
             self._route_cv.notify_all()
             deadline = time.monotonic() + timeout
@@ -505,6 +569,10 @@ class InferenceServer:
     # merge/pad/dispatch.
     # ------------------------------------------------------------------
     def _replica_loop(self, rep: _Replica) -> None:
+        # stable named lane per replica worker: the merged Chrome trace
+        # renders the fleet as parallel tracks
+        _mon_spans.set_thread_lane(
+            "serving/%s/%s worker" % (self.name, rep.name))
         pending = None
         while True:
             if not rep.alive:
@@ -524,7 +592,7 @@ class InferenceServer:
                     return  # server stopping
                 batch, retries = item
                 self._release(rep)
-                self._metrics.count("requeued")
+                self._count_requeue(rep)
                 self._route(batch, retries, exclude=rep)
                 continue
             if pending is None:
@@ -582,7 +650,7 @@ class InferenceServer:
                 continue
             batch, retries = item
             self._release(rep)  # give up this replica's slot...
-            self._metrics.count("requeued")
+            self._count_requeue(rep)
             self._route(batch, retries, exclude=rep)  # ...take one elsewhere
         if saw_sentinel:
             rep.q.put(None)
@@ -593,66 +661,131 @@ class InferenceServer:
                  retries: int):
         """Merge + pad + DISPATCH one batch on ``rep`` (non-blocking
         fetch); returns the pending tuple for _finalize, or None on
-        failure (the failure path re-routes or fails the requests)."""
+        failure (the failure path re-routes or fails the requests).
+
+        Tracing: with a session or flight recorder live, the whole
+        merge/pad/dispatch runs under the batch's trace context (so the
+        executor's h2d/execute spans carry the requests' ids) and —
+        recorder only — under a span capture whose buffer rides the
+        pending tuple into _finalize; otherwise the only rent is two
+        gate checks."""
         valid = sum(r.n_rows for r in batch)
+        fr = _flight.get()
+        cap = [] if fr is not None else None
+        tids = ()
+        if cap is not None or _mon_spans.recording():
+            tids = tuple(r.trace_id for r in batch if r.trace_id)
         try:
-            merged = {
-                name: (
-                    np.concatenate([r.feed[name] for r in batch], axis=0)
-                    if len(batch) > 1 else batch[0].feed[name])
-                for name in self._feed_names
-            }
-            bucket = self._policy.bucket_for(valid)
-            padded = self._policy.pad_feed(merged, bucket)
-            misses0 = rep.predictor.jit_cache_stats()["misses"]
-            t0 = time.perf_counter()
-            kw = {"return_numpy": False} if rep.nonblocking else {}
-            with rep.lock:
-                with profiler.RecordEvent("serving/%s/batch" % self.name):
-                    outs = rep.predictor.run_padded(
-                        padded, n_valid=valid, **kw)
-            recompiled = rep.predictor.jit_cache_stats()["misses"] > misses0
+            with contextlib.ExitStack() as stack:
+                if cap is not None:
+                    stack.enter_context(_mon_spans.capture(cap))
+                if tids or cap is not None:
+                    now = time.perf_counter()
+                    for r in batch:
+                        # per-request queue wait: submit -> picked up
+                        # here, each span owning its single trace id
+                        with _mon_spans.trace_context(
+                                (r.trace_id,) if r.trace_id else ()):
+                            _mon_spans.record_span(
+                                "serving/queue_wait", r.submit_t,
+                                now - r.submit_t, cat="serving",
+                                server=self.name, replica=rep.name,
+                                n_rows=r.n_rows)
+                    stack.enter_context(_mon_spans.trace_context(tids))
+                merged = {
+                    name: (
+                        np.concatenate([r.feed[name] for r in batch], axis=0)
+                        if len(batch) > 1 else batch[0].feed[name])
+                    for name in self._feed_names
+                }
+                bucket = self._policy.bucket_for(valid)
+                padded = self._policy.pad_feed(merged, bucket)
+                misses0 = rep.predictor.jit_cache_stats()["misses"]
+                t0 = time.perf_counter()
+                kw = {"return_numpy": False} if rep.nonblocking else {}
+                with rep.lock:
+                    with profiler.RecordEvent("serving/%s/batch" % self.name):
+                        outs = rep.predictor.run_padded(
+                            padded, n_valid=valid, **kw)
+                recompiled = (
+                    rep.predictor.jit_cache_stats()["misses"] > misses0)
         except BaseException as exc:  # noqa: BLE001 — reroute/fail, keep serving
-            self._replica_failure(rep, batch, retries, exc)
+            self._replica_failure(rep, batch, retries, exc, cap=cap)
             return None
-        return (batch, outs, valid, bucket, t0, recompiled, retries)
+        return (batch, outs, valid, bucket, t0, recompiled, retries, cap)
     # hot-path: end serve_execute
 
     def _replica_failure(self, rep: _Replica, batch: List[ServingRequest],
-                         retries: int, exc: BaseException) -> None:
+                         retries: int, exc: BaseException,
+                         cap: Optional[list] = None) -> None:
         """A batch failed on ``rep``: retire the replica when it fails
         repeatedly, and re-route the batch to a surviving replica so
         accepted requests don't drop — only with no survivor (or no
-        retry budget) do the requests fail."""
+        retry budget) do the requests fail.  Terminally-failed requests
+        are always tail-sampled (with whatever spans the batch captured
+        before dying); a re-routed batch is not recorded here — it may
+        still complete cleanly on the survivor."""
         rep.failed += 1
         rep.consec_failures += 1
         if rep.consec_failures >= _REPLICA_FAIL_LIMIT and rep.alive:
+            # an incident marker ONLY for failure retirement (the clean
+            # shutdown path also retires replicas — that is not an
+            # incident); near-zero cost, gated on recording
+            monitor.record_instant(
+                "serving/replica_retired", cat="serving",
+                server=self.name, replica=rep.name)
             self._retire_replica(rep)
         self._release(rep)
         with self._route_cv:
             survivors = any(
                 r.alive and r is not rep for r in self._replicas)
         if retries > 0 and survivors:
-            self._metrics.count("requeued")
+            self._count_requeue(rep)
             self._route(batch, retries - 1, exclude=rep)
             return
         self._metrics.count("failed", len(batch))
+        fr = _flight.get()
+        if fr is not None:
+            now = time.perf_counter()
+            for r in batch:
+                fr.consider(
+                    r.trace_id, now - r.submit_t, "error", cap or (),
+                    server=self.name, replica=rep.name,
+                    error=repr(exc))
         for r in batch:
             r.fail(exc)
 
     def _finalize(self, rep: _Replica, batch: List[ServingRequest], outs,
                   valid: int, bucket: int, t0: float, recompiled: bool,
-                  retries: int) -> None:
+                  retries: int, cap: Optional[list] = None) -> None:
         """Materialize a dispatched batch (the d2h sync) and complete its
         requests.  Deferred XLA runtime errors surface here — same
         reroute-or-fail handling as a dispatch failure.  The batch is
         observed HERE so ``run_s`` spans dispatch -> outputs materialized
         (the real batch duration; timing only the async dispatch call
-        would report ~0)."""
+        would report ~0).  ``cap``: the span buffer _execute captured
+        for this batch (flight recorder live) — the materialize span
+        joins it, then each request is tail-sampled."""
+        tids = ()
+        rec = cap is not None or _mon_spans.recording()
+        if rec:
+            tids = tuple(r.trace_id for r in batch if r.trace_id)
         try:
-            outs = [np.asarray(o) for o in outs]
+            with contextlib.ExitStack() as stack:
+                if cap is not None:
+                    stack.enter_context(_mon_spans.capture(cap))
+                if tids:
+                    stack.enter_context(_mon_spans.trace_context(tids))
+                if rec:
+                    m0 = time.perf_counter()
+                outs = [np.asarray(o) for o in outs]
+                if rec:
+                    _mon_spans.record_span(
+                        "serving/materialize", m0,
+                        time.perf_counter() - m0, cat="serving",
+                        server=self.name, replica=rep.name)
         except BaseException as exc:  # noqa: BLE001
-            self._replica_failure(rep, batch, retries, exc)
+            self._replica_failure(rep, batch, retries, exc, cap=cap)
             return
         rep.executed += 1
         rep.consec_failures = 0
@@ -669,7 +802,17 @@ class InferenceServer:
             ]
             off += r.n_rows
             r.complete(per_req)
-            self._metrics.observe_request(now - r.submit_t)
+            self._metrics.observe_request(now - r.submit_t,
+                                          trace_id=r.trace_id)
+        fr = _flight.get() if cap is not None else None
+        if fr is not None:
+            # tail-sampling decision per request: slow ones keep the
+            # batch's full span tree (shared spans, per-request record)
+            for r in batch:
+                fr.consider(
+                    r.trace_id, now - r.submit_t, "ok", cap,
+                    server=self.name, replica=rep.name,
+                    bucket=int(bucket), n_rows=int(r.n_rows))
         self._release(rep)
 
     # ------------------------------------------------------------------
@@ -685,7 +828,10 @@ class InferenceServer:
         if admin is not None:
             admin.shutdown()
             admin.server_close()
-        if not drain:
+        if drain:
+            monitor.record_instant(
+                "serving/server_drain", cat="serving", server=self.name)
+        else:
             # empty the queue before releasing the dispatcher so it
             # cannot route work we are abandoning
             self._abort = True

@@ -304,6 +304,47 @@ def test_device_buffered_clean_shutdown_and_stall_counters():
     assert monitor.counter_value("reader_producer_stalls_total") > p0
 
 
+def test_sharded_prefetch_stall_counters_fire():
+    """The reader pipeline-health counters must fire on the SHARDED
+    ``device_buffered(compiled=...)`` path exactly like the single-device
+    one (PR 4 added the sharded producer; the stall accounting lives in
+    the shared _Prefetcher, but a regression that forked the sharded
+    path off it would silently blind /statusz to fleet input stalls)."""
+    import time as _time
+
+    from paddle_tpu import monitor, reader as R
+    from paddle_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.data_parallel_mesh()
+
+    # slow producer + fast consumer: the consumer stalls on an empty
+    # queue while the sharded device_put staging lags behind
+    def slow_src():
+        for i in range(5):
+            _time.sleep(0.01)
+            yield {"x": np.full((8, 2), i, np.float32)}
+
+    c0 = monitor.counter_value("reader_consumer_stalls_total")
+    cs0 = monitor.counter_value("reader_consumer_stall_seconds_total")
+    out = list(R.device_buffered(slow_src, size=2, compiled=mesh)())
+    assert len(out) == 5
+    assert len(out[0]["x"].sharding.device_set) == int(mesh.devices.size)
+    assert monitor.counter_value("reader_consumer_stalls_total") - c0 >= 3
+    assert monitor.counter_value("reader_consumer_stall_seconds_total") > cs0
+
+    # fast producer + stalled consumer: backpressure on the full queue
+    def fast_src():
+        for i in range(50):
+            yield {"x": np.full((8, 2), i, np.float32)}
+
+    p0 = monitor.counter_value("reader_producer_stalls_total")
+    gen = R.device_buffered(fast_src, size=2, compiled=mesh)()
+    next(gen)
+    _time.sleep(0.2)  # producer fills the size-2 queue and blocks
+    assert monitor.counter_value("reader_producer_stalls_total") > p0
+    gen.close()
+
+
 def test_train_from_dataset_prefetch_no_thread_leak():
     """Consumer dying mid-epoch must terminate the prefetch producer —
     the old inline queue left it blocked on q.put forever."""

@@ -1,0 +1,45 @@
+"""Tier-1 wiring for tools/check_metrics_docs.py: every metric the
+codebase registers must be listed in README's Observability metrics
+table and vice versa — and the checker itself must actually catch a
+drifted table (a guard that matches nothing would pass forever).
+"""
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+
+import check_metrics_docs  # noqa: E402
+
+
+def test_registry_and_readme_agree():
+    undocumented, stale = check_metrics_docs.check(REPO_ROOT)
+    assert not undocumented, (
+        "metrics registered but missing from README's Observability "
+        "table: %s" % sorted(undocumented))
+    assert not stale, (
+        "README Observability table rows with no live metric: %s"
+        % sorted(stale))
+
+
+def test_readme_table_parser_sees_rows():
+    """The row regex must actually match the README's table format —
+    a silent format drift would empty the documented set and flip every
+    metric to 'undocumented' (loud) OR empty both sides (silent); pin
+    the parser against a known row and the live README."""
+    rows = check_metrics_docs.documented_metrics(
+        os.path.join(REPO_ROOT, "README.md"))
+    assert len(rows) >= 20, "README metrics table went missing or unparsable"
+    assert "executor_runs_total" in rows
+
+
+def test_checker_catches_stale_and_undocumented(tmp_path):
+    readme = tmp_path / "README.md"
+    readme.write_text(
+        "| `executor_runs_total` | counter | runs |\n"
+        "| `no_such_metric_total` | counter | ghost |\n")
+    documented = check_metrics_docs.documented_metrics(str(readme))
+    assert documented == {"executor_runs_total", "no_such_metric_total"}
+    registered = check_metrics_docs.registered_metrics()
+    assert "no_such_metric_total" not in registered
+    assert "executor_runs_total" in registered

@@ -332,6 +332,94 @@ def test_merged_chrome_trace_lenet_train_plus_serving(tmp_path):
     assert durations  # something measurable actually landed
 
 
+def test_merged_trace_with_device_timeline_two_replica_fleet(tmp_path):
+    """The PR-5 acceptance trace: a LeNet train loop + a 2-replica
+    serving run produce ONE trace.json holding the client ->
+    queue-wait -> replica -> executor span chain (every hop sharing the
+    request's trace id), named replica worker lanes, AND time-aligned
+    device-side events ingested from the jax.profiler trace dir."""
+    from paddle_tpu.monitor.chrome_trace import _DEVICE_PID_BASE
+    from paddle_tpu.serving import Client
+
+    jsonl = str(tmp_path / "events.jsonl")
+    trace_path = str(tmp_path / "trace.json")
+    prof_dir = str(tmp_path / "prof")
+
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 11
+    with framework.program_guard(prog, startup):
+        img = fluid.layers.data("img", [1, 28, 28])
+        lbl = fluid.layers.data("lbl", [1], dtype="int64")
+        avg_loss, _, _ = models.lenet5(img, lbl)
+        fluid.optimizer.SGDOptimizer(learning_rate=0.001).minimize(avg_loss)
+    rng = np.random.RandomState(0)
+    feed = {
+        "img": rng.uniform(-1, 1, (8, 1, 28, 28)).astype("float32"),
+        "lbl": rng.randint(0, 10, (8, 1)).astype("int64"),
+    }
+    mlp_dir = str(tmp_path / "mlp")
+    _save_mlp(mlp_dir)
+
+    with monitor.trace_session(path=trace_path, jsonl_path=jsonl,
+                               device_trace_dir=prof_dir) as sess:
+        profiler.start_jsonl_trace(jsonl)
+        profiler.start_profiler(trace_dir=prof_dir)
+        try:
+            exe = fluid.Executor(fluid.CPUPlace())
+            with fluid.scope_guard(fluid.Scope()):
+                exe.run(startup)
+                for _ in range(2):
+                    exe.run(prog, feed=feed, fetch_list=[avg_loss])
+            server = InferenceServer(
+                [create_paddle_predictor(AnalysisConfig(mlp_dir)),
+                 create_paddle_predictor(AnalysisConfig(mlp_dir))],
+                max_batch_size=2, batch_timeout_ms=1, name="fleet2")
+            try:
+                server.warmup()
+                cli = Client(server)
+                for i in range(4):
+                    cli.infer({"x": np.zeros((1, IN_DIM), "float32")},
+                              trace_id="f1ee7%011d" % i)
+            finally:
+                server.stop()
+        finally:
+            profiler.stop_profiler(profile_path=str(tmp_path / "prof.txt"))
+            profiler.stop_jsonl_trace()
+
+    data = json.load(open(trace_path))  # loadable JSON
+    events = data["traceEvents"]
+    names = {e["name"] for e in events}
+    # the full host-side chain, one file
+    assert {"serving/client_infer", "serving/queue_wait",
+            "predictor/run_padded", "serving/materialize",
+            "executor/h2d_feed", "executor/device_execute"} <= names
+    # one request's trace id on every hop of its chain
+    tid = "f1ee7%011d" % 0
+    chain = {e["name"] for e in events
+             if tid in (e.get("args", {}).get("trace_ids") or ())}
+    assert {"serving/client_infer", "serving/queue_wait",
+            "predictor/run_padded", "serving/materialize"} <= chain
+    assert chain & {"executor/device_execute", "executor/jit_compile"}
+    # replica workers render as named parallel lanes
+    lanes = {e["args"]["name"] for e in events
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert {"serving/fleet2/r0 worker", "serving/fleet2/r1 worker",
+            "serving/fleet2/dispatcher"} <= lanes
+    # device-side events ingested from the jax.profiler dir, rebased
+    # onto the shared (non-negative) timebase
+    device_events = [e for e in events
+                     if e.get("pid", 0) >= _DEVICE_PID_BASE
+                     and e["ph"] != "M"]
+    assert device_events, "no device-side events merged"
+    assert all(e["ts"] >= 0 for e in device_events if "ts" in e)
+    # both sources overlap in time (alignment sanity: the device window
+    # must intersect the host window, not sit off to one side)
+    host_ts = [e["ts"] for e in events
+               if e.get("pid", 0) < _DEVICE_PID_BASE and e["ph"] == "X"]
+    dev_ts = [e["ts"] for e in device_events if "ts" in e]
+    assert min(dev_ts) <= max(host_ts) and min(host_ts) <= max(dev_ts)
+
+
 # ---------------------------------------------------------------------------
 # serving admin surface: /metrics + /statusz
 # ---------------------------------------------------------------------------
@@ -447,6 +535,125 @@ def test_trace_session_ring_buffer_drop_oldest():
 
     with pytest.raises(ValueError):
         monitor.start_recording(max_spans=0)
+
+
+def test_openmetrics_exposition_format():
+    """OpenMetrics 1.0: counter families drop the _total suffix in
+    HELP/TYPE (samples keep it), histogram buckets may carry exemplars,
+    and the document ends with # EOF."""
+    reg = MetricsRegistry()
+    reg.counter("rpc_total", "total rpcs", ("method",)).labels(
+        method="get").inc(3)
+    reg.gauge("temp", "degrees").set(1.5)
+    h = reg.histogram("dur_seconds", "latency", buckets=(0.5,))
+    h.observe(0.25, exemplar={"trace_id": "abc123"})
+    h.observe(2.0)
+    text = reg.render_openmetrics()
+    lines = text.splitlines()
+    assert "# TYPE rpc counter" in lines          # family name, no _total
+    assert "# HELP rpc total rpcs" in lines
+    assert 'rpc_total{method="get"} 3' in lines   # sample keeps _total
+    assert "# TYPE temp gauge" in lines and "temp 1.5" in lines
+    assert "# TYPE dur_seconds histogram" in lines
+    # the 0.25 observation's exemplar rides its bucket line
+    ex = [l for l in lines if l.startswith('dur_seconds_bucket{le="0.5"}')]
+    assert len(ex) == 1 and '# {trace_id="abc123"} 0.25' in ex[0]
+    assert 'dur_seconds_bucket{le="+Inf"} 2' in lines
+    assert lines[-1] == "# EOF"
+    body, ctype = reg.expose(openmetrics=True)
+    assert body == text and ctype.startswith("application/openmetrics-text")
+    body, ctype = reg.expose()
+    assert ctype.startswith("text/plain") and body == reg.render_text()
+
+
+def test_flight_recorder_ring_and_merge_semantics():
+    from paddle_tpu.monitor.flight import FlightRecorder
+
+    rec = FlightRecorder(capacity=3, slow_ms=10.0)
+    assert rec.consider("t1", 0.005, "ok", ()) is False       # fast: dropped
+    assert rec.consider("t2", 0.020, "ok", ()) is True        # slow: kept
+    assert rec.consider("t3", 0.001, "error", ()) is True     # errored: kept
+    assert rec.consider("t4", 0.001, "deadline", ()) is True  # deadline: kept
+    # merge into an existing record: status upgrades, spans append
+    assert rec.consider("t2", 0.001, "error",
+                        [{"name": "late", "ts": 0.0, "dur": 0.0}]) is True
+    r2 = rec.get_record("t2")
+    assert r2["status"] == "error" and r2["latency_ms"] == 20.0
+    assert [s["name"] for s in r2["spans"]] == ["late"]
+    # capacity 3: a fourth retained record evicts the oldest (t2)
+    assert rec.consider("t5", 0.500, "ok", ()) is True
+    assert rec.get_record("t2") is None
+    assert len(rec) == 3
+    assert [r["trace_id"] for r in rec.snapshot()] == ["t5", "t4", "t3"]
+    assert rec.add_span("t5", {"name": "x", "ts": 1.0, "dur": 0.1})
+    assert not rec.add_span("gone", {"name": "x"})
+    doc = rec.statusz()
+    assert doc["retained"] == 3 and doc["capacity"] == 3
+    json.dumps(doc)  # /tracez must be JSON-serializable
+
+
+def test_flight_recorder_chrome_export(tmp_path):
+    from paddle_tpu.monitor.flight import FlightRecorder
+
+    rec = FlightRecorder(capacity=4, slow_ms=0.0)
+    rec.consider("tt00000000000001", 0.05, "ok", [
+        {"name": "serving/queue_wait", "ts": 100.0, "dur": 0.01,
+         "tid": 1, "cat": "serving", "trace_ids": ["tt00000000000001"]},
+        {"name": "executor/device_execute", "ts": 100.01, "dur": 0.04,
+         "tid": 2, "cat": "execute", "trace_ids": ["tt00000000000001"]},
+    ])
+    path = rec.export_chrome_trace(str(tmp_path / "flight.json"))
+    data = json.load(open(path))
+    evs = [e for e in data["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in evs} == {
+        "serving/queue_wait", "executor/device_execute"}
+    assert all(e["args"]["trace_ids"] == ["tt00000000000001"] for e in evs)
+
+
+def test_push_gateway_delivers_exposition(tmp_path):
+    """The push loop PUTs the exposition to <url>/metrics/job/<job>,
+    pushes a final snapshot on stop, and never raises on a dead
+    gateway."""
+    import http.server
+
+    bodies, paths = [], []
+
+    class _Gw(http.server.BaseHTTPRequestHandler):
+        def do_PUT(self):
+            n = int(self.headers.get("Content-Length", 0))
+            bodies.append(self.rfile.read(n).decode())
+            paths.append(self.path)
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    gw = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Gw)
+    t = threading.Thread(target=gw.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = "http://127.0.0.1:%d" % gw.server_address[1]
+        pusher = monitor.push_gateway(url, interval_s=0.05, job="bench job")
+        deadline = time.monotonic() + 10
+        while not bodies and time.monotonic() < deadline:
+            time.sleep(0.01)
+        pusher.stop()  # final push
+        assert bodies, "no push arrived"
+        assert paths[0] == "/metrics/job/bench%20job"
+        assert "# TYPE executor_runs_total counter" in bodies[0]
+        pushes = monitor.counter_value("monitor_push_total")
+        assert pushes >= 2  # at least one interval push + the final one
+    finally:
+        gw.shutdown()
+        gw.server_close()
+    # dead gateway: push_now reports failure, raises nothing
+    dead = monitor.push_gateway(
+        "http://127.0.0.1:1", interval_s=60, timeout_s=0.2)
+    errs0 = monitor.counter_value("monitor_push_errors_total")
+    assert dead.push_now() is False
+    assert monitor.counter_value("monitor_push_errors_total") == errs0 + 1
+    dead.stop(push_final=False)
 
 
 def test_plan_cache_counters_and_dispatch_histogram():

@@ -545,3 +545,196 @@ class ServingRequestStub:
 
     def expired(self, now=None):
         return False
+
+
+# ---------------------------------------------------------------------------
+# request-scoped tracing (PR 5): trace-id propagation + flight recorder
+# ---------------------------------------------------------------------------
+def test_trace_id_propagates_client_to_executor_spans(predictor):
+    """One trace id, minted at the client, must appear on every span in
+    the chain: client span, queue wait, predictor hop, and the
+    executor's h2d/execute phases recorded on the replica thread."""
+    from paddle_tpu import monitor
+
+    server = InferenceServer(
+        predictor, max_batch_size=4, batch_timeout_ms=1, name="tracey")
+    try:
+        server.warmup()
+        cli = Client(server)
+        with monitor.trace_session() as sess:
+            cli.infer({"x": _rows(2, seed=9)}, trace_id="feedbeef00000001")
+        # client minted a fresh id when not given one
+        out = cli.infer({"x": _rows(1)})
+        assert len(out) == 1 and len(cli.last_trace_id) == 16
+    finally:
+        server.stop()
+    by_name = {}
+    for s in sess.spans:
+        if "feedbeef00000001" in (s.get("trace_ids") or ()):
+            by_name.setdefault(s["name"], []).append(s)
+    assert "serving/client_infer" in by_name
+    assert "serving/queue_wait" in by_name
+    assert "predictor/run_padded" in by_name
+    assert "serving/materialize" in by_name
+    assert "executor/h2d_feed" in by_name
+    # warmup ran before the session; the traced request executes from
+    # the jit cache
+    assert "executor/device_execute" in by_name
+    # the client span covers the whole request; queue wait nests inside
+    q = by_name["serving/queue_wait"][0]
+    c = by_name["serving/client_infer"][0]
+    assert c["dur"] >= q["dur"] >= 0
+
+
+def test_flight_recorder_tail_samples_slow_requests(predictor):
+    """Tail sampling: with a recorder installed, a slow request's full
+    span tree is retained (keyed by its trace id) and served by
+    /tracez; fast requests under slow_ms are not."""
+    import json as _json
+    import urllib.request
+
+    from paddle_tpu import monitor
+    from paddle_tpu.monitor import flight as _flight
+
+    slow = SlowPredictor(delay_s=0.05)
+    server = InferenceServer(
+        slow, max_batch_size=2, batch_timeout_ms=1, name="flighty")
+    with monitor.flight_recorder(capacity=16, slow_ms=20.0) as rec:
+        try:
+            server.warmup(configure_cache=False)
+            cli = Client(server)
+            cli.infer({"x": _rows(1)}, trace_id="aaaa000011112222")
+            record = rec.get_record("aaaa000011112222")
+            assert record is not None, "50ms request above slow_ms=20 dropped"
+            names = [s["name"] for s in record["spans"]]
+            assert "serving/queue_wait" in names
+            assert "serving/materialize" in names
+            assert "serving/client_infer" in names  # attached post-result
+            assert record["status"] == "ok"
+            assert record["latency_ms"] >= 20.0
+            assert record["replica"] == "r0"
+
+            host, port = server.start_admin(port=0)
+            with urllib.request.urlopen(
+                    "http://%s:%d/tracez" % (host, port), timeout=10) as resp:
+                doc = _json.load(resp)
+            assert doc["recorder"] is True
+            assert any(r["trace_id"] == "aaaa000011112222"
+                       for r in doc["requests"])
+
+            # a fast request stays below the threshold -> not retained
+            slow.delay_s = 0.0
+            cli.infer({"x": _rows(1)}, trace_id="bbbb000011112222")
+            assert rec.get_record("bbbb000011112222") is None
+        finally:
+            server.stop()
+    assert _flight.get() is None  # context exit uninstalls
+
+
+def test_flight_recorder_retains_deadline_missed_requests():
+    from paddle_tpu import monitor
+
+    slow = SlowPredictor(delay_s=0.3)
+    server = InferenceServer(
+        slow, max_batch_size=4, batch_timeout_ms=1, queue_capacity=8,
+        name="flightdl")
+    with monitor.flight_recorder(capacity=16, slow_ms=1e9) as rec:
+        try:
+            blocker = server.submit({"x": _rows(1)})
+            time.sleep(0.1)
+            fut = server.submit({"x": _rows(1)},
+                                timeout_ms=40, trace_id="dead000011112222")
+            with pytest.raises(DeadlineExceeded):
+                fut.result()
+            blocker.result(timeout=5)
+            deadline = time.monotonic() + 5
+            while (rec.get_record("dead000011112222") is None
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            record = rec.get_record("dead000011112222")
+            assert record is not None and record["status"] == "deadline"
+        finally:
+            server.stop()
+
+
+def test_openmetrics_exemplar_links_latency_bucket_to_trace(predictor):
+    """The OpenMetrics exposition must carry a trace_id exemplar on the
+    latency histogram bucket the traced request landed in."""
+    from paddle_tpu import monitor
+
+    server = InferenceServer(
+        predictor, max_batch_size=2, batch_timeout_ms=1, name="exemplary")
+    try:
+        server.warmup()
+        Client(server).infer({"x": _rows(1)}, trace_id="cafe000011112222")
+        text = monitor.render_openmetrics()
+        lat_lines = [l for l in text.splitlines()
+                     if l.startswith("serving_request_latency_seconds_bucket")
+                     and 'server="exemplary"' in l]
+        assert any('# {trace_id="cafe000011112222"}' in l for l in lat_lines), (
+            "no exemplar found:\n" + "\n".join(lat_lines))
+        assert text.rstrip().endswith("# EOF")
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# serving lifecycle markers (PR 5): incidents visible on the timeline
+# ---------------------------------------------------------------------------
+def test_lifecycle_markers_agree_with_requeue_counter():
+    """Replica retirement / batch requeue / graceful drain emit instant
+    trace markers carrying the replica id, and the requeue markers agree
+    with the serving_requeued_total counter delta."""
+    from paddle_tpu import monitor
+
+    p0, p1 = KillablePredictor(0.02), KillablePredictor(0.02)
+    server = InferenceServer(
+        [p0, p1], max_batch_size=1, batch_timeout_ms=1,
+        queue_capacity=128, name="marktest")
+    with monitor.trace_session() as sess:
+        try:
+            server.warmup(configure_cache=False)
+            requeued0 = monitor.counter_value(
+                "serving_requeued_total", server="marktest")
+            futs = []
+            for i in range(30):
+                futs.append(_storm(server, 1, start_val=i)[0])
+                if i == 10:
+                    p0.killed = True
+            for _, fut in futs:
+                fut.result(timeout=30)
+            requeued = monitor.counter_value(
+                "serving_requeued_total", server="marktest") - requeued0
+        finally:
+            server.stop(drain=True)
+    markers = [s for s in sess.spans
+               if s.get("args", {}).get("instant")
+               and s["args"].get("server") == "marktest"]
+    retire = [m for m in markers if m["name"] == "serving/replica_retired"]
+    requeue = [m for m in markers if m["name"] == "serving/batch_requeue"]
+    drain = [m for m in markers if m["name"] == "serving/server_drain"]
+    assert len(retire) == 1 and retire[0]["args"]["replica"] == "r0"
+    assert requeued >= 1
+    assert len(requeue) == requeued, (
+        "counter says %d requeues, timeline shows %d markers"
+        % (requeued, len(requeue)))
+    assert all(m["args"]["replica"] == "r0" for m in requeue)
+    assert len(drain) == 1  # stop(drain=True)
+
+
+def test_remove_replica_emits_drain_marker():
+    from paddle_tpu import monitor
+
+    pa, pb = SlowPredictor(0.01), SlowPredictor(0.01)
+    server = InferenceServer(
+        [pa, pb], max_batch_size=1, batch_timeout_ms=1, name="drainmark")
+    with monitor.trace_session() as sess:
+        try:
+            server.warmup(configure_cache=False)
+            server.remove_replica("r0")
+        finally:
+            server.stop(drain=True)
+    drains = [s for s in sess.spans
+              if s["name"] == "serving/replica_drain"
+              and s.get("args", {}).get("server") == "drainmark"]
+    assert len(drains) == 1 and drains[0]["args"]["replica"] == "r0"
