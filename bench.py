@@ -213,14 +213,19 @@ def _merge_cal(res, cal):
 # framework_overhead_pct from the driver line; deepfm finishes far
 # inside 480 s (ADVICE r5).  Rebalanced r7 (nmt 780->690): frees 90 s
 # for the new dispatch_sharded stage (a CPU-mesh micro-bench that
-# finishes in well under a minute even cold).
-_BUDGETS = {"probe": 90, "bert": 900, "resnet": 780, "cal": 540, "nmt": 690,
-            "deepfm": 480, "dispatch_sharded": 90}
+# finishes in well under a minute even cold).  Rebalanced r8 (resnet
+# 780->750, cal 540->510, nmt 690->660, deepfm 480->450): frees 120 s
+# for the serving_wire stage (LeNet+DeepFM wire-tax measurement over
+# loopback TCP; its endpoints compile through the persistent cache, so
+# it finishes well inside the budget even cold).
+_BUDGETS = {"probe": 90, "bert": 900, "resnet": 750, "cal": 510, "nmt": 660,
+            "deepfm": 450, "dispatch_sharded": 90, "serving_wire": 120}
 # set to a reduced table when the liveness probe fails: with the backend
 # known-wedged, burning every stage's full budget buys nothing — short
 # budgets still let a recovering tunnel produce numbers
 _DEGRADED_BUDGETS = {"probe": 90, "bert": 300, "resnet": 240, "cal": 150,
-                     "nmt": 150, "deepfm": 150, "dispatch_sharded": 60}
+                     "nmt": 150, "deepfm": 150, "dispatch_sharded": 60,
+                     "serving_wire": 60}
 _active_budgets = _BUDGETS
 
 
@@ -354,6 +359,8 @@ def _orchestrate():
         _emit(line)
         line["dispatch_sharded"] = _dispatch_sharded_block()
         _emit(line)
+        line["serving_wire"] = _serving_wire_block()
+        _emit(line)
         return
 
     _emit(line)  # headline secured before any other stage can hang
@@ -365,6 +372,8 @@ def _orchestrate():
     line["deepfm"] = _run_sub("deepfm")
     _emit(line)
     line["dispatch_sharded"] = _dispatch_sharded_block()
+    _emit(line)
+    line["serving_wire"] = _serving_wire_block()
     _emit(line)
 
 
@@ -399,6 +408,21 @@ def _dispatch_sharded_block():
         "BENCH_PLATFORM": "cpu",
         "JAX_PLATFORMS": "cpu",
         "XLA_FLAGS": xla_flags,
+    })
+
+
+def _serving_wire_block():
+    """Wire-tax measurement (bench_serving --wire loopback): the same
+    serving endpoints in-process vs over loopback TCP through launched
+    child processes — the p50/p99 delta IS the network-edge cost.  Runs
+    on CPU with trimmed storm sizes: the metric is a host-side latency
+    delta, not accelerator throughput."""
+    return _run_sub("serving_wire", {
+        "BENCH_SERVING_WIRE": "loopback",
+        "BENCH_SERVING_THREADS": os.environ.get(
+            "BENCH_SERVING_THREADS", "4"),
+        "BENCH_SERVING_REQUESTS": os.environ.get(
+            "BENCH_SERVING_REQUESTS", "50"),
     })
 
 
@@ -461,6 +485,10 @@ def main():
         import bench_dispatch
 
         line = bench_dispatch.run_sharded()
+    elif model == "serving_wire":
+        import bench_serving
+
+        line = bench_serving.run_wire()
     elif model == "cal":
         line = _run_cal()
     else:

@@ -27,6 +27,16 @@ phases, one trace id each) to PATH alongside the JSON line — the
 latency tail, explained.  Without it the bench asserts the recorder
 stays absent and every span gate off: zero recorder overhead on the
 measured warm path.
+
+``--wire loopback`` (or $BENCH_SERVING_WIRE=loopback) measures the
+WIRE TAX instead: each endpoint is benched in-process AND over
+loopback TCP through a launched serving child
+(``paddle_tpu.serving.wire``), and the JSON line reports the
+client-observed p50/p99 for both plus their delta
+(``wire_tax_p50_ms``/``wire_tax_p99_ms``) — the cost of the codec +
+HTTP hop as a measured number.  The child warms up through the same
+persistent compile cache, and its recompile counter must stay 0
+(asserted via ``/statusz`` over the wire).
 """
 import json
 import os
@@ -185,6 +195,147 @@ def _bench_endpoint(name, save_fn):
         }
 
 
+def _bench_endpoint_wire(name, save_fn):
+    """Client-observed latency for one endpoint served by a launched
+    child process over loopback TCP (the wire half of the tax
+    measurement; the in-process half is _bench_endpoint)."""
+    from paddle_tpu.serving import wire
+
+    with tempfile.TemporaryDirectory() as tmp:
+        d = os.path.join(tmp, name)
+        make_rows = save_fn(d)
+        handle = wire.launch_server(
+            d, name="%s-wire" % name, max_batch_size=MAX_BATCH,
+            batch_timeout_ms=TIMEOUT_MS,
+            queue_capacity=max(64, THREADS * 8))
+        cli = wire.RemoteClient(handle.address)
+        try:
+            t0 = time.perf_counter()
+            warmup_compiles = handle.warmup()
+            warmup_s = time.perf_counter() - t0
+
+            lats = [[] for _ in range(THREADS)]
+            shed = [0] * THREADS
+            start = threading.Barrier(THREADS + 1)
+
+            def storm(tid):
+                import paddle_tpu.serving as serving
+
+                rng = np.random.RandomState(200 + tid)
+                start.wait()
+                for i in range(REQUESTS):
+                    n = REQ_SIZES[(tid + i) % len(REQ_SIZES)]
+                    feed = make_rows(n, rng)
+                    r0 = time.perf_counter()
+                    try:
+                        cli.infer(feed)
+                        lats[tid].append(time.perf_counter() - r0)
+                    except serving.ServerOverloaded:
+                        shed[tid] += 1
+
+            threads = [threading.Thread(target=storm, args=(t,))
+                       for t in range(THREADS)]
+            for t in threads:
+                t.start()
+            start.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t0
+
+            status = wire.HttpTransport(*handle.address).get_json("/statusz")
+            recompiles = status["metrics"]["recompiles"]
+            if recompiles != 0:
+                raise AssertionError(
+                    "wire endpoint %r recompiled after warmup: %s"
+                    % (name, recompiles))
+            all_lats = np.asarray(
+                [v for per in lats for v in per], dtype=np.float64)
+            rows = sum(
+                REQ_SIZES[(t + i) % len(REQ_SIZES)]
+                for t in range(THREADS)
+                for i in range(len(lats[t])))
+            return {
+                "rows_per_sec": round(rows / elapsed, 1),
+                "requests_per_sec": round(all_lats.size / elapsed, 1),
+                "latency_p50_ms": round(
+                    float(np.percentile(all_lats, 50)) * 1e3, 3),
+                "latency_p99_ms": round(
+                    float(np.percentile(all_lats, 99)) * 1e3, 3),
+                "completed": int(all_lats.size),
+                "shed": int(sum(shed)),
+                "server_metrics": {
+                    k: status["metrics"][k]
+                    for k in ("completed", "batches", "latency_p50_ms",
+                              "latency_p99_ms", "mean_batch_occupancy")},
+                "recompiles_after_warmup": int(recompiles),
+                "warmup_compiles": int(warmup_compiles),
+                "warmup_s": round(warmup_s, 2),
+                "elapsed_s": round(elapsed, 2),
+                "backend_pid": handle.pid,
+            }
+        finally:
+            cli.close()
+            handle.shutdown()
+
+
+def run_wire():
+    """The ``--wire loopback`` line: in-process vs loopback-TCP numbers
+    for the same endpoints, plus the measured wire tax."""
+    import jax
+
+    import bench_common
+
+    bench_common.configure_compile_cache(bench_common.HOME_CACHE_DIR)
+    endpoints = {}
+    for name, save_fn in (("lenet", _save_lenet), ("deepfm", _save_deepfm)):
+        inproc = _bench_endpoint(name, save_fn)
+        over_wire = _bench_endpoint_wire(name, save_fn)
+        endpoints[name] = {
+            "inprocess": inproc,
+            "wire": over_wire,
+            "wire_tax_p50_ms": round(
+                over_wire["latency_p50_ms"] - inproc["latency_p50_ms"], 3),
+            "wire_tax_p99_ms": round(
+                over_wire["latency_p99_ms"] - inproc["latency_p99_ms"], 3),
+        }
+    from paddle_tpu import monitor
+
+    # parent-side codec cost across the whole wire storm (the children
+    # have their own registries): histogram sum/count over both ops
+    codec = monitor.snapshot().get("wire_codec_seconds") or {}
+    codec_sum = sum(
+        s["value"]["sum"] for s in codec.get("series", ()))
+    codec_count = sum(
+        s["value"]["count"] for s in codec.get("series", ()))
+    return {
+        "metric": "serving_wire_tax",
+        "unit": "ms",
+        "value": endpoints["lenet"]["wire_tax_p50_ms"],
+        "endpoints": endpoints,
+        "codec_seconds_sum": round(codec_sum, 4),
+        "codec_messages": int(codec_count),
+        "threads": THREADS,
+        "requests_per_thread": REQUESTS,
+        "max_batch_size": MAX_BATCH,
+        "batch_timeout_ms": TIMEOUT_MS,
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def _wire_mode(argv=None):
+    """``--wire loopback`` / $BENCH_SERVING_WIRE."""
+    import sys
+
+    argv = sys.argv[1:] if argv is None else argv
+    for i, a in enumerate(argv):
+        if a == "--wire" and i + 1 < len(argv):
+            return argv[i + 1]
+        if a.startswith("--wire="):
+            return a.split("=", 1)[1]
+    return os.environ.get("BENCH_SERVING_WIRE")
+
+
 def _dump_flight_trace(recorder, path):
     """Write the slowest 1% of bench requests (by client-observed
     latency) with their full span trees — the /tracez document shape,
@@ -252,6 +403,12 @@ def main():
 
     # --metrics-out <path> (or $BENCH_METRICS_OUT) dumps the monitor
     # registry snapshot next to the JSON line
+    mode = _wire_mode()
+    if mode:
+        if mode != "loopback":
+            raise SystemExit("--wire supports only 'loopback' (got %r)" % mode)
+        bench_common.emit_result(run_wire())
+        return
     bench_common.emit_result(run())
 
 

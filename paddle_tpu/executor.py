@@ -991,7 +991,7 @@ class Executor:
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
                            fetch_info=None, print_period=100,
-                           trainer_desc=None):
+                           trainer_desc=None, trace_id=None):
         """Loop the dataset's batches through run() (reference:
         executor.py train_from_dataset -> C++ Trainer/DeviceWorker loop,
         trainer.h:38; here the compiled step is the device worker).
@@ -999,7 +999,16 @@ class Executor:
         ``trainer_desc`` (trainer_desc.py): supplies fetch config
         defaults and validates that the chosen device worker matches the
         program (Section needs a PipelineOptimizer-cut program,
-        DownpourSGD needs distributed lookup tables)."""
+        DownpourSGD needs distributed lookup tables).
+
+        Request-scoped tracing (TPU-native extension): the epoch mints a
+        trace id (or joins ``trace_id``) readable back via
+        ``last_train_trace_id``; while a trace session or flight
+        recorder is live, every step runs under that id inside an
+        ``executor/train_step`` span parented to one
+        ``executor/train_epoch`` span — a training epoch is correlatable
+        in ``/tracez``/the merged Chrome trace exactly like a serving
+        request."""
         n_prefetch = int(thread)
         if trainer_desc is not None:
             worker = trainer_desc._worker
@@ -1058,16 +1067,51 @@ class Executor:
         if ps_ctx is not None and not ps_ctx.get("sync", True):
             overlap_prev = ps_ctx.get("overlap_pull")
             ps_ctx["overlap_pull"] = True
+        # epoch trace id: minted per call (or joined via trace_id=) so a
+        # training epoch's span chain is correlatable like a serving
+        # request; the epoch span id parents every step span.  Gated per
+        # step on the same single recording() flag the run() phases use —
+        # the untraced loop pays two attribute checks, nothing else.
+        from paddle_tpu.monitor import flight as _mon_flight
+
+        tid = trace_id or _mon_flight.new_trace_id()
+        self.last_train_trace_id = tid
+        epoch_sid = None
+        epoch_t0 = None
+        n_steps = 0
         results = []
         try:
             for i, feed in enumerate(batches):
-                out = self.run(program, feed=feed, fetch_list=fetch_list, scope=scope)
+                if _mon_spans.recording():
+                    if epoch_sid is None:
+                        epoch_sid = _mon_spans.new_span_id()
+                        epoch_t0 = time.perf_counter()
+                    _t0 = time.perf_counter()
+                    with _mon_spans.trace_context((tid,)):
+                        with _mon_spans.parent_scope(epoch_sid):
+                            with _mon_spans.parent_scope() as step_sid:
+                                out = self.run(
+                                    program, feed=feed,
+                                    fetch_list=fetch_list, scope=scope)
+                            _mon_spans.record_span(
+                                "executor/train_step", _t0,
+                                time.perf_counter() - _t0, cat="train",
+                                span_id=step_sid, step=i)
+                else:
+                    out = self.run(program, feed=feed, fetch_list=fetch_list, scope=scope)
+                n_steps += 1
                 if fetch_list:
                     results.append(out)
                     if debug and i % print_period == 0:
                         names = fetch_info or [ _as_fetch_name(f) for f in fetch_list]
                         print("batch %d:" % i, dict(zip(names, [np.asarray(o) for o in out])))
         finally:
+            if epoch_sid is not None:
+                with _mon_spans.trace_context((tid,)):
+                    _mon_spans.record_span(
+                        "executor/train_epoch", epoch_t0,
+                        time.perf_counter() - epoch_t0, cat="train",
+                        span_id=epoch_sid, steps=n_steps)
             closer = getattr(batches, "close", None)
             if closer is not None:
                 closer()  # stop the prefetch producer (GeneratorExit path)

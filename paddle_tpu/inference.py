@@ -161,11 +161,23 @@ class AnalysisPredictor(PaddlePredictor):
         _rec = _mon_spans.recording()
         if _rec:
             _t0 = time.perf_counter()
-        outs = self.run(feed, return_numpy=return_numpy)
-        if _rec:
-            _mon_spans.record_span(
-                "predictor/run_padded", _t0, time.perf_counter() - _t0,
-                cat="predictor", padded=int(padded), n_valid=int(n_valid))
+            # push this hop's span id so the executor's h2d/execute/d2h
+            # spans record it as their parent (real hierarchy, not
+            # timestamp inference)
+            _sid = _mon_spans.push_parent()
+        _err = False
+        try:
+            outs = self.run(feed, return_numpy=return_numpy)
+        except BaseException:
+            _err = True
+            raise
+        finally:
+            if _rec:
+                _mon_spans.pop_parent()
+                _mon_spans.record_span(
+                    "predictor/run_padded", _t0, time.perf_counter() - _t0,
+                    cat="predictor", span_id=_sid, error=_err,
+                    padded=int(padded), n_valid=int(n_valid))
         if n_valid == padded:
             return outs
         return [

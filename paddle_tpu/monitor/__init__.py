@@ -63,7 +63,10 @@ from paddle_tpu.monitor import spans as _spans
 from paddle_tpu.monitor.flight import FlightRecorder, new_trace_id
 from paddle_tpu.monitor.push import PushGateway, push_gateway
 from paddle_tpu.monitor.spans import (
+    current_parent,
     current_trace_ids,
+    new_span_id,
+    parent_scope,
     record_instant,
     record_span,
     recording,
@@ -90,6 +93,7 @@ __all__ = [
     "span", "record_span", "record_instant", "recording",
     "start_recording", "stop_recording",
     "trace_context", "current_trace_ids", "set_thread_lane",
+    "new_span_id", "parent_scope", "current_parent",
     "new_trace_id", "flight_recorder", "FlightRecorder",
     "push_gateway", "PushGateway",
     "export_chrome_trace", "trace_session", "TraceSession",

@@ -152,6 +152,12 @@ def export_chrome_trace(
 
     events: List[Dict[str, object]] = []
     tids = set()
+    # span-id -> (ts_us, tid) of every exported span: parent edges that
+    # cross lanes (threads, or processes via a wire hop) get explicit
+    # flow arrows — the viewer draws the hierarchy instead of the reader
+    # inferring it from timestamps
+    span_sites: Dict[str, tuple] = {}
+    child_edges: List[tuple] = []  # (child_id, parent_id, ts_us, tid)
     for s in spans:
         if "ts" not in s:
             continue  # a torn/foreign span dict must not kill the export
@@ -171,6 +177,14 @@ def export_chrome_trace(
             "pid": pid,
             "tid": tid,
         }
+        if s.get("id"):
+            args["span_id"] = s["id"]
+            span_sites[str(s["id"])] = (ev["ts"], tid)
+        if s.get("parent"):
+            args["parent_id"] = s["parent"]
+            if s.get("id"):
+                child_edges.append(
+                    (str(s["id"]), str(s["parent"]), ev["ts"], tid))
         if args.pop("instant", None):
             ev["ph"] = "i"
             ev["s"] = "t"
@@ -178,6 +192,23 @@ def export_chrome_trace(
         if args:
             ev["args"] = args
         events.append(ev)
+
+    for child_id, parent_id, ts_us, tid in child_edges:
+        site = span_sites.get(parent_id)
+        if site is None or site[1] == tid:
+            continue  # same lane (nesting is visible) or parent not exported
+        try:
+            flow_id = int(child_id, 16) & 0x7FFFFFFF
+        except ValueError:
+            continue  # foreign span dict with a non-hex id
+        events.append({
+            "name": "span_parent", "cat": "flow", "ph": "s",
+            "ts": site[0], "pid": pid, "tid": site[1], "id": flow_id,
+        })
+        events.append({
+            "name": "span_parent", "cat": "flow", "ph": "f", "bp": "e",
+            "ts": max(ts_us, site[0]), "pid": pid, "tid": tid, "id": flow_id,
+        })
 
     _JSONL_TID = 0  # dedicated lane for the discrete event stream
     for rec in jsonl:

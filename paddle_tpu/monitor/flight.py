@@ -39,6 +39,7 @@ from paddle_tpu.monitor import registry as _registry
 
 __all__ = [
     "FlightRecorder", "new_trace_id", "install", "get", "uninstall",
+    "span_tree",
 ]
 
 # retention accounting: requests seen vs kept vs pushed off the ring —
@@ -58,6 +59,66 @@ _MON_EVICTED = _registry.REGISTRY.counter(
 def new_trace_id() -> str:
     """Mint a 16-hex-char request trace id (Dapper-style)."""
     return uuid.uuid4().hex[:16]
+
+
+def span_tree(spans: Sequence[Dict]) -> List[Dict]:
+    """Build the real hierarchy from a record's span dicts via their
+    explicit ``id``/``parent`` edges (no timestamp inference): returns a
+    forest of ``{"name", "span_id", "dur_ms", "children": [...]}`` nodes,
+    roots first by start time.  A span whose parent is not in the set
+    (e.g. the remote parent lives in another process's record half)
+    roots its own subtree.  Parent cycles are broken by promoting one
+    member per cycle to a root (its back-edge cut), so every span always
+    appears exactly once and the forest stays JSON-serializable."""
+    nodes, order = {}, []
+    for s in spans:
+        sid = s.get("id")
+        node = {
+            "name": s.get("name"),
+            "span_id": sid,
+            "parent_id": s.get("parent"),
+            "dur_ms": round(float(s.get("dur", 0.0)) * 1e3, 3),
+            "children": [],
+        }
+        if s.get("error"):
+            node["error"] = True
+        order.append(node)
+        if sid and sid not in nodes:
+            nodes[sid] = node
+    roots = []
+    parent_of = {}
+    for node in order:
+        parent = nodes.get(node.pop("parent_id", None))
+        if parent is None or parent is node:
+            roots.append(node)
+        else:
+            parent["children"].append(node)
+            parent_of[id(node)] = parent
+
+    def _mark(start, seen):
+        stack = [start]
+        while stack:
+            n = stack.pop()
+            if id(n) in seen:
+                continue
+            seen.add(id(n))
+            stack.extend(n["children"])
+
+    # parent CYCLES in foreign span dicts (a malformed peer) would leave
+    # every cycle member a child of another member — unreachable from
+    # any root.  Promote one entry node per unreachable component,
+    # CUTTING its back-edge (or the forest would be circular and refuse
+    # to serialize), so the result really degrades to roots instead of
+    # dropping spans.
+    reachable = set()
+    for r in roots:
+        _mark(r, reachable)
+    for node in order:
+        if id(node) not in reachable:
+            parent_of[id(node)]["children"].remove(node)
+            roots.append(node)
+            _mark(node, reachable)
+    return roots
 
 
 class FlightRecorder:
@@ -105,7 +166,7 @@ class FlightRecorder:
                 if rank.get(status, 0) > rank.get(rec["status"], 0):
                     rec["status"] = status
                 if spans:
-                    rec["spans"].extend(dict(s) for s in spans)
+                    self._merge_spans(rec, spans)
                 for k, v in extra.items():
                     rec.setdefault(k, v)
                 return True
@@ -127,16 +188,32 @@ class FlightRecorder:
                 _MON_EVICTED.inc()
         return True
 
+    @staticmethod
+    def _merge_spans(rec: Dict, spans: Sequence[Dict]) -> None:
+        """Append spans, deduplicating by span id: a cross-process merge
+        can present the same span twice (e.g. a loopback hop whose
+        server half shares this process's recorder — the wire response
+        echoes spans the recorder already holds)."""
+        have = {s.get("id") for s in rec["spans"] if s.get("id")}
+        for s in spans:
+            sid = s.get("id")
+            if sid and sid in have:
+                continue
+            if sid:
+                have.add(sid)
+            rec["spans"].append(dict(s))
+
     def add_span(self, trace_id: Optional[str], span: Dict) -> bool:
         """Append one span to an already-retained record (no-op — and
-        False — when the request wasn't sampled)."""
+        False — when the request wasn't sampled; duplicate span ids are
+        merged away)."""
         if not trace_id:
             return False
         with self._lock:
             rec = self._ring.get(trace_id)
             if rec is None:
                 return False
-            rec["spans"].append(dict(span))
+            self._merge_spans(rec, (span,))
         return True
 
     def get_record(self, trace_id: str) -> Optional[Dict]:
@@ -156,12 +233,17 @@ class FlightRecorder:
         return recs[:limit] if limit is not None else recs
 
     def statusz(self) -> Dict[str, object]:
-        """The ``/tracez`` document: knobs + retained records."""
+        """The ``/tracez`` document: knobs + retained records, each
+        carrying its rendered span hierarchy (``tree`` — built from the
+        explicit parent ids, so the nesting is real, not inferred)."""
+        requests = self.snapshot()
+        for rec in requests:
+            rec["tree"] = span_tree(rec.get("spans") or ())
         return {
             "capacity": self.capacity,
             "slow_ms": self.slow_ms,
             "retained": len(self),
-            "requests": self.snapshot(),
+            "requests": requests,
         }
 
     def export_chrome_trace(self, path: str, limit: Optional[int] = None,

@@ -30,6 +30,16 @@ ALSO appended to ``buf`` even when no global session is active — the
 flight recorder's per-batch collection mechanism.  ``recording()``
 reports True when either sink is live, so hot-path gates stay a single
 call.
+
+Span hierarchy (parent ids): every recorded span carries a fresh 16-hex
+``id``, and a ``parent`` id when one is known — no longer inferred from
+timestamps.  Enclosing-span call sites push their own id onto a
+thread-local parent stack while their body runs (``parent_scope()`` /
+``push_parent``+``pop_parent``), so nested spans record a real edge; a
+span recorded after-the-fact picks up ``current_parent()``.  The stack
+also accepts a FOREIGN id — a wire server pushes the remote parent
+parsed from the request's W3C ``traceparent`` header, so a
+cross-process span tree keeps one connected hierarchy per trace id.
 """
 from __future__ import annotations
 
@@ -37,6 +47,7 @@ import collections
 import contextlib
 import threading
 import time
+import uuid
 from typing import Deque, Dict, List, Optional, Sequence
 
 __all__ = [
@@ -44,6 +55,8 @@ __all__ = [
     "record_instant", "span", "session_dropped", "dropped_total",
     "trace_context", "current_trace_ids", "capture",
     "set_thread_lane", "thread_lanes",
+    "new_span_id", "push_parent", "pop_parent", "current_parent",
+    "parent_scope",
 ]
 
 _enabled = False
@@ -125,10 +138,16 @@ def dropped_total() -> int:
 
 
 def record_span(name: str, t0: float, dur: float, cat: str = "host",
-                error: bool = False, **args) -> None:
+                error: bool = False, span_id: Optional[str] = None,
+                parent: Optional[str] = None, **args) -> None:
     """Record one completed span.  ``t0`` is the perf_counter value at
     span start, ``dur`` the duration in seconds.  No-op when neither a
-    session nor a thread-local capture is active."""
+    session nor a thread-local capture is active.
+
+    ``span_id`` pins the span's id (an enclosing call site that pushed
+    the id onto the parent stack while its body ran passes it here);
+    omitted, a fresh id is minted.  ``parent`` pins the parent edge;
+    omitted, the thread's current parent-stack top (if any) is used."""
     cap = getattr(_tls, "capture", None)
     if not _enabled and cap is None:
         return
@@ -137,7 +156,12 @@ def record_span(name: str, t0: float, dur: float, cat: str = "host",
         "cat": cat,
         "dur": float(dur),
         "tid": threading.get_ident(),
+        "id": span_id or new_span_id(),
     }
+    if parent is None:
+        parent = current_parent()
+    if parent:
+        rec["parent"] = parent
     if error:
         rec["error"] = True
     ids = getattr(_tls, "trace_ids", None)
@@ -177,11 +201,16 @@ def record_instant(name: str, cat: str = "host", **args) -> None:
 @contextlib.contextmanager
 def span(name: str, cat: str = "host", **args):
     """Context-manager form; spans that exit via exception are flagged
-    ``error=True``.  Near-zero-cost when no session is active."""
+    ``error=True``.  Near-zero-cost when no session is active.
+
+    The span's id is pushed onto the parent stack while the body runs,
+    so spans recorded inside nest under it (a real parent edge, not a
+    timestamp guess)."""
     if not recording():
         yield
         return
     t0 = time.perf_counter()
+    sid = push_parent()
     err = False
     try:
         yield
@@ -189,7 +218,9 @@ def span(name: str, cat: str = "host", **args):
         err = True
         raise
     finally:
-        record_span(name, t0, time.perf_counter() - t0, cat=cat, error=err, **args)
+        pop_parent()
+        record_span(name, t0, time.perf_counter() - t0, cat=cat, error=err,
+                    span_id=sid, **args)
 
 
 # ---------------------------------------------------------------------------
@@ -216,6 +247,53 @@ def current_trace_ids() -> tuple:
     """The calling thread's active trace ids (empty tuple outside any
     ``trace_context``)."""
     return getattr(_tls, "trace_ids", None) or ()
+
+
+# ---------------------------------------------------------------------------
+# span hierarchy: per-thread parent stack
+# ---------------------------------------------------------------------------
+def new_span_id() -> str:
+    """Mint a 16-hex span id (same shape as a trace id, distinct space)."""
+    return uuid.uuid4().hex[:16]
+
+
+def push_parent(span_id: Optional[str] = None) -> str:
+    """Push a span id onto the calling thread's parent stack (minting a
+    fresh one when omitted) and return it.  Spans the thread records
+    while it is on top carry it as ``parent``.  Pushing a FOREIGN id
+    (e.g. the remote parent from a wire request's ``traceparent``
+    header) grafts this thread's spans under a span recorded elsewhere."""
+    sid = span_id or new_span_id()
+    stack = getattr(_tls, "parents", None)
+    if stack is None:
+        stack = _tls.parents = []
+    stack.append(sid)
+    return sid
+
+
+def pop_parent() -> None:
+    stack = getattr(_tls, "parents", None)
+    if stack:
+        stack.pop()
+
+
+def current_parent() -> Optional[str]:
+    """The calling thread's innermost open parent span id, or None."""
+    stack = getattr(_tls, "parents", None)
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def parent_scope(span_id: Optional[str] = None):
+    """Context-manager form of ``push_parent``/``pop_parent``; yields
+    the pushed id.  The caller that OWNS the enclosing span records it
+    afterwards via ``record_span(..., span_id=<yielded id>)``; a caller
+    grafting under a remote/foreign parent just passes that id."""
+    sid = push_parent(span_id)
+    try:
+        yield sid
+    finally:
+        pop_parent()
 
 
 @contextlib.contextmanager

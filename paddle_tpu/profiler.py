@@ -58,14 +58,22 @@ class RecordEvent:
 
     def __enter__(self):
         self._t0 = time.perf_counter()
+        # own span id on the parent stack while the body runs: spans
+        # recorded inside (predictor hop, executor phases) nest under
+        # this block with a real parent edge
+        self._sid = (
+            _mon_spans.push_parent() if _mon_spans.recording() else None)
         return self
 
     def __exit__(self, exc_type, exc, tb):
         dur = time.perf_counter() - self._t0
         error = exc_type is not None
         _host_events[self.name + _ERROR_SUFFIX if error else self.name].append(dur)
+        if self._sid is not None:
+            _mon_spans.pop_parent()
         _mon_spans.record_span(
-            self.name, self._t0, dur, cat="record_event", error=error)
+            self.name, self._t0, dur, cat="record_event", error=error,
+            span_id=self._sid)
         return False
 
 

@@ -20,7 +20,11 @@ AnalysisPredictor (inference.py):
   ``/statusz`` (JSON snapshot) + ``/tracez`` (tail-sampled
   slow/errored request traces) surface;
 * typed errors: ``ServerOverloaded``, ``DeadlineExceeded``,
-  ``ServerClosed``.
+  ``ServerClosed`` (+ the wire layer's ``WireProtocolError`` /
+  ``BackendUnavailable``);
+* ``serving.wire`` (lazy subpackage) — the cross-host tier: codec +
+  HTTP transport, ``RemoteClient``, ``ServingProcess`` children, and
+  the ``FleetBalancer`` front end.
 
 Quickstart::
 
@@ -35,10 +39,12 @@ from paddle_tpu.serving.batching import DynamicBatcher, ServingRequest
 from paddle_tpu.serving.bucketing import BucketPolicy
 from paddle_tpu.serving.client import Client
 from paddle_tpu.serving.errors import (
+    BackendUnavailable,
     DeadlineExceeded,
     ServerClosed,
     ServerOverloaded,
     ServingError,
+    WireProtocolError,
 )
 from paddle_tpu.serving.metrics import ServingMetrics
 from paddle_tpu.serving.server import InferenceServer
@@ -54,4 +60,20 @@ __all__ = [
     "ServerOverloaded",
     "DeadlineExceeded",
     "ServerClosed",
+    "WireProtocolError",
+    "BackendUnavailable",
+    "wire",
 ]
+
+
+def __getattr__(name):
+    # the wire subpackage is imported lazily: the in-process serving
+    # path must not pay the transport/launcher import (and its metric
+    # registrations) unless the process actually crosses a host boundary
+    if name == "wire":
+        import importlib
+
+        mod = importlib.import_module("paddle_tpu.serving.wire")
+        globals()["wire"] = mod
+        return mod
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

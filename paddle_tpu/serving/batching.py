@@ -38,15 +38,20 @@ class ServingRequest:
     ``trace_id`` (optional) is the request's Dapper-style trace id:
     every span recorded while the batch containing this request executes
     carries it (``monitor.trace_context``), and the flight recorder keys
-    its tail-sampled record by it."""
+    its tail-sampled record by it.  ``parent_span`` (optional) is the
+    submitter-side span id the request's own spans hang under — the
+    client's infer span in-process, or the wire server's request span
+    when the request arrived over a transport hop."""
 
     def __init__(self, feed: Dict[str, np.ndarray], n_rows: int,
                  deadline: Optional[float] = None,
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None,
+                 parent_span: Optional[str] = None):
         self.feed = feed
         self.n_rows = n_rows
         self.deadline = deadline  # time.monotonic() deadline, or None
         self.trace_id = trace_id
+        self.parent_span = parent_span
         self.submit_t = time.perf_counter()
         self._done = threading.Event()
         self._value: Optional[List[np.ndarray]] = None

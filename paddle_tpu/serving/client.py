@@ -1,10 +1,10 @@
 """In-process client helper over InferenceServer.
 
 The test-and-bench-facing convenience surface: blocking single calls,
-scatter/gather for many requests, and named-output dicts.  A remote
-transport (RPC) would sit exactly where this class sits — everything
-below (submit/future) is transport-agnostic, and the trace id minted
-here is exactly what a wire transport would carry in a header.
+scatter/gather for many requests, and named-output dicts.  This seam is
+transport-agnostic — ``paddle_tpu.serving.wire.RemoteClient`` is the
+remote twin with the same signatures over an RPC hop, carrying the
+trace id minted here in a W3C ``traceparent`` header.
 
 Request-scoped tracing: every ``infer*`` call mints a trace id (or
 accepts one via ``trace_id=``), propagates it through submit() into the
@@ -49,10 +49,13 @@ class Client:
                 feed, timeout_ms=timeout_ms, trace_id=tid).result()
         t0 = time.perf_counter()
         err: Optional[BaseException] = None
+        sid = _spans.new_span_id()
         try:
             with _spans.trace_context((tid,)):
-                return self._server.submit(
-                    feed, timeout_ms=timeout_ms, trace_id=tid).result()
+                with _spans.parent_scope(sid):
+                    return self._server.submit(
+                        feed, timeout_ms=timeout_ms, trace_id=tid,
+                        parent_span=sid).result()
         except BaseException as e:  # noqa: BLE001 — observed, re-raised
             err = e
             raise
@@ -61,12 +64,12 @@ class Client:
             with _spans.trace_context((tid,)):
                 _spans.record_span(
                     "serving/client_infer", t0, dur, cat="client",
-                    error=err is not None)
+                    span_id=sid, error=err is not None)
             if fr is not None:
-                self._flight_report(fr, tid, t0, dur, err)
+                self._flight_report(fr, tid, sid, t0, dur, err)
 
     @staticmethod
-    def _flight_report(fr, tid: str, t0: float, dur: float,
+    def _flight_report(fr, tid: str, sid: str, t0: float, dur: float,
                        err: Optional[BaseException]) -> None:
         """Attach the client-side span to the request's tail-sampled
         record — or, for a deadline the server never got to observe
@@ -77,7 +80,7 @@ class Client:
         requests must not flood the bounded ring and evict the slow
         traces tail sampling exists to keep."""
         span = {
-            "name": "serving/client_infer", "cat": "client",
+            "name": "serving/client_infer", "cat": "client", "id": sid,
             "ts": _spans.wall_ts(t0), "dur": dur,
             "tid": threading.get_ident(), "trace_ids": [tid],
         }

@@ -13,6 +13,8 @@ __all__ = [
     "ServerOverloaded",
     "DeadlineExceeded",
     "ServerClosed",
+    "WireProtocolError",
+    "BackendUnavailable",
 ]
 
 
@@ -34,3 +36,20 @@ class DeadlineExceeded(ServingError, TimeoutError):
 class ServerClosed(ServingError):
     """The server is shutting down (or already stopped) and no longer
     admits new requests."""
+
+
+class WireProtocolError(ServingError):
+    """A wire message violated the framing/codec contract (bad magic,
+    truncated frame, oversized frame, unknown frame kind, undecodable
+    payload).  Raised by the codec's BOUNDED reads, so a malformed or
+    malicious peer surfaces as a typed per-request failure instead of
+    wedging a server process on an unbounded read."""
+
+
+class BackendUnavailable(ServingError):
+    """The wire transport could not complete the exchange with the
+    remote process (connection refused/reset, half-written response —
+    the process died or the network dropped).  The RETRYABLE failure
+    class: the front-end balancer re-routes the request to a surviving
+    backend, exactly as the in-process fleet requeues a batch off a dead
+    replica thread."""

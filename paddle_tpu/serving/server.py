@@ -344,16 +344,20 @@ class InferenceServer:
 
     # ------------------------------------------------------------------
     def submit(self, feed, timeout_ms: Optional[float] = None,
-               trace_id: Optional[str] = None) -> ServingRequest:
+               trace_id: Optional[str] = None,
+               parent_span: Optional[str] = None) -> ServingRequest:
         """Enqueue one request; returns its future (ServingRequest).
 
         ``feed``: dict (or positional sequence) of arrays whose shared
         leading dim is the request's row count (1..max_batch_size).
         ``trace_id`` joins the request to a caller-owned trace (the
         Client mints one per call); spans recorded while its batch
-        executes carry it.  Raises ServerOverloaded when the queue is
-        full, ServerClosed after stop(); the future raises
-        DeadlineExceeded when ``timeout_ms`` elapses first.
+        executes carry it.  ``parent_span`` is the submitter-side span
+        id this request's spans parent under (client infer span, or the
+        wire server's request span on a transport hop).  Raises
+        ServerOverloaded when the queue is full, ServerClosed after
+        stop(); the future raises DeadlineExceeded when ``timeout_ms``
+        elapses first.
         """
         if self._closed:
             raise ServerClosed("server %r is stopped" % self.name)
@@ -361,7 +365,8 @@ class InferenceServer:
         deadline = (
             time.monotonic() + float(timeout_ms) / 1e3
             if timeout_ms is not None else None)
-        req = ServingRequest(feed, n_rows, deadline, trace_id=trace_id)
+        req = ServingRequest(feed, n_rows, deadline, trace_id=trace_id,
+                             parent_span=parent_span)
         try:
             self._batcher.offer(req)
         except Exception:
@@ -683,15 +688,26 @@ class InferenceServer:
                     now = time.perf_counter()
                     for r in batch:
                         # per-request queue wait: submit -> picked up
-                        # here, each span owning its single trace id
+                        # here, each span owning its single trace id and
+                        # parenting under its submitter's span (client
+                        # infer span / wire server request span)
                         with _mon_spans.trace_context(
                                 (r.trace_id,) if r.trace_id else ()):
                             _mon_spans.record_span(
                                 "serving/queue_wait", r.submit_t,
                                 now - r.submit_t, cat="serving",
+                                parent=r.parent_span,
                                 server=self.name, replica=rep.name,
                                 n_rows=r.n_rows)
                     stack.enter_context(_mon_spans.trace_context(tids))
+                    if len(batch) == 1 and batch[0].parent_span:
+                        # an unshared batch can keep a fully connected
+                        # tree: the batch/predictor/executor spans graft
+                        # under the request's submitter span (a shared
+                        # batch has no single parent — its subtree roots
+                        # at the RecordEvent batch span instead)
+                        stack.enter_context(
+                            _mon_spans.parent_scope(batch[0].parent_span))
                 merged = {
                     name: (
                         np.concatenate([r.feed[name] for r in batch], axis=0)
@@ -776,6 +792,11 @@ class InferenceServer:
                     stack.enter_context(_mon_spans.capture(cap))
                 if tids:
                     stack.enter_context(_mon_spans.trace_context(tids))
+                if rec and len(batch) == 1 and batch[0].parent_span:
+                    # unshared batch: the d2h span keeps the connected
+                    # tree (same graft rule as _execute)
+                    stack.enter_context(
+                        _mon_spans.parent_scope(batch[0].parent_span))
                 if rec:
                     m0 = time.perf_counter()
                 outs = [np.asarray(o) for o in outs]

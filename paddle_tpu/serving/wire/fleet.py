@@ -1,0 +1,569 @@
+"""FleetBalancer: least-loaded routing over N serving processes.
+
+The in-process replica fleet's state machine — least-loaded routing,
+bounded per-backend in-flight, retirement after consecutive failures,
+requeue-to-survivor so accepted requests never drop — promoted from
+threads to PROCESSES: each backend is a ``ServingProcess`` on the other
+side of a wire transport, failure detection is typed transport errors
+(``BackendUnavailable``: the process died mid-exchange) plus an active
+``/healthz`` probe loop, and requeues re-SEND the request to a
+surviving backend (idempotent by construction: a request whose response
+never arrived was never delivered).
+
+Client surface: the same ``infer`` / ``infer_named`` / ``infer_many``
+(+ ``infer_stream`` seam) contract as ``Client``/``RemoteClient``, so
+the balancer drops in wherever a single endpoint handle did.  Fleet
+accounting reuses ``ServingMetrics`` — the balancer IS a server-shaped
+thing: ``serving_requests_total``/``serving_requeued_total``/the
+latency histogram all expose with ``server=<fleet name>``, and
+balancer-specific health/retirement counters live in ``wire.metrics``.
+
+Operations: ``warmup()`` pre-compiles every bucket rung on EVERY
+backend concurrently (the zero-recompile guarantee becomes fleet-wide
+across processes), and ``rolling_replace()`` swaps each launched
+backend for a fresh warmed child one at a time — capacity never drops
+below N-1 and cold jit caches never see traffic.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu import monitor
+from paddle_tpu.monitor import flight as _flight
+from paddle_tpu.monitor import spans as _spans
+from paddle_tpu.serving import errors as _errors
+from paddle_tpu.serving.errors import (
+    BackendUnavailable,
+    DeadlineExceeded,
+    ServerOverloaded,
+    ServingError,
+)
+from paddle_tpu.serving.metrics import ServingMetrics
+from paddle_tpu.serving.wire import launch as _launch
+from paddle_tpu.serving.wire.client import flight_report as _flight_report
+from paddle_tpu.serving.wire.client import wire_call
+from paddle_tpu.serving.wire.http import HttpTransport
+from paddle_tpu.serving.wire.metrics import (
+    WIRE_BACKEND_RETIRED,
+    WIRE_HEALTH_CHECK_FAILURES,
+    WIRE_HEALTH_CHECKS,
+)
+
+__all__ = ["FleetBalancer"]
+
+# consecutive request/health failures before a backend leaves routing —
+# same limit the in-process replica fleet uses for its workers
+_BACKEND_FAIL_LIMIT = 3
+
+# safety-net bound for the all-backends-busy wait (real wakeups are
+# notifies from releases/retirements)
+_ROUTE_WAIT_S = 0.5
+
+
+class _Backend:
+    """One serving process behind the balancer: transport + health and
+    in-flight accounting (the routing state)."""
+
+    __slots__ = ("name", "transport", "handle", "alive", "in_flight",
+                 "executed", "failed", "consec_failures",
+                 "consec_health_failures")
+
+    def __init__(self, name: str, transport: HttpTransport,
+                 handle: Optional[_launch.ServerHandle] = None):
+        self.name = name
+        self.transport = transport
+        self.handle = handle  # launched child (None: bare address)
+        self.alive = True
+        self.in_flight = 0  # guarded by the balancer's _route_cv
+        self.executed = 0
+        self.failed = 0
+        self.consec_failures = 0
+        self.consec_health_failures = 0
+
+
+class FleetBalancer:
+    """Front-end balancer over serving processes.
+
+    ``backends``: ``(host, port)`` tuples and/or ``ServerHandle``s from
+    ``launch_server`` (handles enable ``rolling_replace``/
+    ``stop(shutdown_backends=True)``).  ``max_in_flight`` bounds
+    concurrent requests PER BACKEND (admission control: with every live
+    backend at the bound, submitters wait — and time out typed against
+    their deadline rather than queuing unboundedly).
+    """
+
+    def __init__(self, backends: Sequence, name: str = "fleet",
+                 max_in_flight: int = 8,
+                 timeout_s: float = 30.0,
+                 health_interval_s: Optional[float] = 1.0):
+        if not backends:
+            raise ValueError("FleetBalancer needs at least one backend")
+        self.name = name
+        self._timeout_s = float(timeout_s)
+        self._max_in_flight = int(max_in_flight)
+        self._backends: List[_Backend] = []
+        for i, b in enumerate(backends):
+            self._add_backend_obj(i, b)
+        self._metrics = ServingMetrics(name)
+        self._retired_counter = WIRE_BACKEND_RETIRED.labels(fleet=name)
+        self._health_counter = WIRE_HEALTH_CHECKS.labels(fleet=name)
+        self._health_failures = WIRE_HEALTH_CHECK_FAILURES.labels(fleet=name)
+        self._route_cv = threading.Condition()
+        self._closed = False
+        self._warmed = False
+        self._shape_lock = threading.Lock()
+        self._feed_names: Optional[List[str]] = None
+        self._fetch_names: Optional[List[str]] = None
+        self._pool = None  # lazy persistent executor (infer_many)
+        self._health_stop = threading.Event()
+        self._health_thread = None
+        if health_interval_s:
+            self._health_thread = threading.Thread(
+                target=self._health_loop, args=(float(health_interval_s),),
+                name="wire-fleet-health-%s" % name, daemon=True)
+            self._health_thread.start()
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_launch(cls, model_dir: str, n: int, name: str = "fleet",
+                    launch_kwargs: Optional[Dict[str, object]] = None,
+                    **fleet_kwargs) -> "FleetBalancer":
+        """Launch ``n`` serving children for ``model_dir`` and balance
+        over them (the one-call fleet constructor)."""
+        kw = dict(launch_kwargs or {})
+        kw.setdefault("name", name)
+        handles = []
+        try:
+            for i in range(n):
+                per = dict(kw)
+                per["name"] = "%s-%d" % (kw["name"], i)
+                handles.append(_launch.launch_server(model_dir, **per))
+        except Exception:
+            for h in handles:
+                h.kill()
+            raise
+        return cls(handles, name=name, **fleet_kwargs)
+
+    def _add_backend_obj(self, idx: int, b) -> _Backend:
+        if isinstance(b, _launch.ServerHandle):
+            be = _Backend(
+                "b%d@%s:%d" % (idx, b.host, b.port),
+                HttpTransport(b.host, b.port, timeout_s=self._timeout_s),
+                handle=b)
+        else:
+            host, port = b
+            be = _Backend(
+                "b%d@%s:%d" % (idx, host, port),
+                HttpTransport(host, port, timeout_s=self._timeout_s))
+        self._backends.append(be)
+        return be
+
+    # ------------------------------------------------------------------
+    @property
+    def num_backends(self) -> int:
+        with self._route_cv:
+            return sum(1 for b in self._backends if b.alive)
+
+    def backend_stats(self) -> Dict[str, Dict[str, object]]:
+        with self._route_cv:
+            return {
+                b.name: {
+                    "alive": b.alive,
+                    "in_flight": b.in_flight,
+                    "executed": b.executed,
+                    "failed": b.failed,
+                }
+                for b in self._backends
+            }
+
+    def metrics(self) -> Dict[str, object]:
+        snap = self._metrics.snapshot()
+        snap["warmed_up"] = self._warmed
+        snap["backends"] = self.backend_stats()
+        return snap
+
+    # ------------------------------------------------------------------
+    def warmup(self, timeout_s: float = 600.0) -> int:
+        """Fleet-wide warmup: every backend pre-compiles every bucket
+        rung CONCURRENTLY (backend 2..N typically loads backend 1's
+        compiles from the shared persistent cache); returns total
+        compiles.  After this, steady-state traffic performs zero XLA
+        compiles anywhere in the fleet."""
+        results: Dict[str, object] = {}
+
+        def one(be: _Backend):
+            try:
+                meta, _ = be.transport.request(
+                    "/warmup", {}, (), timeout_s=timeout_s)
+                from paddle_tpu.serving.wire.client import raise_in_band_error
+
+                raise_in_band_error(meta)
+                results[be.name] = int(meta.get("compiles", 0))
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                results[be.name] = e
+
+        with self._route_cv:
+            live = [b for b in self._backends if b.alive]
+        threads = [threading.Thread(target=one, args=(b,), daemon=True)
+                   for b in live]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        errs = {n: r for n, r in results.items()
+                if isinstance(r, BaseException)}
+        if errs:
+            raise ServingError("fleet warmup failed on %s" % sorted(errs))
+        compiles = sum(int(r) for r in results.values())
+        self._metrics.count("warmup_compiles", compiles)
+        self._warmed = True
+        return compiles
+
+    # ------------------------------------------------------------------
+    # routing: least-loaded live backend, bounded in-flight, requeue on
+    # transport failure — the replica state machine across processes
+    # ------------------------------------------------------------------
+    def _pick(self, exclude: Optional[_Backend]) -> Optional[_Backend]:
+        live = [b for b in self._backends
+                if b.alive and b is not exclude
+                and b.in_flight < self._max_in_flight]
+        if not live:
+            return None
+        return min(live, key=lambda b: b.in_flight)
+
+    def _acquire(self, exclude: Optional[_Backend],
+                 deadline: Optional[float]) -> _Backend:
+        with self._route_cv:
+            while True:
+                if self._closed:
+                    raise _errors.ServerClosed(
+                        "fleet %r is stopped" % self.name)
+                be = self._pick(exclude)
+                if be is None and exclude is not None and not any(
+                        b.alive and b is not exclude for b in self._backends):
+                    be = self._pick(None)  # only the excluded one left: reuse
+                if be is not None:
+                    be.in_flight += 1
+                    return be
+                if not any(b.alive for b in self._backends):
+                    raise ServingError(
+                        "no live backends in fleet %r" % self.name)
+                wait = _ROUTE_WAIT_S
+                if deadline is not None:
+                    wait = min(wait, deadline - time.monotonic())
+                    if wait <= 0:
+                        self._metrics.count("expired")
+                        raise DeadlineExceeded(
+                            "deadline passed waiting for fleet capacity")
+                self._route_cv.wait(timeout=wait)
+
+    def _release(self, be: _Backend, ok: bool) -> None:
+        with self._route_cv:
+            be.in_flight -= 1
+            if ok:
+                be.executed += 1
+                be.consec_failures = 0
+            self._route_cv.notify_all()
+
+    def _record_failure(self, be: _Backend) -> None:
+        with self._route_cv:
+            be.failed += 1
+            be.consec_failures += 1
+            if be.consec_failures >= _BACKEND_FAIL_LIMIT and be.alive:
+                self._retire_locked(be, "request failures")
+
+    def _retire_locked(self, be: _Backend, why: str) -> None:
+        be.alive = False
+        self._retired_counter.inc()
+        monitor.record_instant(
+            "wire/backend_retired", cat="wire",
+            fleet=self.name, backend=be.name, reason=why)
+        self._route_cv.notify_all()
+
+    def _count_requeue(self, be: _Backend) -> None:
+        """One re-routed request: counter + timeline marker move
+        together, exactly like the in-process replica requeue."""
+        self._metrics.count("requeued")
+        monitor.record_instant(
+            "serving/batch_requeue", cat="serving",
+            server=self.name, replica=be.name)
+
+    # ------------------------------------------------------------------
+    def infer(self, feed, timeout_ms: Optional[float] = None,
+              trace_id: Optional[str] = None) -> List[np.ndarray]:
+        """One request through the fleet.  A backend that dies
+        mid-exchange (``BackendUnavailable``) or answers that it is
+        shutting down (``ServerClosed``) retires after repeated failures
+        and the request REQUEUES to a survivor — an accepted request
+        completes or fails typed, never silently drops.  Deadline /
+        overload / validation answers are NOT retried: they are
+        end-state answers from a live backend, not lost work."""
+        tid = trace_id or monitor.new_trace_id()
+        self.last_trace_id = tid
+        names, arrays = self._normalize(feed)
+        deadline = (
+            time.monotonic() + float(timeout_ms) / 1e3
+            if timeout_ms is not None else None)
+        self._metrics.count("requests")
+        fr = _flight.get()
+        rec = _spans.recording() or fr is not None
+        if not rec:
+            _, routs = self._route(names, arrays, timeout_ms, deadline, tid)
+            return routs
+        t0 = time.perf_counter()
+        err: Optional[BaseException] = None
+        sid = _spans.new_span_id()
+        # capture this thread's wire/request span(s) — a requeued
+        # request records one per attempted backend, and the flight
+        # record should show every hop it took
+        cap: List[Dict] = []
+        extra_spans: List[Dict] = []
+        try:
+            with _spans.trace_context((tid,)):
+                with _spans.parent_scope(sid):
+                    with _spans.capture(cap):
+                        rmeta, routs = self._route(
+                            names, arrays, timeout_ms, deadline, tid)
+            extra_spans = list(rmeta.get("spans") or ())
+            return routs
+        except BaseException as e:  # noqa: BLE001 — observed, re-raised
+            err = e
+            raise
+        finally:
+            dur = time.perf_counter() - t0
+            with _spans.trace_context((tid,)):
+                _spans.record_span(
+                    "serving/client_infer", t0, dur, cat="client",
+                    span_id=sid, error=err is not None, fleet=self.name)
+            if fr is not None:
+                _flight_report(fr, tid, sid, t0, dur, err,
+                               cap + extra_spans, fleet=self.name)
+
+    # hot-path: begin fleet_dispatch (acquire -> wire exchange -> release;
+    # the only waits are the bounded capacity CV and socket I/O)
+    def _route(self, names, arrays, timeout_ms, deadline, tid):
+        t_submit = time.perf_counter()
+        retries = max(1, len(self._backends))
+        exclude: Optional[_Backend] = None
+        while True:
+            be = self._acquire(exclude, deadline)
+            remaining_ms = timeout_ms
+            if deadline is not None:
+                remaining_ms = (deadline - time.monotonic()) * 1e3
+                if remaining_ms <= 0:
+                    # expired while acquiring: a deadline is a typed END
+                    # STATE — it must never reach the socket as a 0s
+                    # timeout (non-blocking mode), which would read as a
+                    # backend failure and retire a healthy fleet.  The
+                    # release is NEUTRAL (ok=False only decrements): the
+                    # backend never saw the request, so neither its
+                    # executed count nor its failure streak may move
+                    self._release(be, ok=False)
+                    self._metrics.count("expired")
+                    raise DeadlineExceeded(
+                        "deadline passed before the wire exchange")
+            try:
+                rmeta, routs = wire_call(
+                    be.transport, names, arrays, remaining_ms, tid)
+            except (BackendUnavailable, _errors.ServerClosed):
+                # retryable: the process died mid-exchange (no response
+                # ever arrived) or answered that it is shutting down —
+                # either way the request did NOT complete there, so
+                # re-sending to a survivor cannot double-run it
+                self._release(be, ok=False)
+                self._record_failure(be)
+                retries -= 1
+                if retries <= 0:
+                    self._metrics.count("failed")
+                    raise
+                self._count_requeue(be)
+                exclude = be
+                continue
+            except _errors.ServingError as e:
+                # typed end states from a LIVE backend: deadline/overload/
+                # validation answers propagate; they also clear the
+                # backend's failure streak (it answered)
+                self._release(be, ok=True)
+                key = ("expired" if isinstance(e, DeadlineExceeded)
+                       else "shed" if isinstance(e, ServerOverloaded)
+                       else "failed")
+                self._metrics.count(key)
+                raise
+            self._release(be, ok=True)
+            self._metrics.observe_request(
+                time.perf_counter() - t_submit, trace_id=tid)
+            return rmeta, routs
+    # hot-path: end fleet_dispatch
+
+    def _normalize(self, feed) -> Tuple[List[str], List[np.ndarray]]:
+        names, _ = self._endpoint_shape()
+        if not isinstance(feed, dict):
+            feed = dict(zip(names, feed))
+        if set(feed) != set(names):
+            raise ValueError(
+                "feed names %s != endpoint inputs %s"
+                % (sorted(feed), sorted(names)))
+        return names, [feed[n] for n in names]
+
+    def _endpoint_shape(self) -> Tuple[List[str], List[str]]:
+        with self._shape_lock:
+            if self._feed_names is None:
+                last_err: Optional[BaseException] = None
+                for be in list(self._backends):
+                    try:
+                        doc = be.transport.get_json("/healthz")
+                        self._feed_names = [
+                            str(n) for n in doc["input_names"]]
+                        self._fetch_names = [
+                            str(n) for n in doc["output_names"]]
+                        break
+                    except ServingError as e:
+                        last_err = e
+                else:
+                    raise last_err or ServingError(
+                        "no backend answered /healthz")
+            return self._feed_names, self._fetch_names
+
+    def infer_named(self, feed, timeout_ms: Optional[float] = None,
+                    trace_id: Optional[str] = None) -> Dict[str, np.ndarray]:
+        _, fetch_names = self._endpoint_shape()
+        return dict(zip(fetch_names,
+                        self.infer(feed, timeout_ms, trace_id=trace_id)))
+
+    def infer_many(self, feeds, timeout_ms: Optional[float] = None
+                   ) -> List[List[np.ndarray]]:
+        """Scatter/gather through a PERSISTENT worker pool: long-lived
+        threads keep the transports' per-thread keep-alive connections
+        warm across calls (fresh threads would redial every request)."""
+        tids = [monitor.new_trace_id() for _ in feeds]
+        self.last_trace_ids = tids
+        futures = [
+            self._executor().submit(self.infer, f, timeout_ms, trace_id=t)
+            for f, t in zip(feeds, tids)
+        ]
+        return [f.result() for f in futures]
+
+    def _executor(self):
+        with self._shape_lock:
+            if self._pool is None:
+                import concurrent.futures
+
+                self._pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=16, thread_name_prefix="wire-fleet")
+            return self._pool
+
+    def infer_stream(self, feed, timeout_ms: Optional[float] = None,
+                     trace_id: Optional[str] = None):
+        raise NotImplementedError(
+            "infer_stream lands with continuous batching (ROADMAP #2)")
+
+    # ------------------------------------------------------------------
+    # health checking + rolling replacement
+    # ------------------------------------------------------------------
+    def _health_loop(self, interval_s: float) -> None:
+        while not self._health_stop.wait(interval_s):
+            with self._route_cv:
+                targets = [b for b in self._backends if b.alive]
+            for be in targets:
+                self._health_counter.inc()
+                try:
+                    doc = be.transport.get_json("/healthz", timeout_s=2.0)
+                    healthy = bool(doc.get("ok"))
+                except ServingError:
+                    healthy = False
+                if healthy:
+                    be.consec_health_failures = 0
+                    continue
+                self._health_failures.inc()
+                be.consec_health_failures += 1
+                if be.consec_health_failures >= _BACKEND_FAIL_LIMIT:
+                    with self._route_cv:
+                        if be.alive:
+                            self._retire_locked(be, "health checks")
+
+    def check_health(self) -> Dict[str, bool]:
+        """One synchronous probe round (bench/test convenience; the
+        background loop does this continuously)."""
+        out = {}
+        for be in list(self._backends):
+            self._health_counter.inc()
+            try:
+                doc = be.transport.get_json("/healthz", timeout_s=2.0)
+                out[be.name] = bool(doc.get("ok"))
+            except ServingError:
+                self._health_failures.inc()
+                out[be.name] = False
+        return out
+
+    def rolling_replace(self, warmup: bool = True,
+                        drain_timeout_s: float = 30.0
+                        ) -> List[_launch.ServerHandle]:
+        """Replace every LAUNCHED backend with a fresh child, one at a
+        time: launch new -> (optionally) warm it -> add to routing ->
+        drain the old -> shut it down.  Routable capacity never drops
+        below the current live count, and a cold jit cache never sees
+        traffic.  Backends constructed from bare addresses are skipped
+        (nothing to relaunch)."""
+        new_handles: List[_launch.ServerHandle] = []
+        with self._route_cv:
+            olds = [b for b in self._backends
+                    if b.alive and b.handle is not None]
+        for old in olds:
+            handle = _launch.relaunch(old.handle)
+            if warmup:
+                handle.warmup()
+            with self._route_cv:
+                be = self._add_backend_obj(len(self._backends), handle)
+                self._route_cv.notify_all()
+            new_handles.append(handle)
+            # drain: stop routing to the old backend, let its in-flight
+            # requests finish, then ask the process to exit gracefully
+            with self._route_cv:
+                old.alive = False
+                self._route_cv.notify_all()
+                deadline = time.monotonic() + drain_timeout_s
+                while old.in_flight > 0 and time.monotonic() < deadline:
+                    self._route_cv.wait(timeout=0.1)
+            monitor.record_instant(
+                "wire/backend_replaced", cat="wire",
+                fleet=self.name, old=old.name, new=be.name)
+            old.handle.shutdown(timeout_s=drain_timeout_s)
+            old.transport.close()
+        return new_handles
+
+    # ------------------------------------------------------------------
+    def stop(self, shutdown_backends: bool = False,
+             timeout_s: float = 30.0) -> None:
+        """Stop balancing (in-flight requests finish; new ones are
+        refused typed).  ``shutdown_backends=True`` additionally drains
+        and exits every LAUNCHED child."""
+        with self._route_cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._route_cv.notify_all()
+        self._health_stop.set()
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=5.0)
+        with self._shape_lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
+        if shutdown_backends:
+            for be in self._backends:
+                if be.handle is not None:
+                    be.handle.shutdown(timeout_s=timeout_s)
+        for be in self._backends:
+            be.transport.close()
+        self._metrics.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

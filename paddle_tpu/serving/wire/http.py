@@ -1,0 +1,199 @@
+"""Wire transports: the ABC seam + the stdlib-HTTP implementation.
+
+``Transport`` is the deliberate narrow waist between the serving client
+surface and the bytes on the network: one ``request()`` that moves a
+``(meta, arrays)`` message each way plus headers.  The HTTP transport
+below implements it with nothing beyond ``http.client`` (POST bodies in
+the ``codec`` framing, keep-alive via one pooled connection per calling
+thread); a gRPC transport later implements the same four methods and
+slots in behind ``RemoteClient``/``FleetBalancer`` untouched.
+
+Failure typing is the transport's contract (the fleet's requeue state
+machine routes on it):
+
+* socket timeout            -> ``DeadlineExceeded``   (not retryable)
+* refused/reset/half-close  -> ``BackendUnavailable`` (retryable: the
+  process died — the balancer re-routes to a survivor)
+* malformed response body   -> ``WireProtocolError``
+* typed serving errors travel IN-BAND (response meta ``error`` field)
+  and are re-raised by the caller, never guessed from status codes.
+"""
+from __future__ import annotations
+
+import abc
+import http.client
+import socket
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu.serving.errors import BackendUnavailable, DeadlineExceeded
+from paddle_tpu.serving.wire import codec
+from paddle_tpu.serving.wire.metrics import (
+    WIRE_BYTES_RECEIVED,
+    WIRE_BYTES_SENT,
+    WIRE_REQUESTS,
+)
+
+__all__ = ["Transport", "HttpTransport", "CONTENT_TYPE"]
+
+CONTENT_TYPE = "application/x-paddle-tpu-wire"
+
+_REQS = WIRE_REQUESTS.labels(role="client")
+_SENT = WIRE_BYTES_SENT.labels(role="client")
+_RECV = WIRE_BYTES_RECEIVED.labels(role="client")
+
+
+class Transport(abc.ABC):
+    """One bidirectional message exchange with a remote serving process.
+
+    Implementations must be safe for concurrent ``request()`` calls from
+    multiple threads (the fleet balancer and ``infer_many`` fan out)."""
+
+    @abc.abstractmethod
+    def request(self, path: str, meta: Dict[str, object],
+                arrays: Sequence[np.ndarray] = (),
+                timeout_s: Optional[float] = None,
+                headers: Optional[Dict[str, str]] = None,
+                ) -> Tuple[Dict[str, object], List[np.ndarray]]:
+        """POST one message, return the response message.  ``timeout_s``
+        bounds the whole exchange."""
+
+    @abc.abstractmethod
+    def get_json(self, path: str,
+                 timeout_s: Optional[float] = None) -> Dict[str, object]:
+        """GET a JSON control document (health/status surfaces)."""
+
+    @abc.abstractmethod
+    def close(self) -> None:
+        """Release pooled connections (idempotent)."""
+
+    @property
+    @abc.abstractmethod
+    def address(self) -> Tuple[str, int]:
+        """The remote ``(host, port)`` this transport targets."""
+
+
+class HttpTransport(Transport):
+    """stdlib ``http.client`` transport with per-thread keep-alive.
+
+    Each calling thread owns one pooled ``HTTPConnection`` (HTTP/1.1
+    keep-alive: steady-state requests reuse the TCP connection — no
+    per-request handshake on the hot path); a connection that errors is
+    torn down so the next call redials."""
+
+    def __init__(self, host: str, port: int,
+                 timeout_s: float = 30.0,
+                 max_frame_bytes: int = codec.DEFAULT_MAX_FRAME_BYTES):
+        self._host = str(host)
+        self._port = int(port)
+        self._timeout_s = float(timeout_s)
+        self._max_frame_bytes = int(max_frame_bytes)
+        self._tls = threading.local()
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self._host, self._port)
+
+    def _conn(self, timeout_s: Optional[float]) -> http.client.HTTPConnection:
+        if timeout_s is not None and timeout_s <= 0:
+            # a 0/negative socket timeout means NON-BLOCKING mode, whose
+            # BlockingIOError would masquerade as a dead backend — an
+            # exhausted deadline is typed before it touches the socket
+            raise DeadlineExceeded(
+                "deadline exhausted before the wire exchange to %s:%d"
+                % self.address)
+        conn = getattr(self._tls, "conn", None)
+        if conn is None:
+            conn = http.client.HTTPConnection(
+                self._host, self._port,
+                timeout=timeout_s if timeout_s is not None else self._timeout_s)
+            self._tls.conn = conn
+        else:
+            conn.timeout = (
+                timeout_s if timeout_s is not None else self._timeout_s)
+            if conn.sock is not None:
+                conn.sock.settimeout(conn.timeout)
+        return conn
+
+    def _drop_conn(self) -> None:
+        conn = getattr(self._tls, "conn", None)
+        self._tls.conn = None
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------
+    def request(self, path: str, meta: Dict[str, object],
+                arrays: Sequence[np.ndarray] = (),
+                timeout_s: Optional[float] = None,
+                headers: Optional[Dict[str, str]] = None,
+                ) -> Tuple[Dict[str, object], List[np.ndarray]]:
+        body = codec.encode_message(meta, arrays)
+        hdrs = {"Content-Type": CONTENT_TYPE}
+        if headers:
+            hdrs.update(headers)
+        # hot-path: begin wire_request (client side of the hop: one POST
+        # over the pooled keep-alive connection; the only waits are
+        # socket I/O bounded by the timeout)
+        conn = self._conn(timeout_s)
+        try:
+            conn.request("POST", path, body=body, headers=hdrs)
+            resp = conn.getresponse()
+            payload = resp.read()
+        except socket.timeout as e:
+            self._drop_conn()
+            raise DeadlineExceeded(
+                "wire request to %s:%d timed out" % self.address) from e
+        except (ConnectionError, http.client.HTTPException, OSError) as e:
+            self._drop_conn()
+            raise BackendUnavailable(
+                "backend %s:%d unreachable: %r" % (self._host, self._port, e)
+            ) from e
+        _REQS.inc()
+        _SENT.inc(len(body))
+        _RECV.inc(len(payload))
+        rmeta, rarrays = codec.decode_message(
+            payload, max_frame_bytes=self._max_frame_bytes)
+        # hot-path: end wire_request
+        return rmeta, rarrays
+
+    def get_json(self, path: str,
+                 timeout_s: Optional[float] = None) -> Dict[str, object]:
+        import json
+
+        conn = self._conn(timeout_s)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            payload = resp.read()
+        except socket.timeout as e:
+            self._drop_conn()
+            raise DeadlineExceeded(
+                "wire GET %s on %s:%d timed out"
+                % ((path,) + self.address)) from e
+        except (ConnectionError, http.client.HTTPException, OSError) as e:
+            self._drop_conn()
+            raise BackendUnavailable(
+                "backend %s:%d unreachable: %r" % (self._host, self._port, e)
+            ) from e
+        if resp.status != 200:
+            raise BackendUnavailable(
+                "GET %s on %s:%d -> HTTP %d"
+                % (path, self._host, self._port, resp.status))
+        try:
+            return json.loads(payload.decode("utf-8"))
+        except (UnicodeDecodeError, ValueError) as e:
+            from paddle_tpu.serving.errors import WireProtocolError
+
+            raise WireProtocolError("undecodable JSON from %s: %s"
+                                    % (path, e)) from e
+
+    def close(self) -> None:
+        self._closed = True
+        self._drop_conn()

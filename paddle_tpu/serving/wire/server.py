@@ -1,0 +1,403 @@
+"""ServingProcess: one InferenceServer behind the wire transport.
+
+The process-boundary half of cross-host serving: an HTTP front door
+over one ``InferenceServer`` (which keeps its whole in-process story —
+dynamic batching, bucket ladder, replica fleet, zero-recompile warmup),
+exposing
+
+* ``POST /infer``     — one request in the ``codec`` framing (meta
+  carries ``feed_names``/``timeout_ms``; arrays positional), response
+  carries ``output_names`` + output arrays.  Typed serving errors
+  travel in-band (``error``/``message`` meta fields + a mapped status
+  code) so the remote client re-raises the exact error type the
+  in-process client would have seen.
+* ``POST /warmup``    — fleet-wide warmup hook: pre-compiles every
+  bucket rung on every replica, returns the compile count.
+* ``GET  /healthz``   — liveness + endpoint shape (input/output names):
+  the balancer's health-check and discovery surface.
+* ``GET  /metrics`` ``/statusz`` ``/tracez`` — the same admin surface
+  ``InferenceServer.start_admin()`` serves, on the wire port.
+* ``POST /quitquitquit`` — graceful drain + exit (rolling replacement).
+
+Tracing across the hop: a request carrying a W3C ``traceparent`` header
+joins the client's trace — its trace id flows through the batcher →
+replica → executor span chain, the server-side request span records the
+client's wire span as its REMOTE PARENT, and (when this process has a
+flight recorder installed) the retained server-side span tree is
+returned in the response meta so the client-side recorder merges ONE
+cross-process tree per request.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from paddle_tpu import monitor
+from paddle_tpu.monitor import flight as _flight
+from paddle_tpu.monitor import spans as _spans
+from paddle_tpu.serving.errors import (
+    DeadlineExceeded,
+    ServerClosed,
+    ServerOverloaded,
+    ServingError,
+    WireProtocolError,
+)
+from paddle_tpu.serving.wire import codec
+from paddle_tpu.serving.wire.http import CONTENT_TYPE
+from paddle_tpu.serving.wire.metrics import (
+    WIRE_BYTES_RECEIVED,
+    WIRE_BYTES_SENT,
+    WIRE_REQUESTS,
+)
+
+__all__ = ["ServingProcess", "error_status"]
+
+_REQS = WIRE_REQUESTS.labels(role="server")
+_SENT = WIRE_BYTES_SENT.labels(role="server")
+_RECV = WIRE_BYTES_RECEIVED.labels(role="server")
+
+# /infer's grace poll for the flight recorder to finish filing the
+# request's span tree after its future completed: the replica finalizer
+# completes futures a few microseconds before it files the record, so a
+# handful of short polls close the race — and a request the recorder
+# chose NOT to retain (slow_ms tail sampling) gives up after the same
+# small bound instead of stalling the response (tracing-only path)
+_SPAN_MERGE_POLLS = 10
+_SPAN_MERGE_POLL_S = 0.002
+
+# typed error -> HTTP status (the in-band meta "error" field is the
+# authoritative type channel; the status code is for generic tooling)
+_STATUS = (
+    (ServerOverloaded, 429),
+    (DeadlineExceeded, 504),
+    (ServerClosed, 503),
+    (WireProtocolError, 400),
+    (ValueError, 400),
+    (ServingError, 500),
+)
+
+
+def error_status(exc: BaseException) -> int:
+    for etype, status in _STATUS:
+        if isinstance(exc, etype):
+            return status
+    return 500
+
+
+class ServingProcess:
+    """Bind an ``InferenceServer`` to a wire port.
+
+    ``start()`` serves on a daemon thread and returns the bound address
+    (``port=0`` = ephemeral); ``serve_forever()`` blocks the calling
+    thread instead (the ``launch.py`` child main).  ``stop()`` closes
+    the HTTP front door and then stops the wrapped server."""
+
+    def __init__(self, server, host: str = "127.0.0.1", port: int = 0,
+                 max_frame_bytes: int = codec.DEFAULT_MAX_FRAME_BYTES,
+                 max_body_bytes: Optional[int] = None):
+        self.server = server
+        self._host = host
+        self._port = int(port)
+        self._max_frame_bytes = int(max_frame_bytes)
+        # whole-body cap: a codec MESSAGE may span several frames (one
+        # per feed array), so the body bound is a multiple of the
+        # per-frame bound, not equal to it
+        self._max_body_bytes = (
+            int(max_body_bytes) if max_body_bytes is not None
+            else 4 * self._max_frame_bytes + 65536)
+        self._httpd = None
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._shutdown_cb = None  # launch.py hooks /quitquitquit
+
+    # ------------------------------------------------------------------
+    @property
+    def address(self) -> Optional[Tuple[str, int]]:
+        return self._httpd.server_address if self._httpd is not None else None
+
+    def _bind(self):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        sp = self
+
+        class _WireHandler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"  # keep-alive for pooled clients
+
+            def log_message(self, *args):
+                pass  # scrapes/requests stay out of stderr
+
+            # -- plumbing ------------------------------------------------
+            def _send(self, status: int, body: bytes, ctype: str) -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_json(self, doc, status: int = 200) -> None:
+                self._send(status,
+                           json.dumps(doc, sort_keys=True,
+                                      default=str).encode("utf-8"),
+                           "application/json")
+
+            def _send_message(self, meta, arrays=(), status: int = 200) -> None:
+                body = codec.encode_message(meta, arrays)
+                _SENT.inc(len(body))
+                self._send(status, body, CONTENT_TYPE)
+
+            def _read_body(self) -> bytes:
+                length = int(self.headers.get("Content-Length") or 0)
+                if length > sp._max_body_bytes:
+                    # reject WITHOUT reading — and drop the keep-alive
+                    # connection, since the unread body would desync the
+                    # next request on this socket
+                    self.close_connection = True
+                    raise WireProtocolError(
+                        "request body of %d bytes exceeds the %d-byte "
+                        "wire bound" % (length, sp._max_body_bytes))
+                body = self.rfile.read(length)
+                _RECV.inc(len(body))
+                return body
+
+            def _drain_body(self) -> None:
+                """Consume a control POST's body so the HTTP/1.1
+                keep-alive connection stays in sync for the client's
+                next pooled request (an unread body would be parsed as
+                the next request line)."""
+                try:
+                    self._read_body()
+                except WireProtocolError:
+                    pass  # close_connection already set
+
+            # -- GET surfaces (health + admin) ---------------------------
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/healthz":
+                        self._send_json(sp.healthz())
+                    elif path == "/metrics":
+                        om = "application/openmetrics-text" in (
+                            self.headers.get("Accept") or "")
+                        text, ctype = monitor.expose(openmetrics=om)
+                        self._send(200, text.encode("utf-8"), ctype)
+                    elif path == "/statusz":
+                        self._send_json(sp.server.statusz())
+                    elif path == "/tracez":
+                        self._send_json(sp.server.tracez())
+                    else:
+                        self.send_error(404, "unknown path")
+                except Exception as e:  # noqa: BLE001 — typed to the peer
+                    self._send_json({"error": type(e).__name__,
+                                     "message": str(e)}, status=500)
+
+            # -- POST surfaces (infer + control) -------------------------
+            def do_POST(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/infer":
+                    self._do_infer()
+                elif path == "/warmup":
+                    self._drain_body()
+                    try:
+                        compiles = sp.server.warmup()
+                        self._send_message({"compiles": int(compiles)})
+                    except Exception as e:  # noqa: BLE001
+                        self._send_message(
+                            {"error": type(e).__name__, "message": str(e)},
+                            status=500)
+                elif path == "/quitquitquit":
+                    self._drain_body()
+                    self._send_message({"ok": True, "draining": True})
+                    threading.Thread(
+                        target=sp._quit, name="wire-quit", daemon=True
+                    ).start()
+                else:
+                    self.send_error(404, "unknown path")
+
+            def _do_infer(self):
+                _REQS.inc()
+                try:
+                    meta, arrays = codec.decode_message(
+                        self._read_body(),
+                        max_frame_bytes=sp._max_frame_bytes)
+                    feed_names = meta.get("feed_names")
+                    if (not isinstance(feed_names, list)
+                            or len(feed_names) != len(arrays)):
+                        raise WireProtocolError(
+                            "feed_names/arrays mismatch: %r names, %d arrays"
+                            % (feed_names, len(arrays)))
+                    feed = dict(zip(feed_names, arrays))
+                    timeout_ms = meta.get("timeout_ms")
+                    rmeta, routs = sp._infer(
+                        feed, timeout_ms,
+                        traceparent=self.headers.get("traceparent"),
+                        want_spans=self.headers.get("X-Wire-Spans") == "1")
+                except BaseException as e:  # noqa: BLE001 — typed to the peer
+                    try:
+                        self._send_message(
+                            {"error": type(e).__name__, "message": str(e)},
+                            status=error_status(e))
+                    except Exception:
+                        pass  # peer already gone; nothing to report to
+                    return
+                self._send_message(rmeta, routs)
+
+        with self._lock:
+            if self._httpd is None:
+                self._httpd = ThreadingHTTPServer(
+                    (self._host, self._port), _WireHandler)
+            return self._httpd
+
+    # ------------------------------------------------------------------
+    def healthz(self) -> Dict[str, object]:
+        """Liveness + endpoint discovery: the balancer health-checks
+        this and the remote client reads the feed/fetch names from it."""
+        import os
+
+        srv = self.server
+        m = srv.metrics()
+        return {
+            "ok": srv.num_replicas > 0,
+            "pid": os.getpid(),
+            "server": srv.name,
+            "warmed_up": bool(m.get("warmed_up")),
+            "live_replicas": srv.num_replicas,
+            "queue_depth": m.get("queue_depth"),
+            "max_batch_size": srv.max_batch_size,
+            "input_names": list(srv._feed_names),
+            "output_names": list(srv._predictor.get_output_names()),
+        }
+
+    # ------------------------------------------------------------------
+    def _infer(self, feed, timeout_ms, traceparent: Optional[str],
+               want_spans: bool):
+        """Bridge one wire request into the in-process server: install
+        the remote trace context, submit, wait, and (tracing on) hand
+        the server-side span tree back for the client-side merge."""
+        parsed = codec.parse_traceparent(traceparent)
+        tid = parsed[0] if parsed else monitor.new_trace_id()
+        remote_parent = parsed[1] if parsed else None
+        fr = _flight.get()
+        rec = _spans.recording() or fr is not None
+        if not rec:
+            outs = self.server.submit(
+                feed, timeout_ms=timeout_ms, trace_id=tid).result()
+            return self._result_meta(tid), outs
+
+        t0 = time.perf_counter()
+        err: Optional[BaseException] = None
+        sid = _spans.new_span_id()
+        try:
+            with _spans.trace_context((tid,)):
+                # this request span is the server-side root: its parent
+                # is the CLIENT's wire span (from traceparent), and the
+                # spans recorded downstream (queue wait via the request's
+                # parent_span, batch/executor via the replica thread)
+                # hang off it or off the batch tree
+                with _spans.parent_scope(sid):
+                    outs = self.server.submit(
+                        feed, timeout_ms=timeout_ms, trace_id=tid,
+                        parent_span=sid).result()
+        except BaseException as e:  # noqa: BLE001 — observed, re-raised
+            err = e
+            raise
+        finally:
+            dur = time.perf_counter() - t0
+            with _spans.trace_context((tid,)):
+                _spans.record_span(
+                    "wire/server_request", t0, dur, cat="wire",
+                    span_id=sid, parent=remote_parent,
+                    error=err is not None, server=self.server.name)
+        meta = self._result_meta(tid)
+        if want_spans and fr is not None:
+            # the handler's own request span, as an explicit dict: the
+            # batch pipeline files the OTHER server-side spans into the
+            # flight record, but this one closes right here
+            wire_span = {
+                "name": "wire/server_request", "cat": "wire", "id": sid,
+                "ts": _spans.wall_ts(t0), "dur": dur,
+                "tid": threading.get_ident(), "trace_ids": [tid],
+                "args": {"server": self.server.name},
+            }
+            if remote_parent:
+                wire_span["parent"] = remote_parent
+            # only requests tail sampling RETAINS are worth the grace
+            # poll (this path is success-only — errors re-raised above);
+            # a fast request under slow_ms will never grow a record, and
+            # stalling its response would tax exactly the requests
+            # sampling was built to leave untouched
+            if dur * 1e3 >= fr.slow_ms:
+                spans = self._collect_spans(fr, tid) or []
+            else:
+                rec_now = fr.get_record(tid)  # one check, no poll
+                spans = (rec_now.get("spans") or []) if rec_now else []
+            fr.add_span(tid, wire_span)  # local /tracez completeness
+            meta["spans"] = list(spans) + [wire_span]
+        return meta, outs
+
+    def _result_meta(self, tid: str) -> Dict[str, object]:
+        return {"trace_id": tid,
+                "output_names": list(self.server._predictor.get_output_names())}
+
+    @staticmethod
+    def _collect_spans(fr, tid: str):
+        """The retained server-side span tree for ``tid``, or None when
+        the recorder didn't keep this request (see _SPAN_MERGE_POLLS)."""
+        for i in range(_SPAN_MERGE_POLLS):
+            rec = fr.get_record(tid)
+            if rec is not None:
+                return rec.get("spans") or []
+            time.sleep(_SPAN_MERGE_POLL_S)
+        return None
+
+    def _quit(self) -> None:
+        """Graceful exit for rolling replacement: drain, then unblock
+        ``serve_forever``/the launch main."""
+        try:
+            self.stop(drain=True)
+        finally:
+            cb = self._shutdown_cb
+            if cb is not None:
+                cb()
+
+    # ------------------------------------------------------------------
+    def start(self) -> Tuple[str, int]:
+        """Serve on a background thread; returns the bound address."""
+        httpd = self._bind()
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=httpd.serve_forever,
+                name="wire-%s" % self.server.name, daemon=True)
+            self._thread.start()
+        return httpd.server_address
+
+    def serve_forever(self) -> None:
+        """Bind and serve on the CALLING thread (child-process main)."""
+        self._bind().serve_forever()
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        """Close the front door first (stop admitting wire requests),
+        then stop the wrapped server — in-flight requests finish under
+        ``drain=True``."""
+        with self._lock:
+            httpd, self._httpd = self._httpd, None
+            thread, self._thread = self._thread, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if thread is not None:
+            # clear the serve thread too, or a later start() would bind
+            # a fresh listener that nothing serves (connections accepted
+            # into the backlog would hang)
+            thread.join(timeout=5.0)
+        self.server.stop(drain=drain, timeout=timeout)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop(drain=exc == (None, None, None))
+        return False

@@ -683,3 +683,124 @@ def test_plan_cache_counters_and_dispatch_histogram():
     assert hist.labels().value["count"] == h0 + 3
     assert monitor.counter_value("executor_dispatch_overhead_seconds_total") > 0
     assert any(s["name"] == "executor/device_execute" for s in sess.spans)
+
+
+# ---------------------------------------------------------------------------
+# span hierarchy: explicit parent ids (PR-6; nesting is no longer
+# inferred from timestamps)
+# ---------------------------------------------------------------------------
+def test_span_parent_ids_from_nesting():
+    from paddle_tpu.monitor import spans as _spans
+
+    with monitor.trace_session() as sess:
+        with monitor.span("outer"):
+            with monitor.span("inner"):
+                monitor.record_span(
+                    "leaf", time.perf_counter(), 0.0)
+            with profiler.RecordEvent("sibling"):
+                pass
+    by = {s["name"]: s for s in sess.spans}
+    assert set(by) == {"outer", "inner", "leaf", "sibling"}
+    assert all(s.get("id") for s in sess.spans)
+    assert by["inner"]["parent"] == by["outer"]["id"]
+    assert by["leaf"]["parent"] == by["inner"]["id"]
+    assert by["sibling"]["parent"] == by["outer"]["id"]
+    assert "parent" not in by["outer"]
+    # the stack is clean after the session
+    assert _spans.current_parent() is None
+
+
+def test_span_remote_parent_graft():
+    """A foreign id (e.g. the remote parent from a wire traceparent)
+    pushed onto the stack parents local spans under a span recorded in
+    another process."""
+    from paddle_tpu.monitor import spans as _spans
+
+    with monitor.trace_session() as sess:
+        with _spans.parent_scope("feedfacefeedface"):
+            with monitor.span("local_root"):
+                pass
+    (s,) = sess.spans
+    assert s["parent"] == "feedfacefeedface"
+
+
+def test_flight_span_tree_builder():
+    from paddle_tpu.monitor.flight import span_tree
+
+    spans = [
+        {"name": "root", "id": "r", "dur": 0.002},
+        {"name": "child", "id": "c", "parent": "r", "dur": 0.001},
+        {"name": "grandchild", "id": "g", "parent": "c", "dur": 0.0005},
+        {"name": "orphan", "id": "o", "parent": "missing", "dur": 0.0},
+        {"name": "idless", "dur": 0.0},
+    ]
+    roots = span_tree(spans)
+    names = [n["name"] for n in roots]
+    assert names == ["root", "orphan", "idless"]
+    root = roots[0]
+    assert [c["name"] for c in root["children"]] == ["child"]
+    assert [c["name"] for c in root["children"][0]["children"]] == [
+        "grandchild"]
+
+
+def test_chrome_trace_carries_span_ids_and_cross_lane_flows(tmp_path):
+    """Exported events carry span_id/parent_id args, and a parent edge
+    that crosses thread lanes gets explicit flow arrows."""
+    path = str(tmp_path / "trace.json")
+    spans = [
+        {"name": "parent", "id": "aa11", "ts": 1.0, "dur": 0.01, "tid": 1},
+        {"name": "same_lane_child", "id": "bb22", "parent": "aa11",
+         "ts": 1.001, "dur": 0.001, "tid": 1},
+        {"name": "cross_lane_child", "id": "cc33", "parent": "aa11",
+         "ts": 1.002, "dur": 0.001, "tid": 2},
+    ]
+    monitor.export_chrome_trace(path, spans=spans)
+    with open(path) as f:
+        doc = json.load(f)
+    evs = doc["traceEvents"]
+    named = {e["name"]: e for e in evs if e.get("ph") == "X"}
+    assert named["parent"]["args"]["span_id"] == "aa11"
+    assert named["cross_lane_child"]["args"]["parent_id"] == "aa11"
+    flows = [e for e in evs if e.get("cat") == "flow"]
+    # exactly one s/f pair: only the cross-lane edge needs an arrow
+    assert sorted(e["ph"] for e in flows) == ["f", "s"]
+    assert flows[0]["id"] == flows[1]["id"]
+
+
+def test_train_from_dataset_trace_ids():
+    """PR-6 satellite: a training epoch is correlatable like a serving
+    request — one trace id through every step, real step->epoch->run
+    parent edges."""
+    prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [IN_DIM])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square(pred - y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    ds = [{"x": rng.rand(4, IN_DIM).astype("float32"),
+           "y": rng.rand(4, 1).astype("float32")} for _ in range(3)]
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with monitor.trace_session() as sess:
+            exe.train_from_dataset(
+                prog, ds, fetch_list=[loss], trace_id="beadbeadbeadbead")
+        assert exe.last_train_trace_id == "beadbeadbeadbead"
+        # a second epoch mints a FRESH id
+        exe.train_from_dataset(prog, ds, fetch_list=[loss])
+        assert exe.last_train_trace_id != "beadbeadbeadbead"
+    steps = [s for s in sess.spans if s["name"] == "executor/train_step"]
+    epochs = [s for s in sess.spans if s["name"] == "executor/train_epoch"]
+    assert len(steps) == 3 and len(epochs) == 1
+    assert all(s["trace_ids"] == ["beadbeadbeadbead"]
+               for s in steps + epochs)
+    assert all(s["parent"] == epochs[0]["id"] for s in steps)
+    step_ids = {s["id"] for s in steps}
+    execs = [s for s in sess.spans
+             if s["name"] in ("executor/device_execute",
+                              "executor/jit_compile")]
+    assert execs and all(s["parent"] in step_ids for s in execs)
+    assert all(s["trace_ids"] == ["beadbeadbeadbead"] for s in execs)

@@ -30,12 +30,20 @@ import re
 import sys
 from typing import List, Tuple
 
-# files owning annotated hot regions (repo-root relative)
+# files owning annotated hot regions (repo-root relative).  The wire
+# files guard the cross-host request path: codec encode/decode, the
+# client POST, and the balancer's acquire->exchange->release dispatch
+# must stay free of blocking-sync tokens (sleeps belong only in the
+# accept/health/span-merge loops OUTSIDE the regions).
 CHECKED_FILES = [
     "paddle_tpu/executor.py",
     "paddle_tpu/serving/server.py",
     "paddle_tpu/reader.py",
     "paddle_tpu/parallel/compiled_program.py",
+    "paddle_tpu/serving/wire/codec.py",
+    "paddle_tpu/serving/wire/http.py",
+    "paddle_tpu/serving/wire/client.py",
+    "paddle_tpu/serving/wire/fleet.py",
 ]
 
 # blocking-sync tokens (substring match on code, not comments)

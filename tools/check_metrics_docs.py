@@ -35,6 +35,7 @@ REGISTERING_MODULES = [
     "paddle_tpu.reader",
     "paddle_tpu.inference",
     "paddle_tpu.serving.metrics",
+    "paddle_tpu.serving.wire.metrics",
 ]
 
 # README table rows look like ``| `metric_name` | type | ... |``
