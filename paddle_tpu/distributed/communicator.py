@@ -19,6 +19,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from paddle_tpu.distributed.ps import PSClient
+from paddle_tpu.faults.retry import RetryPolicy
 
 __all__ = ["Communicator", "GeoSGD"]
 
@@ -30,6 +31,11 @@ class Communicator:
     table's queue, merges duplicate ids (grad sum — the reference's
     merge-before-send), and issues one PS push.  ``max_merge`` bounds
     staleness: at most that many batches are merged into one send.
+
+    The send thread owns a DEDICATED ``PSClient`` (opened at thread
+    start, closed in its ``finally`` on every exit path — a stopped or
+    crashed communicator must not leak sockets) so its pushes never
+    interleave frames with ``flush()``'s on the caller's client.
     """
 
     def __init__(self, client: PSClient, max_merge: int = 20, capacity: int = 200,
@@ -38,14 +44,21 @@ class Communicator:
         self._queues: Dict[str, queue.Queue] = {}
         self._max_merge = max_merge
         self._capacity = capacity
-        self._max_retries = max(1, int(max_retries))
+        # bounded transient-failure retry (reference: grpc_client.cc send
+        # deadline + retry) — shared RetryPolicy semantics: exponential
+        # backoff with full jitter, one budget per merged send
+        self._retry_policy = RetryPolicy(
+            max_attempts=max(1, int(max_retries)),
+            base_delay_s=0.2, multiplier=2.0, max_delay_s=2.0)
         self._dropped = 0  # batches lost to a full queue after retries
         self._lock = threading.Lock()
         # serializes PS pushes between the send thread and flush() — the
-        # client's sockets are not safe for interleaved frames
+        # merge queues' pop-and-push must stay atomic for the flush
+        # barrier even though each side pushes on its own client
         self._send_lock = threading.Lock()
         self._running = False
         self._thread: Optional[threading.Thread] = None
+        self._send_client: Optional[PSClient] = None  # the thread's own
         self._error: Optional[BaseException] = None
 
     # -- lifecycle (reference: Communicator::Start/Stop) --
@@ -101,11 +114,12 @@ class Communicator:
         return self._dropped
 
     # -- internals --
-    def _drain(self, table: str, block: bool) -> bool:
+    def _drain(self, table: str, block: bool, client: Optional[PSClient] = None) -> bool:
         # pop AND push under the send lock: flush()'s empty-queue +
         # lock-acquire check must never observe a popped-but-unpushed
         # batch (that would break its barrier guarantee)
         q = self._queues[table]
+        client = client if client is not None else self._client
         with self._send_lock:
             batch: List = []
             try:
@@ -120,42 +134,54 @@ class Communicator:
             ids = np.concatenate([b[0] for b in batch])
             grads = np.concatenate([b[1].reshape(len(b[0]), -1) for b in batch])
             # PSClient.push_sparse dedups+sums — the merge.  Transient PS
-            # errors get a bounded retry (reference: grpc_client.cc send
-            # deadline + retry); if the send still fails the merged batch
+            # errors get a RetryPolicy budget (exponential backoff + full
+            # jitter); if the send still fails the merged batch
             # re-enqueues so no grads are lost, and only when the queue
             # itself is full do we count a drop.
-            import time as _time
-
-            last = None
-            for attempt in range(self._max_retries):
-                try:
-                    self._client.push_sparse(table, ids, grads)
-                    return True
-                except Exception as e:  # noqa: BLE001 — network layer
-                    last = e
-                    _time.sleep(0.2 * (2 ** attempt))
+            budget = self._retry_policy.budget(op="communicator.push")
             try:
-                q.put_nowait((ids, grads))
-            except queue.Full:
-                self._dropped += len(batch)
-            raise last
+                budget.call(
+                    lambda: client.push_sparse(table, ids, grads))
+                return True
+            except Exception:  # noqa: BLE001 — network layer
+                try:
+                    q.put_nowait((ids, grads))
+                except queue.Full:
+                    self._dropped += len(batch)
+                raise
 
     def _send_loop(self):
         import time
 
-        while self._running:
-            any_sent = False
-            for table in list(self._queues):
-                try:
-                    any_sent |= self._drain(table, block=True)
-                except Exception as e:
-                    # surface on next push/flush but KEEP the thread
-                    # alive — a transient PS error must not turn into a
-                    # silent dead queue (the batch re-enqueued in _drain)
-                    self._error = e
-                    time.sleep(0.5)
-            if not any_sent and not self._queues:
-                time.sleep(0.01)
+        # the thread's own client: concurrent flush() pushes ride the
+        # caller's client, this one closes in the finally on EVERY exit
+        # path (stop, crash) — no socket leak per abandoned communicator.
+        # A duck-typed client (tests, in-memory stubs) has no endpoints
+        # to redial: share it and own nothing.
+        if isinstance(self._client, PSClient):
+            client = self._send_client = PSClient(list(self._client.endpoints))
+            own = True
+        else:
+            client = self._send_client = self._client
+            own = False
+        try:
+            while self._running:
+                any_sent = False
+                for table in list(self._queues):
+                    try:
+                        any_sent |= self._drain(table, block=True,
+                                                client=client)
+                    except Exception as e:
+                        # surface on next push/flush but KEEP the thread
+                        # alive — a transient PS error must not turn into a
+                        # silent dead queue (the batch re-enqueued in _drain)
+                        self._error = e
+                        time.sleep(0.5)
+                if not any_sent and not self._queues:
+                    time.sleep(0.01)
+        finally:
+            if own:
+                client.close()
 
 
 class GeoSGD:

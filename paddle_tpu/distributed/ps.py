@@ -30,6 +30,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from paddle_tpu import faults as _faults
+
 __all__ = ["ParameterServer", "PSClient", "shard_ids"]
 
 # bound per-message allocation (framing is attacker-controlled input)
@@ -387,6 +389,16 @@ class ParameterServer:
             return {
                 "tables": {n: {"dim": t.dim, "size": len(t.rows)} for n, t in self._tables.items()}
             }
+        if op == "assign":
+            # checkpoint RESTORE: set rows by VALUE, bypassing the
+            # optimizer (push applies -lr*grad; a restored row must land
+            # exactly as saved)
+            t = self._tables[msg["table"]]
+            rows = np.asarray(msg["rows"], np.float32)
+            with t._lock:
+                for idx, row in zip(np.asarray(msg["ids"]).reshape(-1), rows):
+                    t.rows[int(idx)] = np.array(row, np.float32)
+            return {"ok": True}
         if op == "keys":
             # paged, sorted key listing so huge shards fit the wire cap
             t = self._tables[msg["table"]]
@@ -479,24 +491,27 @@ class PSClient:
         self.endpoints = list(endpoints)
         self._socks: List[Optional[socket.socket]] = [None] * len(self.endpoints)
 
+    # connect retry: peers start concurrently and the server process may
+    # still be booting (real rendezvous semantics; a refused connection
+    # fails instantly otherwise) — deadline-bounded, jittered backoff
+    CONNECT_TIMEOUT_S = 60.0
+
     def _sock(self, i) -> socket.socket:
         if self._socks[i] is None:
             import time
 
+            from paddle_tpu.faults.retry import RetryPolicy
+
             host, port = self.endpoints[i].rsplit(":", 1)
-            # retry with deadline: peers start concurrently and the
-            # server process may still be booting (real rendezvous
-            # semantics; a refused connection fails instantly otherwise)
-            deadline = time.monotonic() + 60.0
-            while True:
-                try:
-                    s = socket.create_connection((host, int(port)), timeout=30)
-                    break
-                except ConnectionRefusedError:
-                    if time.monotonic() > deadline:
-                        raise
-                    time.sleep(0.2)
-            self._socks[i] = s
+            budget = RetryPolicy(
+                max_attempts=None, base_delay_s=0.2, multiplier=1.5,
+                max_delay_s=2.0,
+            ).budget(deadline=time.monotonic() + self.CONNECT_TIMEOUT_S,
+                     op="ps.connect")
+            self._socks[i] = budget.call(
+                lambda: socket.create_connection((host, int(port)),
+                                                 timeout=30),
+                retryable=(ConnectionRefusedError,))
         return self._socks[i]
 
     def _call(self, i, msg):
@@ -515,6 +530,8 @@ class PSClient:
 
     def pull_sparse(self, table: str, ids: np.ndarray) -> np.ndarray:
         """Row lookup for a flat id array -> [len(ids), dim]."""
+        if _faults.active is not None:  # disarmed: one is-None gate
+            _faults.active.faultpoint("ps.pull", table=table)
         ids = np.asarray(ids).reshape(-1)
         n = len(self.endpoints)
         parts = shard_ids(ids, n)
@@ -529,6 +546,8 @@ class PSClient:
         return out
 
     def push_sparse(self, table: str, ids: np.ndarray, grads: np.ndarray) -> None:
+        if _faults.active is not None:  # disarmed: one is-None gate
+            _faults.active.faultpoint("ps.push", table=table)
         ids = np.asarray(ids).reshape(-1)
         grads = np.asarray(grads).reshape(len(ids), -1)
         # de-duplicate ids, summing grads (reference merge_ids_op)
@@ -570,12 +589,16 @@ class PSClient:
         return bool(r["seeded"])
 
     def push_dense(self, name: str, grad: np.ndarray, lr: float) -> int:
+        if _faults.active is not None:  # disarmed: one is-None gate
+            _faults.active.faultpoint("ps.push", param=name)
         r = self._call(self.shard_for(name),
                        {"op": "push_dense", "name": name,
                         "grad": np.asarray(grad, np.float32), "lr": float(lr)})
         return int(r["version"])
 
     def pull_dense(self, name: str, min_version: int = 0, timeout: float = 60.0):
+        if _faults.active is not None:  # disarmed: one is-None gate
+            _faults.active.faultpoint("ps.pull", param=name)
         r = self._call(self.shard_for(name),
                        {"op": "pull_dense", "name": name,
                         "min_version": int(min_version), "timeout": timeout})
@@ -630,6 +653,31 @@ class PSClient:
                 np.concatenate(v[1]) if v[1] else np.zeros((0, 0), np.float32))
             for n, v in out.items()
         }
+
+    def load_tables(self, state, chunk_rows: Optional[int] = None):
+        """Restore a :meth:`save` dump: create any missing table and
+        ASSIGN the saved rows by value (the server-side ``assign`` op
+        bypasses the optimizer — a restored row lands exactly as saved;
+        optimizer row moments restart, and table optimizer config comes
+        from whoever creates the tables, normally the program binding).
+        Rows stream in wire-cap-sized chunks like :meth:`save`."""
+        for name, (ids, rows) in state.items():
+            ids = np.asarray(ids, np.int64).reshape(-1)
+            rows = np.asarray(rows, np.float32).reshape(len(ids), -1)
+            if not len(ids):
+                continue
+            dim = rows.shape[1]
+            self.create_table(name, dim)
+            per_chunk = chunk_rows or max(
+                1, self._SAVE_BYTES_PER_CHUNK // (dim * 4))
+            parts = shard_ids(ids, len(self.endpoints))
+            for i, pos in enumerate(parts):
+                if len(pos) == 0:
+                    continue
+                for s in range(0, len(pos), per_chunk):
+                    sel = pos[s:s + per_chunk]
+                    self._call(i, {"op": "assign", "table": name,
+                                   "ids": ids[sel], "rows": rows[sel]})
 
     def close(self):
         for s in self._socks:

@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from paddle_tpu import framework
+from paddle_tpu import faults as _faults
 from paddle_tpu.core import lowering
 from paddle_tpu.core import types as core_types
 from paddle_tpu.monitor import registry as _mon_registry
@@ -341,6 +342,8 @@ class Executor:
 
         stats = self._cache_stats
         stats["runs"] += 1
+        if _faults.active is not None:  # disarmed: one is-None gate
+            _faults.active.faultpoint("executor.run")
         _rec = _mon_spans.recording()
         _t_run0 = time.perf_counter()
         compiled = None
@@ -768,20 +771,61 @@ class Executor:
             client = ctx["_pull_client"] = PSClient(ctx["endpoints"])
         return client
 
+    # transient PS pull failures the background thread may retry: the
+    # connection classes only — a PS in-band application error
+    # (RuntimeError from PSClient._call) is deterministic and must
+    # surface, not be retried
+    _PS_PULL_RETRYABLE = (ConnectionError, OSError, TimeoutError)
+    _PS_PULL_RETRY = None  # lazily built shared RetryPolicy
+
+    @classmethod
+    def _ps_pull_policy(cls):
+        if cls._PS_PULL_RETRY is None:
+            from paddle_tpu.faults.retry import RetryPolicy
+
+            cls._PS_PULL_RETRY = RetryPolicy(
+                max_attempts=4, base_delay_s=0.05, multiplier=2.0,
+                max_delay_s=1.0)
+        return cls._PS_PULL_RETRY
+
     def _dense_ps_spawn_pull(self, ctx, names) -> None:
         """Start the next step's param pull on a background thread (one
-        in flight at a time — run() joins the previous before spawning)."""
+        in flight at a time — run() joins the previous before spawning).
+        A transient PS failure (connection refused/reset — a flapping
+        server) closes the dead client's sockets, redials on a fresh
+        dedicated client, and retries under a RetryPolicy budget; on
+        EVERY failure the erroring client's sockets are closed before
+        the error propagates (no socket leak per failed pull thread)."""
         import threading
+
+        from paddle_tpu.distributed.ps import PSClient
 
         client = self._dense_ps_pull_client(ctx)
         result: Dict[str, Any] = {}
+        budget = self._ps_pull_policy().budget(op="ps.pull")
 
         def _pull():
+            nonlocal client
             t0 = time.perf_counter()
             try:
-                result["vals"] = {
-                    n: client.pull_dense(n, min_version=0) for n in names
-                }
+                while True:
+                    try:
+                        result["vals"] = {
+                            n: client.pull_dense(n, min_version=0)
+                            for n in names
+                        }
+                        return
+                    except self._PS_PULL_RETRYABLE:
+                        # try/finally contract: the dedicated client's
+                        # sockets close on this exit path no matter what
+                        try:
+                            client.close()
+                        finally:
+                            ctx.pop("_pull_client", None)
+                        if not budget.backoff():
+                            raise
+                        client = ctx["_pull_client"] = PSClient(
+                            ctx["endpoints"])
             except BaseException as e:  # noqa: BLE001 — re-raised at join
                 result["exc"] = e
             finally:
@@ -991,7 +1035,9 @@ class Executor:
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
                            fetch_info=None, print_period=100,
-                           trainer_desc=None, trace_id=None):
+                           trainer_desc=None, trace_id=None,
+                           checkpoint_dir=None, checkpoint_every=0,
+                           checkpoint_epoch=0, resume_from=None):
         """Loop the dataset's batches through run() (reference:
         executor.py train_from_dataset -> C++ Trainer/DeviceWorker loop,
         trainer.h:38; here the compiled step is the device worker).
@@ -1000,6 +1046,20 @@ class Executor:
         defaults and validates that the chosen device worker matches the
         program (Section needs a PipelineOptimizer-cut program,
         DownpourSGD needs distributed lookup tables).
+
+        Crash-resumable training (TPU-native extension, reference:
+        checkpoint_notify + trainer restart from persistables — here
+        exact to a step): ``checkpoint_dir`` + ``checkpoint_every=N``
+        commits an atomic checkpoint every N completed steps — the
+        program's persistables, the PS sparse tables (when the program
+        is bound to a ``PSClient``), and the dataset cursor, all via
+        tmp+rename (``paddle_tpu.faults.checkpoint.TrainCheckpoint``).
+        A SIGKILLed run restarted with ``resume_from=<same dir>``
+        restores all three and SKIPS the already-consumed batches, so it
+        continues within one checkpoint interval of where it died;
+        ``last_resume_step`` reports the restored cursor.  Async PS
+        state (the overlapped pull, the Communicator's queued pushes) is
+        quiesced before each save so the checkpoint is consistent.
 
         Request-scoped tracing (TPU-native extension): the epoch mints a
         trace id (or joins ``trace_id``) readable back via
@@ -1033,7 +1093,32 @@ class Executor:
             and getattr(program, "_is_compiled_program", False) else None)
         prog_obj = compiled._program if compiled is not None else (
             program if program is not None else framework.default_main_program())
+        # crash-resume: restore persistables + PS tables + the dataset
+        # cursor BEFORE the first batch, then skip the consumed prefix
+        ckpt = None
+        start_step = 0
+        self.last_resume_step = None
+        if checkpoint_dir is not None or resume_from is not None:
+            from paddle_tpu.faults.checkpoint import TrainCheckpoint
+
+            ckpt = TrainCheckpoint(checkpoint_dir or resume_from,
+                                   every_n_steps=int(checkpoint_every))
+            if resume_from is not None:
+                # restore from resume_from even when NEW checkpoints go
+                # to a different checkpoint_dir (fork-a-run semantics)
+                src = (ckpt if checkpoint_dir in (None, resume_from)
+                       else TrainCheckpoint(resume_from))
+                cursor = src.restore(
+                    prog_obj, scope or global_scope(),
+                    ps_client=getattr(prog_obj, "_ps_client", None))
+                if cursor is not None:
+                    start_step = int(cursor.get("step", 0))
+                    self.last_resume_step = start_step
         batches = iter(dataset)
+        if start_step:
+            import itertools as _itertools
+
+            batches = _itertools.islice(batches, start_step, None)
         if n_prefetch > 1:
             # the reference's reader threads feeding device workers
             # (trainer.h thread_num): a bounded background prefetcher
@@ -1082,6 +1167,7 @@ class Executor:
         results = []
         try:
             for i, feed in enumerate(batches):
+                step = start_step + i  # global step (resume-aware cursor)
                 if _mon_spans.recording():
                     if epoch_sid is None:
                         epoch_sid = _mon_spans.new_span_id()
@@ -1096,7 +1182,7 @@ class Executor:
                             _mon_spans.record_span(
                                 "executor/train_step", _t0,
                                 time.perf_counter() - _t0, cat="train",
-                                span_id=step_sid, step=i)
+                                span_id=step_sid, step=step)
                 else:
                     out = self.run(program, feed=feed, fetch_list=fetch_list, scope=scope)
                 n_steps += 1
@@ -1104,7 +1190,11 @@ class Executor:
                     results.append(out)
                     if debug and i % print_period == 0:
                         names = fetch_info or [ _as_fetch_name(f) for f in fetch_list]
-                        print("batch %d:" % i, dict(zip(names, [np.asarray(o) for o in out])))
+                        print("batch %d:" % step, dict(zip(names, [np.asarray(o) for o in out])))
+                if ckpt is not None and ckpt.should_save(step + 1):
+                    self._train_checkpoint(
+                        ckpt, prog_obj, scope or global_scope(),
+                        step + 1, int(checkpoint_epoch), ps_ctx)
         finally:
             if epoch_sid is not None:
                 with _mon_spans.trace_context((tid,)):
@@ -1117,7 +1207,10 @@ class Executor:
                 closer()  # stop the prefetch producer (GeneratorExit path)
             if ps_ctx is not None:
                 # drain the in-flight pull so the scope leaves with the
-                # freshest params and no dangling thread
+                # freshest params and no dangling thread, then CLOSE the
+                # pull thread's dedicated client — its sockets must not
+                # outlive the epoch on any exit path (a fresh epoch
+                # redials)
                 try:
                     self._dense_ps_join_pending(ps_ctx, scope or global_scope())
                 finally:
@@ -1125,7 +1218,25 @@ class Executor:
                         ps_ctx.pop("overlap_pull", None)
                     else:
                         ps_ctx["overlap_pull"] = overlap_prev
+                    pull_client = ps_ctx.get("_pull_client")
+                    if pull_client is not None:
+                        pull_client.close()  # next epoch redials
         return results
+
+    def _train_checkpoint(self, ckpt, program, scope, step, epoch,
+                          ps_ctx) -> None:
+        """Quiesce async PS state, then commit one atomic checkpoint.
+        The overlapped dense-PS pull is joined (its params land in the
+        scope first) and the async Communicator is flushed (every queued
+        sparse grad reaches the server) so the saved params, PS rows,
+        and cursor describe the SAME step."""
+        if ps_ctx is not None:
+            self._dense_ps_join_pending(ps_ctx, scope)
+        comm = getattr(program, "_ps_communicator", None)
+        if comm is not None:
+            comm.flush()
+        ckpt.save(program, scope, step=step, epoch=epoch,
+                  ps_client=getattr(program, "_ps_client", None))
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,

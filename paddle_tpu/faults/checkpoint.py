@@ -1,0 +1,185 @@
+"""Crash-resumable training checkpoints.
+
+The reference recovered a dead trainer by reloading persistables and a
+PS table checkpoint (checkpoint_notify) and re-reading the dataset from
+the top; this manager makes the recovery *exact to a step*: one
+checkpoint atomically captures
+
+1. the program's persistables (params + optimizer accumulators + LR),
+   via ``io.save_persistables`` into the checkpoint directory,
+2. the PS sparse tables (``PSClient.save`` row dump, restored by value
+   with the ``assign`` op — not replayed through the optimizer), and
+3. the dataset **cursor** (completed-step count + caller epoch), so a
+   resumed ``train_from_dataset`` skips exactly the batches already
+   consumed instead of restarting the epoch.
+
+Atomicity is tmp+rename at every level: a checkpoint is staged under
+``<run_dir>/.tmp-<step>``, ``os.replace``d to ``ckpt-<step>`` only when
+complete, and only then does the ``LATEST`` pointer move (itself via
+tmp+rename).  A SIGKILL at ANY instant leaves either the previous
+checkpoint or the new one — never a half-written directory a resume
+could trust.
+
+Layout::
+
+    run_dir/
+      LATEST              # "ckpt-000040\n"
+      ckpt-000040/
+        cursor.json       # {"step": 40, "epoch": 0}
+        params/           # io.save_persistables output
+        ps/               # optional: manifest.json + t<i>_{ids,rows}.npy
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Dict, Optional
+
+import numpy as np
+
+from paddle_tpu.faults.metrics import TRAIN_CHECKPOINTS
+
+__all__ = ["TrainCheckpoint"]
+
+_LATEST = "LATEST"
+_PREFIX = "ckpt-"
+_TMP_PREFIX = ".tmp-"
+
+
+class TrainCheckpoint:
+    """One run directory's checkpoint manager.
+
+    ``every_n_steps``: cadence for :meth:`should_save` (0 disables the
+    periodic trigger; explicit :meth:`save` always works).
+    ``keep``: finished checkpoints retained (older ones are pruned
+    after each successful commit; the latest is never pruned).
+    """
+
+    def __init__(self, run_dir: str, every_n_steps: int = 0, keep: int = 2):
+        self.run_dir = str(run_dir)
+        self.every_n_steps = int(every_n_steps)
+        self.keep = max(1, int(keep))
+        os.makedirs(self.run_dir, exist_ok=True)
+
+    # ------------------------------------------------------------------
+    def should_save(self, completed_steps: int) -> bool:
+        return (self.every_n_steps > 0
+                and completed_steps > 0
+                and completed_steps % self.every_n_steps == 0)
+
+    def _name(self, step: int) -> str:
+        return "%s%06d" % (_PREFIX, int(step))
+
+    # ------------------------------------------------------------------
+    def save(self, program, scope, step: int, epoch: int = 0,
+             ps_client=None, extra: Optional[Dict] = None) -> str:
+        """Commit one checkpoint; returns the finished directory path.
+        ``step`` is the number of COMPLETED steps (the resume cursor).
+        The caller is responsible for quiescing async state first (the
+        executor joins its overlapped PS pull and flushes the
+        Communicator before calling)."""
+        from paddle_tpu import io as _io
+
+        final = os.path.join(self.run_dir, self._name(step))
+        tmp = os.path.join(self.run_dir, _TMP_PREFIX + self._name(step))
+        for stale in (tmp, final):  # a crashed previous attempt
+            if os.path.isdir(stale):
+                shutil.rmtree(stale)
+        os.makedirs(tmp)
+        _io.save_persistables(None, os.path.join(tmp, "params"),
+                              main_program=program, scope=scope)
+        if ps_client is not None:
+            self._save_ps(os.path.join(tmp, "ps"), ps_client)
+        cursor = {"step": int(step), "epoch": int(epoch)}
+        if extra:
+            cursor.update(extra)
+        with open(os.path.join(tmp, "cursor.json"), "w") as f:
+            json.dump(cursor, f)
+        os.replace(tmp, final)
+        # move LATEST only after the checkpoint directory is committed
+        ptr_tmp = os.path.join(self.run_dir, _LATEST + ".tmp")
+        with open(ptr_tmp, "w") as f:
+            f.write(self._name(step) + "\n")
+        os.replace(ptr_tmp, os.path.join(self.run_dir, _LATEST))
+        TRAIN_CHECKPOINTS.inc()
+        self._prune(keep_name=self._name(step))
+        return final
+
+    def _save_ps(self, dirname: str, ps_client) -> None:
+        state = ps_client.save()
+        os.makedirs(dirname)
+        manifest = []
+        for i, (table, (ids, rows)) in enumerate(sorted(state.items())):
+            np.save(os.path.join(dirname, "t%03d_ids.npy" % i),
+                    np.asarray(ids, np.int64))
+            np.save(os.path.join(dirname, "t%03d_rows.npy" % i),
+                    np.asarray(rows, np.float32))
+            manifest.append({"table": table, "index": i,
+                             "dim": int(rows.shape[1]) if rows.size else 0})
+        with open(os.path.join(dirname, "manifest.json"), "w") as f:
+            json.dump({"tables": manifest}, f)
+
+    @staticmethod
+    def _step_of(name: str) -> int:
+        try:
+            return int(name[len(_PREFIX):])
+        except ValueError:
+            return -1
+
+    def _prune(self, keep_name: str) -> None:
+        # numeric order, not lexicographic: a step past the %06d padding
+        # must never make a NEWER checkpoint sort as the oldest
+        done = sorted(
+            (d for d in os.listdir(self.run_dir)
+             if d.startswith(_PREFIX)
+             and os.path.isdir(os.path.join(self.run_dir, d))),
+            key=self._step_of)
+        excess = [d for d in done[:-self.keep] if d != keep_name]
+        for d in excess:
+            shutil.rmtree(os.path.join(self.run_dir, d), ignore_errors=True)
+
+    # ------------------------------------------------------------------
+    def latest(self) -> Optional[str]:
+        """Path of the newest COMMITTED checkpoint, or None."""
+        ptr = os.path.join(self.run_dir, _LATEST)
+        if not os.path.exists(ptr):
+            return None
+        with open(ptr) as f:
+            name = f.read().strip()
+        path = os.path.join(self.run_dir, name)
+        return path if os.path.isdir(path) else None
+
+    def restore(self, program, scope, ps_client=None) -> Optional[Dict]:
+        """Restore the newest checkpoint into ``scope`` (and the PS
+        tables through ``ps_client``); returns its cursor dict, or None
+        when the run directory holds no committed checkpoint (fresh
+        start)."""
+        from paddle_tpu import io as _io
+
+        path = self.latest()
+        if path is None:
+            return None
+        _io.load_persistables(None, os.path.join(path, "params"),
+                              main_program=program, scope=scope)
+        ps_dir = os.path.join(path, "ps")
+        if os.path.isdir(ps_dir):
+            if ps_client is None:
+                raise ValueError(
+                    "checkpoint %s carries PS tables but no ps_client was "
+                    "given to restore them" % path)
+            self._restore_ps(ps_dir, ps_client)
+        with open(os.path.join(path, "cursor.json")) as f:
+            return json.load(f)
+
+    @staticmethod
+    def _restore_ps(dirname: str, ps_client) -> None:
+        with open(os.path.join(dirname, "manifest.json")) as f:
+            manifest = json.load(f)
+        state = {}
+        for ent in manifest["tables"]:
+            i = int(ent["index"])
+            ids = np.load(os.path.join(dirname, "t%03d_ids.npy" % i))
+            rows = np.load(os.path.join(dirname, "t%03d_rows.npy" % i))
+            state[str(ent["table"])] = (ids, rows)
+        ps_client.load_tables(state)

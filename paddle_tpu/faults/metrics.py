@@ -1,0 +1,35 @@
+"""Robustness metrics (process-global registry, always on).
+
+The counters every fault-tolerance mechanism reports through: armed
+fault points count their injections here, ``RetryPolicy`` counts every
+granted retry, the half-open circuit breakers (wire fleet backends AND
+in-process serving replicas — the shared ``pool`` label distinguishes
+them) count their probe admissions, and the training checkpointer
+counts completed atomic saves.  ``tools/check_metrics_docs.py`` holds
+the README table to this set like every other metrics module.
+"""
+from __future__ import annotations
+
+from paddle_tpu.monitor import registry as _registry
+
+__all__ = [
+    "FAULTS_INJECTED", "RETRY_ATTEMPTS",
+    "BACKEND_HALFOPEN_PROBES", "TRAIN_CHECKPOINTS",
+]
+
+FAULTS_INJECTED = _registry.REGISTRY.counter(
+    "faults_injected_total",
+    "fault-point triggers that actually fired an armed injection "
+    "(point=<faultpoint name>)", ("point",))
+RETRY_ATTEMPTS = _registry.REGISTRY.counter(
+    "retry_attempts_total",
+    "retries granted by a RetryPolicy budget, after the backoff sleep "
+    "(op=<call site>)", ("op",))
+BACKEND_HALFOPEN_PROBES = _registry.REGISTRY.counter(
+    "backend_halfopen_probes_total",
+    "half-open circuit-breaker probes: a retired backend/replica "
+    "admitted one trial after its cooldown (pool=<fleet or server>)",
+    ("pool",))
+TRAIN_CHECKPOINTS = _registry.REGISTRY.counter(
+    "train_checkpoints_total",
+    "training checkpoints committed (atomic tmp+rename completed)")

@@ -58,10 +58,14 @@ def _var_path(dirname: str, name: str) -> str:
     return os.path.join(dirname, name.replace("/", "%2F") + ".npy")
 
 
-def save_vars(executor, dirname, main_program=None, vars=None, predicate=None, filename=None):
-    """reference: io.py:109.  ``filename`` packs everything into one .npz."""
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None, scope=None):
+    """reference: io.py:109.  ``filename`` packs everything into one .npz.
+    ``scope`` (TPU-native extension): read values from this scope instead
+    of the global one (the training checkpointer runs under caller-owned
+    scopes)."""
     program = main_program or framework.default_main_program()
-    scope = global_scope()
+    scope = scope if scope is not None else global_scope()
     to_save = _collect(program, predicate or _is_persistable, vars)
     os.makedirs(dirname, exist_ok=True)
     manifest = {"format_version": 1, "vars": []}
@@ -97,15 +101,19 @@ def save_params(executor, dirname, main_program=None, filename=None):
     )
 
 
-def save_persistables(executor, dirname, main_program=None, filename=None):
+def save_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
     """reference: io.py:477 — params + optimizer state + LR etc."""
-    return save_vars(executor, dirname, main_program, predicate=_is_persistable, filename=filename)
+    return save_vars(executor, dirname, main_program,
+                     predicate=_is_persistable, filename=filename, scope=scope)
 
 
-def load_vars(executor, dirname, main_program=None, vars=None, predicate=None, filename=None):
-    """reference: io.py:529.  Loads into the current global scope."""
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None, scope=None):
+    """reference: io.py:529.  Loads into the current global scope (or
+    ``scope`` when given)."""
     program = main_program or framework.default_main_program()
-    scope = global_scope()
+    scope = scope if scope is not None else global_scope()
     import jax.numpy as jnp
 
     with open(os.path.join(dirname, _MANIFEST)) as f:
@@ -142,8 +150,10 @@ def load_params(executor, dirname, main_program=None, filename=None):
     )
 
 
-def load_persistables(executor, dirname, main_program=None, filename=None):
-    return load_vars(executor, dirname, main_program, predicate=_is_persistable, filename=filename)
+def load_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=_is_persistable, filename=filename, scope=scope)
 
 
 # ---------------------------------------------------------------------------

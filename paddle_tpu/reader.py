@@ -23,6 +23,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from paddle_tpu import framework
+from paddle_tpu import faults as _faults
 from paddle_tpu.core import types as core_types
 from paddle_tpu.monitor import registry as _mon_registry
 
@@ -125,6 +126,11 @@ class _Prefetcher:
         try:
             src = self._source() if callable(self._source) else self._source
             for item in src:
+                if _faults.active is not None:  # disarmed: one is-None gate
+                    # prefetch-thread death: the injected error rides the
+                    # existing producer-exception channel — surfaced
+                    # TYPED in the consumer, thread terminates cleanly
+                    _faults.active.faultpoint("reader.prefetch")
                 if self._transform is not None:
                     item = self._transform(item)
                 if not self._put(item):

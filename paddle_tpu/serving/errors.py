@@ -15,6 +15,7 @@ __all__ = [
     "ServerClosed",
     "WireProtocolError",
     "BackendUnavailable",
+    "RelaunchFailed",
 ]
 
 
@@ -53,3 +54,11 @@ class BackendUnavailable(ServingError):
     class: the front-end balancer re-routes the request to a surviving
     backend, exactly as the in-process fleet requeues a batch off a dead
     replica thread."""
+
+
+class RelaunchFailed(ServingError):
+    """The supervisor gave up reviving a crash-looping serving child:
+    every relaunch attempt inside its capped-backoff budget failed.  The
+    backend stays retired; an operator (or a replacement launch) has to
+    intervene — the supervisor will not relaunch-storm a child that
+    cannot come up."""

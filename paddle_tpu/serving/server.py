@@ -51,7 +51,9 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from paddle_tpu import faults as _faults
 from paddle_tpu import monitor, profiler
+from paddle_tpu.faults.metrics import BACKEND_HALFOPEN_PROBES
 from paddle_tpu.monitor import flight as _flight
 from paddle_tpu.monitor import spans as _mon_spans
 from paddle_tpu.serving.batching import DynamicBatcher, ServingRequest
@@ -86,7 +88,7 @@ class _Replica:
 
     __slots__ = ("idx", "name", "predictor", "nonblocking", "lock", "q",
                  "thread", "alive", "in_flight", "executed", "failed",
-                 "consec_failures")
+                 "consec_failures", "retired_at", "removed")
 
     def __init__(self, idx: int, predictor):
         self.idx = idx
@@ -110,6 +112,8 @@ class _Replica:
         self.executed = 0
         self.failed = 0
         self.consec_failures = 0
+        self.retired_at = None  # monotonic stamp of failure retirement
+        self.removed = False    # remove_replica(): never re-admit
 
 
 class InferenceServer:
@@ -135,8 +139,18 @@ class InferenceServer:
         bucket_ladder: Optional[Sequence[int]] = None,
         input_specs: Optional[Dict[str, Tuple[tuple, Any]]] = None,
         name: str = "server",
+        readmit_cooldown_s: Optional[float] = None,
     ):
         self.name = name
+        # circuit-breaker re-admission for failure-retired replicas: a
+        # retired replica goes half-open after this cooldown and takes
+        # ONE probe batch (it rejoins routing with a single remaining
+        # strike — the probe's success resets the streak, a failure
+        # re-retires immediately).  None (default) keeps retirement
+        # terminal, the pre-existing behavior.
+        self._readmit_cooldown = (
+            float(readmit_cooldown_s) if readmit_cooldown_s is not None
+            else None)
         predictors = (
             list(predictor) if isinstance(predictor, (list, tuple))
             else [predictor])
@@ -449,10 +463,35 @@ class InferenceServer:
                     self._stop, self._on_expired, block=True)
                 if batch is None:
                     return  # stopped and drained
+                self._maybe_readmit()
                 self._route(batch, retries=max(1, len(self._replicas)))
         finally:
             for rep in self._replicas:
                 rep.q.put(None)  # drain sentinel (idempotent)
+
+    def _maybe_readmit(self) -> None:
+        """Half-open re-admission pass (readmit_cooldown_s set): a
+        failure-retired replica whose cooldown elapsed rejoins routing
+        with one remaining strike — the next routed batch IS the probe
+        (success resets the streak in _finalize, failure re-retires in
+        _replica_failure)."""
+        if self._readmit_cooldown is None:
+            return
+        now = time.monotonic()
+        with self._route_cv:
+            for rep in self._replicas:
+                if (rep.alive or rep.removed or rep.retired_at is None
+                        or now - rep.retired_at < self._readmit_cooldown):
+                    continue
+                rep.alive = True
+                rep.retired_at = None
+                rep.consec_failures = _REPLICA_FAIL_LIMIT - 1
+                BACKEND_HALFOPEN_PROBES.labels(
+                    pool="server/%s" % self.name).inc()
+                monitor.record_instant(
+                    "serving/replica_readmit", cat="serving",
+                    server=self.name, replica=rep.name)
+                self._route_cv.notify_all()
 
     def _pick_replica(self, exclude: Optional[_Replica]):
         """Least-loaded live replica with capacity, or None.  Caller
@@ -514,6 +553,7 @@ class InferenceServer:
     def _retire_replica(self, rep: _Replica) -> None:
         with self._route_cv:
             rep.alive = False
+            rep.retired_at = time.monotonic()  # re-admission cooldown
             self._route_cv.notify_all()
 
     def _count_requeue(self, rep: _Replica) -> None:
@@ -524,6 +564,23 @@ class InferenceServer:
         monitor.record_instant(
             "serving/batch_requeue", cat="serving",
             server=self.name, replica=rep.name)
+
+    def _requeue(self, rep: _Replica, batch: List[ServingRequest],
+                 retries: int) -> None:
+        """Re-route a batch off ``rep`` — failing already-expired
+        requests fast with DeadlineExceeded BEFORE they burn a
+        retry/replica slot (an expired request re-routed to a survivor
+        would occupy real capacity just to be shed there)."""
+        live = []
+        for r in batch:
+            if r.expired():
+                self._on_expired(r)
+            else:
+                live.append(r)
+        if not live:
+            return
+        self._count_requeue(rep)
+        self._route(live, retries, exclude=rep)
 
     def _replica_exit(self, rep: _Replica) -> None:
         """Terminal bookkeeping for a replica thread: mark dead under
@@ -562,6 +619,7 @@ class InferenceServer:
                 "serving/replica_drain", cat="serving",
                 server=self.name, replica=rep.name)
             rep.alive = False
+            rep.removed = True  # deliberate: re-admission never undoes it
             self._route_cv.notify_all()
             deadline = time.monotonic() + timeout
             while rep.in_flight > 0 and time.monotonic() < deadline:
@@ -579,7 +637,9 @@ class InferenceServer:
         _mon_spans.set_thread_lane(
             "serving/%s/%s worker" % (self.name, rep.name))
         pending = None
+        _unset = object()
         while True:
+            item = _unset
             if not rep.alive:
                 # retired (failure) or removed (remove_replica): finish
                 # the in-flight batch, re-route the rest, then PARK as a
@@ -592,23 +652,25 @@ class InferenceServer:
                     pending = None
                 self._drain_replica_queue(rep)
                 item = rep.q.get()
-                if item is None:
-                    self._replica_exit(rep)
-                    return  # server stopping
-                batch, retries = item
-                self._release(rep)
-                self._count_requeue(rep)
-                self._route(batch, retries, exclude=rep)
-                continue
-            if pending is None:
-                item = rep.q.get()
-            else:
-                try:
-                    item = rep.q.get_nowait()
-                except queue.Empty:
-                    self._finalize(rep, *pending)
-                    pending = None
-                    continue  # re-enter blocking wait
+                if item is not None and not rep.alive:
+                    batch, retries = item
+                    self._release(rep)
+                    self._requeue(rep, batch, retries)
+                    continue
+                # item is the stop sentinel (exit below), or the replica
+                # was RE-ADMITTED while parked (half-open probe): the
+                # batch that just arrived is the probe — serve it via
+                # the normal path
+            if item is _unset:
+                if pending is None:
+                    item = rep.q.get()
+                else:
+                    try:
+                        item = rep.q.get_nowait()
+                    except queue.Empty:
+                        self._finalize(rep, *pending)
+                        pending = None
+                        continue  # re-enter blocking wait
             if item is None:
                 if pending is not None:
                     self._finalize(rep, *pending)
@@ -655,8 +717,7 @@ class InferenceServer:
                 continue
             batch, retries = item
             self._release(rep)  # give up this replica's slot...
-            self._count_requeue(rep)
-            self._route(batch, retries, exclude=rep)  # ...take one elsewhere
+            self._requeue(rep, batch, retries)  # ...take one elsewhere
         if saw_sentinel:
             rep.q.put(None)
 
@@ -708,6 +769,10 @@ class InferenceServer:
                         # at the RecordEvent batch span instead)
                         stack.enter_context(
                             _mon_spans.parent_scope(batch[0].parent_span))
+                if _faults.active is not None:  # disarmed: one is-None gate
+                    _faults.active.faultpoint(
+                        "replica.dispatch", server=self.name,
+                        replica=rep.name)
                 merged = {
                     name: (
                         np.concatenate([r.feed[name] for r in batch], axis=0)
@@ -756,8 +821,7 @@ class InferenceServer:
             survivors = any(
                 r.alive and r is not rep for r in self._replicas)
         if retries > 0 and survivors:
-            self._count_requeue(rep)
-            self._route(batch, retries - 1, exclude=rep)
+            self._requeue(rep, batch, retries - 1)
             return
         self._metrics.count("failed", len(batch))
         fr = _flight.get()

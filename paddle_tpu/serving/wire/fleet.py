@@ -26,21 +26,27 @@ below N-1 and cold jit caches never see traffic.
 """
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from paddle_tpu import faults as _faults
 from paddle_tpu import monitor
+from paddle_tpu.faults.metrics import BACKEND_HALFOPEN_PROBES
+from paddle_tpu.faults.retry import RetryPolicy
 from paddle_tpu.monitor import flight as _flight
 from paddle_tpu.monitor import spans as _spans
 from paddle_tpu.serving import errors as _errors
 from paddle_tpu.serving.errors import (
     BackendUnavailable,
     DeadlineExceeded,
+    RelaunchFailed,
     ServerOverloaded,
     ServingError,
+    WireProtocolError,
 )
 from paddle_tpu.serving.metrics import ServingMetrics
 from paddle_tpu.serving.wire import launch as _launch
@@ -63,17 +69,33 @@ _BACKEND_FAIL_LIMIT = 3
 # notifies from releases/retirements)
 _ROUTE_WAIT_S = 0.5
 
+# transport failures the balancer may re-route: the process died
+# mid-exchange (no response), it answered that it is shutting down, or
+# the frame was corrupted in flight.  Inference is stateless and
+# idempotent, so re-sending a corrupted-or-lost exchange to a survivor
+# cannot double-apply anything.
+_RETRYABLE = (BackendUnavailable, _errors.ServerClosed, WireProtocolError)
+
+
+def _probe_jitter(interval_s: float, rng: random.Random) -> float:
+    """Per-backend probe spacing: the interval +-15%.  N backends probed
+    on one lockstep clock would thundering-herd a server that is just
+    coming back; de-phased clocks spread the load."""
+    return interval_s * (0.85 + 0.3 * rng.random())
+
 
 class _Backend:
     """One serving process behind the balancer: transport + health and
     in-flight accounting (the routing state)."""
 
-    __slots__ = ("name", "transport", "handle", "alive", "in_flight",
+    __slots__ = ("idx", "name", "transport", "handle", "alive", "in_flight",
                  "executed", "failed", "consec_failures",
-                 "consec_health_failures")
+                 "consec_health_failures", "retired_at", "removed",
+                 "give_up", "next_probe_at")
 
-    def __init__(self, name: str, transport: HttpTransport,
+    def __init__(self, idx: int, name: str, transport: HttpTransport,
                  handle: Optional[_launch.ServerHandle] = None):
+        self.idx = idx
         self.name = name
         self.transport = transport
         self.handle = handle  # launched child (None: bare address)
@@ -83,6 +105,10 @@ class _Backend:
         self.failed = 0
         self.consec_failures = 0
         self.consec_health_failures = 0
+        self.retired_at = 0.0     # monotonic stamp of failure retirement
+        self.removed = False      # deliberate removal: never re-admit
+        self.give_up = False      # supervisor exhausted its relaunches
+        self.next_probe_at = 0.0  # per-backend jittered probe clock
 
 
 class FleetBalancer:
@@ -99,7 +125,10 @@ class FleetBalancer:
     def __init__(self, backends: Sequence, name: str = "fleet",
                  max_in_flight: int = 8,
                  timeout_s: float = 30.0,
-                 health_interval_s: Optional[float] = 1.0):
+                 health_interval_s: Optional[float] = 1.0,
+                 cooldown_s: float = 5.0,
+                 supervisor: Optional[_launch.Supervisor] = None,
+                 retry_policy: Optional[RetryPolicy] = None):
         if not backends:
             raise ValueError("FleetBalancer needs at least one backend")
         self.name = name
@@ -108,10 +137,24 @@ class FleetBalancer:
         self._backends: List[_Backend] = []
         for i, b in enumerate(backends):
             self._add_backend_obj(i, b)
+        # requeue budget per request: enough attempts to try every
+        # backend once plus one survivor retry, with a short
+        # full-jitter backoff so a fleet-wide blip isn't re-stormed
+        self._retry_policy = retry_policy or RetryPolicy(
+            max_attempts=max(2, len(self._backends) + 1),
+            base_delay_s=0.005, multiplier=2.0, max_delay_s=0.1)
+        # circuit-breaker re-admission: a failure-retired backend goes
+        # half-open after cooldown_s and takes one probe; a backend
+        # whose PROCESS died is revived through the supervisor (capped
+        # backoff) before the probe
+        self._cooldown_s = float(cooldown_s)
+        self._supervisor = supervisor
         self._metrics = ServingMetrics(name)
         self._retired_counter = WIRE_BACKEND_RETIRED.labels(fleet=name)
         self._health_counter = WIRE_HEALTH_CHECKS.labels(fleet=name)
         self._health_failures = WIRE_HEALTH_CHECK_FAILURES.labels(fleet=name)
+        self._halfopen_probes = BACKEND_HALFOPEN_PROBES.labels(
+            pool="fleet/%s" % name)
         self._route_cv = threading.Condition()
         self._closed = False
         self._warmed = False
@@ -151,13 +194,13 @@ class FleetBalancer:
     def _add_backend_obj(self, idx: int, b) -> _Backend:
         if isinstance(b, _launch.ServerHandle):
             be = _Backend(
-                "b%d@%s:%d" % (idx, b.host, b.port),
+                idx, "b%d@%s:%d" % (idx, b.host, b.port),
                 HttpTransport(b.host, b.port, timeout_s=self._timeout_s),
                 handle=b)
         else:
             host, port = b
             be = _Backend(
-                "b%d@%s:%d" % (idx, host, port),
+                idx, "b%d@%s:%d" % (idx, host, port),
                 HttpTransport(host, port, timeout_s=self._timeout_s))
         self._backends.append(be)
         return be
@@ -242,6 +285,13 @@ class FleetBalancer:
                 if self._closed:
                     raise _errors.ServerClosed(
                         "fleet %r is stopped" % self.name)
+                if deadline is not None and time.monotonic() >= deadline:
+                    # expired BEFORE taking a slot: fail fast typed —
+                    # never burn a backend's in-flight capacity on a
+                    # request whose caller already gave up
+                    self._metrics.count("expired")
+                    raise DeadlineExceeded(
+                        "deadline passed before acquiring a backend")
                 be = self._pick(exclude)
                 if be is None and exclude is not None and not any(
                         b.alive and b is not exclude for b in self._backends):
@@ -278,6 +328,7 @@ class FleetBalancer:
 
     def _retire_locked(self, be: _Backend, why: str) -> None:
         be.alive = False
+        be.retired_at = time.monotonic()  # half-open cooldown starts now
         self._retired_counter.inc()
         monitor.record_instant(
             "wire/backend_retired", cat="wire",
@@ -344,10 +395,12 @@ class FleetBalancer:
                                cap + extra_spans, fleet=self.name)
 
     # hot-path: begin fleet_dispatch (acquire -> wire exchange -> release;
-    # the only waits are the bounded capacity CV and socket I/O)
+    # the only waits are the bounded capacity CV, the retry budget's
+    # jittered backoff, and socket I/O)
     def _route(self, names, arrays, timeout_ms, deadline, tid):
         t_submit = time.perf_counter()
-        retries = max(1, len(self._backends))
+        budget = self._retry_policy.budget(
+            deadline=deadline, op="fleet.requeue")
         exclude: Optional[_Backend] = None
         while True:
             be = self._acquire(exclude, deadline)
@@ -367,17 +420,30 @@ class FleetBalancer:
                     raise DeadlineExceeded(
                         "deadline passed before the wire exchange")
             try:
+                # the fault gate lives INSIDE the try: an error-mode
+                # injection follows the exact release/requeue path a real
+                # transport failure takes (never leaks the in-flight slot)
+                if _faults.active is not None:  # disarmed: one is-None gate
+                    _faults.active.faultpoint(
+                        "fleet.dispatch", backend=be.name,
+                        pid=be.handle.pid if be.handle is not None else None)
                 rmeta, routs = wire_call(
                     be.transport, names, arrays, remaining_ms, tid)
-            except (BackendUnavailable, _errors.ServerClosed):
+            except _RETRYABLE:
                 # retryable: the process died mid-exchange (no response
-                # ever arrived) or answered that it is shutting down —
-                # either way the request did NOT complete there, so
-                # re-sending to a survivor cannot double-run it
+                # ever arrived), answered that it is shutting down, or
+                # the frame corrupted in flight — the request did NOT
+                # complete there, so re-sending a stateless inference to
+                # a survivor cannot double-run anything
                 self._release(be, ok=False)
                 self._record_failure(be)
-                retries -= 1
-                if retries <= 0:
+                if deadline is not None and time.monotonic() >= deadline:
+                    # fail fast typed at the REQUEUE site: an expired
+                    # request must not burn another retry/backend slot
+                    self._metrics.count("expired")
+                    raise DeadlineExceeded(
+                        "deadline passed at requeue after backend failure")
+                if not budget.backoff():
                     self._metrics.count("failed")
                     raise
                 self._count_requeue(be)
@@ -392,6 +458,14 @@ class FleetBalancer:
                        else "shed" if isinstance(e, ServerOverloaded)
                        else "failed")
                 self._metrics.count(key)
+                raise
+            except BaseException:
+                # anything non-serving (an injected builtin error type, a
+                # bug in the transport): the slot must still release, and
+                # it counts as a backend failure like any other
+                self._release(be, ok=False)
+                self._record_failure(be)
+                self._metrics.count("failed")
                 raise
             self._release(be, ok=True)
             self._metrics.observe_request(
@@ -465,10 +539,23 @@ class FleetBalancer:
     # health checking + rolling replacement
     # ------------------------------------------------------------------
     def _health_loop(self, interval_s: float) -> None:
-        while not self._health_stop.wait(interval_s):
+        # each backend owns a de-phased probe clock (see _probe_jitter):
+        # N backends must not fire /healthz in lockstep at a server that
+        # is just recovering.  The same loop runs the circuit breaker's
+        # re-admission pass for retired backends.
+        rng = random.Random("probe-jitter:%s" % self.name)
+        now = time.monotonic()
+        with self._route_cv:
+            for be in self._backends:
+                be.next_probe_at = now + interval_s * rng.random()
+        while True:
             with self._route_cv:
                 targets = [b for b in self._backends if b.alive]
+            now = time.monotonic()
             for be in targets:
+                if be.next_probe_at > now:
+                    continue
+                be.next_probe_at = now + _probe_jitter(interval_s, rng)
                 self._health_counter.inc()
                 try:
                     doc = be.transport.get_json("/healthz", timeout_s=2.0)
@@ -484,6 +571,82 @@ class FleetBalancer:
                     with self._route_cv:
                         if be.alive:
                             self._retire_locked(be, "health checks")
+            self._reanimate()
+            with self._route_cv:
+                nxt = min((b.next_probe_at for b in self._backends
+                           if b.alive), default=now + interval_s)
+            wait = max(0.01, min(interval_s, nxt - time.monotonic()))
+            if self._health_stop.wait(wait):
+                return
+
+    # ------------------------------------------------------------------
+    # circuit-breaker re-admission: retired -> (cooldown) -> half-open
+    # probe -> rejoined, with the supervisor reviving dead processes
+    # ------------------------------------------------------------------
+    def _reanimate(self) -> None:
+        """One re-admission pass.  A backend retired for FAILURES (not
+        removed by an operator/rolling replacement) whose cooldown
+        elapsed goes half-open: a dead child process is first revived
+        through the supervisor (capped-backoff relaunch), then ONE
+        ``/healthz`` probe decides — success rejoins routing with one
+        remaining strike, failure restarts the cooldown.  Runs on the
+        health thread; also callable directly (tests, no-thread use)."""
+        now = time.monotonic()
+        with self._route_cv:
+            candidates = [
+                b for b in self._backends
+                if not b.alive and not b.removed and not b.give_up
+                and now - b.retired_at >= self._cooldown_s
+            ]
+        for be in candidates:
+            if be.handle is not None and be.handle.poll() is not None:
+                if self._supervisor is None:
+                    continue  # process is gone and nothing can revive it
+                try:
+                    handle = self._supervisor.revive(be.handle)
+                except RelaunchFailed:
+                    with self._route_cv:
+                        be.give_up = True
+                    continue
+                if self._warmed:
+                    # the fleet promised zero recompiles: a revived child
+                    # warms before it can rejoin routing
+                    try:
+                        handle.warmup()
+                    except ServingError:
+                        handle.kill()
+                        with self._route_cv:
+                            be.retired_at = time.monotonic()
+                        continue
+                with self._route_cv:
+                    old_transport = be.transport
+                    be.handle = handle
+                    be.transport = HttpTransport(
+                        handle.host, handle.port, timeout_s=self._timeout_s)
+                    be.name = "b%d@%s:%d" % (be.idx, handle.host, handle.port)
+                old_transport.close()
+            self._halfopen_probes.inc()
+            try:
+                ok = bool(be.transport.get_json(
+                    "/healthz", timeout_s=2.0).get("ok"))
+            except ServingError:
+                ok = False
+            with self._route_cv:
+                if be.removed or be.alive:
+                    continue
+                if ok:
+                    be.alive = True
+                    be.consec_health_failures = 0
+                    # half-open: ONE remaining strike — the next request
+                    # failure re-retires immediately, a success resets
+                    be.consec_failures = _BACKEND_FAIL_LIMIT - 1
+                    self._route_cv.notify_all()
+                else:
+                    be.retired_at = time.monotonic()
+            if ok:
+                monitor.record_instant(
+                    "wire/backend_readmitted", cat="wire",
+                    fleet=self.name, backend=be.name)
 
     def check_health(self) -> Dict[str, bool]:
         """One synchronous probe round (bench/test convenience; the
@@ -521,9 +684,12 @@ class FleetBalancer:
                 self._route_cv.notify_all()
             new_handles.append(handle)
             # drain: stop routing to the old backend, let its in-flight
-            # requests finish, then ask the process to exit gracefully
+            # requests finish, then ask the process to exit gracefully.
+            # removed (not retired): re-admission must never resurrect a
+            # deliberately replaced backend
             with self._route_cv:
                 old.alive = False
+                old.removed = True
                 self._route_cv.notify_all()
                 deadline = time.monotonic() + drain_timeout_s
                 while old.in_flight > 0 and time.monotonic() < deadline:

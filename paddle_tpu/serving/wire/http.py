@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from paddle_tpu import faults as _faults
 from paddle_tpu.serving.errors import BackendUnavailable, DeadlineExceeded
 from paddle_tpu.serving.wire import codec
 from paddle_tpu.serving.wire.metrics import (
@@ -141,6 +142,11 @@ class HttpTransport(Transport):
         # hot-path: begin wire_request (client side of the hop: one POST
         # over the pooled keep-alive connection; the only waits are
         # socket I/O bounded by the timeout)
+        if _faults.active is not None:  # disarmed: one is-None gate
+            act = _faults.active.faultpoint(
+                "wire.send", backend="%s:%d" % self.address)
+            if act is not None:
+                body = act.corrupt(body)
         conn = self._conn(timeout_s)
         try:
             conn.request("POST", path, body=body, headers=hdrs)

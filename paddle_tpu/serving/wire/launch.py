@@ -24,7 +24,8 @@ import sys
 import threading
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["ServerHandle", "launch_server", "main"]
+__all__ = ["ServerHandle", "launch_server", "relaunch", "Supervisor",
+           "main"]
 
 READY_PREFIX = "WIRE_READY "
 
@@ -240,6 +241,57 @@ def relaunch(handle: ServerHandle, port: int = 0) -> ServerHandle:
             "address?) — cannot relaunch" % handle.name)
     spec["port"] = port
     return launch_server(**spec)
+
+
+class Supervisor:
+    """Relaunch crash-looped serving children with capped backoff.
+
+    The re-admission story's last resort: a retired backend whose
+    PROCESS is gone cannot pass a half-open probe, so the balancer hands
+    its handle here.  ``revive()`` retries :func:`relaunch` under a
+    ``RetryPolicy`` budget (exponential backoff, capped at
+    ``max_delay_s``, full jitter) and gives up with a typed
+    ``RelaunchFailed`` after ``max_attempts`` — a child that dies on
+    every boot must not be relaunch-stormed forever.  Every attempt
+    (successful or not) increments
+    ``wire_backend_relaunches_total{fleet=...}``.
+
+    ``sleep`` is injectable so crash-loop tests run in milliseconds.
+    """
+
+    def __init__(self, max_attempts: int = 3, base_delay_s: float = 0.5,
+                 max_delay_s: float = 10.0, multiplier: float = 2.0,
+                 fleet: str = "supervisor", sleep=None):
+        import time as _time
+
+        from paddle_tpu.faults.retry import RetryPolicy
+
+        self.fleet = fleet
+        self._policy = RetryPolicy(
+            max_attempts=max(1, int(max_attempts)),
+            base_delay_s=base_delay_s, multiplier=multiplier,
+            max_delay_s=max_delay_s,
+            sleep=sleep if sleep is not None else _time.sleep)
+
+    def revive(self, handle: ServerHandle, port: int = 0) -> ServerHandle:
+        """A fresh, READY child from ``handle``'s launch spec, or a
+        ``RelaunchFailed`` chaining the last boot error."""
+        from paddle_tpu.serving.errors import RelaunchFailed
+        from paddle_tpu.serving.wire.metrics import WIRE_BACKEND_RELAUNCHES
+
+        relaunches = WIRE_BACKEND_RELAUNCHES.labels(fleet=self.fleet)
+        budget = self._policy.budget(op="wire.relaunch")
+        last: Exception
+        while True:
+            relaunches.inc()
+            try:
+                return relaunch(handle, port=port)
+            except Exception as e:  # noqa: BLE001 — typed give-up below
+                last = e
+            if not budget.backoff():
+                raise RelaunchFailed(
+                    "giving up on child %r after %d relaunch attempt(s): %r"
+                    % (handle.name, budget.attempts, last)) from last
 
 
 # ---------------------------------------------------------------------------

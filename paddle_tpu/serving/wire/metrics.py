@@ -18,6 +18,7 @@ __all__ = [
     "WIRE_REQUESTS", "WIRE_BYTES_SENT", "WIRE_BYTES_RECEIVED",
     "WIRE_CODEC_SECONDS", "WIRE_BACKEND_RETIRED",
     "WIRE_HEALTH_CHECKS", "WIRE_HEALTH_CHECK_FAILURES",
+    "WIRE_BACKEND_RELAUNCHES",
 ]
 
 WIRE_REQUESTS = _registry.REGISTRY.counter(
@@ -47,3 +48,8 @@ WIRE_HEALTH_CHECKS = _registry.REGISTRY.counter(
 WIRE_HEALTH_CHECK_FAILURES = _registry.REGISTRY.counter(
     "wire_health_check_failures_total",
     "balancer /healthz probes that failed or timed out", ("fleet",))
+WIRE_BACKEND_RELAUNCHES = _registry.REGISTRY.counter(
+    "wire_backend_relaunches_total",
+    "supervisor relaunch attempts for crashed serving children "
+    "(each attempt counts; compare against RelaunchFailed give-ups)",
+    ("fleet",))

@@ -1,0 +1,84 @@
+"""Child process for the SIGKILL-and-resume chaos drill.
+
+Trains a tiny linear-regression program with checkpointing enabled and
+prints one parseable ``batch <step>: {'loss': ...}`` line per step (the
+executor's own debug stream).  Batches are a deterministic function of
+the GLOBAL step index, so a resumed run regenerates exactly the batches
+the killed run would have consumed — loss-trajectory continuity is then
+a straight per-step comparison.
+
+Driven by tests/chaos/test_chaos_training.py; not a test module.
+"""
+import argparse
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, REPO_ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu import framework  # noqa: E402
+
+W_TRUE = np.array([[0.5], [-1.0], [2.0], [0.25]], np.float32)
+
+
+def build_model():
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 17
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+    return prog, startup, loss
+
+
+def batches(n_steps, step_delay):
+    for i in range(n_steps):
+        rng = np.random.RandomState(1000 + i)  # keyed by GLOBAL step
+        x = rng.uniform(-1, 1, (8, 4)).astype("float32")
+        y = (x @ W_TRUE + 0.05 * rng.standard_normal((8, 1))).astype(
+            "float32")
+        if step_delay:
+            time.sleep(step_delay)
+        yield {"x": x, "y": y}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--run-dir", required=True)
+    ap.add_argument("--steps", type=int, required=True)
+    ap.add_argument("--ckpt-every", type=int, default=5)
+    ap.add_argument("--step-delay", type=float, default=0.0)
+    ap.add_argument("--resume", action="store_true")
+    args = ap.parse_args()
+
+    prog, startup, loss = build_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.train_from_dataset(
+            program=prog,
+            dataset=batches(args.steps, args.step_delay),
+            scope=scope,
+            fetch_list=[loss], fetch_info=["loss"],
+            debug=True, print_period=1,
+            checkpoint_dir=args.run_dir,
+            checkpoint_every=args.ckpt_every,
+            resume_from=args.run_dir if args.resume else None,
+        )
+        if args.resume:
+            print("RESUMED_FROM %s" % exe.last_resume_step, flush=True)
+    print("DONE", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,379 @@
+"""Chaos drills for the training stack: PS pull flaps under async
+training (``ps.pull``), Communicator push flaps (``ps.push``),
+prefetch-thread death (``reader.prefetch``), and crash-resumable
+``train_from_dataset`` — both an in-process mid-epoch crash via the
+``executor.run`` fault point and a real SIGKILLed child that resumes
+with loss-trajectory continuity.
+"""
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import faults, framework, monitor, reader
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    yield
+    faults.disarm()
+
+
+# ---------------------------------------------------------------------------
+# reader.prefetch: producer-thread death is typed, leak-free, healable
+# ---------------------------------------------------------------------------
+def test_prefetch_thread_death_is_typed_and_heals():
+    def src():
+        for i in range(10):
+            yield {"a": np.full((2,), i, np.float32)}
+
+    with faults.armed("reader.prefetch=error:RuntimeError,after=3,times=1"):
+        p = reader._Prefetcher(src, size=2)
+        got = []
+        with pytest.raises(RuntimeError, match="injected fault"):
+            for item in p:
+                got.append(item)
+        assert len(got) == 3  # the pre-fault prefix was delivered
+        p._thread.join(timeout=5.0)
+        assert not p._thread.is_alive()  # died clean, no thread leak
+        p.close()
+
+        # the device_buffered consumer path surfaces the same typed error
+        # (the fault healed after times=1, so this epoch runs clean)
+        it = reader.device_buffered(src, size=2, device=None)()
+        assert len(list(it)) == 10
+
+
+def test_prefetch_death_mid_train_from_dataset(tmp_path):
+    """The executor's prefetch path (thread=N) propagates the producer's
+    typed error out of train_from_dataset instead of hanging."""
+    prog, startup, loss = _tiny_model(seed=5)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    feeds = _feeds(8)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with faults.armed(
+                "reader.prefetch=error:ConnectionError,after=2,times=1"):
+            with pytest.raises(ConnectionError, match="injected fault"):
+                exe.train_from_dataset(program=prog, dataset=feeds,
+                                       scope=scope, thread=2,
+                                       fetch_list=[loss])
+        # disarmed: the same pipeline trains end to end
+        out = exe.train_from_dataset(program=prog, dataset=feeds,
+                                     scope=scope, thread=2,
+                                     fetch_list=[loss])
+    assert len(out) == 8
+
+
+# ---------------------------------------------------------------------------
+# ps.push: Communicator rides out a push flap without losing grads
+# ---------------------------------------------------------------------------
+def test_communicator_survives_ps_push_flap():
+    from paddle_tpu.distributed.communicator import Communicator
+    from paddle_tpu.distributed.ps import ParameterServer, PSClient
+
+    srv = ParameterServer().start()
+    cli = PSClient([srv.endpoint])
+    try:
+        cli.create_table("emb", 2, initializer="zeros", lr=1.0)
+        r0 = monitor.counter_value(
+            "retry_attempts_total", op="communicator.push")
+        comm = Communicator(cli, max_retries=4).start()
+        # the send thread's first two pushes fail injected, then heal —
+        # the merged batch must retry, never drop
+        with faults.armed("ps.push=error:ConnectionError,times=2"):
+            comm.push("emb", np.array([3, 9]),
+                      np.full((2, 2), -1.0, np.float32))
+            comm.flush()
+        comm.stop()
+        assert comm.dropped == 0
+        rows = cli.pull_sparse("emb", np.array([3, 9]))
+        np.testing.assert_allclose(rows, np.ones((2, 2)))  # lr=1, g=-1
+        assert monitor.counter_value(
+            "retry_attempts_total", op="communicator.push") - r0 >= 2
+    finally:
+        cli.close()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# ps.pull: dense-PS pull flaps during async (Hogwild) training
+# ---------------------------------------------------------------------------
+def test_ps_pull_flap_during_async_training():
+    import socket as _socket
+
+    from paddle_tpu.trainer_desc import TrainerFactory
+    from paddle_tpu.transpiler import DistributeTranspiler
+
+    def _model():
+        from paddle_tpu import unique_name
+
+        with unique_name.guard():
+            prog, startup = framework.Program(), framework.Program()
+            prog.random_seed = startup.random_seed = 11
+            with framework.program_guard(prog, startup):
+                x = fluid.layers.data("x", [8])
+                y = fluid.layers.data("y", [1], dtype="int64")
+                h = fluid.layers.fc(x, 16, act="relu")
+                logits = fluid.layers.fc(h, 4)
+                loss = fluid.layers.mean(
+                    fluid.layers.softmax_with_cross_entropy(logits, y))
+                fluid.optimizer.SGDOptimizer(0.2).minimize(loss)
+            return prog, startup, loss
+
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    ep = "127.0.0.1:%d" % s.getsockname()[1]
+    s.close()
+
+    t = DistributeTranspiler()
+    p, st, _ = _model()
+    t.transpile(0, program=p, pservers=ep, trainers=1, sync_mode=False)
+    pprog = t.get_pserver_program(ep)
+    threading.Thread(target=fluid.Executor(fluid.CPUPlace()).run,
+                     args=(pprog,), daemon=True).start()
+
+    prog, startup, loss = _model()
+    t2 = DistributeTranspiler()
+    t2.transpile(0, program=prog, pservers=ep, trainers=1, sync_mode=True)
+    tprog = t2.get_trainer_program()
+    desc = TrainerFactory().create_trainer()  # Hogwild: async rounds
+    desc.set_fetch_var_and_info([loss], ["loss"], 100)
+
+    rng = np.random.RandomState(0)
+    xb = rng.uniform(-1, 1, (16, 8)).astype("float32")
+    yb = rng.randint(0, 4, (16, 1)).astype("int64")
+    feeds = [{"x": xb, "y": yb} for _ in range(12)]
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    r0 = monitor.counter_value("retry_attempts_total", op="ps.pull")
+    try:
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            # the init handshake performs 4 direct pull_dense calls (one
+            # per param); after=10 lands the two flaps inside a STEP's
+            # overlapped background pull, the retry-protected path
+            with faults.armed(
+                    "ps.pull=error:ConnectionError,after=10,times=2"):
+                out = exe.train_from_dataset(
+                    program=tprog, dataset=feeds, scope=scope,
+                    trainer_desc=desc)
+        assert tprog._dense_ps_ctx["sync"] is False
+        assert len(out) == 12  # every step completed despite the flap
+        losses = [float(np.asarray(o[0])) for o in out]
+        assert losses[-1] < losses[0] * 0.9, losses
+        # the background pull retried (and redialed) through the budget
+        assert monitor.counter_value(
+            "retry_attempts_total", op="ps.pull") - r0 >= 1
+        # the epoch closed its dedicated pull client's sockets (no leak;
+        # the flap's redial path already closed the dead client's)
+        pull_client = tprog._dense_ps_ctx.get("_pull_client")
+        assert pull_client is None or all(
+            s is None for s in pull_client._socks)
+    finally:
+        if hasattr(pprog, "_pserver"):
+            pprog._pserver.stop()
+
+
+# ---------------------------------------------------------------------------
+# executor.run + checkpoint/resume: in-process mid-epoch crash drill
+# ---------------------------------------------------------------------------
+def _tiny_model(seed=3):
+    from paddle_tpu import unique_name
+
+    with unique_name.guard():
+        prog, startup = framework.Program(), framework.Program()
+        prog.random_seed = startup.random_seed = seed
+        with framework.program_guard(prog, startup):
+            x = fluid.layers.data("x", [4])
+            y = fluid.layers.data("y", [1])
+            pred = fluid.layers.fc(x, 1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+        return prog, startup, loss
+
+
+def _feeds(n):
+    out = []
+    for i in range(n):
+        rng = np.random.RandomState(1000 + i)
+        x = rng.uniform(-1, 1, (8, 4)).astype("float32")
+        y = (x @ np.array([[0.5], [-1.0], [2.0], [0.25]], np.float32)
+             + 0.05 * rng.standard_normal((8, 1))).astype("float32")
+        out.append({"x": x, "y": y})
+    return out
+
+
+def test_executor_run_fault_mid_epoch_then_resume(tmp_path):
+    """An injected executor.run crash mid-epoch leaves a committed
+    checkpoint; a fresh scope resumed from it replays the remaining
+    steps with losses matching an uninterrupted golden run exactly."""
+    feeds = _feeds(12)
+    run_dir = str(tmp_path / "run")
+
+    # golden: uninterrupted
+    prog, startup, loss = _tiny_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        golden = [float(np.asarray(o[0])) for o in exe.train_from_dataset(
+            program=prog, dataset=feeds, scope=scope, fetch_list=[loss])]
+
+    # crashed run: checkpoint every 4 steps, injected crash at step 9
+    prog, startup, loss = _tiny_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with faults.armed("executor.run=error:RuntimeError,after=9,times=1"):
+            with pytest.raises(RuntimeError, match="injected fault"):
+                exe.train_from_dataset(
+                    program=prog, dataset=feeds, scope=scope,
+                    fetch_list=[loss], checkpoint_dir=run_dir,
+                    checkpoint_every=4)
+    assert os.path.exists(os.path.join(run_dir, "LATEST"))
+
+    # fork-a-run (review regression): resume_from=crashed run while NEW
+    # checkpoints go to a DIFFERENT dir — the restore must come from
+    # resume_from, not the empty checkpoint_dir (run first: it must not
+    # advance run_dir's cursor)
+    fork_dir = str(tmp_path / "fork")
+    prog, startup, loss = _tiny_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        out = exe.train_from_dataset(
+            program=prog, dataset=feeds, scope=scope, fetch_list=[loss],
+            checkpoint_dir=fork_dir, checkpoint_every=4,
+            resume_from=run_dir)
+    assert exe.last_resume_step == 8
+    forked = [float(np.asarray(o[0])) for o in out]
+    np.testing.assert_allclose(forked, golden[8:], rtol=1e-5)
+    assert os.path.exists(os.path.join(fork_dir, "ckpt-000012"))
+
+    # resumed run proper: FRESH scope + executor, restore-and-continue
+    # in place (the fork above wrote nothing into run_dir)
+    prog, startup, loss = _tiny_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)  # params re-initialized... then overwritten
+        out = exe.train_from_dataset(
+            program=prog, dataset=feeds, scope=scope, fetch_list=[loss],
+            checkpoint_dir=run_dir, checkpoint_every=4,
+            resume_from=run_dir)
+    assert exe.last_resume_step == 8  # the last committed cursor
+    resumed = [float(np.asarray(o[0])) for o in out]
+    assert len(resumed) == 4  # steps 8..11 only — the cursor skipped 8
+    # loss-trajectory continuity: the resumed tail IS the golden tail
+    np.testing.assert_allclose(resumed, golden[8:], rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# the real thing: SIGKILL a training child, resume, assert continuity
+# ---------------------------------------------------------------------------
+def _spawn_child(run_dir, steps, step_delay, resume=False):
+    argv = [sys.executable, "-u",
+            os.path.join(REPO_ROOT, "tests", "chaos", "_train_child.py"),
+            "--run-dir", run_dir, "--steps", str(steps),
+            "--ckpt-every", "5", "--step-delay", str(step_delay)]
+    if resume:
+        argv.append("--resume")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    prev = env.get("PYTHONPATH")
+    env["PYTHONPATH"] = REPO_ROOT + (os.pathsep + prev if prev else "")
+    return subprocess.Popen(argv, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, env=env)
+
+
+_LOSS_RE = re.compile(r"batch (\d+): \{'loss': array\(([0-9.eE+-]+)")
+
+
+def _parse_losses(lines):
+    out = {}
+    for line in lines:
+        m = _LOSS_RE.search(line)
+        if m:
+            out[int(m.group(1))] = float(m.group(2))
+    return out
+
+
+def test_sigkill_then_resume_loss_continuity(tmp_path):
+    """The acceptance drill: a training child is SIGKILLed mid-epoch
+    (after its checkpointer committed), restarted with resume, and
+    continues from the cursor — overlapping steps' losses match the
+    killed run's, so the trajectory is continuous, not restarted."""
+    run_dir = str(tmp_path / "run")
+    proc = _spawn_child(run_dir, steps=400, step_delay=0.15)
+    lines, err_lines = [], []
+
+    def _collect(stream, sink):
+        try:
+            for line in stream:
+                sink.append(line)
+        except Exception:
+            pass
+
+    # both pipes drain on threads: a chatty child (jax logs on stderr)
+    # must never block on a full pipe before its first checkpoint
+    threading.Thread(target=_collect, args=(proc.stdout, lines),
+                     daemon=True).start()
+    threading.Thread(target=_collect, args=(proc.stderr, err_lines),
+                     daemon=True).start()
+    try:
+        # wait for the first committed checkpoint + two more steps
+        deadline = time.monotonic() + 120
+        latest = os.path.join(run_dir, "LATEST")
+        while not os.path.exists(latest):
+            assert proc.poll() is None, (
+                "child died before its first checkpoint:\n"
+                + "".join(lines) + "".join(err_lines))
+            assert time.monotonic() < deadline, "no checkpoint within 120s"
+            time.sleep(0.05)
+        n0 = len(_parse_losses(lines))
+        while len(_parse_losses(lines)) < n0 + 2:
+            assert proc.poll() is None and time.monotonic() < deadline
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGKILL)  # the crash
+        assert proc.wait(timeout=30) == -9
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    killed = _parse_losses(lines)
+    assert killed, "killed run produced no parseable steps"
+    with open(latest) as f:
+        cursor = int(f.read().strip().rsplit("-", 1)[1])
+    assert cursor % 5 == 0 and cursor >= 5
+    assert max(killed) >= cursor  # it ran PAST the checkpoint, then died
+
+    # resume: same run dir, short remaining horizon, no artificial delay
+    res = _spawn_child(run_dir, steps=cursor + 6, step_delay=0.0,
+                       resume=True)
+    out, err = res.communicate(timeout=180)
+    assert res.returncode == 0, err
+    assert ("RESUMED_FROM %d" % cursor) in out
+    resumed = _parse_losses(out.splitlines())
+    # the cursor was honored: nothing before it was re-run
+    assert min(resumed) == cursor
+    # loss-trajectory continuity on every overlapping step
+    overlap = sorted(set(killed) & set(resumed))
+    assert overlap, (sorted(killed), sorted(resumed))
+    for step in overlap:
+        np.testing.assert_allclose(
+            resumed[step], killed[step], rtol=1e-4,
+            err_msg="divergence at resumed step %d" % step)

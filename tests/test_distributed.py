@@ -1110,8 +1110,11 @@ def test_dense_ps_overlapped_pull_hides_latency_and_trains():
                                          scope=scope, trainer_desc=desc)
         ctx = tprog._dense_ps_ctx
         assert ctx["sync"] is False
-        # the pull thread ran on a DEDICATED client and was drained
+        # the pull thread ran on a DEDICATED client and was drained —
+        # and the epoch closed that client's sockets on the way out
+        # (PR 7 leak contract; a fresh epoch redials)
         assert ctx.get("_pull_client") is not None
+        assert ctx["_pull_client"]._socks == [None] * len(ctx["endpoints"])
         assert ctx.get("_pull_pending") is None
         assert "overlap_pull" not in ctx  # flag restored after the loop
         stats = exe.jit_cache_stats()

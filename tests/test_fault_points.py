@@ -1,0 +1,40 @@
+"""Tier-1 wiring for tools/check_fault_points.py: every faultpoint()
+site must use a module-unique name, be documented in README's fault
+catalog, and be driven by at least one chaos test — and the checker
+itself must actually catch drift (a guard matching nothing would pass
+forever).
+"""
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+
+import check_fault_points  # noqa: E402
+
+
+def test_fault_points_documented_and_chaos_covered():
+    problems = check_fault_points.check(REPO_ROOT)
+    assert problems == [], "\n".join(problems)
+
+
+def test_catalog_has_the_known_points():
+    """The site scanner must actually see the framework's gates — an
+    accidentally broken regex would empty the set and pass vacuously."""
+    points = set(check_fault_points.source_points(REPO_ROOT))
+    for want in ("wire.send", "fleet.dispatch", "ps.pull", "ps.push",
+                 "replica.dispatch", "reader.prefetch", "executor.run"):
+        assert want in points, (want, sorted(points))
+
+
+def test_checker_catches_undocumented_point(tmp_path):
+    root = tmp_path
+    (root / "paddle_tpu").mkdir()
+    (root / "paddle_tpu" / "x.py").write_text(
+        'if a is not None:\n    a.faultpoint("ghost.point")\n')
+    (root / "README.md").write_text("| `other.point` | somewhere |\n")
+    (root / "tests").mkdir()
+    problems = check_fault_points.check(str(root))
+    assert any("ghost.point" in p and "catalog" in p for p in problems)
+    assert any("other.point" in p and "stale" in p for p in problems)
+    assert any("ghost.point" in p and "chaos" in p for p in problems)

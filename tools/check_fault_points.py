@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Static fault-point guard: source, docs, and the chaos suite agree.
+
+Every ``faultpoint("...")`` site in ``paddle_tpu/`` must
+
+1. use a name unique to ONE module (two different code paths sharing a
+   name would make injection counters and chaos assertions ambiguous;
+   multiple sites of the same semantic point inside one module are
+   fine — e.g. ``ps.pull`` guards both sparse and dense pulls),
+2. be documented in the README "Fault tolerance" catalog table (an
+   operator arming ``PADDLE_TPU_FAULTS`` works from that table), and
+3. be exercised by at least one chaos test (``tests/chaos/``) — an
+   uninjected fault point is dead weight that will rot.
+
+Conversely, every catalog row must name a fault point that still exists
+in source.
+
+Wired into tier-1 via tests/test_fault_points.py (alongside
+check_hot_path, which keeps the gates themselves off the blocking-sync
+list); also runnable directly::
+
+    python tools/check_fault_points.py   # exits 1 and prints problems
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict, List, Set, Tuple
+
+# call sites look like  _faults.active.faultpoint("wire.send", ...)
+_SITE_RE = re.compile(r"""\.faultpoint\(\s*["']([a-z0-9_.]+)["']""")
+
+# README catalog rows look like  | `wire.send` | ... |
+_ROW_RE = re.compile(r"^\|\s*`([a-z0-9_.]+)`\s*\|")
+
+_SOURCE_ROOT = "paddle_tpu"
+_CHAOS_DIR = os.path.join("tests", "chaos")
+_README = "README.md"
+
+# definition/docs files whose faultpoint mentions are not injection
+# sites (the registry defines the method; its docstring shows usage)
+_EXCLUDE = {os.path.join("paddle_tpu", "faults", "__init__.py")}
+
+
+def source_points(root: str) -> Dict[str, Set[str]]:
+    """{point name: {repo-relative files using it}}."""
+    out: Dict[str, Set[str]] = {}
+    src = os.path.join(root, _SOURCE_ROOT)
+    for dirpath, _, files in os.walk(src):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root)
+            if rel in _EXCLUDE:
+                continue
+            with open(path) as f:
+                for name in _SITE_RE.findall(f.read()):
+                    out.setdefault(name, set()).add(rel)
+    return out
+
+
+def documented_points(root: str) -> Set[str]:
+    names: Set[str] = set()
+    with open(os.path.join(root, _README)) as f:
+        for line in f:
+            m = _ROW_RE.match(line.strip())
+            if m and "." in m.group(1):  # metric rows have no dots
+                names.add(m.group(1))
+    return names
+
+
+def chaos_covered(root: str) -> Set[str]:
+    """Fault-point names mentioned anywhere under tests/chaos/ (direct
+    faultpoint() references, arm() spec strings, or env plans)."""
+    text = []
+    chaos = os.path.join(root, _CHAOS_DIR)
+    if os.path.isdir(chaos):
+        for fn in sorted(os.listdir(chaos)):
+            if fn.endswith(".py"):
+                with open(os.path.join(chaos, fn)) as f:
+                    text.append(f.read())
+    blob = "\n".join(text)
+    return {name for name in source_points(root) if name in blob}
+
+
+def check(repo_root: str = None) -> List[str]:
+    root = repo_root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    points = source_points(root)
+    problems: List[str] = []
+    for name, files in sorted(points.items()):
+        mods = {f for f in files}
+        if len(mods) > 1:
+            problems.append(
+                "fault point %r is used from multiple modules (%s) — "
+                "names are unique per code path" % (name, sorted(mods)))
+    documented = documented_points(root)
+    covered = chaos_covered(root)
+    for name in sorted(set(points) - documented):
+        problems.append(
+            "fault point %r is not in the README fault-point catalog"
+            % name)
+    for name in sorted(documented - set(points)):
+        problems.append(
+            "stale README catalog row %r: no such faultpoint() in source"
+            % name)
+    for name in sorted(set(points) - covered):
+        problems.append(
+            "fault point %r has no chaos test under tests/chaos/ "
+            "referencing it" % name)
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if not problems:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        pts = source_points(root)
+        print("check_fault_points: OK (%d fault points documented and "
+              "chaos-covered)" % len(pts))
+        return 0
+    for p in problems:
+        print("check_fault_points: %s" % p, file=sys.stderr)
+    print("check_fault_points: %d problem(s)" % len(problems),
+          file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
