@@ -37,6 +37,19 @@ client-observed p50/p99 for both plus their delta
 HTTP hop as a measured number.  The child warms up through the same
 persistent compile cache, and its recompile counter must stay 0
 (asserted via ``/statusz`` over the wire).
+
+``--overload`` (or $BENCH_SERVING_OVERLOAD=1) runs the graceful-
+degradation sweep instead: measure the endpoint's saturation
+throughput closed-loop, then drive OPEN-loop offered load at 1x/2x/3x
+saturation with mixed priority classes and record, per stage and per
+priority, goodput / shed / expired counts and client-observed p99 —
+plus the adaptive admit limit and brownout level the server settled
+at, and the median ``retry_after_ms`` hint the sheds carried.  The
+headline value is goodput at 3x as a fraction of saturation: a
+production edge must keep it flat past the knee (the chaos suite
+asserts the >= 0.7 floor; the bench records the curve).
+Env knobs: BENCH_OVERLOAD_SECONDS (per stage, default 3),
+BENCH_OVERLOAD_MULTIPLIERS (default "1,2,3").
 """
 import json
 import os
@@ -323,6 +336,170 @@ def run_wire():
     }
 
 
+def _bench_overload(name, save_fn):
+    """The graceful-degradation sweep for one endpoint: saturation
+    throughput first (closed loop), then open-loop offered load at
+    multiples of it with mixed priorities."""
+    from paddle_tpu import serving
+    from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+
+    stage_s = float(os.environ.get("BENCH_OVERLOAD_SECONDS", "3"))
+    multipliers = tuple(
+        float(m) for m in os.environ.get(
+            "BENCH_OVERLOAD_MULTIPLIERS", "1,2,3").split(","))
+    deadline_ms = float(os.environ.get("BENCH_OVERLOAD_DEADLINE_MS", "2000"))
+    prios = (("high", serving.PRIORITY_HIGH),
+             ("normal", serving.PRIORITY_NORMAL),
+             ("low", serving.PRIORITY_LOW))
+
+    with tempfile.TemporaryDirectory() as tmp:
+        d = os.path.join(tmp, name)
+        make_rows = save_fn(d)
+        predictor = create_paddle_predictor(AnalysisConfig(d))
+        server = serving.InferenceServer(
+            predictor, max_batch_size=MAX_BATCH, batch_timeout_ms=TIMEOUT_MS,
+            queue_capacity=max(64, THREADS * 8), name=name)
+        try:
+            server.warmup()
+            cli = serving.Client(server)
+
+            # --- saturation: closed-loop storm, completed requests/sec
+            done = [0] * THREADS
+            stop_flag = threading.Event()
+            start = threading.Barrier(THREADS + 1)
+
+            def closed(tid):
+                rng = np.random.RandomState(300 + tid)
+                start.wait()
+                while not stop_flag.is_set():
+                    n = REQ_SIZES[(tid + done[tid]) % len(REQ_SIZES)]
+                    try:
+                        cli.infer(make_rows(n, rng), timeout_ms=deadline_ms)
+                        done[tid] += 1
+                    except serving.ServingError:
+                        pass
+
+            threads = [threading.Thread(target=closed, args=(t,))
+                       for t in range(THREADS)]
+            for t in threads:
+                t.start()
+            start.wait()
+            t0 = time.perf_counter()
+            time.sleep(stage_s)
+            stop_flag.set()
+            for t in threads:
+                t.join()
+            sat_rps = sum(done) / (time.perf_counter() - t0)
+
+            # --- overload sweep: open-loop submission at mult * sat_rps
+            stages = {}
+            rng = np.random.RandomState(7)
+            for mult in multipliers:
+                target_rps = max(1.0, mult * sat_rps)
+                interval = 1.0 / target_rps
+                per = {
+                    label: {"offered": 0, "completed": 0, "shed": 0,
+                            "expired": 0, "lat": []}
+                    for label, _ in prios
+                }
+                hints = []
+                pending = []
+                t0 = time.perf_counter()
+                i = 0
+                while True:
+                    now = time.perf_counter()
+                    if now - t0 >= stage_s:
+                        break
+                    # paced submission: catch up to the offered-load
+                    # schedule, then sleep to the next slot (open loop —
+                    # the arrival process does not care who completed)
+                    while i * interval <= now - t0:
+                        label, prio = prios[i % len(prios)]
+                        n = REQ_SIZES[i % len(REQ_SIZES)]
+                        per[label]["offered"] += 1
+                        try:
+                            req = server.submit(
+                                make_rows(n, rng), timeout_ms=deadline_ms,
+                                priority=prio)
+                            pending.append((label, time.perf_counter(), req))
+                        except serving.ServerOverloaded as e:
+                            per[label]["shed"] += 1
+                            if e.retry_after_ms is not None:
+                                hints.append(e.retry_after_ms)
+                        except serving.DeadlineExceeded:
+                            per[label]["expired"] += 1
+                        i += 1
+                    time.sleep(min(interval, 0.002))
+                elapsed_submit = time.perf_counter() - t0
+                for label, t_sub, req in pending:
+                    try:
+                        req.result()
+                        per[label]["completed"] += 1
+                        # done_t is stamped at COMPLETION, so latency is
+                        # honest even though this gather loop drains
+                        # sequentially after the submission window
+                        per[label]["lat"].append(
+                            ((req.done_t or time.perf_counter()) - t_sub)
+                            * 1e3)
+                    except serving.ServerOverloaded as e:
+                        per[label]["shed"] += 1  # evicted while queued
+                        if e.retry_after_ms is not None:
+                            hints.append(e.retry_after_ms)
+                    except serving.ServingError:
+                        per[label]["expired"] += 1
+                completed = sum(p["completed"] for p in per.values())
+                for label in per:
+                    lat = sorted(per[label].pop("lat"))
+                    per[label]["p99_ms"] = (
+                        round(lat[int(0.99 * (len(lat) - 1))], 3)
+                        if lat else None)
+                stages["%gx" % mult] = {
+                    "offered_rps": round(i / elapsed_submit, 1),
+                    "goodput_rps": round(completed / elapsed_submit, 1),
+                    "goodput_vs_saturation": round(
+                        completed / elapsed_submit / sat_rps, 3)
+                    if sat_rps else None,
+                    "per_priority": per,
+                    "retry_after_ms_p50": (
+                        round(sorted(hints)[len(hints) // 2], 2)
+                        if hints else None),
+                    "admit_limit_end": server._batcher.queue.limit,
+                    "brownout_level_end": server._brownout.level,
+                }
+            m = server.metrics()
+            return {
+                "saturation_rps": round(sat_rps, 1),
+                "stages": stages,
+                "shed_total": m["shed"],
+                "expired_total": m["expired"],
+                "admit_limit_final": m["admit_limit"],
+            }
+        finally:
+            server.stop(drain=False)
+
+
+def run_overload():
+    """The ``--overload`` line: the degradation curve past saturation."""
+    import jax
+
+    import bench_common
+
+    bench_common.configure_compile_cache(bench_common.HOME_CACHE_DIR)
+    endpoints = {"lenet": _bench_overload("lenet", _save_lenet)}
+    # numeric, not lexicographic: "10x" must beat "5x" for the headline
+    last = max(endpoints["lenet"]["stages"], key=lambda k: float(k[:-1]))
+    return {
+        "metric": "serving_overload_goodput",
+        "unit": "fraction_of_saturation",
+        "value": endpoints["lenet"]["stages"][last]["goodput_vs_saturation"],
+        "endpoints": endpoints,
+        "threads": THREADS,
+        "max_batch_size": MAX_BATCH,
+        "batch_timeout_ms": TIMEOUT_MS,
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def _wire_mode(argv=None):
     """``--wire loopback`` / $BENCH_SERVING_WIRE."""
     import sys
@@ -403,6 +580,12 @@ def main():
 
     # --metrics-out <path> (or $BENCH_METRICS_OUT) dumps the monitor
     # registry snapshot next to the JSON line
+    import sys
+
+    if "--overload" in sys.argv[1:] or os.environ.get(
+            "BENCH_SERVING_OVERLOAD"):
+        bench_common.emit_result(run_overload())
+        return
     mode = _wire_mode()
     if mode:
         if mode != "loopback":

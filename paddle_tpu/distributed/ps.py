@@ -385,20 +385,48 @@ class ParameterServer:
             self.create_table(msg["table"], msg["dim"], **msg.get("kwargs", {}))
             return {"ok": True}
         if op == "tables":
-            # table directory for chunked checkpointing
+            # table directory for chunked checkpointing ("moments": rows
+            # with live optimizer state — adagrad accumulators — so a
+            # checkpoint knows whether a moment dump is needed at all)
             return {
-                "tables": {n: {"dim": t.dim, "size": len(t.rows)} for n, t in self._tables.items()}
+                "tables": {
+                    n: {"dim": t.dim, "size": len(t.rows),
+                        "moments": len(t.moments)}
+                    for n, t in self._tables.items()
+                }
             }
         if op == "assign":
             # checkpoint RESTORE: set rows by VALUE, bypassing the
             # optimizer (push applies -lr*grad; a restored row must land
-            # exactly as saved)
+            # exactly as saved).  An optional "moments" payload restores
+            # the adagrad accumulators the same way, so a resumed sparse
+            # optimizer continues with the exact per-row step sizes it
+            # died with instead of restarting from zero
             t = self._tables[msg["table"]]
             rows = np.asarray(msg["rows"], np.float32)
+            moments = msg.get("moments")
+            if moments is not None:
+                moments = np.asarray(moments, np.float32)
             with t._lock:
-                for idx, row in zip(np.asarray(msg["ids"]).reshape(-1), rows):
-                    t.rows[int(idx)] = np.array(row, np.float32)
+                for k, idx in enumerate(np.asarray(msg["ids"]).reshape(-1)):
+                    t.rows[int(idx)] = np.array(rows[k], np.float32)
+                    if moments is not None:
+                        t.moments[int(idx)] = np.array(
+                            moments[k], np.float32)
             return {"ok": True}
+        if op == "pull_moments":
+            # checkpoint SAVE: optimizer accumulators for the given ids,
+            # zeros where absent (zero IS adagrad's initial state, so
+            # the dump stays exact and id-aligned with the row pull)
+            t = self._tables[msg["table"]]
+            ids = np.asarray(msg["ids"]).reshape(-1)
+            with t._lock:
+                out = np.zeros((len(ids), t.dim), np.float32)
+                for i, idx in enumerate(ids):
+                    m = t.moments.get(int(idx))
+                    if m is not None:
+                        out[i] = m
+            return {"rows": out}
         if op == "keys":
             # paged, sorted key listing so huge shards fit the wire cap
             t = self._tables[msg["table"]]
@@ -607,15 +635,35 @@ class PSClient:
     # stay well under _MAX_MSG per frame (header + payload slack)
     _SAVE_BYTES_PER_CHUNK = 256 << 20
 
-    def save(self, chunk_rows: Optional[int] = None):
+    def save(self, chunk_rows: Optional[int] = None,
+             include_moments: bool = False):
         """Checkpoint every table across all shards (reference:
         checkpoint_notify_op.cc / RequestCheckpoint).  Keys page and rows
         stream in chunks sized by the row width, so any shard checkpoints
-        within the wire-frame cap.  Returns {table: (ids[N], rows[N, dim])}."""
+        within the wire-frame cap.  Returns {table: (ids[N], rows[N, dim])}.
+
+        ``include_moments=True`` additionally dumps the server-side
+        optimizer accumulators (adagrad moments) for any table that has
+        them, id-aligned with the row dump: values become
+        ``(ids, rows, moments_or_None)`` 3-tuples, and a restore through
+        :meth:`load_tables` is then EXACT for sparse optimizers (the
+        per-row step sizes resume, not restart)."""
         out: Dict[str, List] = {}
+        # one directory pass up front: a table whose moments live on ANY
+        # shard dumps moments from EVERY shard (zeros where absent), so
+        # the concatenated dump stays id-aligned across shards
+        shard_tables = [
+            self._call(i, {"op": "tables"})["tables"]
+            for i in range(len(self.endpoints))
+        ]
+        has_moments = set()
+        if include_moments:
+            for tables in shard_tables:
+                for name, info in tables.items():
+                    if int(info.get("moments", 0)) > 0:
+                        has_moments.add(name)
         for i in range(len(self.endpoints)):
-            tables = self._call(i, {"op": "tables"})["tables"]
-            for name, info in tables.items():
+            for name, info in shard_tables[i].items():
                 dim = max(1, int(info["dim"]))
                 rows_per_chunk = chunk_rows or max(
                     1, self._SAVE_BYTES_PER_CHUNK // (dim * 4)
@@ -635,35 +683,62 @@ class PSClient:
                         break
                 ids = np.concatenate(id_pages) if id_pages else np.zeros(0, np.int64)
                 chunks = []
+                mchunks = []
                 for s in range(0, len(ids), rows_per_chunk):
                     part = ids[s : s + rows_per_chunk]
                     chunks.append(
                         self._call(i, {"op": "pull", "table": name, "ids": part})["rows"]
                     )
+                    if name in has_moments:
+                        mchunks.append(self._call(
+                            i, {"op": "pull_moments", "table": name,
+                                "ids": part})["rows"])
                 rows = (
                     np.concatenate(chunks)
                     if chunks
                     else np.zeros((0, dim), np.float32)
                 )
-                out.setdefault(name, [[], []])
+                out.setdefault(name, [[], [], []])
                 out[name][0].append(ids)
                 out[name][1].append(rows)
-        return {
-            n: (np.concatenate(v[0]) if v[0] else np.zeros(0, np.int64),
-                np.concatenate(v[1]) if v[1] else np.zeros((0, 0), np.float32))
-            for n, v in out.items()
-        }
+                if name in has_moments:
+                    out[name][2].append(
+                        np.concatenate(mchunks) if mchunks
+                        else np.zeros((0, dim), np.float32))
+        state = {}
+        for n, v in out.items():
+            ids = np.concatenate(v[0]) if v[0] else np.zeros(0, np.int64)
+            rows = (np.concatenate(v[1]) if v[1]
+                    else np.zeros((0, 0), np.float32))
+            if not include_moments:
+                state[n] = (ids, rows)
+            else:
+                moments = np.concatenate(v[2]) if v[2] else None
+                state[n] = (ids, rows, moments)
+        return state
 
     def load_tables(self, state, chunk_rows: Optional[int] = None):
         """Restore a :meth:`save` dump: create any missing table and
         ASSIGN the saved rows by value (the server-side ``assign`` op
         bypasses the optimizer — a restored row lands exactly as saved;
-        optimizer row moments restart, and table optimizer config comes
-        from whoever creates the tables, normally the program binding).
-        Rows stream in wire-cap-sized chunks like :meth:`save`."""
-        for name, (ids, rows) in state.items():
+        table optimizer config comes from whoever creates the tables,
+        normally the program binding).  Values may be ``(ids, rows)``
+        pairs or ``(ids, rows, moments)`` triples from
+        ``save(include_moments=True)`` — a moments array restores the
+        adagrad accumulators by value too, making SIGKILL-resume exact
+        for sparse optimizers.  Rows stream in wire-cap-sized chunks
+        like :meth:`save`."""
+        for name, value in state.items():
+            if len(value) == 3:
+                ids, rows, moments = value
+            else:
+                ids, rows = value
+                moments = None
             ids = np.asarray(ids, np.int64).reshape(-1)
             rows = np.asarray(rows, np.float32).reshape(len(ids), -1)
+            if moments is not None:
+                moments = np.asarray(moments, np.float32).reshape(
+                    len(ids), -1)
             if not len(ids):
                 continue
             dim = rows.shape[1]
@@ -676,8 +751,11 @@ class PSClient:
                     continue
                 for s in range(0, len(pos), per_chunk):
                     sel = pos[s:s + per_chunk]
-                    self._call(i, {"op": "assign", "table": name,
-                                   "ids": ids[sel], "rows": rows[sel]})
+                    msg = {"op": "assign", "table": name,
+                           "ids": ids[sel], "rows": rows[sel]}
+                    if moments is not None:
+                        msg["moments"] = moments[sel]
+                    self._call(i, msg)
 
     def close(self):
         for s in self._socks:

@@ -28,6 +28,7 @@ Layout::
         cursor.json       # {"step": 40, "epoch": 0}
         params/           # io.save_persistables output
         ps/               # optional: manifest.json + t<i>_{ids,rows}.npy
+                          #   (+ t<i>_moments.npy: adagrad accumulators)
 """
 from __future__ import annotations
 
@@ -107,16 +108,26 @@ class TrainCheckpoint:
         return final
 
     def _save_ps(self, dirname: str, ps_client) -> None:
-        state = ps_client.save()
+        # include_moments: the adagrad accumulators dump alongside the
+        # rows so a SIGKILL-resume is exact for sparse optimizers (a
+        # moment-less restore would restart per-row step sizes at their
+        # largest and re-diverge the loss trajectory)
+        state = ps_client.save(include_moments=True)
         os.makedirs(dirname)
         manifest = []
-        for i, (table, (ids, rows)) in enumerate(sorted(state.items())):
+        for i, (table, value) in enumerate(sorted(state.items())):
+            ids, rows = value[0], value[1]
+            moments = value[2] if len(value) == 3 else None
             np.save(os.path.join(dirname, "t%03d_ids.npy" % i),
                     np.asarray(ids, np.int64))
             np.save(os.path.join(dirname, "t%03d_rows.npy" % i),
                     np.asarray(rows, np.float32))
+            if moments is not None:
+                np.save(os.path.join(dirname, "t%03d_moments.npy" % i),
+                        np.asarray(moments, np.float32))
             manifest.append({"table": table, "index": i,
-                             "dim": int(rows.shape[1]) if rows.size else 0})
+                             "dim": int(rows.shape[1]) if rows.size else 0,
+                             "moments": moments is not None})
         with open(os.path.join(dirname, "manifest.json"), "w") as f:
             json.dump({"tables": manifest}, f)
 
@@ -181,5 +192,11 @@ class TrainCheckpoint:
             i = int(ent["index"])
             ids = np.load(os.path.join(dirname, "t%03d_ids.npy" % i))
             rows = np.load(os.path.join(dirname, "t%03d_rows.npy" % i))
-            state[str(ent["table"])] = (ids, rows)
+            mpath = os.path.join(dirname, "t%03d_moments.npy" % i)
+            # pre-moments checkpoints (no flag, no file) restore as
+            # before: rows only, accumulators restart
+            if ent.get("moments") and os.path.exists(mpath):
+                state[str(ent["table"])] = (ids, rows, np.load(mpath))
+            else:
+                state[str(ent["table"])] = (ids, rows)
         ps_client.load_tables(state)

@@ -482,13 +482,36 @@ def make_train_step(cfg: HybridConfig, mesh=None, optimizer=None):
                     new_aux["%s@%s" % (n, slot)] = out
         return new_p, new_aux
 
+    # pre-vma jax (no lax.pvary / jax.typeof) runs shard_map with
+    # check_rep=False (mesh_lib.shard_map), which disables the automatic
+    # cotangent psum over each input's replication axes — grads come back
+    # as raw per-device partials.  The exact correction: every device
+    # seeds its (replicated) loss output with 1, so the SPMD backward
+    # computes the adjoint of N_mesh identical losses — psum the grad
+    # over the param's replicated axes and divide by the mesh size.
+    pre_vma = (getattr(jax, "typeof", None) is None
+               or getattr(jax.lax, "pvary", None) is None)
+    n_mesh = int(np.prod(list(cfg.mesh_axes().values())))
+
+    def reduce_grads(grads):
+        out = {}
+        for n, g in grads.items():
+            rep = replicated_axes(specs[n])
+            if rep:
+                g = jax.lax.psum(g, rep)
+            out[n] = g / n_mesh
+        return out
+
     def sharded_step(params, aux, tokens, labels):
         # Gradient reduction over each param's replication axes (the
         # reference's NCCL allreduce, details/all_reduce_op_handle.cc) is
         # inserted by shard_map's transpose: under check_vma=True the
         # cotangent of an input that is invariant over an axis is psum'd
-        # over that axis automatically.
+        # over that axis automatically.  Under pre-vma check_rep=False
+        # the reduction is applied explicitly (reduce_grads above).
         loss, grads = jax.value_and_grad(local_loss)(params, tokens, labels)
+        if pre_vma:
+            grads = reduce_grads(grads)
         if optimizer is None:
             new_params = {n: params[n] - cfg.lr * grads[n] for n in params}
             return loss, new_params, aux

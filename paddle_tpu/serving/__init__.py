@@ -35,6 +35,13 @@ Quickstart::
     out, = serving.Client(server).infer({"x": rows})
     server.stop(drain=True)
 """
+from paddle_tpu.serving.admission import (
+    PRIORITY_HIGH,
+    PRIORITY_LOW,
+    PRIORITY_NORMAL,
+    AdmissionQueue,
+    BrownoutController,
+)
 from paddle_tpu.serving.batching import DynamicBatcher, ServingRequest
 from paddle_tpu.serving.bucketing import BucketPolicy
 from paddle_tpu.serving.client import Client
@@ -55,6 +62,11 @@ __all__ = [
     "DynamicBatcher",
     "ServingRequest",
     "BucketPolicy",
+    "AdmissionQueue",
+    "BrownoutController",
+    "PRIORITY_HIGH",
+    "PRIORITY_NORMAL",
+    "PRIORITY_LOW",
     "ServingMetrics",
     "ServingError",
     "ServerOverloaded",

@@ -1,0 +1,372 @@
+"""Overload control: deadline-aware admission, priority shedding, AIMD.
+
+PR 1's admission control was a fixed-capacity FIFO: a full queue shed
+the newest arrival, whatever its deadline or importance, and the
+capacity number was a static guess at what the chip could absorb.  This
+module replaces the queue's POLICY while the coalescing mechanics stay
+in ``batching.DynamicBatcher``:
+
+* **Earliest-deadline-first ordering** — the queue is a heap keyed by
+  deadline (no-deadline requests sort last, FIFO among themselves), so
+  the next batch always starts from the request closest to giving up,
+  and *expired* entries surface at the top where the sweep drops them
+  with a typed ``DeadlineExceeded`` instead of burning a batch slot.
+* **Priority classes** — every request carries a small-int priority
+  (``PRIORITY_HIGH=0`` < ``PRIORITY_NORMAL=1`` < ``PRIORITY_LOW=2``;
+  any int works, lower = more important).  A full queue sheds *the
+  lowest-priority, least-urgent queued entry* to admit a more important
+  arrival — under pressure low priority is shed first, never silently
+  reordered.
+* **An adaptive admit limit (AIMD)** — the effective queue bound floats
+  between ``min_limit`` and the configured capacity, multiplicatively
+  halved when the observed queue wait overshoots ``target_wait_ms`` and
+  additively grown (+1) while it stays under — so the backlog tracks
+  what the chip actually absorbs instead of a config constant.  Exposed
+  as the ``serving_admit_limit`` gauge.
+* **A computed retry hint** — every shed carries ``retry_after_ms``
+  (EWMA queue wait scaled by the overload ratio) on the
+  ``ServerOverloaded`` it raises; the wire layer forwards it as
+  response meta + an HTTP ``Retry-After`` header and the fleet
+  balancer's retry pacing honors it.
+
+``BrownoutController`` is the deterministic degradation ladder the
+server climbs under *sustained* saturation (ratio thresholds held for
+``hold_s``): L1 drops flight-recorder capture, L2 forces eager batching
+(batch window 0), L3 sheds the lowest priority class at admission.
+Descent is slower than ascent (hysteresis) so the ladder doesn't
+flap.  Exposed as the ``serving_brownout_level`` gauge.
+"""
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from paddle_tpu import monitor
+
+__all__ = [
+    "PRIORITY_HIGH", "PRIORITY_NORMAL", "PRIORITY_LOW",
+    "AdmissionQueue", "BrownoutController",
+]
+
+PRIORITY_HIGH = 0
+PRIORITY_NORMAL = 1
+PRIORITY_LOW = 2
+
+_NO_DEADLINE = float("inf")
+
+ADMIT_LIMIT = monitor.gauge(
+    "serving_admit_limit",
+    "current adaptive admission limit (AIMD on observed queue wait vs "
+    "the latency target)", ("server",))
+BROWNOUT_LEVEL = monitor.gauge(
+    "serving_brownout_level",
+    "degradation-ladder level under sustained saturation (0=normal, "
+    "1=no flight capture, 2=eager batching, 3=shed lowest priority)",
+    ("server",))
+ADMISSION_EXPIRED = monitor.counter(
+    "admission_expired_total",
+    "requests shed at admission because their deadline had already "
+    "passed (wire deadline propagation fail-fast)", ("server",))
+
+
+class _Entry:
+    """One queued request: EDF heap key, the admission priority, and a
+    tombstone flag (priority shedding removes entries lazily — the heap
+    is never re-built).  Request attributes are read ONCE at admission
+    (duck-typed stubs without priority/deadline still work)."""
+
+    __slots__ = ("key", "seq", "req", "priority", "alive")
+
+    def __init__(self, key: float, seq: int, req, priority: int):
+        self.key = key
+        self.seq = seq
+        self.req = req
+        self.priority = priority
+        self.alive = True
+
+    def __lt__(self, other: "_Entry") -> bool:
+        return (self.key, self.seq) < (other.key, other.seq)
+
+
+class AdmissionQueue:
+    """The bounded, deadline-ordered, priority-shedding request store
+    behind ``DynamicBatcher``.
+
+    Locking: ``cv`` is the queue's condition variable (the batcher's
+    wakeup channel — submitters notify, the single consumer waits).
+    ``*_locked`` methods require it held; ``offer`` takes it itself and
+    returns the requests it dropped so the CALLER fails them outside
+    the lock (no user callbacks run under ``cv``).
+    """
+
+    # AIMD cadence: adjust after this many pops or this much time,
+    # whichever comes first (per-pop adjustment would thrash the limit)
+    _ADJUST_EVERY = 16
+    _ADJUST_INTERVAL_S = 0.25
+    # EWMA smoothing for the observed queue wait
+    _EWMA_ALPHA = 0.2
+
+    def __init__(self, capacity: int, target_wait_ms: float = 50.0,
+                 min_limit: int = 4, name: str = "server",
+                 adaptive: bool = True):
+        # queue.Queue convention kept from the FIFO version: <= 0 means
+        # unbounded (no shedding, no adaptive limit)
+        self.capacity = int(capacity) if int(capacity) > 0 else None
+        self.target_wait_s = float(target_wait_ms) / 1e3
+        # the AIMD floor can never exceed the configured capacity (a
+        # decrease must not GROW the limit past the hard bound)
+        self.min_limit = max(1, int(min_limit))
+        if self.capacity is not None:
+            self.min_limit = min(self.min_limit, self.capacity)
+        self.adaptive = bool(adaptive) and self.capacity is not None
+        self.name = name
+        self.cv = threading.Condition()
+        self._heap: List[_Entry] = []
+        self._live = 0
+        self._seq = 0
+        self._limit = self.capacity if self.capacity is not None else 0
+        self._wait_ewma = 0.0   # seconds, EWMA of observed queue wait
+        self._pops_since_adjust = 0
+        self._last_adjust = time.monotonic()
+        self._gauge = ADMIT_LIMIT.labels(server=name)
+        if self.capacity is not None:
+            self._gauge.set(self._limit)
+
+    # ------------------------------------------------------------------
+    @property
+    def limit(self) -> int:
+        """Current effective admit limit (the AIMD output)."""
+        return self._limit if self.capacity is not None else 0
+
+    def qsize(self) -> int:
+        with self.cv:
+            return self._live
+
+    def depth_ratio(self) -> float:
+        """Queue pressure in [0, ~1]: live entries / admit limit (0 for
+        an unbounded queue — brownout needs a bound to define 'full')."""
+        with self.cv:
+            if self.capacity is None or self._limit <= 0:
+                return 0.0
+            return self._live / float(self._limit)
+
+    def retry_after_ms(self) -> float:
+        """The shed hint: how long a rejected caller should back off —
+        the EWMA queue wait scaled by the current overload ratio, never
+        under 1ms (a 0 hint would invite an immediate re-storm)."""
+        with self.cv:
+            return self._retry_after_locked()
+
+    def _retry_after_locked(self) -> float:
+        ratio = 1.0
+        if self.capacity is not None and self._limit > 0:
+            ratio = max(1.0, self._live / float(self._limit))
+        return max(1.0, self._wait_ewma * 1e3 * ratio)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _key(req) -> float:
+        deadline = getattr(req, "deadline", None)
+        return deadline if deadline is not None else _NO_DEADLINE
+
+    def offer(self, req) -> Tuple[bool, List, List, float]:
+        """Try to admit ``req``.  Returns ``(admitted, expired, shed,
+        retry_after_ms)``: ``expired`` are entries the sweep dropped
+        (deadline passed while queued), ``shed`` are lower-priority
+        entries evicted to make room.  The caller fails both lists
+        typed, outside the lock, and raises ``ServerOverloaded``
+        carrying ``retry_after_ms`` when not admitted."""
+        expired: List = []
+        shed: List = []
+        # hot-path: begin admission_offer (heap push + bounded sweep
+        # under the queue CV; no sleeps, no device syncs)
+        priority = int(getattr(req, "priority", PRIORITY_NORMAL))
+        with self.cv:
+            now = time.monotonic()
+            self._sweep_locked(now, expired)
+            admitted = True
+            if self.capacity is not None and self._live >= self._limit:
+                victim = self._pick_victim_locked(priority)
+                if victim is None:
+                    admitted = False
+                else:
+                    victim.alive = False
+                    self._live -= 1
+                    shed.append(victim.req)
+            retry_ms = self._retry_after_locked()
+            if admitted:
+                self._seq += 1
+                heapq.heappush(
+                    self._heap,
+                    _Entry(self._key(req), self._seq, req, priority))
+                self._live += 1
+                self.cv.notify()
+        # hot-path: end admission_offer
+        return admitted, expired, shed, retry_ms
+
+    def _sweep_locked(self, now: float, expired: List) -> None:
+        """Drop dead/expired entries off the heap top.  EDF makes this
+        complete: every expired entry keys earlier than every live one
+        (no-deadline entries key at +inf), so expired work can only sit
+        at the top — the sweep never has to scan the middle."""
+        heap = self._heap
+        while heap:
+            top = heap[0]
+            if not top.alive:
+                heapq.heappop(heap)
+                continue
+            if top.key is not _NO_DEADLINE and top.key <= now:
+                heapq.heappop(heap)
+                top.alive = False
+                self._live -= 1
+                expired.append(top.req)
+                continue
+            break
+
+    def _pick_victim_locked(self, priority: int) -> Optional[_Entry]:
+        """The entry priority shedding evicts for an arrival at
+        ``priority``: the strictly-lower-priority entry with the latest
+        deadline (least urgent loses).  None when every queued entry is
+        at least as important as the arrival — then the ARRIVAL sheds.
+        O(n) scan, but only ever on the shed path of a full queue."""
+        victim = None
+        for ent in self._heap:
+            if not ent.alive or ent.priority <= priority:
+                continue
+            if victim is None or (
+                    (ent.priority, ent.key, ent.seq)
+                    > (victim.priority, victim.key, victim.seq)):
+                victim = ent
+        return victim
+
+    # ------------------------------------------------------------------
+    def pop_locked(self, now: Optional[float] = None
+                   ) -> Tuple[Optional[object], List]:
+        """Pop the earliest-deadline live request (None when empty) and
+        the expired entries swept on the way.  Records the popped
+        request's queue wait into the AIMD controller.  Caller holds
+        ``cv`` and fails the expired list outside the lock."""
+        expired: List = []
+        now = time.monotonic() if now is None else now
+        # hot-path: begin admission_pop (heap pop + AIMD arithmetic
+        # under the queue CV; no sleeps, no device syncs)
+        self._sweep_locked(now, expired)
+        if not self._heap:
+            return None, expired
+        ent = heapq.heappop(self._heap)
+        ent.alive = False
+        self._live -= 1
+        submit_t = getattr(ent.req, "submit_t", None)
+        if submit_t is not None:
+            self._observe_locked(
+                max(0.0, time.perf_counter() - submit_t), now)
+        # hot-path: end admission_pop
+        return ent.req, expired
+
+    def _observe_locked(self, wait_s: float, now: float) -> None:
+        """One observed queue wait -> the AIMD controller.  Overshoot of
+        the target halves the admit limit (multiplicative decrease);
+        staying under grows it by 1 (additive increase)."""
+        self._wait_ewma += self._EWMA_ALPHA * (wait_s - self._wait_ewma)
+        if not self.adaptive:
+            return
+        self._pops_since_adjust += 1
+        if (self._pops_since_adjust < self._ADJUST_EVERY
+                and now - self._last_adjust < self._ADJUST_INTERVAL_S):
+            return
+        self._pops_since_adjust = 0
+        self._last_adjust = now
+        if self._wait_ewma > self.target_wait_s:
+            self._limit = max(self.min_limit, self._limit // 2)
+        elif self._limit < self.capacity:
+            self._limit += 1
+        self._gauge.set(self._limit)
+
+    # ------------------------------------------------------------------
+    def drain_locked(self) -> List:
+        """Pop and return every live queued request (shutdown).  Caller
+        holds ``cv``."""
+        out = [e.req for e in self._heap if e.alive]
+        self._heap = []
+        self._live = 0
+        return out
+
+    def close(self) -> None:
+        """Retire this queue's gauge series from the exposition."""
+        ADMIT_LIMIT.remove_labels(server=self.name)
+
+
+class BrownoutController:
+    """The deterministic degradation ladder.
+
+    ``update(ratio)`` is called by the server's dispatcher with the
+    current queue pressure (``AdmissionQueue.depth_ratio``); the level
+    climbs one rung at a time when the pressure has stayed at or above
+    that rung's threshold for ``hold_s`` (sustained saturation, not a
+    blip) and descends — one rung, slower (``4 * hold_s``) — when it
+    has stayed below.  Levels:
+
+      0  normal
+      1  drop flight-recorder capture (tracing rent off the hot path)
+      2  force the batch window to 0 (eager batching: ship what's here)
+      3  shed the lowest priority class at admission
+
+    Deterministic by construction: level changes are a pure function of
+    the (ratio, clock) series — chaos tests drive it with an injected
+    clock and assert exact transitions.
+    """
+
+    #: pressure at or above which each level (1, 2, 3) wants to engage
+    THRESHOLDS = (0.5, 0.75, 0.9)
+    MAX_LEVEL = 3
+
+    def __init__(self, name: str = "server", hold_s: float = 0.25,
+                 clock=time.monotonic):
+        self.name = name
+        self.hold_s = float(hold_s)
+        self._clock = clock
+        self.level = 0
+        self._pending: Optional[Tuple[int, float]] = None  # (direction, since)
+        # update() is called from the dispatcher loop AND the submit
+        # path (an L3 door-shed must still be able to descend when only
+        # low-priority traffic arrives — with nothing enqueued the
+        # dispatcher stays parked and would never sample again)
+        self._lock = threading.Lock()
+        self._gauge = BROWNOUT_LEVEL.labels(server=name)
+        self._gauge.set(0)
+
+    def _target(self, ratio: float) -> int:
+        lvl = 0
+        for i, thr in enumerate(self.THRESHOLDS):
+            if ratio >= thr:
+                lvl = i + 1
+        return lvl
+
+    def update(self, ratio: float, now: Optional[float] = None) -> int:
+        """Fold one pressure sample; returns the (possibly new) level.
+        Thread-safe: sampled by the dispatcher each turn and by the
+        submit path at the L3 door."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            target = self._target(ratio)
+            if target == self.level:
+                self._pending = None
+                return self.level
+            direction = 1 if target > self.level else -1
+            if self._pending is None or self._pending[0] != direction:
+                self._pending = (direction, now)
+                return self.level
+            hold = self.hold_s if direction > 0 else 4.0 * self.hold_s
+            if now - self._pending[1] >= hold:
+                self.level += direction
+                self._pending = None
+                self._gauge.set(self.level)
+                monitor.record_instant(
+                    "serving/brownout", cat="serving", server=self.name,
+                    level=self.level)
+            return self.level
+
+    def close(self) -> None:
+        BROWNOUT_LEVEL.remove_labels(server=self.name)

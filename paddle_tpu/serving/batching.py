@@ -7,19 +7,24 @@ first request, then keeps absorbing arrivals until either
 since the batch opened — whichever fires first.  A request that would
 overflow the open batch is carried into the next one (never split).
 
-Admission control lives at the queue: a full queue sheds the request
-with a typed ServerOverloaded at submit time, so overload back-pressure
-reaches the caller immediately instead of growing an unbounded backlog.
+Admission control lives at the queue, but is no longer a fixed FIFO
+(``serving.admission``): the store is deadline-ordered (EDF) with an
+expired-entry sweep, the bound adapts by AIMD on the observed queue
+wait, and a full queue sheds by PRIORITY — a low-priority queued entry
+is evicted for a more important arrival, and whoever is shed gets a
+typed ``ServerOverloaded`` carrying a computed ``retry_after_ms`` hint,
+so overload back-pressure reaches callers immediately with a usable
+pacing signal instead of growing an unbounded backlog.
 """
 from __future__ import annotations
 
 import threading
 import time
-from collections import deque
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from paddle_tpu.serving.admission import PRIORITY_NORMAL, AdmissionQueue
 from paddle_tpu.serving.errors import DeadlineExceeded, ServerOverloaded
 
 __all__ = ["ServingRequest", "DynamicBatcher"]
@@ -35,6 +40,10 @@ class ServingRequest:
     future the submitter waits on.  ``n_rows`` is the leading dim shared
     by every feed array (validated by the server at submit).
 
+    ``priority`` is the request's admission class (lower = more
+    important; ``serving.admission.PRIORITY_*``): under overload the
+    queue sheds strictly-lower-priority entries first.
+
     ``trace_id`` (optional) is the request's Dapper-style trace id:
     every span recorded while the batch containing this request executes
     carries it (``monitor.trace_context``), and the flight recorder keys
@@ -46,13 +55,16 @@ class ServingRequest:
     def __init__(self, feed: Dict[str, np.ndarray], n_rows: int,
                  deadline: Optional[float] = None,
                  trace_id: Optional[str] = None,
-                 parent_span: Optional[str] = None):
+                 parent_span: Optional[str] = None,
+                 priority: int = PRIORITY_NORMAL):
         self.feed = feed
         self.n_rows = n_rows
         self.deadline = deadline  # time.monotonic() deadline, or None
+        self.priority = int(priority)
         self.trace_id = trace_id
         self.parent_span = parent_span
         self.submit_t = time.perf_counter()
+        self.done_t: Optional[float] = None  # perf_counter at completion
         self._done = threading.Event()
         self._value: Optional[List[np.ndarray]] = None
         self._exc: Optional[BaseException] = None
@@ -62,12 +74,14 @@ class ServingRequest:
         if self._done.is_set():
             return  # first completion wins (shutdown races)
         self._value = value
+        self.done_t = time.perf_counter()
         self._done.set()
 
     def fail(self, exc: BaseException) -> None:
         if self._done.is_set():
             return  # first completion wins (shutdown races)
         self._exc = exc
+        self.done_t = time.perf_counter()
         self._done.set()
 
     def expired(self, now: Optional[float] = None) -> bool:
@@ -95,35 +109,65 @@ class ServingRequest:
 class DynamicBatcher:
     """Bounded request queue + the coalescing policy.
 
-    The queue is a deque under one condition variable: submitters
+    The store is an ``AdmissionQueue`` (EDF heap, priority shedding,
+    AIMD admit limit) under one condition variable: submitters
     ``notify`` on arrival and the (single) consuming worker WAITS on the
     condition while idle — an idle server sleeps at ~0% CPU instead of
-    polling (the pre-CV version woke 50x/s to re-check a stop flag).
-    ``wake()`` nudges a parked consumer at shutdown."""
+    polling.  ``wake()`` nudges a parked consumer at shutdown.
+
+    ``eager`` (set by the server's brownout ladder at level >= 2)
+    collapses the coalescing window to 0: whatever is queued ships
+    immediately — under saturation the window only adds latency, the
+    queue itself provides the batching.
+
+    ``on_shed(req, retry_after_ms)`` / ``on_expired(req)`` are the
+    server's hooks for requests the QUEUE drops (priority eviction /
+    offer-time sweep); the defaults fail the request typed so a
+    standalone batcher still honors the contract."""
 
     def __init__(self, max_batch_size: int, batch_timeout_ms: float,
-                 queue_capacity: int):
+                 queue_capacity: int, name: str = "server",
+                 target_wait_ms: float = 50.0, min_limit: int = 4,
+                 adaptive: bool = True):
         self.max_batch_size = int(max_batch_size)
         self.batch_timeout_s = float(batch_timeout_ms) / 1e3
-        # queue.Queue convention the pre-deque version had: <= 0 means
-        # unbounded, not "shed everything"
-        self._capacity = int(queue_capacity) if int(queue_capacity) > 0 else None
-        self._cv = threading.Condition()
-        self._dq: "deque[ServingRequest]" = deque()
+        self.queue = AdmissionQueue(
+            queue_capacity, target_wait_ms=target_wait_ms,
+            min_limit=min_limit, name=name, adaptive=adaptive)
+        self._cv = self.queue.cv  # one lock: queue state + wakeups
         self._carry: Optional[ServingRequest] = None  # worker-thread only
+        self.eager = False
+        self.on_shed = self._default_shed
+        self.on_expired = self._default_expired
+
+    @staticmethod
+    def _default_shed(req: ServingRequest, retry_after_ms: float) -> None:
+        req.fail(ServerOverloaded(
+            "evicted by a higher-priority request",
+            retry_after_ms=retry_after_ms))
+
+    @staticmethod
+    def _default_expired(req: ServingRequest) -> None:
+        req.fail(DeadlineExceeded("deadline passed while queued"))
 
     def qsize(self) -> int:
-        return len(self._dq) + (1 if self._carry is not None else 0)
+        return self.queue.qsize() + (1 if self._carry is not None else 0)
+
+    def depth_ratio(self) -> float:
+        """Queue pressure for the brownout controller."""
+        return self.queue.depth_ratio()
 
     # --- submitter side ---
     def offer(self, req: ServingRequest) -> None:
-        with self._cv:
-            if self._capacity is not None and len(self._dq) >= self._capacity:
-                raise ServerOverloaded(
-                    "request queue full (%d waiting); shedding"
-                    % len(self._dq)) from None
-            self._dq.append(req)
-            self._cv.notify()
+        admitted, expired, shed, retry_ms = self.queue.offer(req)
+        for r in expired:
+            self.on_expired(r)
+        for r in shed:
+            self.on_shed(r, retry_ms)
+        if not admitted:
+            raise ServerOverloaded(
+                "request queue at its admit limit (%d); shedding"
+                % self.queue.limit, retry_after_ms=retry_ms) from None
 
     def wake(self) -> None:
         """Wake a consumer parked on the empty-queue wait (shutdown)."""
@@ -135,9 +179,11 @@ class DynamicBatcher:
         without drain: the server fails them with ServerClosed).  Does
         not touch the carry slot — that one is the worker's."""
         with self._cv:
-            out = list(self._dq)
-            self._dq.clear()
-        return out
+            return self.queue.drain_locked()
+
+    def close(self) -> None:
+        """Retire the queue's gauge series (server stop)."""
+        self.queue.close()
 
     # --- worker side (single consumer) ---
     def _take_first(self, stop: threading.Event, on_expired,
@@ -148,18 +194,25 @@ class DynamicBatcher:
                 return first
             on_expired(first)
         while True:
+            expired: List[ServingRequest] = []
             with self._cv:
-                while not self._dq:
+                while True:
+                    req, ex = self.queue.pop_locked()
+                    expired.extend(ex)
+                    if req is not None or expired:
+                        break
                     if not block or stop.is_set():
-                        return None  # nothing ready / drained
+                        break
                     # sleeps until offer()/wake() notifies; the timeout
                     # is only a lost-notify safety net, not a poll
                     self._cv.wait(timeout=_IDLE_WAIT_S)
-                first = self._dq.popleft()
-            if first.expired():
-                on_expired(first)
-                continue
-            return first
+            for r in expired:
+                on_expired(r)
+            if req is not None:
+                return req
+            if expired:
+                continue  # swept some; go park again for live work
+            return None  # nothing ready / drained
 
     def next_batch(self, stop: threading.Event, on_expired,
                    block: bool = True) -> Optional[List[ServingRequest]]:
@@ -167,34 +220,46 @@ class DynamicBatcher:
         drained.  ``on_expired`` is called with each request whose
         deadline passed while queued (the server fails + counts it).
 
+        Requests coalesce in DEADLINE order (the queue is EDF), so the
+        batch always starts from the request closest to giving up.
+
         ``block=False``: a non-blocking poll — returns None immediately
         when no live request is ready.
 
-        While draining (``stop`` set) the window is not awaited — only
-        already-queued requests coalesce, so shutdown latency is bounded
-        by the in-flight work, not by the timeout."""
+        While draining (``stop`` set) or in ``eager`` brownout mode the
+        window is not awaited — only already-queued requests coalesce,
+        so shutdown latency is bounded by the in-flight work and a
+        saturated server ships what it has."""
         first = self._take_first(stop, on_expired, block=block)
         if first is None:
             return None
         batch = [first]
         rows = first.n_rows
-        window_end = time.monotonic() + self.batch_timeout_s
+        window = 0.0 if self.eager else self.batch_timeout_s
+        window_end = time.monotonic() + window
         while rows < self.max_batch_size:
+            expired: List[ServingRequest] = []
             with self._cv:
-                if not self._dq:
+                req, ex = self.queue.pop_locked()
+                expired.extend(ex)
+                if req is None and not expired:
                     wait = window_end - time.monotonic()
                     if wait <= 0 or stop.is_set():
                         break
                     self._cv.wait(timeout=wait)
-                    if not self._dq:
-                        continue  # window re-checked at loop top
-                req = self._dq.popleft()
-            if req.expired():
-                on_expired(req)
-                continue
+                    req, ex = self.queue.pop_locked()
+                    expired.extend(ex)
+            for r in expired:
+                on_expired(r)
+            if req is None:
+                if window_end - time.monotonic() <= 0 or stop.is_set():
+                    break
+                continue  # window re-checked at loop top
             if rows + req.n_rows > self.max_batch_size:
                 self._carry = req  # never split a request across batches
                 break
             batch.append(req)
             rows += req.n_rows
         return batch
+    # hot-path note: the coalescing loop above waits only on the queue
+    # CV bounded by the batch window — no device syncs, no sleeps

@@ -24,6 +24,7 @@ import numpy as np
 from paddle_tpu import monitor
 from paddle_tpu.monitor import flight as _flight
 from paddle_tpu.monitor import spans as _spans
+from paddle_tpu.serving.admission import PRIORITY_NORMAL
 from paddle_tpu.serving.errors import DeadlineExceeded
 
 __all__ = ["Client"]
@@ -35,18 +36,22 @@ class Client:
         self._fetch_names = list(server._predictor.get_output_names())
 
     def infer(self, feed, timeout_ms: Optional[float] = None,
-              trace_id: Optional[str] = None) -> List[np.ndarray]:
+              trace_id: Optional[str] = None,
+              priority: int = PRIORITY_NORMAL) -> List[np.ndarray]:
         """Submit one request and block for its outputs (list ordered
-        like the predictor's fetch list).  ``trace_id`` joins the call
-        to an existing trace; by default a fresh id is minted — read it
-        back via ``last_trace_id``."""
+        like the predictor's fetch list).  ``priority`` is the admission
+        class (``serving.admission.PRIORITY_*``, lower = more
+        important): under overload the server sheds low priority first.
+        ``trace_id`` joins the call to an existing trace; by default a
+        fresh id is minted — read it back via ``last_trace_id``."""
         tid = trace_id or monitor.new_trace_id()
         self.last_trace_id = tid
         fr = _flight.get()
         rec = _spans.recording() or fr is not None
         if not rec:
             return self._server.submit(
-                feed, timeout_ms=timeout_ms, trace_id=tid).result()
+                feed, timeout_ms=timeout_ms, trace_id=tid,
+                priority=priority).result()
         t0 = time.perf_counter()
         err: Optional[BaseException] = None
         sid = _spans.new_span_id()
@@ -55,7 +60,7 @@ class Client:
                 with _spans.parent_scope(sid):
                     return self._server.submit(
                         feed, timeout_ms=timeout_ms, trace_id=tid,
-                        parent_span=sid).result()
+                        parent_span=sid, priority=priority).result()
         except BaseException as e:  # noqa: BLE001 — observed, re-raised
             err = e
             raise
@@ -96,18 +101,22 @@ class Client:
             [span])
 
     def infer_named(self, feed, timeout_ms: Optional[float] = None,
-                    trace_id: Optional[str] = None) -> Dict[str, np.ndarray]:
+                    trace_id: Optional[str] = None,
+                    priority: int = PRIORITY_NORMAL) -> Dict[str, np.ndarray]:
         """infer(), but keyed by the endpoint's output names."""
         return dict(zip(self._fetch_names,
-                        self.infer(feed, timeout_ms, trace_id=trace_id)))
+                        self.infer(feed, timeout_ms, trace_id=trace_id,
+                                   priority=priority)))
 
-    def infer_many(self, feeds, timeout_ms: Optional[float] = None) -> List[List[np.ndarray]]:
+    def infer_many(self, feeds, timeout_ms: Optional[float] = None,
+                   priority: int = PRIORITY_NORMAL) -> List[List[np.ndarray]]:
         """Submit every feed first (so they can coalesce into shared
         batches), then gather all results in order.  Each request gets
         its own trace id (``last_trace_ids`` after the call)."""
         tids = [monitor.new_trace_id() for _ in feeds]
         futures = [
-            self._server.submit(f, timeout_ms=timeout_ms, trace_id=t)
+            self._server.submit(f, timeout_ms=timeout_ms, trace_id=t,
+                                priority=priority)
             for f, t in zip(feeds, tids)
         ]
         self.last_trace_ids = tids

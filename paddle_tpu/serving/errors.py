@@ -25,7 +25,22 @@ class ServingError(RuntimeError):
 
 class ServerOverloaded(ServingError):
     """Admission control shed this request: the bounded request queue is
-    full.  The request was NOT enqueued; back off and retry."""
+    at its (adaptive) limit and no lower-priority entry could be evicted
+    to make room, or the brownout ladder is shedding this priority
+    class.  The request was NOT enqueued (or was evicted before any
+    work ran); back off and retry.
+
+    ``retry_after_ms`` is the server's computed backoff hint (EWMA queue
+    wait scaled by the overload ratio).  It rides the wire as response
+    meta (and an HTTP ``Retry-After`` header), and the fleet balancer's
+    retry pacing honors it — a shedding backend is not re-dispatched to
+    before the hint elapses."""
+
+    def __init__(self, message: str = "server overloaded",
+                 retry_after_ms: "float | None" = None):
+        super().__init__(message)
+        self.retry_after_ms = (
+            float(retry_after_ms) if retry_after_ms is not None else None)
 
 
 class DeadlineExceeded(ServingError, TimeoutError):

@@ -56,11 +56,18 @@ from paddle_tpu import monitor, profiler
 from paddle_tpu.faults.metrics import BACKEND_HALFOPEN_PROBES
 from paddle_tpu.monitor import flight as _flight
 from paddle_tpu.monitor import spans as _mon_spans
+from paddle_tpu.serving.admission import (
+    ADMISSION_EXPIRED,
+    PRIORITY_LOW,
+    PRIORITY_NORMAL,
+    BrownoutController,
+)
 from paddle_tpu.serving.batching import DynamicBatcher, ServingRequest
 from paddle_tpu.serving.bucketing import BucketPolicy
 from paddle_tpu.serving.errors import (
     DeadlineExceeded,
     ServerClosed,
+    ServerOverloaded,
     ServingError,
 )
 from paddle_tpu.serving.metrics import ServingMetrics
@@ -140,6 +147,8 @@ class InferenceServer:
         input_specs: Optional[Dict[str, Tuple[tuple, Any]]] = None,
         name: str = "server",
         readmit_cooldown_s: Optional[float] = None,
+        target_queue_wait_ms: float = 50.0,
+        brownout_hold_s: float = 0.25,
     ):
         self.name = name
         # circuit-breaker re-admission for failure-retired replicas: a
@@ -161,8 +170,18 @@ class InferenceServer:
         self._nonblocking = self._replicas[0].nonblocking
         self._policy = BucketPolicy(max_batch_size, bucket_ladder)
         self._batcher = DynamicBatcher(
-            max_batch_size, batch_timeout_ms, queue_capacity)
+            max_batch_size, batch_timeout_ms, queue_capacity, name=name,
+            target_wait_ms=target_queue_wait_ms)
         self._metrics = ServingMetrics(name)
+        # queue-level drops (priority eviction / offer-time sweep) route
+        # through the server's accounting, not the batcher's defaults
+        self._batcher.on_shed = self._on_queue_shed
+        self._batcher.on_expired = self._on_expired
+        # deterministic degradation ladder, driven by queue pressure
+        # from the dispatcher loop (L1 drops flight capture, L2 forces
+        # eager batching, L3 sheds the lowest priority class)
+        self._brownout = BrownoutController(name, hold_s=brownout_hold_s)
+        self._admission_expired = ADMISSION_EXPIRED.labels(server=name)
         self._specs = (
             dict(input_specs) if input_specs else predictors[0].input_specs())
         self._feed_names = list(predictors[0].get_input_names())
@@ -215,10 +234,24 @@ class InferenceServer:
     def metrics(self) -> Dict[str, object]:
         snap = self._metrics.snapshot()
         snap["queue_depth"] = self._batcher.qsize()
+        snap["admit_limit"] = self._batcher.queue.limit
+        snap["brownout_level"] = self._brownout.level
         snap["bucket_ladder"] = self.bucket_ladder
         snap["warmed_up"] = self._warmed
         snap["replicas"] = self.replica_stats()
         return snap
+
+    def load(self) -> Dict[str, object]:
+        """The overload-control load report: queue depth, the adaptive
+        admit limit, and the brownout level.  Rides in every wire
+        response meta so the fleet balancer folds REPORTED load (the
+        server's actual backlog) into least-loaded routing, not just its
+        own in-flight counts."""
+        return {
+            "queue_depth": self._batcher.qsize(),
+            "admit_limit": self._batcher.queue.limit,
+            "brownout_level": self._brownout.level,
+        }
 
     def metrics_text(self) -> str:
         """Prometheus text exposition of the WHOLE process registry
@@ -359,28 +392,59 @@ class InferenceServer:
     # ------------------------------------------------------------------
     def submit(self, feed, timeout_ms: Optional[float] = None,
                trace_id: Optional[str] = None,
-               parent_span: Optional[str] = None) -> ServingRequest:
+               parent_span: Optional[str] = None,
+               priority: int = PRIORITY_NORMAL) -> ServingRequest:
         """Enqueue one request; returns its future (ServingRequest).
 
         ``feed``: dict (or positional sequence) of arrays whose shared
         leading dim is the request's row count (1..max_batch_size).
-        ``trace_id`` joins the request to a caller-owned trace (the
-        Client mints one per call); spans recorded while its batch
-        executes carry it.  ``parent_span`` is the submitter-side span
-        id this request's spans parent under (client infer span, or the
-        wire server's request span on a transport hop).  Raises
-        ServerOverloaded when the queue is full, ServerClosed after
-        stop(); the future raises DeadlineExceeded when ``timeout_ms``
-        elapses first.
+        ``priority`` is the admission class (lower = more important,
+        ``serving.admission.PRIORITY_*``): a full queue sheds
+        strictly-lower-priority entries first, and brownout level 3
+        sheds the lowest class outright.  ``trace_id`` joins the request
+        to a caller-owned trace (the Client mints one per call); spans
+        recorded while its batch executes carry it.  ``parent_span`` is
+        the submitter-side span id this request's spans parent under
+        (client infer span, or the wire server's request span on a
+        transport hop).  Raises ServerOverloaded (with a computed
+        ``retry_after_ms`` hint) when shed, ServerClosed after stop();
+        a ``timeout_ms`` that is already <= 0 — expired work arriving
+        over the wire — fails fast typed at admission
+        (``admission_expired_total``) instead of dispatching stale work.
         """
         if self._closed:
             raise ServerClosed("server %r is stopped" % self.name)
+        if timeout_ms is not None and float(timeout_ms) <= 0:
+            # deadline propagation fail-fast: the remaining deadline the
+            # wire hop carried is already gone — shed at admission, never
+            # burn a batch slot dispatching work nobody is waiting for
+            self._admission_expired.inc()
+            self._metrics.count("expired")
+            raise DeadlineExceeded(
+                "deadline exhausted before admission (%.1f ms)"
+                % float(timeout_ms))
+        if _faults.active is not None:  # disarmed: one is-None gate
+            _faults.active.faultpoint(
+                "server.admit", server=self.name, priority=int(priority))
+        # sample the ladder HERE too: at L3 the door sheds low priority
+        # before anything enqueues, so low-priority-only traffic would
+        # otherwise never wake the parked dispatcher and the level
+        # could latch at 3 on an idle server forever
+        self._brownout.update(self._batcher.depth_ratio())
+        if (self._brownout.level >= 3
+                and int(priority) >= PRIORITY_LOW):
+            # brownout L3: the lowest priority class sheds at the door
+            self._metrics.count("shed")
+            raise ServerOverloaded(
+                "brownout level %d sheds priority %d"
+                % (self._brownout.level, int(priority)),
+                retry_after_ms=self._batcher.queue.retry_after_ms())
         feed, n_rows = self._normalize_feed(feed)
         deadline = (
             time.monotonic() + float(timeout_ms) / 1e3
             if timeout_ms is not None else None)
         req = ServingRequest(feed, n_rows, deadline, trace_id=trace_id,
-                             parent_span=parent_span)
+                             parent_span=parent_span, priority=priority)
         try:
             self._batcher.offer(req)
         except Exception:
@@ -439,6 +503,15 @@ class InferenceServer:
         for req in self._batcher.drain_pending():
             req.fail(ServerClosed("server %r stopped" % self.name))
 
+    def _on_queue_shed(self, req: ServingRequest,
+                       retry_after_ms: float) -> None:
+        """A queued request evicted by priority shedding: counted as a
+        shed (it never ran) and failed typed with the retry hint."""
+        self._metrics.count("shed")
+        req.fail(ServerOverloaded(
+            "evicted by a higher-priority request",
+            retry_after_ms=retry_after_ms))
+
     def _on_expired(self, req: ServingRequest) -> None:
         self._metrics.count("expired")
         fr = _flight.get()
@@ -459,6 +532,12 @@ class InferenceServer:
         _mon_spans.set_thread_lane("serving/%s/dispatcher" % self.name)
         try:
             while True:
+                # one pressure sample per dispatch turn drives the
+                # brownout ladder; eager batching (L2+) collapses the
+                # coalescing window so a saturated server ships what it
+                # has instead of waiting for more
+                level = self._brownout.update(self._batcher.depth_ratio())
+                self._batcher.eager = level >= 2
                 batch = self._batcher.next_batch(
                     self._stop, self._on_expired, block=True)
                 if batch is None:
@@ -736,7 +815,9 @@ class InferenceServer:
         pending tuple into _finalize; otherwise the only rent is two
         gate checks."""
         valid = sum(r.n_rows for r in batch)
-        fr = _flight.get()
+        # brownout L1+: flight-recorder capture is the first rent shed
+        # under sustained saturation (tracing is a luxury; goodput isn't)
+        fr = _flight.get() if self._brownout.level < 1 else None
         cap = [] if fr is not None else None
         tids = ()
         if cap is not None or _mon_spans.recording():
@@ -944,6 +1025,9 @@ class InferenceServer:
         # retire this instance's series from the registry exposition;
         # metrics()/statusz() keep working off the detached children
         self._metrics.close()
+        self._batcher.close()
+        self._brownout.close()
+        ADMISSION_EXPIRED.remove_labels(server=self.name)
 
     def __enter__(self):
         return self

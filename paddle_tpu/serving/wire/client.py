@@ -23,7 +23,8 @@ from paddle_tpu import monitor
 from paddle_tpu.monitor import flight as _flight
 from paddle_tpu.monitor import spans as _spans
 from paddle_tpu.serving import errors as _errors
-from paddle_tpu.serving.errors import ServingError
+from paddle_tpu.serving.admission import PRIORITY_NORMAL
+from paddle_tpu.serving.errors import DeadlineExceeded, ServingError
 from paddle_tpu.serving.wire.codec import format_traceparent
 from paddle_tpu.serving.wire.http import HttpTransport, Transport
 
@@ -72,28 +73,47 @@ def flight_report(fr, tid: str, sid: str, t0: float, dur: float,
 
 def raise_in_band_error(meta: Dict[str, object]) -> None:
     """Re-raise the typed serving error a response meta carries (no-op
-    for a success meta)."""
+    for a success meta).  A ``retry_after_ms`` hint in the meta (the
+    server's computed overload backoff) is re-attached to the raised
+    error so the fleet's retry pacing can honor it."""
     name = meta.get("error")
     if not name:
         return
     etype = _ERROR_TYPES.get(str(name), ServingError)
-    raise etype(str(meta.get("message") or name))
+    err = etype(str(meta.get("message") or name))
+    retry_ms = meta.get("retry_after_ms")
+    if retry_ms is not None:
+        try:
+            err.retry_after_ms = float(retry_ms)
+        except (TypeError, ValueError):
+            pass  # a malformed hint never masks the typed error
+    load = meta.get("load")
+    if isinstance(load, dict):
+        # a typed error still carries the server's load report: the
+        # balancer's least-loaded routing learns from sheds too
+        err.load = load
+    raise err
 
 
 def wire_call(transport: Transport, feed_names: Sequence[str],
               arrays: Sequence[np.ndarray], timeout_ms: Optional[float],
               tid: str, extra_meta: Optional[Dict[str, object]] = None,
+              priority: Optional[int] = None,
               ) -> Tuple[Dict[str, object], List[np.ndarray]]:
     """One traced ``/infer`` exchange (shared by ``RemoteClient`` and
     the fleet balancer): records the client-side ``wire/request`` span,
     sends its id as the ``traceparent`` parent so the server's request
     span is its child, and asks for the server-side span tree whenever a
-    local sink could use it."""
+    local sink could use it.  ``timeout_ms`` is the REMAINING deadline
+    at send time (the server sheds <= 0 at admission); ``priority`` is
+    the admission class carried in the request meta."""
     fr = _flight.get()
     rec = _spans.recording() or fr is not None
     meta: Dict[str, object] = {"feed_names": list(feed_names)}
     if timeout_ms is not None:
         meta["timeout_ms"] = float(timeout_ms)
+    if priority is not None:
+        meta["priority"] = int(priority)
     if extra_meta:
         meta.update(extra_meta)
     # hot-path: begin wire_dispatch (trace gates + the transport POST;
@@ -197,19 +217,36 @@ class RemoteClient:
 
     # ------------------------------------------------------------------
     def infer(self, feed, timeout_ms: Optional[float] = None,
-              trace_id: Optional[str] = None) -> List[np.ndarray]:
+              trace_id: Optional[str] = None,
+              priority: int = PRIORITY_NORMAL) -> List[np.ndarray]:
         """Submit one request over the wire and block for its outputs
         (ordered like the endpoint's fetch list).  Same deadline /
         overload / closed error types as the in-process client, plus
-        ``BackendUnavailable`` when the remote process is gone."""
+        ``BackendUnavailable`` when the remote process is gone.
+
+        ``priority`` (``serving.admission.PRIORITY_*``, lower = more
+        important) rides the request meta into the server's priority
+        shedding.  The deadline is anchored at THIS call's entry: what
+        goes over the wire is the remaining budget at send time, so
+        work done inside the call (endpoint-shape discovery on first
+        use, feed normalization) debits the caller's clock and the
+        server sheds already-expired work at admission instead of
+        dispatching it.  (``infer_many`` pool waits happen before the
+        per-request ``infer`` starts, so each request's budget starts
+        when its worker picks it up.)"""
         tid = trace_id or monitor.new_trace_id()
         self.last_trace_id = tid
+        deadline = (
+            time.monotonic() + float(timeout_ms) / 1e3
+            if timeout_ms is not None else None)
         names, arrays = self._normalize(feed)
+        remaining_ms = self._remaining_ms(deadline)
         fr = _flight.get()
         rec = _spans.recording() or fr is not None
         if not rec:
             _, routs = wire_call(
-                self._transport, names, arrays, timeout_ms, tid)
+                self._transport, names, arrays, remaining_ms, tid,
+                priority=priority)
             return routs
         t0 = time.perf_counter()
         err: Optional[BaseException] = None
@@ -223,7 +260,8 @@ class RemoteClient:
                 with _spans.parent_scope(sid):
                     with _spans.capture(cap):
                         rmeta, routs = wire_call(
-                            self._transport, names, arrays, timeout_ms, tid)
+                            self._transport, names, arrays, remaining_ms,
+                            tid, priority=priority)
             extra_spans = list(rmeta.get("spans") or ())
             return routs
         except BaseException as e:  # noqa: BLE001 — observed, re-raised
@@ -239,14 +277,29 @@ class RemoteClient:
                 flight_report(fr, tid, sid, t0, dur, err,
                               cap + extra_spans)
 
+    @staticmethod
+    def _remaining_ms(deadline: Optional[float]) -> Optional[float]:
+        """Remaining budget at send time.  Already-expired fails fast
+        HERE, typed — never burns a wire exchange on dead work."""
+        if deadline is None:
+            return None
+        remaining = (deadline - time.monotonic()) * 1e3
+        if remaining <= 0:
+            raise DeadlineExceeded(
+                "deadline exhausted before the wire send")
+        return remaining
+
     def infer_named(self, feed, timeout_ms: Optional[float] = None,
-                    trace_id: Optional[str] = None) -> Dict[str, np.ndarray]:
+                    trace_id: Optional[str] = None,
+                    priority: int = PRIORITY_NORMAL) -> Dict[str, np.ndarray]:
         """``infer()``, but keyed by the endpoint's output names."""
         _, fetch_names = self._endpoint_shape()
         return dict(zip(fetch_names,
-                        self.infer(feed, timeout_ms, trace_id=trace_id)))
+                        self.infer(feed, timeout_ms, trace_id=trace_id,
+                                   priority=priority)))
 
-    def infer_many(self, feeds, timeout_ms: Optional[float] = None
+    def infer_many(self, feeds, timeout_ms: Optional[float] = None,
+                   priority: int = PRIORITY_NORMAL
                    ) -> List[List[np.ndarray]]:
         """Issue every request concurrently (so the remote batcher can
         coalesce them into shared batches) and gather results in order.
@@ -255,7 +308,7 @@ class RemoteClient:
         self.last_trace_ids = tids
         futures = [
             self._executor().submit(
-                self.infer, f, timeout_ms, trace_id=t)
+                self.infer, f, timeout_ms, trace_id=t, priority=priority)
             for f, t in zip(feeds, tids)
         ]
         return [f.result() for f in futures]

@@ -10,6 +10,18 @@ side of a wire transport, failure detection is typed transport errors
 surviving backend (idempotent by construction: a request whose response
 never arrived was never delivered).
 
+Overload control closes the loop fleet-wide: every response meta
+carries the server's load report (queue depth, adaptive admit limit,
+brownout level), and routing ranks backends by ``in_flight + reported
+backlog`` instead of in-flight counts alone — a server drowning in its
+own queue stops attracting traffic before it ever fails a health check.
+A ``ServerOverloaded`` answer pauses that backend until its
+``retry_after_ms`` hint elapses, and EVERY re-dispatch (requeue or
+overload retry) spends a token from a token-bucket throttle
+(``retry_throttled_total``): under saturation the fleet propagates
+back-pressure to callers instead of amplifying its own retries into a
+metastable collapse.
+
 Client surface: the same ``infer`` / ``infer_named`` / ``infer_many``
 (+ ``infer_stream`` seam) contract as ``Client``/``RemoteClient``, so
 the balancer drops in wherever a single endpoint handle did.  Fleet
@@ -54,6 +66,7 @@ from paddle_tpu.serving.wire.client import flight_report as _flight_report
 from paddle_tpu.serving.wire.client import wire_call
 from paddle_tpu.serving.wire.http import HttpTransport
 from paddle_tpu.serving.wire.metrics import (
+    RETRY_THROTTLED,
     WIRE_BACKEND_RETIRED,
     WIRE_HEALTH_CHECK_FAILURES,
     WIRE_HEALTH_CHECKS,
@@ -76,6 +89,39 @@ _ROUTE_WAIT_S = 0.5
 # cannot double-apply anything.
 _RETRYABLE = (BackendUnavailable, _errors.ServerClosed, WireProtocolError)
 
+# a backend's reported load (queue depth + admit limit in every response
+# meta) participates in routing only while this fresh; after that the
+# balancer falls back to its own in-flight counts (a stale report from
+# a quiet backend must not repel traffic forever)
+_LOAD_FRESH_S = 5.0
+
+
+class _RetryThrottle:
+    """Token-bucket pacing for fleet re-dispatch: tokens accrue at
+    ``rate_per_s`` up to ``burst``; every requeue/retry spends one.  A
+    dry bucket means the fleet's own retries have become the load — the
+    typed error propagates to the caller (who holds the retry hint)
+    instead of re-storming a saturated backend into metastable
+    collapse."""
+
+    def __init__(self, rate_per_s: float = 100.0, burst: int = 32):
+        self.rate = float(rate_per_s)
+        self.burst = float(burst)
+        self._tokens = float(burst)
+        self._stamp = time.monotonic()
+        self._lock = threading.Lock()
+
+    def try_acquire(self) -> bool:
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._stamp) * self.rate)
+            self._stamp = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
 
 def _probe_jitter(interval_s: float, rng: random.Random) -> float:
     """Per-backend probe spacing: the interval +-15%.  N backends probed
@@ -91,7 +137,9 @@ class _Backend:
     __slots__ = ("idx", "name", "transport", "handle", "alive", "in_flight",
                  "executed", "failed", "consec_failures",
                  "consec_health_failures", "retired_at", "removed",
-                 "give_up", "next_probe_at")
+                 "give_up", "next_probe_at", "reported_depth",
+                 "reported_limit", "reported_brownout", "load_ts",
+                 "not_before")
 
     def __init__(self, idx: int, name: str, transport: HttpTransport,
                  handle: Optional[_launch.ServerHandle] = None):
@@ -109,6 +157,17 @@ class _Backend:
         self.removed = False      # deliberate removal: never re-admit
         self.give_up = False      # supervisor exhausted its relaunches
         self.next_probe_at = 0.0  # per-backend jittered probe clock
+        # the server's self-reported load (response meta "load"): queue
+        # depth + adaptive admit limit + brownout level, folded into
+        # least-loaded routing while fresh (guarded by _route_cv)
+        self.reported_depth = 0
+        self.reported_limit = 0
+        self.reported_brownout = 0
+        self.load_ts = None  # monotonic stamp of the last report
+        # retry-after pacing: routing skips this backend until the stamp
+        # (set from ServerOverloaded.retry_after_ms — a shedding backend
+        # must not be re-dispatched to before its own hint elapses)
+        self.not_before = 0.0
 
 
 class FleetBalancer:
@@ -128,7 +187,9 @@ class FleetBalancer:
                  health_interval_s: Optional[float] = 1.0,
                  cooldown_s: float = 5.0,
                  supervisor: Optional[_launch.Supervisor] = None,
-                 retry_policy: Optional[RetryPolicy] = None):
+                 retry_policy: Optional[RetryPolicy] = None,
+                 retry_rate_per_s: float = 100.0,
+                 retry_burst: int = 32):
         if not backends:
             raise ValueError("FleetBalancer needs at least one backend")
         self.name = name
@@ -143,6 +204,12 @@ class FleetBalancer:
         self._retry_policy = retry_policy or RetryPolicy(
             max_attempts=max(2, len(self._backends) + 1),
             base_delay_s=0.005, multiplier=2.0, max_delay_s=0.1)
+        # token-bucket pacing for EVERY re-dispatch (requeue after a
+        # transport failure, paced retry after an overload shed): a dry
+        # bucket fails the request typed instead of letting the fleet's
+        # own retries amplify saturation into metastable collapse
+        self._throttle = _RetryThrottle(retry_rate_per_s, retry_burst)
+        self._throttled_counter = RETRY_THROTTLED.labels(fleet=name)
         # circuit-breaker re-admission: a failure-retired backend goes
         # half-open after cooldown_s and takes one probe; a backend
         # whose PROCESS died is revived through the supervisor (capped
@@ -213,12 +280,19 @@ class FleetBalancer:
 
     def backend_stats(self) -> Dict[str, Dict[str, object]]:
         with self._route_cv:
+            now = time.monotonic()
             return {
                 b.name: {
                     "alive": b.alive,
                     "in_flight": b.in_flight,
                     "executed": b.executed,
                     "failed": b.failed,
+                    "reported_depth": b.reported_depth,
+                    "reported_limit": b.reported_limit,
+                    "brownout_level": b.reported_brownout,
+                    "load_fresh": (b.load_ts is not None
+                                   and now - b.load_ts <= _LOAD_FRESH_S),
+                    "paused_ms": max(0.0, (b.not_before - now) * 1e3),
                 }
                 for b in self._backends
             }
@@ -270,13 +344,43 @@ class FleetBalancer:
     # routing: least-loaded live backend, bounded in-flight, requeue on
     # transport failure — the replica state machine across processes
     # ------------------------------------------------------------------
-    def _pick(self, exclude: Optional[_Backend]) -> Optional[_Backend]:
+    def _load_score(self, be: _Backend, now: float) -> float:
+        """Routing weight: this balancer's own in-flight count plus the
+        backend's self-reported backlog (queue depth, while the report
+        is fresh) plus its brownout level — a server drowning in its own
+        queue stops attracting traffic even when its in-flight count
+        looks fine from the outside, and a degraded (browned-out)
+        backend ranks behind a healthy equal."""
+        score = float(be.in_flight)
+        if be.load_ts is not None and now - be.load_ts <= _LOAD_FRESH_S:
+            score += float(be.reported_depth) + float(be.reported_brownout)
+        return score
+
+    def _pick(self, exclude: Optional[_Backend],
+              now: Optional[float] = None) -> Optional[_Backend]:
+        now = time.monotonic() if now is None else now
         live = [b for b in self._backends
                 if b.alive and b is not exclude
-                and b.in_flight < self._max_in_flight]
+                and b.in_flight < self._max_in_flight
+                and b.not_before <= now]
         if not live:
             return None
-        return min(live, key=lambda b: b.in_flight)
+        return min(live, key=lambda b: self._load_score(b, now))
+
+    def _update_load(self, be: _Backend, load) -> None:
+        """Fold one response's load report (success meta ``load``, or
+        the same dict re-attached to a typed in-band error) into the
+        routing state."""
+        if not isinstance(load, dict):
+            return
+        with self._route_cv:
+            try:
+                be.reported_depth = int(load.get("queue_depth") or 0)
+                be.reported_limit = int(load.get("admit_limit") or 0)
+                be.reported_brownout = int(load.get("brownout_level") or 0)
+            except (TypeError, ValueError):
+                return  # a malformed report never breaks routing
+            be.load_ts = time.monotonic()
 
     def _acquire(self, exclude: Optional[_Backend],
                  deadline: Optional[float]) -> _Backend:
@@ -285,17 +389,18 @@ class FleetBalancer:
                 if self._closed:
                     raise _errors.ServerClosed(
                         "fleet %r is stopped" % self.name)
-                if deadline is not None and time.monotonic() >= deadline:
+                now = time.monotonic()
+                if deadline is not None and now >= deadline:
                     # expired BEFORE taking a slot: fail fast typed —
                     # never burn a backend's in-flight capacity on a
                     # request whose caller already gave up
                     self._metrics.count("expired")
                     raise DeadlineExceeded(
                         "deadline passed before acquiring a backend")
-                be = self._pick(exclude)
+                be = self._pick(exclude, now)
                 if be is None and exclude is not None and not any(
                         b.alive and b is not exclude for b in self._backends):
-                    be = self._pick(None)  # only the excluded one left: reuse
+                    be = self._pick(None, now)  # only the excluded one: reuse
                 if be is not None:
                     be.in_flight += 1
                     return be
@@ -303,6 +408,13 @@ class FleetBalancer:
                     raise ServingError(
                         "no live backends in fleet %r" % self.name)
                 wait = _ROUTE_WAIT_S
+                # a retry-after pause expires on a clock, not a notify:
+                # wake exactly when the earliest paused backend frees up
+                nxt = min((b.not_before for b in self._backends
+                           if b.alive and b.not_before > now),
+                          default=None)
+                if nxt is not None:
+                    wait = min(wait, max(0.001, nxt - now))
                 if deadline is not None:
                     wait = min(wait, deadline - time.monotonic())
                     if wait <= 0:
@@ -345,14 +457,22 @@ class FleetBalancer:
 
     # ------------------------------------------------------------------
     def infer(self, feed, timeout_ms: Optional[float] = None,
-              trace_id: Optional[str] = None) -> List[np.ndarray]:
+              trace_id: Optional[str] = None,
+              priority: Optional[int] = None) -> List[np.ndarray]:
         """One request through the fleet.  A backend that dies
         mid-exchange (``BackendUnavailable``) or answers that it is
         shutting down (``ServerClosed``) retires after repeated failures
         and the request REQUEUES to a survivor — an accepted request
         completes or fails typed, never silently drops.  Deadline /
-        overload / validation answers are NOT retried: they are
-        end-state answers from a live backend, not lost work."""
+        validation answers are NOT retried: they are end-state answers
+        from a live backend, not lost work.  An overload shed is
+        retried — PACED: the shedding backend is skipped until its
+        ``retry_after_ms`` hint elapses and every re-dispatch spends a
+        token from the fleet's retry throttle
+        (``retry_throttled_total`` counts denials), so saturation
+        propagates back-pressure instead of a retry storm.
+        ``priority`` (``serving.admission.PRIORITY_*``) rides the wire
+        meta into the backend's priority shedding."""
         tid = trace_id or monitor.new_trace_id()
         self.last_trace_id = tid
         names, arrays = self._normalize(feed)
@@ -363,7 +483,8 @@ class FleetBalancer:
         fr = _flight.get()
         rec = _spans.recording() or fr is not None
         if not rec:
-            _, routs = self._route(names, arrays, timeout_ms, deadline, tid)
+            _, routs = self._route(names, arrays, timeout_ms, deadline, tid,
+                                   priority=priority)
             return routs
         t0 = time.perf_counter()
         err: Optional[BaseException] = None
@@ -378,7 +499,8 @@ class FleetBalancer:
                 with _spans.parent_scope(sid):
                     with _spans.capture(cap):
                         rmeta, routs = self._route(
-                            names, arrays, timeout_ms, deadline, tid)
+                            names, arrays, timeout_ms, deadline, tid,
+                            priority=priority)
             extra_spans = list(rmeta.get("spans") or ())
             return routs
         except BaseException as e:  # noqa: BLE001 — observed, re-raised
@@ -397,7 +519,8 @@ class FleetBalancer:
     # hot-path: begin fleet_dispatch (acquire -> wire exchange -> release;
     # the only waits are the bounded capacity CV, the retry budget's
     # jittered backoff, and socket I/O)
-    def _route(self, names, arrays, timeout_ms, deadline, tid):
+    def _route(self, names, arrays, timeout_ms, deadline, tid,
+               priority=None):
         t_submit = time.perf_counter()
         budget = self._retry_policy.budget(
             deadline=deadline, op="fleet.requeue")
@@ -428,7 +551,8 @@ class FleetBalancer:
                         "fleet.dispatch", backend=be.name,
                         pid=be.handle.pid if be.handle is not None else None)
                 rmeta, routs = wire_call(
-                    be.transport, names, arrays, remaining_ms, tid)
+                    be.transport, names, arrays, remaining_ms, tid,
+                    priority=priority)
             except _RETRYABLE:
                 # retryable: the process died mid-exchange (no response
                 # ever arrived), answered that it is shutting down, or
@@ -443,21 +567,55 @@ class FleetBalancer:
                     self._metrics.count("expired")
                     raise DeadlineExceeded(
                         "deadline passed at requeue after backend failure")
+                if not self._throttle.try_acquire():
+                    # the token bucket is the anti-storm backstop: a dry
+                    # bucket means the fleet's own re-dispatches have
+                    # become the load — propagate the failure instead
+                    self._throttled_counter.inc()
+                    self._metrics.count("failed")
+                    raise
                 if not budget.backoff():
                     self._metrics.count("failed")
                     raise
                 self._count_requeue(be)
                 exclude = be
                 continue
+            except ServerOverloaded as e:
+                # the backend ANSWERED (it is alive and shedding):
+                # release clean, learn its load report, and honor its
+                # retry hint — routing skips it until the hint elapses,
+                # so a sick backend never sees a retry storm
+                self._release(be, ok=True)
+                self._update_load(be, getattr(e, "load", None))
+                hint_ms = getattr(e, "retry_after_ms", None)
+                if hint_ms:
+                    with self._route_cv:
+                        be.not_before = max(
+                            be.not_before,
+                            time.monotonic() + float(hint_ms) / 1e3)
+                self._metrics.count("shed")
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise
+                # paced re-dispatch (another backend may have room; this
+                # one is paused by not_before): token bucket first, then
+                # the jittered backoff budget — either refusing means
+                # the shed propagates with its hint intact
+                if not self._throttle.try_acquire():
+                    self._throttled_counter.inc()
+                    raise
+                if not budget.backoff():
+                    raise
+                exclude = be
+                continue
             except _errors.ServingError as e:
-                # typed end states from a LIVE backend: deadline/overload/
+                # typed end states from a LIVE backend: deadline /
                 # validation answers propagate; they also clear the
                 # backend's failure streak (it answered)
                 self._release(be, ok=True)
-                key = ("expired" if isinstance(e, DeadlineExceeded)
-                       else "shed" if isinstance(e, ServerOverloaded)
-                       else "failed")
-                self._metrics.count(key)
+                self._update_load(be, getattr(e, "load", None))
+                self._metrics.count(
+                    "expired" if isinstance(e, DeadlineExceeded)
+                    else "failed")
                 raise
             except BaseException:
                 # anything non-serving (an injected builtin error type, a
@@ -468,6 +626,7 @@ class FleetBalancer:
                 self._metrics.count("failed")
                 raise
             self._release(be, ok=True)
+            self._update_load(be, rmeta.get("load"))
             self._metrics.observe_request(
                 time.perf_counter() - t_submit, trace_id=tid)
             return rmeta, routs
@@ -503,12 +662,15 @@ class FleetBalancer:
             return self._feed_names, self._fetch_names
 
     def infer_named(self, feed, timeout_ms: Optional[float] = None,
-                    trace_id: Optional[str] = None) -> Dict[str, np.ndarray]:
+                    trace_id: Optional[str] = None,
+                    priority: Optional[int] = None) -> Dict[str, np.ndarray]:
         _, fetch_names = self._endpoint_shape()
         return dict(zip(fetch_names,
-                        self.infer(feed, timeout_ms, trace_id=trace_id)))
+                        self.infer(feed, timeout_ms, trace_id=trace_id,
+                                   priority=priority)))
 
-    def infer_many(self, feeds, timeout_ms: Optional[float] = None
+    def infer_many(self, feeds, timeout_ms: Optional[float] = None,
+                   priority: Optional[int] = None
                    ) -> List[List[np.ndarray]]:
         """Scatter/gather through a PERSISTENT worker pool: long-lived
         threads keep the transports' per-thread keep-alive connections
@@ -516,7 +678,8 @@ class FleetBalancer:
         tids = [monitor.new_trace_id() for _ in feeds]
         self.last_trace_ids = tids
         futures = [
-            self._executor().submit(self.infer, f, timeout_ms, trace_id=t)
+            self._executor().submit(self.infer, f, timeout_ms, trace_id=t,
+                                    priority=priority)
             for f, t in zip(feeds, tids)
         ]
         return [f.result() for f in futures]
@@ -640,6 +803,11 @@ class FleetBalancer:
                     # half-open: ONE remaining strike — the next request
                     # failure re-retires immediately, a success resets
                     be.consec_failures = _BACKEND_FAIL_LIMIT - 1
+                    # a rejoined backend starts with a clean load slate:
+                    # pre-retirement reports and pauses describe a
+                    # process state that no longer exists
+                    be.not_before = 0.0
+                    be.load_ts = None
                     self._route_cv.notify_all()
                 else:
                     be.retired_at = time.monotonic()

@@ -18,7 +18,7 @@ __all__ = [
     "WIRE_REQUESTS", "WIRE_BYTES_SENT", "WIRE_BYTES_RECEIVED",
     "WIRE_CODEC_SECONDS", "WIRE_BACKEND_RETIRED",
     "WIRE_HEALTH_CHECKS", "WIRE_HEALTH_CHECK_FAILURES",
-    "WIRE_BACKEND_RELAUNCHES",
+    "WIRE_BACKEND_RELAUNCHES", "RETRY_THROTTLED",
 ]
 
 WIRE_REQUESTS = _registry.REGISTRY.counter(
@@ -52,4 +52,10 @@ WIRE_BACKEND_RELAUNCHES = _registry.REGISTRY.counter(
     "wire_backend_relaunches_total",
     "supervisor relaunch attempts for crashed serving children "
     "(each attempt counts; compare against RelaunchFailed give-ups)",
+    ("fleet",))
+RETRY_THROTTLED = _registry.REGISTRY.counter(
+    "retry_throttled_total",
+    "fleet re-dispatches the token-bucket retry throttle denied: the "
+    "typed error propagated to the caller instead of amplifying load "
+    "on a saturated backend (back-pressure, not a retry storm)",
     ("fleet",))

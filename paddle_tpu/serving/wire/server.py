@@ -129,10 +129,13 @@ class ServingProcess:
                 pass  # scrapes/requests stay out of stderr
 
             # -- plumbing ------------------------------------------------
-            def _send(self, status: int, body: bytes, ctype: str) -> None:
+            def _send(self, status: int, body: bytes, ctype: str,
+                      extra_headers=None) -> None:
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -142,10 +145,12 @@ class ServingProcess:
                                       default=str).encode("utf-8"),
                            "application/json")
 
-            def _send_message(self, meta, arrays=(), status: int = 200) -> None:
+            def _send_message(self, meta, arrays=(), status: int = 200,
+                              extra_headers=None) -> None:
                 body = codec.encode_message(meta, arrays)
                 _SENT.inc(len(body))
-                self._send(status, body, CONTENT_TYPE)
+                self._send(status, body, CONTENT_TYPE,
+                           extra_headers=extra_headers)
 
             def _read_body(self) -> bytes:
                 length = int(self.headers.get("Content-Length") or 0)
@@ -229,15 +234,28 @@ class ServingProcess:
                             % (feed_names, len(arrays)))
                     feed = dict(zip(feed_names, arrays))
                     timeout_ms = meta.get("timeout_ms")
+                    priority = meta.get("priority")
                     rmeta, routs = sp._infer(
                         feed, timeout_ms,
                         traceparent=self.headers.get("traceparent"),
-                        want_spans=self.headers.get("X-Wire-Spans") == "1")
+                        want_spans=self.headers.get("X-Wire-Spans") == "1",
+                        priority=priority)
                 except BaseException as e:  # noqa: BLE001 — typed to the peer
+                    emeta = {"error": type(e).__name__, "message": str(e),
+                             "load": sp._load_meta()}
+                    headers = None
+                    retry_ms = getattr(e, "retry_after_ms", None)
+                    if retry_ms is not None:
+                        # the in-band channel carries the exact hint; the
+                        # HTTP Retry-After header (whole seconds, ceil'd
+                        # to stay >= the hint) is for generic tooling
+                        emeta["retry_after_ms"] = float(retry_ms)
+                        headers = {"Retry-After":
+                                   str(int(-(-float(retry_ms) // 1000)))}
                     try:
                         self._send_message(
-                            {"error": type(e).__name__, "message": str(e)},
-                            status=error_status(e))
+                            emeta, status=error_status(e),
+                            extra_headers=headers)
                     except Exception:
                         pass  # peer already gone; nothing to report to
                     return
@@ -264,6 +282,8 @@ class ServingProcess:
             "warmed_up": bool(m.get("warmed_up")),
             "live_replicas": srv.num_replicas,
             "queue_depth": m.get("queue_depth"),
+            "admit_limit": m.get("admit_limit"),
+            "brownout_level": m.get("brownout_level"),
             "max_batch_size": srv.max_batch_size,
             "input_names": list(srv._feed_names),
             "output_names": list(srv._predictor.get_output_names()),
@@ -271,18 +291,25 @@ class ServingProcess:
 
     # ------------------------------------------------------------------
     def _infer(self, feed, timeout_ms, traceparent: Optional[str],
-               want_spans: bool):
+               want_spans: bool, priority=None):
         """Bridge one wire request into the in-process server: install
         the remote trace context, submit, wait, and (tracing on) hand
-        the server-side span tree back for the client-side merge."""
+        the server-side span tree back for the client-side merge.
+        ``timeout_ms`` is the REMAINING deadline the client computed at
+        send time; an already-expired one is shed typed at admission
+        (``admission_expired_total``) by ``InferenceServer.submit``.
+        ``priority`` rides the request meta into priority shedding."""
         parsed = codec.parse_traceparent(traceparent)
         tid = parsed[0] if parsed else monitor.new_trace_id()
         remote_parent = parsed[1] if parsed else None
+        kw = {}
+        if priority is not None:
+            kw["priority"] = int(priority)
         fr = _flight.get()
         rec = _spans.recording() or fr is not None
         if not rec:
             outs = self.server.submit(
-                feed, timeout_ms=timeout_ms, trace_id=tid).result()
+                feed, timeout_ms=timeout_ms, trace_id=tid, **kw).result()
             return self._result_meta(tid), outs
 
         t0 = time.perf_counter()
@@ -298,7 +325,7 @@ class ServingProcess:
                 with _spans.parent_scope(sid):
                     outs = self.server.submit(
                         feed, timeout_ms=timeout_ms, trace_id=tid,
-                        parent_span=sid).result()
+                        parent_span=sid, **kw).result()
         except BaseException as e:  # noqa: BLE001 — observed, re-raised
             err = e
             raise
@@ -336,9 +363,18 @@ class ServingProcess:
             meta["spans"] = list(spans) + [wire_span]
         return meta, outs
 
+    def _load_meta(self) -> Dict[str, object]:
+        """The per-response load report (queue depth + adaptive admit
+        limit + brownout level): the balancer folds it into least-loaded
+        routing so a backlogged server stops attracting traffic even
+        when its in-flight count looks fine from the outside."""
+        load = getattr(self.server, "load", None)
+        return load() if callable(load) else {}
+
     def _result_meta(self, tid: str) -> Dict[str, object]:
         return {"trace_id": tid,
-                "output_names": list(self.server._predictor.get_output_names())}
+                "output_names": list(self.server._predictor.get_output_names()),
+                "load": self._load_meta()}
 
     @staticmethod
     def _collect_spans(fr, tid: str):

@@ -401,6 +401,66 @@ def test_checkpoint_ps_tables_roundtrip(tmp_path):
         s4.stop()
 
 
+def test_checkpoint_restores_adagrad_moments_exactly(tmp_path):
+    """Optimizer-moment checkpointing: after restore, the SAME gradient
+    applied to the original and the resumed table lands the SAME rows —
+    the adagrad accumulators were restored by value, so per-row step
+    sizes continue instead of restarting at their largest (which would
+    diverge the loss trajectory on resume)."""
+    from paddle_tpu.distributed.ps import ParameterServer, PSClient
+    from paddle_tpu.faults.checkpoint import TrainCheckpoint
+
+    prog, startup, _ = _tiny_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    ids = np.arange(17, dtype=np.int64)
+    rng = np.random.RandomState(3)
+    g1, g2, g3 = (rng.uniform(-1, 1, (17, 4)).astype(np.float32)
+                  for _ in range(3))
+    s1 = ParameterServer().start()
+    s2 = ParameterServer().start()
+    cli = PSClient([s1.endpoint, s2.endpoint])
+    ck = TrainCheckpoint(str(tmp_path))
+    try:
+        cli.create_table("emb", 4, initializer="zeros",
+                         optimizer="adagrad", lr=0.1)
+        cli.push_sparse("emb", ids, g1)
+        cli.push_sparse("emb", ids, g2)  # moments now hold g1^2 + g2^2
+        want = cli.pull_sparse("emb", ids)
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            path = ck.save(prog, scope, step=5, ps_client=cli)
+        # the moment dump is really on disk, flagged in the manifest
+        assert os.path.exists(os.path.join(path, "ps", "t000_moments.npy"))
+        cli.push_sparse("emb", ids, g3)  # the original run continues
+        want_after = cli.pull_sparse("emb", ids)
+    finally:
+        cli.close()
+        s1.stop()
+        s2.stop()
+
+    s3 = ParameterServer().start()
+    s4 = ParameterServer().start()
+    cli2 = PSClient([s3.endpoint, s4.endpoint])
+    try:
+        # the resumed run binds its tables first (optimizer config comes
+        # from the program binding, not the checkpoint)
+        cli2.create_table("emb", 4, initializer="zeros",
+                          optimizer="adagrad", lr=0.1)
+        scope2 = fluid.Scope()
+        ck.restore(prog, scope2, ps_client=cli2)
+        np.testing.assert_array_equal(cli2.pull_sparse("emb", ids), want)
+        # the SAME next gradient must produce the SAME next rows:
+        # bitwise, because the accumulators resumed by value
+        cli2.push_sparse("emb", ids, g3)
+        np.testing.assert_array_equal(
+            cli2.pull_sparse("emb", ids), want_after)
+    finally:
+        cli2.close()
+        s3.stop()
+        s4.stop()
+
+
 def test_checkpoint_with_ps_tables_requires_client(tmp_path):
     from paddle_tpu.distributed.ps import ParameterServer, PSClient
     from paddle_tpu.faults.checkpoint import TrainCheckpoint

@@ -38,6 +38,7 @@ from typing import List, Tuple
 CHECKED_FILES = [
     "paddle_tpu/executor.py",
     "paddle_tpu/serving/server.py",
+    "paddle_tpu/serving/admission.py",
     "paddle_tpu/reader.py",
     "paddle_tpu/parallel/compiled_program.py",
     "paddle_tpu/serving/wire/codec.py",
