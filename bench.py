@@ -221,16 +221,21 @@ def _merge_cal(res, cal):
 # 750->720, nmt 660->630, deepfm 450->420): frees 90 s for the
 # serving_overload stage (the graceful-degradation sweep — saturation
 # measure + three short open-loop stages on the already-cached LeNet
-# endpoint; finishes in ~1 min even cold).
-_BUDGETS = {"probe": 90, "bert": 900, "resnet": 720, "cal": 510, "nmt": 630,
-            "deepfm": 420, "dispatch_sharded": 90, "serving_wire": 120,
-            "serving_overload": 90}
+# endpoint; finishes in ~1 min even cold).  Rebalanced r10 (bert
+# 900->870, resnet 720->690, nmt 630->600, deepfm 420->390): frees
+# 120 s for the serving_decode stage (continuous-batching vs
+# request-at-a-time on a small transformer LM; ~65 s measured with its
+# ~20 s AOT warmup, 120 s covers a cold cache).
+_BUDGETS = {"probe": 90, "bert": 870, "resnet": 690, "cal": 510, "nmt": 600,
+            "deepfm": 390, "dispatch_sharded": 90, "serving_wire": 120,
+            "serving_overload": 90, "serving_decode": 120}
 # set to a reduced table when the liveness probe fails: with the backend
 # known-wedged, burning every stage's full budget buys nothing — short
 # budgets still let a recovering tunnel produce numbers
 _DEGRADED_BUDGETS = {"probe": 90, "bert": 300, "resnet": 240, "cal": 150,
                      "nmt": 150, "deepfm": 150, "dispatch_sharded": 60,
-                     "serving_wire": 60, "serving_overload": 60}
+                     "serving_wire": 60, "serving_overload": 60,
+                     "serving_decode": 60}
 _active_budgets = _BUDGETS
 
 
@@ -368,6 +373,8 @@ def _orchestrate():
         _emit(line)
         line["serving_overload"] = _serving_overload_block()
         _emit(line)
+        line["serving_decode"] = _serving_decode_block()
+        _emit(line)
         return
 
     _emit(line)  # headline secured before any other stage can hang
@@ -383,6 +390,8 @@ def _orchestrate():
     line["serving_wire"] = _serving_wire_block()
     _emit(line)
     line["serving_overload"] = _serving_overload_block()
+    _emit(line)
+    line["serving_decode"] = _serving_decode_block()
     _emit(line)
 
 
@@ -446,6 +455,20 @@ def _serving_overload_block():
             "BENCH_SERVING_THREADS", "4"),
         "BENCH_OVERLOAD_SECONDS": os.environ.get(
             "BENCH_OVERLOAD_SECONDS", "2"),
+    })
+
+
+def _serving_decode_block():
+    """Continuous-batching decode bench (bench_serving --decode): the
+    same mixed prompt/decode workload on a small transformer LM,
+    request-at-a-time vs token-level continuous batching — tokens/s for
+    both, the speedup (>= 2x is the acceptance bar), streamed TTFT, the
+    late-arrival drill, and the post-warmup recompile count (must stay
+    0: the slot pool's bucket ladders close the compiled-shape set)."""
+    return _run_sub("serving_decode", {
+        "BENCH_SERVING_DECODE": "1",
+        "BENCH_DECODE_REQUESTS": os.environ.get(
+            "BENCH_DECODE_REQUESTS", "24"),
     })
 
 
@@ -516,6 +539,10 @@ def main():
         import bench_serving
 
         line = bench_serving.run_overload()
+    elif model == "serving_decode":
+        import bench_serving
+
+        line = bench_serving.run_decode()
     elif model == "cal":
         line = _run_cal()
     else:

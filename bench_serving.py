@@ -50,6 +50,22 @@ production edge must keep it flat past the knee (the chaos suite
 asserts the >= 0.7 floor; the bench records the curve).
 Env knobs: BENCH_OVERLOAD_SECONDS (per stage, default 3),
 BENCH_OVERLOAD_MULTIPLIERS (default "1,2,3").
+
+``--decode`` (or $BENCH_SERVING_DECODE=1) benches the CONTINUOUS-
+BATCHING decode scheduler (``serving.decode``) on a transformer-LM
+endpoint under mixed prompt/decode traffic: the same interleaved
+long/short workload is decoded request-at-a-time (admit in groups of
+``max_slots``, wait out each group — what the request-batching server
+does to an autoregressive endpoint) and continuously (finished
+sequences free slots mid-flight, queued prompts join at the next
+tick).  The line reports tokens/s for both, their ratio (the
+acceptance bar is >= 2x on this mixed workload), streamed-client TTFT
+percentiles, the late-arrival drill (a request submitted mid-decode
+must reach its first token before the in-flight batch finishes), the
+prefill/decode token ratio, and the recompile count (0 after warmup —
+the slot pool's bucket ladders keep the compiled-shape set closed).
+Env knobs: BENCH_DECODE_REQUESTS (default 24), BENCH_DECODE_SLOTS
+(default 8), BENCH_DECODE_STEPS (per tick, default 4).
 """
 import json
 import os
@@ -575,6 +591,139 @@ def run():
     return result
 
 
+# ---------------------------------------------------------------------------
+# --decode: continuous batching vs request-at-a-time on a transformer LM
+# ---------------------------------------------------------------------------
+def _decode_workload(rng, n, max_seq_len):
+    """Interleaved long/short prompts (the mixed-length traffic that
+    makes request-at-a-time batching waste freed slots): every 4th
+    request decodes near the length cap, the rest are short."""
+    reqs = []
+    for i in range(n):
+        if i % 4 == 0:
+            plen, gen = 12, max_seq_len - 16
+        else:
+            plen, gen = 2 + i % 5, 4 + i % 6
+        prompt = rng.randint(3, 400, plen).astype(np.int32)
+        reqs.append((prompt, gen))
+    return reqs
+
+
+def run_decode():
+    """The ``--decode`` line: token-level scheduling, measured."""
+    import jax
+
+    import bench_common
+    from paddle_tpu.decoding import (
+        make_transformer_lm_pooled_step_fn,
+        random_transformer_lm_state,
+    )
+    from paddle_tpu.serving.client import Client
+    from paddle_tpu.serving.decode import DecodeServer
+
+    bench_common.configure_compile_cache(bench_common.HOME_CACHE_DIR)
+    n_requests = int(os.environ.get("BENCH_DECODE_REQUESTS", "24"))
+    max_slots = int(os.environ.get("BENCH_DECODE_SLOTS", "8"))
+    steps = int(os.environ.get("BENCH_DECODE_STEPS", "4"))
+    V, D, L, H, DI, ML = 512, 64, 2, 4, 128, 64
+    rng = np.random.RandomState(0)
+    state = random_transformer_lm_state(rng, V, D, L, H, DI, ML)
+    step_fn, make_cache = make_transformer_lm_pooled_step_fn(
+        state, V, D, L, H, DI)
+    srv = DecodeServer(step_fn, make_cache, eos_id=1, max_seq_len=ML,
+                       max_slots=max_slots, steps_per_tick=steps,
+                       name="bench-decode")
+    t0 = time.perf_counter()
+    compiles = srv.warmup()
+    warmup_s = time.perf_counter() - t0
+    work = _decode_workload(rng, n_requests, ML)
+
+    def gen_tokens():
+        return int(srv.metrics()["decode"]["generated_tokens"])
+
+    # request-at-a-time: admit in arrival-order groups of max_slots,
+    # wait the WHOLE group before the next
+    g0, t0 = gen_tokens(), time.perf_counter()
+    for g in range(0, len(work), max_slots):
+        group = [srv.submit({"tokens": p}, max_new_tokens=c)
+                 for p, c in work[g:g + max_slots]]
+        for r in group:
+            r.result(timeout=300.0)
+    rat_s = time.perf_counter() - t0
+    rat_tokens = gen_tokens() - g0
+
+    # continuous: streamed clients, all submitted up front; TTFT is
+    # first-chunk arrival as the CLIENT sees it
+    cli = Client(srv)
+    ttfts = []
+    lock = threading.Lock()
+
+    def stream_one(prompt, cap):
+        t_submit = time.perf_counter()
+        first = None
+        for _ in cli.infer_stream({"tokens": prompt}, max_new_tokens=cap):
+            if first is None:
+                first = time.perf_counter() - t_submit
+        with lock:
+            ttfts.append(first)
+
+    g0, t0 = gen_tokens(), time.perf_counter()
+    threads = [threading.Thread(target=stream_one, args=(p, c))
+               for p, c in work]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    cont_s = time.perf_counter() - t0
+    cont_tokens = gen_tokens() - g0
+
+    # the late-arrival drill: fill the pool with STAGGERED long decodes
+    # (the shortest frees its slot while the longest still runs), submit
+    # one short request mid-flight, compare scheduler timestamps
+    longs = [srv.submit({"tokens": work[0][0]},
+                        max_new_tokens=min(ML - 16, 16 + 4 * i))
+             for i in range(max_slots)]
+    while srv.metrics()["decode"]["slot_occupancy"] == 0.0:
+        time.sleep(0.001)
+    late = srv.submit({"tokens": np.array([5, 6], np.int32)},
+                      max_new_tokens=4)
+    late.result(timeout=300.0)
+    for r in longs:
+        r.result(timeout=300.0)
+    late_before_batch = late.first_token_t < max(r.done_t for r in longs)
+    late_ttft_ms = (late.first_token_t - late.submit_t) * 1e3
+
+    m = srv.metrics()
+    recompiles = int(m.get("recompiles", 0))
+    d = m["decode"]
+    srv.stop(drain=True, timeout=60.0)
+    ttfts.sort()
+    cont_tps = cont_tokens / cont_s
+    rat_tps = rat_tokens / rat_s
+    return {
+        "metric": "serving_decode_tokens_per_s",
+        "unit": "tokens/s",
+        "value": round(cont_tps, 1),
+        "request_at_a_time_tokens_per_s": round(rat_tps, 1),
+        "continuous_speedup": round(cont_tps / rat_tps, 2),
+        "ttft_ms_p50": round(ttfts[len(ttfts) // 2] * 1e3, 2),
+        "ttft_ms_p99": round(
+            ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))] * 1e3, 2),
+        "late_arrival_ttft_ms": round(late_ttft_ms, 2),
+        "late_arrival_before_batch_done": bool(late_before_batch),
+        "prefill_decode_ratio": round(
+            d["prefill_tokens"] / max(1, d["generated_tokens"]), 3),
+        "ticks": d["ticks"],
+        "steps_per_tick": steps,
+        "max_slots": max_slots,
+        "requests": n_requests,
+        "warmup_compiles": compiles,
+        "warmup_s": round(warmup_s, 1),
+        "recompiles": recompiles,
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def main():
     import bench_common
 
@@ -585,6 +734,10 @@ def main():
     if "--overload" in sys.argv[1:] or os.environ.get(
             "BENCH_SERVING_OVERLOAD"):
         bench_common.emit_result(run_overload())
+        return
+    if "--decode" in sys.argv[1:] or os.environ.get(
+            "BENCH_SERVING_DECODE"):
+        bench_common.emit_result(run_decode())
         return
     mode = _wire_mode()
     if mode:

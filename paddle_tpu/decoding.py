@@ -32,7 +32,34 @@ __all__ = [
     "beam_search", "greedy_search", "make_program_logits_fn",
     "beam_search_cached", "greedy_search_cached",
     "make_transformer_lm_step_fn",
+    "make_transformer_lm_pooled_step_fn", "make_slot_decode_fns",
+    "random_transformer_lm_state",
 ]
+
+
+def random_transformer_lm_state(rng, vocab, d_model, n_layer, n_head,
+                                d_inner, max_pos, name="lm"):
+    """A randomly initialized transformer-LM weight dict with exactly
+    the keys the ``make_transformer_lm_*_step_fn`` builders read —
+    the one place the key/shape schema lives for benches and tests."""
+    w = {name + "_word_emb": rng.randn(vocab, d_model) * 0.1,
+         name + "_pos_emb": rng.randn(max_pos, d_model) * 0.1,
+         name + "_head_w": rng.randn(d_model, vocab) * 0.1,
+         name + "_head_b": np.zeros(vocab)}
+    for i in range(n_layer):
+        p = "%s_dec_%d" % (name, i)
+        for nm, shp in (("_att_q", (d_model, d_model)),
+                        ("_att_k", (d_model, d_model)),
+                        ("_att_v", (d_model, d_model)),
+                        ("_att_out", (d_model, d_model)),
+                        ("_ffn_fc0", (d_model, d_inner)),
+                        ("_ffn_fc1", (d_inner, d_model))):
+            w[p + nm + "_w"] = rng.randn(*shp) * 0.1
+            w[p + nm + "_b"] = np.zeros(shp[1])
+        for ln in ("_ln1", "_ln2"):
+            w[p + ln + "_scale"] = np.ones(d_model)
+            w[p + ln + "_bias"] = np.zeros(d_model)
+    return {k: np.asarray(v, "float32") for k, v in w.items()}
 
 
 def make_program_logits_fn(program, state, feed_names, logits_name):
@@ -212,15 +239,6 @@ def make_transformer_lm_step_fn(
     d_head = d_model // n_head
     W = {k: jnp.asarray(v) for k, v in state.items()}
 
-    def fc(x, pname):
-        return x @ W[pname + "_w"] + W[pname + "_b"]
-
-    def ln(x, pname):
-        mean = jnp.mean(x, axis=-1, keepdims=True)
-        var = jnp.var(x, axis=-1, keepdims=True)
-        y = (x - mean) / jnp.sqrt(var + 1e-5)
-        return y * W[pname + "_scale"] + W[pname + "_bias"]
-
     def make_cache(n_rows: int):
         return [
             {
@@ -235,28 +253,228 @@ def make_transformer_lm_step_fn(
     def step_fn(cache, tokens, t):
         # tokens [N] int32; t: position being consumed
         x = W[name + "_word_emb"][tokens] + W[name + "_pos_emb"][t]
-        new_cache = []
-        n = x.shape[0]
-        pos_ok = (jnp.arange(max_len) <= t)[None, None, :]  # [1,1,T]
-        for i in range(n_layer):
-            p = "%s_dec_%d" % (name, i)
-            q = fc(x, p + "_att_q").reshape(n, n_head, d_head)
-            k = fc(x, p + "_att_k").reshape(n, n_head, d_head)
-            v = fc(x, p + "_att_v").reshape(n, n_head, d_head)
+        return _lm_forward_one(W, name, cache, x, t, None, n_layer,
+                               n_head, d_head, d_model, scale)
+
+    return step_fn, make_cache
+
+
+def _lm_forward_one(W, name, cache, x, t, ts, n_layer, n_head, d_head,
+                    d_model, scale):
+    """One incremental transformer-LM forward shared by the scalar-``t``
+    and slot-pooled (per-row ``ts``) step fns.  Exactly one of ``t``
+    (scalar loop position, all rows aligned) / ``ts`` ([N] int32, each
+    row at its own position) is not None; the cache T axis is read from
+    the cache itself so one builder serves every length rung."""
+    import jax
+    import jax.numpy as jnp
+
+    T = cache[0]["k"].shape[2]
+    n = x.shape[0]
+    if ts is None:
+        pos_ok = (jnp.arange(T) <= t)[None, None, :]       # [1,1,T]
+        row_t = None
+    else:
+        pos_ok = (jnp.arange(T)[None, :] <= ts[:, None])[:, None, :]  # [N,1,T]
+        row_t = (jnp.arange(T)[None, :] == ts[:, None])    # [N,T]
+    new_cache = []
+    for i in range(n_layer):
+        p = "%s_dec_%d" % (name, i)
+        q = _fc(W, x, p + "_att_q").reshape(n, n_head, d_head)
+        k = _fc(W, x, p + "_att_k").reshape(n, n_head, d_head)
+        v = _fc(W, x, p + "_att_v").reshape(n, n_head, d_head)
+        if ts is None:
             kc = jax.lax.dynamic_update_index_in_dim(
                 cache[i]["k"], k, t, axis=2)
             vc = jax.lax.dynamic_update_index_in_dim(
                 cache[i]["v"], v, t, axis=2)
-            new_cache.append({"k": kc, "v": vc})
-            scores = jnp.einsum("nhd,nhtd->nht", q, kc) * scale
-            scores = jnp.where(pos_ok, scores, -1e9)
-            w = jax.nn.softmax(scores, axis=-1)
-            ctx = jnp.einsum("nht,nhtd->nhd", w, vc).reshape(n, d_model)
-            att = fc(ctx, p + "_att_out")
-            x = ln(x + att, p + "_ln1")
-            h = jax.nn.gelu(fc(x, p + "_ffn_fc0"), approximate=False)
-            x = ln(x + fc(h, p + "_ffn_fc1"), p + "_ln2")
-        logits = fc(x, name + "_head")
-        return logits, new_cache
+        else:
+            # per-row scatter: each lane writes its OWN position — the
+            # one-hot select is O(cache) like the attention itself
+            sel = row_t[:, None, :, None]                  # [N,1,T,1]
+            kc = jnp.where(sel, k[:, :, None, :], cache[i]["k"])
+            vc = jnp.where(sel, v[:, :, None, :], cache[i]["v"])
+        new_cache.append({"k": kc, "v": vc})
+        scores = jnp.einsum("nhd,nhtd->nht", q, kc) * scale
+        scores = jnp.where(pos_ok, scores, -1e9)
+        w = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("nht,nhtd->nhd", w, vc).reshape(n, d_model)
+        att = _fc(W, ctx, p + "_att_out")
+        x = _ln(W, x + att, p + "_ln1")
+        h = jax.nn.gelu(_fc(W, x, p + "_ffn_fc0"), approximate=False)
+        x = _ln(W, x + _fc(W, h, p + "_ffn_fc1"), p + "_ln2")
+    logits = _fc(W, x, name + "_head")
+    return logits, new_cache
+
+
+def _fc(W, x, pname):
+    return x @ W[pname + "_w"] + W[pname + "_b"]
+
+
+def _ln(W, x, pname):
+    import jax.numpy as jnp
+
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + 1e-5)
+    return y * W[pname + "_scale"] + W[pname + "_bias"]
+
+
+def make_transformer_lm_pooled_step_fn(
+    state,
+    vocab_size: int,
+    d_model: int,
+    n_layer: int,
+    n_head: int,
+    d_inner: int,
+    name: str = "lm",
+):
+    """The slot-pool variant of :func:`make_transformer_lm_step_fn`.
+
+    Continuous batching decodes a POOL of sequences that are each at a
+    DIFFERENT position (a request admitted mid-flight starts its prefill
+    while its neighbors are deep into generation), so the step consumes
+    per-row positions: ``step_fn(cache, tokens [N] int32, ts [N] int32)
+    -> (logits [N, V], cache)`` where row ``i`` consumes ``tokens[i]``
+    at position ``ts[i]`` (cache row ``i`` updated at ``ts[i]``; its
+    attention masked to positions ``<= ts[i]``).
+
+    The cache T axis is read from the cache arrays themselves, so one
+    step fn serves every length rung of the slot pool's bucket ladder:
+    ``make_cache(n_rows, seq_len)`` allocates the zeroed pytree for one
+    (slot-rung, length-rung) pair.  Math is identical to the scalar-t
+    builder — with all rows at the same position the two are exactly
+    equal (parity-tested in tests/test_seq2seq_decode.py).
+
+    The pool relies on a write-before-read invariant instead of cache
+    zeroing on slot reuse: a sequence at position ``ts`` has itself
+    written every cache position ``<= ts`` (prefill consumes each prompt
+    token through the same step), and the mask hides ``> ts`` — stale
+    rows from a previous occupant are never read.
+    """
+    import jax.numpy as jnp
+
+    d_head = d_model // n_head
+    W = {k: jnp.asarray(v) for k, v in state.items()}
+    scale = 1.0 / float(np.sqrt(d_head))
+
+    def make_cache(n_rows: int, seq_len: int):
+        return [
+            {
+                "k": jnp.zeros((n_rows, n_head, seq_len, d_head), "float32"),
+                "v": jnp.zeros((n_rows, n_head, seq_len, d_head), "float32"),
+            }
+            for _ in range(n_layer)
+        ]
+
+    def step_fn(cache, tokens, ts):
+        x = W[name + "_word_emb"][tokens] + W[name + "_pos_emb"][ts]
+        return _lm_forward_one(W, name, cache, x, None, ts, n_layer,
+                               n_head, d_head, d_model, scale)
 
     return step_fn, make_cache
+
+
+# ---------------------------------------------------------------------------
+# Slot-pool decode: the fused multi-token chunk + admit executables
+# ---------------------------------------------------------------------------
+def make_slot_decode_fns(step_fn, eos_id: int, steps: int):
+    """Build the three pure functions the serving slot pool compiles per
+    (slot-rung, length-rung) pair: ``chunk(state) -> state`` advancing
+    every active slot by up to ``steps`` tokens in ONE device dispatch
+    (a ``fori_loop`` — multi-step dispatch amortizes host overhead
+    between scheduler interventions), ``admit(state, slot_mask,
+    prompt, prompt_len, total_len) -> state`` seating one request into a
+    free slot, and ``release(state, slot_mask) -> state`` deactivating
+    slots mid-flight (deadline abort) so their lanes stop advancing.
+
+    The pool state is a dict pytree (every leaf's axis 0 is the slot):
+
+    * ``cache``    — the step fn's KV pytree (T axis read by the step)
+    * ``tokens``   — [S, T] int32, position-indexed token buffer
+    * ``pos``      — [S] int32, tokens consumed so far (the step eats
+      index ``pos`` and produces the token for ``pos + 1``)
+    * ``prompt_len``/``total_len`` — [S] int32 per-slot prompt size and
+      overall length cap (prompt + generated <= total_len <= T)
+    * ``active``/``finished`` — [S] bool scheduler flags
+    * ``n_gen``    — [S] int32 generated-token count (prefill/decode
+      ratio accounting reads the deltas host-side)
+
+    Prefill and decode are the SAME step: while ``pos + 1 <
+    prompt_len`` the produced token is discarded in favor of the stored
+    prompt token (teacher forcing), so a freshly admitted prompt fills
+    its cache inside the running batch — no separate prefill executable,
+    no second compiled shape.  A slot finishes when it emits ``eos_id``
+    or reaches ``total_len``; inactive slots are fully masked (their
+    ``pos`` does not advance) and cost only the wasted lane math the
+    bucket ladder already prices in.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def _body(_, state):
+        tokens = state["tokens"]
+        pos = state["pos"]
+        active = state["active"]
+        S, T = tokens.shape
+        rows = jnp.arange(S)
+        tok_in = tokens[rows, jnp.minimum(pos, T - 1)]
+        logits, cache = step_fn(state["cache"], tok_in, pos)
+        nxt = jnp.argmax(logits, axis=-1).astype("int32")
+        write_idx = jnp.minimum(pos + 1, T - 1)
+        in_prefill = (pos + 1) < state["prompt_len"]
+        do_write = active & ~in_prefill
+        cur = tokens[rows, write_idx]
+        tokens = tokens.at[rows, write_idx].set(
+            jnp.where(do_write, nxt, cur))
+        newly_fin = do_write & (
+            (nxt == eos_id) | ((pos + 2) >= state["total_len"]))
+        return {
+            "cache": cache,
+            "tokens": tokens,
+            "pos": jnp.where(active, pos + 1, pos),
+            "prompt_len": state["prompt_len"],
+            "total_len": state["total_len"],
+            "active": active & ~newly_fin,
+            "finished": state["finished"] | newly_fin,
+            "n_gen": state["n_gen"] + do_write.astype("int32"),
+        }
+
+    def chunk(state):
+        return jax.lax.fori_loop(0, steps, _body, state)
+
+    def admit(state, slot_mask, prompt, prompt_len, total_len):
+        # slot_mask [S] bool (one admitted slot), prompt [T] int32
+        # (padded host-side), prompt_len/total_len () int32 scalars.
+        # The cache passes through UNTOUCHED: the write-before-read
+        # invariant (see make_transformer_lm_pooled_step_fn) makes
+        # zeroing a reused slot's rows unnecessary.
+        mask = slot_mask
+        return {
+            "cache": state["cache"],
+            "tokens": jnp.where(mask[:, None], prompt[None, :],
+                                state["tokens"]),
+            "pos": jnp.where(mask, 0, state["pos"]),
+            "prompt_len": jnp.where(mask, prompt_len, state["prompt_len"]),
+            "total_len": jnp.where(mask, total_len, state["total_len"]),
+            "active": state["active"] | mask,
+            "finished": state["finished"] & ~mask,
+            "n_gen": jnp.where(mask, 0, state["n_gen"]),
+        }
+
+    def release(state, slot_mask):
+        # deactivate without finishing: the slot becomes seatable again
+        # (its request was aborted host-side); tokens/cache stay — the
+        # write-before-read invariant protects the next occupant
+        return {
+            "cache": state["cache"],
+            "tokens": state["tokens"],
+            "pos": state["pos"],
+            "prompt_len": state["prompt_len"],
+            "total_len": state["total_len"],
+            "active": state["active"] & ~slot_mask,
+            "finished": state["finished"] & ~slot_mask,
+            "n_gen": state["n_gen"],
+        }
+
+    return chunk, admit, release

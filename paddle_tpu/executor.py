@@ -1037,7 +1037,8 @@ class Executor:
                            fetch_info=None, print_period=100,
                            trainer_desc=None, trace_id=None,
                            checkpoint_dir=None, checkpoint_every=0,
-                           checkpoint_epoch=0, resume_from=None):
+                           checkpoint_epoch=0, resume_from=None,
+                           checkpoint_async=False):
         """Loop the dataset's batches through run() (reference:
         executor.py train_from_dataset -> C++ Trainer/DeviceWorker loop,
         trainer.h:38; here the compiled step is the device worker).
@@ -1060,6 +1061,10 @@ class Executor:
         ``last_resume_step`` reports the restored cursor.  Async PS
         state (the overlapped pull, the Communicator's queued pushes) is
         quiesced before each save so the checkpoint is consistent.
+        ``checkpoint_async=True`` moves serialization off the critical
+        path: the step pays only a quiesce + copy-on-write gather and a
+        background snapshot thread writes/commits (same tmp+rename
+        atomicity; the epoch joins the tail save before returning).
 
         Request-scoped tracing (TPU-native extension): the epoch mints a
         trace id (or joins ``trace_id``) readable back via
@@ -1194,8 +1199,21 @@ class Executor:
                 if ckpt is not None and ckpt.should_save(step + 1):
                     self._train_checkpoint(
                         ckpt, prog_obj, scope or global_scope(),
-                        step + 1, int(checkpoint_epoch), ps_ctx)
+                        step + 1, int(checkpoint_epoch), ps_ctx,
+                        async_=bool(checkpoint_async))
+            if ckpt is not None:
+                # commit the tail background save before returning (a
+                # write error surfaces here, on the epoch's own path)
+                ckpt.wait()
         finally:
+            if ckpt is not None and ckpt.in_flight:
+                # abnormal exit with a save still writing: join so the
+                # writer can't race teardown; the epoch's primary error
+                # stays the one that propagates
+                try:
+                    ckpt.wait()
+                except BaseException:  # noqa: BLE001 — deliberate
+                    pass
             if epoch_sid is not None:
                 with _mon_spans.trace_context((tid,)):
                     _mon_spans.record_span(
@@ -1224,19 +1242,22 @@ class Executor:
         return results
 
     def _train_checkpoint(self, ckpt, program, scope, step, epoch,
-                          ps_ctx) -> None:
+                          ps_ctx, async_: bool = False) -> None:
         """Quiesce async PS state, then commit one atomic checkpoint.
         The overlapped dense-PS pull is joined (its params land in the
         scope first) and the async Communicator is flushed (every queued
         sparse grad reaches the server) so the saved params, PS rows,
-        and cursor describe the SAME step."""
+        and cursor describe the SAME step.  ``async_``: snapshot on this
+        thread (copy-on-write gather), serialize + commit on the
+        checkpoint's background writer — the step resumes immediately."""
         if ps_ctx is not None:
             self._dense_ps_join_pending(ps_ctx, scope)
         comm = getattr(program, "_ps_communicator", None)
         if comm is not None:
             comm.flush()
-        ckpt.save(program, scope, step=step, epoch=epoch,
-                  ps_client=getattr(program, "_ps_client", None))
+        saver = ckpt.save_async if async_ else ckpt.save
+        saver(program, scope, step=step, epoch=epoch,
+              ps_client=getattr(program, "_ps_client", None))
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,

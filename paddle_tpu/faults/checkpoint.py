@@ -20,6 +20,18 @@ tmp+rename).  A SIGKILL at ANY instant leaves either the previous
 checkpoint or the new one — never a half-written directory a resume
 could trust.
 
+**Async mode** (:meth:`save_async`) takes the save cost off the
+training critical path: the caller (already quiesced) pays only a
+copy-on-write gather — persistables copied to host numpy, PS tables
+dumped by value — and serialization + commit happen on a background
+snapshot thread.  The atomicity story is unchanged (the background
+writer goes through the same tmp+rename commit), so a SIGKILL DURING a
+background save leaves the previous committed checkpoint in charge;
+the ``checkpoint.commit`` fault point injects delay/error into the
+commit phase so chaos tests can pin exactly that window.  One save is
+in flight at a time: a new ``save_async`` (or :meth:`wait`) joins the
+previous one first.
+
 Layout::
 
     run_dir/
@@ -35,10 +47,12 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import threading
 from typing import Dict, Optional
 
 import numpy as np
 
+import paddle_tpu.faults as _faults
 from paddle_tpu.faults.metrics import TRAIN_CHECKPOINTS
 
 __all__ = ["TrainCheckpoint"]
@@ -61,6 +75,9 @@ class TrainCheckpoint:
         self.run_dir = str(run_dir)
         self.every_n_steps = int(every_n_steps)
         self.keep = max(1, int(keep))
+        self._bg: Optional[threading.Thread] = None
+        self._bg_result: Optional[str] = None
+        self._bg_error: Optional[BaseException] = None
         os.makedirs(self.run_dir, exist_ok=True)
 
     # ------------------------------------------------------------------
@@ -80,6 +97,81 @@ class TrainCheckpoint:
         The caller is responsible for quiescing async state first (the
         executor joins its overlapped PS pull and flushes the
         Communicator before calling)."""
+        self.wait()  # never interleave with an in-flight async save
+        ps_state = (self._gather_ps(ps_client)
+                    if ps_client is not None else None)
+        return self._commit(program, scope, step, epoch, ps_state, extra)
+
+    def save_async(self, program, scope, step: int, epoch: int = 0,
+                   ps_client=None, extra: Optional[Dict] = None) -> None:
+        """Snapshot now, serialize in the background.
+
+        The caller-thread cost is one copy-on-write gather: every
+        persistable's value copied to host numpy (into a detached
+        snapshot scope) and the PS tables dumped by value — the PS
+        sockets are only touched here, never from the writer thread.
+        Serialization, fsync traffic, the tmp+rename commit, and
+        pruning all happen on a daemon snapshot thread; training
+        continues immediately.  A previous in-flight save is joined
+        first (its error, if any, re-raises HERE — a silent checkpoint
+        gap must not go unnoticed); call :meth:`wait` at end of epoch
+        to commit the tail save."""
+        self.wait()
+        snap = self._snapshot_scope(program, scope)
+        ps_state = (self._gather_ps(ps_client)
+                    if ps_client is not None else None)
+        self._bg_result = self._bg_error = None
+
+        def _write():
+            try:
+                self._bg_result = self._commit(
+                    program, snap, step, epoch, ps_state, extra)
+            except BaseException as e:  # noqa: BLE001 — re-raised at wait()
+                self._bg_error = e
+
+        self._bg = threading.Thread(
+            target=_write, name="ckpt-writer-%06d" % int(step), daemon=True)
+        self._bg.start()
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[str]:
+        """Join the in-flight background save, if any.  Returns its
+        committed path (None when nothing was in flight) and re-raises
+        its failure."""
+        bg, self._bg = self._bg, None
+        if bg is not None:
+            bg.join(timeout)
+            if bg.is_alive():  # caller keeps ownership of the join
+                self._bg = bg
+                raise TimeoutError("background checkpoint still writing")
+        if self._bg_error is not None:
+            err, self._bg_error = self._bg_error, None
+            raise err
+        result, self._bg_result = self._bg_result, None
+        return result
+
+    @property
+    def in_flight(self) -> bool:
+        return self._bg is not None and self._bg.is_alive()
+
+    @staticmethod
+    def _snapshot_scope(program, scope):
+        """Copy every persistable's current value into a detached
+        scope: the writer thread reads ONLY these copies, so training
+        may mutate the live scope the instant this returns."""
+        from paddle_tpu import io as _io
+        from paddle_tpu.scope import Scope
+
+        snap = Scope()
+        for v in _io._collect(program, _io._is_persistable, None):
+            val = scope.get(v.name)
+            if val is not None:
+                snap.set(v.name, np.array(np.asarray(val), copy=True))
+        return snap
+
+    def _commit(self, program, scope, step, epoch, ps_state, extra) -> str:
+        """The write + atomic-rename phase (caller thread for ``save``,
+        snapshot thread for ``save_async``); reads only the given scope
+        and the pre-gathered ``ps_state``."""
         from paddle_tpu import io as _io
 
         final = os.path.join(self.run_dir, self._name(step))
@@ -90,13 +182,19 @@ class TrainCheckpoint:
         os.makedirs(tmp)
         _io.save_persistables(None, os.path.join(tmp, "params"),
                               main_program=program, scope=scope)
-        if ps_client is not None:
-            self._save_ps(os.path.join(tmp, "ps"), ps_client)
+        if ps_state is not None:
+            self._write_ps(os.path.join(tmp, "ps"), ps_state)
         cursor = {"step": int(step), "epoch": int(epoch)}
         if extra:
             cursor.update(extra)
         with open(os.path.join(tmp, "cursor.json"), "w") as f:
             json.dump(cursor, f)
+        if _faults.active is not None:  # disarmed: one is-None gate
+            # the chaos window: a kill/delay/error HERE lands between a
+            # fully staged tmp dir and its commit — resume must still
+            # see only the previous committed checkpoint
+            _faults.active.faultpoint(
+                "checkpoint.commit", run_dir=self.run_dir, step=int(step))
         os.replace(tmp, final)
         # move LATEST only after the checkpoint directory is committed
         ptr_tmp = os.path.join(self.run_dir, _LATEST + ".tmp")
@@ -107,12 +205,16 @@ class TrainCheckpoint:
         self._prune(keep_name=self._name(step))
         return final
 
-    def _save_ps(self, dirname: str, ps_client) -> None:
+    @staticmethod
+    def _gather_ps(ps_client):
         # include_moments: the adagrad accumulators dump alongside the
         # rows so a SIGKILL-resume is exact for sparse optimizers (a
         # moment-less restore would restart per-row step sizes at their
         # largest and re-diverge the loss trajectory)
-        state = ps_client.save(include_moments=True)
+        return ps_client.save(include_moments=True)
+
+    @staticmethod
+    def _write_ps(dirname: str, state) -> None:
         os.makedirs(dirname)
         manifest = []
         for i, (table, value) in enumerate(sorted(state.items())):

@@ -24,7 +24,10 @@ AnalysisPredictor (inference.py):
   ``BackendUnavailable``);
 * ``serving.wire`` (lazy subpackage) — the cross-host tier: codec +
   HTTP transport, ``RemoteClient``, ``ServingProcess`` children, and
-  the ``FleetBalancer`` front end.
+  the ``FleetBalancer`` front end;
+* ``serving.decode`` (lazy module) — continuous-batching token-level
+  decode: ``DecodeServer`` over the bucketed KV-cache slot pool
+  (``serving.kv_pool``), streamed to clients via ``infer_stream``.
 
 Quickstart::
 
@@ -75,17 +78,19 @@ __all__ = [
     "WireProtocolError",
     "BackendUnavailable",
     "wire",
+    "decode",
 ]
 
 
 def __getattr__(name):
-    # the wire subpackage is imported lazily: the in-process serving
-    # path must not pay the transport/launcher import (and its metric
-    # registrations) unless the process actually crosses a host boundary
-    if name == "wire":
+    # the wire subpackage and the decode module are imported lazily:
+    # the in-process serving path must not pay the transport/launcher
+    # import (and its metric registrations) — or the decode scheduler's
+    # — unless the process actually uses them
+    if name in ("wire", "decode"):
         import importlib
 
-        mod = importlib.import_module("paddle_tpu.serving.wire")
-        globals()["wire"] = mod
+        mod = importlib.import_module("paddle_tpu.serving." + name)
+        globals()[name] = mod
         return mod
     raise AttributeError("module %r has no attribute %r" % (__name__, name))

@@ -28,6 +28,17 @@ in ``batching.DynamicBatcher``:
   ``ServerOverloaded`` it raises; the wire layer forwards it as
   response meta + an HTTP ``Retry-After`` header and the fleet
   balancer's retry pacing honors it.
+* **Weighted fair sharing across classes** — under STEADY saturation,
+  pure priority ordering starves LOW entirely (every pop goes to a
+  more important class that never drains).  The store is therefore one
+  EDF heap PER CLASS, and pops are stride-scheduled across the
+  non-empty classes by ``class_weights`` (default HIGH 4 : NORMAL 2 :
+  LOW 1): each class owns a virtual-time pass advanced by
+  ``1/weight`` per pop, and the smallest pass is served next — so LOW
+  gets a deterministic trickle (1 pop in 7 under three-way
+  saturation) instead of zero, while EDF order is preserved WITHIN
+  each class.  ``class_weights=None`` disables sharing and restores
+  the pure cross-class EDF pop order.
 
 ``BrownoutController`` is the deterministic degradation ladder the
 server climbs under *sustained* saturation (ratio thresholds held for
@@ -41,18 +52,26 @@ from __future__ import annotations
 import heapq
 import threading
 import time
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from paddle_tpu import monitor
 
 __all__ = [
     "PRIORITY_HIGH", "PRIORITY_NORMAL", "PRIORITY_LOW",
-    "AdmissionQueue", "BrownoutController",
+    "DEFAULT_CLASS_WEIGHTS", "AdmissionQueue", "BrownoutController",
 ]
 
 PRIORITY_HIGH = 0
 PRIORITY_NORMAL = 1
 PRIORITY_LOW = 2
+
+#: default stride-scheduling shares (lower class int = more important):
+#: under three-way saturation HIGH gets 4 of every 7 pops, NORMAL 2,
+#: LOW 1 — a deterministic trickle instead of starvation.  A class not
+#: in the map weighs 1.
+DEFAULT_CLASS_WEIGHTS = {
+    PRIORITY_HIGH: 4.0, PRIORITY_NORMAL: 2.0, PRIORITY_LOW: 1.0,
+}
 
 _NO_DEADLINE = float("inf")
 
@@ -110,7 +129,8 @@ class AdmissionQueue:
 
     def __init__(self, capacity: int, target_wait_ms: float = 50.0,
                  min_limit: int = 4, name: str = "server",
-                 adaptive: bool = True):
+                 adaptive: bool = True,
+                 class_weights: Optional[Dict[int, float]] = "default"):
         # queue.Queue convention kept from the FIFO version: <= 0 means
         # unbounded (no shedding, no adaptive limit)
         self.capacity = int(capacity) if int(capacity) > 0 else None
@@ -123,7 +143,26 @@ class AdmissionQueue:
         self.adaptive = bool(adaptive) and self.capacity is not None
         self.name = name
         self.cv = threading.Condition()
-        self._heap: List[_Entry] = []
+        # the store: one EDF heap PER PRIORITY CLASS, so weighted fair
+        # sharing can stride-schedule pops across classes while EDF
+        # order is preserved within each
+        if class_weights == "default":
+            class_weights = DEFAULT_CLASS_WEIGHTS
+        self.class_weights = (
+            {int(k): float(v) for k, v in class_weights.items()}
+            if class_weights is not None else None)
+        if self.class_weights is not None and any(
+                w <= 0 for w in self.class_weights.values()):
+            raise ValueError(
+                "class weights must be positive, got %r" % class_weights)
+        self._heaps: Dict[int, List[_Entry]] = {}
+        self._class_live: Dict[int, int] = {}
+        # stride scheduling state: each class owns a virtual-time pass
+        # advanced by 1/weight per pop; the smallest pass serves next.
+        # _global_pass anchors a class waking from empty so an idle
+        # class can never bank credit and then monopolize the queue.
+        self._pass: Dict[int, float] = {}
+        self._global_pass = 0.0
         self._live = 0
         self._seq = 0
         self._limit = self.capacity if self.capacity is not None else 0
@@ -194,36 +233,46 @@ class AdmissionQueue:
                 else:
                     victim.alive = False
                     self._live -= 1
+                    self._class_live[victim.priority] -= 1
                     shed.append(victim.req)
             retry_ms = self._retry_after_locked()
             if admitted:
                 self._seq += 1
+                live = self._class_live.get(priority, 0)
+                if live == 0 and self.class_weights is not None:
+                    # a class waking from empty joins at the CURRENT
+                    # virtual time: idle never banks credit
+                    self._pass[priority] = max(
+                        self._pass.get(priority, 0.0), self._global_pass)
                 heapq.heappush(
-                    self._heap,
+                    self._heaps.setdefault(priority, []),
                     _Entry(self._key(req), self._seq, req, priority))
+                self._class_live[priority] = live + 1
                 self._live += 1
                 self.cv.notify()
         # hot-path: end admission_offer
         return admitted, expired, shed, retry_ms
 
     def _sweep_locked(self, now: float, expired: List) -> None:
-        """Drop dead/expired entries off the heap top.  EDF makes this
-        complete: every expired entry keys earlier than every live one
-        (no-deadline entries key at +inf), so expired work can only sit
-        at the top — the sweep never has to scan the middle."""
-        heap = self._heap
-        while heap:
-            top = heap[0]
-            if not top.alive:
-                heapq.heappop(heap)
-                continue
-            if top.key is not _NO_DEADLINE and top.key <= now:
-                heapq.heappop(heap)
-                top.alive = False
-                self._live -= 1
-                expired.append(top.req)
-                continue
-            break
+        """Drop dead/expired entries off every class heap's top.  EDF
+        makes this complete per heap: every expired entry keys earlier
+        than every live one (no-deadline entries key at +inf), so
+        expired work can only sit at a top — the sweep never has to
+        scan a heap's middle."""
+        for cls, heap in self._heaps.items():
+            while heap:
+                top = heap[0]
+                if not top.alive:
+                    heapq.heappop(heap)
+                    continue
+                if top.key is not _NO_DEADLINE and top.key <= now:
+                    heapq.heappop(heap)
+                    top.alive = False
+                    self._live -= 1
+                    self._class_live[cls] -= 1
+                    expired.append(top.req)
+                    continue
+                break
 
     def _pick_victim_locked(self, priority: int) -> Optional[_Entry]:
         """The entry priority shedding evicts for an arrival at
@@ -232,32 +281,63 @@ class AdmissionQueue:
         at least as important as the arrival — then the ARRIVAL sheds.
         O(n) scan, but only ever on the shed path of a full queue."""
         victim = None
-        for ent in self._heap:
-            if not ent.alive or ent.priority <= priority:
+        for cls, heap in self._heaps.items():
+            if cls <= priority:
                 continue
-            if victim is None or (
-                    (ent.priority, ent.key, ent.seq)
-                    > (victim.priority, victim.key, victim.seq)):
-                victim = ent
+            for ent in heap:
+                if not ent.alive:
+                    continue
+                if victim is None or (
+                        (ent.priority, ent.key, ent.seq)
+                        > (victim.priority, victim.key, victim.seq)):
+                    victim = ent
         return victim
+
+    def _next_class_locked(self) -> Optional[int]:
+        """The class the next pop serves.  ``class_weights=None``: pure
+        cross-class EDF (the globally earliest deadline wins, FIFO on
+        ties).  With weights: stride scheduling — the non-empty class
+        with the smallest virtual-time pass wins, so every class drains
+        in proportion to its weight and none starves."""
+        best = None
+        best_rank = None
+        for cls, heap in self._heaps.items():
+            if not self._class_live.get(cls) or not heap:
+                continue
+            top = heap[0]
+            if self.class_weights is None:
+                rank = (top.key, top.seq)
+            else:
+                rank = (self._pass.get(cls, 0.0), cls)
+            if best_rank is None or rank < best_rank:
+                best, best_rank = cls, rank
+        return best
 
     # ------------------------------------------------------------------
     def pop_locked(self, now: Optional[float] = None
                    ) -> Tuple[Optional[object], List]:
-        """Pop the earliest-deadline live request (None when empty) and
-        the expired entries swept on the way.  Records the popped
-        request's queue wait into the AIMD controller.  Caller holds
-        ``cv`` and fails the expired list outside the lock."""
+        """Pop the next live request (None when empty) and the expired
+        entries swept on the way: earliest deadline within the class the
+        fair-share scheduler picked (see ``_next_class_locked``).
+        Records the popped request's queue wait into the AIMD
+        controller.  Caller holds ``cv`` and fails the expired list
+        outside the lock."""
         expired: List = []
         now = time.monotonic() if now is None else now
         # hot-path: begin admission_pop (heap pop + AIMD arithmetic
         # under the queue CV; no sleeps, no device syncs)
         self._sweep_locked(now, expired)
-        if not self._heap:
+        cls = self._next_class_locked()
+        if cls is None:
             return None, expired
-        ent = heapq.heappop(self._heap)
+        ent = heapq.heappop(self._heaps[cls])
         ent.alive = False
         self._live -= 1
+        self._class_live[cls] -= 1
+        if self.class_weights is not None:
+            cur = self._pass.get(cls, 0.0)
+            self._global_pass = cur
+            self._pass[cls] = cur + 1.0 / self.class_weights.get(cls, 1.0)
         submit_t = getattr(ent.req, "submit_t", None)
         if submit_t is not None:
             self._observe_locked(
@@ -287,11 +367,18 @@ class AdmissionQueue:
     # ------------------------------------------------------------------
     def drain_locked(self) -> List:
         """Pop and return every live queued request (shutdown).  Caller
-        holds ``cv``."""
-        out = [e.req for e in self._heap if e.alive]
-        self._heap = []
+        holds ``cv``.  Drained in strict priority order (HIGH first,
+        EDF/FIFO within each class) — NOT the weighted stride order
+        dispatch follows; shutdown fails everything anyway, so only a
+        stable, explainable order matters here."""
+        out = []
+        for heap in self._heaps.values():
+            out.extend(e for e in heap if e.alive)
+        out.sort(key=lambda e: (e.priority, e.key, e.seq))
+        self._heaps = {}
+        self._class_live = {}
         self._live = 0
-        return out
+        return [e.req for e in out]
 
     def close(self) -> None:
         """Retire this queue's gauge series from the exposition."""

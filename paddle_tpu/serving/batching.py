@@ -128,12 +128,13 @@ class DynamicBatcher:
     def __init__(self, max_batch_size: int, batch_timeout_ms: float,
                  queue_capacity: int, name: str = "server",
                  target_wait_ms: float = 50.0, min_limit: int = 4,
-                 adaptive: bool = True):
+                 adaptive: bool = True, class_weights="default"):
         self.max_batch_size = int(max_batch_size)
         self.batch_timeout_s = float(batch_timeout_ms) / 1e3
         self.queue = AdmissionQueue(
             queue_capacity, target_wait_ms=target_wait_ms,
-            min_limit=min_limit, name=name, adaptive=adaptive)
+            min_limit=min_limit, name=name, adaptive=adaptive,
+            class_weights=class_weights)
         self._cv = self.queue.cv  # one lock: queue state + wakeups
         self._carry: Optional[ServingRequest] = None  # worker-thread only
         self.eager = False

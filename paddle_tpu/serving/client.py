@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -25,7 +26,11 @@ from paddle_tpu import monitor
 from paddle_tpu.monitor import flight as _flight
 from paddle_tpu.monitor import spans as _spans
 from paddle_tpu.serving.admission import PRIORITY_NORMAL
-from paddle_tpu.serving.errors import DeadlineExceeded
+from paddle_tpu.serving.errors import (
+    DeadlineExceeded,
+    ServerClosed,
+    ServingError,
+)
 
 __all__ = ["Client"]
 
@@ -99,6 +104,81 @@ class Client:
             tid, dur,
             "deadline" if isinstance(err, DeadlineExceeded) else "ok",
             [span])
+
+    def infer_stream(self, feed, timeout_ms: Optional[float] = None,
+                     trace_id: Optional[str] = None,
+                     priority: int = PRIORITY_NORMAL,
+                     max_new_tokens: Optional[int] = None):
+        """Submit one decode prompt and iterate generated-token chunks
+        (1-D int32 arrays) as the continuous-batching scheduler produces
+        them — the first chunk arrives as soon as the request's first
+        multi-step tick completes, long before the sequence finishes.
+
+        Only a streaming endpoint (``serving.decode.DecodeServer``)
+        supports this; a request-batching ``InferenceServer`` raises
+        ``ServingError`` immediately.  Admission errors (shed, expired,
+        closed) raise AT THIS CALL, not at first iteration; mid-stream
+        failures re-raise typed from the iterator.  Abandoning the
+        iterator aborts the decode so its slot frees for queued work.
+        Every chunk belongs to one trace id (``last_trace_id``)."""
+        if not getattr(self._server, "supports_streaming", False):
+            raise ServingError(
+                "endpoint does not stream (not a decode server); use "
+                "infer() or serve the model with serving.decode")
+        tid = trace_id or monitor.new_trace_id()
+        self.last_trace_id = tid
+        sid = _spans.new_span_id() if _spans.recording() else None
+        kw = {}
+        if max_new_tokens is not None:
+            kw["max_new_tokens"] = int(max_new_tokens)
+        with _spans.trace_context((tid,)):
+            req = self._server.submit(
+                feed, timeout_ms=timeout_ms, trace_id=tid,
+                parent_span=sid, priority=priority, **kw)
+        gen = self._stream_chunks(req, tid, sid)
+        # a generator abandoned BEFORE its first next() never enters its
+        # body, so _stream_chunks' finally can't abort the decode and
+        # the slot would keep generating for a gone caller — a GC
+        # finalizer covers that window (req.fail is a no-op once done,
+        # so a normally-finished stream makes this inert)
+        weakref.finalize(gen, Client._abort_unstarted, req)
+        return gen
+
+    @staticmethod
+    def _abort_unstarted(req):
+        if not req.done():
+            req.fail(ServerClosed("stream consumer went away"))
+
+    @staticmethod
+    def _stream_chunks(req, tid: str, sid: Optional[str]):
+        t0 = time.perf_counter()
+        err: Optional[BaseException] = None
+        chunks = 0
+        try:
+            for chunk in req.stream():
+                chunks += 1
+                yield chunk
+        except GeneratorExit:
+            raise  # abandoned: neutral, not a stream failure
+        except BaseException as e:  # noqa: BLE001 — observed, re-raised
+            err = e
+            raise
+        finally:
+            if not req.done():
+                if err is not None:
+                    # a client-side typed failure (e.g. stream()'s own
+                    # DeadlineExceeded) is the request's terminal error
+                    req.fail(err)
+                else:
+                    # consumer walked away mid-stream: abort the decode
+                    # so the slot frees for queued work at the next tick
+                    req.fail(ServerClosed("stream consumer went away"))
+            if sid is not None:
+                with _spans.trace_context((tid,)):
+                    _spans.record_span(
+                        "serving/client_stream", t0,
+                        time.perf_counter() - t0, cat="client",
+                        span_id=sid, chunks=chunks, error=err is not None)
 
     def infer_named(self, feed, timeout_ms: Optional[float] = None,
                     trace_id: Optional[str] = None,

@@ -1,0 +1,293 @@
+"""Bucketed KV-cache slot pool for continuous-batching decode.
+
+The serving batcher's zero-recompile story (``BucketPolicy``: pad every
+batch onto a closed ladder of sizes, ``warmup()`` pre-compiles each
+rung) extends here to AUTOREGRESSIVE state: a decode step's executable
+is shaped by (slot count, cache length), so the pool quantizes both
+onto ladders — ``slot_ladder`` rungs over batch slots x ``len_ladder``
+rungs over sequence length — and AOT-compiles the two pure functions
+the scheduler dispatches (``decoding.make_slot_decode_fns``: the
+multi-step ``chunk`` and the seat-one-request ``admit``/``release``)
+for every rung pair at :meth:`warmup`.  After warmup, a mixed
+prompt/decode storm runs entirely on warmed executables — the pool's
+:meth:`jit_cache_stats` is the recompile ground truth the serving
+``/statusz`` reports, exactly like ``AnalysisPredictor`` on the
+request-batching path.
+
+The pool state is one dict pytree (slot axis 0 on every leaf; the KV
+cache's T axis read by the step fn).  Buffer donation applies to the
+state argument on every executable — the multi-MB KV cache updates in
+place in device memory instead of being copied per tick — with the same
+CPU carve-out as the executor (``executor._donate_kwargs``: donation +
+the persistent compile cache corrupts fetches on the CPU backend).
+
+Rung transitions (a storm outgrowing its slot rung, a long prompt
+outgrowing the length rung) are CONTROL-PLANE operations: the state is
+materialized host-side, zero-padded into the next rung's shapes with
+plain numpy, and handed back to the (already warmed) larger
+executables.  No XLA compile, no new shape — a transition costs one
+d2h/h2d round trip, amortized over the thousands of decode steps that
+follow.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu.serving.bucketing import BucketPolicy
+
+__all__ = ["KVSlotPool", "default_len_ladder"]
+
+
+def default_len_ladder(max_seq_len: int, start: int = 8) -> List[int]:
+    """Powers of two from ``start`` up to ``max_seq_len`` (appended when
+    not itself a power of two) — the length-axis analog of the batch
+    bucket ladder."""
+    if max_seq_len < 1:
+        raise ValueError("max_seq_len must be >= 1, got %r" % max_seq_len)
+    ladder = []
+    b = min(start, max_seq_len)
+    while b < max_seq_len:
+        ladder.append(b)
+        b *= 2
+    ladder.append(max_seq_len)
+    return sorted(set(ladder))
+
+
+class KVSlotPool:
+    """Warmed executables + state plumbing for one decode endpoint.
+
+    ``step_fn``/``make_cache``: the slot-pooled step builder's outputs
+    (``decoding.make_transformer_lm_pooled_step_fn`` — per-row positions,
+    cache T axis read from the cache itself, so ONE step fn serves every
+    rung pair).  ``steps``: tokens advanced per ``chunk`` dispatch (the
+    ``fori_loop`` multi-step amortization between scheduler
+    interventions).
+
+    ``on_recompile``: called (once per compile) when an executable is
+    built AFTER :meth:`warmup` — the serving layer counts it as a
+    recompile, the guarantee violation.
+    """
+
+    def __init__(self, step_fn: Callable, make_cache: Callable, *,
+                 eos_id: int, max_slots: int, max_seq_len: int,
+                 slot_ladder: Optional[Sequence[int]] = None,
+                 len_ladder: Optional[Sequence[int]] = None,
+                 steps: int = 4,
+                 on_recompile: Optional[Callable[[], None]] = None):
+        from paddle_tpu.decoding import make_slot_decode_fns
+
+        self._make_cache = make_cache
+        self.eos_id = int(eos_id)
+        self.steps = max(1, int(steps))
+        self.slot_policy = BucketPolicy(max_slots, slot_ladder)
+        self.len_policy = BucketPolicy(
+            max_seq_len, len_ladder or default_len_ladder(max_seq_len))
+        self._fns = make_slot_decode_fns(step_fn, self.eos_id, self.steps)
+        self._chunk_fn, self._admit_fn, self._release_fn = self._fns
+        self._jitted = None  # built lazily (first compile / warmup)
+        self._exe: Dict[Tuple[str, int, int], object] = {}
+        self._lock = threading.Lock()
+        self._hits = 0
+        self._misses = 0
+        self.warmed = False
+        self._on_recompile = on_recompile
+
+    # ------------------------------------------------------------------
+    @property
+    def max_slots(self) -> int:
+        return self.slot_policy.max_batch_size
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.len_policy.max_batch_size
+
+    def rung_pairs(self) -> List[Tuple[int, int]]:
+        return [(s, t) for s in self.slot_policy.ladder
+                for t in self.len_policy.ladder]
+
+    # ------------------------------------------------------------------
+    def _jit(self):
+        """The jitted (not yet shape-specialized) fns, built once.  The
+        state argument is DONATED so the KV cache updates in place —
+        except on CPU, where donation + the persistent compile cache is
+        known-unsafe (executor._donate_kwargs pins the policy)."""
+        if self._jitted is None:
+            import jax
+
+            from paddle_tpu.executor import _donate_kwargs
+
+            kw = _donate_kwargs(jax.devices()[0])
+            self._jitted = {
+                "chunk": jax.jit(self._chunk_fn, **kw),
+                "admit": jax.jit(self._admit_fn, **kw),
+                "release": jax.jit(self._release_fn, **kw),
+            }
+        return self._jitted
+
+    def _state_spec(self, s: int, t: int):
+        """Abstract (ShapeDtypeStruct) pool state for rung pair
+        ``(s, t)`` — shapes without allocating a byte (``jax.eval_shape``
+        traces ``make_cache`` instead of running it)."""
+        import jax
+
+        cache = jax.eval_shape(lambda: self._make_cache(s, t))
+        i32 = np.dtype(np.int32)
+        return {
+            "cache": cache,
+            "tokens": jax.ShapeDtypeStruct((s, t), i32),
+            "pos": jax.ShapeDtypeStruct((s,), i32),
+            "prompt_len": jax.ShapeDtypeStruct((s,), i32),
+            "total_len": jax.ShapeDtypeStruct((s,), i32),
+            "active": jax.ShapeDtypeStruct((s,), np.dtype(bool)),
+            "finished": jax.ShapeDtypeStruct((s,), np.dtype(bool)),
+            "n_gen": jax.ShapeDtypeStruct((s,), i32),
+        }
+
+    def alloc(self, s: int, t: int) -> Dict[str, object]:
+        """A fresh zeroed pool state for rung pair ``(s, t)``, HOST-side
+        (plain numpy): device memory is first touched by the executable
+        that consumes it, and an idle pool that dropped its state holds
+        no HBM at all."""
+        import jax
+
+        return jax.tree.map(
+            lambda sd: np.zeros(sd.shape, sd.dtype), self._state_spec(s, t))
+
+    def resize(self, state, new_s: int, new_t: int) -> Dict[str, object]:
+        """Re-shape ``state`` into rung pair ``(new_s, new_t)``
+        host-side: every leaf is materialized (d2h), copied into a
+        zero-padded (or sliced) buffer of the target rung's shape, and
+        returned as numpy for the next executable call (h2d).  A pure
+        control-plane move — no XLA compile is ever involved, so the
+        zero-recompile guarantee survives rung transitions.  Shrinking
+        assumes the caller vacated the dropped tail slots."""
+        import jax
+
+        spec = self._state_spec(new_s, new_t)
+
+        def one(arr, sd):
+            src = np.asarray(arr)
+            if src.shape == sd.shape:
+                return src
+            out = np.zeros(sd.shape, sd.dtype)
+            sl = tuple(slice(0, min(a, b))
+                       for a, b in zip(src.shape, sd.shape))
+            out[sl] = src[sl]
+            return out
+
+        return jax.tree.map(one, state, spec)
+
+    @staticmethod
+    def state_rungs(state) -> Tuple[int, int]:
+        """The (slot, length) rung pair a state currently occupies."""
+        s, t = state["tokens"].shape
+        return int(s), int(t)
+
+    # ------------------------------------------------------------------
+    def _get_exe(self, kind: str, s: int, t: int):
+        key = (kind, s, t)
+        with self._lock:
+            exe = self._exe.get(key)
+            if exe is not None:
+                self._hits += 1
+                return exe
+        exe = self._compile(kind, s, t)
+        with self._lock:
+            self._exe[key] = exe
+            self._misses += 1
+            if self.warmed and self._on_recompile is not None:
+                self._on_recompile()
+        return exe
+
+    def _compile(self, kind: str, s: int, t: int):
+        import jax
+
+        spec = self._state_spec(s, t)
+        jitted = self._jit()[kind]
+        if kind == "chunk":
+            return jitted.lower(spec).compile()
+        i32 = np.dtype(np.int32)
+        mask = jax.ShapeDtypeStruct((s,), np.dtype(bool))
+        if kind == "release":
+            return jitted.lower(spec, mask).compile()
+        prompt = jax.ShapeDtypeStruct((t,), i32)
+        scalar = jax.ShapeDtypeStruct((), i32)
+        return jitted.lower(spec, mask, prompt, scalar, scalar).compile()
+
+    # ------------------------------------------------------------------
+    def warmup(self) -> int:
+        """AOT-compile chunk + admit + release for EVERY rung pair;
+        returns the number of compiles performed (0 on a re-warm).
+        After this, a storm that stays inside the ladders never builds
+        an executable again — :meth:`jit_cache_stats` ``misses`` is the
+        proof the serving layer asserts on."""
+        compiles = 0
+        for s, t in self.rung_pairs():
+            for kind in ("chunk", "admit", "release"):
+                key = (kind, s, t)
+                with self._lock:
+                    have = key in self._exe
+                if have:
+                    continue
+                exe = self._compile(kind, s, t)
+                with self._lock:
+                    self._exe[key] = exe
+                compiles += 1
+        self.warmed = True
+        return compiles
+
+    def jit_cache_stats(self) -> Dict[str, int]:
+        """The recompile ground truth (same contract as
+        ``AnalysisPredictor.jit_cache_stats``): ``misses`` counts built
+        executables, ``hits`` runs served by an existing one."""
+        with self._lock:
+            return {"entries": len(self._exe), "hits": self._hits,
+                    "misses": self._misses}
+
+    # ------------------------------------------------------------------
+    # dispatch (the scheduler's hot path: one dict lookup + one call)
+    # ------------------------------------------------------------------
+    def chunk(self, state) -> Dict[str, object]:
+        """Advance every active slot by up to ``steps`` tokens in ONE
+        device dispatch (prefill and decode interleaved inside)."""
+        s, t = self.state_rungs(state)
+        # hot-path: begin kv_chunk (executable lookup + async dispatch;
+        # the scheduler materializes results OUTSIDE this region)
+        exe = self._get_exe("chunk", s, t)
+        out = exe(state)
+        # hot-path: end kv_chunk
+        return out
+
+    def admit(self, state, slot: int, prompt: np.ndarray,
+              prompt_len: int, total_len: int) -> Dict[str, object]:
+        """Seat one request into free slot ``slot``: the prompt is
+        padded host-side to the state's length rung and the slot's
+        flags/cursors reset in ONE device dispatch (the cache passes
+        through untouched — write-before-read makes zeroing a reused
+        slot unnecessary)."""
+        s, t = self.state_rungs(state)
+        mask = np.zeros((s,), bool)
+        mask[slot] = True
+        buf = np.zeros((t,), np.int32)
+        n = min(len(prompt), t)
+        buf[:n] = np.asarray(prompt[:n], np.int32)
+        # hot-path: begin kv_admit (executable lookup + async dispatch)
+        exe = self._get_exe("admit", s, t)
+        out = exe(state, mask, buf,
+                  np.asarray(prompt_len, np.int32),  # hot-ok: host scalar
+                  np.asarray(total_len, np.int32))  # hot-ok: host scalar
+        # hot-path: end kv_admit
+        return out
+
+    def release(self, state, slots: Sequence[int]) -> Dict[str, object]:
+        """Deactivate ``slots`` mid-flight (expired deadline, abort):
+        their lanes stop advancing and become seatable again."""
+        s, t = self.state_rungs(state)
+        mask = np.zeros((s,), bool)
+        for i in slots:
+            mask[i] = True
+        exe = self._get_exe("release", s, t)
+        return exe(state, mask)

@@ -149,6 +149,7 @@ class InferenceServer:
         readmit_cooldown_s: Optional[float] = None,
         target_queue_wait_ms: float = 50.0,
         brownout_hold_s: float = 0.25,
+        class_weights="default",
     ):
         self.name = name
         # circuit-breaker re-admission for failure-retired replicas: a
@@ -171,7 +172,8 @@ class InferenceServer:
         self._policy = BucketPolicy(max_batch_size, bucket_ladder)
         self._batcher = DynamicBatcher(
             max_batch_size, batch_timeout_ms, queue_capacity, name=name,
-            target_wait_ms=target_queue_wait_ms)
+            target_wait_ms=target_queue_wait_ms,
+            class_weights=class_weights)
         self._metrics = ServingMetrics(name)
         # queue-level drops (priority eviction / offer-time sweep) route
         # through the server's accounting, not the batcher's defaults

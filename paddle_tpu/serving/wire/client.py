@@ -1,8 +1,8 @@
 """RemoteClient: the in-process ``Client`` surface over a wire hop.
 
 Keeps the transport-agnostic contract the serving layer promised: the
-same ``infer`` / ``infer_named`` / ``infer_many`` signatures (plus the
-``infer_stream`` seam, reserved for the streaming-decode roadmap item),
+same ``infer`` / ``infer_named`` / ``infer_many`` / ``infer_stream``
+signatures (streaming rides chunked codec messages on one response),
 the same typed errors (``ServerOverloaded`` / ``DeadlineExceeded`` /
 ``ServerClosed`` re-raised from the response's in-band error channel,
 ``BackendUnavailable`` / ``WireProtocolError`` for transport/framing
@@ -29,7 +29,7 @@ from paddle_tpu.serving.wire.codec import format_traceparent
 from paddle_tpu.serving.wire.http import HttpTransport, Transport
 
 __all__ = ["RemoteClient", "raise_in_band_error", "wire_call",
-           "flight_report"]
+           "wire_stream_open", "flight_report"]
 
 # the response meta "error" field names a type from serving.errors (or
 # the validation builtin); an unknown name degrades to the base
@@ -148,6 +148,59 @@ def wire_call(transport: Transport, feed_names: Sequence[str],
                 span_id=sid, error=err is not None,
                 backend="%s:%d" % transport.address)
     # hot-path: end wire_dispatch
+
+
+def wire_stream_open(transport: Transport, feed_names: Sequence[str],
+                     arrays: Sequence[np.ndarray],
+                     timeout_ms: Optional[float], tid: str,
+                     extra_meta: Optional[Dict[str, object]] = None,
+                     priority: Optional[int] = None):
+    """Open one ``/infer_stream`` exchange and read its FIRST message
+    (shared by ``RemoteClient`` and the fleet balancer): a pre-stream
+    failure — unreachable backend, admission shed, expired deadline —
+    surfaces typed AT THIS CALL, before the caller commits to the
+    stream, which is what lets the fleet requeue to a survivor.
+    Returns ``(iterator, first_message)``; subsequent messages come off
+    the iterator, each either a token chunk or the ``final`` meta (a
+    mid-stream error travels in-band on the final message)."""
+    meta: Dict[str, object] = {"feed_names": list(feed_names)}
+    if timeout_ms is not None:
+        meta["timeout_ms"] = float(timeout_ms)
+    if priority is not None:
+        meta["priority"] = int(priority)
+    if extra_meta:
+        meta.update(extra_meta)
+    timeout_s = (
+        float(timeout_ms) / 1e3 if timeout_ms is not None else None)
+    headers = {"traceparent": format_traceparent(tid, _spans.new_span_id())}
+    it = transport.stream("/infer_stream", meta, arrays,
+                          timeout_s=timeout_s, headers=headers)
+    first = next(iter(it), None)
+    if first is None:
+        raise _errors.WireProtocolError(
+            "stream closed before the first message")
+    raise_in_band_error(first[0])
+    return it, first
+
+
+def pump_stream_messages(it, first, counter: List[int]):
+    """The one client/fleet stream-consumption protocol: yield token
+    chunks off a wire message iterator (``yield from`` this), re-raising
+    in-band typed errors, and RETURN the ``final`` meta message.
+    ``counter``: one-element list incremented per chunk, so the caller's
+    accounting survives an abandoned (closed mid-yield) generator."""
+    rmeta, rarrays = first
+    while True:
+        raise_in_band_error(rmeta)
+        if rmeta.get("final"):
+            return rmeta
+        counter[0] += 1
+        yield rarrays[0]
+        nxt = next(it, None)
+        if nxt is None:
+            raise _errors.WireProtocolError(
+                "stream ended without a final message")
+        rmeta, rarrays = nxt
 
 
 class RemoteClient:
@@ -314,14 +367,67 @@ class RemoteClient:
         return [f.result() for f in futures]
 
     def infer_stream(self, feed, timeout_ms: Optional[float] = None,
-                     trace_id: Optional[str] = None):
-        """Reserved seam for token streaming (continuous batching /
-        autoregressive decode, ROADMAP item 2): the wire framing already
-        supports multi-frame bodies, so a streaming response is a codec
-        mode, not a protocol break."""
-        raise NotImplementedError(
-            "infer_stream lands with continuous batching (ROADMAP #2); "
-            "the wire codec's framing is stream-ready")
+                     trace_id: Optional[str] = None,
+                     priority: int = PRIORITY_NORMAL,
+                     max_new_tokens: Optional[int] = None):
+        """Stream generated-token chunks from a remote decode endpoint
+        (``serving.decode.DecodeServer`` behind a ``ServingProcess``):
+        each yielded 1-D int32 array is one chunk, received over the
+        wire as its own codec message on the chunked response body —
+        the first arrives as soon as the server's scheduler completes
+        the request's first tick, long before the sequence finishes.
+
+        Pre-stream failures (unreachable backend, admission shed,
+        expired deadline, a non-streaming endpoint) raise typed AT THIS
+        CALL; a mid-stream failure re-raises typed from the iterator.
+        Every chunk carries the one trace id (``last_trace_id``); the
+        final message's meta lands in ``last_stream_final`` (chunk
+        count, output names, the server's load report).  Abandoning the
+        iterator drops the pooled connection — and the server, seeing
+        the peer gone, aborts the decode so its slot frees."""
+        tid = trace_id or monitor.new_trace_id()
+        self.last_trace_id = tid
+        deadline = (
+            time.monotonic() + float(timeout_ms) / 1e3
+            if timeout_ms is not None else None)
+        names, arrays = self._normalize(feed)
+        remaining_ms = self._remaining_ms(deadline)
+        extra = {}
+        if max_new_tokens is not None:
+            extra["max_new_tokens"] = int(max_new_tokens)
+        it, first = wire_stream_open(
+            self._transport, names, arrays, remaining_ms, tid,
+            extra_meta=extra, priority=priority)
+        return self._stream_chunks(it, first, tid)
+
+    def _stream_chunks(self, it, first, tid: str):
+        t0 = time.perf_counter()
+        sid = _spans.new_span_id() if _spans.recording() else None
+        err: Optional[BaseException] = None
+        counter = [0]
+        try:
+            self.last_stream_final = yield from pump_stream_messages(
+                it, first, counter)
+            return
+        except GeneratorExit:
+            raise  # abandoned: neutral, not a stream failure
+        except BaseException as e:  # noqa: BLE001 — observed, re-raised
+            err = e
+            raise
+        finally:
+            # abandoning mid-stream closes the transport iterator, which
+            # drops the (desynced) pooled connection
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
+            if sid is not None:
+                with _spans.trace_context((tid,)):
+                    _spans.record_span(
+                        "serving/client_stream", t0,
+                        time.perf_counter() - t0, cat="client",
+                        span_id=sid, chunks=counter[0],
+                        error=err is not None,
+                        backend="%s:%d" % self._transport.address)
 
     def close(self) -> None:
         with self._shape_lock:

@@ -23,7 +23,7 @@ back-pressure to callers instead of amplifying its own retries into a
 metastable collapse.
 
 Client surface: the same ``infer`` / ``infer_named`` / ``infer_many``
-(+ ``infer_stream`` seam) contract as ``Client``/``RemoteClient``, so
+/ ``infer_stream`` contract as ``Client``/``RemoteClient``, so
 the balancer drops in wherever a single endpoint handle did.  Fleet
 accounting reuses ``ServingMetrics`` — the balancer IS a server-shaped
 thing: ``serving_requests_total``/``serving_requeued_total``/the
@@ -41,6 +41,7 @@ from __future__ import annotations
 import random
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -63,7 +64,12 @@ from paddle_tpu.serving.errors import (
 from paddle_tpu.serving.metrics import ServingMetrics
 from paddle_tpu.serving.wire import launch as _launch
 from paddle_tpu.serving.wire.client import flight_report as _flight_report
-from paddle_tpu.serving.wire.client import wire_call
+from paddle_tpu.serving.wire.client import (
+    pump_stream_messages,
+    raise_in_band_error,
+    wire_call,
+    wire_stream_open,
+)
 from paddle_tpu.serving.wire.http import HttpTransport
 from paddle_tpu.serving.wire.metrics import (
     RETRY_THROTTLED,
@@ -694,9 +700,182 @@ class FleetBalancer:
             return self._pool
 
     def infer_stream(self, feed, timeout_ms: Optional[float] = None,
-                     trace_id: Optional[str] = None):
-        raise NotImplementedError(
-            "infer_stream lands with continuous batching (ROADMAP #2)")
+                     trace_id: Optional[str] = None,
+                     priority: Optional[int] = None,
+                     max_new_tokens: Optional[int] = None):
+        """Stream generated-token chunks through the fleet: the request
+        routes like ``infer`` (least loaded, retry pacing, requeue), and
+        a failure BEFORE the first message — unreachable backend, shed,
+        shutdown answer — requeues to a survivor with the same throttle
+        and backoff discipline, so opening a stream is as fault-tolerant
+        as a unary call.  Once the first message arrives the stream is
+        COMMITTED to its backend: generated tokens were already handed
+        to the caller, so a mid-stream death re-raises typed
+        (``BackendUnavailable``) instead of silently replaying the
+        sequence on a survivor — the caller decides whether to resubmit.
+        Every chunk carries one trace id (``last_trace_id``); the final
+        meta lands in ``last_stream_final``."""
+        tid = trace_id or monitor.new_trace_id()
+        self.last_trace_id = tid
+        names, arrays = self._normalize(feed)
+        deadline = (
+            time.monotonic() + float(timeout_ms) / 1e3
+            if timeout_ms is not None else None)
+        self._metrics.count("requests")
+        extra = {}
+        if max_new_tokens is not None:
+            extra["max_new_tokens"] = int(max_new_tokens)
+        budget = self._retry_policy.budget(
+            deadline=deadline, op="fleet.requeue")
+        exclude: Optional[_Backend] = None
+        while True:
+            be = self._acquire(exclude, deadline)
+            remaining_ms = timeout_ms
+            if deadline is not None:
+                remaining_ms = (deadline - time.monotonic()) * 1e3
+                if remaining_ms <= 0:
+                    self._release(be, ok=False)
+                    self._metrics.count("expired")
+                    raise DeadlineExceeded(
+                        "deadline passed before the wire exchange")
+            try:
+                if _faults.active is not None:  # disarmed: one is-None gate
+                    _faults.active.faultpoint(
+                        "fleet.dispatch", backend=be.name,
+                        pid=be.handle.pid if be.handle is not None else None)
+                it, first = wire_stream_open(
+                    be.transport, names, arrays, remaining_ms, tid,
+                    extra_meta=extra, priority=priority)
+            except _RETRYABLE:
+                # nothing streamed yet: the exact unary requeue
+                # discipline applies (stateless until the first chunk)
+                self._release(be, ok=False)
+                self._record_failure(be)
+                if deadline is not None and time.monotonic() >= deadline:
+                    self._metrics.count("expired")
+                    raise DeadlineExceeded(
+                        "deadline passed at requeue after backend failure")
+                if not self._throttle.try_acquire():
+                    self._throttled_counter.inc()
+                    self._metrics.count("failed")
+                    raise
+                if not budget.backoff():
+                    self._metrics.count("failed")
+                    raise
+                self._count_requeue(be)
+                exclude = be
+                continue
+            except ServerOverloaded as e:
+                self._release(be, ok=True)
+                self._update_load(be, getattr(e, "load", None))
+                hint_ms = getattr(e, "retry_after_ms", None)
+                if hint_ms:
+                    with self._route_cv:
+                        be.not_before = max(
+                            be.not_before,
+                            time.monotonic() + float(hint_ms) / 1e3)
+                self._metrics.count("shed")
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise
+                if not self._throttle.try_acquire():
+                    self._throttled_counter.inc()
+                    raise
+                if not budget.backoff():
+                    raise
+                exclude = be
+                continue
+            except _errors.ServingError as e:
+                self._release(be, ok=True)
+                self._update_load(be, getattr(e, "load", None))
+                self._metrics.count(
+                    "expired" if isinstance(e, DeadlineExceeded)
+                    else "failed")
+                raise
+            except BaseException:
+                self._release(be, ok=False)
+                self._record_failure(be)
+                self._metrics.count("failed")
+                raise
+            return self._make_stream(be, it, first, tid)
+
+    def _make_stream(self, be: _Backend, it, first, tid: str):
+        # a generator abandoned BEFORE its first next() never enters its
+        # body, so _stream_chunks' finally can't run and the backend's
+        # in-flight slot would leak forever — a GC finalizer covers that
+        # window.  ``settled`` makes release one-shot; the finalizer and
+        # the generator body can't race (the finalizer only fires once
+        # the generator is unreachable, i.e. not executing).
+        settled = [False]
+
+        def _abandoned():
+            if settled[0]:
+                return
+            settled[0] = True
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
+            self._release(be, ok=False)  # neutral: not a backend failure
+
+        gen = self._stream_chunks(be, it, first, tid, settled)
+        weakref.finalize(gen, _abandoned)
+        return gen
+
+    def _stream_chunks(self, be: _Backend, it, first, tid: str,
+                       settled: List[bool]):
+        t_submit = time.perf_counter()
+        sid = _spans.new_span_id() if _spans.recording() else None
+        err: Optional[BaseException] = None
+        clean = False
+        counter = [0]
+        try:
+            rmeta = yield from pump_stream_messages(it, first, counter)
+            self.last_stream_final = rmeta
+            self._update_load(be, rmeta.get("load"))
+            self._metrics.observe_request(
+                time.perf_counter() - t_submit, trace_id=tid)
+            clean = True
+            return
+        except GeneratorExit:
+            raise  # abandoned: neutral, not a request failure
+        except BaseException as e:  # noqa: BLE001 — observed, re-raised
+            err = e
+            raise
+        finally:
+            if not settled[0]:
+                settled[0] = True
+                close = getattr(it, "close", None)
+                if close is not None:
+                    close()
+                if clean:
+                    self._release(be, ok=True)
+                elif err is None:
+                    # abandoned: neutral — the slot frees, the backend's
+                    # failure streak does not move
+                    self._release(be, ok=False)
+                elif (isinstance(err, _errors.ServingError)
+                        and not isinstance(err, _RETRYABLE)):
+                    # an in-band typed answer (deadline, overload...):
+                    # the backend SERVED it — same accounting as the
+                    # unary path (release ok, expired vs failed split)
+                    self._release(be, ok=True)
+                    self._update_load(be, getattr(err, "load", None))
+                    self._metrics.count(
+                        "expired" if isinstance(err, DeadlineExceeded)
+                        else "failed")
+                else:
+                    # transport death / protocol break mid-stream
+                    self._release(be, ok=False)
+                    if isinstance(err, _RETRYABLE):
+                        self._record_failure(be)
+                    self._metrics.count("failed")
+            if sid is not None:
+                with _spans.trace_context((tid,)):
+                    _spans.record_span(
+                        "serving/client_stream", t_submit,
+                        time.perf_counter() - t_submit, cat="client",
+                        span_id=sid, chunks=counter[0],
+                        error=err is not None, fleet=self.name,
+                        backend=be.name)
 
     # ------------------------------------------------------------------
     # health checking + rolling replacement

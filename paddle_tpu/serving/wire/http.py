@@ -61,6 +61,20 @@ class Transport(abc.ABC):
         """POST one message, return the response message.  ``timeout_s``
         bounds the whole exchange."""
 
+    def stream(self, path: str, meta: Dict[str, object],
+               arrays: Sequence[np.ndarray] = (),
+               timeout_s: Optional[float] = None,
+               headers: Optional[Dict[str, str]] = None):
+        """POST one message, iterate RESPONSE messages as the peer
+        produces them (the streaming-decode path: each yielded
+        ``(meta, arrays)`` is one codec message read incrementally off
+        the response body; the message carrying ``meta['final']`` ends
+        the stream).  Optional: a transport that cannot stream raises —
+        callers degrade to :meth:`request`."""
+        raise NotImplementedError(
+            "%s does not support streaming responses"
+            % type(self).__name__)
+
     @abc.abstractmethod
     def get_json(self, path: str,
                  timeout_s: Optional[float] = None) -> Dict[str, object]:
@@ -74,6 +88,23 @@ class Transport(abc.ABC):
     @abc.abstractmethod
     def address(self) -> Tuple[str, int]:
         """The remote ``(host, port)`` this transport targets."""
+
+
+class _CountingReader:
+    """File-like over an HTTPResponse that feeds the received-bytes
+    counter as the codec pulls frames off the stream."""
+
+    __slots__ = ("_resp", "_counter")
+
+    def __init__(self, resp, counter):
+        self._resp = resp
+        self._counter = counter
+
+    def read(self, n: int) -> bytes:
+        data = self._resp.read(n)
+        if data:
+            self._counter.inc(len(data))
+        return data
 
 
 class HttpTransport(Transport):
@@ -168,6 +199,91 @@ class HttpTransport(Transport):
             payload, max_frame_bytes=self._max_frame_bytes)
         # hot-path: end wire_request
         return rmeta, rarrays
+
+    def stream(self, path: str, meta: Dict[str, object],
+               arrays: Sequence[np.ndarray] = (),
+               timeout_s: Optional[float] = None,
+               headers: Optional[Dict[str, str]] = None):
+        """POST, then yield ``(meta, arrays)`` response messages as the
+        server produces them (chunked transfer; ``http.client`` decodes
+        the chunk framing transparently, the codec reads message by
+        message).  The message carrying ``meta['final']`` ends the
+        stream; an abandoned or failed stream DROPS the pooled
+        connection — a half-read response body can never desync the
+        next request on this thread's socket."""
+        body = codec.encode_message(meta, arrays)
+        hdrs = {"Content-Type": CONTENT_TYPE}
+        if headers:
+            hdrs.update(headers)
+        if _faults.active is not None:  # disarmed: one is-None gate
+            act = _faults.active.faultpoint(
+                "wire.send", backend="%s:%d" % self.address)
+            if act is not None:
+                body = act.corrupt(body)
+        conn = self._conn(timeout_s)
+        try:
+            conn.request("POST", path, body=body, headers=hdrs)
+            resp = conn.getresponse()
+        except socket.timeout as e:
+            self._drop_conn()
+            raise DeadlineExceeded(
+                "wire stream to %s:%d timed out" % self.address) from e
+        except (ConnectionError, http.client.HTTPException, OSError) as e:
+            self._drop_conn()
+            raise BackendUnavailable(
+                "backend %s:%d unreachable: %r" % (self._host, self._port, e)
+            ) from e
+        _REQS.inc()
+        _SENT.inc(len(body))
+        return self._stream_messages(resp, conn)
+
+    def _stream_messages(self, resp, conn):
+        """Generator reading codec messages off one response body.  The
+        connection stays pooled only after a CLEAN finish (final message
+        seen, body drained); every other exit path drops it."""
+        clean = False
+        try:
+            while True:
+                try:
+                    rmeta, rarrays = codec.read_message(
+                        _CountingReader(resp, _RECV),
+                        max_frame_bytes=self._max_frame_bytes)
+                except socket.timeout as e:
+                    raise DeadlineExceeded(
+                        "wire stream from %s:%d timed out"
+                        % self.address) from e
+                except (ConnectionError, http.client.HTTPException,
+                        OSError) as e:
+                    raise BackendUnavailable(
+                        "backend %s:%d died mid-stream: %r"
+                        % (self._host, self._port, e)) from e
+                final = bool(rmeta.get("final"))
+                if final:
+                    # drain + mark clean BEFORE yielding: consumers stop
+                    # at the final message without advancing the
+                    # generator again, so post-yield code would only run
+                    # under GeneratorExit and every stream would drop
+                    # its pooled connection
+                    resp.read()  # drain the terminator for keep-alive
+                    clean = True
+                yield rmeta, rarrays
+                if final:
+                    return
+        finally:
+            if not clean:
+                # this generator may be close()d from ANY thread (the
+                # fleet's abandoned-stream GC finalizer) — _drop_conn
+                # only clears the CALLING thread's pool slot, so close
+                # the very connection the stream was reading; the
+                # owning thread's pooled handle then auto-reopens on
+                # its next request instead of reusing a half-read
+                # socket
+                if getattr(self._tls, "conn", None) is conn:
+                    self._tls.conn = None
+                try:
+                    conn.close()
+                except Exception:
+                    pass
 
     def get_json(self, path: str,
                  timeout_s: Optional[float] = None) -> Dict[str, object]:

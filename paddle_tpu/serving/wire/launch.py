@@ -316,21 +316,41 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
 
     from paddle_tpu import monitor
-    from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
-    from paddle_tpu.serving.server import InferenceServer
     from paddle_tpu.serving.wire.server import ServingProcess
 
-    predictors = [
-        create_paddle_predictor(AnalysisConfig(args.model_dir))
-        for _ in range(max(1, args.replicas))
-    ]
-    server = InferenceServer(
-        predictors,
-        max_batch_size=args.max_batch_size,
-        batch_timeout_ms=args.batch_timeout_ms,
-        queue_capacity=args.queue_capacity,
-        name=args.name,
-    )
+    # the endpoint-kind marker is checked WITHOUT importing
+    # serving.decode (is_decode_endpoint is just this exists()):
+    # non-decode children keep the package's lazy-import policy — no
+    # decode metric families registered in processes that never stream
+    if os.path.exists(os.path.join(args.model_dir, "decode.json")):
+        # a decode endpoint dir (decode.json + weights) hosts the
+        # continuous-batching scheduler instead of a request batcher;
+        # slot/steps config comes from the saved endpoint
+        from paddle_tpu.serving.decode import load_decode_endpoint
+
+        server = load_decode_endpoint(
+            args.model_dir,
+            queue_capacity=args.queue_capacity,
+            name=args.name,
+        )
+    else:
+        from paddle_tpu.inference import (
+            AnalysisConfig,
+            create_paddle_predictor,
+        )
+        from paddle_tpu.serving.server import InferenceServer
+
+        predictors = [
+            create_paddle_predictor(AnalysisConfig(args.model_dir))
+            for _ in range(max(1, args.replicas))
+        ]
+        server = InferenceServer(
+            predictors,
+            max_batch_size=args.max_batch_size,
+            batch_timeout_ms=args.batch_timeout_ms,
+            queue_capacity=args.queue_capacity,
+            name=args.name,
+        )
     if args.flight_slow_ms is not None:
         monitor.flight_recorder(slow_ms=args.flight_slow_ms)
     if args.warmup:

@@ -202,6 +202,8 @@ class ServingProcess:
                 path = self.path.split("?", 1)[0]
                 if path == "/infer":
                     self._do_infer()
+                elif path == "/infer_stream":
+                    self._do_infer_stream()
                 elif path == "/warmup":
                     self._drain_body()
                     try:
@@ -220,50 +222,142 @@ class ServingProcess:
                 else:
                     self.send_error(404, "unknown path")
 
+            def _decode_infer_body(self):
+                meta, arrays = codec.decode_message(
+                    self._read_body(),
+                    max_frame_bytes=sp._max_frame_bytes)
+                feed_names = meta.get("feed_names")
+                if (not isinstance(feed_names, list)
+                        or len(feed_names) != len(arrays)):
+                    raise WireProtocolError(
+                        "feed_names/arrays mismatch: %r names, %d arrays"
+                        % (feed_names, len(arrays)))
+                return meta, dict(zip(feed_names, arrays))
+
+            def _send_error_message(self, e: BaseException) -> None:
+                """The one-message typed-error response (shared by
+                /infer and a pre-stream /infer_stream failure)."""
+                emeta = {"error": type(e).__name__, "message": str(e),
+                         "load": sp._load_meta()}
+                headers = None
+                retry_ms = getattr(e, "retry_after_ms", None)
+                if retry_ms is not None:
+                    # the in-band channel carries the exact hint; the
+                    # HTTP Retry-After header (whole seconds, ceil'd
+                    # to stay >= the hint) is for generic tooling
+                    emeta["retry_after_ms"] = float(retry_ms)
+                    headers = {"Retry-After":
+                               str(int(-(-float(retry_ms) // 1000)))}
+                emeta["final"] = True  # a stream reader ends here too
+                try:
+                    self._send_message(
+                        emeta, status=error_status(e),
+                        extra_headers=headers)
+                except Exception:
+                    pass  # peer already gone; nothing to report to
+
             def _do_infer(self):
                 _REQS.inc()
                 try:
-                    meta, arrays = codec.decode_message(
-                        self._read_body(),
-                        max_frame_bytes=sp._max_frame_bytes)
-                    feed_names = meta.get("feed_names")
-                    if (not isinstance(feed_names, list)
-                            or len(feed_names) != len(arrays)):
-                        raise WireProtocolError(
-                            "feed_names/arrays mismatch: %r names, %d arrays"
-                            % (feed_names, len(arrays)))
-                    feed = dict(zip(feed_names, arrays))
-                    timeout_ms = meta.get("timeout_ms")
-                    priority = meta.get("priority")
+                    meta, feed = self._decode_infer_body()
                     rmeta, routs = sp._infer(
-                        feed, timeout_ms,
+                        feed, meta.get("timeout_ms"),
                         traceparent=self.headers.get("traceparent"),
                         want_spans=self.headers.get("X-Wire-Spans") == "1",
-                        priority=priority)
+                        priority=meta.get("priority"))
                 except BaseException as e:  # noqa: BLE001 — typed to the peer
-                    emeta = {"error": type(e).__name__, "message": str(e),
-                             "load": sp._load_meta()}
-                    headers = None
-                    retry_ms = getattr(e, "retry_after_ms", None)
-                    if retry_ms is not None:
-                        # the in-band channel carries the exact hint; the
-                        # HTTP Retry-After header (whole seconds, ceil'd
-                        # to stay >= the hint) is for generic tooling
-                        emeta["retry_after_ms"] = float(retry_ms)
-                        headers = {"Retry-After":
-                                   str(int(-(-float(retry_ms) // 1000)))}
-                    try:
-                        self._send_message(
-                            emeta, status=error_status(e),
-                            extra_headers=headers)
-                    except Exception:
-                        pass  # peer already gone; nothing to report to
+                    self._send_error_message(e)
                     return
                 self._send_message(rmeta, routs)
 
+            # -- streaming (continuous-batching decode endpoints) --------
+            def _write_chunk(self, payload: bytes) -> None:
+                self.wfile.write(b"%x\r\n" % len(payload))
+                self.wfile.write(payload)
+                self.wfile.write(b"\r\n")
+
+            def _do_infer_stream(self):
+                """One decode request, answered as a CHUNKED stream of
+                codec messages: one message per token chunk as the
+                scheduler produces it (meta carries the trace id + a
+                chunk sequence number), then one ``final`` message
+                (completion, or the typed mid-stream error).  A
+                pre-stream failure answers exactly like ``/infer`` —
+                one typed-error message the stream reader also
+                understands (``final`` set)."""
+                _REQS.inc()
+                try:
+                    meta, feed = self._decode_infer_body()
+                    req, tid = sp._submit_stream(
+                        feed, meta,
+                        traceparent=self.headers.get("traceparent"))
+                except BaseException as e:  # noqa: BLE001 — typed to the peer
+                    self._send_error_message(e)
+                    return
+                # headers commit here: everything after — including a
+                # mid-stream failure — travels inside the chunked body
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                t0 = time.perf_counter()
+                seq = 0
+                err: Optional[BaseException] = None
+                try:
+                    try:
+                        for tokens in req.stream():
+                            payload = codec.encode_message(
+                                {"trace_id": tid, "seq": seq}, (tokens,))
+                            self._write_chunk(payload)
+                            _SENT.inc(len(payload))
+                            seq += 1
+                    except BaseException as e:  # noqa: BLE001 — in-band
+                        err = e
+                    fmeta: Dict[str, object] = {
+                        "final": True, "trace_id": tid, "chunks": seq,
+                        "output_names":
+                            list(sp.server._predictor.get_output_names()),
+                        "load": sp._load_meta()}
+                    if err is not None:
+                        fmeta["error"] = type(err).__name__
+                        fmeta["message"] = str(err)
+                        retry_ms = getattr(err, "retry_after_ms", None)
+                        if retry_ms is not None:
+                            fmeta["retry_after_ms"] = float(retry_ms)
+                    payload = codec.encode_message(fmeta)
+                    self._write_chunk(payload)
+                    _SENT.inc(len(payload))
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    # the peer hung up mid-stream: abandon the decode so
+                    # its slot frees for queued work, and drop the (now
+                    # desynced) connection
+                    req.fail(ServerClosed("stream consumer went away"))
+                    self.close_connection = True
+                finally:
+                    with _spans.trace_context((tid,)):
+                        _spans.record_span(
+                            "wire/server_stream", t0,
+                            time.perf_counter() - t0, cat="wire",
+                            chunks=seq, error=err is not None,
+                            server=sp.server.name)
+
+        class _QuietServer(ThreadingHTTPServer):
+            def handle_error(self, request, client_address):
+                # a peer dropping its pooled connection (reset between
+                # keep-alive requests, an abandoned stream) is a normal
+                # event, not a server error worth a stderr traceback
+                import sys
+
+                etype = sys.exc_info()[0]
+                if etype is not None and issubclass(
+                        etype, (ConnectionError, BrokenPipeError)):
+                    return
+                super().handle_error(request, client_address)
+
         with self._lock:
             if self._httpd is None:
-                self._httpd = ThreadingHTTPServer(
+                self._httpd = _QuietServer(
                     (self._host, self._port), _WireHandler)
             return self._httpd
 
@@ -285,6 +379,7 @@ class ServingProcess:
             "admit_limit": m.get("admit_limit"),
             "brownout_level": m.get("brownout_level"),
             "max_batch_size": srv.max_batch_size,
+            "streaming": bool(getattr(srv, "supports_streaming", False)),
             "input_names": list(srv._feed_names),
             "output_names": list(srv._predictor.get_output_names()),
         }
@@ -362,6 +457,31 @@ class ServingProcess:
             fr.add_span(tid, wire_span)  # local /tracez completeness
             meta["spans"] = list(spans) + [wire_span]
         return meta, outs
+
+    def _submit_stream(self, feed, meta, traceparent: Optional[str]):
+        """Bridge one wire stream request into the decode server:
+        install the remote trace context and submit WITHOUT waiting —
+        the handler streams the request's chunks as the scheduler
+        produces them.  Returns ``(request, trace_id)``; every chunk
+        message carries that one id, so the stream is a single trace
+        end to end."""
+        srv = self.server
+        if not getattr(srv, "supports_streaming", False):
+            raise ServingError(
+                "endpoint %r does not stream (not a decode server)"
+                % srv.name)
+        parsed = codec.parse_traceparent(traceparent)
+        tid = parsed[0] if parsed else monitor.new_trace_id()
+        kw = {}
+        if meta.get("priority") is not None:
+            kw["priority"] = int(meta["priority"])
+        if meta.get("max_new_tokens") is not None:
+            kw["max_new_tokens"] = int(meta["max_new_tokens"])
+        with _spans.trace_context((tid,)):
+            req = srv.submit(
+                feed, timeout_ms=meta.get("timeout_ms"), trace_id=tid,
+                **kw)
+        return req, tid
 
     def _load_meta(self) -> Dict[str, object]:
         """The per-response load report (queue depth + adaptive admit

@@ -57,6 +57,7 @@ def main():
     ap.add_argument("--ckpt-every", type=int, default=5)
     ap.add_argument("--step-delay", type=float, default=0.0)
     ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--async-ckpt", action="store_true")
     args = ap.parse_args()
 
     prog, startup, loss = build_model()
@@ -73,6 +74,7 @@ def main():
             checkpoint_dir=args.run_dir,
             checkpoint_every=args.ckpt_every,
             resume_from=args.run_dir if args.resume else None,
+            checkpoint_async=args.async_ckpt,
         )
         if args.resume:
             print("RESUMED_FROM %s" % exe.last_resume_step, flush=True)

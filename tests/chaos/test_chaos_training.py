@@ -377,3 +377,96 @@ def test_sigkill_then_resume_loss_continuity(tmp_path):
         np.testing.assert_allclose(
             resumed[step], killed[step], rtol=1e-4,
             err_msg="divergence at resumed step %d" % step)
+
+
+def _spawn_async_child(run_dir, steps, step_delay, resume=False,
+                       commit_delay=None):
+    argv = [sys.executable, "-u",
+            os.path.join(REPO_ROOT, "tests", "chaos", "_train_child.py"),
+            "--run-dir", run_dir, "--steps", str(steps),
+            "--ckpt-every", "5", "--step-delay", str(step_delay),
+            "--async-ckpt"]
+    if resume:
+        argv.append("--resume")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PADDLE_TPU_FAULTS", None)
+    if commit_delay is not None:
+        # stretch the BACKGROUND commit window so the kill below lands
+        # while a save is staged but not yet renamed into place
+        env["PADDLE_TPU_FAULTS"] = (
+            "checkpoint.commit=delay:%g,after=1" % commit_delay)
+    prev = env.get("PYTHONPATH")
+    env["PYTHONPATH"] = REPO_ROOT + (os.pathsep + prev if prev else "")
+    return subprocess.Popen(argv, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, env=env)
+
+
+def test_sigkill_during_background_save_resumes_from_last_complete(
+        tmp_path):
+    """The async-checkpoint chaos drill: the child's FIRST save commits
+    normally, its second background save is stretched by an injected
+    ``checkpoint.commit`` delay, and a SIGKILL lands while that save is
+    staged (tmp dir on disk) but uncommitted.  Resume must come up from
+    the last COMPLETE checkpoint — step 5 — with loss continuity, and
+    the half-written attempt must be cleaned, not trusted."""
+    run_dir = str(tmp_path / "run")
+    proc = _spawn_async_child(run_dir, steps=400, step_delay=0.05,
+                              commit_delay=30.0)
+    lines, err_lines = [], []
+
+    def _collect(stream, sink):
+        try:
+            for line in stream:
+                sink.append(line)
+        except Exception:
+            pass
+
+    threading.Thread(target=_collect, args=(proc.stdout, lines),
+                     daemon=True).start()
+    threading.Thread(target=_collect, args=(proc.stderr, err_lines),
+                     daemon=True).start()
+    try:
+        deadline = time.monotonic() + 120
+        latest = os.path.join(run_dir, "LATEST")
+        # first commit (step 5) goes through (the delay arms after=1)
+        while not os.path.exists(latest):
+            assert proc.poll() is None, (
+                "child died before its first checkpoint:\n"
+                + "".join(lines) + "".join(err_lines))
+            assert time.monotonic() < deadline, "no checkpoint within 120s"
+            time.sleep(0.05)
+        # the second save stages .tmp-ckpt-000010 and stalls in the
+        # injected commit delay — the kill window
+        while not any(d.startswith(".tmp-") for d in os.listdir(run_dir)):
+            assert proc.poll() is None, (
+                "child died before staging its background save:\n"
+                + "".join(lines) + "".join(err_lines))
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGKILL)
+        assert proc.wait(timeout=30) == -9
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    killed = _parse_losses(lines)
+    with open(latest) as f:
+        committed = int(f.read().strip().rsplit("-", 1)[1])
+    assert committed == 5  # the stalled step-10 save never committed
+    assert any(d.startswith(".tmp-") for d in os.listdir(run_dir))
+
+    res = _spawn_async_child(run_dir, steps=committed + 6,
+                             step_delay=0.0, resume=True)
+    out, err = res.communicate(timeout=180)
+    assert res.returncode == 0, err
+    assert ("RESUMED_FROM %d" % committed) in out
+    resumed = _parse_losses(out.splitlines())
+    assert min(resumed) == committed  # nothing before the cursor re-ran
+    overlap = sorted(set(killed) & set(resumed))
+    assert overlap
+    for step in overlap:
+        np.testing.assert_allclose(
+            resumed[step], killed[step], rtol=1e-4,
+            err_msg="divergence at resumed step %d" % step)
+    # the resumed run's own step-10 checkpoint replaced the stale tmp
+    assert not any(d.startswith(".tmp-") for d in os.listdir(run_dir))

@@ -151,6 +151,112 @@ def test_equal_priority_arrival_is_shed_not_queued_work():
 
 
 # ---------------------------------------------------------------------------
+# weighted fair sharing across priority classes
+# ---------------------------------------------------------------------------
+def _fill_three_classes(q, n_per_class=14):
+    for i in range(n_per_class):
+        for prio, tag in ((PRIORITY_HIGH, "high"), (PRIORITY_NORMAL, "norm"),
+                          (PRIORITY_LOW, "low")):
+            admitted, _, shed, _ = q.offer(Req(priority=prio,
+                                               tag="%s-%d" % (tag, i)))
+            assert admitted and not shed
+
+
+def test_weighted_shares_under_three_way_saturation():
+    """Default 4:2:1 stride scheduling: out of every 7 pops under
+    steady three-way saturation, HIGH gets 4, NORMAL 2, LOW 1 — a
+    deterministic trickle instead of the starvation pure priority
+    ordering produces."""
+    q = AdmissionQueue(64, name="wfs", adaptive=False)
+    _fill_three_classes(q)
+    first14 = [_pop(q)[0].tag.split("-")[0] for _ in range(14)]
+    counts = {c: first14.count(c) for c in ("high", "norm", "low")}
+    assert counts == {"high": 8, "norm": 4, "low": 2}
+    # LOW's trickle starts inside the first stride window, not after
+    # the other classes drain
+    assert "low" in set(first14[:7])
+    q.close()
+
+
+def test_weighted_share_preserves_edf_within_class():
+    q = AdmissionQueue(16, name="wfs-edf", adaptive=False)
+    now = time.monotonic()
+    q.offer(Req(deadline=now + 30, priority=PRIORITY_LOW, tag="low-late"))
+    q.offer(Req(deadline=now + 10, priority=PRIORITY_LOW, tag="low-soon"))
+    q.offer(Req(deadline=now + 50, priority=PRIORITY_HIGH, tag="high-a"))
+    popped = [_pop(q)[0].tag for _ in range(3)]
+    # whatever the cross-class interleave, LOW drains soonest-first
+    assert popped.index("low-soon") < popped.index("low-late")
+    q.close()
+
+
+def test_class_weights_none_restores_pure_edf():
+    """``class_weights=None`` disables sharing: pops follow the global
+    deadline order regardless of class."""
+    q = AdmissionQueue(16, name="wfs-off", adaptive=False,
+                       class_weights=None)
+    now = time.monotonic()
+    q.offer(Req(deadline=now + 30, priority=PRIORITY_HIGH, tag="high-30"))
+    q.offer(Req(deadline=now + 10, priority=PRIORITY_LOW, tag="low-10"))
+    q.offer(Req(deadline=now + 20, priority=PRIORITY_NORMAL, tag="norm-20"))
+    assert [_pop(q)[0].tag for _ in range(3)] == [
+        "low-10", "norm-20", "high-30"]
+    q.close()
+
+
+def test_idle_class_cannot_bank_credit():
+    """A class waking from empty joins at the CURRENT virtual time: a
+    long-idle LOW must not monopolize the queue to 'catch up'."""
+    q = AdmissionQueue(64, name="wfs-bank", adaptive=False)
+    # drain a long HIGH-only phase (advances HIGH's pass well past 0)
+    for i in range(12):
+        q.offer(Req(priority=PRIORITY_HIGH, tag="h%d" % i))
+    for _ in range(12):
+        _pop(q)
+    # LOW wakes now; under mixed load it still gets only its 1-in-5
+    # share vs HIGH (4:_:1), never a burst of back-credit
+    for i in range(10):
+        q.offer(Req(priority=PRIORITY_HIGH, tag="high-%d" % i))
+        q.offer(Req(priority=PRIORITY_LOW, tag="low-%d" % i))
+    first5 = [_pop(q)[0].tag.split("-")[0] for _ in range(5)]
+    assert first5.count("low") == 1
+    q.close()
+
+
+def test_custom_and_invalid_class_weights():
+    q = AdmissionQueue(16, name="wfs-custom", adaptive=False,
+                       class_weights={PRIORITY_HIGH: 1.0,
+                                      PRIORITY_LOW: 1.0})
+    for i in range(4):
+        q.offer(Req(priority=PRIORITY_HIGH, tag="high-%d" % i))
+        q.offer(Req(priority=PRIORITY_LOW, tag="low-%d" % i))
+    first4 = [_pop(q)[0].tag.split("-")[0] for _ in range(4)]
+    # equal weights: strict alternation between the two classes
+    assert first4.count("high") == 2 and first4.count("low") == 2
+    q.close()
+    with pytest.raises(ValueError):
+        AdmissionQueue(16, name="wfs-bad",
+                       class_weights={PRIORITY_HIGH: 0.0})
+
+
+def test_weighted_share_flows_through_batcher_pops():
+    """The batcher pops through the same stride scheduler, so a
+    saturated server's batches carry the LOW trickle."""
+    b = DynamicBatcher(1, 0.0, 64, name="wfs-batcher")
+    for i in range(7):
+        for prio in (PRIORITY_HIGH, PRIORITY_NORMAL, PRIORITY_LOW):
+            b.offer(ServingRequest({"x": _rows(1)}, 1, None, priority=prio))
+    stop = threading.Event()
+    popped = []
+    for _ in range(7):
+        batch = b.next_batch(stop, lambda r: None, block=False)
+        popped.extend(r.priority for r in batch)
+    assert popped.count(PRIORITY_LOW) == 1
+    assert popped.count(PRIORITY_HIGH) == 4
+    b.close()
+
+
+# ---------------------------------------------------------------------------
 # AIMD admit limit
 # ---------------------------------------------------------------------------
 def test_aimd_halves_on_overshoot_and_regrows_additively():
@@ -300,14 +406,18 @@ def test_server_sheds_low_priority_for_high_under_pressure():
                           name="prioserver")
     try:
         # saturate the dispatch pipeline (dispatcher holds batches while
-        # the replica's bounded in-flight is full), THEN fill the queue
-        pipelined = [srv.submit({"x": _rows(1)}, priority=PRIORITY_LOW)
-                     for _ in range(3)]
-        # wait until the dispatcher actually absorbed them (a fixed
-        # sleep flakes under CPU contention): queue empty again
-        wait_until = time.monotonic() + 5.0
-        while srv._batcher.qsize() > 0 and time.monotonic() < wait_until:
-            time.sleep(0.01)
+        # the replica's bounded in-flight is full), waiting for the
+        # dispatcher to absorb EACH submit — a burst can overflow the
+        # 2-slot queue itself when the dispatcher thread is starved
+        # under CPU contention — THEN fill the queue
+        pipelined = []
+        for _ in range(3):
+            pipelined.append(
+                srv.submit({"x": _rows(1)}, priority=PRIORITY_LOW))
+            wait_until = time.monotonic() + 5.0
+            while (srv._batcher.qsize() > 0
+                   and time.monotonic() < wait_until):
+                time.sleep(0.01)
         assert srv._batcher.qsize() == 0
         queued = [srv.submit({"x": _rows(1)}, priority=PRIORITY_LOW)
                   for _ in range(2)]  # fills the 2-slot queue

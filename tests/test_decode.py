@@ -1,0 +1,680 @@
+"""Continuous-batching decode tests (serving/decode.py +
+serving/kv_pool.py + the infer_stream client surfaces).
+
+Two model tiers keep this fast: a deterministic "chain" step fn (next
+token = previous + 1 mod V; no cache math) exercises the SCHEDULER —
+admission, slot reuse, EOS/cap termination, TTFT, ticks accounting,
+streaming — with near-zero compile cost, while a small real
+transformer-LM (random weights) proves NUMERIC parity of the slot-pool
+path against the scalar cached step fn, and backs the 2-child wire
+fleet acceptance run.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import monitor
+from paddle_tpu.decoding import (
+    make_transformer_lm_pooled_step_fn,
+    make_transformer_lm_step_fn,
+)
+from paddle_tpu.serving.client import Client
+from paddle_tpu.serving.decode import (
+    DecodeRequest,
+    DecodeServer,
+    save_decode_endpoint,
+)
+from paddle_tpu.serving.errors import (
+    DeadlineExceeded,
+    ServerClosed,
+    ServerOverloaded,
+    ServingError,
+)
+from paddle_tpu.serving.kv_pool import KVSlotPool, default_len_ladder
+
+EOS = 9
+V = 23
+
+
+# ---------------------------------------------------------------------------
+# model builders
+# ---------------------------------------------------------------------------
+def chain_model():
+    """next token = (consumed token + 1) % V; cache is a dummy leaf.
+    From prompt [..., p] the generated chain is p+1, p+2, ... — EOS is
+    reached exactly when the chain passes 9, so termination and token
+    values are checkable by arithmetic."""
+    import jax
+    import jax.numpy as jnp
+
+    def step_fn(cache, tokens, ts):
+        logits = jax.nn.one_hot((tokens + 1) % V, V) * 10.0
+        return logits, cache
+
+    def make_cache(n_rows, seq_len):
+        return {"z": jnp.zeros((n_rows, seq_len), "float32")}
+
+    return step_fn, make_cache
+
+
+def slow_chain_model(work=320):
+    """The chain model with ~5ms of dense matmul per step (the burn
+    rides the cache so XLA cannot fold it): decode takes human-scale
+    time, giving the mid-decode timing tests real room."""
+    import jax
+    import jax.numpy as jnp
+
+    def step_fn(cache, tokens, ts):
+        w = cache["w"]
+        burn = (w @ w).sum() * 1e-30
+        logits = jax.nn.one_hot((tokens + 1) % V, V) * 10.0 + burn
+        return logits, cache
+
+    def make_cache(n_rows, seq_len):
+        return {"z": jnp.zeros((n_rows, seq_len), "float32"),
+                "w": jnp.zeros((work, work), "float32")}
+
+    return step_fn, make_cache
+
+
+@pytest.fixture(scope="module")
+def slow_server():
+    step_fn, make_cache = slow_chain_model()
+    srv = DecodeServer(step_fn, make_cache, eos_id=EOS, max_seq_len=64,
+                       max_slots=4, len_ladder=[64], steps_per_tick=1,
+                       name="slowchain")
+    srv.warmup(configure_cache=False)
+    yield srv
+    srv.stop(drain=False)
+
+
+def expected_chain(prompt, total_len):
+    """The chain model's generated tokens for ``prompt`` under length
+    cap ``total_len`` (prompt + generated), EOS included."""
+    out = []
+    cur = prompt[-1]
+    for _ in range(total_len - len(prompt)):
+        cur = (cur + 1) % V
+        out.append(cur)
+        if cur == EOS:
+            break
+    return out
+
+
+from paddle_tpu.decoding import random_transformer_lm_state as lm_weights
+
+
+LM_DIMS = dict(vocab=V, d_model=16, n_layer=2, n_head=2, d_inner=32,
+               max_pos=32)
+
+
+@pytest.fixture(scope="module")
+def lm_state():
+    return lm_weights(np.random.RandomState(7), **LM_DIMS)
+
+
+@pytest.fixture(scope="module")
+def chain_server():
+    """One warmed chain-model server shared by the scheduler tests
+    (requests are independent; each test leaves it idle)."""
+    step_fn, make_cache = chain_model()
+    srv = DecodeServer(step_fn, make_cache, eos_id=EOS, max_seq_len=16,
+                       max_slots=4, steps_per_tick=2, name="chain")
+    srv.warmup(configure_cache=False)
+    yield srv
+    srv.stop(drain=False)
+
+
+def _ref_continuation(state, prompt, total_len):
+    """Greedy continuation via the SCALAR cached step fn — the
+    independent reference the slot-pool path must match exactly."""
+    import jax.numpy as jnp
+
+    step_fn, make_cache = make_transformer_lm_step_fn(
+        state, LM_DIMS["vocab"], LM_DIMS["d_model"], LM_DIMS["n_layer"],
+        LM_DIMS["n_head"], LM_DIMS["d_inner"], LM_DIMS["max_pos"])
+    cache = make_cache(1)
+    logits = None
+    for t, tok in enumerate(prompt):
+        logits, cache = step_fn(cache, jnp.asarray([tok], "int32"), t)
+    out = []
+    pos = len(prompt)
+    while pos < total_len:
+        nxt = int(np.argmax(np.asarray(logits[0])))
+        out.append(nxt)
+        if nxt == EOS:
+            break
+        logits, cache = step_fn(cache, jnp.asarray([nxt], "int32"), pos)
+        pos += 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# KVSlotPool units
+# ---------------------------------------------------------------------------
+def test_default_len_ladder_shape():
+    assert default_len_ladder(64) == [8, 16, 32, 64]
+    assert default_len_ladder(48) == [8, 16, 32, 48]
+    assert default_len_ladder(8) == [8]
+    assert default_len_ladder(6) == [6]
+    with pytest.raises(ValueError):
+        default_len_ladder(0)
+
+
+def test_pool_alloc_resize_and_rungs():
+    step_fn, make_cache = chain_model()
+    pool = KVSlotPool(step_fn, make_cache, eos_id=EOS, max_slots=4,
+                      max_seq_len=32, steps=2)
+    st = pool.alloc(2, 8)
+    assert pool.state_rungs(st) == (2, 8)
+    assert st["tokens"].shape == (2, 8) and st["tokens"].dtype == np.int32
+    st["tokens"][:] = np.arange(16).reshape(2, 8)
+    st["pos"][:] = [3, 5]
+    up = pool.resize(st, 4, 16)
+    assert pool.state_rungs(up) == (4, 16)
+    # old content zero-padded into the larger rungs
+    np.testing.assert_array_equal(up["tokens"][:2, :8],
+                                  np.arange(16).reshape(2, 8))
+    assert up["tokens"][2:].sum() == 0 and up["tokens"][:2, 8:].sum() == 0
+    np.testing.assert_array_equal(up["pos"][:2], [3, 5])
+    down = pool.resize(up, 2, 8)
+    np.testing.assert_array_equal(down["tokens"], st["tokens"])
+
+
+def test_pool_warmup_covers_every_rung_pair_then_zero_misses():
+    step_fn, make_cache = chain_model()
+    pool = KVSlotPool(step_fn, make_cache, eos_id=EOS, max_slots=4,
+                      max_seq_len=16, steps=2)
+    n = pool.warmup()
+    assert n == len(pool.rung_pairs()) * 3  # chunk + admit + release
+    assert pool.warmup() == 0  # re-warm is free
+    recompiles = []
+    pool._on_recompile = lambda: recompiles.append(1)
+    # dispatch at every rung pair: all warmed, no compile
+    for s, t in pool.rung_pairs():
+        st = pool.alloc(s, t)
+        st = pool.admit(st, 0, np.array([2, 3], np.int32), 2, t)
+        st = pool.chunk(st)
+        st = pool.release(st, [0])
+    stats = pool.jit_cache_stats()
+    assert stats["misses"] == 0 and not recompiles
+    assert stats["hits"] >= len(pool.rung_pairs()) * 3
+
+
+# ---------------------------------------------------------------------------
+# scheduler semantics (chain model)
+# ---------------------------------------------------------------------------
+def test_generation_eos_and_cap_termination(chain_server):
+    # EOS mid-stream: prompt ends at 5 -> 6, 7, 8, 9(EOS)
+    req = chain_server.submit({"tokens": np.array([4, 5], np.int32)})
+    assert req.result()[0].tolist() == [6, 7, 8, 9]
+    # cap termination: chain from 10 never hits EOS before the cap
+    req = chain_server.submit({"tokens": np.array([10], np.int32)},
+                              max_new_tokens=5)
+    assert req.result()[0].tolist() == [11, 12, 13, 14, 15]
+    # 2-D [1, L] and positional feeds accepted
+    req = chain_server.submit({"tokens": np.array([[4, 5]], np.int32)})
+    assert req.result()[0].tolist() == [6, 7, 8, 9]
+    assert chain_server.submit(
+        [np.array([5], np.int32)]).result()[0].tolist() == [6, 7, 8, 9]
+
+
+def test_submit_validation(chain_server):
+    with pytest.raises(ValueError):
+        chain_server.submit({"tokens": np.zeros((2, 3), np.int32)})
+    with pytest.raises(ValueError):
+        chain_server.submit({"tokens": np.array([], np.int32)})
+    with pytest.raises(ValueError):  # prompt leaves no room to generate
+        chain_server.submit({"tokens": np.arange(16, dtype=np.int32)})
+    with pytest.raises(ValueError):
+        chain_server.submit({"wrong": np.array([1], np.int32)})
+    with pytest.raises(ValueError):  # a 0 cap must not generate a token
+        chain_server.submit({"tokens": np.array([2], np.int32)},
+                            max_new_tokens=0)
+    with pytest.raises(DeadlineExceeded):
+        chain_server.submit({"tokens": np.array([2], np.int32)},
+                            timeout_ms=0)
+
+
+def test_stream_yields_chunks_before_completion(slow_server):
+    """The streaming contract: the first chunk is in the consumer's
+    hands while the sequence is still decoding (~5ms/tick leaves ~95ms
+    of decode after tick 1)."""
+    req = slow_server.submit({"tokens": np.array([10], np.int32)},
+                             max_new_tokens=20)
+    it = req.stream()
+    first = next(it)
+    assert not req.done()  # tokens in hand, sequence still in flight
+    rest = [c for c in it]
+    got = [t for c in [first] + rest for t in c.tolist()]
+    assert got == expected_chain([10], 21)
+    assert len(rest) >= 1  # chunked, not one blob
+    assert req.result()[0].tolist() == got
+
+
+def test_mixed_storm_zero_recompiles_and_isolation(chain_server):
+    """A concurrent mixed prompt-length storm: every sequence exact,
+    zero executables built after warmup (the acceptance guarantee,
+    in-process edition)."""
+    misses0 = chain_server._pool.jit_cache_stats()["misses"]
+    results = {}
+    errs = []
+
+    def one(i):
+        plen = 1 + i % 4
+        start = 10 + (i % 7)
+        prompt = np.arange(start, start + plen, dtype=np.int32) % V
+        cap = 2 + i % 9
+        try:
+            if i % 2:
+                got = [t for c in Client(chain_server).infer_stream(
+                    {"tokens": prompt}, max_new_tokens=cap)
+                    for t in c.tolist()]
+            else:
+                got = chain_server.submit(
+                    {"tokens": prompt},
+                    max_new_tokens=cap).result()[0].tolist()
+            results[i] = (prompt.tolist(), cap, got)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(24)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert len(results) == 24
+    for prompt, cap, got in results.values():
+        total = min(len(prompt) + cap, chain_server.max_seq_len)
+        assert got == expected_chain(prompt, total)
+    assert chain_server._pool.jit_cache_stats()["misses"] == misses0
+    assert chain_server.metrics().get("recompiles", 0) == 0
+
+
+def test_continuous_batching_beats_request_at_a_time(chain_server):
+    """The scheduling win, measured in TICKS (each tick = one fixed-cost
+    device dispatch, the honest proxy for wall time on a host-bound
+    test): interleaved long/short traffic finishes in less than half
+    the ticks request-at-a-time grouping burns, because a group held
+    open by one long sequence wastes every freed slot."""
+    def workload():
+        reqs = []
+        for i in range(16):
+            if i % 4 == 0:
+                reqs.append((np.array([10], np.int32), 14))  # long
+            else:
+                reqs.append((np.array([12], np.int32), 2))   # short
+        return reqs
+
+    def ticks():
+        return chain_server.metrics()["decode"]["ticks"]
+
+    # request-at-a-time: admit in arrival-order groups of max_slots,
+    # wait the WHOLE group before admitting the next (what the
+    # request-batching server does to an autoregressive endpoint)
+    t0 = ticks()
+    for g in range(0, 16, chain_server.max_batch_size):
+        group = [chain_server.submit({"tokens": p}, max_new_tokens=c)
+                 for p, c in workload()[g:g + chain_server.max_batch_size]]
+        for r in group:
+            r.result()
+    rat_ticks = ticks() - t0
+
+    # continuous: submit everything; finished sequences free slots
+    # mid-flight and the queue refills them at the next tick
+    t0 = ticks()
+    reqs = [chain_server.submit({"tokens": p}, max_new_tokens=c)
+            for p, c in workload()]
+    outs = [r.result()[0].tolist() for r in reqs]
+    cont_ticks = ticks() - t0
+
+    for (p, c), got in zip(workload(), outs):
+        assert got == expected_chain(p.tolist(), len(p) + c)
+    assert rat_ticks >= 2 * cont_ticks, (rat_ticks, cont_ticks)
+
+
+def test_late_arrival_first_token_before_batch_finishes(slow_server):
+    """TTFT under continuous batching (the acceptance criterion): a
+    request arriving mid-decode reaches its first token BEFORE the
+    in-flight batch finishes — request-at-a-time would have parked it
+    behind the whole decode.  Asserted on the scheduler's own
+    ``first_token_t``/``done_t`` stamps, so the check is exact."""
+    longs = [slow_server.submit({"tokens": np.array([10], np.int32)},
+                                max_new_tokens=40) for _ in range(2)]
+    # wait until the long batch is genuinely mid-decode (~200ms total)
+    deadline = time.monotonic() + 10.0
+    while slow_server.metrics()["decode"]["slot_occupancy"] == 0.0:
+        assert time.monotonic() < deadline
+        time.sleep(0.002)
+    late = slow_server.submit({"tokens": np.array([4, 5], np.int32)})
+    first_chunk = next(late.stream())
+    assert first_chunk.tolist()[0] == 6
+    for r in longs:
+        assert r.result(timeout=30.0)[0].tolist() == expected_chain(
+            [10], 41)
+    # the late arrival's first token landed strictly before either
+    # in-flight sequence completed: TTFT < remaining batch decode time
+    assert late.first_token_t is not None
+    assert late.first_token_t < min(r.done_t for r in longs)
+
+
+def test_deadline_mid_decode_frees_slot(slow_server):
+    """A deadline passing mid-decode fails the request typed and frees
+    its slot for queued work.  The budget is a quarter of a MEASURED
+    full decode (not a wall-clock guess), so tick speed can't flake
+    the test either way."""
+    t0 = time.perf_counter()
+    slow_server.submit({"tokens": np.array([10], np.int32)},
+                       max_new_tokens=40).result(timeout=30.0)
+    full_ms = (time.perf_counter() - t0) * 1e3
+    req = slow_server.submit({"tokens": np.array([10], np.int32)},
+                             timeout_ms=full_ms / 4.0, max_new_tokens=40)
+    with pytest.raises(DeadlineExceeded):
+        req.result(timeout=30.0)
+    deadline = time.monotonic() + 10.0
+    while slow_server._active_count():
+        assert time.monotonic() < deadline
+        time.sleep(0.005)
+
+
+def test_abandoned_stream_frees_slot(slow_server):
+    it = Client(slow_server).infer_stream(
+        {"tokens": np.array([10], np.int32)}, max_new_tokens=40)
+    next(it)
+    it.close()
+    deadline = time.monotonic() + 10.0
+    while slow_server._active_count():
+        assert time.monotonic() < deadline
+        time.sleep(0.005)
+
+
+def test_abandoned_stream_never_started_frees_slot(slow_server):
+    """A generator dropped BEFORE its first next() never runs its body,
+    so only the GC finalizer can abort the decode — without it the slot
+    generates its full chain (~22 tokens to EOS) for a caller that is
+    gone.  The token delta is the discriminator: an aborted lane stops
+    within a tick or two."""
+    import gc
+
+    def gen_tokens():
+        return int(slow_server.metrics()["decode"]["generated_tokens"])
+
+    g0 = gen_tokens()
+    gen = Client(slow_server).infer_stream(
+        {"tokens": np.array([10], np.int32)}, max_new_tokens=40)
+    while not slow_server._active_count():
+        time.sleep(0.005)
+    del gen
+    gc.collect()
+    deadline = time.monotonic() + 10.0
+    while slow_server._active_count():
+        assert time.monotonic() < deadline
+        time.sleep(0.005)
+    assert gen_tokens() - g0 < 12  # aborted mid-flight, not decoded out
+
+
+def test_stream_on_non_decode_server_raises_typed():
+    class NotDecode:
+        _predictor = type("P", (), {
+            "get_output_names": lambda self: ["y"]})()
+
+    with pytest.raises(ServingError):
+        Client(NotDecode()).infer_stream({"tokens": [1]})
+
+
+def test_overload_shed_carries_retry_hint():
+    step_fn, make_cache = chain_model()
+    srv = DecodeServer(step_fn, make_cache, eos_id=EOS, max_seq_len=16,
+                       max_slots=1, slot_ladder=[1], len_ladder=[16],
+                       steps_per_tick=1, queue_capacity=2, name="tiny")
+    srv.warmup(configure_cache=False)
+    try:
+        reqs = []
+        with pytest.raises(ServerOverloaded) as ei:
+            for _ in range(12):
+                reqs.append(srv.submit(
+                    {"tokens": np.array([10], np.int32)},
+                    max_new_tokens=14))
+        assert ei.value.retry_after_ms >= 1.0
+        for r in reqs:  # admitted work still completes
+            r.result(timeout=30.0)
+    finally:
+        srv.stop(drain=False)
+
+
+def test_stop_drain_finishes_queued_and_abort_fails_typed():
+    step_fn, make_cache = chain_model()
+    srv = DecodeServer(step_fn, make_cache, eos_id=EOS, max_seq_len=16,
+                       max_slots=2, steps_per_tick=2, name="draining")
+    srv.warmup(configure_cache=False)
+    reqs = [srv.submit({"tokens": np.array([10 + i], np.int32)},
+                       max_new_tokens=4) for i in range(6)]
+    srv.stop(drain=True, timeout=30.0)
+    for i, r in enumerate(reqs):
+        assert r.result()[0].tolist() == expected_chain([10 + i], 5)
+    with pytest.raises(ServerClosed):
+        srv.submit({"tokens": np.array([2], np.int32)})
+
+    srv2 = DecodeServer(step_fn, make_cache, eos_id=EOS, max_seq_len=16,
+                        max_slots=2, steps_per_tick=2, name="aborting")
+    srv2.warmup(configure_cache=False)
+    reqs = [srv2.submit({"tokens": np.array([10], np.int32)},
+                        max_new_tokens=14) for _ in range(4)]
+    srv2.stop(drain=False, timeout=30.0)
+    for r in reqs:
+        with pytest.raises(ServerClosed):
+            r.result()
+
+
+def test_decode_metrics_series(chain_server):
+    req = chain_server.submit({"tokens": np.array([2, 3, 4], np.int32)},
+                              max_new_tokens=4)
+    req.result()
+    d = chain_server.metrics()["decode"]
+    assert d["generated_tokens"] > 0 and d["prefill_tokens"] > 0
+    assert d["ticks"] > 0
+    assert d["slot_ladder"] == [1, 2, 4] and d["len_ladder"] == [8, 16]
+    snap = monitor.snapshot()
+    for name in ("serving_decode_tokens_total",
+                 "serving_decode_prefill_tokens_total",
+                 "serving_decode_ticks_total",
+                 "serving_decode_ttft_seconds",
+                 "serving_decode_slot_occupancy"):
+        assert name in snap, name
+
+
+# ---------------------------------------------------------------------------
+# numeric parity: slot pool vs the scalar cached step fn
+# ---------------------------------------------------------------------------
+def test_pooled_matches_scalar_step_fn_mixed_prompts(lm_state):
+    """The whole slot-pool machinery — per-row positions, interleaved
+    prefill/decode, rung growth, slot reuse — must reproduce the
+    scalar cached path's greedy continuations exactly, for concurrent
+    prompts of different lengths."""
+    step_fn, make_cache = make_transformer_lm_pooled_step_fn(
+        lm_state, LM_DIMS["vocab"], LM_DIMS["d_model"], LM_DIMS["n_layer"],
+        LM_DIMS["n_head"], LM_DIMS["d_inner"])
+    srv = DecodeServer(step_fn, make_cache, eos_id=EOS, max_seq_len=32,
+                       max_slots=2, slot_ladder=[1, 2],
+                       len_ladder=[16, 32], steps_per_tick=3, name="lm")
+    srv.warmup(configure_cache=False)
+    try:
+        prompts = [[2, 3, 4], [5], [7, 8], [11, 12, 13, 14]]
+        caps = [10, 6, 12, 8]
+        reqs = [srv.submit({"tokens": np.array(p, np.int32)},
+                           max_new_tokens=c)
+                for p, c in zip(prompts, caps)]
+        outs = [r.result(timeout=60.0)[0].tolist() for r in reqs]
+        for p, c, got in zip(prompts, caps, outs):
+            assert got == _ref_continuation(lm_state, p, len(p) + c), p
+        assert srv._pool.jit_cache_stats()["misses"] == 0
+    finally:
+        srv.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# streaming over the wire
+# ---------------------------------------------------------------------------
+def test_wire_stream_loopback_chunks_and_one_trace_id(chain_server):
+    from paddle_tpu.serving.wire.client import RemoteClient
+    from paddle_tpu.serving.wire.codec import parse_traceparent
+    from paddle_tpu.serving.wire.server import ServingProcess
+
+    sp = ServingProcess(chain_server)
+    host, port = sp.start()
+    try:
+        rc = RemoteClient((host, port))
+        assert rc.healthz()["streaming"] is True
+        chunks = list(rc.infer_stream(
+            {"tokens": np.array([10], np.int32)}, max_new_tokens=12))
+        got = [t for c in chunks for t in c.tolist()]
+        assert got == expected_chain([10], 13)
+        assert len(chunks) >= 2  # incremental, not one blob
+        final = rc.last_stream_final
+        assert final["chunks"] == len(chunks)
+        # ONE trace id spans the whole stream: client mint == every
+        # chunk's meta == the final message
+        assert final["trace_id"] == rc.last_trace_id
+        # raw message-level check: every chunk meta carries the id
+        from paddle_tpu.serving.wire.client import wire_stream_open
+        tid = monitor.new_trace_id()
+        it, first = wire_stream_open(
+            rc._transport, ["tokens"], [np.array([10], np.int32)],
+            None, tid, extra_meta={"max_new_tokens": 6})
+        metas = [first[0]] + [m for m, _ in it]
+        assert all(m["trace_id"] == tid for m in metas)
+        assert metas[-1]["final"] and not any(
+            m.get("final") for m in metas[:-1])
+        # unary /infer works against the decode endpoint too
+        out, = rc.infer({"tokens": np.array([4, 5], np.int32)})
+        assert out.tolist() == [6, 7, 8, 9]
+        rc.close()
+    finally:
+        sp.stop()
+
+
+def test_wire_stream_deadline_is_typed_end_to_end(chain_server):
+    from paddle_tpu.serving.wire.client import RemoteClient
+    from paddle_tpu.serving.wire.server import ServingProcess
+
+    sp = ServingProcess(chain_server)
+    host, port = sp.start()
+    try:
+        rc = RemoteClient((host, port))
+        with pytest.raises(DeadlineExceeded):
+            for _ in rc.infer_stream(
+                    {"tokens": np.array([10], np.int32)},
+                    timeout_ms=0.0001, max_new_tokens=14):
+                pass
+        rc.close()
+    finally:
+        sp.stop()
+
+
+def test_wire_stream_closed_from_other_thread_keeps_conn_usable():
+    """An abandoned fleet stream is close()d by a GC finalizer on
+    whatever thread runs GC — the connection the stream was reading
+    must be torn down BY OBJECT (a thread-local drop on the closing
+    thread is a no-op), or the opening thread's next request reuses a
+    half-read socket and desyncs."""
+    from paddle_tpu.serving.wire.client import RemoteClient
+    from paddle_tpu.serving.wire.server import ServingProcess
+
+    # own server: ServingProcess.stop() stops the wrapped server, so
+    # the shared chain fixture would arrive here already closed
+    step_fn, make_cache = chain_model()
+    srv = DecodeServer(step_fn, make_cache, eos_id=EOS, max_seq_len=16,
+                       max_slots=4, steps_per_tick=2, name="chain-x")
+    srv.warmup(configure_cache=False)
+    sp = ServingProcess(srv)
+    host, port = sp.start()
+    try:
+        rc = RemoteClient((host, port))
+        it = rc.infer_stream({"tokens": np.array([2], np.int32)},
+                             max_new_tokens=12)
+        next(it)  # stream live: this thread's pooled body is half-read
+        t = threading.Thread(target=it.close)
+        t.start()
+        t.join()
+        # the SAME thread that opened the stream must get a clean
+        # exchange (auto-reopened conn, not the desynced one)
+        out, = rc.infer({"tokens": np.array([4, 5], np.int32)})
+        assert out.tolist() == [6, 7, 8, 9]
+        rc.close()
+    finally:
+        sp.stop()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance run: a real 2-child wire fleet
+# ---------------------------------------------------------------------------
+def test_decode_fleet_two_children_stream_and_zero_recompiles(
+        tmp_path, lm_state):
+    """ISSUE acceptance: a real 2-child fleet hosting a saved decode
+    endpoint — fleet-wide warmup, then a mixed stream/unary storm with
+    ZERO recompiles on both children (``/statusz`` jit cache is the
+    ground truth), streamed tokens correct and each stream under one
+    trace id."""
+    from paddle_tpu.serving.wire.fleet import FleetBalancer
+
+    d = str(tmp_path / "lm-endpoint")
+    save_decode_endpoint(
+        d, lm_state, vocab_size=LM_DIMS["vocab"],
+        d_model=LM_DIMS["d_model"], n_layer=LM_DIMS["n_layer"],
+        n_head=LM_DIMS["n_head"], d_inner=LM_DIMS["d_inner"], eos_id=EOS,
+        max_seq_len=32, max_slots=2, steps_per_tick=3)
+    fb = FleetBalancer.from_launch(d, 2, name="decode-fleet")
+    try:
+        fb.warmup()
+        ref = _ref_continuation(lm_state, [2, 3, 4], 11)
+        errs = []
+        streamed = []
+
+        def one(i):
+            try:
+                if i % 2:
+                    chunks = list(fb.infer_stream(
+                        {"tokens": np.array([2, 3, 4], np.int32)},
+                        max_new_tokens=8))
+                    streamed.append((
+                        [t for c in chunks for t in c.tolist()],
+                        len(chunks)))
+                else:
+                    p = [5] if i % 4 else [7, 8]
+                    fb.infer({"tokens": np.array(p, np.int32)})
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        for got, n_chunks in streamed:
+            assert got == ref
+            assert n_chunks >= 2
+        for be in fb._backends:
+            st = be.transport.get_json("/statusz")
+            assert st["jit_cache"]["misses"] == 0, st["jit_cache"]
+        # abandoning a stream BEFORE its first next() must not leak the
+        # backend's in-flight slot (a never-started generator skips its
+        # finally; the GC finalizer releases instead)
+        import gc
+
+        gen = fb.infer_stream({"tokens": np.array([2], np.int32)},
+                              max_new_tokens=4)
+        assert sum(be.in_flight for be in fb._backends) == 1
+        del gen
+        gc.collect()
+        deadline = time.monotonic() + 5.0
+        while (time.monotonic() < deadline
+               and any(be.in_flight for be in fb._backends)):
+            time.sleep(0.02)
+        assert all(be.in_flight == 0 for be in fb._backends)
+    finally:
+        fb.stop(shutdown_backends=True)

@@ -146,3 +146,146 @@ def test_cached_decode_matches_full_prefix():
     np.testing.assert_array_equal(np.asarray(g_c), np.asarray(g_full))
     np.testing.assert_allclose(
         np.asarray(gs_c), np.asarray(gs_full), rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# cached-path coverage: pooled-step parity, EOS early-exit, cache reuse
+# ---------------------------------------------------------------------------
+from paddle_tpu.decoding import (  # noqa: E402 — test-local alias
+    random_transformer_lm_state as _random_lm_state,
+)
+
+
+_LM = dict(vocab=18, d_model=16, n_layer=2, n_head=2, d_inner=32,
+           max_pos=12)
+
+
+def test_pooled_step_fn_matches_scalar_step_fn():
+    """The slot-pool step fn (per-row positions ``ts``) must equal the
+    scalar-``t`` step fn exactly when all rows sit at the same position
+    — same weights, same caches, token by token."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(3)
+    state = _random_lm_state(rng, **_LM)
+    N, ML = 3, _LM["max_pos"]
+    s_fn, s_cache = decoding.make_transformer_lm_step_fn(
+        state, _LM["vocab"], _LM["d_model"], _LM["n_layer"],
+        _LM["n_head"], _LM["d_inner"], ML)
+    p_fn, p_cache = decoding.make_transformer_lm_pooled_step_fn(
+        state, _LM["vocab"], _LM["d_model"], _LM["n_layer"],
+        _LM["n_head"], _LM["d_inner"])
+    sc, pc = s_cache(N), p_cache(N, ML)
+    for t in range(ML):
+        toks = jnp.asarray(rng.randint(0, _LM["vocab"], N), "int32")
+        ls, sc = s_fn(sc, toks, t)
+        lp, pc = p_fn(pc, toks, jnp.full((N,), t, "int32"))
+        np.testing.assert_allclose(np.asarray(ls), np.asarray(lp),
+                                   rtol=1e-5, atol=1e-5)
+    for i in range(_LM["n_layer"]):
+        np.testing.assert_allclose(np.asarray(sc[i]["k"]),
+                                   np.asarray(pc[i]["k"]),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_pooled_step_fn_rows_at_different_positions():
+    """Per-row positions are genuinely independent: running row A to
+    position k with row B idle gives row A the same logits as running
+    A alone — the pooled mask/scatter never leaks across rows."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(4)
+    state = _random_lm_state(rng, **_LM)
+    ML = _LM["max_pos"]
+    p_fn, p_cache = decoding.make_transformer_lm_pooled_step_fn(
+        state, _LM["vocab"], _LM["d_model"], _LM["n_layer"],
+        _LM["n_head"], _LM["d_inner"])
+    toks = rng.randint(0, _LM["vocab"], ML)
+    # lane 0 alone
+    c1 = p_cache(1, ML)
+    solo = []
+    for t in range(4):
+        l1, c1 = p_fn(c1, jnp.asarray([toks[t]], "int32"),
+                      jnp.asarray([t], "int32"))
+        solo.append(np.asarray(l1[0]))
+    # lane 0 advancing while lane 1 replays position 0 every step with
+    # junk tokens (a stale/idle slot)
+    c2 = p_cache(2, ML)
+    for t in range(4):
+        l2, c2 = p_fn(
+            c2, jnp.asarray([toks[t], 7], "int32"),
+            jnp.asarray([t, 0], "int32"))
+        np.testing.assert_allclose(np.asarray(l2[0]), solo[t],
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_greedy_cached_eos_early_exit():
+    """A sequence that emits EOS freezes: every later position stays
+    EOS (finished beams extend only with EOS) and the score stops
+    accumulating at the EOS transition."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(5)
+    state = _random_lm_state(rng, **_LM)
+    ML = _LM["max_pos"]
+    step_fn, make_cache = decoding.make_transformer_lm_step_fn(
+        state, _LM["vocab"], _LM["d_model"], _LM["n_layer"],
+        _LM["n_head"], _LM["d_inner"], ML)
+    bos = 1
+    # whatever greedy picks first becomes the EOS of the rerun: the
+    # decode must then finish at position 1 and pad EOS to max_len
+    logits, _ = step_fn(make_cache(1), jnp.asarray([bos], "int32"), 0)
+    eos = int(np.argmax(np.asarray(logits[0])))
+    toks, scores = decoding.greedy_search_cached(
+        step_fn, make_cache(1), 1, bos, eos, max_len=ML)
+    toks = np.asarray(toks)
+    assert toks[0, 0] == bos
+    assert (toks[0, 1:] == eos).all()
+    expected = float(jax.nn.log_softmax(
+        jnp.asarray(logits[0]))[eos])
+    np.testing.assert_allclose(float(np.asarray(scores)[0]), expected,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_cached_decode_cache_reuse_across_calls():
+    """Cache buffers are reusable across calls without leakage: a
+    second run on the same cache pytree — and a run on a junk-filled
+    cache — produce identical tokens and scores, proving the
+    write-before-read discipline the serving slot pool relies on."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(6)
+    state = _random_lm_state(rng, **_LM)
+    B, ML = 2, _LM["max_pos"]
+    step_fn, make_cache = decoding.make_transformer_lm_step_fn(
+        state, _LM["vocab"], _LM["d_model"], _LM["n_layer"],
+        _LM["n_head"], _LM["d_inner"], ML)
+    cache = make_cache(B)
+    t1, s1 = decoding.greedy_search_cached(
+        step_fn, cache, B, BOS, EOS, max_len=ML)
+    t2, s2 = decoding.greedy_search_cached(
+        step_fn, cache, B, BOS, EOS, max_len=ML)
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+    junk = [
+        {"k": jnp.full_like(layer["k"], 7.5),
+         "v": jnp.full_like(layer["v"], -3.25)}
+        for layer in cache
+    ]
+    t3, s3 = decoding.greedy_search_cached(
+        step_fn, junk, B, BOS, EOS, max_len=ML)
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t3))
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s3),
+                               rtol=1e-5, atol=1e-5)
+    t4, s4 = decoding.beam_search_cached(
+        step_fn, make_cache(B * 3), B, BOS, EOS, beam_size=3,
+        max_len=ML)
+    t5, s5 = decoding.beam_search_cached(
+        step_fn, jax.tree.map(lambda c: jnp.full_like(c, 9.0),
+                              make_cache(B * 3)),
+        B, BOS, EOS, beam_size=3, max_len=ML)
+    np.testing.assert_array_equal(np.asarray(t4), np.asarray(t5))
+    np.testing.assert_allclose(np.asarray(s4), np.asarray(s5),
+                               rtol=1e-5, atol=1e-5)

@@ -186,7 +186,10 @@ def test_remote_client_roundtrip_and_typed_errors():
             cli.infer({"nope": x})
         with pytest.raises(ValueError):
             cli.infer({"x": _rows(999)})
-        with pytest.raises(NotImplementedError):
+        # a non-decode endpoint refuses infer_stream typed, in-band,
+        # AT THE CALL (the streaming contract's pre-stream failure)
+        from paddle_tpu.serving.errors import ServingError
+        with pytest.raises(ServingError, match="does not stream"):
             cli.infer_stream({"x": x})
         h = cli.healthz()
         assert h["ok"] and h["input_names"] == ["x"]

@@ -45,6 +45,8 @@ CHECKED_FILES = [
     "paddle_tpu/serving/wire/http.py",
     "paddle_tpu/serving/wire/client.py",
     "paddle_tpu/serving/wire/fleet.py",
+    "paddle_tpu/serving/decode.py",
+    "paddle_tpu/serving/kv_pool.py",
 ]
 
 # blocking-sync tokens (substring match on code, not comments)

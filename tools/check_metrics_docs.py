@@ -36,6 +36,7 @@ REGISTERING_MODULES = [
     "paddle_tpu.inference",
     "paddle_tpu.serving.metrics",
     "paddle_tpu.serving.wire.metrics",
+    "paddle_tpu.serving.decode",
     "paddle_tpu.faults.metrics",
 ]
 
