@@ -98,6 +98,22 @@ def fresh_enabled(default="1"):
     return os.environ.get("BENCH_FRESH", default) == "1"
 
 
+def virtual_mesh_env(n=8, env=None):
+    """Env-var overrides forcing an ``n``-device virtual CPU mesh:
+    ``JAX_PLATFORMS=cpu`` plus ``xla_force_host_platform_device_count``
+    appended to the existing XLA_FLAGS (read from ``env``, default
+    ``os.environ``; an already-present device-count flag is kept as
+    is).  The one definition behind every CPU-mesh bench stage — pass
+    the returned dict to a subprocess env, or ``os.environ.update()``
+    it BEFORE the first jax import for an in-process bench."""
+    base = os.environ if env is None else env
+    flags = base.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags
+                 + " --xla_force_host_platform_device_count=%d" % n).strip()
+    return {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": flags}
+
+
 # ---------------------------------------------------------------------------
 # Metrics dump alongside the bench JSON line (paddle_tpu.monitor)
 # ---------------------------------------------------------------------------

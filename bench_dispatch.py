@@ -256,15 +256,13 @@ def main():
     import sys
 
     sharded = "--sharded" in sys.argv[1:]
-    if sharded and "xla_force_host_platform_device_count" not in os.environ.get(
-            "XLA_FLAGS", ""):
+    import bench_common
+
+    if sharded:
         # a CPU host needs the virtual multi-device platform; only
         # effective when jax has not been imported yet (bench.py's
         # orchestrator sets it in the subprocess env instead)
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + " --xla_force_host_platform_device_count=8").strip()
-    import bench_common
+        os.environ["XLA_FLAGS"] = bench_common.virtual_mesh_env()["XLA_FLAGS"]
 
     bench_common.configure_compile_cache(bench_common.HOME_CACHE_DIR)
     bench_common.emit_result(run_sharded() if sharded else run())

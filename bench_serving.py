@@ -66,6 +66,18 @@ prefill/decode token ratio, and the recompile count (0 after warmup —
 the slot pool's bucket ladders keep the compiled-shape set closed).
 Env knobs: BENCH_DECODE_REQUESTS (default 24), BENCH_DECODE_SLOTS
 (default 8), BENCH_DECODE_STEPS (per tick, default 4).
+
+``--sharded`` (or $BENCH_SERVING_SHARDED=1) benches MODEL-PARALLEL
+serving (``paddle_tpu.sharding``): the same transformer-LM endpoint
+served replicated vs as a 2-way tp group on the 8-device CPU mesh
+(the canonical layout rides the saved model's manifest, so the
+predictor reconstructs the placement on load exactly like a serving
+child would).  The line reports QPS for both, the post-warmup
+recompile count (must stay 0 — sharded out_shardings pin the state
+layout, so the jit-cache shape set stays closed), and the per-device
+HBM footprint vs the replicated baseline (sharded params hold 1/tp of
+their bytes per device — the capacity headroom the layout buys).
+Env knobs: BENCH_SHARDED_TP (default 2).
 """
 import json
 import os
@@ -205,8 +217,12 @@ def _bench_endpoint(name, save_fn):
                 "endpoint %r recompiled after warmup: registry=%s snapshot=%s"
                 % (name, registry_recompiles, m["recompiles"]))
         rows = sum(total_rows)
+        sharding_stats = None
+        if getattr(predictor, "sharded", False):
+            sharding_stats = predictor.sharding_stats()
         return {
             "rows_per_sec": round(rows / elapsed, 1),
+            **({"sharding": sharding_stats} if sharding_stats else {}),
             "d2h_overlap": bool(server._nonblocking),
             "requests_per_sec": round(m["completed"] / elapsed, 1),
             "latency_p50_ms": m["latency_p50_ms"],
@@ -592,6 +608,95 @@ def run():
 
 
 # ---------------------------------------------------------------------------
+# --sharded: a 2-way tp model-parallel group vs the replicated baseline
+# ---------------------------------------------------------------------------
+SHARDED_TP = int(os.environ.get("BENCH_SHARDED_TP", "2"))
+_LM_V, _LM_D, _LM_L, _LM_H, _LM_DI, _LM_S = 512, 64, 2, 4, 128, 32
+
+
+def _save_lm_bench(sharded: bool):
+    """Save-fn factory for the transformer-LM endpoint (the "giant
+    model" stand-in): same weights both ways (seeded), with the
+    canonical tp layout + mesh embedded in the manifest when
+    ``sharded`` — the predictor then loads as ONE model-parallel group
+    spanning ``BENCH_SHARDED_TP`` devices of the virtual CPU mesh."""
+    def save_fn(dirname):
+        import paddle_tpu as fluid
+        from paddle_tpu import framework, models, sharding
+
+        prog, startup = framework.Program(), framework.Program()
+        prog.random_seed = startup.random_seed = 17
+        with framework.program_guard(prog, startup):
+            ids = fluid.layers.data("src_ids", [_LM_S], dtype="int64")
+            _, logits = models.transformer_lm(
+                ids, None, vocab_size=_LM_V, d_model=_LM_D,
+                n_layer=_LM_L, n_head=_LM_H, d_inner=_LM_DI,
+                seq_len=_LM_S, max_pos=2 * _LM_S)
+        exe = fluid.Executor(fluid.CPUPlace())
+        kw = {}
+        if sharded:
+            kw = dict(sharding_rules=sharding.transformer_lm_rules("tp"),
+                      sharding_mesh={"tp": SHARDED_TP})
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            fluid.save_inference_model(
+                dirname, ["src_ids"], [logits], exe, prog, **kw)
+
+        def make_rows(n, rng):
+            return {"src_ids": rng.randint(
+                1, _LM_V, (n, _LM_S)).astype(np.int64)}
+
+        return make_rows
+
+    return save_fn
+
+
+def run_sharded():
+    """The ``--sharded`` line: the same transformer-LM endpoint served
+    replicated (one chip's replica) vs as a 2-way tp model-parallel
+    group on the 8-device CPU mesh — QPS and post-warmup recompile
+    count for both, plus the per-device HBM footprint the sharding
+    buys (sharded params hold 1/tp of their bytes per device)."""
+    import sys
+
+    import bench_common
+
+    if "jax" not in sys.modules:
+        # standalone invocation (`python bench_serving.py --sharded`):
+        # the tp group needs the virtual multi-device CPU mesh, and the
+        # env only takes effect before the first jax import (bench.py's
+        # serving_sharded stage injects the same env into its
+        # subprocess; this covers the direct path)
+        os.environ.update(bench_common.virtual_mesh_env())
+    import jax
+
+    bench_common.configure_compile_cache(bench_common.HOME_CACHE_DIR)
+    replicated = _bench_endpoint("lm-replicated", _save_lm_bench(False))
+    shard = _bench_endpoint("lm-tp%d" % SHARDED_TP, _save_lm_bench(True))
+    stats = shard.get("sharding") or {}
+    return {
+        "metric": "serving_sharded_qps",
+        "unit": "rows/sec",
+        "value": shard["rows_per_sec"],
+        "replicated_rows_per_sec": replicated["rows_per_sec"],
+        "qps_vs_replicated": round(
+            shard["rows_per_sec"] / max(1e-9, replicated["rows_per_sec"]),
+            3),
+        "tp": SHARDED_TP,
+        "recompiles_after_warmup": shard["recompiles_after_warmup"],
+        "hbm_bytes_per_device": stats.get("hbm_bytes_per_device"),
+        "replicated_hbm_bytes": stats.get("replicated_bytes"),
+        "params_sharded": stats.get("n_sharded"),
+        "endpoints": {"replicated": replicated, "sharded": shard},
+        "threads": THREADS,
+        "requests_per_thread": REQUESTS,
+        "max_batch_size": MAX_BATCH,
+        "batch_timeout_ms": TIMEOUT_MS,
+        "platform": jax.devices()[0].platform,
+    }
+
+
+# ---------------------------------------------------------------------------
 # --decode: continuous batching vs request-at-a-time on a transformer LM
 # ---------------------------------------------------------------------------
 def _decode_workload(rng, n, max_seq_len):
@@ -738,6 +843,10 @@ def main():
     if "--decode" in sys.argv[1:] or os.environ.get(
             "BENCH_SERVING_DECODE"):
         bench_common.emit_result(run_decode())
+        return
+    if "--sharded" in sys.argv[1:] or os.environ.get(
+            "BENCH_SERVING_SHARDED"):
+        bench_common.emit_result(run_sharded())
         return
     mode = _wire_mode()
     if mode:

@@ -61,6 +61,7 @@ from paddle_tpu.fluid_dataset import DatasetFactory, InMemoryDataset, QueueDatas
 from paddle_tpu import monitor
 from paddle_tpu import profiler
 from paddle_tpu import serving
+from paddle_tpu import sharding
 from paddle_tpu import memory
 from paddle_tpu import trainer_desc
 from paddle_tpu.trainer_desc import TrainerFactory

@@ -242,10 +242,20 @@ _JIT_CACHE_CAPACITY = int(os.environ.get(
 
 class Executor:
     def __init__(self, place=None, plan_cache_capacity: Optional[int] = None,
-                 jit_cache_capacity: Optional[int] = None):
+                 jit_cache_capacity: Optional[int] = None,
+                 reshard_on_gather: Optional[bool] = None):
         # place=None means "process default device" (jax.devices()[0]) —
         # an explicit TPUPlace/CPUPlace is honored strictly (_device).
         self.place = place if place is not None else framework._DefaultPlace()
+        # uncompiled-after-compiled interop: scope state a compiled run
+        # committed to a MESH cannot feed a single-device jit.  Default
+        # is a loud typed diagnostic (MeshCommittedStateError naming the
+        # variable and its mesh); opting in here (or via
+        # PADDLE_TPU_RESHARD_ON_GATHER=1) gathers the state back to
+        # host ONCE at the offending run instead.
+        self._reshard_on_gather = (
+            bool(reshard_on_gather) if reshard_on_gather is not None
+            else os.environ.get("PADDLE_TPU_RESHARD_ON_GATHER", "0") == "1")
         self._cache = _LRUCache(
             jit_cache_capacity if jit_cache_capacity is not None
             else _JIT_CACHE_CAPACITY,
@@ -473,19 +483,59 @@ class Executor:
                 "executor/h2d_feed", _t0, time.perf_counter() - _t0,
                 cat="transfer", n_feeds=len(feed_arrays))
 
-        # gather state from scope (one pass doubles as the init check)
-        mut_state, ro_state, missing = {}, {}, None
+        # gather state from scope (one pass doubles as the init check;
+        # the committed-state probe is two getattrs per var, and only
+        # for UNcompiled runs — compiled runs re-place via the mesh)
+        mut_state, ro_state, missing, committed = {}, {}, None, None
         for names, out in ((state_mut, mut_state), (state_ro, ro_state)):
             for n in names:
                 v = scope.get(n)
                 if v is None:
                     missing = (missing or []) + [n]
+                elif compiled is None:
+                    sh = getattr(v, "sharding", None)
+                    if sh is not None and len(
+                            getattr(sh, "device_set", ())) > 1:
+                        committed = (committed or []) + [(n, out, sh)]
                 out[n] = v
         if missing:
             raise RuntimeError(
                 "Variables %s are not initialized in scope — run the startup "
                 "program first (reference: executor.py run startup)" % missing
             )
+        if committed:
+            # interop gap (ROADMAP): a program run UNCOMPILED after a
+            # compiled run sees mesh-committed (sharded or mesh-
+            # replicated) state; feeding it to a single-device jit
+            # fails deep inside jax with a device mismatch.  Either
+            # gather the state back to host once (opt-in) or name the
+            # problem loudly here.
+            if self._reshard_on_gather:
+                for n, out, _sh in committed:
+                    host = jax.device_get(out[n])  # hot-ok: cold interop path — committed state detected, gather once
+                    out[n] = host
+                    scope.set(n, host)  # later runs gather clean
+            else:
+                from paddle_tpu.sharding.rules import MeshCommittedStateError
+
+                descs = []
+                for n, _out, sh in committed[:4]:
+                    mesh = getattr(sh, "mesh", None)
+                    where = (
+                        dict(zip(mesh.axis_names, mesh.devices.shape))
+                        if mesh is not None else
+                        "%d devices" % len(sh.device_set))
+                    descs.append("%r on %s" % (n, where))
+                more = len(committed) - len(descs)
+                raise MeshCommittedStateError(
+                    "running this program UNCOMPILED, but its scope state "
+                    "is committed to a device mesh by a previous compiled "
+                    "run: %s%s. Run it through the same CompiledProgram, "
+                    "or opt into a one-time host gather with "
+                    "Executor(reshard_on_gather=True) / "
+                    "PADDLE_TPU_RESHARD_ON_GATHER=1."
+                    % ("; ".join(descs),
+                       " (+%d more)" % more if more > 0 else ""))
 
         feed_sig = tuple(
             (n, feed_arrays[n].shape, feed_arrays[n].dtype)

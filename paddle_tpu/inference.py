@@ -94,6 +94,113 @@ class AnalysisPredictor(PaddlePredictor):
             )
         self._fetch_names = [v.name for v in self._fetch_vars]
         self._jit_cache: Dict[Any, Any] = {}
+        # a saved sharding manifest (save_inference_model's
+        # sharding_rules=) reconstructs the SAME model-parallel layout
+        # here: this predictor then owns a mesh-spanning group of
+        # devices instead of one chip's replica
+        self._compiled = None
+        manifest = getattr(self._program, "_sharding_manifest", None)
+        if manifest:
+            from paddle_tpu.sharding.rules import (
+                PartitionRules,
+                ShardingRuleError,
+            )
+
+            rules_doc = manifest.get("rules")
+            if not rules_doc:
+                raise ShardingRuleError(
+                    "malformed sharding manifest in %r: missing 'rules' "
+                    "(%r)" % (config.model_dir, manifest))
+            self.with_sharding_rules(
+                PartitionRules.from_manifest(rules_doc),
+                mesh_axes=manifest.get("mesh_axes"))
+
+    # --- TPU-native sharding surface (paddle_tpu/sharding) ---
+    def with_sharding_rules(self, rules, mesh=None,
+                            mesh_axes=None) -> "AnalysisPredictor":
+        """Span this predictor across a model-parallel device group:
+        the loaded program runs as a ``CompiledProgram`` whose
+        partition rules place each parameter SHARD-wise on the mesh
+        (see ``CompiledProgram.with_sharding_rules``).  Called
+        automatically when the saved model carries a sharding
+        manifest."""
+        from paddle_tpu.parallel.compiled_program import CompiledProgram
+
+        self._compiled = CompiledProgram(self._program).with_sharding_rules(
+            rules, mesh=mesh, mesh_axes=mesh_axes)
+        return self
+
+    @property
+    def sharded(self) -> bool:
+        """True when this predictor spans a model-parallel mesh."""
+        return self._compiled is not None
+
+    def param_placements(self) -> Dict[str, Dict[str, Any]]:
+        """Observed placement per persistable: resolved spec, this
+        host's addressable shard shape, and per-device bytes.  Ground
+        truth for "each param is placed per its rule" — read AFTER
+        warmup/first run (before placement, params report their host
+        staging shape with ``placed=False``)."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for v in self._program.list_vars():
+            if not v.persistable or v.is_data:
+                continue
+            val = self._scope.get(v.name)
+            if val is None:
+                continue
+            spec = (self._compiled._spec_for_state(v.name)
+                    if self._compiled is not None else None)
+            shape = tuple(np.shape(val))
+            entry: Dict[str, Any] = {
+                "spec": list(tuple(spec)) if spec is not None else None,
+                "shape": shape,
+            }
+            sh = getattr(val, "sharding", None)
+            shards = getattr(val, "addressable_shards", None)
+            if sh is not None and shards:
+                shard_shape = tuple(shards[0].data.shape)
+                entry["shard_shape"] = shard_shape
+                entry["bytes_per_device"] = int(
+                    shards[0].data.size * val.dtype.itemsize)
+                entry["sharded"] = shard_shape != shape
+                entry["placed"] = len(sh.device_set) > 1
+            else:
+                entry["shard_shape"] = shape
+                entry["bytes_per_device"] = int(
+                    np.asarray(val).nbytes if not hasattr(val, "nbytes")
+                    else val.nbytes)
+                entry["sharded"] = False
+                entry["placed"] = False
+            out[v.name] = entry
+        return out
+
+    def sharding_stats(self, group: Optional[str] = None) -> Dict[str, Any]:
+        """Aggregate placement accounting for this predictor's group:
+        parameter counts, per-device HBM bytes vs the replicated
+        baseline.  ``group=<label>`` additionally publishes the
+        per-device bytes to the ``sharding_group_hbm_bytes`` gauge."""
+        placements = self.param_placements()
+        hbm = sum(p["bytes_per_device"] for p in placements.values())
+        total = 0  # the replicated baseline: every param whole, per chip
+        for p in placements.values():
+            n_shard = int(np.prod(p["shard_shape"])) if p["shard_shape"] else 1
+            itemsize = p["bytes_per_device"] // max(1, n_shard)
+            total += (int(np.prod(p["shape"])) if p["shape"] else 1) * itemsize
+        stats = {
+            "sharded": self.sharded,
+            "mesh_axes": (dict(self._compiled._mesh_axes)
+                          if self._compiled is not None
+                          and self._compiled._mesh_axes else None),
+            "n_params": len(placements),
+            "n_sharded": sum(1 for p in placements.values() if p["sharded"]),
+            "hbm_bytes_per_device": int(hbm),
+            "replicated_bytes": int(total),
+        }
+        if group is not None:
+            from paddle_tpu.sharding.metrics import GROUP_HBM_BYTES
+
+            GROUP_HBM_BYTES.labels(group=str(group)).set(float(hbm))
+        return stats
 
     # --- reference surface ---
     def get_input_names(self) -> List[str]:
@@ -117,7 +224,11 @@ class AnalysisPredictor(PaddlePredictor):
         _MON_PRED_RUNS.inc()
         with fluid.scope_guard(self._scope):
             return self._exe.run(
-                self._program, feed=feed, fetch_list=self._fetch_names,
+                # a sharded predictor dispatches through its
+                # CompiledProgram so every run places/pins per the rules
+                self._compiled if self._compiled is not None
+                else self._program,
+                feed=feed, fetch_list=self._fetch_names,
                 return_numpy=return_numpy,
             )
 

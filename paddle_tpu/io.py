@@ -181,10 +181,12 @@ def _prune_program(program: Program, feed_names: Sequence[str], fetch_names: Seq
 
 
 def _save_model(dirname, program, feed_names, fetch_names, executor,
-                model_filename=None, params_filename=None):
+                model_filename=None, params_filename=None, sharding=None):
     """Shared save path for save_inference_model / save_program: the
     ``__model__`` JSON + persistable ``.npy`` layout consumed by both
-    load_inference_model and the native C++ runtime (predictor.cc)."""
+    load_inference_model and the native C++ runtime (predictor.cc).
+    ``sharding``: the partition-rule manifest (``{"mesh_axes": ...,
+    "rules": ...}``) a sharded endpoint carries with its weights."""
     os.makedirs(dirname, exist_ok=True)
     model = {
         "format_version": 1,
@@ -192,6 +194,8 @@ def _save_model(dirname, program, feed_names, fetch_names, executor,
         "feed_names": list(feed_names),
         "fetch_names": list(fetch_names),
     }
+    if sharding is not None:
+        model["sharding"] = sharding
     with open(os.path.join(dirname, model_filename or _MODEL_FILE), "w") as f:
         json.dump(model, f)
     save_vars(
@@ -234,20 +238,73 @@ def save_inference_model(
     main_program: Optional[Program] = None,
     model_filename=None,
     params_filename=None,
+    sharding_rules=None,
+    sharding_mesh=None,
 ):
-    """reference: io.py:925 — prune + save program and params."""
+    """reference: io.py:925 — prune + save program and params.
+
+    ``sharding_rules`` (TPU-native extension): a
+    ``paddle_tpu.sharding.PartitionRules`` (or ``(regex, spec)`` list)
+    embedded in the ``__model__`` manifest together with
+    ``sharding_mesh`` (axis→size, e.g. ``{"tp": 2}``) so every loader —
+    ``AnalysisPredictor``, a ``ServingProcess`` child — reconstructs
+    the SAME model-parallel layout.  The rules are validated against
+    the pruned program's persistables HERE (full coverage, rank
+    checks), so a bad layout fails at export, not in a serving child."""
     program = main_program or framework.default_main_program()
     fetch_names = [t.name if isinstance(t, Variable) else str(t) for t in target_vars]
     pruned = _prune_program(program, feeded_var_names, fetch_names)
+    sharding = None
+    if sharding_rules is not None:
+        from paddle_tpu.sharding.rules import PartitionRules, ShardingRuleError
+
+        if not isinstance(sharding_rules, PartitionRules):
+            sharding_rules = PartitionRules(sharding_rules)
+        # fail-at-export validation: every persistable resolves, the
+        # mesh carries every axis the rules shard over, and every
+        # sharded dim divides by its axes' size — a layout/mesh
+        # mismatch must fail HERE, not in a serving child's load
+        shapes = {
+            v.name: tuple(v.shape or ())
+            for v in pruned.list_vars() if _is_persistable(v)
+        }
+        axes = sharding_rules.axes()
+        if sharding_mesh is not None:
+            mesh_axes = dict(sharding_mesh)
+            missing = sorted(axes - set(mesh_axes))
+            if missing:
+                raise ShardingRuleError(
+                    "sharding_rules shard over axes %s which are not in "
+                    "sharding_mesh %s" % (missing, mesh_axes))
+            # coverage + rank + divisibility, one resolution pass
+            sharding_rules.validate_shapes(shapes, mesh_axes)
+        else:
+            if len(axes) > 1:
+                raise ShardingRuleError(
+                    "sharding_rules span axes %s — pass sharding_mesh= "
+                    "to fix their sizes (a loader cannot infer a "
+                    "multi-axis mesh shape)" % sorted(axes))
+            sharding_rules.match(shapes)  # coverage + rank
+        sharding = {
+            "mesh_axes": ({str(a): int(n)
+                           for a, n in dict(sharding_mesh).items()}
+                          if sharding_mesh else None),
+            "rules": sharding_rules.to_manifest(),
+        }
     return _save_model(dirname, pruned, feeded_var_names, fetch_names,
-                       executor, model_filename, params_filename)
+                       executor, model_filename, params_filename,
+                       sharding=sharding)
 
 
 def load_inference_model(dirname, executor, model_filename=None, params_filename=None):
-    """reference: io.py:1116 — returns (program, feed_names, fetch_vars)."""
+    """reference: io.py:1116 — returns (program, feed_names, fetch_vars).
+    A saved sharding manifest rides back on the program as
+    ``program._sharding_manifest`` (AnalysisPredictor consumes it)."""
     with open(os.path.join(dirname, model_filename or _MODEL_FILE)) as f:
         model = json.load(f)
     program = Program.from_json(json.dumps(model["program"]))
+    if model.get("sharding"):
+        program._sharding_manifest = model["sharding"]
     load_vars(executor, dirname, program, filename=params_filename)
     fetch_vars = [program.global_block().var(n) for n in model["fetch_names"]]
     return program, model["feed_names"], fetch_vars

@@ -14,7 +14,13 @@ strategy automatically.
 Model parallelism: `DistributedStrategy.mesh_axes` gives the mesh shape
 (dp/tp/pp/sp/ep) and `sharding_specs` maps persistable var names to
 PartitionSpec dim tuples, e.g. ``{"fc_w": (None, "tp")}`` for a
-column-parallel weight.
+column-parallel weight.  `with_sharding_rules(rules)` is the
+declarative layer above that: an ordered regex→PartitionSpec rule set
+(`paddle_tpu.sharding.PartitionRules`, GSPMD tradition) resolved
+against persistable names at restage time — each param is placed
+SHARD-wise on the mesh (not replicated), output layouts are pinned so
+sharded state stays sharded across steps, and after warmup the steady
+state pays zero placement work and zero recompiles.
 """
 from __future__ import annotations
 
@@ -36,6 +42,8 @@ class CompiledProgram:
         self._program = getattr(program, "_program", program)
         self._mesh = None
         self._strategy: Optional[DistributedStrategy] = None
+        self._rules = None  # PartitionRules (with_sharding_rules)
+        self._mesh_axes: Optional[Dict[str, int]] = None  # manifest form
         self._batch_axis = "dp"
         self._build_strategy: Optional[BuildStrategy] = None
         self._exec_strategy: Optional[ExecutionStrategy] = None
@@ -88,6 +96,54 @@ class CompiledProgram:
         self._clear_sharding_memos()
         return self
 
+    def with_sharding_rules(self, rules, mesh=None, mesh_axes=None,
+                            default=None) -> "CompiledProgram":
+        """Bind a declarative partition-rule set (GSPMD tradition).
+
+        ``rules``: a :class:`paddle_tpu.sharding.PartitionRules` or a
+        sequence of ``(regex, PartitionSpec)`` pairs (first match
+        wins; ``default=`` is the fallback spec for unmatched names —
+        without it an unmatched persistable is a typed
+        ``ShardingRuleError`` at resolve time, never an XLA error).
+
+        The mesh: ``mesh`` (a jax Mesh) or ``mesh_axes`` (axis→size,
+        e.g. ``{"tp": 2}``); with neither, a single-axis rule set
+        spans every local device on its one axis.  Explicit
+        ``DistributedStrategy.sharding_specs`` entries still win over
+        the rules for their names (per-var override)."""
+        from paddle_tpu.sharding.rules import PartitionRules, ShardingRuleError
+
+        if not isinstance(rules, PartitionRules):
+            rules = PartitionRules(rules, default=default)
+        elif default is not None:
+            rules = PartitionRules(rules.rules, default=default,
+                                   name=rules.name)
+        if mesh is not None:
+            self._mesh = mesh
+            self._mesh_axes = dict(
+                zip(mesh.axis_names, mesh.devices.shape))
+        elif mesh_axes:
+            self._mesh_axes = {str(a): int(n) for a, n in
+                               dict(mesh_axes).items()}
+            self._mesh = mesh_lib.make_mesh(self._mesh_axes)
+        else:
+            axes = sorted(rules.axes())
+            if len(axes) != 1:
+                raise ShardingRuleError(
+                    "rule set %r spans axes %s — pass mesh= or "
+                    "mesh_axes= to fix their sizes" % (rules.name, axes))
+            n = len(mesh_lib.local_devices())
+            self._mesh_axes = {axes[0]: n}
+            self._mesh = mesh_lib.make_mesh(self._mesh_axes)
+        rules.validate_mesh(self._mesh)
+        self._rules = rules
+        self._clear_sharding_memos()
+        return self
+
+    @property
+    def sharding_rules(self):
+        return self._rules
+
     def _clear_sharding_memos(self) -> None:
         self._sharding_memo.clear()
         self._state_sh_memo.clear()
@@ -118,6 +174,21 @@ class CompiledProgram:
         specs = self._strategy.sharding_specs if self._strategy else {}
         if name in specs:
             return P(*specs[name])
+        if self._rules is not None:
+            # rule resolution (regex scan + rank/divisibility checks)
+            # runs once per name — state_sharding memoizes the resolved
+            # NamedSharding, so the dispatch region never re-resolves
+            # (warmup-time only; tools/check_hot_path.py guards the
+            # sharding files)
+            var = self._program.global_block()._find_var_recursive(name)
+            shape = (tuple(var.shape)
+                     if var is not None and var.shape is not None else None)
+            spec = self._rules.spec_for(name, shape=shape)
+            if shape and self._mesh_axes:
+                # typed here, not as a raw device_put ValueError later
+                self._rules.check_divisible(
+                    name, spec, shape, self._mesh_axes)
+            return spec
         return P()  # replicated
 
     def _spec_for_feed(self, name: str, ndim: int):
@@ -250,6 +321,18 @@ class CompiledProgram:
         ro_out = put(ro_state, state_sh, track=True)
         if steady_token is not None and not restaged:
             self._steady_tokens.add(steady_token)
+        if restaged and self._rules is not None:
+            # placement accounting (cold: restage is a warmup-time
+            # event; a counter still moving in steady state means state
+            # is re-placed per step — the bug this design prevents)
+            n_sharded = sum(
+                1 for n in restaged
+                if any(e is not None
+                       for e in tuple(state_sharding(n).spec)))
+            if n_sharded:
+                from paddle_tpu.sharding import metrics as _sh_metrics
+
+                _sh_metrics.PARAMS_SHARDED.inc(n_sharded)
         return feed_out, mut_out, ro_out, restaged
     # hot-path: end shard_inputs
 

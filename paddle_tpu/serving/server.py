@@ -276,7 +276,7 @@ class InferenceServer:
         (incl. bucket-ladder occupancy histogram, per-replica health,
         and recompile counter), the predictors' jit-cache stats, and the
         process registry."""
-        return {
+        doc = {
             "server": self.name,
             "metrics": self.metrics(),
             "jit_cache": self._predictor.jit_cache_stats(),
@@ -285,6 +285,17 @@ class InferenceServer:
             },
             "registry": monitor.snapshot(),
         }
+        sharding = {}
+        for r in self._replicas:
+            stats_fn = getattr(r.predictor, "sharding_stats", None)
+            if callable(stats_fn) and getattr(r.predictor, "sharded", False):
+                sharding[r.name] = stats_fn()
+        if sharding:
+            # each replica here is a model-parallel GROUP of devices;
+            # the capacity math ("does the model fit one chip's
+            # share?") reads hbm_bytes_per_device vs replicated_bytes
+            doc["sharding"] = sharding
+        return doc
 
     # ------------------------------------------------------------------
     def start_admin(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
@@ -387,6 +398,14 @@ class InferenceServer:
                             "serving/%s/warmup" % self.name):
                         rep.predictor.run_padded(feed, n_valid=bucket)
             compiles += rep.predictor.jit_cache_stats()["misses"] - misses0
+            # a mesh-spanning (sharded) replica publishes its per-device
+            # HBM footprint now that warmup placed every param per its
+            # rule (sharding_group_hbm_bytes gauge, one series per
+            # model-parallel group)
+            stats_fn = getattr(rep.predictor, "sharding_stats", None)
+            if callable(stats_fn) and getattr(rep.predictor, "sharded",
+                                              False):
+                stats_fn(group="%s/%s" % (self.name, rep.name))
         self._metrics.count("warmup_compiles", compiles)
         self._warmed = True
         return compiles
@@ -1030,6 +1049,13 @@ class InferenceServer:
         self._batcher.close()
         self._brownout.close()
         ADMISSION_EXPIRED.remove_labels(server=self.name)
+        if any(getattr(r.predictor, "sharded", False)
+               for r in self._replicas):
+            from paddle_tpu.sharding.metrics import GROUP_HBM_BYTES
+
+            for rep in self._replicas:
+                GROUP_HBM_BYTES.remove_labels(
+                    group="%s/%s" % (self.name, rep.name))
 
     def __enter__(self):
         return self

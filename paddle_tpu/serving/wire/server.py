@@ -380,6 +380,11 @@ class ServingProcess:
             "brownout_level": m.get("brownout_level"),
             "max_batch_size": srv.max_batch_size,
             "streaming": bool(getattr(srv, "supports_streaming", False)),
+            # a sharded backend is one MODEL-PARALLEL GROUP of devices
+            # behind one address — the balancer routes to groups exactly
+            # like single-chip replicas (in-flight accounting, warmup,
+            # retirement unchanged)
+            "sharded": bool(getattr(srv._predictor, "sharded", False)),
             "input_names": list(srv._feed_names),
             "output_names": list(srv._predictor.get_output_names()),
         }

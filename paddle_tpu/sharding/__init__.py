@@ -1,0 +1,52 @@
+"""paddle_tpu.sharding — declarative partition rules for model-parallel
+serving and training.
+
+The GSPMD-tradition surface (regex rules over parameter names →
+``PartitionSpec``s) that lets ONE predictor span a tensor/FSDP-sharded
+mesh instead of replicating every parameter per chip:
+
+* :mod:`paddle_tpu.sharding.rules` — :class:`PartitionRules` (ordered
+  first-match rule sets, typed errors, JSON manifest round-trip),
+* :mod:`paddle_tpu.sharding.layouts` — canonical ``tp`` / ``fsdp`` /
+  ``fsdp_tp`` layouts for the in-tree model families (transformer LM,
+  NMT seq2seq, DeepFM), coverage-checked against the real models by
+  ``tools/check_partition_rules.py``,
+* :mod:`paddle_tpu.sharding.metrics` — placement observability
+  (imported lazily by the placement path; import it explicitly for the
+  registry series).
+
+Entry points: ``CompiledProgram.with_sharding_rules(rules, ...)``
+(paddle_tpu/parallel/compiled_program.py),
+``save_inference_model(..., sharding_rules=..., sharding_mesh=...)``
+(paddle_tpu/io.py), and ``AnalysisPredictor`` which reconstructs the
+saved layout automatically on load (paddle_tpu/inference.py).
+"""
+from paddle_tpu.sharding.layouts import (
+    AXIS_FSDP,
+    AXIS_TP,
+    FAMILIES,
+    MODES,
+    canonical_rules,
+    deepfm_rules,
+    transformer_lm_rules,
+    transformer_nmt_rules,
+)
+from paddle_tpu.sharding.rules import (
+    MeshCommittedStateError,
+    PartitionRules,
+    ShardingRuleError,
+)
+
+__all__ = [
+    "PartitionRules",
+    "ShardingRuleError",
+    "MeshCommittedStateError",
+    "canonical_rules",
+    "transformer_lm_rules",
+    "transformer_nmt_rules",
+    "deepfm_rules",
+    "AXIS_TP",
+    "AXIS_FSDP",
+    "MODES",
+    "FAMILIES",
+]

@@ -1,0 +1,29 @@
+"""Sharding metrics (process-global registry, always on).
+
+Registered at import like every subsystem's metrics;
+``tools/check_metrics_docs.py`` holds the README table to this set.
+
+``sharding_params_sharded_total`` counts parameters the compiled
+program placed SHARD-wise (a non-replicated PartitionSpec) at restage
+time — placement is a warmup-time event, so the counter moving after
+warmup means state is being re-staged per step (a bug the steady-token
+machinery exists to prevent).  ``sharding_group_hbm_bytes`` is the
+per-device footprint of one model-parallel group's persistable state:
+the number the "does this model fit one chip's share" capacity math
+reads.
+"""
+from __future__ import annotations
+
+from paddle_tpu.monitor import registry as _registry
+
+__all__ = ["PARAMS_SHARDED", "GROUP_HBM_BYTES"]
+
+PARAMS_SHARDED = _registry.REGISTRY.counter(
+    "sharding_params_sharded_total",
+    "params placed shard-wise (non-replicated PartitionSpec) onto a "
+    "mesh at restage time")
+GROUP_HBM_BYTES = _registry.REGISTRY.gauge(
+    "sharding_group_hbm_bytes",
+    "per-device HBM bytes of one model-parallel group's persistable "
+    "state (sharded params count their shard, replicated params their "
+    "full size)", ("group",))

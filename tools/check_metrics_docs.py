@@ -38,6 +38,7 @@ REGISTERING_MODULES = [
     "paddle_tpu.serving.wire.metrics",
     "paddle_tpu.serving.decode",
     "paddle_tpu.faults.metrics",
+    "paddle_tpu.sharding.metrics",
 ]
 
 # README table rows look like ``| `metric_name` | type | ... |``

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Static partition-rule guard: canonical layouts and the real models agree.
+
+Every canonical layout in ``paddle_tpu/sharding/layouts.py`` must, for
+every mode, FULLY cover its model family's parameter names against the
+real in-tree model:
+
+1. no unmatched parameter — each persistable resolves to a spec (the
+   scalar auto-replicate shortcut counts as covered),
+2. no dead rule — a pattern matching NO parameter of the family is
+   stale cruft that will rot,
+3. no rank mismatch — every resolved spec fits its parameter's rank
+   (``PartitionRules.match`` raises typed otherwise).
+
+The parameter sets come from BUILDING the models (transformer LM, NMT
+seq2seq, DeepFM dense tower), not from a hand-written list, so a model
+refactor that renames a parameter fails here instead of at a serving
+child's load.
+
+Wired into tier-1 via tests/test_partition_rules.py (same pattern as
+check_fault_points.py); also runnable directly::
+
+    python tools/check_partition_rules.py   # exits 1 and prints problems
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List, Tuple
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _build(family: str) -> Dict[str, Tuple[int, ...]]:
+    """{param name: shape} for one family's real in-tree model."""
+    import paddle_tpu as fluid
+    from paddle_tpu import framework, models
+    from paddle_tpu.models.seq2seq import transformer_nmt
+
+    prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(prog, startup):
+        if family == "transformer_lm":
+            ids = fluid.layers.data("src_ids", [16], dtype="int64")
+            models.transformer_lm(
+                ids, None, vocab_size=128, d_model=32, n_layer=2,
+                n_head=4, d_inner=64, seq_len=16, max_pos=64)
+        elif family == "transformer_nmt":
+            src = fluid.layers.data("src_ids", [8], dtype="int64")
+            tgt = fluid.layers.data("tgt_ids", [8], dtype="int64")
+            transformer_nmt(src, tgt, None, None, src_len=8, tgt_len=8)
+        elif family == "deepfm":
+            ids = fluid.layers.data("feat_ids", [39, 1], dtype="int64")
+            vals = fluid.layers.data("feat_vals", [39])
+            lbl = fluid.layers.data("lbl", [1], dtype="int64")
+            models.deepfm_ctr(ids, vals, lbl, num_features=1000,
+                              num_fields=39, embed_dim=8,
+                              deep_layers=(16, 16))
+        else:
+            raise ValueError("unknown family %r" % family)
+    # the same predicate save_inference_model validates against
+    # (io._is_persistable): persistable non-Parameter vars — e.g. batch
+    # norm running stats — must be covered too, or this guard would
+    # green-light layouts the export path rejects
+    return {
+        v.name: tuple(v.shape or ())
+        for v in prog.list_vars()
+        if v.persistable and not v.is_data
+    }
+
+
+def check() -> List[str]:
+    from paddle_tpu.sharding.layouts import FAMILIES, MODES, canonical_rules
+    from paddle_tpu.sharding.rules import ShardingRuleError
+
+    problems: List[str] = []
+    for family in sorted(FAMILIES):
+        params = _build(family)
+        if not params:
+            problems.append("family %r built zero parameters" % family)
+            continue
+        for mode in MODES:
+            rules = canonical_rules(family, mode)
+            try:
+                rules.match(params)
+            except ShardingRuleError as e:
+                problems.append(
+                    "layout %s/%s does not cover its family: %s"
+                    % (family, mode, e))
+            for pat in rules.dead_rules(params):
+                problems.append(
+                    "layout %s/%s rule %r matches no %s parameter "
+                    "(dead rule)" % (family, mode, pat, family))
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if not problems:
+        from paddle_tpu.sharding.layouts import FAMILIES, MODES
+
+        print("check_partition_rules: OK (%d layouts cover %d families)"
+              % (len(FAMILIES) * len(MODES), len(FAMILIES)))
+        return 0
+    for p in problems:
+        print("check_partition_rules: %s" % p, file=sys.stderr)
+    print("check_partition_rules: %d problem(s)" % len(problems),
+          file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    sys.exit(main())
