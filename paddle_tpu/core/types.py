@@ -37,6 +37,20 @@ _DTYPE_ALIASES = {
 }
 
 
+# serving precision-variant labels (the bf16/int8 compiled variants plus
+# the fp32 base program) — ONE alias map shared by AnalysisPredictor's
+# dispatch, InferenceServer.submit's validation, and the mixed-precision
+# export, so the accepted request-facing spelling set can never drift
+# between the layers (a dtype submit admits must be one the predictor
+# serves).  Distinct from _DTYPE_ALIASES above: these canonicalize to
+# the short variant labels ("bf16"), not numpy dtype names.
+PRECISION_ALIASES = {
+    "bf16": "bf16", "bfloat16": "bf16",
+    "int8": "int8",
+    "fp32": "fp32", "float32": "fp32",
+}
+
+
 def canonical_dtype(dtype) -> str:
     if isinstance(dtype, str):
         key = dtype.lower()

@@ -143,7 +143,10 @@ def _donate_kwargs(device) -> Dict[str, Any]:
     (reproduced: a DynamicRNN+Adam module fetches its rnn output
     computed with POST-update weights on every warm-cache process;
     cold compiles are always correct).  So: donate everywhere except
-    CPU (tests/test_dispatch_fastpath.py pins the policy)."""
+    CPU — tests/test_dispatch_fastpath.py pins the kwargs policy and
+    tests/test_donation_cache.py pins the HAZARD itself with a
+    two-process shared-cache drill (re-enabling donation here makes
+    the warm-cache process disagree with the cold one)."""
     if getattr(device, "platform", None) == "cpu":
         return {}
     return {"donate_argnums": (0,)}

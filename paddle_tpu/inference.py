@@ -37,6 +37,10 @@ _MON_PRED_WASTE_ROWS = _mon_registry.REGISTRY.counter(
     "predictor_padding_waste_rows_total",
     "padding rows computed then sliced away (padded - valid)")
 
+# dispatch-time dtype aliases: the shared precision-label map (one dict
+# lookup per run; no contrib import on the hot path)
+from paddle_tpu.core.types import PRECISION_ALIASES as _DTYPE_ALIASES
+
 
 class AnalysisConfig:
     """reference: api/paddle_analysis_config.h."""
@@ -94,6 +98,16 @@ class AnalysisPredictor(PaddlePredictor):
             )
         self._fetch_names = [v.name for v in self._fetch_vars]
         self._jit_cache: Dict[Any, Any] = {}
+        # a saved precision manifest (save_inference_model's
+        # precision_policy=) reconstructs the SAME low-precision
+        # serving variant here: requests default to the policy dtype,
+        # precision="fp32" opts a request back onto the base program
+        self._precision: Optional[Dict[str, Any]] = None
+        self._default_dtype = "fp32"
+        self._variants: Dict[str, Any] = {}  # dtype -> (program, scope)
+        pmanifest = getattr(self._program, "_precision_manifest", None)
+        if pmanifest:
+            self._init_precision(pmanifest, config)
         # a saved sharding manifest (save_inference_model's
         # sharding_rules=) reconstructs the SAME model-parallel layout
         # here: this predictor then owns a mesh-spanning group of
@@ -114,6 +128,84 @@ class AnalysisPredictor(PaddlePredictor):
             self.with_sharding_rules(
                 PartitionRules.from_manifest(rules_doc),
                 mesh_axes=manifest.get("mesh_axes"))
+
+    # --- TPU-native precision surface (contrib/mixed_precision) ---
+    def _init_precision(self, manifest: Dict[str, Any],
+                        config: AnalysisConfig) -> None:
+        """Rebuild the endpoint's low-precision variant from its
+        manifest: bf16 re-runs the deterministic rewrite on the loaded
+        program and casts the hoisted params ONCE at placement time
+        (the variant scope holds bf16 copies resident in HBM); int8
+        loads the frozen sub-model (int8 weights + dequantize ops) the
+        export materialized.  Both run through the SAME executor, so
+        the jit/plan caches and ``jit_cache_stats`` cover every
+        variant."""
+        import os
+
+        import paddle_tpu as fluid
+        from paddle_tpu.contrib.mixed_precision import inference as mp_inf
+
+        dtype = mp_inf.normalize_dtype(manifest.get("dtype", ""))
+        if dtype == "bf16":
+            variant, info = mp_inf.build_bf16_variant(
+                self._program, self._fetch_names,
+                custom_white_list=manifest.get("custom_white_list"),
+                custom_black_list=manifest.get("custom_black_list"))
+            vscope = mp_inf.variant_scope(
+                variant, self._scope, set(info["cast_params"]))
+        elif dtype == "int8":
+            vdir = manifest.get("variant_dir")
+            if not vdir:
+                raise mp_inf.PrecisionPolicyError(
+                    "int8 precision manifest in %r is missing "
+                    "'variant_dir' (the frozen sub-model)"
+                    % (config.model_dir,))
+            vscope = fluid.Scope()
+            with fluid.scope_guard(vscope):
+                variant, _, _ = io.load_inference_model(
+                    os.path.join(config.model_dir, vdir), self._exe)
+        else:
+            raise mp_inf.PrecisionPolicyError(
+                "unsupported precision manifest dtype %r in %r"
+                % (manifest.get("dtype"), config.model_dir))
+        self._precision = dict(manifest)
+        self._default_dtype = dtype
+        self._variants[dtype] = (variant, vscope)
+
+    @property
+    def precision_policy(self) -> Optional[Dict[str, Any]]:
+        """The endpoint's saved precision policy (dtype, rtol, measured
+        ``max_rel_err``), or None for a plain fp32 endpoint."""
+        return dict(self._precision) if self._precision else None
+
+    def precision_dtypes(self) -> List[str]:
+        """Serving dtypes this predictor dispatches, DEFAULT FIRST:
+        ``["bf16", "fp32"]`` for a bf16-policy endpoint (fp32 stays
+        available as the per-request opt-out), ``["fp32"]`` without a
+        policy.  The serving warmup compiles every bucket rung for
+        every entry here, so the per-request choice never compiles."""
+        if self._precision is None:
+            return ["fp32"]
+        return [self._default_dtype, "fp32"]
+
+    def _select_variant(self, precision: Optional[str]):
+        """(program, scope) for one dispatch.  ``None`` = the policy
+        default; ``"fp32"`` = the base program (per-request opt-out)."""
+        d = self._default_dtype if precision is None else (
+            _DTYPE_ALIASES.get(str(precision).lower()))
+        if d is None:
+            raise ValueError(
+                "unknown precision %r (endpoint serves %s)"
+                % (precision, self.precision_dtypes()))
+        if d == "fp32":
+            return (self._compiled if self._compiled is not None
+                    else self._program), self._scope
+        entry = self._variants.get(d)
+        if entry is None:
+            raise ValueError(
+                "endpoint has no %r variant (it serves %s)"
+                % (d, self.precision_dtypes()))
+        return entry
 
     # --- TPU-native sharding surface (paddle_tpu/sharding) ---
     def with_sharding_rules(self, rules, mesh=None,
@@ -210,33 +302,42 @@ class AnalysisPredictor(PaddlePredictor):
         return list(self._fetch_names)
 
     def run(self, feed: Dict[str, np.ndarray] | Sequence[np.ndarray],
-            return_numpy: bool = True):
+            return_numpy: bool = True, precision: Optional[str] = None):
         """One predictor dispatch.  ``return_numpy=False`` is the
         non-blocking fast path: outputs come back as device arrays
         WITHOUT forcing a device-to-host sync, so the caller can
         dispatch the next batch while this one's d2h transfer (a later
         ``np.asarray``) overlaps it — the serving worker's double-buffer
-        discipline (paddle_tpu/serving/server.py)."""
+        discipline (paddle_tpu/serving/server.py).
+
+        ``precision``: which compiled variant serves this call — None
+        runs the endpoint's policy default (the bf16/int8 variant when
+        a precision manifest is loaded), ``"fp32"`` is the per-request
+        opt-out onto the base program.  All variants share one executor
+        (one jit cache, one recompile ground truth)."""
         import paddle_tpu as fluid
 
         if not isinstance(feed, dict):
             feed = dict(zip(self._feed_names, feed))
         _MON_PRED_RUNS.inc()
-        with fluid.scope_guard(self._scope):
+        # hot-path: begin predictor_dispatch (variant select is one dict
+        # lookup; all rewrite/cast work happened at load time, never here)
+        target, scope = self._select_variant(precision)
+        with fluid.scope_guard(scope):
             return self._exe.run(
                 # a sharded predictor dispatches through its
                 # CompiledProgram so every run places/pins per the rules
-                self._compiled if self._compiled is not None
-                else self._program,
+                target,
                 feed=feed, fetch_list=self._fetch_names,
                 return_numpy=return_numpy,
             )
+        # hot-path: end predictor_dispatch
 
     Run = run  # C++-style alias
 
     # --- TPU-native serving surface (paddle_tpu/serving) ---
     def run_padded(self, feed: Dict[str, np.ndarray], n_valid: Optional[int] = None,
-                   return_numpy: bool = True):
+                   return_numpy: bool = True, precision: Optional[str] = None):
         """Batched-run entry for pre-padded bucket feeds.
 
         The serving layer pads every coalesced batch up to a fixed
@@ -247,6 +348,9 @@ class AnalysisPredictor(PaddlePredictor):
         pass through untouched).  All feeds must agree on the padded
         leading dim.  With ``return_numpy=False`` outputs stay device
         arrays (the n_valid slice is a lazy device op) — no d2h sync.
+        ``precision`` selects the compiled variant (see :meth:`run`);
+        the serving layer groups batches by it, so one padded batch is
+        always one variant.
         """
         if not isinstance(feed, dict):
             feed = dict(zip(self._feed_names, feed))
@@ -278,7 +382,8 @@ class AnalysisPredictor(PaddlePredictor):
             _sid = _mon_spans.push_parent()
         _err = False
         try:
-            outs = self.run(feed, return_numpy=return_numpy)
+            outs = self.run(feed, return_numpy=return_numpy,
+                            precision=precision)
         except BaseException:
             _err = True
             raise

@@ -181,12 +181,18 @@ def _prune_program(program: Program, feed_names: Sequence[str], fetch_names: Seq
 
 
 def _save_model(dirname, program, feed_names, fetch_names, executor,
-                model_filename=None, params_filename=None, sharding=None):
+                model_filename=None, params_filename=None, sharding=None,
+                precision=None, scope=None):
     """Shared save path for save_inference_model / save_program: the
     ``__model__`` JSON + persistable ``.npy`` layout consumed by both
     load_inference_model and the native C++ runtime (predictor.cc).
     ``sharding``: the partition-rule manifest (``{"mesh_axes": ...,
-    "rules": ...}``) a sharded endpoint carries with its weights."""
+    "rules": ...}``) a sharded endpoint carries with its weights.
+    ``precision``: the precision-policy manifest (``{"dtype": ...,
+    "rtol": ...}``) a mixed-precision endpoint carries so every loader
+    reconstructs the same low-precision variant.  ``scope``: read
+    values from this scope instead of the current global one (the int8
+    variant sub-model saves from its calibration scratch scope)."""
     os.makedirs(dirname, exist_ok=True)
     model = {
         "format_version": 1,
@@ -196,12 +202,15 @@ def _save_model(dirname, program, feed_names, fetch_names, executor,
     }
     if sharding is not None:
         model["sharding"] = sharding
+    if precision is not None:
+        model["precision"] = precision
     with open(os.path.join(dirname, model_filename or _MODEL_FILE), "w") as f:
         json.dump(model, f)
     save_vars(
         executor, dirname, program,
         predicate=_is_persistable,
         filename=params_filename,
+        scope=scope,
     )
     return list(fetch_names)
 
@@ -230,6 +239,112 @@ def save_program(
                        executor, model_filename, params_filename)
 
 
+def _export_precision_variant(dirname, pruned, feed_names, fetch_names,
+                              executor, policy):
+    """Build + parity-gate a low-precision variant of ``pruned`` and
+    return its manifest block (the ``precision`` entry of
+    ``__model__``).
+
+    ``policy``: ``{"dtype": "bf16"|"int8", "rtol": float?,
+    "custom_white_list"/"custom_black_list": [...]?,
+    "calibration": [feed dicts] (int8 only),
+    "parity_feeds": [feed dicts]?}``.
+
+    The parity gate runs the variant against the fp32 program on the
+    parity feeds and REFUSES the export (typed
+    ``PrecisionParityError``) when the measured max relative error
+    exceeds the policy's rtol; the measured value rides the manifest as
+    the endpoint's advertised accuracy bound.  An int8 variant is
+    additionally materialized as a sub-model (frozen program + int8
+    weights) under ``dirname/<variant_dir>`` — bf16 needs no extra
+    weights on disk (the loader rebuilds the rewrite and casts params
+    at placement time)."""
+    from paddle_tpu.contrib.mixed_precision import inference as mp_inf
+    from paddle_tpu.scope import global_scope, scope_guard
+
+    policy = dict(policy)
+    dtype = mp_inf.normalize_dtype(policy.pop("dtype", None) or "")
+    if dtype == "fp32":
+        raise mp_inf.PrecisionPolicyError(
+            "precision_policy dtype 'fp32' is the base model — pass no "
+            "policy instead")
+    rtol = float(policy.pop("rtol", mp_inf.DEFAULT_RTOL[dtype]))
+    parity_feeds = policy.pop("parity_feeds", None) or (
+        mp_inf.synthetic_parity_feeds(pruned, feed_names))
+    # every known key pops BEFORE dispatching on dtype, so validation
+    # is symmetric: an unknown key is typed for both dtypes, and a
+    # known key the chosen dtype cannot honor is refused loudly rather
+    # than silently discarded (a user who passed calibration feeds must
+    # not be left believing calibration happened)
+    wl = policy.pop("custom_white_list", None)
+    bl = policy.pop("custom_black_list", None)
+    calibration = policy.pop("calibration", None)
+    if policy:
+        raise mp_inf.PrecisionPolicyError(
+            "unknown precision_policy keys %s" % sorted(policy))
+    manifest = {"dtype": dtype, "rtol": rtol}
+    if dtype == "bf16":
+        if calibration:
+            raise mp_inf.PrecisionPolicyError(
+                "'calibration' is an int8-only policy key — the bf16 "
+                "rewrite needs no calibration data (drop the key, or "
+                "export with dtype='int8')")
+        variant, info = mp_inf.build_bf16_variant(
+            pruned, fetch_names, custom_white_list=wl,
+            custom_black_list=bl)
+        vscope = mp_inf.variant_scope(
+            variant, global_scope(), set(info["cast_params"]))
+        if wl:
+            manifest["custom_white_list"] = sorted(wl)
+        if bl:
+            manifest["custom_black_list"] = sorted(bl)
+        manifest["cast_params"] = len(info["cast_params"])
+    else:  # int8 via the contrib/quantize seam
+        from paddle_tpu.contrib.quantize import calibrate_int8_program
+
+        if wl or bl:
+            raise mp_inf.PrecisionPolicyError(
+                "custom_white_list/custom_black_list are bf16-only "
+                "policy keys — the int8 path quantizes the slim pass's "
+                "fixed op set")
+        if not calibration:
+            raise mp_inf.PrecisionPolicyError(
+                "precision_policy dtype 'int8' needs calibration data "
+                "(policy['calibration'] = [feed dicts] — "
+                "bench_calibration.py-style representative batches)")
+        variant, vscope = calibrate_int8_program(
+            pruned, executor, calibration, fetch_names)
+    # parity gate: fp32 vs variant on every parity feed, worst rel err
+    worst = 0.0
+    for feed in parity_feeds:
+        ref = executor.run(pruned, feed=feed, fetch_list=list(fetch_names))
+        with scope_guard(vscope):
+            outs = executor.run(
+                variant, feed=feed, fetch_list=list(fetch_names))
+        worst = max(worst, mp_inf.max_rel_err(ref, outs))
+    if worst > rtol:
+        raise mp_inf.PrecisionParityError(
+            "%s variant disagrees with fp32 beyond the policy bound: "
+            "max_rel_err=%.4g > rtol=%.4g — loosen the policy rtol or "
+            "blacklist the offending ops" % (dtype, worst, rtol))
+    manifest["max_rel_err"] = float(worst)
+    if dtype == "int8":
+        # drop block vars nothing references any more (the freeze pass
+        # leaves the original fp32 weights behind) so the sub-model
+        # saves only the int8 state — the 4x disk/HBM win is the point
+        block = variant.global_block()
+        used = set(feed_names) | set(fetch_names)
+        for op in block.ops:
+            used.update(op.input_arg_names)
+            used.update(op.output_arg_names)
+        block.vars = {n: v for n, v in block.vars.items() if n in used}
+        variant_dir = "__int8__"
+        _save_model(os.path.join(dirname, variant_dir), variant,
+                    feed_names, fetch_names, executor, scope=vscope)
+        manifest["variant_dir"] = variant_dir
+    return manifest
+
+
 def save_inference_model(
     dirname,
     feeded_var_names: Sequence[str],
@@ -240,6 +355,7 @@ def save_inference_model(
     params_filename=None,
     sharding_rules=None,
     sharding_mesh=None,
+    precision_policy=None,
 ):
     """reference: io.py:925 — prune + save program and params.
 
@@ -250,10 +366,30 @@ def save_inference_model(
     ``AnalysisPredictor``, a ``ServingProcess`` child — reconstructs
     the SAME model-parallel layout.  The rules are validated against
     the pruned program's persistables HERE (full coverage, rank
-    checks), so a bad layout fails at export, not in a serving child."""
+    checks), so a bad layout fails at export, not in a serving child.
+
+    ``precision_policy`` (TPU-native extension): a per-endpoint
+    low-precision serving policy (``{"dtype": "bf16"|"int8", "rtol":
+    float, ...}`` — see :func:`_export_precision_variant`) embedded in
+    the manifest after its variant PASSES the parity gate here, so
+    every loader serves the same variant and the endpoint's accuracy
+    bound is a measured, exported fact."""
     program = main_program or framework.default_main_program()
     fetch_names = [t.name if isinstance(t, Variable) else str(t) for t in target_vars]
     pruned = _prune_program(program, feeded_var_names, fetch_names)
+    if precision_policy is not None and sharding_rules is not None:
+        from paddle_tpu.contrib.mixed_precision.inference import (
+            PrecisionPolicyError,
+        )
+
+        raise PrecisionPolicyError(
+            "precision_policy and sharding_rules are not yet composable "
+            "on one endpoint — export two models or drop one")
+    precision = None
+    if precision_policy is not None:
+        precision = _export_precision_variant(
+            dirname, pruned, list(feeded_var_names), fetch_names,
+            executor, precision_policy)
     sharding = None
     if sharding_rules is not None:
         from paddle_tpu.sharding.rules import PartitionRules, ShardingRuleError
@@ -293,18 +429,21 @@ def save_inference_model(
         }
     return _save_model(dirname, pruned, feeded_var_names, fetch_names,
                        executor, model_filename, params_filename,
-                       sharding=sharding)
+                       sharding=sharding, precision=precision)
 
 
 def load_inference_model(dirname, executor, model_filename=None, params_filename=None):
     """reference: io.py:1116 — returns (program, feed_names, fetch_vars).
     A saved sharding manifest rides back on the program as
-    ``program._sharding_manifest`` (AnalysisPredictor consumes it)."""
+    ``program._sharding_manifest``, a precision-policy manifest as
+    ``program._precision_manifest`` (AnalysisPredictor consumes both)."""
     with open(os.path.join(dirname, model_filename or _MODEL_FILE)) as f:
         model = json.load(f)
     program = Program.from_json(json.dumps(model["program"]))
     if model.get("sharding"):
         program._sharding_manifest = model["sharding"]
+    if model.get("precision"):
+        program._precision_manifest = model["precision"]
     load_vars(executor, dirname, program, filename=params_filename)
     fetch_vars = [program.global_block().var(n) for n in model["fetch_names"]]
     return program, model["feed_names"], fetch_vars

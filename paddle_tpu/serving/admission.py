@@ -191,6 +191,13 @@ class AdmissionQueue:
                 return 0.0
             return self._live / float(self._limit)
 
+    @property
+    def wait_ewma_ms(self) -> float:
+        """The observed queue-wait EWMA in ms (the signal the AIMD
+        limit and the ladder autotuner's batch-window proposal read)."""
+        with self.cv:
+            return self._wait_ewma * 1e3
+
     def retry_after_ms(self) -> float:
         """The shed hint: how long a rejected caller should back off —
         the EWMA queue wait scaled by the current overload ratio, never

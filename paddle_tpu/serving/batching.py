@@ -50,19 +50,26 @@ class ServingRequest:
     its tail-sampled record by it.  ``parent_span`` (optional) is the
     submitter-side span id the request's own spans hang under — the
     client's infer span in-process, or the wire server's request span
-    when the request arrived over a transport hop."""
+    when the request arrived over a transport hop.
+
+    ``precision`` (optional) is the request's compiled-variant choice
+    on a mixed-precision endpoint: None serves the endpoint's policy
+    default, ``"fp32"`` is the per-request opt-out.  A batch is always
+    ONE variant — the coalescing loop never mixes precisions."""
 
     def __init__(self, feed: Dict[str, np.ndarray], n_rows: int,
                  deadline: Optional[float] = None,
                  trace_id: Optional[str] = None,
                  parent_span: Optional[str] = None,
-                 priority: int = PRIORITY_NORMAL):
+                 priority: int = PRIORITY_NORMAL,
+                 precision: Optional[str] = None):
         self.feed = feed
         self.n_rows = n_rows
         self.deadline = deadline  # time.monotonic() deadline, or None
         self.priority = int(priority)
         self.trace_id = trace_id
         self.parent_span = parent_span
+        self.precision = precision
         self.submit_t = time.perf_counter()
         self.done_t: Optional[float] = None  # perf_counter at completion
         self._done = threading.Event()
@@ -258,6 +265,13 @@ class DynamicBatcher:
                 continue  # window re-checked at loop top
             if rows + req.n_rows > self.max_batch_size:
                 self._carry = req  # never split a request across batches
+                break
+            if (getattr(req, "precision", None)
+                    != getattr(first, "precision", None)):
+                # one batch = one compiled precision variant; a
+                # mismatched arrival opens the NEXT batch (same carry
+                # slot as a size overflow — never dropped, never mixed)
+                self._carry = req
                 break
             batch.append(req)
             rows += req.n_rows

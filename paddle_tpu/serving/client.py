@@ -42,21 +42,26 @@ class Client:
 
     def infer(self, feed, timeout_ms: Optional[float] = None,
               trace_id: Optional[str] = None,
-              priority: int = PRIORITY_NORMAL) -> List[np.ndarray]:
+              priority: int = PRIORITY_NORMAL,
+              precision: Optional[str] = None) -> List[np.ndarray]:
         """Submit one request and block for its outputs (list ordered
         like the predictor's fetch list).  ``priority`` is the admission
         class (``serving.admission.PRIORITY_*``, lower = more
         important): under overload the server sheds low priority first.
+        ``precision`` picks the compiled variant on a mixed-precision
+        endpoint (``"fp32"`` opts this request out of the policy
+        default; both are warmed, so neither choice compiles).
         ``trace_id`` joins the call to an existing trace; by default a
         fresh id is minted — read it back via ``last_trace_id``."""
         tid = trace_id or monitor.new_trace_id()
         self.last_trace_id = tid
+        kw = {"precision": precision} if precision is not None else {}
         fr = _flight.get()
         rec = _spans.recording() or fr is not None
         if not rec:
             return self._server.submit(
                 feed, timeout_ms=timeout_ms, trace_id=tid,
-                priority=priority).result()
+                priority=priority, **kw).result()
         t0 = time.perf_counter()
         err: Optional[BaseException] = None
         sid = _spans.new_span_id()
@@ -65,7 +70,7 @@ class Client:
                 with _spans.parent_scope(sid):
                     return self._server.submit(
                         feed, timeout_ms=timeout_ms, trace_id=tid,
-                        parent_span=sid, priority=priority).result()
+                        parent_span=sid, priority=priority, **kw).result()
         except BaseException as e:  # noqa: BLE001 — observed, re-raised
             err = e
             raise

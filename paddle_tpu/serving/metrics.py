@@ -59,6 +59,18 @@ _BATCH_ROWS = monitor.counter(
 _BATCH_VALID_ROWS = monitor.counter(
     "serving_batch_valid_rows_total",
     "valid (non-padding) rows in executed batches", _LABELS)
+_PRECISION_REQS = monitor.counter(
+    "serving_precision_requests_total",
+    "requests served per compiled precision variant",
+    _LABELS + ("dtype",))
+_LADDER_REPLANS = monitor.counter(
+    "serving_ladder_replans_total",
+    "bucket-ladder re-plans applied behind the warmup barrier",
+    _LABELS)
+_PADDING_WASTE = monitor.gauge(
+    "serving_padding_waste_ratio",
+    "cumulative padding rows / padded rows for this endpoint (the "
+    "bucket ladder's rent; the autotuner's objective)", _LABELS)
 
 # distinguishes same-named servers constructed in one process
 _instance_seq = itertools.count()
@@ -73,11 +85,20 @@ class ServingMetrics:
         self._latency = _LATENCY.labels(**lbl)
         self._batch_rows = _BATCH_ROWS.labels(**lbl)
         self._batch_valid = _BATCH_VALID_ROWS.labels(**lbl)
+        self._replans = _LADDER_REPLANS.labels(**lbl)
+        self._waste_gauge = _PADDING_WASTE.labels(**lbl)
+        self._precision_children: Dict[str, object] = {}  # dtype -> child
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
         self._latencies: deque = deque(maxlen=_RESERVOIR)  # seconds, per request
         # bucket -> [n_batches, total_valid_rows]
         self._occupancy: Dict[int, list] = {}
+        # request n_rows -> count: the observed ARRIVAL-size histogram
+        # the ladder autotuner plans from (request sizes, not batch
+        # sizes — rung spacing must fit what callers actually send)
+        self._arrivals: Dict[int, int] = {}
+        self._padded_rows = 0   # cumulative bucket rows executed
+        self._valid_rows = 0    # cumulative valid rows executed
 
     # ------------------------------------------------------------------
     def close(self) -> None:
@@ -87,12 +108,46 @@ class ServingMetrics:
         repeatedly doesn't grow /metrics without bound."""
         lbl = {"server": self.name, "instance": self.instance}
         for metric in list(_COUNTERS.values()) + [
-                _LATENCY, _BATCH_ROWS, _BATCH_VALID_ROWS]:
+                _LATENCY, _BATCH_ROWS, _BATCH_VALID_ROWS,
+                _LADDER_REPLANS, _PADDING_WASTE]:
             metric.remove_labels(**lbl)
+        with self._lock:
+            dtypes = list(self._precision_children)
+        for dtype in dtypes:
+            _PRECISION_REQS.remove_labels(dtype=dtype, **lbl)
 
     # ------------------------------------------------------------------
     def count(self, key: str, n: int = 1) -> None:
         self._c[key].inc(n)
+
+    def count_precision(self, dtype: str, n: int = 1) -> None:
+        """``n`` requests served by the ``dtype`` compiled variant.
+        Child creation is under the instance lock — replica workers
+        race the first request of a dtype against snapshot()/close()
+        iterating the children."""
+        with self._lock:
+            child = self._precision_children.get(dtype)
+            if child is None:
+                child = self._precision_children[dtype] = (
+                    _PRECISION_REQS.labels(
+                        server=self.name, instance=self.instance,
+                        dtype=dtype))
+        child.inc(n)
+
+    def count_replan(self) -> None:
+        """One applied bucket-ladder re-plan."""
+        self._replans.inc()
+
+    def observe_arrival(self, n_rows: int) -> None:
+        """Record one request's row count into the arrival histogram."""
+        with self._lock:
+            self._arrivals[n_rows] = self._arrivals.get(n_rows, 0) + 1
+
+    def arrival_histogram(self) -> Dict[int, int]:
+        """Snapshot of the observed request-size distribution (the
+        autotuner's input)."""
+        with self._lock:
+            return dict(self._arrivals)
 
     def observe_request(self, latency_s: float,
                         trace_id: Optional[str] = None) -> None:
@@ -119,6 +174,12 @@ class ServingMetrics:
             ent = self._occupancy.setdefault(bucket, [0, 0])
             ent[0] += 1
             ent[1] += valid
+            self._padded_rows += bucket
+            self._valid_rows += valid
+            waste = 1.0 - self._valid_rows / self._padded_rows
+        # cumulative padding waste — the measured number the autotuned
+        # ladder must strictly reduce (bench_serving reports it)
+        self._waste_gauge.set(round(waste, 6))
         event = {
             "event": "serving.batch",
             "server": self.name,
@@ -139,6 +200,9 @@ class ServingMetrics:
             lats = np.asarray(self._latencies, dtype=np.float64)
             occupancy = {b: tuple(v) for b, v in self._occupancy.items()}
             elapsed = time.perf_counter() - self._t0
+            arrivals = dict(self._arrivals)
+            padded_rows, valid_rows = self._padded_rows, self._valid_rows
+            precision_children = dict(self._precision_children)
         snap: Dict[str, object] = dict(counters)
         snap["elapsed_s"] = round(elapsed, 3)
         snap["qps"] = round(counters["completed"] / elapsed, 2) if elapsed > 0 else 0.0
@@ -155,4 +219,13 @@ class ServingMetrics:
             str(b): {"batches": n, "valid_rows": v}
             for b, (n, v) in sorted(occupancy.items())
         }
+        snap["arrival_histogram"] = {
+            str(k): v for k, v in sorted(arrivals.items())}
+        snap["padding_waste_ratio"] = (
+            round(1.0 - valid_rows / padded_rows, 4) if padded_rows
+            else None)
+        snap["ladder_replans"] = int(self._replans.value)
+        snap["precision_requests"] = {
+            dtype: int(child.value)
+            for dtype, child in precision_children.items()}
         return snap

@@ -84,6 +84,11 @@ _MAX_IN_FLIGHT = 2
 # consecutive batch failures before a replica is retired from routing
 _REPLICA_FAIL_LIMIT = 3
 
+# request-facing dtype aliases: the same shared map AnalysisPredictor
+# dispatches by, so submit() can never admit a spelling the predictor
+# would then reject (one dict lookup, no contrib import)
+from paddle_tpu.core.types import PRECISION_ALIASES as _PRECISION_ALIASES
+
 # safety-net bound for the routing capacity wait (real wakeups are
 # notifies from _release/_retire/stop)
 _ROUTE_WAIT_S = 0.5
@@ -93,9 +98,9 @@ class _Replica:
     """One predictor behind the shared batcher: its own worker thread,
     bounded in-flight accounting, and health state."""
 
-    __slots__ = ("idx", "name", "predictor", "nonblocking", "lock", "q",
-                 "thread", "alive", "in_flight", "executed", "failed",
-                 "consec_failures", "retired_at", "removed")
+    __slots__ = ("idx", "name", "predictor", "nonblocking", "precision",
+                 "lock", "q", "thread", "alive", "in_flight", "executed",
+                 "failed", "consec_failures", "retired_at", "removed")
 
     def __init__(self, idx: int, predictor):
         self.idx = idx
@@ -103,14 +108,18 @@ class _Replica:
         self.predictor = predictor
         # non-blocking fetch (AnalysisPredictor return_numpy=False) lets
         # the replica overlap batch N's d2h with batch N+1's dispatch; a
-        # duck-typed predictor without the kwarg runs synchronously
+        # duck-typed predictor without the kwarg runs synchronously.
+        # precision-variant dispatch (run_padded precision=) is detected
+        # the same way so duck-typed test predictors keep working.
         import inspect
 
         try:
-            self.nonblocking = "return_numpy" in inspect.signature(
-                predictor.run_padded).parameters
+            params = inspect.signature(predictor.run_padded).parameters
+            self.nonblocking = "return_numpy" in params
+            self.precision = "precision" in params
         except (TypeError, ValueError):
             self.nonblocking = False
+            self.precision = False
         self.lock = threading.Lock()  # warmup vs worker predictor use
         self.q: "queue.Queue" = queue.Queue()  # (batch, retries) | None
         self.thread: Optional[threading.Thread] = None
@@ -187,6 +196,22 @@ class InferenceServer:
         self._specs = (
             dict(input_specs) if input_specs else predictors[0].input_specs())
         self._feed_names = list(predictors[0].get_input_names())
+        # mixed-precision endpoints: the serving dtypes, default first
+        # (AnalysisPredictor.precision_dtypes); warmup compiles every
+        # bucket rung for EVERY entry so the per-request choice (policy
+        # default vs fp32 opt-out) never compiles
+        dts = getattr(predictors[0], "precision_dtypes", None)
+        if callable(dts) and self._replicas[0].precision:
+            self._precision_dtypes = [str(d) for d in dts()]
+        else:
+            self._precision_dtypes = ["fp32"]
+        self._default_dtype = self._precision_dtypes[0]
+        # rungs already compiled on every replica (warmup + replan
+        # barriers); replan_ladder only warms the DELTA
+        self._warmed_rungs: set = set()
+        self._autotune_thread: Optional[threading.Thread] = None
+        self._autotune_stop: Optional[threading.Event] = None
+        self._replan_lock = threading.Lock()
         self._stop = threading.Event()
         self._closed = False           # admission gate (set before _stop on shutdown)
         self._abort = False            # stop(drain=False): fail instead of route
@@ -239,6 +264,12 @@ class InferenceServer:
         snap["admit_limit"] = self._batcher.queue.limit
         snap["brownout_level"] = self._brownout.level
         snap["bucket_ladder"] = self.bucket_ladder
+        snap["batch_timeout_ms"] = self._batcher.batch_timeout_s * 1e3
+        # exported so a recorded /statusz snapshot is a complete input
+        # for tools/autotune_ladder.py (ladder + histogram + wait EWMA)
+        snap["queue_wait_ewma_ms"] = round(
+            self._batcher.queue.wait_ewma_ms, 3)
+        snap["precision_dtypes"] = list(self._precision_dtypes)
         snap["warmed_up"] = self._warmed
         snap["replicas"] = self.replica_stats()
         return snap
@@ -385,19 +416,8 @@ class InferenceServer:
                     cache_dir or bench_common.HOME_CACHE_DIR)
             except (ImportError, AttributeError):
                 pass  # standalone use / foreign bench_common: compile cold
-        compiles = 0
+        compiles = self._warm_rungs(self._policy.ladder)
         for rep in self._replicas:
-            misses0 = rep.predictor.jit_cache_stats()["misses"]
-            for bucket in self._policy.ladder:
-                feed = {
-                    name: np.zeros((bucket,) + tuple(shape), dtype)
-                    for name, (shape, dtype) in self._specs.items()
-                }
-                with rep.lock:
-                    with profiler.RecordEvent(
-                            "serving/%s/warmup" % self.name):
-                        rep.predictor.run_padded(feed, n_valid=bucket)
-            compiles += rep.predictor.jit_cache_stats()["misses"] - misses0
             # a mesh-spanning (sharded) replica publishes its per-device
             # HBM footprint now that warmup placed every param per its
             # rule (sharding_group_hbm_bytes gauge, one series per
@@ -410,12 +430,127 @@ class InferenceServer:
         self._warmed = True
         return compiles
 
+    def _warm_rungs(self, rungs) -> int:
+        """Compile ``rungs`` on every replica, for EVERY precision
+        dtype the endpoint serves, skipping rungs already warmed —
+        shared by ``warmup()`` and the autotuner's re-plan barrier
+        (a new ladder compiles HERE, while the old ladder still serves
+        traffic, so a ladder change never serves a cold cache).
+        Returns the number of XLA compiles performed."""
+        compiles = 0
+        todo = [b for b in rungs if b not in self._warmed_rungs]
+        if not todo:
+            return 0
+        for rep in self._replicas:
+            misses0 = rep.predictor.jit_cache_stats()["misses"]
+            for bucket in todo:
+                feed = {
+                    name: np.zeros((bucket,) + tuple(shape), dtype)
+                    for name, (shape, dtype) in self._specs.items()
+                }
+                for pdtype in (self._precision_dtypes if rep.precision
+                               else (None,)):
+                    kw = {"precision": pdtype} if pdtype is not None else {}
+                    with rep.lock:
+                        with profiler.RecordEvent(
+                                "serving/%s/warmup" % self.name):
+                            rep.predictor.run_padded(
+                                feed, n_valid=bucket, **kw)
+            compiles += rep.predictor.jit_cache_stats()["misses"] - misses0
+        self._warmed_rungs.update(todo)
+        return compiles
+
+    # ------------------------------------------------------------------
+    def replan_ladder(self, ladder: Optional[Sequence[int]] = None,
+                      batch_timeout_ms: Optional[float] = None,
+                      max_rungs: int = 8) -> Dict[str, object]:
+        """Re-plan the bucket ladder behind a warmup barrier.
+
+        With ``ladder=None`` the new ladder (and, unless overridden,
+        the batch window) comes from ``serving.autotune.plan`` over
+        this server's observed arrival-size histogram and queue-wait
+        EWMA.  Any NEW rungs are compiled on every replica (every
+        precision dtype) BEFORE the policy reference is swapped, so a
+        re-plan never causes a recompiled request — the old ladder
+        keeps serving until the new one is hot.  Returns the applied
+        plan; increments ``serving_ladder_replans_total`` only when the
+        ladder actually changed."""
+        from paddle_tpu.serving import autotune
+
+        with self._replan_lock:
+            proposal = None
+            if ladder is None:
+                proposal = autotune.plan(
+                    self._metrics.arrival_histogram(),
+                    self.max_batch_size, self._policy.ladder,
+                    queue_wait_ewma_ms=self._batcher.queue.wait_ewma_ms,
+                    current_timeout_ms=self._batcher.batch_timeout_s * 1e3,
+                    max_rungs=max_rungs)
+                ladder = proposal["ladder"]
+                if batch_timeout_ms is None:
+                    batch_timeout_ms = proposal["batch_timeout_ms"]
+            new_policy = BucketPolicy(self.max_batch_size, ladder)
+            changed = new_policy.ladder != self._policy.ladder
+            compiles = 0
+            if changed:
+                compiles = self._warm_rungs(new_policy.ladder)  # barrier
+                self._policy = new_policy  # atomic reference swap
+                self._metrics.count_replan()
+                monitor.record_instant(
+                    "serving/ladder_replan", cat="serving",
+                    server=self.name, ladder=str(new_policy.ladder))
+            if batch_timeout_ms is not None:
+                self._batcher.batch_timeout_s = float(batch_timeout_ms) / 1e3
+            return {
+                "ladder": list(new_policy.ladder),
+                "changed": changed,
+                "barrier_compiles": compiles,
+                "batch_timeout_ms": (
+                    float(batch_timeout_ms) if batch_timeout_ms is not None
+                    else self._batcher.batch_timeout_s * 1e3),
+                **({"proposal": proposal} if proposal else {}),
+            }
+
+    def start_autotuner(self, interval_s: float = 10.0,
+                        max_rungs: int = 8) -> None:
+        """Periodic online re-plan: every ``interval_s`` the autotuner
+        thread re-derives the ladder + batch window from the live
+        arrival histogram and applies any change behind the warmup
+        barrier.  Idempotent; stopped by ``stop()``."""
+        if self._autotune_thread is not None:
+            return
+        self._autotune_stop = threading.Event()
+
+        def _loop():
+            while not self._autotune_stop.wait(interval_s):
+                try:
+                    self.replan_ladder(max_rungs=max_rungs)
+                except Exception as e:  # noqa: BLE001 — keep re-planning
+                    # a failed re-plan must never kill the tuner loop
+                    # (the server keeps serving on the current ladder);
+                    # leave a timeline breadcrumb instead of stderr
+                    monitor.record_instant(
+                        "serving/ladder_replan_error", cat="serving",
+                        server=self.name, error=repr(e))
+
+        self._autotune_thread = threading.Thread(
+            target=_loop, name="serving-%s-autotune" % self.name,
+            daemon=True)
+        self._autotune_thread.start()
+
     # ------------------------------------------------------------------
     def submit(self, feed, timeout_ms: Optional[float] = None,
                trace_id: Optional[str] = None,
                parent_span: Optional[str] = None,
-               priority: int = PRIORITY_NORMAL) -> ServingRequest:
+               priority: int = PRIORITY_NORMAL,
+               precision: Optional[str] = None) -> ServingRequest:
         """Enqueue one request; returns its future (ServingRequest).
+
+        ``precision``: compiled-variant choice on a mixed-precision
+        endpoint — None serves the policy default, ``"fp32"`` is the
+        per-request opt-out; both are pre-compiled by warmup, so the
+        choice never costs an XLA compile.  An unknown dtype fails
+        typed here, before anything enqueues.
 
         ``feed``: dict (or positional sequence) of arrays whose shared
         leading dim is the request's row count (1..max_batch_size).
@@ -460,12 +595,23 @@ class InferenceServer:
                 "brownout level %d sheds priority %d"
                 % (self._brownout.level, int(priority)),
                 retry_after_ms=self._batcher.queue.retry_after_ms())
+        if precision is not None:
+            precision = _PRECISION_ALIASES.get(
+                str(precision).lower(), str(precision))
+            if precision not in self._precision_dtypes:
+                raise ValueError(
+                    "unknown precision %r for endpoint %r (serves %s)"
+                    % (precision, self.name, self._precision_dtypes))
+            if precision == self._default_dtype:
+                precision = None  # one batch group for the default
         feed, n_rows = self._normalize_feed(feed)
+        self._metrics.observe_arrival(n_rows)
         deadline = (
             time.monotonic() + float(timeout_ms) / 1e3
             if timeout_ms is not None else None)
         req = ServingRequest(feed, n_rows, deadline, trace_id=trace_id,
-                             parent_span=parent_span, priority=priority)
+                             parent_span=parent_span, priority=priority,
+                             precision=precision)
         try:
             self._batcher.offer(req)
         except Exception:
@@ -886,6 +1032,11 @@ class InferenceServer:
                 misses0 = rep.predictor.jit_cache_stats()["misses"]
                 t0 = time.perf_counter()
                 kw = {"return_numpy": False} if rep.nonblocking else {}
+                # one batch = one precision variant (the batcher never
+                # mixes); the select itself is a dict lookup downstream
+                prec = getattr(batch[0], "precision", None)
+                if prec is not None and rep.precision:
+                    kw["precision"] = prec
                 with rep.lock:
                     with profiler.RecordEvent("serving/%s/batch" % self.name):
                         outs = rep.predictor.run_padded(
@@ -979,6 +1130,9 @@ class InferenceServer:
         self._metrics.observe_batch(
             valid, bucket, time.perf_counter() - t0,
             recompiled=recompiled and self._warmed, replica=rep.name)
+        self._metrics.count_precision(
+            getattr(batch[0], "precision", None) or self._default_dtype,
+            len(batch))
         off = 0
         now = time.perf_counter()
         for r in batch:
@@ -1010,6 +1164,11 @@ class InferenceServer:
         ServerClosed (batches already routed to a replica still
         complete)."""
         self._closed = True
+        if self._autotune_stop is not None:
+            self._autotune_stop.set()
+            if self._autotune_thread is not None:
+                self._autotune_thread.join(timeout=5.0)
+                self._autotune_thread = None
         with self._admin_lock:
             admin, self._admin = self._admin, None
         if admin is not None:

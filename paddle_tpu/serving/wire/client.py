@@ -271,11 +271,17 @@ class RemoteClient:
     # ------------------------------------------------------------------
     def infer(self, feed, timeout_ms: Optional[float] = None,
               trace_id: Optional[str] = None,
-              priority: int = PRIORITY_NORMAL) -> List[np.ndarray]:
+              priority: int = PRIORITY_NORMAL,
+              precision: Optional[str] = None) -> List[np.ndarray]:
         """Submit one request over the wire and block for its outputs
         (ordered like the endpoint's fetch list).  Same deadline /
         overload / closed error types as the in-process client, plus
         ``BackendUnavailable`` when the remote process is gone.
+
+        ``precision`` rides the request meta to the server's
+        mixed-precision dispatch (``"fp32"`` = per-request opt-out of
+        the endpoint's policy default); an unknown dtype re-raises the
+        server's typed ValueError.
 
         ``priority`` (``serving.admission.PRIORITY_*``, lower = more
         important) rides the request meta into the server's priority
@@ -294,12 +300,13 @@ class RemoteClient:
             if timeout_ms is not None else None)
         names, arrays = self._normalize(feed)
         remaining_ms = self._remaining_ms(deadline)
+        extra = {"precision": str(precision)} if precision is not None else None
         fr = _flight.get()
         rec = _spans.recording() or fr is not None
         if not rec:
             _, routs = wire_call(
                 self._transport, names, arrays, remaining_ms, tid,
-                priority=priority)
+                priority=priority, extra_meta=extra)
             return routs
         t0 = time.perf_counter()
         err: Optional[BaseException] = None
@@ -314,7 +321,7 @@ class RemoteClient:
                     with _spans.capture(cap):
                         rmeta, routs = wire_call(
                             self._transport, names, arrays, remaining_ms,
-                            tid, priority=priority)
+                            tid, priority=priority, extra_meta=extra)
             extra_spans = list(rmeta.get("spans") or ())
             return routs
         except BaseException as e:  # noqa: BLE001 — observed, re-raised

@@ -464,7 +464,8 @@ class FleetBalancer:
     # ------------------------------------------------------------------
     def infer(self, feed, timeout_ms: Optional[float] = None,
               trace_id: Optional[str] = None,
-              priority: Optional[int] = None) -> List[np.ndarray]:
+              priority: Optional[int] = None,
+              precision: Optional[str] = None) -> List[np.ndarray]:
         """One request through the fleet.  A backend that dies
         mid-exchange (``BackendUnavailable``) or answers that it is
         shutting down (``ServerClosed``) retires after repeated failures
@@ -478,7 +479,10 @@ class FleetBalancer:
         (``retry_throttled_total`` counts denials), so saturation
         propagates back-pressure instead of a retry storm.
         ``priority`` (``serving.admission.PRIORITY_*``) rides the wire
-        meta into the backend's priority shedding."""
+        meta into the backend's priority shedding; ``precision`` into
+        the backend's mixed-precision variant dispatch (every backend
+        serves the same saved manifest, so any survivor a requeue
+        lands on honors the same choice)."""
         tid = trace_id or monitor.new_trace_id()
         self.last_trace_id = tid
         names, arrays = self._normalize(feed)
@@ -490,7 +494,7 @@ class FleetBalancer:
         rec = _spans.recording() or fr is not None
         if not rec:
             _, routs = self._route(names, arrays, timeout_ms, deadline, tid,
-                                   priority=priority)
+                                   priority=priority, precision=precision)
             return routs
         t0 = time.perf_counter()
         err: Optional[BaseException] = None
@@ -506,7 +510,7 @@ class FleetBalancer:
                     with _spans.capture(cap):
                         rmeta, routs = self._route(
                             names, arrays, timeout_ms, deadline, tid,
-                            priority=priority)
+                            priority=priority, precision=precision)
             extra_spans = list(rmeta.get("spans") or ())
             return routs
         except BaseException as e:  # noqa: BLE001 — observed, re-raised
@@ -526,8 +530,9 @@ class FleetBalancer:
     # the only waits are the bounded capacity CV, the retry budget's
     # jittered backoff, and socket I/O)
     def _route(self, names, arrays, timeout_ms, deadline, tid,
-               priority=None):
+               priority=None, precision=None):
         t_submit = time.perf_counter()
+        extra = {"precision": str(precision)} if precision is not None else None
         budget = self._retry_policy.budget(
             deadline=deadline, op="fleet.requeue")
         exclude: Optional[_Backend] = None
@@ -558,7 +563,7 @@ class FleetBalancer:
                         pid=be.handle.pid if be.handle is not None else None)
                 rmeta, routs = wire_call(
                     be.transport, names, arrays, remaining_ms, tid,
-                    priority=priority)
+                    priority=priority, extra_meta=extra)
             except _RETRYABLE:
                 # retryable: the process died mid-exchange (no response
                 # ever arrived), answered that it is shutting down, or

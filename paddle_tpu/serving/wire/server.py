@@ -264,7 +264,8 @@ class ServingProcess:
                         feed, meta.get("timeout_ms"),
                         traceparent=self.headers.get("traceparent"),
                         want_spans=self.headers.get("X-Wire-Spans") == "1",
-                        priority=meta.get("priority"))
+                        priority=meta.get("priority"),
+                        precision=meta.get("precision"))
                 except BaseException as e:  # noqa: BLE001 — typed to the peer
                     self._send_error_message(e)
                     return
@@ -385,26 +386,38 @@ class ServingProcess:
             # like single-chip replicas (in-flight accounting, warmup,
             # retirement unchanged)
             "sharded": bool(getattr(srv._predictor, "sharded", False)),
+            # mixed-precision discovery: the policy dtype this endpoint
+            # serves by default (None = plain fp32) and every dtype a
+            # request may ask for — clients and the bench read this
+            # instead of guessing
+            "precision": (getattr(srv, "_default_dtype", "fp32")
+                          if getattr(srv, "_default_dtype", "fp32") != "fp32"
+                          else None),
+            "precision_dtypes": list(
+                getattr(srv, "_precision_dtypes", ["fp32"])),
             "input_names": list(srv._feed_names),
             "output_names": list(srv._predictor.get_output_names()),
         }
 
     # ------------------------------------------------------------------
     def _infer(self, feed, timeout_ms, traceparent: Optional[str],
-               want_spans: bool, priority=None):
+               want_spans: bool, priority=None, precision=None):
         """Bridge one wire request into the in-process server: install
         the remote trace context, submit, wait, and (tracing on) hand
         the server-side span tree back for the client-side merge.
         ``timeout_ms`` is the REMAINING deadline the client computed at
         send time; an already-expired one is shed typed at admission
         (``admission_expired_total``) by ``InferenceServer.submit``.
-        ``priority`` rides the request meta into priority shedding."""
+        ``priority`` rides the request meta into priority shedding;
+        ``precision`` into the mixed-precision variant dispatch."""
         parsed = codec.parse_traceparent(traceparent)
         tid = parsed[0] if parsed else monitor.new_trace_id()
         remote_parent = parsed[1] if parsed else None
         kw = {}
         if priority is not None:
             kw["priority"] = int(priority)
+        if precision is not None:
+            kw["precision"] = str(precision)
         fr = _flight.get()
         rec = _spans.recording() or fr is not None
         if not rec:

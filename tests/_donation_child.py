@@ -1,0 +1,75 @@
+"""Child process for the CPU buffer-donation persistent-cache drill.
+
+Runs ONE training step of a tiny deterministic program through the
+executor with jax's persistent compilation cache pointed at the
+directory the parent provides, and prints the loss fetch as a parseable
+``RESULT {json}`` line.
+
+The hazard this pins (PR 3's latent-corruption fix, until now only
+documented in ``executor._donate_kwargs``'s comment): an executable
+compiled WITH input-output aliasing (donated state) and then RELOADED
+from the persistent cache on the CPU backend returns fetches that
+observe the in-place-MUTATED parameters — the loss comes back computed
+with post-update weights.  Cold compiles are always correct, so the
+corruption only shows on the second process sharing the cache dir.
+``_donate_kwargs`` therefore disables donation on CPU; if a refactor
+ever re-enables it, the warm-cache process prints a DIFFERENT result
+than the cold one and tests/test_donation_cache.py fails.
+
+Driven by tests/test_donation_cache.py; not a test module.
+"""
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# every compile must reach the persistent cache, however fast — the
+# default 1 s threshold would silently skip this tiny program and make
+# the drill vacuous (both runs would compile cold and trivially agree)
+os.environ["JAX_COMPILATION_CACHE_DIR"] = sys.argv[1]
+os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
+os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "0"
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu import framework  # noqa: E402
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", sys.argv[1])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 23
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        # Adam mutates params AND moment state in the same executable —
+        # the richest in-place-update surface the aliasing bug had
+        # (the original repro was DynamicRNN+Adam)
+        fluid.optimizer.Adam(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        rng = np.random.RandomState(5)
+        feed = {
+            "x": rng.uniform(-1, 1, (8, 4)).astype(np.float32),
+            "y": rng.uniform(-1, 1, (8, 1)).astype(np.float32),
+        }
+        out = exe.run(prog, feed=feed, fetch_list=[loss.name])
+    print("RESULT " + json.dumps({"loss": float(np.asarray(out[0]))}),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,278 @@
+"""Bucket-ladder autotuner (serving/autotune.py + InferenceServer
+replan): the DP proposal, the waste accounting, the online re-plan
+behind the warmup barrier (zero recompiled requests), and the offline
+replay tool.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import framework, serving
+from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+from paddle_tpu.serving import autotune
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _default_ladder(max_batch):
+    out, b = [], 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# proposal DP
+# ---------------------------------------------------------------------------
+def test_skewed_histogram_strictly_beats_default_ladder():
+    """The acceptance inequality: on a recorded skewed arrival
+    histogram the autotuned ladder's expected padding waste is
+    STRICTLY below the hardcoded 1/2/4/.../max ladder's."""
+    hist = {3: 120, 5: 60, 1: 10}  # sizes the power-of-two ladder hates
+    default = _default_ladder(16)
+    proposed = autotune.propose_ladder(hist, 16, max_rungs=8)
+    assert proposed[-1] == 16
+    w_def, p_def = autotune.expected_waste(hist, default, 16)
+    w_new, p_new = autotune.expected_waste(hist, proposed, 16)
+    assert w_new < w_def  # strict
+    # with rungs to spare, the DP covers every observed size exactly
+    assert set(hist) <= set(proposed)
+    assert w_new == 0
+
+
+def test_dp_respects_max_rungs_and_optimality():
+    hist = {2: 10, 3: 10, 5: 10, 7: 10, 11: 10}
+    proposed = autotune.propose_ladder(hist, 16, max_rungs=3)
+    assert len(proposed) <= 3
+    assert proposed[-1] == 16
+    # brute-force check: no 3-rung ladder does better
+    import itertools
+
+    best = None
+    cands = sorted(set(hist) | {16})
+    for k in (1, 2, 3):
+        for combo in itertools.combinations(cands, k):
+            if combo[-1] != 16:
+                continue
+            w, _ = autotune.expected_waste(hist, combo, 16)
+            best = w if best is None else min(best, w)
+    w_dp, _ = autotune.expected_waste(hist, proposed, 16)
+    assert w_dp == best
+
+
+def test_ties_prefer_fewer_rungs():
+    # every request is size 4: [4, 16] and [2, 4, 16] both waste 0 —
+    # the proposal must not spend a rung (a compile) for nothing
+    proposed = autotune.propose_ladder({4: 50}, 16)
+    assert proposed == [4, 16]
+
+
+def test_empty_histogram_keeps_current():
+    assert autotune.propose_ladder({}, 16) is None
+    doc = autotune.plan({}, 16, [1, 2, 4, 8, 16])
+    assert doc["ladder"] == [1, 2, 4, 8, 16]
+    assert not doc["changed"]
+
+
+def test_oversize_and_junk_entries_ignored():
+    proposed = autotune.propose_ladder(
+        {"3": 10, 99: 5, 0: 7, -2: 1}, 8)
+    assert proposed == [3, 8]
+
+
+def test_expected_waste_never_negative_for_unservable_sizes():
+    """A size above the ladder's top rung is unservable — it must be
+    EXCLUDED, not credited with the top rung (which fabricated negative
+    waste and made a strictly better proposal look like a regression
+    in the offline tool)."""
+    w, p = autotune.expected_waste({12: 100, 4: 10}, [1, 2, 4, 8], 16)
+    assert (w, p) == (0, 40)  # only the servable size-4 entries count
+    doc = autotune.plan({12: 100, 4: 10}, 16, [1, 2, 4, 8])
+    assert doc["waste_rows_saved"] >= 0
+
+
+def test_timeout_proposal_bounds():
+    assert autotune.propose_timeout_ms(None, current_ms=2.0) == 2.0
+    assert autotune.propose_timeout_ms(0.0) == 0.5
+    assert autotune.propose_timeout_ms(40.0) == 10.0
+    assert autotune.propose_timeout_ms(1000.0, max_ms=50.0) == 50.0
+    assert autotune.propose_timeout_ms(0.1) == 0.5  # floor
+
+
+def test_plan_document_fields():
+    doc = autotune.plan({3: 100}, 16, _default_ladder(16),
+                        queue_wait_ewma_ms=20.0, current_timeout_ms=2.0)
+    assert doc["changed"]
+    assert doc["proposed_waste_ratio"] < doc["current_waste_ratio"]
+    assert doc["waste_rows_saved"] == 100  # 3->4 padding gone
+    assert doc["batch_timeout_ms"] == 5.0
+
+
+# ---------------------------------------------------------------------------
+# online re-plan behind the warmup barrier
+# ---------------------------------------------------------------------------
+IN_DIM = 8
+
+
+@pytest.fixture(scope="module")
+def mlp_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("autotune") / "mlp")
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 3
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [IN_DIM])
+        h = fluid.layers.fc(x, 16, act="relu")
+        pred = fluid.layers.fc(h, 4, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.save_inference_model(d, ["x"], [pred], exe, prog)
+    return d
+
+
+def _storm(cli, sizes, repeats, seed=0):
+    rng = np.random.RandomState(seed)
+    for i in range(repeats):
+        n = sizes[i % len(sizes)]
+        cli.infer({"x": rng.uniform(-1, 1, (n, IN_DIM)).astype(np.float32)})
+
+
+def test_online_replan_zero_recompiled_requests(mlp_dir):
+    """The warmup-barrier acceptance drill: skewed traffic on the
+    hardcoded ladder, an online re-plan, identical traffic after —
+    the ladder changed, measured padding waste strictly dropped, and
+    the serving recompile counter never moved (new rungs compiled
+    behind the barrier, not under a request)."""
+    pred = create_paddle_predictor(AnalysisConfig(mlp_dir))
+    srv = serving.InferenceServer(
+        pred, max_batch_size=16, batch_timeout_ms=1, queue_capacity=64,
+        name="tune-srv")
+    try:
+        srv.warmup()
+        assert srv.bucket_ladder == [1, 2, 4, 8, 16]
+        cli = serving.Client(srv)
+        sizes = (3, 3, 5, 3)  # skewed off the power-of-two rungs
+
+        def waste():
+            m = srv.metrics()
+            padded = sum(int(b) * v["batches"] for b, v in
+                         m["batch_histogram"].items())
+            valid = sum(v["valid_rows"] for v in
+                        m["batch_histogram"].values())
+            return padded, valid
+
+        _storm(cli, sizes, 40, seed=1)
+        padded1, valid1 = waste()
+        w1 = 1 - valid1 / padded1
+        assert w1 > 0  # the default ladder pays real padding rent
+
+        result = srv.replan_ladder()
+        assert result["changed"]
+        assert 3 in result["ladder"] and 5 in result["ladder"]
+        assert result["barrier_compiles"] > 0  # new rungs compiled NOW
+        assert srv.metrics()["ladder_replans"] == 1
+
+        misses0 = pred.jit_cache_stats()["misses"]
+        _storm(cli, sizes, 40, seed=2)
+        padded2, valid2 = waste()
+        w2 = 1 - (valid2 - valid1) / (padded2 - padded1)
+        m = srv.metrics()
+        assert m["recompiles"] == 0
+        assert pred.jit_cache_stats()["misses"] == misses0  # zero, really
+        assert w2 < w1  # strictly less measured padding waste
+        # a second replan from the same histogram is a no-op
+        again = srv.replan_ladder()
+        assert not again["changed"]
+        assert srv.metrics()["ladder_replans"] == 1
+    finally:
+        srv.stop(drain=True)
+
+
+def test_periodic_autotuner_thread(mlp_dir):
+    pred = create_paddle_predictor(AnalysisConfig(mlp_dir))
+    srv = serving.InferenceServer(
+        pred, max_batch_size=8, batch_timeout_ms=1, queue_capacity=64,
+        name="tune-thread")
+    try:
+        srv.warmup()
+        cli = serving.Client(srv)
+        _storm(cli, (3,), 20, seed=4)
+        srv.start_autotuner(interval_s=0.1)
+        srv.start_autotuner(interval_s=0.1)  # idempotent
+        deadline = time.monotonic() + 10.0
+        while (srv.metrics()["ladder_replans"] == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert srv.metrics()["ladder_replans"] >= 1
+        assert 3 in srv.bucket_ladder
+        _storm(cli, (3,), 10, seed=5)
+        assert srv.metrics()["recompiles"] == 0
+    finally:
+        srv.stop(drain=True)  # joins the tuner thread too
+
+
+def test_replan_explicit_ladder_validates(mlp_dir):
+    pred = create_paddle_predictor(AnalysisConfig(mlp_dir))
+    srv = serving.InferenceServer(
+        pred, max_batch_size=8, batch_timeout_ms=1, name="tune-explicit")
+    try:
+        srv.warmup()
+        with pytest.raises(ValueError):
+            srv.replan_ladder(ladder=[1, 2, 4])  # must top out at max
+        out = srv.replan_ladder(ladder=[2, 8], batch_timeout_ms=3.0)
+        assert out["ladder"] == [2, 8]
+        assert srv.metrics()["batch_timeout_ms"] == 3.0
+        cli = serving.Client(srv)
+        _storm(cli, (1, 2), 8, seed=6)
+        assert srv.metrics()["recompiles"] == 0
+    finally:
+        srv.stop(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# offline replay tool
+# ---------------------------------------------------------------------------
+def test_offline_tool_replays_recorded_histogram(tmp_path):
+    doc = {
+        "arrival_histogram": {"3": 120, "5": 60},
+        "max_batch_size": 16,
+        "queue_wait_ewma_ms": 8.0,
+        "batch_timeout_ms": 2.0,
+    }
+    p = tmp_path / "hist.json"
+    p.write_text(json.dumps(doc))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                      "autotune_ladder.py"), str(p)],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["ladder"] == [3, 5, 16]
+    assert out["changed"]
+    assert out["proposed_waste_ratio"] < out["current_waste_ratio"]
+    assert out["batch_timeout_ms"] == 2.0
+
+    # a /statusz-shaped document (histogram under "metrics") works too
+    p2 = tmp_path / "statusz.json"
+    p2.write_text(json.dumps(
+        {"metrics": {"arrival_histogram": {"3": 10},
+                     "bucket_ladder": [1, 2, 4, 8]}}))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                      "autotune_ladder.py"), str(p2)],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["ladder"] == [3, 8]

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Offline bucket-ladder replay: recorded arrival histogram → proposal.
+
+The offline half of the serving ladder autotuner
+(``paddle_tpu.serving.autotune``): feed it a recorded arrival-size
+histogram — the ``arrival_histogram`` field of an
+``InferenceServer.metrics()`` / ``/statusz`` snapshot, a bench
+``--metrics-out`` dump, or a hand-written document — and it prints the
+waste-minimal ladder plus the expected padding waste under both the
+current and the proposed ladder, so a ladder change can be evaluated
+(and reviewed) before any server re-plans online.
+
+Input JSON (either shape):
+
+    {"arrival_histogram": {"3": 120, "5": 60}, "max_batch_size": 16,
+     "ladder": [1, 2, 4, 8, 16],          # optional: current ladder
+     "queue_wait_ewma_ms": 12.0,          # optional: window proposal
+     "batch_timeout_ms": 2.0}             # optional: current window
+
+    {"metrics": {"arrival_histogram": ..., "bucket_ladder": ...}}
+      (a /statusz document — the server block is found automatically)
+
+Usage::
+
+    python tools/autotune_ladder.py histogram.json [--max-rungs 8]
+
+Prints one JSON line (the ``serving.autotune.plan`` document).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _find_block(doc):
+    """The dict holding ``arrival_histogram`` — the document itself, or
+    a ``metrics`` sub-block (a /statusz or bench dump)."""
+    if "arrival_histogram" in doc:
+        return doc
+    inner = doc.get("metrics")
+    if isinstance(inner, dict) and "arrival_histogram" in inner:
+        return inner
+    raise SystemExit(
+        "no 'arrival_histogram' found in the input document "
+        "(top level or under 'metrics')")
+
+
+def propose(doc, max_rungs: int = 8):
+    from paddle_tpu.serving.autotune import plan
+
+    block = _find_block(doc)
+    hist = block["arrival_histogram"]
+    ladder = block.get("bucket_ladder") or block.get("ladder")
+    max_batch = block.get("max_batch_size") or (
+        max(int(b) for b in ladder) if ladder else None)
+    if max_batch is None:
+        raise SystemExit(
+            "input needs 'max_batch_size' (or a 'ladder'/'bucket_ladder' "
+            "whose top rung defines it)")
+    if not ladder:
+        # default current: the hardcoded 1/2/4/.../max (PR-1 shape)
+        ladder, b = [], 1
+        while b < int(max_batch):
+            ladder.append(b)
+            b *= 2
+        ladder.append(int(max_batch))
+    return plan(
+        hist, int(max_batch), ladder,
+        queue_wait_ewma_ms=block.get("queue_wait_ewma_ms"),
+        current_timeout_ms=block.get("batch_timeout_ms"),
+        max_rungs=max_rungs)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="propose a serving bucket ladder from a recorded "
+                    "arrival-size histogram")
+    parser.add_argument("histogram", help="JSON file (see module doc)")
+    parser.add_argument("--max-rungs", type=int, default=8)
+    args = parser.parse_args(argv)
+    with open(args.histogram) as f:
+        doc = json.load(f)
+    print(json.dumps(propose(doc, max_rungs=args.max_rungs),
+                     sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -53,6 +53,13 @@ CHECKED_FILES = [
     # the list means any future hot-path region added here is guarded
     "paddle_tpu/sharding/rules.py",
     "paddle_tpu/sharding/layouts.py",
+    # the precision-variant dispatch (one dict lookup per run) is a hot
+    # region in inference.py; the rewrite/cast/calibration passes run at
+    # load/export time only.  autotune.py is pure re-plan arithmetic on
+    # the tuner thread — keeping both listed guards against a future
+    # blocking sync (or a re-plan) creeping into the request path.
+    "paddle_tpu/inference.py",
+    "paddle_tpu/serving/autotune.py",
 ]
 
 # blocking-sync tokens (substring match on code, not comments)
