@@ -1168,7 +1168,8 @@ class Executor:
                        else TrainCheckpoint(resume_from))
                 cursor = src.restore(
                     prog_obj, scope or global_scope(),
-                    ps_client=getattr(prog_obj, "_ps_client", None))
+                    ps_client=getattr(prog_obj, "_ps_client", None),
+                    compiled=compiled)
                 if cursor is not None:
                     start_step = int(cursor.get("step", 0))
                     self.last_resume_step = start_step
@@ -1253,7 +1254,7 @@ class Executor:
                     self._train_checkpoint(
                         ckpt, prog_obj, scope or global_scope(),
                         step + 1, int(checkpoint_epoch), ps_ctx,
-                        async_=bool(checkpoint_async))
+                        async_=bool(checkpoint_async), compiled=compiled)
             if ckpt is not None:
                 # commit the tail background save before returning (a
                 # write error surfaces here, on the epoch's own path)
@@ -1295,14 +1296,18 @@ class Executor:
         return results
 
     def _train_checkpoint(self, ckpt, program, scope, step, epoch,
-                          ps_ctx, async_: bool = False) -> None:
+                          ps_ctx, async_: bool = False,
+                          compiled=None) -> None:
         """Quiesce async PS state, then commit one atomic checkpoint.
         The overlapped dense-PS pull is joined (its params land in the
         scope first) and the async Communicator is flushed (every queued
         sparse grad reaches the server) so the saved params, PS rows,
         and cursor describe the SAME step.  ``async_``: snapshot on this
         thread (copy-on-write gather), serialize + commit on the
-        checkpoint's background writer — the step resumes immediately."""
+        checkpoint's background writer — the step resumes immediately.
+        ``compiled``: the CompiledProgram of a mesh-sharded run — its
+        state then checkpoints SHARD-wise (each device's addressable
+        shards; no full-tensor host gather)."""
         if ps_ctx is not None:
             self._dense_ps_join_pending(ps_ctx, scope)
         comm = getattr(program, "_ps_communicator", None)
@@ -1310,7 +1315,8 @@ class Executor:
             comm.flush()
         saver = ckpt.save_async if async_ else ckpt.save
         saver(program, scope, step=step, epoch=epoch,
-              ps_client=getattr(program, "_ps_client", None))
+              ps_client=getattr(program, "_ps_client", None),
+              compiled=compiled)
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,

@@ -32,13 +32,33 @@ commit phase so chaos tests can pin exactly that window.  One save is
 in flight at a time: a new ``save_async`` (or :meth:`wait`) joins the
 previous one first.
 
+**Shard-wise mode** (``save(..., compiled=<CompiledProgram>)``): a
+mesh-sharded training run (``paddle_tpu.sharding.train``) must not
+funnel every parameter and optimizer moment through one host buffer —
+at scale the full tensor does not FIT one host.  With ``compiled=``
+given, each mesh-committed persistable is saved as its **addressable
+shards**: one ``.npy`` per distinct shard (replicas deduplicated) plus
+a shard manifest recording the global shape, dtype, PartitionSpec, and
+each shard's index slices.  No full tensor is ever materialized — the
+per-shard files ARE the checkpoint (their shapes prove it).  Restore
+(``restore(..., compiled=)``) re-places each shard straight onto its
+device via ``jax.make_array_from_single_device_arrays``, again without
+a full host tensor; resuming on a mesh with a DIFFERENT shape (or a
+layout whose shard indexes no longer match) is a typed
+:class:`CheckpointMeshMismatchError`, never silent mis-placement.
+Shard-wise saves compose with async mode and the atomic-commit /
+``checkpoint.commit`` fault-point machinery unchanged.
+
 Layout::
 
     run_dir/
       LATEST              # "ckpt-000040\n"
       ckpt-000040/
         cursor.json       # {"step": 40, "epoch": 0}
-        params/           # io.save_persistables output
+        params/           # io.save_persistables output (host-resident
+                          #   vars only in shard-wise mode)
+        shards/           # optional: manifest.json + v<i>_s<j>.npy —
+                          #   per-shard dumps of mesh-committed state
         ps/               # optional: manifest.json + t<i>_{ids,rows}.npy
                           #   (+ t<i>_moments.npy: adagrad accumulators)
 """
@@ -48,14 +68,33 @@ import json
 import os
 import shutil
 import threading
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
 import paddle_tpu.faults as _faults
 from paddle_tpu.faults.metrics import TRAIN_CHECKPOINTS
 
-__all__ = ["TrainCheckpoint"]
+__all__ = ["TrainCheckpoint", "CheckpointMeshMismatchError"]
+
+
+class CheckpointMeshMismatchError(RuntimeError):
+    """A shard-wise checkpoint cannot re-place on the CURRENT mesh or
+    layout: the mesh shape differs from the one the shards were saved
+    under, or a device's expected shard index has no saved file.
+    Resuming anyway would silently mis-place state; re-shard offline or
+    resume on the original mesh shape."""
+
+
+def _index_key(index, shape) -> Tuple[Tuple[int, int], ...]:
+    """Normalize a shard index (tuple of slices over the global shape)
+    to a hashable/JSON-safe ((start, stop), ...) key."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
 
 _LATEST = "LATEST"
 _PREFIX = "ckpt-"
@@ -91,33 +130,45 @@ class TrainCheckpoint:
 
     # ------------------------------------------------------------------
     def save(self, program, scope, step: int, epoch: int = 0,
-             ps_client=None, extra: Optional[Dict] = None) -> str:
+             ps_client=None, extra: Optional[Dict] = None,
+             compiled=None) -> str:
         """Commit one checkpoint; returns the finished directory path.
         ``step`` is the number of COMPLETED steps (the resume cursor).
-        The caller is responsible for quiescing async state first (the
-        executor joins its overlapped PS pull and flushes the
-        Communicator before calling)."""
+        ``compiled``: the CompiledProgram a sharded training run
+        executes through — mesh-committed state then saves SHARD-wise
+        (each device's addressable shards, never a gathered full
+        tensor).  The caller is responsible for quiescing async state
+        first (the executor joins its overlapped PS pull and flushes
+        the Communicator before calling)."""
         self.wait()  # never interleave with an in-flight async save
         ps_state = (self._gather_ps(ps_client)
                     if ps_client is not None else None)
-        return self._commit(program, scope, step, epoch, ps_state, extra)
+        shard_state = self._gather_shards(program, scope, compiled,
+                                          copy=False)
+        return self._commit(program, scope, step, epoch, ps_state, extra,
+                            shard_state)
 
     def save_async(self, program, scope, step: int, epoch: int = 0,
-                   ps_client=None, extra: Optional[Dict] = None) -> None:
+                   ps_client=None, extra: Optional[Dict] = None,
+                   compiled=None) -> None:
         """Snapshot now, serialize in the background.
 
         The caller-thread cost is one copy-on-write gather: every
         persistable's value copied to host numpy (into a detached
-        snapshot scope) and the PS tables dumped by value — the PS
-        sockets are only touched here, never from the writer thread.
-        Serialization, fsync traffic, the tmp+rename commit, and
-        pruning all happen on a daemon snapshot thread; training
-        continues immediately.  A previous in-flight save is joined
-        first (its error, if any, re-raises HERE — a silent checkpoint
-        gap must not go unnoticed); call :meth:`wait` at end of epoch
-        to commit the tail save."""
+        snapshot scope; mesh-committed state copies PER SHARD — the
+        full tensor is never materialized) and the PS tables dumped by
+        value — the PS sockets are only touched here, never from the
+        writer thread.  Serialization, fsync traffic, the tmp+rename
+        commit, and pruning all happen on a daemon snapshot thread;
+        training continues immediately.  A previous in-flight save is
+        joined first (its error, if any, re-raises HERE — a silent
+        checkpoint gap must not go unnoticed); call :meth:`wait` at end
+        of epoch to commit the tail save."""
         self.wait()
-        snap = self._snapshot_scope(program, scope)
+        shard_state = self._gather_shards(program, scope, compiled,
+                                          copy=True)
+        exclude = set(shard_state["vars"]) if shard_state else ()
+        snap = self._snapshot_scope(program, scope, exclude=exclude)
         ps_state = (self._gather_ps(ps_client)
                     if ps_client is not None else None)
         self._bg_result = self._bg_error = None
@@ -125,7 +176,8 @@ class TrainCheckpoint:
         def _write():
             try:
                 self._bg_result = self._commit(
-                    program, snap, step, epoch, ps_state, extra)
+                    program, snap, step, epoch, ps_state, extra,
+                    shard_state)
             except BaseException as e:  # noqa: BLE001 — re-raised at wait()
                 self._bg_error = e
 
@@ -154,24 +206,83 @@ class TrainCheckpoint:
         return self._bg is not None and self._bg.is_alive()
 
     @staticmethod
-    def _snapshot_scope(program, scope):
+    def _snapshot_scope(program, scope, exclude=()):
         """Copy every persistable's current value into a detached
         scope: the writer thread reads ONLY these copies, so training
-        may mutate the live scope the instant this returns."""
+        may mutate the live scope the instant this returns.
+        ``exclude``: names captured elsewhere (the shard-wise gather) —
+        copying them here would materialize the full tensor."""
         from paddle_tpu import io as _io
         from paddle_tpu.scope import Scope
 
         snap = Scope()
         for v in _io._collect(program, _io._is_persistable, None):
+            if v.name in exclude:
+                continue
             val = scope.get(v.name)
             if val is not None:
                 snap.set(v.name, np.array(np.asarray(val), copy=True))
         return snap
 
-    def _commit(self, program, scope, step, epoch, ps_state, extra) -> str:
+    @staticmethod
+    def _gather_shards(program, scope, compiled, copy: bool):
+        """Collect mesh-committed persistables as per-shard host arrays
+        (replicas deduplicated by shard index).  Returns None when
+        ``compiled`` is None or nothing is mesh-committed.  Each shard
+        copies only ITS slice to host — the full tensor never exists in
+        one buffer.  ``copy=True`` (async mode) forces an owned numpy
+        copy so a donated device buffer mutated by the next step cannot
+        reach the writer thread."""
+        if compiled is None:
+            return None
+        from paddle_tpu import io as _io
+        from paddle_tpu.sharding.rules import spec_to_manifest
+
+        mesh = compiled.mesh
+        mesh_axes = {str(a): int(n) for a, n in
+                     zip(mesh.axis_names, mesh.devices.shape)}
+        entries: Dict[str, Dict] = {}
+        for v in _io._collect(program, _io._is_persistable, None):
+            val = scope.get(v.name)
+            shards = getattr(val, "addressable_shards", None)
+            sh = getattr(val, "sharding", None)
+            if (not shards or sh is None
+                    or len(getattr(sh, "device_set", ())) <= 1):
+                continue  # host / single-device value: params/ path
+            if getattr(sh, "is_fully_replicated", False):
+                # every device holds the FULL value (plain data-parallel
+                # state, norms/LR under a sharded layout): the params/
+                # path saves one portable host copy — routing it through
+                # shards/ would pin a replicated checkpoint to this
+                # mesh's exact shape for zero space win
+                continue
+            shape = tuple(int(d) for d in val.shape)
+            seen: Dict[Tuple, np.ndarray] = {}
+            for s in shards:
+                key = _index_key(s.index, shape)
+                if key in seen:
+                    continue  # a replica of an already-captured shard
+                arr = np.asarray(s.data)  # THIS shard only, never full
+                if copy:
+                    arr = np.array(arr, copy=True)
+                seen[key] = arr
+            spec = getattr(sh, "spec", None)
+            entries[v.name] = {
+                "shape": shape,
+                "dtype": str(val.dtype),
+                "spec": (spec_to_manifest(spec)
+                         if spec is not None else None),
+                "shards": sorted(seen.items()),
+            }
+        if not entries:
+            return None
+        return {"mesh_axes": mesh_axes, "vars": entries}
+
+    def _commit(self, program, scope, step, epoch, ps_state, extra,
+                shard_state=None) -> str:
         """The write + atomic-rename phase (caller thread for ``save``,
         snapshot thread for ``save_async``); reads only the given scope
-        and the pre-gathered ``ps_state``."""
+        and the pre-gathered ``ps_state``/``shard_state``."""
         from paddle_tpu import io as _io
 
         final = os.path.join(self.run_dir, self._name(step))
@@ -180,8 +291,14 @@ class TrainCheckpoint:
             if os.path.isdir(stale):
                 shutil.rmtree(stale)
         os.makedirs(tmp)
-        _io.save_persistables(None, os.path.join(tmp, "params"),
-                              main_program=program, scope=scope)
+        shard_names = set(shard_state["vars"]) if shard_state else set()
+        _io.save_vars(
+            None, os.path.join(tmp, "params"), main_program=program,
+            predicate=lambda v: (_io._is_persistable(v)
+                                 and v.name not in shard_names),
+            scope=scope)
+        if shard_state is not None:
+            self._write_shards(os.path.join(tmp, "shards"), shard_state)
         if ps_state is not None:
             self._write_ps(os.path.join(tmp, "ps"), ps_state)
         cursor = {"step": int(step), "epoch": int(epoch)}
@@ -204,6 +321,31 @@ class TrainCheckpoint:
         TRAIN_CHECKPOINTS.inc()
         self._prune(keep_name=self._name(step))
         return final
+
+    @staticmethod
+    def _write_shards(dirname: str, shard_state) -> None:
+        """One ``.npy`` per distinct shard plus a manifest tying each
+        file to its variable, global shape/dtype/spec, and index
+        slices.  File shapes ARE shard shapes — the on-disk proof that
+        no full tensor was gathered."""
+        os.makedirs(dirname)
+        manifest = {"mesh_axes": shard_state["mesh_axes"], "vars": {}}
+        for i, (name, ent) in enumerate(sorted(
+                shard_state["vars"].items())):
+            files = []
+            for j, (key, arr) in enumerate(ent["shards"]):
+                fname = "v%03d_s%02d.npy" % (i, j)
+                np.save(os.path.join(dirname, fname), arr)
+                files.append({"file": fname,
+                              "index": [list(se) for se in key]})
+            manifest["vars"][name] = {
+                "shape": list(ent["shape"]),
+                "dtype": ent["dtype"],
+                "spec": ent["spec"],
+                "shards": files,
+            }
+        with open(os.path.join(dirname, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
 
     @staticmethod
     def _gather_ps(ps_client):
@@ -263,11 +405,15 @@ class TrainCheckpoint:
         path = os.path.join(self.run_dir, name)
         return path if os.path.isdir(path) else None
 
-    def restore(self, program, scope, ps_client=None) -> Optional[Dict]:
+    def restore(self, program, scope, ps_client=None,
+                compiled=None) -> Optional[Dict]:
         """Restore the newest checkpoint into ``scope`` (and the PS
         tables through ``ps_client``); returns its cursor dict, or None
         when the run directory holds no committed checkpoint (fresh
-        start)."""
+        start).  A shard-wise checkpoint needs ``compiled`` (the same
+        sharded layout the run trains through) so each shard re-places
+        straight onto its device — a mesh whose shape differs from the
+        saved one is a typed :class:`CheckpointMeshMismatchError`."""
         from paddle_tpu import io as _io
 
         path = self.latest()
@@ -275,6 +421,14 @@ class TrainCheckpoint:
             return None
         _io.load_persistables(None, os.path.join(path, "params"),
                               main_program=program, scope=scope)
+        shards_dir = os.path.join(path, "shards")
+        if os.path.isdir(shards_dir):
+            if compiled is None:
+                raise ValueError(
+                    "checkpoint %s holds SHARD-wise state — pass the "
+                    "run's CompiledProgram (compiled=) so shards "
+                    "re-place onto its mesh" % path)
+            self._restore_shards(shards_dir, scope, compiled)
         ps_dir = os.path.join(path, "ps")
         if os.path.isdir(ps_dir):
             if ps_client is None:
@@ -284,6 +438,75 @@ class TrainCheckpoint:
             self._restore_ps(ps_dir, ps_client)
         with open(os.path.join(path, "cursor.json")) as f:
             return json.load(f)
+
+    @staticmethod
+    def _restore_shards(dirname: str, scope, compiled) -> None:
+        """Re-place saved shards onto the compiled program's mesh: each
+        device receives exactly its index's shard via ``device_put`` +
+        ``make_array_from_single_device_arrays`` — the full tensor is
+        never assembled host-side.  Typed failures: a mesh shape
+        differing from the saved one, a layout whose resolved spec
+        drifted from the saved spec, or a device index with no saved
+        shard file."""
+        import jax
+
+        from paddle_tpu.sharding.rules import spec_to_manifest
+
+        with open(os.path.join(dirname, "manifest.json")) as f:
+            manifest = json.load(f)
+        mesh = compiled.mesh
+        cur_axes = {str(a): int(n) for a, n in
+                    zip(mesh.axis_names, mesh.devices.shape)}
+        saved_axes = {str(a): int(n)
+                      for a, n in manifest["mesh_axes"].items()}
+        if cur_axes != saved_axes:
+            raise CheckpointMeshMismatchError(
+                "shard-wise checkpoint was saved on mesh %s but this "
+                "run's mesh is %s — shards cannot re-place on a "
+                "different mesh shape (resume on the original shape, "
+                "or re-shard offline)" % (saved_axes, cur_axes))
+
+        def _norm(doc):
+            doc = list(doc or [])
+            while doc and doc[-1] is None:
+                doc.pop()  # trailing replicated dims are spec-equal
+            return doc
+
+        for name, ent in manifest["vars"].items():
+            sharding = compiled.state_sharding(name)
+            shape = tuple(int(d) for d in ent["shape"])
+            saved_spec = ent.get("spec")
+            cur_spec = spec_to_manifest(sharding.spec)
+            if saved_spec is not None and _norm(saved_spec) != _norm(
+                    cur_spec):
+                raise CheckpointMeshMismatchError(
+                    "var %r was saved with partition spec %s but the "
+                    "current layout resolves it to %s — the rules "
+                    "changed since the checkpoint; restore with the "
+                    "saving layout" % (name, saved_spec, cur_spec))
+            by_index = {}
+            for doc in ent["shards"]:
+                key = tuple(tuple(int(x) for x in se)
+                            for se in doc["index"])
+                by_index[key] = os.path.join(dirname, doc["file"])
+            loaded: Dict[Tuple, np.ndarray] = {}
+            arrays = []
+            for dev, idx in sharding.addressable_devices_indices_map(
+                    shape).items():
+                key = _index_key(idx, shape)
+                fpath = by_index.get(key)
+                if fpath is None:
+                    raise CheckpointMeshMismatchError(
+                        "var %r: device %s expects shard index %s but "
+                        "the checkpoint holds only %s — layout/mesh "
+                        "drift since the save"
+                        % (name, dev, key, sorted(by_index)))
+                arr = loaded.get(key)
+                if arr is None:
+                    arr = loaded[key] = np.load(fpath)
+                arrays.append(jax.device_put(arr, dev))
+            scope.set(name, jax.make_array_from_single_device_arrays(
+                shape, sharding, arrays))
 
     @staticmethod
     def _restore_ps(dirname: str, ps_client) -> None:

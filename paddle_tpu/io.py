@@ -396,6 +396,13 @@ def save_inference_model(
 
         if not isinstance(sharding_rules, PartitionRules):
             sharding_rules = PartitionRules(sharding_rules)
+        # a TRAINING layout (sharding.train.TrainPartitionRules) unwraps
+        # to its base serving rules: the pruned inference program has no
+        # optimizer accumulators, and the manifest a predictor/fleet
+        # reconstructs is exactly the serving layout — the train→export→
+        # serve round-trip rides through unchanged
+        sharding_rules = getattr(sharding_rules, "serving_rules",
+                                 sharding_rules)
         # fail-at-export validation: every persistable resolves, the
         # mesh carries every axis the rules shard over, and every
         # sharded dim divides by its axes' size — a layout/mesh

@@ -136,6 +136,21 @@ class Optimizer:
     def _get_accumulator(self, name, param):
         return self._accumulators[name][param.name]
 
+    def accumulator_map(self) -> Dict[str, tuple]:
+        """``{accumulator var name: (param name, accumulator kind)}`` for
+        every optimizer-state var this optimizer created (populated by
+        ``minimize``/``apply_gradients``).  The name↔param surface the
+        sharded-training rules consume: each accumulator's placement is
+        derived from its param's matched partition rule
+        (``paddle_tpu.sharding.train.train_rules``), so the mapping —
+        not a name-pattern guess — is the ground truth for which param
+        an accumulator belongs to."""
+        out: Dict[str, tuple] = {}
+        for kind, per_param in self._accumulators.items():
+            for pname, var in per_param.items():
+                out[var.name] = (pname, kind)
+        return out
+
     # ------------------------------------------------------------------
     def _create_accumulators(self, block, parameters):
         pass

@@ -116,8 +116,9 @@ class CompiledProgram:
         if not isinstance(rules, PartitionRules):
             rules = PartitionRules(rules, default=default)
         elif default is not None:
-            rules = PartitionRules(rules.rules, default=default,
-                                   name=rules.name)
+            # polymorphic rebuild: a TrainPartitionRules keeps its
+            # accumulator map through the default rebind
+            rules = rules.with_default(default)
         if mesh is not None:
             self._mesh = mesh
             self._mesh_axes = dict(
@@ -136,8 +137,11 @@ class CompiledProgram:
             self._mesh_axes = {axes[0]: n}
             self._mesh = mesh_lib.make_mesh(self._mesh_axes)
         rules.validate_mesh(self._mesh)
-        self._rules = rules
+        # clear BEFORE rebinding: the retire check inside must see the
+        # OLD rules (a train layout being replaced tears down its
+        # state-bytes series; the new layout republishes at placement)
         self._clear_sharding_memos()
+        self._rules = rules
         return self
 
     @property
@@ -145,6 +149,12 @@ class CompiledProgram:
         return self._rules
 
     def _clear_sharding_memos(self) -> None:
+        if getattr(self._rules, "state_kind", None) is not None:
+            # a mesh/rules rebind tears the old training layout down:
+            # its state-bytes series must not keep scraping stale values
+            from paddle_tpu.sharding import train as _sh_train
+
+            _sh_train.retire_state_bytes()
         self._sharding_memo.clear()
         self._state_sh_memo.clear()
         self._feed_sh_memo.clear()
@@ -321,6 +331,14 @@ class CompiledProgram:
         ro_out = put(ro_state, state_sh, track=True)
         if steady_token is not None and not restaged:
             self._steady_tokens.add(steady_token)
+        kind_of = getattr(self._rules, "state_kind", None)
+        if kind_of is not None:
+            # sharded TRAINING accounting: per-device param/grad/moment
+            # bytes, published on every full placement pass (cold —
+            # steady-state dispatches return above before reaching this)
+            from paddle_tpu.sharding import train as _sh_train
+
+            _sh_train.publish_state_bytes(kind_of, mut_out, ro_out)
         if restaged and self._rules is not None:
             # placement accounting (cold: restage is a warmup-time
             # event; a counter still moving in steady state means state

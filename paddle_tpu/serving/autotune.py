@@ -25,6 +25,13 @@ ladder:
   replan_ladder`` (online, behind the warmup barrier so a ladder
   change never serves a cold cache) and by ``tools/autotune_ladder.py``
   (offline replay of a recorded histogram).
+* :func:`propose_len_ladder` / :func:`plan_kv_ladder` — the SAME DP
+  pointed at the decode path's KV length ladder
+  (``serving/kv_pool.py``): waste counted in padded cache positions
+  from the observed per-request total sequence lengths
+  (``DecodeServer.seq_len_histogram``), replacing the hand-picked
+  powers-of-two ``default_len_ladder``.  Offline proposal only: a
+  ladder change re-warms the pool, a restart-time decision.
 
 Everything here is pure host-side arithmetic on snapshots — it runs on
 the autotuner's own thread (or offline), never inside the dispatch hot
@@ -38,6 +45,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 __all__ = [
     "expected_waste",
     "propose_ladder",
+    "propose_len_ladder",
+    "plan_kv_ladder",
     "propose_timeout_ms",
     "plan",
 ]
@@ -132,6 +141,56 @@ def propose_ladder(counts, max_batch_size: int,
     assert ladder[-1] == M
     # hot-path: end ladder_plan
     return ladder
+
+
+def propose_len_ladder(seq_len_counts, max_seq_len: int,
+                       max_rungs: int = 6) -> Optional[List[int]]:
+    """The waste-minimal KV length-bucket ladder for an observed
+    sequence-length histogram (``DecodeServer`` records total sequence
+    length — prompt + generation budget — per admitted request as
+    ``seq_len_histogram``), or None when the histogram is empty.
+
+    Same exact DP as :func:`propose_ladder`, with waste counted in
+    padded CACHE POSITIONS instead of batch rows: a sequence of total
+    length ``s`` decoded on length rung ``r`` carries ``r - s`` dead
+    cache slots for its whole lifetime, in HBM and in every attention
+    step.  The result drops into ``KVSlotPool(len_ladder=...)`` /
+    ``DecodeServer(len_ladder=...)``; each rung is one AOT compile per
+    slot rung at warmup, so ties prefer fewer rungs exactly like the
+    batch ladder.  Offline proposal only — replacing a live pool's
+    ladder means re-warming, which is a restart-time decision."""
+    return propose_ladder(seq_len_counts, max_seq_len,
+                          max_rungs=max_rungs)
+
+
+def plan_kv_ladder(seq_len_histogram, max_seq_len: int,
+                   current_ladder: Optional[Sequence[int]] = None,
+                   max_rungs: int = 6) -> Dict[str, object]:
+    """One KV-ladder proposal document: the waste-minimal length ladder
+    for the observed sequence lengths vs the current (default:
+    ``kv_pool.default_len_ladder`` — the hand-picked powers of two),
+    with expected padded-position waste both ways so the improvement is
+    a number, not a claim."""
+    from paddle_tpu.serving.kv_pool import default_len_ladder
+
+    current = sorted(int(b) for b in (
+        current_ladder if current_ladder is not None
+        else default_len_ladder(int(max_seq_len))))
+    proposed = propose_len_ladder(seq_len_histogram, max_seq_len,
+                                  max_rungs=max_rungs)
+    if proposed is None:
+        proposed = list(current)
+    cur_w, cur_p = expected_waste(seq_len_histogram, current, max_seq_len)
+    new_w, new_p = expected_waste(seq_len_histogram, proposed, max_seq_len)
+    return {
+        "len_ladder": proposed,
+        "changed": proposed != current,
+        "current_waste_ratio": round(cur_w / cur_p, 6) if cur_p else None,
+        "proposed_waste_ratio": round(new_w / new_p, 6) if new_p else None,
+        "waste_positions_saved": int(cur_w - new_w),
+        "n_lengths_observed": len(
+            _normalize_counts(seq_len_histogram, max_seq_len)),
+    }
 
 
 def propose_timeout_ms(queue_wait_ewma_ms: Optional[float],

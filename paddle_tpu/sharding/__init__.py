@@ -11,6 +11,11 @@ mesh instead of replicating every parameter per chip:
   ``fsdp_tp`` layouts for the in-tree model families (transformer LM,
   NMT seq2seq, DeepFM), coverage-checked against the real models by
   ``tools/check_partition_rules.py``,
+* :mod:`paddle_tpu.sharding.train` — the same rules pointed at a TRAIN
+  program: :class:`TrainPartitionRules` derives every optimizer
+  accumulator's spec from its param's matched rule, so params, grads,
+  and optimizer state all live sharded (FSDP/tp training with zero new
+  concepts),
 * :mod:`paddle_tpu.sharding.metrics` — placement observability
   (imported lazily by the placement path; import it explicitly for the
   registry series).
@@ -36,11 +41,19 @@ from paddle_tpu.sharding.rules import (
     PartitionRules,
     ShardingRuleError,
 )
+from paddle_tpu.sharding.train import (
+    TrainPartitionRules,
+    sharded_train_program,
+    train_rules,
+)
 
 __all__ = [
     "PartitionRules",
     "ShardingRuleError",
     "MeshCommittedStateError",
+    "TrainPartitionRules",
+    "train_rules",
+    "sharded_train_program",
     "canonical_rules",
     "transformer_lm_rules",
     "transformer_nmt_rules",

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 from paddle_tpu.monitor import registry as _registry
 
-__all__ = ["PARAMS_SHARDED", "GROUP_HBM_BYTES"]
+__all__ = ["PARAMS_SHARDED", "GROUP_HBM_BYTES", "TRAIN_STATE_BYTES"]
 
 PARAMS_SHARDED = _registry.REGISTRY.counter(
     "sharding_params_sharded_total",
@@ -27,3 +27,13 @@ GROUP_HBM_BYTES = _registry.REGISTRY.gauge(
     "per-device HBM bytes of one model-parallel group's persistable "
     "state (sharded params count their shard, replicated params their "
     "full size)", ("group",))
+TRAIN_STATE_BYTES = _registry.REGISTRY.gauge(
+    "sharding_train_state_bytes",
+    "per-device bytes of sharded-training state by kind (param | grad "
+    "| moment); published on each full placement pass (restage — a "
+    "warmup-time event) and retired when the layout is torn down.  "
+    "Grad bytes are accounted at the param's placement: one grad per "
+    "trainable param, layout pinned to the param's by the update's "
+    "out sharding.  Scope: ONE sharded-training layout per process — "
+    "publish is last-writer-wins and retire is global (kind is the "
+    "only label; a training process hosts one trainer)", ("kind",))

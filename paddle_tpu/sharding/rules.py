@@ -141,6 +141,12 @@ class PartitionRules:
                 "empty rule set %r with no default spec" % self.name)
 
     # ------------------------------------------------------------------
+    def with_default(self, default) -> "PartitionRules":
+        """A copy of this rule set with ``default`` as the unmatched-name
+        fallback spec.  Subclasses override so a rebuild keeps their
+        extra state (TrainPartitionRules' accumulator map)."""
+        return PartitionRules(self.rules, default=default, name=self.name)
+
     def axes(self) -> set:
         """Every mesh axis name any rule (or the default) refers to."""
         out: set = set()

@@ -1,0 +1,273 @@
+"""Sharded FSDP/TP *training* through the partition-rules surface.
+
+PR 10's :class:`~paddle_tpu.sharding.rules.PartitionRules` made
+model-parallel serving declarative; this module points the SAME rules
+at a TRAIN program — forward + backward + optimizer ops in one compiled
+module — so params, grads, and optimizer state all live sharded on the
+mesh with zero new user concepts:
+
+* :class:`TrainPartitionRules` — a rule set that also carries the
+  optimizer's accumulator↔param mapping
+  (``Optimizer.accumulator_map()``).  Each accumulator derives its spec
+  from **its param's matched rule** (the ``match_partition_rules``
+  shape fmengine uses for Optax state): an Adam moment inherits the
+  param's placement, a scalar beta-pow auto-replicates, and there is NO
+  ``default=`` escape hatch in the derivation — an accumulator whose
+  param no rule covers fails typed, naming the param.
+* :func:`train_rules` — build one from a base layout (e.g.
+  ``canonical_rules("transformer_lm", "fsdp")``) plus a live optimizer
+  or an explicit accumulator map.
+* :func:`sharded_train_program` — the one-call surface:
+  ``CompiledProgram(prog).with_sharding_rules(train_rules(...))`` with
+  the mesh bound, ready for ``Executor.run``/``train_from_dataset``.
+  Output layouts are pinned by the executor exactly like serving
+  (``out_shardings``), so sharded optimizer state stays sharded across
+  steps and the steady state pays zero placement work and zero
+  recompiles — the same jit-cache ground truth as the serving path.
+
+Export closes the loop: ``save_inference_model(sharding_rules=`` a
+``TrainPartitionRules``)`` unwraps to the base serving rules (the
+pruned inference program has no accumulators), so the training layout
+rides the manifest into ``AnalysisPredictor`` / the fleet unchanged.
+
+Observability: during each full placement pass the compiled program
+publishes ``sharding_train_state_bytes{kind=param|grad|moment}`` — the
+per-device bytes the capacity math reads (grad bytes are accounted at
+the param's placement: one grad per trainable param, its layout pinned
+to the param's by the update's out sharding).  The series retire when
+the layout is torn down (:func:`retire_state_bytes`, also called on a
+mesh/rules rebind).
+"""
+from __future__ import annotations
+
+from typing import Dict, Mapping, Optional
+
+from paddle_tpu.sharding.rules import (
+    PartitionRules,
+    ShardingRuleError,
+    _n_elements,
+    _shape_of,
+)
+
+__all__ = [
+    "TrainPartitionRules",
+    "train_rules",
+    "sharded_train_program",
+    "per_device_bytes",
+    "state_bytes",
+    "publish_state_bytes",
+    "retire_state_bytes",
+]
+
+_KINDS = ("param", "grad", "moment")
+
+
+class TrainPartitionRules(PartitionRules):
+    """A rule set over a TRAIN program's persistables.
+
+    ``accumulators``: ``{accumulator var name: param name}`` (values may
+    also be ``(param name, kind)`` tuples, the
+    ``Optimizer.accumulator_map()`` shape).  Resolution:
+
+    * an accumulator resolves to **its param's** spec — the param's
+      first matching rule (or the rule set's default, when the param
+      itself falls back to it); scalar/single-element accumulators
+      (Adam beta pows) auto-replicate like any scalar,
+    * every other name resolves with plain :class:`PartitionRules`
+      semantics,
+    * rank checks run against the accumulator's OWN shape, so a spec a
+      param carries but an accumulator cannot (rank mismatch) is a
+      typed error naming both.
+
+    The base layout is kept as :attr:`serving_rules`:
+    ``save_inference_model`` unwraps to it, so the exported manifest is
+    exactly the serving layout (accumulators do not exist in the pruned
+    inference program).
+    """
+
+    def __init__(self, rules, accumulators: Mapping[str, object],
+                 default=None, name: Optional[str] = None):
+        if isinstance(rules, PartitionRules):
+            base = rules
+            if default is not None:
+                base = PartitionRules(base.rules, default=default,
+                                      name=base.name)
+        else:
+            base = PartitionRules(rules, default=default)
+        super().__init__(base.rules, default=base.default,
+                         name=name or "%s+train" % base.name)
+        self.serving_rules = base
+        self._acc_param: Dict[str, str] = {}
+        self._acc_kind: Dict[str, str] = {}
+        for acc, ent in dict(accumulators).items():
+            if isinstance(ent, (tuple, list)):
+                pname, kind = str(ent[0]), str(ent[1])
+            else:
+                pname, kind = str(ent), "moment"
+            self._acc_param[str(acc)] = pname
+            self._acc_kind[str(acc)] = kind
+        if not self._acc_param:
+            raise ShardingRuleError(
+                "TrainPartitionRules %r got an empty accumulator map — "
+                "build it from Optimizer.accumulator_map() AFTER "
+                "minimize() has run" % self.name)
+
+    @property
+    def accumulators(self) -> Dict[str, str]:
+        """{accumulator name: param name} (copy)."""
+        return dict(self._acc_param)
+
+    def with_default(self, default) -> "TrainPartitionRules":
+        # keep the accumulator map through a default rebind — dropping
+        # to a plain PartitionRules here would silently replicate any
+        # accumulator whose param only the default covers
+        return TrainPartitionRules(
+            self.serving_rules,
+            {a: (p, self._acc_kind[a]) for a, p in self._acc_param.items()},
+            default=default, name=self.name)
+
+    def state_kind(self, name: str) -> Optional[str]:
+        """``"moment"`` for an optimizer accumulator, ``"param"`` for a
+        name some accumulator points at, None otherwise (LR vars, EMA
+        state, ...).  The classification behind the
+        ``sharding_train_state_bytes`` gauge."""
+        if name in self._acc_param:
+            return "moment"
+        if name in self._param_names:
+            return "param"
+        return None
+
+    @property
+    def _param_names(self):
+        names = getattr(self, "_param_name_set", None)
+        if names is None:
+            names = self._param_name_set = set(self._acc_param.values())
+        return names
+
+    # hot-path: begin train_spec_resolve (rule resolution for train
+    # state — runs once per name on the compiled program's memo MISS
+    # path, inside the dispatch region; pure dict/regex work only)
+    def spec_for(self, name: str, shape=None):
+        param = self._acc_param.get(name)
+        if param is None:
+            return super().spec_for(name, shape=shape)
+        shp = _shape_of(shape) if shape is not None else None
+        if shp is not None and (len(shp) == 0 or _n_elements(shp) == 1):
+            # scalar accumulators (beta pows, step counters) never
+            # partition — same shortcut as params
+            from jax.sharding import PartitionSpec as P
+
+            return P()
+        try:
+            # the param's FULL resolution (its matched rule, or the rule
+            # set's default when the param itself uses it) — the
+            # accumulator adds no fallback of its own
+            spec = super().spec_for(param)
+        except ShardingRuleError as e:
+            raise ShardingRuleError(
+                "accumulator %r inherits its spec from param %r, which "
+                "no rule covers: %s" % (name, param, e)) from None
+        if shp is not None:
+            self._check_rank(
+                name, spec, shp, "inherited from param %r" % param)
+        return spec
+    # hot-path: end train_spec_resolve
+
+
+def train_rules(rules, optimizer=None, accumulators=None,
+                default=None, name: Optional[str] = None
+                ) -> TrainPartitionRules:
+    """Build :class:`TrainPartitionRules` from a base layout plus the
+    optimizer that owns the accumulators.
+
+    ``rules``: a :class:`PartitionRules` (e.g. ``canonical_rules(...)``)
+    or a ``(regex, spec)`` list.  ``optimizer``: a live
+    ``paddle_tpu.optimizer.Optimizer`` AFTER ``minimize()`` — its
+    ``accumulator_map()`` supplies the name↔param ground truth;
+    ``accumulators`` passes the map explicitly instead."""
+    if accumulators is None:
+        if optimizer is None:
+            raise ShardingRuleError(
+                "train_rules needs the optimizer (or an explicit "
+                "accumulators= map) to know which accumulator belongs "
+                "to which param")
+        accumulators = optimizer.accumulator_map()
+    return TrainPartitionRules(rules, accumulators, default=default,
+                               name=name)
+
+
+def sharded_train_program(program, rules, optimizer=None,
+                          accumulators=None, mesh=None, mesh_axes=None,
+                          default=None):
+    """One call from a built train program to a mesh-sharded
+    ``CompiledProgram``: wraps ``rules`` into train rules (accumulators
+    inherit their param's placement) and binds the mesh.  ``program``
+    may be a ``Program`` or an existing ``CompiledProgram``."""
+    from paddle_tpu.parallel.compiled_program import CompiledProgram
+
+    if not isinstance(rules, TrainPartitionRules):
+        rules = train_rules(rules, optimizer=optimizer,
+                            accumulators=accumulators, default=default)
+    compiled = (program if getattr(program, "_is_compiled_program", False)
+                else CompiledProgram(program))
+    return compiled.with_sharding_rules(rules, mesh=mesh,
+                                        mesh_axes=mesh_axes)
+
+
+# ---------------------------------------------------------------------------
+# per-device state-bytes accounting (sharding_train_state_bytes gauge)
+# ---------------------------------------------------------------------------
+def per_device_bytes(arr) -> int:
+    """ONE device's bytes for ``arr``: a mesh-committed array counts
+    its (first) addressable shard, a host/single-device value its full
+    size — the measurement behind the gauge, the bench, and the tests
+    (one definition, so they cannot drift)."""
+    shards = getattr(arr, "addressable_shards", None)
+    if shards:
+        return int(shards[0].data.nbytes)
+    return int(getattr(arr, "nbytes", 0))
+
+
+# hot-path: begin train_state_bytes (runs on the compiled program's
+# full placement pass — a cold/warmup event; reads shard METADATA only,
+# never a device buffer, so no d2h sync can hide here)
+def state_bytes(kind_of, *state_dicts) -> Dict[str, int]:
+    """Per-device bytes by kind over state dicts of {name: array}.
+    ``kind_of``: name -> "param"|"moment"|None (TrainPartitionRules
+    .state_kind).  Grad bytes are the param total: one grad per
+    trainable param, placed like its param by the pinned update
+    layout."""
+    totals = {"param": 0, "moment": 0}
+    for d in state_dicts:
+        for n, a in d.items():
+            kind = kind_of(n)
+            if kind in totals:
+                totals[kind] += per_device_bytes(a)
+    totals["grad"] = totals["param"]
+    return totals
+# hot-path: end train_state_bytes
+
+
+def publish_state_bytes(kind_of, *state_dicts) -> Dict[str, int]:
+    """Set the ``sharding_train_state_bytes{kind=...}`` gauges from the
+    current state placement (called by the compiled program on each
+    full placement pass; steady-state dispatches skip it entirely)."""
+    from paddle_tpu.sharding import metrics as _metrics
+
+    totals = state_bytes(kind_of, *state_dicts)
+    for kind in _KINDS:
+        _metrics.TRAIN_STATE_BYTES.labels(kind=kind).set(totals[kind])
+    return totals
+
+
+def retire_state_bytes() -> None:
+    """Drop the ``sharding_train_state_bytes`` series from the
+    exposition — called when the sharded-training layout is torn down
+    (the compiled program's mesh/rules rebind does this; tests and the
+    bench call it explicitly after teardown).  Like the publish path,
+    this is process-global: the gauge carries only a ``kind`` label, so
+    a process is assumed to host ONE sharded trainer at a time."""
+    from paddle_tpu.sharding import metrics as _metrics
+
+    for kind in _KINDS:
+        _metrics.TRAIN_STATE_BYTES.remove_labels(kind=kind)

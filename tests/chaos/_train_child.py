@@ -7,6 +7,12 @@ the GLOBAL step index, so a resumed run regenerates exactly the batches
 the killed run would have consumed — loss-trajectory continuity is then
 a straight per-step comparison.
 
+``--sharded``: the same drill through the SHARDED training path — the
+model trains with Adam on an fsdp-2 mesh via
+``paddle_tpu.sharding.train`` rules, so the checkpoints under test are
+SHARD-wise (per-shard files, no host gather) and resume must re-place
+every shard (moments included) loss-exactly.
+
 Driven by tests/chaos/test_chaos_training.py; not a test module.
 """
 import argparse
@@ -18,6 +24,13 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 sys.path.insert(0, REPO_ROOT)
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--sharded" in sys.argv:
+    # the fsdp-2 mesh needs virtual CPU devices; must land in the env
+    # before jax initializes its backend (imports below stay lazy) —
+    # one shared definition with every CPU-mesh bench stage
+    import bench_common
+
+    os.environ.update(bench_common.virtual_mesh_env())
 
 import numpy as np  # noqa: E402
 
@@ -27,7 +40,7 @@ from paddle_tpu import framework  # noqa: E402
 W_TRUE = np.array([[0.5], [-1.0], [2.0], [0.25]], np.float32)
 
 
-def build_model():
+def build_model(sharded=False):
     prog, startup = framework.Program(), framework.Program()
     prog.random_seed = startup.random_seed = 17
     with framework.program_guard(prog, startup):
@@ -35,8 +48,24 @@ def build_model():
         y = fluid.layers.data("y", [1])
         pred = fluid.layers.fc(x, 1)
         loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
-        fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
-    return prog, startup, loss
+        if sharded:
+            # Adam, not SGD: the sharded drill must checkpoint/restore
+            # real optimizer moments shard-wise
+            opt = fluid.optimizer.AdamOptimizer(0.05)
+        else:
+            opt = fluid.optimizer.SGDOptimizer(0.05)
+        opt.minimize(loss)
+    if not sharded:
+        return prog, startup, loss
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu import sharding
+    from paddle_tpu.sharding.rules import PartitionRules
+
+    compiled = sharding.sharded_train_program(
+        prog, PartitionRules([(r".", P("fsdp"))], name="child/fsdp"),
+        optimizer=opt, mesh_axes={"fsdp": 2})
+    return compiled, startup, loss
 
 
 def batches(n_steps, step_delay):
@@ -58,9 +87,10 @@ def main():
     ap.add_argument("--step-delay", type=float, default=0.0)
     ap.add_argument("--resume", action="store_true")
     ap.add_argument("--async-ckpt", action="store_true")
+    ap.add_argument("--sharded", action="store_true")
     args = ap.parse_args()
 
-    prog, startup, loss = build_model()
+    prog, startup, loss = build_model(sharded=args.sharded)
     exe = fluid.Executor(fluid.CPUPlace())
     scope = fluid.Scope()
     with fluid.scope_guard(scope):

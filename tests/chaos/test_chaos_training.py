@@ -380,7 +380,7 @@ def test_sigkill_then_resume_loss_continuity(tmp_path):
 
 
 def _spawn_async_child(run_dir, steps, step_delay, resume=False,
-                       commit_delay=None):
+                       commit_delay=None, sharded=False):
     argv = [sys.executable, "-u",
             os.path.join(REPO_ROOT, "tests", "chaos", "_train_child.py"),
             "--run-dir", run_dir, "--steps", str(steps),
@@ -388,6 +388,8 @@ def _spawn_async_child(run_dir, steps, step_delay, resume=False,
             "--async-ckpt"]
     if resume:
         argv.append("--resume")
+    if sharded:
+        argv.append("--sharded")
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PADDLE_TPU_FAULTS", None)
@@ -470,3 +472,80 @@ def test_sigkill_during_background_save_resumes_from_last_complete(
             err_msg="divergence at resumed step %d" % step)
     # the resumed run's own step-10 checkpoint replaced the stale tmp
     assert not any(d.startswith(".tmp-") for d in os.listdir(run_dir))
+
+
+def test_sharded_sigkill_during_background_save(tmp_path):
+    """The SHARDED drill through the same ``checkpoint.commit`` fault
+    point: the child trains fsdp-2 through the rules surface (Adam —
+    moments checkpoint shard-wise), its second ASYNC save stalls in the
+    injected commit delay, and a SIGKILL lands mid-save.  Resume must
+    come up from the last COMPLETE shard-wise checkpoint with per-step
+    loss continuity — every shard (moments included) re-placed onto the
+    mesh, never a half-written attempt trusted."""
+    run_dir = str(tmp_path / "run")
+    proc = _spawn_async_child(run_dir, steps=400, step_delay=0.05,
+                              commit_delay=30.0, sharded=True)
+    lines, err_lines = [], []
+
+    def _collect(stream, sink):
+        try:
+            for line in stream:
+                sink.append(line)
+        except Exception:
+            pass
+
+    threading.Thread(target=_collect, args=(proc.stdout, lines),
+                     daemon=True).start()
+    threading.Thread(target=_collect, args=(proc.stderr, err_lines),
+                     daemon=True).start()
+    try:
+        deadline = time.monotonic() + 120
+        latest = os.path.join(run_dir, "LATEST")
+        while not os.path.exists(latest):
+            assert proc.poll() is None, (
+                "child died before its first checkpoint:\n"
+                + "".join(lines) + "".join(err_lines))
+            assert time.monotonic() < deadline, "no checkpoint within 120s"
+            time.sleep(0.05)
+        while not any(d.startswith(".tmp-") for d in os.listdir(run_dir)):
+            assert proc.poll() is None, (
+                "child died before staging its background save:\n"
+                + "".join(lines) + "".join(err_lines))
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGKILL)
+        assert proc.wait(timeout=30) == -9
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    killed = _parse_losses(lines)
+    with open(latest) as f:
+        committed = int(f.read().strip().rsplit("-", 1)[1])
+    assert committed == 5  # the stalled second save never committed
+    # the committed checkpoint IS shard-wise: per-shard files with
+    # SHARD shapes (the fc weight (4,1) saved as two (2,1) halves)
+    import json as _json
+
+    sdir = os.path.join(run_dir, "ckpt-%06d" % committed, "shards")
+    assert os.path.isdir(sdir)
+    man = _json.load(open(os.path.join(sdir, "manifest.json")))
+    assert man["mesh_axes"] == {"fsdp": 2}
+    went = [e for n, e in man["vars"].items() if e["shape"] == [4, 1]]
+    assert went and all(len(e["shards"]) == 2 for e in went)
+    for e in went:
+        for doc in e["shards"]:
+            assert np.load(os.path.join(sdir, doc["file"])).shape == (2, 1)
+
+    res = _spawn_async_child(run_dir, steps=committed + 6,
+                             step_delay=0.0, resume=True, sharded=True)
+    out, err = res.communicate(timeout=180)
+    assert res.returncode == 0, err
+    assert ("RESUMED_FROM %d" % committed) in out
+    resumed = _parse_losses(out.splitlines())
+    assert min(resumed) == committed  # nothing before the cursor re-ran
+    overlap = sorted(set(killed) & set(resumed))
+    assert overlap
+    for step in overlap:
+        np.testing.assert_allclose(
+            resumed[step], killed[step], rtol=1e-4,
+            err_msg="divergence at resumed sharded step %d" % step)

@@ -100,6 +100,47 @@ def test_expected_waste_never_negative_for_unservable_sizes():
     assert doc["waste_rows_saved"] >= 0
 
 
+def test_len_ladder_dp_optimal_vs_brute_force():
+    """The KV length-ladder proposal (the same DP pointed at the decode
+    slot pool's length rungs) is exactly optimal: no ladder of the same
+    rung budget pays fewer padded cache positions."""
+    import itertools
+
+    hist = {7: 30, 9: 25, 33: 10, 50: 6, 100: 2}
+    M, k_max = 128, 3
+    proposed = autotune.propose_len_ladder(hist, M, max_rungs=k_max)
+    assert len(proposed) <= k_max and proposed[-1] == M
+    best = None
+    cands = sorted(set(hist) | {M})
+    for k in range(1, k_max + 1):
+        for combo in itertools.combinations(cands, k):
+            if combo[-1] != M:
+                continue
+            w, _ = autotune.expected_waste(hist, combo, M)
+            best = w if best is None else min(best, w)
+    w_dp, _ = autotune.expected_waste(hist, proposed, M)
+    assert w_dp == best
+
+
+def test_plan_kv_ladder_beats_default_on_skewed_lengths():
+    """On a skewed length histogram (the few-prompt-shapes traffic the
+    decode path actually sees) the proposal strictly beats the
+    hand-picked powers-of-two default_len_ladder, and the document
+    quantifies it."""
+    from paddle_tpu.serving.kv_pool import default_len_ladder
+
+    hist = {20: 100, 40: 60, 96: 5}  # powers-of-two pad 20->32, 40->64
+    doc = autotune.plan_kv_ladder(hist, 128, max_rungs=4)
+    assert doc["changed"]
+    assert doc["len_ladder"][-1] == 128
+    assert doc["proposed_waste_ratio"] < doc["current_waste_ratio"]
+    assert doc["waste_positions_saved"] > 0
+    cur_w, _ = autotune.expected_waste(hist, default_len_ladder(128), 128)
+    new_w, _ = autotune.expected_waste(hist, doc["len_ladder"], 128)
+    assert new_w < cur_w
+    assert doc["n_lengths_observed"] == 3
+
+
 def test_timeout_proposal_bounds():
     assert autotune.propose_timeout_ms(None, current_ms=2.0) == 2.0
     assert autotune.propose_timeout_ms(0.0) == 0.5

@@ -221,6 +221,25 @@ def test_generation_eos_and_cap_termination(chain_server):
         [np.array([5], np.int32)]).result()[0].tolist() == [6, 7, 8, 9]
 
 
+def test_seq_len_histogram_feeds_kv_ladder_proposal(chain_server):
+    """Every admitted request records its TOTAL sequence length (prompt
+    + generation budget) — the observed histogram the offline KV
+    length-ladder proposal (autotune.plan_kv_ladder) consumes, surfaced
+    through metrics() like the batching path's arrival histogram."""
+    from paddle_tpu.serving import autotune
+
+    before = chain_server.seq_len_histogram().get(8, 0)
+    req = chain_server.submit({"tokens": np.array([10, 11, 12], np.int32)},
+                              max_new_tokens=5)  # total = 3 + 5 = 8
+    req.result()
+    hist = chain_server.seq_len_histogram()
+    assert hist.get(8, 0) == before + 1
+    assert chain_server.metrics()["decode"]["seq_len_histogram"]["8"] >= 1
+    # the recorded histogram is a valid proposal input as-is
+    doc = autotune.plan_kv_ladder(hist, chain_server.max_seq_len)
+    assert doc["len_ladder"][-1] == chain_server.max_seq_len
+
+
 def test_submit_validation(chain_server):
     with pytest.raises(ValueError):
         chain_server.submit({"tokens": np.zeros((2, 3), np.int32)})

@@ -18,6 +18,44 @@ def test_layouts_cover_their_families():
     assert problems == [], "\n".join(problems)
 
 
+def test_train_layouts_cover_accumulators():
+    """TRAIN mode: every canonical layout, wrapped in train_rules, must
+    cover its family's full train persistable set — params, Adam
+    moments/beta-pows (inherited from the param's rule), the LR var."""
+    problems = check_partition_rules.check_train()
+    assert problems == [], "\n".join(problems)
+
+
+def test_train_builder_sees_real_accumulators():
+    """The train build must produce a real accumulator map — an empty
+    map would make train coverage pass vacuously — and the checker must
+    catch a missing-accumulator layout (an accumulator whose param no
+    rule covers fails typed, naming both)."""
+    from paddle_tpu.sharding.layouts import canonical_rules
+    from paddle_tpu.sharding.rules import PartitionRules, ShardingRuleError
+    from paddle_tpu.sharding.train import train_rules
+
+    shapes, acc_map = check_partition_rules._build_train("transformer_lm")
+    assert "lm_dec_0_att_q_w" in shapes
+    moments = {a: (p, k) for a, (p, k) in acc_map.items()
+               if k == "moment1"}
+    assert moments and all(a in shapes for a in moments)
+
+    # a doctored base layout missing the head rules: the HEAD's moment
+    # fails typed, naming the accumulator AND its param
+    good = canonical_rules("transformer_lm", "tp")
+    doctored = PartitionRules(
+        [(p, s) for p, s in good.rules if "head" not in p],
+        name="doctored")
+    tr = train_rules(doctored, accumulators=acc_map)
+    try:
+        tr.match(shapes)
+    except ShardingRuleError as e:
+        assert "lm_head_w" in str(e)
+    else:
+        raise AssertionError("uncovered accumulator param did not raise")
+
+
 def test_builder_sees_real_params():
     """The model builder must actually produce the families' parameter
     grammars — an empty build would make coverage pass vacuously."""

@@ -53,6 +53,11 @@ CHECKED_FILES = [
     # the list means any future hot-path region added here is guarded
     "paddle_tpu/sharding/rules.py",
     "paddle_tpu/sharding/layouts.py",
+    # sharded-training resolution + restage accounting: spec inheritance
+    # runs on the compiled program's memo-miss path inside the dispatch
+    # region, and the state-bytes pass reads shard METADATA only — a
+    # blocking sync creeping into either would stall every train step
+    "paddle_tpu/sharding/train.py",
     # the precision-variant dispatch (one dict lookup per run) is a hot
     # region in inference.py; the rewrite/cast/calibration passes run at
     # load/export time only.  autotune.py is pure re-plan arithmetic on

@@ -17,6 +17,14 @@ seq2seq, DeepFM dense tower), not from a hand-written list, so a model
 refactor that renames a parameter fails here instead of at a serving
 child's load.
 
+TRAIN mode extends the guarantee to sharded training
+(``paddle_tpu.sharding.train``): each family's model is built WITH a
+real backward pass + Adam, and every canonical layout wrapped in
+``train_rules`` must cover the full TRAIN persistable set — params,
+optimizer accumulators (via rule inheritance from their param), LR
+vars — with no unmatched name and no dead rule.  A layout that serves
+fine but cannot train fails here, not in the first sharded epoch.
+
 Wired into tier-1 via tests/test_partition_rules.py (same pattern as
 check_fault_points.py); also runnable directly::
 
@@ -31,8 +39,12 @@ from typing import Dict, List, Tuple
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
-def _build(family: str) -> Dict[str, Tuple[int, ...]]:
-    """{param name: shape} for one family's real in-tree model."""
+def _build_family(family: str, train: bool):
+    """Build one family's real in-tree model; with ``train`` a real
+    Adam minimize is appended (labels + backward + accumulators).
+    Returns ({persistable name: shape}, optimizer-or-None) — ONE
+    construction per family, so the serve and train guards can never
+    validate against different parameter grammars."""
     import paddle_tpu as fluid
     from paddle_tpu import framework, models
     from paddle_tpu.models.seq2seq import transformer_nmt
@@ -41,31 +53,56 @@ def _build(family: str) -> Dict[str, Tuple[int, ...]]:
     with framework.program_guard(prog, startup):
         if family == "transformer_lm":
             ids = fluid.layers.data("src_ids", [16], dtype="int64")
-            models.transformer_lm(
-                ids, None, vocab_size=128, d_model=32, n_layer=2,
+            lbl = (fluid.layers.data("lbl", [16, 1], dtype="int64")
+                   if train else None)
+            loss, _ = models.transformer_lm(
+                ids, lbl, vocab_size=128, d_model=32, n_layer=2,
                 n_head=4, d_inner=64, seq_len=16, max_pos=64)
         elif family == "transformer_nmt":
             src = fluid.layers.data("src_ids", [8], dtype="int64")
             tgt = fluid.layers.data("tgt_ids", [8], dtype="int64")
-            transformer_nmt(src, tgt, None, None, src_len=8, tgt_len=8)
+            lbl = (fluid.layers.data("lbl", [8, 1], dtype="int64")
+                   if train else None)
+            loss, _ = transformer_nmt(src, tgt, lbl, None,
+                                      src_len=8, tgt_len=8)
         elif family == "deepfm":
             ids = fluid.layers.data("feat_ids", [39, 1], dtype="int64")
             vals = fluid.layers.data("feat_vals", [39])
             lbl = fluid.layers.data("lbl", [1], dtype="int64")
-            models.deepfm_ctr(ids, vals, lbl, num_features=1000,
-                              num_fields=39, embed_dim=8,
-                              deep_layers=(16, 16))
+            loss, _ = models.deepfm_ctr(ids, vals, lbl, num_features=1000,
+                                        num_fields=39, embed_dim=8,
+                                        deep_layers=(16, 16))
         else:
             raise ValueError("unknown family %r" % family)
+        opt = None
+        if train:
+            opt = fluid.optimizer.AdamOptimizer(1e-3)
+            opt.minimize(loss)
     # the same predicate save_inference_model validates against
     # (io._is_persistable): persistable non-Parameter vars — e.g. batch
     # norm running stats — must be covered too, or this guard would
     # green-light layouts the export path rejects
-    return {
+    shapes = {
         v.name: tuple(v.shape or ())
         for v in prog.list_vars()
         if v.persistable and not v.is_data
     }
+    return shapes, opt
+
+
+def _build(family: str) -> Dict[str, Tuple[int, ...]]:
+    """{param name: shape} for one family's real in-tree model."""
+    return _build_family(family, train=False)[0]
+
+
+def _build_train(family: str):
+    """(persistable shapes, accumulator map) for one family's real
+    TRAIN program: the same build as :func:`_build` with labels + a
+    real Adam minimize, so the persistable set includes every optimizer
+    accumulator and the LR var — exactly what a sharded training run
+    must place."""
+    shapes, opt = _build_family(family, train=True)
+    return shapes, opt.accumulator_map()
 
 
 def check() -> List[str]:
@@ -93,13 +130,53 @@ def check() -> List[str]:
     return problems
 
 
+def check_train() -> List[str]:
+    """Train-mode coverage: every canonical layout, wrapped in
+    ``train_rules``, must resolve the family's FULL train persistable
+    set — optimizer accumulators inherit their param's rule, scalars
+    (beta pows, LR) auto-replicate, and no rule may be dead against the
+    param names."""
+    from paddle_tpu.sharding.layouts import FAMILIES, MODES, canonical_rules
+    from paddle_tpu.sharding.rules import ShardingRuleError
+    from paddle_tpu.sharding.train import train_rules
+
+    problems: List[str] = []
+    for family in sorted(FAMILIES):
+        shapes, acc_map = _build_train(family)
+        if not acc_map:
+            problems.append(
+                "family %r built zero optimizer accumulators" % family)
+            continue
+        missing = [a for a, (p, _) in acc_map.items() if a not in shapes]
+        if missing:
+            problems.append(
+                "family %r: accumulators %s not among the program's "
+                "persistables" % (family, missing[:3]))
+        for mode in MODES:
+            rules = train_rules(canonical_rules(family, mode),
+                                accumulators=acc_map)
+            try:
+                rules.match(shapes)
+            except ShardingRuleError as e:
+                problems.append(
+                    "train layout %s/%s does not cover its family's "
+                    "train state: %s" % (family, mode, e))
+            param_names = [n for n in shapes if n not in acc_map]
+            for pat in rules.dead_rules(param_names):
+                problems.append(
+                    "train layout %s/%s rule %r matches no %s "
+                    "parameter (dead rule)" % (family, mode, pat, family))
+    return problems
+
+
 def main() -> int:
-    problems = check()
+    problems = check() + check_train()
     if not problems:
         from paddle_tpu.sharding.layouts import FAMILIES, MODES
 
-        print("check_partition_rules: OK (%d layouts cover %d families)"
-              % (len(FAMILIES) * len(MODES), len(FAMILIES)))
+        print("check_partition_rules: OK (%d layouts cover %d families, "
+              "serve + train)" % (len(FAMILIES) * len(MODES),
+                                  len(FAMILIES)))
         return 0
     for p in problems:
         print("check_partition_rules: %s" % p, file=sys.stderr)
