@@ -138,7 +138,292 @@ def run(batch=BATCH, steps=STEPS, chunk=CHUNK):
     }
 
 
+# ---------------------------------------------------------------------------
+# Sparse scale-out stages (ISSUE 14): mesh-resident row-sharded tables,
+# serial vs overlapped PS prefetch, and the Zipf hot-id serving cache.
+# Env knobs (defaults sized for the 90 s deepfm_sparse budget):
+SPARSE_FEATURES = int(os.environ.get("BENCH_DEEPFM_SPARSE_FEATURES",
+                                     "1000000"))
+SPARSE_BATCH = int(os.environ.get("BENCH_DEEPFM_SPARSE_BATCH", "512"))
+SPARSE_STEPS = int(os.environ.get("BENCH_DEEPFM_SPARSE_STEPS", "16"))
+SPARSE_MESH = int(os.environ.get("BENCH_DEEPFM_SPARSE_MESH", "8"))
+# Simulated PS network RTT for the overlap drill: the in-process
+# loopback server has ~zero wire latency, so without it the drill
+# measures only CPU contention, not the round trip overlap actually
+# hides.  Injected via the ps.pull delay fault (a sleep — no CPU), paid
+# identically by BOTH legs; 0 disables.
+SPARSE_NET_MS = float(os.environ.get("BENCH_DEEPFM_SPARSE_NET_MS", "30"))
+SPARSE_OVERLAP_STEPS = int(os.environ.get(
+    "BENCH_DEEPFM_SPARSE_OVERLAP_STEPS", "24"))
+
+
+def _sparse_model(num_features, fields=8, embed=16, seed=42,
+                  deep_layers=(64, 64)):
+    import paddle_tpu as fluid
+    from paddle_tpu import framework, models
+
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = seed
+    with framework.program_guard(prog, startup):
+        ids = fluid.layers.data("ids", [fields, 1], dtype="int64")
+        vals = fluid.layers.data("vals", [fields])
+        lbl = fluid.layers.data("lbl", [1], dtype="int64")
+        avg_loss, _ = models.deepfm.deepfm_ctr(
+            ids, vals, lbl, num_features=num_features, num_fields=fields,
+            embed_dim=embed, deep_layers=deep_layers, distributed_emb=True,
+        )
+        fluid.optimizer.SGDOptimizer(1e-2).minimize(avg_loss)
+    return prog, startup, avg_loss
+
+
+def _sparse_feeds(num_features, batch, n, fields=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        {"ids": rng.randint(0, num_features,
+                            (batch, fields, 1)).astype("int64"),
+         "vals": rng.rand(batch, fields).astype("float32"),
+         "lbl": rng.randint(0, 2, (batch, 1)).astype("int64")}
+        for _ in range(n)
+    ]
+
+
+def _run_mesh_tables(steps, batch):
+    """Mesh-resident row-sharded tables: examples/s + per-device table
+    bytes at a table whose REPLICATED form exceeds one virtual chip's
+    1/n share (the sharded layout is what makes it placeable)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel import mesh as mesh_lib
+    from paddle_tpu.parallel.compiled_program import CompiledProgram
+    from paddle_tpu.sharding.sparse import bind_mesh_tables
+
+    prog, startup, avg_loss = _sparse_model(SPARSE_FEATURES)
+    mesh = mesh_lib.make_mesh({"mp": SPARSE_MESH})
+    compiled = CompiledProgram(prog).with_mesh(mesh)
+    rt = bind_mesh_tables(compiled, optimizer="sgd", lr=1e-2,
+                          initializer="uniform")
+    feeds = _sparse_feeds(SPARSE_FEATURES, batch, 4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # warm every bucket the id mix can produce + the program shape
+        from paddle_tpu.executor import pow2_id_bucket
+
+        uniq_counts = {pow2_id_bucket(len(np.unique(f["ids"])))
+                       for f in feeds}
+        rt.warmup(sorted(uniq_counts))
+        for f in feeds[:2]:
+            (l,) = exe.run(compiled, feed=dict(f), fetch_list=[avg_loss])
+            np.asarray(l)
+        c0, m0 = rt.compiles, exe.jit_cache_stats()["misses"]
+        done = 0
+        t0 = time.perf_counter()
+        while done < steps:
+            (l,) = exe.run(compiled, feed=dict(feeds[done % len(feeds)]),
+                           fetch_list=[avg_loss])
+            np.asarray(l)
+            done += 1
+        dt = time.perf_counter() - t0
+        recompiles = (exe.jit_cache_stats()["misses"] - m0) + (
+            rt.compiles - c0)
+    stats = rt.stats()["tables"]
+    per_dev = sum(t["bytes_per_device"] for t in stats.values())
+    replicated = sum(t["replicated_bytes"] for t in stats.values())
+    out = {
+        "examples_per_sec": round(batch * done / dt, 1),
+        "table_bytes_per_device": int(per_dev),
+        "table_bytes_replicated": int(replicated),
+        "per_device_share_of_replicated": round(per_dev / replicated, 4),
+        "n_shards": SPARSE_MESH,
+        "recompiles_after_warmup": int(recompiles),
+    }
+    rt.close()
+    if recompiles != 0:
+        raise AssertionError(
+            "mesh-table stage recompiled %d time(s) after warmup"
+            % recompiles)
+    return out
+
+
+def _run_prefetch_overlap(steps, batch):
+    """Serial vs overlapped PS prefetch (both async-push mode, so the
+    ONLY delta is whether batch N+1's pulls hide behind batch N):
+    examples/s must strictly improve, and the
+    executor_ps_pull_overlap_seconds_total accounting shows the hidden
+    latency beside the visible wait.  Both legs pay the same simulated
+    PS network RTT (SPARSE_NET_MS via the ps.pull delay fault) — the
+    loopback server has none, and the RTT is exactly what the overlap
+    exists to hide."""
+    import contextlib
+
+    import paddle_tpu as fluid
+    from paddle_tpu import faults
+    from paddle_tpu.distributed.ps import ParameterServer
+
+    feeds = _sparse_feeds(SPARSE_FEATURES, batch, steps, seed=1)
+    net = (faults.armed("ps.pull=delay:%.4f" % (SPARSE_NET_MS / 1e3))
+           if SPARSE_NET_MS > 0 else contextlib.nullcontext())
+
+    def drill(overlap):
+        server = ParameterServer().start()
+        try:
+            # a real tower (the train step must have compute for the
+            # pull to hide BEHIND — the lookup-only module is pull-bound
+            # and caps the overlap win at ~1.1x)
+            prog, startup, avg_loss = _sparse_model(
+                SPARSE_FEATURES, deep_layers=(512, 512, 512))
+            fluid.distributed.bind_distributed_tables(
+                prog, [server.endpoint], optimizer="sgd", lr=1e-2,
+                initializer="zeros", async_mode=True)
+            prog._sparse_overlap = overlap
+            exe = fluid.Executor(fluid.CPUPlace())
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                # warm the EXACT timed entry (no fetch list — the epoch
+                # below runs none; a different fetch set is a different
+                # jit key and its compile would land in the window)
+                for _ in range(2):
+                    exe.run(prog, feed=dict(feeds[0]))
+                t0 = time.perf_counter()
+                exe.train_from_dataset(program=prog, dataset=feeds,
+                                       scope=scope)
+                dt = time.perf_counter() - t0
+            stats = exe.jit_cache_stats()
+            prog._ps_communicator.stop()
+            return (round(batch * len(feeds) / dt, 1),
+                    round(stats["ps_pull_overlap_s"], 4),
+                    round(stats["ps_pull_wait_s"], 4))
+        finally:
+            server.stop()
+
+    with net:
+        # best-of-2 per leg: a transient CPU-contention spike in one
+        # measurement window (the legs share cores with the pull
+        # threads and anything else on the box) must not decide the
+        # strict-improvement comparison
+        serial_eps = max(drill(False)[0] for _ in range(2))
+        runs = [drill(True) for _ in range(2)]
+        overlap_eps, hidden_s, wait_s = max(runs, key=lambda r: r[0])
+    out = {
+        "serial_examples_per_sec": serial_eps,
+        "overlapped_examples_per_sec": overlap_eps,
+        "speedup": round(overlap_eps / serial_eps, 3),
+        "pull_hidden_s": hidden_s,
+        "pull_wait_s": wait_s,
+        "simulated_net_ms": SPARSE_NET_MS,
+    }
+    if overlap_eps <= serial_eps:
+        raise AssertionError(
+            "overlapped sparse prefetch did not improve examples/s: "
+            "%s" % out)
+    return out
+
+
+def _run_zipf_serving():
+    """Zipf(1.0) hot-id traffic against the serving cache tier: lookup
+    p99 + hit ratio with the cache on vs the raw PS path."""
+    from paddle_tpu.distributed.ps import ParameterServer, PSClient
+    from paddle_tpu.serving.embedding_cache import EmbeddingRowCache
+
+    TABLE_ROWS = 200_000
+    ACTIVE = 20_000
+    CAPACITY = 10_000  # 5% of the table
+    B, WARM, MEAS = 1024, 30, 30
+    server = ParameterServer().start()
+    client = PSClient([server.endpoint])
+    client.create_table("zipf", EMBED, initializer="uniform", seed=3)
+    try:
+        rng = np.random.RandomState(0)
+        p = 1.0 / np.arange(1, ACTIVE + 1)
+        p /= p.sum()
+        cdf = np.cumsum(p)
+
+        def batch():
+            ids = np.searchsorted(cdf, rng.rand(B)).astype(np.int64)
+            uniq, counts = np.unique(ids, return_counts=True)
+            return uniq, counts
+
+        def measure(cache):
+            lats, pulled = [], 0
+            for _ in range(MEAS):
+                uniq, counts = batch()
+                t0 = time.perf_counter()
+                if cache is not None:
+                    cache.lookup_through(client, "zipf", uniq,
+                                         counts=counts)
+                else:
+                    client.pull_sparse("zipf", uniq)
+                    pulled += len(uniq)
+                lats.append(time.perf_counter() - t0)
+            return lats, pulled
+
+        off_lats, off_pulled = measure(None)
+        cache = EmbeddingRowCache(capacity_rows=CAPACITY, name="bench")
+        for _ in range(WARM):
+            uniq, counts = batch()
+            cache.lookup_through(client, "zipf", uniq, counts=counts)
+        s0 = cache.stats()
+        on_lats, _ = measure(cache)
+        s1 = cache.stats()
+        d_hits = s1["hits"] - s0["hits"]
+        d_miss = s1["misses"] - s0["misses"]
+        out = {
+            "hit_ratio": round(d_hits / (d_hits + d_miss), 4),
+            "cache_capacity_rows": CAPACITY,
+            "cache_pct_of_table": round(CAPACITY / TABLE_ROWS, 4),
+            # the PS offload: unique rows actually fetched during the
+            # measured window, cache on vs off (the capacity win even
+            # on a loopback server whose RTT is ~zero)
+            "ps_rows_pulled_cache_on": int(
+                s1["pulled_rows"] - s0["pulled_rows"]),
+            "ps_rows_pulled_cache_off": int(off_pulled),
+            "lookup_p99_ms_cache_on": round(
+                float(np.percentile(on_lats, 99)) * 1e3, 3),
+            "lookup_p99_ms_cache_off": round(
+                float(np.percentile(off_lats, 99)) * 1e3, 3),
+            "lookup_p50_ms_cache_on": round(
+                float(np.percentile(on_lats, 50)) * 1e3, 3),
+            "lookup_p50_ms_cache_off": round(
+                float(np.percentile(off_lats, 50)) * 1e3, 3),
+        }
+        cache.close()
+        return out
+    finally:
+        client.close()
+        server.stop()
+
+
+def run_sparse():
+    """The deepfm_sparse bench stage: one JSON line with the three
+    sparse scale-out sub-stages."""
+    import jax
+
+    platform = jax.devices()[0].platform
+    line = {
+        "metric": "deepfm_sparse_mesh_examples_per_sec",
+        "unit": "examples/sec",
+        "platform": platform,
+        "num_features": SPARSE_FEATURES,
+        "batch": SPARSE_BATCH,
+    }
+    mesh_stage = _run_mesh_tables(SPARSE_STEPS, SPARSE_BATCH)
+    line["value"] = mesh_stage["examples_per_sec"]
+    line["mesh_tables"] = mesh_stage
+    line["prefetch_overlap"] = _run_prefetch_overlap(
+        SPARSE_OVERLAP_STEPS, SPARSE_BATCH)
+    line["zipf_serving"] = _run_zipf_serving()
+    return line
+
+
 if __name__ == "__main__":
     import json
+    import sys
 
-    print(json.dumps(run()))
+    if "--sparse" in sys.argv[1:]:
+        import bench_common
+
+        os.environ.update(bench_common.virtual_mesh_env(SPARSE_MESH))
+        print(json.dumps(run_sparse()))
+    else:
+        print(json.dumps(run()))

@@ -60,6 +60,10 @@ class Communicator:
         self._thread: Optional[threading.Thread] = None
         self._send_client: Optional[PSClient] = None  # the thread's own
         self._error: Optional[BaseException] = None
+        # post-apply hook: called (table, ids) AFTER a merged push has
+        # landed server-side — the embedding cache invalidates here, not
+        # at enqueue time (the rows only change when the send applies)
+        self.on_pushed = None
 
     # -- lifecycle (reference: Communicator::Start/Stop) --
     def start(self):
@@ -142,6 +146,8 @@ class Communicator:
             try:
                 budget.call(
                     lambda: client.push_sparse(table, ids, grads))
+                if self.on_pushed is not None:
+                    self.on_pushed(table, ids)
                 return True
             except Exception:  # noqa: BLE001 — network layer
                 try:

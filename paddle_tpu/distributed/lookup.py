@@ -11,7 +11,7 @@ the lr that matches the trainer-side optimizer for the dense params.
 """
 from __future__ import annotations
 
-from typing import Sequence, Union
+from typing import Optional, Sequence, Union
 
 from paddle_tpu.distributed.ps import PSClient
 
@@ -26,6 +26,7 @@ def bind_distributed_tables(
     initializer: str = "uniform",
     seed: int = 0,
     async_mode: bool = False,
+    id_bucket_ladder: Optional[Sequence[int]] = None,
 ):
     """Create each of ``program``'s distributed tables on the servers and
     attach the client so the executor can prefetch/push.  Returns the
@@ -34,7 +35,15 @@ def bind_distributed_tables(
     ``async_mode``: grad pushes drain through a background Communicator
     (reference: communicator.h async PS) — next step's pull may miss the
     newest grads (bounded staleness); call
-    ``program._ps_communicator.flush()`` before eval/save."""
+    ``program._ps_communicator.flush()`` before eval/save.  Async mode
+    also arms the OVERLAPPED sparse prefetch in ``train_from_dataset``
+    (batch N+1's pulls run behind batch N's device compute).
+
+    ``id_bucket_ladder``: an explicit unique-id-count bucket ladder for
+    the prefetch (the offline ``autotune.propose_id_bucket_ladder``
+    output); without it unique counts pad to power-of-two buckets.
+    Unique counts above the ladder's top rung fall back to power-of-two
+    (a compile, so size the ladder from a representative histogram)."""
     tables = getattr(program, "_distributed_tables", None)
     if not tables:
         raise ValueError("program has no distributed lookup tables")
@@ -54,6 +63,9 @@ def bind_distributed_tables(
             optimizer=optimizer, lr=lr,
         )
     program._ps_client = client
+    if id_bucket_ladder is not None:
+        program._sparse_id_ladder = sorted(
+            int(b) for b in id_bucket_ladder)
     if async_mode:
         from paddle_tpu.distributed.communicator import Communicator
 

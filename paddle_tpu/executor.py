@@ -132,6 +132,16 @@ def _as_fetch_name(f) -> str:
     return f.name if isinstance(f, framework.Variable) else str(f)
 
 
+def pow2_id_bucket(n_unique: int) -> int:
+    """The default sparse-prefetch unique-id bucket: the next power of
+    two >= ``n_unique``, floored at 8.  THE one definition — the
+    prefetch (``_sparse_expand_ids``), the id-ladder autotune's
+    comparison baseline (``autotune._pow2_id_ladder``), and the bench's
+    warmup-bucket computation all call it, so the bucketing can never
+    drift between the runtime and the tools sized against it."""
+    return max(8, 1 << max(0, int(n_unique) - 1).bit_length())
+
+
 def _donate_kwargs(device) -> Dict[str, Any]:
     """Buffer-donation jit kwargs for ``device``.
 
@@ -405,25 +415,47 @@ class Executor:
 
         # distributed lookup tables: pull rows before the step, push the
         # sparse grads after (reference: parameter_prefetch.cc + the
-        # trainer-side send of SelectedRows grads).  Host-side per batch;
-        # NOTE the plan key uses the PRE-expansion feed names — the
-        # rows/local names the prefetch adds are a deterministic function
-        # of them, so the expanded plan is safe to reuse.
+        # trainer-side send of SelectedRows grads).  Host-side per batch
+        # (or a device-side mesh gather — sharding/sparse.py).  NOTE the
+        # plan key uses the PRE-expansion feed names: the rows/local
+        # names the prefetch adds are a deterministic function of them,
+        # so the expanded plan is safe to reuse — and they are EXCLUDED
+        # from the key even when already present (the overlapped
+        # prefetch installs them ahead of run()), so the inline and
+        # overlapped paths share one plan and one jit entry.  A
+        # caller-managed manual prefetch (rows fed with NO side-channel
+        # ids — grads are not pushed) is keyed separately.
+        dist_tables = getattr(program, "_distributed_tables", None)
+        feed_key_names = tuple(sorted(feed))
+        manual_prefetch = ()
+        if dist_tables:
+            side = getattr(program, "_sparse_prefetched_ids", None) or {}
+            internal = set()
+            manual = []
+            for meta in dist_tables.values():
+                internal.add(meta["rows_name"])
+                internal.add(meta["local_name"])
+                if meta["rows_name"] in feed and meta["rows_name"] not in side:
+                    manual.append(meta["rows_name"])
+            feed_key_names = tuple(
+                sorted(n for n in feed if n not in internal))
+            manual_prefetch = tuple(sorted(manual))
         plan_key = (
             framework._program_uid(program),
             program.version,
             sum(len(b.ops) for b in program.blocks),
-            tuple(sorted(feed)),
+            feed_key_names,
             tuple(_as_fetch_name(f) for f in (fetch_list or [])),
             steps,
             per_step_feed,
             getattr(self.place, "backend", None),
             framework._program_uid(compiled) if compiled is not None else None,
+            manual_prefetch,
         )
         ps_push = ()
-        if getattr(program, "_distributed_tables", None):
+        if dist_tables:
             ps_push = self._prefetch_distributed_tables(
-                program, program.global_block(), feed)
+                program, program.global_block(), feed, compiled=compiled)
 
         plan = self._plans.get(plan_key) if use_program_cache else None
         if plan is not None:
@@ -696,19 +728,35 @@ class Executor:
                 for name in names:
                     scope.set(name, client.pull_dense(name, min_version=min_v))
         if ps_push:
-            # async mode: enqueue on the Communicator (merge-before-send
-            # background thread); sync mode: blocking push
+            # mesh-resident tables: shard-wise device update, grad never
+            # leaves HBM.  PS tables: async mode enqueues on the
+            # Communicator (merge-before-send background thread), sync
+            # mode pushes blocking — and a bound embedding cache
+            # invalidates the pushed rows AFTER the server-side write
+            # lands (invalidating before it would let a concurrent
+            # read-through re-cache the pre-update row permanently; the
+            # async path invalidates from the Communicator's send
+            # thread, after each applied merge).
             comm = getattr(program, "_ps_communicator", None)
-            client = program._ps_client
+            client = getattr(program, "_ps_client", None)
+            mesh_rt = getattr(program, "_mesh_tables", None)
+            cache = getattr(program, "_embedding_cache", None)
+            if comm is not None and cache is not None:
+                comm.on_pushed = cache.invalidate_ids
             # fetch_names still carries the dense-grad tail even though
             # those entries were sliced off `fetches` above — subtract
             # both hidden tails or the sparse-grad zip walks user fetches
             n_user = len(fetch_names) - len(ps_push) - n_dense_fetch
             for (table, uniq, _), grad in zip(ps_push, fetches[n_user:]):
+                if mesh_rt is not None and table in mesh_rt:
+                    mesh_rt.push(table, uniq, grad)
+                    continue
                 if comm is not None:
                     comm.push(table, uniq, np.asarray(grad))
                 else:
                     client.push_sparse(table, uniq, np.asarray(grad))
+                    if cache is not None:
+                        cache.invalidate_ids(table, uniq)
             fetches = fetches[:n_user]
         if os.environ.get("FLAGS_check_nan_inf", "0") == "1":
             # module-boundary nan/inf check (reference checks per-op after
@@ -909,6 +957,162 @@ class Executor:
         for n, v in result["vals"].items():
             scope.set(n, v)
 
+    # ------------------------------------------------------------------
+    # Overlapped SPARSE prefetch (train_from_dataset async mode): batch
+    # N+1's per-table PS pulls run on a background thread while batch
+    # N's device compute is in flight — the sparse analog of the
+    # overlapped dense pulls above, with the same dedicated-client and
+    # overlap/wait accounting contracts.  Async (Communicator) mode
+    # only: the prefetched rows miss the current step's own push
+    # (bounded staleness 1), which async mode already tolerates by
+    # construction; sync mode keeps the strict pull-push ordering.
+    # ------------------------------------------------------------------
+    def _sparse_overlap_clients(self, ctx, endpoints, n: int):
+        """The overlap thread's own clients (one per table) — never the
+        caller's, and never the inline pool's (those serve the caller
+        thread's concurrent pulls)."""
+        from paddle_tpu.distributed.ps import PSClient
+
+        pool = ctx.setdefault("clients", [])
+        while len(pool) < n:
+            pool.append(PSClient(list(endpoints)))
+        return pool[:n]
+
+    def _sparse_overlap_close(self, ctx) -> None:
+        for cl in ctx.pop("clients", []):
+            try:
+                cl.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+
+    def _sparse_spawn_prefetch(self, program, feed) -> None:
+        """Start the NEXT batch's table pulls on a background thread
+        (one in flight at a time — the overlap iterator joins before
+        spawning).  Per-table pulls inside the thread run concurrently
+        on dedicated clients; a transient failure closes the thread's
+        clients, redials, and retries under the shared RetryPolicy
+        budget — on exhaustion the error surfaces typed at join."""
+        import threading
+
+        dist_tables = program._distributed_tables
+        mesh_rt = getattr(program, "_mesh_tables", None)
+        cache = getattr(program, "_embedding_cache", None)
+        ladder = getattr(program, "_sparse_id_ladder", None)
+        endpoints = getattr(
+            getattr(program, "_ps_client", None), "endpoints", None)
+        jobs = []
+        for meta in dist_tables.values():
+            if meta["rows_name"] in feed or meta["ids_name"] not in feed:
+                continue
+            if mesh_rt is not None and meta["table"] in mesh_rt:
+                continue  # device-side gather: nothing to hide
+            uniq_p, n, counts, local = self._sparse_expand_ids(
+                meta, feed[meta["ids_name"]], ladder)
+            self._record_uniq_count(program, n)
+            jobs.append((meta, uniq_p, n, counts, local))
+        if not jobs or not endpoints:
+            return
+        ctx = program.__dict__.setdefault("_sparse_overlap_ctx", {})
+        result: Dict[str, Any] = {}
+        budget = self._ps_pull_policy().budget(op="ps.pull")
+
+        def _pull():
+            t0 = time.perf_counter()
+            try:
+                while True:
+                    try:
+                        clients = self._sparse_overlap_clients(
+                            ctx, endpoints, len(jobs))
+                        vals, errs = self._fanout_table_pulls(
+                            jobs, clients, cache)
+                        if errs:
+                            raise errs[0][0]
+                        result["vals"] = vals
+                        return
+                    except self._PS_PULL_RETRYABLE:
+                        # close + redial on a fresh set, like the dense
+                        # pull thread (no socket leak per failed pull)
+                        self._sparse_overlap_close(ctx)
+                        if not budget.backoff():
+                            raise
+            except BaseException as e:  # noqa: BLE001 — re-raised at join
+                result["exc"] = e
+            finally:
+                result["dur"] = time.perf_counter() - t0
+
+        th = threading.Thread(target=_pull, name="ptpu-sparse-prefetch",
+                              daemon=True)
+        ctx["pending"] = (th, result, jobs)
+        th.start()
+
+    def _sparse_join_prefetch(self, program, feed) -> None:
+        """Join the in-flight sparse prefetch and install the pulled
+        rows + local maps into ``feed``; the unique ids ride the
+        ``_sparse_prefetched_ids`` side-channel so the next run() still
+        pushes this batch's sparse grads.  Accounting mirrors the dense
+        path: ``ps_pull_overlap_s`` is the pull time that hid behind
+        device compute, ``ps_pull_wait_s`` what this join blocked for."""
+        ctx = program.__dict__.get("_sparse_overlap_ctx")
+        pending = ctx.pop("pending", None) if ctx else None
+        if pending is None:
+            return
+        th, result, jobs = pending
+        t0 = time.perf_counter()
+        th.join()
+        wait = time.perf_counter() - t0
+        stats = self._cache_stats
+        stats["ps_pull_wait_s"] += wait
+        stats["ps_pull_overlap_s"] += max(0.0, result.get("dur", 0.0) - wait)
+        exc = result.get("exc")
+        if exc is not None:
+            raise exc
+        side = program.__dict__.setdefault("_sparse_prefetched_ids", {})
+        for meta, uniq_p, _n, _counts, local in jobs:
+            feed[meta["rows_name"]] = result["vals"][meta["rows_name"]]
+            feed[meta["local_name"]] = local
+            side[meta["rows_name"]] = uniq_p
+
+    def _sparse_overlap_iter(self, program, batches):
+        """One-step-lookahead wrapper: spawn batch N+1's pulls BEFORE
+        yielding batch N (so they run while N computes), join + install
+        when the consumer asks for N+1.  Every exit path joins the
+        pending thread and closes the overlap clients."""
+        ctx = program.__dict__.setdefault("_sparse_overlap_ctx", {})
+        it = iter(batches)
+
+        def pull_next():
+            # work on a COPY: the join installs rows/local into the
+            # feed, and mutating the CALLER's dict would make a second
+            # epoch over the same feed list look manually-prefetched
+            # (silently dropping its grad pushes)
+            nxt = next(it, None)
+            return dict(nxt) if isinstance(nxt, dict) else nxt
+
+        try:
+            cur = pull_next()
+            if cur is None:
+                return
+            while True:
+                nxt = pull_next()
+                if nxt is not None:
+                    self._sparse_spawn_prefetch(program, nxt)
+                yield cur
+                if nxt is None:
+                    return
+                self._sparse_join_prefetch(program, nxt)
+                cur = nxt
+        finally:
+            pending = ctx.pop("pending", None)
+            if pending is not None:
+                # abandoned mid-epoch (consumer error/break): drain the
+                # thread so it can't race teardown; its error is moot
+                pending[0].join()
+            self._sparse_overlap_close(ctx)
+            program.__dict__.pop("_sparse_prefetched_ids", None)
+            closer = getattr(it, "close", None)
+            if closer is not None:
+                closer()
+
     def _dense_ps_init(self, ctx, scope):
         """First-run handshake: create the server-side entries, trainer 0
         seeds its initial param values (deterministic broadcast), everyone
@@ -1033,55 +1237,227 @@ class Executor:
         return out
 
     # ------------------------------------------------------------------
-    def _prefetch_distributed_tables(self, program, block, feed):
-        """Pull each distributed table's rows for this batch's unique ids
-        and add them (plus the ids->row map) to the feed.  Returns
+    # Distributed lookup tables: the sparse prefetch/push runtime.
+    # Three backends behind one feed contract: mesh-resident tables
+    # (sharding/sparse.py device gather), PS pulls (optionally through a
+    # hot-id cache), and the overlapped background prefetch that
+    # pipelines batch N+1's pulls behind batch N's device compute.
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _sparse_expand_ids(meta, ids_val, ladder=None):
+        """Unique + bucket one table's batch ids.  Returns
+        ``(uniq_padded, n_uniq, counts, local)``: the bucketed unique
+        ids (padded by repeating ids[0], which receives zero gradient —
+        no local index maps to it, so the push is a no-op for it), the
+        real unique count, per-unique occurrence counts (the cache's
+        served-rows accounting), and the ids->row map shaped like the
+        feed.  ``ladder``: an explicit unique-count bucket ladder (the
+        autotuned ``propose_id_bucket_ladder`` output); sizes above its
+        top rung — or no ladder — fall back to power-of-two buckets."""
+        ids_val = np.asarray(ids_val)
+        flat = ids_val.reshape(-1).astype(np.int64)
+        uniq, inv, counts = np.unique(
+            flat, return_inverse=True, return_counts=True)
+        n = len(uniq)
+        bucket = None
+        if ladder:
+            for r in ladder:
+                if int(r) >= n:
+                    bucket = int(r)
+                    break
+        if bucket is None:
+            bucket = pow2_id_bucket(n)
+        fill = uniq[0] if n else 0
+        uniq_p = np.concatenate(
+            [uniq, np.full(bucket - n, fill, np.int64)])
+        local = inv.astype(np.int32)
+        if meta["squeeze_last"] and ids_val.ndim >= 2 and ids_val.shape[-1] == 1:
+            local = local.reshape(ids_val.shape[:-1])
+        else:
+            local = local.reshape(ids_val.shape)
+        return uniq_p, n, counts, local
+
+    @staticmethod
+    def _record_uniq_count(program, n: int) -> None:
+        """Per-batch unique-id-count histogram (the offline id-ladder
+        autotuner's input — serving.autotune.propose_id_bucket_ladder).
+        Best-effort under the GIL, like the serving arrival histogram."""
+        hist = program.__dict__.get("_uniq_id_hist")
+        if hist is None:
+            hist = program.__dict__.setdefault("_uniq_id_hist", {})
+        hist[n] = hist.get(n, 0) + 1
+
+    def _sparse_client_pool(self, program, n: int):
+        """``n`` DEDICATED PSClients for concurrent per-table pulls (a
+        PSClient socket is not thread-safe — interleaved frames corrupt
+        the wire).  Pooled on the program and redialed lazily after an
+        error closed one.  Returns None when the bound client is a
+        duck-typed stub with no endpoints to dial (tests) — the caller
+        then pulls serially on its own thread."""
+        client = getattr(program, "_ps_client", None)
+        endpoints = getattr(client, "endpoints", None)
+        if not endpoints:
+            return None
+        from paddle_tpu.distributed.ps import PSClient
+
+        pool = program.__dict__.setdefault("_sparse_pull_pool", [])
+        while len(pool) < n:
+            pool.append(PSClient(list(endpoints)))
+        return pool[:n]
+
+    def _pull_one_table(self, client, cache, meta, uniq_p, n_uniq, counts):
+        """One table's row pull, through the hot-id cache when bound."""
+        if cache is not None:
+            rows = cache.lookup_through(
+                client, meta["table"], uniq_p, n_valid=n_uniq,
+                counts=counts)
+        else:
+            rows = client.pull_sparse(meta["table"], uniq_p)
+        return np.asarray(rows, np.float32)
+
+    def _fanout_table_pulls(self, jobs, clients, cache):
+        """The shared per-table fan-out: job 0 on the CALLING thread
+        with ``clients[0]``, jobs[1:] on worker threads each with its
+        dedicated client (one socket per thread — frames never
+        interleave).  Returns ``(results, errors)`` with ``errors`` as
+        ``[(exc, client)]`` — callers decide the cleanup policy (the
+        inline path drops the failed pool client; the overlap thread
+        redials its whole set)."""
+        results: Dict[str, np.ndarray] = {}
+        errors: List = []
+
+        def work(job, cl):
+            meta, uniq_p, n, counts, _local = job
+            try:
+                results[meta["rows_name"]] = self._pull_one_table(
+                    cl, cache, meta, uniq_p, n, counts)
+            except BaseException as e:  # noqa: BLE001 — caller re-raises
+                errors.append((e, cl))
+
+        if len(jobs) == 1:
+            work(jobs[0], clients[0])
+            return results, errors
+        import threading
+
+        threads = [
+            threading.Thread(target=work, args=(job, cl),
+                             name="ptpu-sparse-pull", daemon=True)
+            for job, cl in zip(jobs[1:], clients[1:])
+        ]
+        for th in threads:
+            th.start()
+        work(jobs[0], clients[0])
+        for th in threads:
+            th.join()
+        return results, errors
+
+    def _pull_tables_concurrent(self, program, client, cache, jobs):
+        """Issue every job's ``pull_sparse`` CONCURRENTLY — job 0 on the
+        calling thread with ``client``, the rest on worker threads each
+        with a dedicated pool client (DeepFM has one table per sparse
+        field; serializing them on one socket was the old behavior).
+        Returns {rows_name: rows}; the first worker error propagates
+        after all joins, with that worker's client closed and dropped
+        from the pool (the next pull redials)."""
+        pool = (self._sparse_client_pool(program, len(jobs) - 1)
+                if len(jobs) > 1 else None)
+        if len(jobs) > 1 and not pool:
+            # duck-typed stub client with no endpoints to dial: serial
+            results: Dict[str, np.ndarray] = {}
+            for meta, uniq_p, n, counts, _local in jobs:
+                results[meta["rows_name"]] = self._pull_one_table(
+                    client, cache, meta, uniq_p, n, counts)
+            return results
+        results, errors = self._fanout_table_pulls(
+            jobs, [client] + (pool or []), cache)
+        if errors:
+            exc = errors[0][0]
+            pool_list = program.__dict__.get("_sparse_pull_pool", [])
+            for e, cl in errors:
+                if cl is not client:
+                    try:
+                        cl.close()
+                    finally:
+                        if cl in pool_list:
+                            pool_list.remove(cl)
+            raise exc
+        return results
+
+    def _prefetch_distributed_tables(self, program, block, feed,
+                                     compiled=None):
+        """Resolve each distributed table's rows for this batch's unique
+        ids and add them (plus the ids->row map) to the feed.  Returns
         [(table, padded_unique_ids, rows_grad_name)] for tables whose
-        grad exists in the program (training) so run() can push after the
-        step.  Unique counts are padded to power-of-two buckets to bound
-        recompiles; padding repeats ids[0], which receives zero gradient
-        (no local index maps to it) so the push is a no-op for it."""
+        grad exists in the program (training) so run() can push after
+        the step.  Unique counts bucket (power-of-two, or the autotuned
+        ``program._sparse_id_ladder``) to bound recompiles.
+
+        Routing per table: a mesh-resident table (``bind_mesh_tables``)
+        serves a device-side sharded gather — no host round-trip; PS
+        tables pull host-side, all tables CONCURRENTLY (dedicated
+        clients) and through the hot-id embedding cache when one is
+        bound; rows already in the feed were supplied by the overlapped
+        prefetch (its side-channel carries the unique ids so the grad
+        push still happens) or by a manual caller (no push)."""
         dist_tables = getattr(program, "_distributed_tables", None)
         if not dist_tables:
             return []
-        client = getattr(program, "_ps_client", None)
-        if client is None:
-            raise RuntimeError(
-                "program has distributed lookup tables; call "
-                "paddle_tpu.distributed.bind_distributed_tables(program, "
-                "endpoints) before running it"
-            )
+        mesh_rt = getattr(program, "_mesh_tables", None)
+        cache = getattr(program, "_embedding_cache", None)
+        side = getattr(program, "_sparse_prefetched_ids", None)
+        ladder = getattr(program, "_sparse_id_ladder", None)
         from paddle_tpu.framework import grad_var_name
 
         ps_push = []
+        pulls = []  # PS-backed jobs, pulled concurrently below
         for meta in dist_tables.values():
             tname = meta["table"]
-            if meta["rows_name"] in feed:
-                continue  # caller prefetched manually
+            rows_name = meta["rows_name"]
+            if rows_name in feed:
+                if side and rows_name in side:
+                    # overlapped prefetch: rows landed ahead of run();
+                    # the side-channel ids keep the grad push alive
+                    uniq_p = side.pop(rows_name)
+                    gname = grad_var_name(rows_name)
+                    if block._find_var_recursive(gname) is not None:
+                        ps_push.append((tname, uniq_p, gname))
+                continue  # caller prefetched manually (no push)
             ids_name = meta["ids_name"]
             if ids_name not in feed:
                 raise RuntimeError(
                     "distributed table %r needs ids var %r in the feed "
                     "(prefetch happens host-side per batch)" % (tname, ids_name)
                 )
-            ids_val = np.asarray(feed[ids_name])
-            flat = ids_val.reshape(-1).astype(np.int64)
-            uniq, inv = np.unique(flat, return_inverse=True)
-            bucket = max(8, 1 << max(0, int(len(uniq) - 1).bit_length()))
-            pad = bucket - len(uniq)
-            fill = uniq[0] if len(uniq) else 0
-            uniq_p = np.concatenate([uniq, np.full(pad, fill, np.int64)])
-            rows = client.pull_sparse(meta["table"], uniq_p)
-            local = inv.astype(np.int32)
-            if meta["squeeze_last"] and ids_val.ndim >= 2 and ids_val.shape[-1] == 1:
-                local = local.reshape(ids_val.shape[:-1])
-            else:
-                local = local.reshape(ids_val.shape)
-            feed[meta["rows_name"]] = np.asarray(rows, np.float32)
+            uniq_p, n_uniq, counts, local = self._sparse_expand_ids(
+                meta, feed[ids_name], ladder)
+            self._record_uniq_count(program, n_uniq)
             feed[meta["local_name"]] = local
-            gname = grad_var_name(meta["rows_name"])
+            gname = grad_var_name(rows_name)
             if block._find_var_recursive(gname) is not None:
-                ps_push.append((meta["table"], uniq_p, gname))
+                ps_push.append((tname, uniq_p, gname))
+            if mesh_rt is not None and tname in mesh_rt:
+                if compiled is None:
+                    raise RuntimeError(
+                        "table %r is mesh-resident (bind_mesh_tables): "
+                        "its rows live sharded on the mesh, so this "
+                        "program must run through its CompiledProgram "
+                        "— an uncompiled run cannot consume the "
+                        "mesh-committed lookup" % tname)
+                feed[rows_name] = mesh_rt.lookup(tname, uniq_p)
+            else:
+                pulls.append((meta, uniq_p, n_uniq, counts, local))
+        if pulls:
+            client = getattr(program, "_ps_client", None)
+            if client is None:
+                raise RuntimeError(
+                    "program has distributed lookup tables; call "
+                    "paddle_tpu.distributed.bind_distributed_tables("
+                    "program, endpoints) before running it"
+                )
+            rows_by_name = self._pull_tables_concurrent(
+                program, client, cache, pulls)
+            for meta, _uniq_p, _n, _counts, _local in pulls:
+                feed[meta["rows_name"]] = rows_by_name[meta["rows_name"]]
         return ps_push
 
     # ------------------------------------------------------------------
@@ -1202,6 +1578,15 @@ class Executor:
                     device = None  # no jax backend: prefetch host-side only
                 batches = _reader.device_buffered(
                     batches, size=n_prefetch, device=device)()
+        # overlapped SPARSE prefetch: in async (Communicator) mode batch
+        # N+1's per-table PS pulls run behind batch N's device compute
+        # (the sparse analog of the dense overlap below; same
+        # ps_pull_overlap_s accounting, same bounded-staleness trade —
+        # sync mode keeps the strict pull-after-push ordering)
+        if (getattr(prog_obj, "_distributed_tables", None)
+                and getattr(prog_obj, "_ps_communicator", None) is not None
+                and getattr(prog_obj, "_sparse_overlap", True)):
+            batches = self._sparse_overlap_iter(prog_obj, batches)
         # dense-PS async mode: overlap each step's host param pull with
         # the device compute (the pull thread runs while the chip works;
         # ps_pull_overlap_s counts the hidden seconds).  Sync mode keeps

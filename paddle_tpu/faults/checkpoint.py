@@ -436,6 +436,12 @@ class TrainCheckpoint:
                     "checkpoint %s carries PS tables but no ps_client was "
                     "given to restore them" % path)
             self._restore_ps(ps_dir, ps_client)
+            cache = getattr(program, "_embedding_cache", None)
+            if cache is not None:
+                # the restore rewrote rows wholesale server-side: a
+                # cached copy from before it is stale (regression-pinned
+                # in tests/test_embedding_cache.py)
+                cache.invalidate()
         with open(os.path.join(path, "cursor.json")) as f:
             return json.load(f)
 

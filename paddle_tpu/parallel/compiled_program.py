@@ -45,6 +45,10 @@ class CompiledProgram:
         self._rules = None  # PartitionRules (with_sharding_rules)
         self._mesh_axes: Optional[Dict[str, int]] = None  # manifest form
         self._batch_axis = "dp"
+        # feeds whose leading dim is NOT the batch (mesh-table prefetch
+        # rows: leading dim = bucketed unique ids) — placed replicated
+        # instead of batch-sharded (sharding.sparse.bind_mesh_tables)
+        self._replicated_feeds: set = set()
         self._build_strategy: Optional[BuildStrategy] = None
         self._exec_strategy: Optional[ExecutionStrategy] = None
         self._loss_name: Optional[str] = None
@@ -207,6 +211,8 @@ class CompiledProgram:
         specs = self._strategy.sharding_specs if self._strategy else {}
         if name in specs:
             return P(*specs[name])
+        if name in self._replicated_feeds:
+            return P()  # unique-id-keyed prefetch rows, not batch rows
         if ndim >= 1 and self._batch_axis in self.mesh.axis_names:
             return P(self._batch_axis)  # shard batch dim, rest replicated
         return P()

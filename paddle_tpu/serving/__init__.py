@@ -56,6 +56,7 @@ from paddle_tpu.serving.errors import (
     ServingError,
     WireProtocolError,
 )
+from paddle_tpu.serving.embedding_cache import EmbeddingRowCache
 from paddle_tpu.serving.metrics import ServingMetrics
 from paddle_tpu.serving.server import InferenceServer
 
@@ -71,6 +72,7 @@ __all__ = [
     "PRIORITY_NORMAL",
     "PRIORITY_LOW",
     "ServingMetrics",
+    "EmbeddingRowCache",
     "ServingError",
     "ServerOverloaded",
     "DeadlineExceeded",

@@ -82,7 +82,8 @@ ADMIT_LIMIT = monitor.gauge(
 BROWNOUT_LEVEL = monitor.gauge(
     "serving_brownout_level",
     "degradation-ladder level under sustained saturation (0=normal, "
-    "1=no flight capture, 2=eager batching, 3=shed lowest priority)",
+    "1=no flight capture, 2=eager batching, 3=shed lowest priority, "
+    "4=cache-only embedding lookups on endpoints with a bound cache)",
     ("server",))
 ADMISSION_EXPIRED = monitor.counter(
     "admission_expired_total",
@@ -406,6 +407,16 @@ class BrownoutController:
       1  drop flight-recorder capture (tracing rent off the hot path)
       2  force the batch window to 0 (eager batching: ship what's here)
       3  shed the lowest priority class at admission
+      4  (embedding-cache endpoints only) serve lookups CACHE-ONLY —
+         misses get the fallback row instead of queuing on PS pulls
+
+    The rung count is the threshold tuple's length: the default ladder
+    stops at 3; an ``InferenceServer`` with a bound
+    ``EmbeddingRowCache`` passes a 4-threshold ladder so the cache-only
+    rung exists exactly where it has a cache to serve from.  The same
+    hold/4x-hysteresis machinery governs every rung, so the cache-only
+    mode enters late and exits slowly (no flapping between stale-tier
+    and PS-tier serving).
 
     Deterministic by construction: level changes are a pure function of
     the (ratio, clock) series — chaos tests drive it with an injected
@@ -414,12 +425,21 @@ class BrownoutController:
 
     #: pressure at or above which each level (1, 2, 3) wants to engage
     THRESHOLDS = (0.5, 0.75, 0.9)
+    #: the cache-only rung's threshold when a 4-rung ladder is built
+    CACHE_ONLY_THRESHOLD = 0.97
     MAX_LEVEL = 3
 
     def __init__(self, name: str = "server", hold_s: float = 0.25,
-                 clock=time.monotonic):
+                 clock=time.monotonic, thresholds=None):
         self.name = name
         self.hold_s = float(hold_s)
+        self.thresholds = (tuple(float(t) for t in thresholds)
+                           if thresholds is not None else self.THRESHOLDS)
+        if list(self.thresholds) != sorted(self.thresholds):
+            raise ValueError(
+                "brownout thresholds must ascend, got %r"
+                % (self.thresholds,))
+        self.max_level = len(self.thresholds)
         self._clock = clock
         self.level = 0
         self._pending: Optional[Tuple[int, float]] = None  # (direction, since)
@@ -433,7 +453,7 @@ class BrownoutController:
 
     def _target(self, ratio: float) -> int:
         lvl = 0
-        for i, thr in enumerate(self.THRESHOLDS):
+        for i, thr in enumerate(self.thresholds):
             if ratio >= thr:
                 lvl = i + 1
         return lvl

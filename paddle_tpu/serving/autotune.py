@@ -47,6 +47,8 @@ __all__ = [
     "propose_ladder",
     "propose_len_ladder",
     "plan_kv_ladder",
+    "propose_id_bucket_ladder",
+    "plan_id_ladder",
     "propose_timeout_ms",
     "plan",
 ]
@@ -190,6 +192,80 @@ def plan_kv_ladder(seq_len_histogram, max_seq_len: int,
         "waste_positions_saved": int(cur_w - new_w),
         "n_lengths_observed": len(
             _normalize_counts(seq_len_histogram, max_seq_len)),
+    }
+
+
+def propose_id_bucket_ladder(uniq_id_counts, max_unique: int,
+                             max_rungs: int = 8) -> Optional[List[int]]:
+    """The waste-minimal UNIQUE-ID bucket ladder for an observed
+    per-batch unique-id-count histogram (the executor's sparse
+    prefetch records ``len(unique(batch ids))`` per table per batch as
+    ``program._uniq_id_hist``), or None when the histogram is empty.
+
+    Same exact DP as :func:`propose_ladder`, with waste counted in
+    padded ID SLOTS: a batch with ``n`` unique ids bucketed to rung
+    ``r`` pulls (PS path) or gathers + pushes (mesh path) ``r - n``
+    padding rows per table per step.  The result replaces the
+    hardcoded power-of-two buckets via
+    ``bind_distributed_tables(..., id_bucket_ladder=...)`` /
+    ``program._sparse_id_ladder``.  Offline proposal only: each rung
+    is one compiled lookup/push shape, so changing a live ladder means
+    re-warming — a restart-time decision, exactly like the KV length
+    ladder."""
+    return propose_ladder(uniq_id_counts, max_unique, max_rungs=max_rungs)
+
+
+def _pow2_id_ladder(max_unique: int) -> List[int]:
+    """The executor's default unique-count buckets: 8, 16, ... up to
+    the next power of two covering ``max_unique`` (the bucket rounding
+    is the executor's own ``pow2_id_bucket`` — one definition, so this
+    comparison baseline can never drift from the runtime)."""
+    from paddle_tpu.executor import pow2_id_bucket
+
+    ladder, b = [], 8
+    top = pow2_id_bucket(int(max_unique))
+    while b < top:
+        ladder.append(b)
+        b *= 2
+    ladder.append(top)
+    return ladder
+
+
+def plan_id_ladder(uniq_id_histogram,
+                   max_unique: Optional[int] = None,
+                   current_ladder: Optional[Sequence[int]] = None,
+                   max_rungs: int = 8) -> Dict[str, object]:
+    """One id-ladder proposal document: the waste-minimal unique-id
+    bucket ladder for the observed histogram vs the current (default:
+    the executor's power-of-two buckets), with the expected padded-slot
+    waste both ways.  ``max_unique`` defaults to the largest observed
+    count (the histogram IS the traffic)."""
+    counts = {int(k): int(v) for k, v in dict(uniq_id_histogram or {}).items()
+              if int(v) > 0 and int(k) > 0}
+    if max_unique is None:
+        if not counts:
+            raise ValueError(
+                "empty unique-id histogram and no max_unique given — "
+                "nothing to plan from")
+        max_unique = max(counts)
+    current = sorted(int(b) for b in (
+        current_ladder if current_ladder is not None
+        else _pow2_id_ladder(int(max_unique))))
+    proposed = propose_id_bucket_ladder(counts, int(max_unique),
+                                        max_rungs=max_rungs)
+    if proposed is None:
+        proposed = list(current)
+    # compare over the shared coverage: the pow2 default always tops
+    # out at >= max_unique, so both ladders serve every observed size
+    cur_w, cur_p = expected_waste(counts, current, current[-1])
+    new_w, new_p = expected_waste(counts, proposed, current[-1])
+    return {
+        "id_ladder": proposed,
+        "changed": proposed != current,
+        "current_waste_ratio": round(cur_w / cur_p, 6) if cur_p else None,
+        "proposed_waste_ratio": round(new_w / new_p, 6) if new_p else None,
+        "waste_slots_saved": int(cur_w - new_w),
+        "n_counts_observed": len(counts),
     }
 
 

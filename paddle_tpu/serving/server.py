@@ -159,6 +159,7 @@ class InferenceServer:
         target_queue_wait_ms: float = 50.0,
         brownout_hold_s: float = 0.25,
         class_weights="default",
+        embedding_cache=None,
     ):
         self.name = name
         # circuit-breaker re-admission for failure-retired replicas: a
@@ -188,10 +189,26 @@ class InferenceServer:
         # through the server's accounting, not the batcher's defaults
         self._batcher.on_shed = self._on_queue_shed
         self._batcher.on_expired = self._on_expired
+        # hot-id embedding cache (serving/embedding_cache.py): bound to
+        # every replica's program so sparse lookups read through it, and
+        # to the brownout ladder — a 4th rung serves CACHE-ONLY under
+        # sustained saturation (misses get the fallback row instead of
+        # queuing on PS pulls), so Zipf-skewed traffic degrades
+        # gracefully through a PS outage
+        self._embedding_cache = embedding_cache
+        if embedding_cache is not None:
+            for p in predictors:
+                embedding_cache.bind(p)
         # deterministic degradation ladder, driven by queue pressure
         # from the dispatcher loop (L1 drops flight capture, L2 forces
-        # eager batching, L3 sheds the lowest priority class)
-        self._brownout = BrownoutController(name, hold_s=brownout_hold_s)
+        # eager batching, L3 sheds the lowest priority class, and — on
+        # embedding-cache endpoints — L4 serves lookups cache-only)
+        thresholds = (
+            BrownoutController.THRESHOLDS
+            + (BrownoutController.CACHE_ONLY_THRESHOLD,)
+            if embedding_cache is not None else None)
+        self._brownout = BrownoutController(
+            name, hold_s=brownout_hold_s, thresholds=thresholds)
         self._admission_expired = ADMISSION_EXPIRED.labels(server=name)
         self._specs = (
             dict(input_specs) if input_specs else predictors[0].input_specs())
@@ -272,6 +289,8 @@ class InferenceServer:
         snap["precision_dtypes"] = list(self._precision_dtypes)
         snap["warmed_up"] = self._warmed
         snap["replicas"] = self.replica_stats()
+        if self._embedding_cache is not None:
+            snap["embedding_cache"] = self._embedding_cache.stats()
         return snap
 
     def load(self) -> Dict[str, object]:
@@ -586,7 +605,8 @@ class InferenceServer:
         # before anything enqueues, so low-priority-only traffic would
         # otherwise never wake the parked dispatcher and the level
         # could latch at 3 on an idle server forever
-        self._brownout.update(self._batcher.depth_ratio())
+        self._apply_brownout(
+            self._brownout.update(self._batcher.depth_ratio()))
         if (self._brownout.level >= 3
                 and int(priority) >= PRIORITY_LOW):
             # brownout L3: the lowest priority class sheds at the door
@@ -663,6 +683,15 @@ class InferenceServer:
         return out, n_rows
 
     # ------------------------------------------------------------------
+    def _apply_brownout(self, level: int) -> None:
+        """Side effects of a (possibly new) brownout level that live
+        outside the controller: the embedding cache's cache-only rung
+        engages at the ladder's 4th threshold and releases — with the
+        controller's 4x-slower descent hysteresis — when the ladder
+        steps back down."""
+        if self._embedding_cache is not None:
+            self._embedding_cache.set_cache_only(level >= 4)
+
     def _fail_stragglers(self) -> None:
         """Fail every request still queued once no worker will ever
         serve it — stuck requests must surface as typed errors, never
@@ -705,6 +734,7 @@ class InferenceServer:
                 # has instead of waiting for more
                 level = self._brownout.update(self._batcher.depth_ratio())
                 self._batcher.eager = level >= 2
+                self._apply_brownout(level)
                 batch = self._batcher.next_batch(
                     self._stop, self._on_expired, block=True)
                 if batch is None:

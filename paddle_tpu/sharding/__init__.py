@@ -16,6 +16,11 @@ mesh instead of replicating every parameter per chip:
   accumulator's spec from its param's matched rule, so params, grads,
   and optimizer state all live sharded (FSDP/tp training with zero new
   concepts),
+* :mod:`paddle_tpu.sharding.sparse` — mesh-RESIDENT sparse tables:
+  a distributed lookup table living row-sharded on the mesh, with
+  device-side gather lookups (shard-routed psum) and shard-wise grad
+  pushes replacing the host PS round-trip
+  (:func:`bind_mesh_tables` on a ``CompiledProgram``),
 * :mod:`paddle_tpu.sharding.metrics` — placement observability
   (imported lazily by the placement path; import it explicitly for the
   registry series).
@@ -41,6 +46,10 @@ from paddle_tpu.sharding.rules import (
     PartitionRules,
     ShardingRuleError,
 )
+from paddle_tpu.sharding.sparse import (
+    MeshTableRuntime,
+    bind_mesh_tables,
+)
 from paddle_tpu.sharding.train import (
     TrainPartitionRules,
     sharded_train_program,
@@ -62,4 +71,6 @@ __all__ = [
     "AXIS_FSDP",
     "MODES",
     "FAMILIES",
+    "MeshTableRuntime",
+    "bind_mesh_tables",
 ]

@@ -16,7 +16,8 @@ from __future__ import annotations
 
 from paddle_tpu.monitor import registry as _registry
 
-__all__ = ["PARAMS_SHARDED", "GROUP_HBM_BYTES", "TRAIN_STATE_BYTES"]
+__all__ = ["PARAMS_SHARDED", "GROUP_HBM_BYTES", "TRAIN_STATE_BYTES",
+           "SPARSE_TABLE_BYTES", "SPARSE_LOOKUPS"]
 
 PARAMS_SHARDED = _registry.REGISTRY.counter(
     "sharding_params_sharded_total",
@@ -37,3 +38,12 @@ TRAIN_STATE_BYTES = _registry.REGISTRY.gauge(
     "out sharding.  Scope: ONE sharded-training layout per process — "
     "publish is last-writer-wins and retire is global (kind is the "
     "only label; a training process hosts one trainer)", ("kind",))
+SPARSE_TABLE_BYTES = _registry.REGISTRY.gauge(
+    "sharding_sparse_table_bytes",
+    "per-device bytes of one mesh-resident row-sharded lookup table "
+    "(the addressable shard — ~1/n_shards of the replicated table); "
+    "set at bind, retired by MeshTableRuntime.close()", ("table",))
+SPARSE_LOOKUPS = _registry.REGISTRY.counter(
+    "sharding_sparse_lookups_total",
+    "device-side gathers served by mesh-resident tables (each one a "
+    "host PS round-trip the mesh path did NOT pay)")

@@ -1,0 +1,231 @@
+"""Chaos drills for the sparse scale-out path (ISSUE 14): ``ps.pull``
+faults against the overlapped sparse prefetch (transient flaps heal
+under the retry budget; persistent non-retryable outages surface typed
+at the join), and the hot-id cache tier through a PS outage (hits keep
+serving, misses fail typed, and the brownout cache-only rung holds the
+endpoint available — typed and counted — until the PS heals).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import faults, framework, monitor
+from paddle_tpu.distributed.ps import ParameterServer, PSClient
+from paddle_tpu.serving.embedding_cache import EmbeddingRowCache
+from paddle_tpu.serving.errors import BackendUnavailable
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    yield
+    faults.disarm()
+
+
+def _emb_model(V=50, D=4, seed=23):
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = seed
+    with framework.program_guard(prog, startup):
+        ids = fluid.layers.data("ids", [1], dtype="int64")
+        y = fluid.layers.data("y", [1])
+        emb = fluid.layers.embedding(
+            ids, [V, D], is_sparse=True, is_distributed=True,
+            param_attr=fluid.ParamAttr(name="chaos_tbl"))
+        pred = fluid.layers.fc(emb, 1, name="head")
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    return prog, startup, loss
+
+
+def _feeds(V, B, n, seed=3):
+    rng = np.random.RandomState(seed)
+    return [
+        {"ids": rng.randint(0, V, (B, 1)).astype("int64"),
+         "y": rng.randn(B, 1).astype("float32")}
+        for _ in range(n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Overlapped sparse prefetch under ps.pull faults
+# ---------------------------------------------------------------------------
+def test_overlapped_sparse_prefetch_rides_out_pull_flap():
+    """A transient connection-class flap on the background prefetch
+    thread retries under the RetryPolicy budget (close + redial) and
+    the epoch completes — no lost batches, no dangling thread."""
+    V, B = 50, 8
+    server = ParameterServer().start()
+    try:
+        prog, startup, loss = _emb_model(V=V)
+        fluid.distributed.bind_distributed_tables(
+            prog, [server.endpoint], optimizer="sgd", lr=0.1,
+            initializer="zeros", async_mode=True)
+        feeds = _feeds(V, B, 10)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            with faults.armed(
+                    "ps.pull=error:ConnectionError,after=3,times=2"):
+                out = exe.train_from_dataset(
+                    program=prog, dataset=feeds, scope=scope,
+                    fetch_list=[loss])
+                assert faults.active.triggers().get("ps.pull", 0) >= 1
+        assert len(out) == 10
+        assert all(np.isfinite(float(np.asarray(o[0]))) for o in out)
+        assert monitor.counter_value("retry_attempts_total") > 0
+        ctx = prog.__dict__.get("_sparse_overlap_ctx", {})
+        assert "pending" not in ctx and ctx.get("clients", []) == []
+        prog._ps_communicator.stop()
+    finally:
+        server.stop()
+
+
+def test_overlapped_sparse_prefetch_persistent_outage_fails_typed():
+    """A persistent NON-retryable ps.pull failure surfaces typed from
+    train_from_dataset at the join — never a hang, never an untyped
+    thread death — and the epoch still cleans up its clients."""
+    V, B = 50, 8
+    server = ParameterServer().start()
+    try:
+        prog, startup, loss = _emb_model(V=V, seed=31)
+        fluid.distributed.bind_distributed_tables(
+            prog, [server.endpoint], optimizer="sgd", lr=0.1,
+            initializer="zeros", async_mode=True)
+        feeds = _feeds(V, B, 8)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            with faults.armed("ps.pull=error:BackendUnavailable,after=2"):
+                with pytest.raises(BackendUnavailable,
+                                   match="injected fault"):
+                    exe.train_from_dataset(
+                        program=prog, dataset=feeds, scope=scope,
+                        fetch_list=[loss])
+            ctx = prog.__dict__.get("_sparse_overlap_ctx", {})
+            assert "pending" not in ctx and ctx.get("clients", []) == []
+            # healed: the same program trains end to end
+            out = exe.train_from_dataset(
+                program=prog, dataset=feeds, scope=scope,
+                fetch_list=[loss])
+        assert len(out) == 8
+        prog._ps_communicator.stop()
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# The cache tier through a PS outage
+# ---------------------------------------------------------------------------
+def test_cache_serves_hits_through_ps_outage_misses_fail_typed():
+    """With the PS down (persistent ps.pull fault), a lookup fully
+    covered by cached rows succeeds — the cache IS the availability
+    floor — while a lookup needing any uncached row fails with the
+    typed outage error (normal mode never serves a fabricated row)."""
+    server = ParameterServer().start()
+    client = PSClient([server.endpoint])
+    client.create_table("hot", 4, initializer="uniform", seed=7)
+    try:
+        cache = EmbeddingRowCache(capacity_rows=32, name="outage")
+        hot = np.arange(8, dtype=np.int64)
+        truth = cache.lookup_through(client, "hot", hot).copy()
+        with faults.armed("ps.pull=error:BackendUnavailable"):
+            rows = cache.lookup_through(client, "hot", hot)
+            np.testing.assert_array_equal(rows, truth)  # pure hits: OK
+            with pytest.raises(BackendUnavailable, match="injected fault"):
+                cache.lookup_through(
+                    client, "hot", np.array([100, 101], np.int64))
+        s = cache.stats()
+        assert s["hits"] >= 8 and s["fallback_rows"] == 0
+        cache.close()
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_cache_only_rung_holds_serving_available_through_outage():
+    """The brownout cache-only rung through an injected ps.pull outage:
+    every lookup COMPLETES — hits exact, misses served from the
+    fallback row and counted
+    (serving_embedding_cache_fallback_rows_total) — and after the PS
+    heals and the rung releases, misses read through again."""
+    server = ParameterServer().start()
+    client = PSClient([server.endpoint])
+    client.create_table("zipf", 4, initializer="uniform", seed=11)
+    try:
+        cache = EmbeddingRowCache(capacity_rows=64, name="rung")
+        hot = np.arange(16, dtype=np.int64)
+        truth = cache.lookup_through(client, "zipf", hot).copy()
+        fb0 = monitor.counter_value(
+            "serving_embedding_cache_fallback_rows_total")
+        cache.set_cache_only(True)  # the L4 rung engaged
+        with faults.armed("ps.pull=error:BackendUnavailable"):
+            # a Zipf-shaped mix: mostly hot ids, a cold tail
+            mixed = np.concatenate([hot[:12],
+                                    np.array([900, 901], np.int64)])
+            rows = cache.lookup_through(client, "zipf", mixed)
+            np.testing.assert_array_equal(rows[:12], truth[:12])
+            mean = truth.mean(axis=0)
+            np.testing.assert_allclose(rows[12], mean, rtol=1e-5)
+            np.testing.assert_allclose(rows[13], mean, rtol=1e-5)
+        # the degradation is typed AND counted, never silent
+        assert (monitor.counter_value(
+                    "serving_embedding_cache_fallback_rows_total")
+                == fb0 + 2)
+        # heal: rung releases, the cold ids read through for real
+        cache.set_cache_only(False)
+        real = cache.lookup_through(
+            client, "zipf", np.array([900, 901], np.int64))
+        assert not np.allclose(real[0], mean)
+        cache.close()
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_inline_concurrent_pulls_propagate_worker_fault_typed():
+    """A ps.pull fault on a WORKER table's dedicated client (the
+    concurrent multi-table path) propagates typed out of run() after
+    all joins — and the worker's client is dropped from the pool so
+    the next step redials clean."""
+    V, B = 40, 8
+    server = ParameterServer().start()
+    try:
+        prog, startup = framework.Program(), framework.Program()
+        prog.random_seed = startup.random_seed = 41
+        with framework.program_guard(prog, startup):
+            ids = fluid.layers.data("ids", [1], dtype="int64")
+            y = fluid.layers.data("y", [1])
+            e1 = fluid.layers.embedding(
+                ids, [V, 4], is_sparse=True, is_distributed=True,
+                param_attr=fluid.ParamAttr(name="w1"))
+            e2 = fluid.layers.embedding(
+                ids, [V, 4], is_sparse=True, is_distributed=True,
+                param_attr=fluid.ParamAttr(name="w2"))
+            pred = fluid.layers.fc(
+                fluid.layers.concat([e1, e2], axis=1), 1, name="head")
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+        fluid.distributed.bind_distributed_tables(
+            prog, [server.endpoint], initializer="zeros")
+        exe = fluid.Executor(fluid.CPUPlace())
+        feeds = _feeds(V, B, 4, seed=9)
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            (l,) = exe.run(prog, feed=dict(feeds[0]), fetch_list=[loss])
+            np.asarray(l)
+            pool_before = list(prog.__dict__.get("_sparse_pull_pool", []))
+            assert len(pool_before) == 1
+            # every pull faults: both the caller-thread table and the
+            # worker table — the error must surface typed either way
+            with faults.armed("ps.pull=error:BackendUnavailable"):
+                with pytest.raises(BackendUnavailable,
+                                   match="injected fault"):
+                    exe.run(prog, feed=dict(feeds[1]), fetch_list=[loss])
+            # healed: the pool redials (the faulted worker client was
+            # dropped) and training continues
+            (l,) = exe.run(prog, feed=dict(feeds[2]), fetch_list=[loss])
+            assert np.isfinite(float(np.asarray(l)))
+    finally:
+        server.stop()

@@ -1,0 +1,345 @@
+"""Hot-id embedding cache semantics (ISSUE 14): bounded capacity +
+LRU eviction, read-through accounting and the hit-ratio gauge math,
+invalidation on push and on checkpoint restore (stale-row regression
+pinned), the cache-only fallback tier, the brownout cache-only rung's
+enter/exit hysteresis, and the Zipf(1.0) absorption acceptance (hit
+ratio >= 0.8 with a cache sized at 5% of the table).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import framework, monitor
+from paddle_tpu.distributed.ps import ParameterServer, PSClient
+from paddle_tpu.serving.embedding_cache import EmbeddingRowCache
+
+
+def _ps_with_table(name="tbl", dim=4, n_rows=0, seed=0):
+    server = ParameterServer().start()
+    client = PSClient([server.endpoint])
+    client.create_table(name, dim, initializer="uniform", seed=seed)
+    if n_rows:
+        client.pull_sparse(name, np.arange(n_rows, dtype=np.int64))
+    return server, client
+
+
+# ---------------------------------------------------------------------------
+# Capacity, eviction, accounting
+# ---------------------------------------------------------------------------
+def test_bounded_capacity_and_lru_eviction():
+    server, client = _ps_with_table(dim=3)
+    try:
+        cache = EmbeddingRowCache(capacity_rows=4, name="cap")
+        cache.lookup_through(client, "tbl", np.arange(4, dtype=np.int64))
+        assert len(cache) == 4
+        # touch id 0 (MRU), then insert two more: 1 and 2 evict
+        cache.lookup_through(client, "tbl", np.array([0], np.int64))
+        cache.lookup_through(client, "tbl", np.array([10, 11], np.int64))
+        assert len(cache) == 4
+        assert cache.get("tbl", 0) is not None     # recently used: kept
+        assert cache.get("tbl", 1) is None         # LRU: evicted
+        assert cache.get("tbl", 2) is None
+        cache.close()
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_read_through_values_and_hit_ratio_gauge_math():
+    server, client = _ps_with_table(dim=4, seed=3)
+    try:
+        cache = EmbeddingRowCache(capacity_rows=64, name="gauge")
+        ids = np.array([5, 9, 5, 13], np.int64)
+        uniq, counts = np.unique(ids, return_counts=True)
+        truth = client.pull_sparse("tbl", uniq)
+        rows = cache.lookup_through(client, "tbl", uniq, counts=counts)
+        np.testing.assert_array_equal(rows, truth)
+        # all cold: occurrence-weighted misses = 4 (id 5 counts twice)
+        s = cache.stats()
+        assert (s["hits"], s["misses"]) == (0, 4)
+        rows2 = cache.lookup_through(client, "tbl", uniq, counts=counts)
+        np.testing.assert_array_equal(rows2, truth)
+        s = cache.stats()
+        assert (s["hits"], s["misses"]) == (4, 4)
+        assert s["hit_ratio"] == pytest.approx(0.5)
+        # the gauge carries hits / (hits + misses) exactly
+        snap = monitor.REGISTRY.snapshot()[
+            "serving_embedding_cache_hit_ratio"]
+        series = {tuple(x["labels"].items()): x["value"]
+                  for x in snap["series"]}
+        assert series[(("cache", "gauge"),)] == pytest.approx(0.5)
+        # padding entries (n_valid) never count
+        padded = np.concatenate([uniq, np.full(5, uniq[0], np.int64)])
+        rows3 = cache.lookup_through(client, "tbl", padded, n_valid=3)
+        np.testing.assert_array_equal(rows3[:3], truth)
+        np.testing.assert_array_equal(rows3[3:],
+                                      np.broadcast_to(truth[0], (5, 4)))
+        s = cache.stats()
+        assert (s["hits"], s["misses"]) == (7, 4)  # +3 unweighted hits
+        cache.close()
+    finally:
+        client.close()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Invalidation: push + checkpoint restore (stale-row regressions)
+# ---------------------------------------------------------------------------
+def _train_model(V=30, D=4, table="inv_table", seed=17):
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = seed
+    with framework.program_guard(prog, startup):
+        ids = fluid.layers.data("ids", [1], dtype="int64")
+        y = fluid.layers.data("y", [1])
+        emb = fluid.layers.embedding(
+            ids, [V, D], is_sparse=True, is_distributed=True,
+            param_attr=fluid.ParamAttr(name=table))
+        pred = fluid.layers.fc(emb, 1, name="head")
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    return prog, startup, loss
+
+
+def test_push_invalidates_cached_rows_no_stale_training():
+    """Training THROUGH the cache matches training without it exactly:
+    every step's push invalidates the pushed rows, so step N+1's
+    prefetch re-pulls the post-optimizer values.  (Without the
+    invalidation hook the second step would train on stale rows and
+    the loss trajectories diverge — the pinned regression.)"""
+    V, B = 30, 12
+    rng = np.random.RandomState(1)
+    feeds = [
+        {"ids": rng.randint(0, V, (B, 1)).astype("int64"),
+         "y": rng.randn(B, 1).astype("float32")}
+        for _ in range(8)
+    ]
+
+    def train(with_cache):
+        server = ParameterServer().start()
+        try:
+            prog, startup, loss = _train_model(V=V)
+            fluid.distributed.bind_distributed_tables(
+                prog, [server.endpoint], optimizer="sgd", lr=0.1,
+                initializer="zeros")
+            cache = None
+            if with_cache:
+                cache = EmbeddingRowCache(capacity_rows=V, name="inv")
+                cache.bind(prog)
+            exe = fluid.Executor(fluid.CPUPlace())
+            out = []
+            with fluid.scope_guard(fluid.Scope()):
+                exe.run(startup)
+                for f in feeds:
+                    (l,) = exe.run(prog, feed=dict(f), fetch_list=[loss])
+                    out.append(float(np.asarray(l)))
+            if cache is not None:
+                assert cache.stats()["misses"] > 0  # it WAS in the loop
+                cache.close()
+            return out
+        finally:
+            server.stop()
+
+    np.testing.assert_allclose(train(True), train(False),
+                               rtol=1e-6, atol=1e-8)
+
+
+def test_async_push_invalidates_after_server_apply():
+    """The async (Communicator) path invalidates via ``on_pushed`` —
+    AFTER the merged push lands server-side, never at enqueue time (an
+    enqueue-time invalidation lets a concurrent read-through re-cache
+    the pre-update row permanently)."""
+    from paddle_tpu.distributed.communicator import Communicator
+
+    server, client = _ps_with_table(name="async_tbl", dim=3, seed=1)
+    try:
+        cache = EmbeddingRowCache(capacity_rows=16, name="async")
+        ids = np.arange(4, dtype=np.int64)
+        stale = cache.lookup_through(client, "async_tbl", ids).copy()
+        comm = Communicator(client).start()
+        comm.on_pushed = cache.invalidate_ids
+        comm.push("async_tbl", ids, np.ones((4, 3), np.float32))
+        comm.flush()  # barrier: the merged push has applied
+        # the pushed ids are gone from the cache, so the next
+        # read-through serves the post-optimizer rows
+        assert all(cache.get("async_tbl", int(i)) is None for i in ids)
+        fresh = cache.lookup_through(client, "async_tbl", ids)
+        assert not np.allclose(fresh, stale)
+        comm.stop()
+        cache.close()
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_checkpoint_restore_invalidates_cache(tmp_path):
+    """A checkpoint restore rewrites rows server-side by value; a cache
+    warmed on the PRE-restore rows must not serve them afterwards."""
+    from paddle_tpu.faults.checkpoint import TrainCheckpoint
+
+    server, client = _ps_with_table(name="ckpt_tbl", dim=3, seed=5)
+    try:
+        prog = framework.Program()  # carrier for the cache binding
+        prog._ps_client = client
+        cache = EmbeddingRowCache(capacity_rows=32, name="ckpt")
+        cache.bind(prog)
+        ids = np.arange(6, dtype=np.int64)
+        rows_a = client.pull_sparse("ckpt_tbl", ids).copy()
+
+        ckpt = TrainCheckpoint(str(tmp_path), every_n_steps=1)
+        scope = fluid.Scope()
+        ckpt.save(prog, scope, step=1, epoch=0, ps_client=client)
+
+        # mutate the rows after the save (training moved on)...
+        client.push_sparse("ckpt_tbl", ids,
+                           np.ones((len(ids), 3), np.float32))
+        rows_b = client.pull_sparse("ckpt_tbl", ids).copy()
+        assert not np.allclose(rows_a, rows_b)
+        # ...warm the cache on the post-save rows...
+        cache.lookup_through(client, "ckpt_tbl", ids)
+        # ...then restore: the cache must be invalidated, so the next
+        # read-through serves the RESTORED rows, not the cached copy
+        ckpt.restore(prog, scope, ps_client=client)
+        assert len(cache) == 0
+        got = cache.lookup_through(client, "ckpt_tbl", ids)
+        np.testing.assert_allclose(got, rows_a, rtol=1e-6)
+        cache.close()
+    finally:
+        client.close()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Cache-only tier + the brownout rung
+# ---------------------------------------------------------------------------
+def test_cache_only_mode_serves_fallback_rows_counted():
+    server, client = _ps_with_table(dim=4, seed=9)
+    try:
+        cache = EmbeddingRowCache(capacity_rows=16, name="fb")
+        warm = np.arange(4, dtype=np.int64)
+        truth = cache.lookup_through(client, "tbl", warm).copy()
+        fb0 = monitor.counter_value(
+            "serving_embedding_cache_fallback_rows_total")
+        cache.set_cache_only(True)
+        mixed = np.array([0, 1, 100, 101], np.int64)
+        rows = cache.lookup_through(client, "tbl", mixed)
+        np.testing.assert_array_equal(rows[:2], truth[:2])  # hits exact
+        mean = truth.mean(axis=0)
+        np.testing.assert_allclose(rows[2], mean, rtol=1e-5)  # mean row
+        np.testing.assert_allclose(rows[3], mean, rtol=1e-5)
+        s = cache.stats()
+        assert s["fallback_rows"] == 2
+        assert monitor.counter_value(
+            "serving_embedding_cache_fallback_rows_total") == fb0 + 2
+        # zero-fallback variant
+        zc = EmbeddingRowCache(capacity_rows=8, name="fbz",
+                               fallback="zero")
+        zc.lookup_through(client, "tbl", warm)
+        zc.set_cache_only(True)
+        rows = zc.lookup_through(client, "tbl", np.array([500], np.int64))
+        np.testing.assert_array_equal(rows, np.zeros((1, 4), np.float32))
+        zc.close()
+        cache.close()
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_brownout_cache_only_rung_enters_and_exits_with_hysteresis():
+    """The 4-threshold ladder an embedding-cache endpoint builds: the
+    cache-only rung engages one hold above L3 and releases 4x slower
+    (same hysteresis machinery as every other rung), and the server's
+    _apply_brownout mirrors the level into the cache mode."""
+    from paddle_tpu.serving.admission import BrownoutController
+
+    clk = [0.0]
+    b = BrownoutController(
+        "l4", hold_s=1.0, clock=lambda: clk[0],
+        thresholds=BrownoutController.THRESHOLDS
+        + (BrownoutController.CACHE_ONLY_THRESHOLD,))
+    assert b.max_level == 4
+    for expect in (1, 2, 3, 4):
+        b.update(0.98)
+        clk[0] += 1.1
+        assert b.update(0.98) == expect
+    clk[0] += 5.0
+    assert b.update(0.98) == 4  # capped
+    # descent: one rung per 4*hold
+    assert b.update(0.0) == 4
+    clk[0] += 2.0
+    assert b.update(0.0) == 4   # inside the slow hold
+    clk[0] += 2.5
+    assert b.update(0.0) == 3   # released: back below the L4 rung
+    b.close()
+
+    # the server-side mirror: level >= 4 flips the cache mode on; a
+    # lower level flips it back off
+    class _Srv:
+        from paddle_tpu.serving.server import InferenceServer as _IS
+        _apply_brownout = _IS._apply_brownout
+
+    srv = _Srv()
+    srv._embedding_cache = EmbeddingRowCache(capacity_rows=4, name="mir")
+    srv._apply_brownout(4)
+    assert srv._embedding_cache.cache_only
+    srv._apply_brownout(3)
+    assert not srv._embedding_cache.cache_only
+    srv._embedding_cache.close()
+
+    # the default ladder (no cache) still stops at 3
+    b3 = BrownoutController("l3", hold_s=1.0, clock=lambda: clk[0])
+    assert b3.max_level == 3
+    b3.close()
+
+
+def test_brownout_thresholds_must_ascend():
+    from paddle_tpu.serving.admission import BrownoutController
+
+    with pytest.raises(ValueError, match="ascend"):
+        BrownoutController("bad", thresholds=(0.9, 0.5))
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: Zipf(1.0) absorption
+# ---------------------------------------------------------------------------
+def test_zipf_stream_hit_ratio_above_080_at_5pct_capacity():
+    """Under a Zipf(1.0) id stream over the table's active id range, a
+    cache sized at 5% of the table absorbs >= 0.8 of served rows after
+    warm (occurrence-weighted, the cache's own accounting).  The table
+    is provisioned for the full hash space (the CTR sizing reality);
+    traffic follows Zipf over the live ids."""
+    TABLE_ROWS = 100_000
+    ACTIVE = 10_000
+    CAPACITY = 5_000  # 5% of the table
+    B, WARM, MEAS = 1024, 25, 25
+
+    server, client = _ps_with_table(name="zipf", dim=4, seed=2)
+    try:
+        cache = EmbeddingRowCache(capacity_rows=CAPACITY, name="zipf")
+        assert CAPACITY <= 0.05 * TABLE_ROWS
+        rng = np.random.RandomState(0)
+        p = 1.0 / np.arange(1, ACTIVE + 1)
+        p /= p.sum()
+        cdf = np.cumsum(p)
+
+        def batch():
+            ids = np.searchsorted(cdf, rng.rand(B)).astype(np.int64)
+            uniq, counts = np.unique(ids, return_counts=True)
+            return uniq, counts
+
+        for _ in range(WARM):
+            uniq, counts = batch()
+            cache.lookup_through(client, "zipf", uniq, counts=counts)
+        s0 = cache.stats()
+        for _ in range(MEAS):
+            uniq, counts = batch()
+            cache.lookup_through(client, "zipf", uniq, counts=counts)
+        s1 = cache.stats()
+        d_hits = s1["hits"] - s0["hits"]
+        d_miss = s1["misses"] - s0["misses"]
+        ratio = d_hits / (d_hits + d_miss)
+        assert ratio >= 0.8, (ratio, s1)
+        assert s1["size_rows"] <= CAPACITY
+        cache.close()
+    finally:
+        client.close()
+        server.stop()

