@@ -35,10 +35,18 @@ measured window.  On a host-SIMULATED mesh the examples/s ratio
 reflects the XLA:CPU collective emulation tax, not the TPU number —
 the bytes ratio is the portable claim.
 
+``--checkpoint`` (or ``run_checkpoint()``): the CHECKPOINT stage — the
+same Adam block sharded fsdp-2, measuring ``TrainCheckpoint`` sync
+shard-wise save time (+ bytes/s), SAME-mesh restore (direct per-shard
+re-place) and CROSS-mesh restore onto fsdp-4 (the topology-elastic
+shard-exchange assembly), with the exchange host-buffer high-water
+reported alongside so the never-a-full-tensor claim has a number.
+
 Env knobs: BENCH_DISPATCH_LAYERS (default 20 -> ~190 ops with backward
 + sgd), BENCH_DISPATCH_DIM (default 32), BENCH_DISPATCH_ITERS (default
 200), BENCH_DISPATCH_BATCH (default 8; the sharded mode rounds it up to
-a multiple of the mesh size).
+a multiple of the mesh size), BENCH_CKPT_LAYERS/BENCH_CKPT_DIM (default
+4/512 — sized so the checkpoint is ~10 MB of real shard files).
 """
 import os
 import time
@@ -383,21 +391,124 @@ def run_sharded_train(layers=LAYERS, dim=DIM, iters=ITERS, batch=BATCH):
     }
 
 
+def run_checkpoint(layers=None, dim=None, batch=BATCH):
+    """TrainCheckpoint throughput on an fsdp-2-sharded Adam block:
+    save_s + bytes/s, then same-mesh vs cross-mesh (fsdp-4) restore —
+    the cross-mesh leg IS the shard-exchange path (exchanged > 0 and a
+    bounded host buffer are asserted, same contract as the tests)."""
+    import shutil
+    import tempfile
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    import paddle_tpu as fluid
+    from paddle_tpu.faults.checkpoint import TrainCheckpoint
+    from paddle_tpu.sharding import sharded_train_program
+    from paddle_tpu.sharding.rules import PartitionRules
+    from paddle_tpu.sharding.train import retire_state_bytes
+
+    layers = layers or int(os.environ.get("BENCH_CKPT_LAYERS", "4"))
+    dim = dim or int(os.environ.get("BENCH_CKPT_DIM", "512"))
+    platform = jax.devices()[0].platform
+    place = fluid.TPUPlace(0) if platform == "tpu" else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    prog, startup, loss, opt = build_train_program(layers, dim, seed=11)
+
+    def compiled_for(n):
+        return sharded_train_program(
+            prog, PartitionRules([(r".", P("fsdp"))],
+                                 name="ckptbench/fsdp"),
+            optimizer=opt, mesh_axes={"fsdp": n})
+
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(batch, dim).astype(np.float32)}
+    c2 = compiled_for(2)
+    run_dir = tempfile.mkdtemp(prefix="ptpu_ckpt_bench_")
+    try:
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(3):  # compile + settle the state avals
+                (out,) = exe.run(c2, feed=feed, fetch_list=[loss],
+                                 return_numpy=False)
+                out.block_until_ready()
+            ck = TrainCheckpoint(run_dir, keep=2)
+            ck.save(prog, scope, step=1, compiled=c2)  # warm the fs path
+            t0 = time.perf_counter()
+            path = ck.save(prog, scope, step=2, compiled=c2)
+            save_s = time.perf_counter() - t0
+        ckpt_bytes = sum(
+            os.path.getsize(os.path.join(dp, f))
+            for dp, _, fs in os.walk(path) for f in fs)
+
+        # same-mesh restore: direct per-shard re-place
+        s_same = fluid.Scope()
+        with fluid.scope_guard(s_same):
+            exe.run(startup)
+            t0 = time.perf_counter()
+            ck.restore(prog, s_same, compiled=c2)
+            restore_same_s = time.perf_counter() - t0
+        same_stats = dict(ck.last_restore_stats or {})
+        assert same_stats.get("exchanged", 0) == 0  # direct fast path
+
+        # cross-mesh restore: fsdp-2 shards re-sliced onto fsdp-4
+        c4 = compiled_for(4)
+        s_cross = fluid.Scope()
+        with fluid.scope_guard(s_cross):
+            exe.run(startup)
+            t0 = time.perf_counter()
+            ck.restore(prog, s_cross, compiled=c4)
+            restore_cross_s = time.perf_counter() - t0
+        cross_stats = dict(ck.last_restore_stats or {})
+        assert cross_stats.get("exchanged", 0) > 0  # real exchange
+        full_var_bytes = dim * dim * 4
+        assert 0 < cross_stats["max_region_bytes"] < full_var_bytes
+    finally:
+        retire_state_bytes()
+        shutil.rmtree(run_dir, ignore_errors=True)
+
+    return {
+        "metric": "checkpoint_save_mbytes_per_sec",
+        "value": round(ckpt_bytes / save_s / 1e6, 2),
+        "unit": "MB/sec",
+        "save_s": round(save_s, 4),
+        "restore_same_mesh_s": round(restore_same_s, 4),
+        "restore_cross_mesh_s": round(restore_cross_s, 4),
+        "restore_same_mbytes_per_sec": round(
+            ckpt_bytes / restore_same_s / 1e6, 2),
+        "restore_cross_mbytes_per_sec": round(
+            ckpt_bytes / restore_cross_s / 1e6, 2),
+        "checkpoint_bytes": int(ckpt_bytes),
+        "cross_mesh_exchanged_regions": int(cross_stats["exchanged"]),
+        "cross_mesh_max_region_bytes": int(
+            cross_stats["max_region_bytes"]),
+        "full_var_bytes": int(full_var_bytes),
+        "shard_files_read_cross": int(cross_stats["shard_files_read"]),
+        "layers": layers,
+        "dim": dim,
+        "platform": platform,
+    }
+
+
 def main():
     import sys
 
     sharded = "--sharded" in sys.argv[1:]
     sharded_train = "--sharded-train" in sys.argv[1:]
+    checkpoint = "--checkpoint" in sys.argv[1:]
     import bench_common
 
-    if sharded or sharded_train:
+    if sharded or sharded_train or checkpoint:
         # a CPU host needs the virtual multi-device platform; only
         # effective when jax has not been imported yet (bench.py's
         # orchestrator sets it in the subprocess env instead)
         os.environ["XLA_FLAGS"] = bench_common.virtual_mesh_env()["XLA_FLAGS"]
 
     bench_common.configure_compile_cache(bench_common.HOME_CACHE_DIR)
-    if sharded_train:
+    if checkpoint:
+        bench_common.emit_result(run_checkpoint())
+    elif sharded_train:
         bench_common.emit_result(run_sharded_train())
     else:
         bench_common.emit_result(run_sharded() if sharded else run())

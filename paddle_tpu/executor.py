@@ -254,6 +254,14 @@ _JIT_CACHE_CAPACITY = int(os.environ.get(
 
 
 class Executor:
+    # train_from_dataset resume bookkeeping — class-level defaults so a
+    # fresh executor answers reads before any epoch ran (each call
+    # resets them as instance attributes)
+    last_resume_step = None
+    last_restore_path = None
+    last_restore_fallbacks = 0
+    last_restore_stats = None
+
     def __init__(self, place=None, plan_cache_capacity: Optional[int] = None,
                  jit_cache_capacity: Optional[int] = None,
                  reshard_on_gather: Optional[bool] = None):
@@ -1532,6 +1540,11 @@ class Executor:
         ckpt = None
         start_step = 0
         self.last_resume_step = None
+        # reset the restore bookkeeping every call — a plain run after a
+        # resumed one must not keep reporting the old run's restore
+        self.last_restore_path = None
+        self.last_restore_fallbacks = 0
+        self.last_restore_stats = None
         if checkpoint_dir is not None or resume_from is not None:
             from paddle_tpu.faults.checkpoint import TrainCheckpoint
 
@@ -1546,6 +1559,12 @@ class Executor:
                     prog_obj, scope or global_scope(),
                     ps_client=getattr(prog_obj, "_ps_client", None),
                     compiled=compiled)
+                # which checkpoint actually served (integrity fallback
+                # may have skipped corrupt/pruned ones — the drills and
+                # operators read these alongside last_resume_step)
+                self.last_restore_path = src.last_restore_path
+                self.last_restore_fallbacks = src.last_restore_fallbacks
+                self.last_restore_stats = src.last_restore_stats
                 if cursor is not None:
                     start_step = int(cursor.get("step", 0))
                     self.last_resume_step = start_step

@@ -40,14 +40,42 @@ given, each mesh-committed persistable is saved as its **addressable
 shards**: one ``.npy`` per distinct shard (replicas deduplicated) plus
 a shard manifest recording the global shape, dtype, PartitionSpec, and
 each shard's index slices.  No full tensor is ever materialized — the
-per-shard files ARE the checkpoint (their shapes prove it).  Restore
-(``restore(..., compiled=)``) re-places each shard straight onto its
-device via ``jax.make_array_from_single_device_arrays``, again without
-a full host tensor; resuming on a mesh with a DIFFERENT shape (or a
-layout whose shard indexes no longer match) is a typed
-:class:`CheckpointMeshMismatchError`, never silent mis-placement.
-Shard-wise saves compose with async mode and the atomic-commit /
-``checkpoint.commit`` fault-point machinery unchanged.
+per-shard files ARE the checkpoint (their shapes prove it).
+Mesh-resident sparse tables (``sharding.sparse.MeshTableRuntime`` —
+the program's ``_mesh_tables`` binding) ride the SAME path: row arrays
+and optimizer moments dump shard-wise into ``shards/`` under manifest
+entries tagged ``kind: mesh_table[_moments]`` and restore back into
+the runtime.
+
+**Topology-elastic restore** (``restore(..., compiled=)``): resume on
+the SAME mesh re-places each saved shard straight onto its device via
+``jax.make_array_from_single_device_arrays``.  Resume on a *different*
+mesh shape, device assignment, or layout performs a **shard
+exchange**: each target device's addressable region is assembled from
+the OVERLAPPING saved shard files — slice-wise reads out of
+memory-mapped per-shard ``.npy`` files, so the largest host buffer is
+one device's region, never the full tensor (``last_restore_stats``
+records the high-water mark).  :class:`CheckpointMeshMismatchError`
+remains only for genuinely incompatible cases: a layout whose resolve
+fails on the new mesh (axis divisibility), a global-shape drift, or
+saved shards that no longer tile a target region (a doctored/partial
+manifest).
+
+**Integrity-verified recovery**: every committed checkpoint carries an
+``integrity.json`` manifest — a content hash (sha256) and byte size
+for EVERY file in the checkpoint (params, shards, PS tables, cursor).
+``restore`` verifies the newest checkpoint before trusting it; a
+flipped byte, truncation, or missing file is a typed
+:class:`CheckpointCorruptionError`, counted in
+``train_checkpoint_corruption_total``, and restore automatically falls
+back through the keep-N chain to the newest fully-verifiable
+checkpoint (each skip counted in ``train_checkpoint_fallback_total`` —
+never silent).  A ``LATEST`` pointer naming a pruned/missing directory
+falls back the same way instead of failing (or silently fresh-
+starting) on the dangling pointer.  The ``checkpoint.restore`` fault
+point arms the restore path for chaos drills exactly like
+``checkpoint.commit`` arms the save path; ``tools/check_checkpoint.py``
+runs the same verification offline.
 
 Layout::
 
@@ -55,35 +83,136 @@ Layout::
       LATEST              # "ckpt-000040\n"
       ckpt-000040/
         cursor.json       # {"step": 40, "epoch": 0}
+        integrity.json    # {"algo": "sha256", "files": {relpath:
+                          #   {"sha256": ..., "bytes": ...}}} — every
+                          #   other file in the checkpoint
         params/           # io.save_persistables output (host-resident
                           #   vars only in shard-wise mode)
         shards/           # optional: manifest.json + v<i>_s<j>.npy —
                           #   per-shard dumps of mesh-committed state
+                          #   (incl. mesh-table rows/moments, tagged
+                          #   kind: mesh_table[_moments])
         ps/               # optional: manifest.json + t<i>_{ids,rows}.npy
                           #   (+ t<i>_moments.npy: adagrad accumulators)
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import shutil
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 import paddle_tpu.faults as _faults
-from paddle_tpu.faults.metrics import TRAIN_CHECKPOINTS
+from paddle_tpu.faults.metrics import (
+    TRAIN_CHECKPOINT_BYTES,
+    TRAIN_CHECKPOINT_CORRUPTION,
+    TRAIN_CHECKPOINT_FALLBACKS,
+    TRAIN_CHECKPOINT_RESTORES,
+    TRAIN_CHECKPOINTS,
+)
 
-__all__ = ["TrainCheckpoint", "CheckpointMeshMismatchError"]
+__all__ = ["TrainCheckpoint", "CheckpointMeshMismatchError",
+           "CheckpointCorruptionError", "verify_checkpoint_dir",
+           "hash_file"]
 
 
 class CheckpointMeshMismatchError(RuntimeError):
-    """A shard-wise checkpoint cannot re-place on the CURRENT mesh or
-    layout: the mesh shape differs from the one the shards were saved
-    under, or a device's expected shard index has no saved file.
-    Resuming anyway would silently mis-place state; re-shard offline or
-    resume on the original mesh shape."""
+    """A shard-wise checkpoint is GENUINELY incompatible with the
+    current mesh/layout: the layout cannot resolve on this mesh (axis
+    divisibility), the global shape drifted, or the saved shards no
+    longer tile a target device's region.  A merely *different* mesh
+    shape or device assignment is NOT this error — the shard-exchange
+    restore re-slices overlapping shards onto the new topology."""
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint failed integrity verification: a file listed in
+    ``integrity.json`` is missing, truncated, or its content hash does
+    not match what the commit recorded.  Restore falls back through
+    the keep-N chain; this error surfaces only when NO checkpoint in
+    the run directory verifies."""
+
+
+def hash_file(path: str, chunk: int = 1 << 20) -> str:
+    """sha256 hex digest of a file, streamed (checkpoints can exceed
+    comfortable read-at-once sizes)."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(chunk), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def verify_checkpoint_dir(path: str) -> None:
+    """Verify one committed checkpoint directory against its
+    ``integrity.json``: every listed file must exist with the recorded
+    size and content hash, and every file on disk must be listed (an
+    unlisted file means the directory was tampered with after the
+    commit).  Raises :class:`CheckpointCorruptionError`; checkpoints
+    from before the integrity manifest existed pass unverified (there
+    is nothing to check them against)."""
+    integ = os.path.join(path, _INTEGRITY)
+    if not os.path.exists(integ):
+        return  # pre-integrity checkpoint: restore-as-before semantics
+    try:
+        with open(integ) as f:
+            doc = json.load(f)
+        files = dict(doc["files"])
+        entries = sorted((str(rel), ent["sha256"], int(ent["bytes"]))
+                         for rel, ent in files.items())
+    except CheckpointCorruptionError:
+        raise
+    except Exception as e:  # noqa: BLE001 — ANY malformed-structure
+        # shape (non-dict files, entry missing a key, junk types) must
+        # become the typed corruption so the keep-N fallback engages —
+        # an untyped crash here would defeat the recovery chain
+        raise CheckpointCorruptionError(
+            "checkpoint %s: unreadable/malformed integrity manifest (%s)"
+            % (path, e)) from None
+    on_disk = set()
+    for dirpath, _, fns in os.walk(path):
+        for fn in fns:
+            rel = os.path.relpath(os.path.join(dirpath, fn), path)
+            if rel != _INTEGRITY:
+                on_disk.add(rel.replace(os.sep, "/"))
+    listed = {rel for rel, _, _ in entries}
+    for rel in sorted(listed - on_disk):
+        raise CheckpointCorruptionError(
+            "checkpoint %s: file %r listed in the integrity manifest "
+            "is missing" % (path, rel))
+    for rel in sorted(on_disk - listed):
+        raise CheckpointCorruptionError(
+            "checkpoint %s: file %r on disk is not in the integrity "
+            "manifest (written after the commit?)" % (path, rel))
+    for rel, want_digest, want_bytes in entries:
+        fpath = os.path.join(path, *rel.split("/"))
+        size = os.path.getsize(fpath)
+        if size != want_bytes:
+            raise CheckpointCorruptionError(
+                "checkpoint %s: file %r is %d bytes, manifest recorded "
+                "%d (truncated?)" % (path, rel, size, want_bytes))
+        digest = hash_file(fpath)
+        if digest != want_digest:
+            raise CheckpointCorruptionError(
+                "checkpoint %s: file %r content hash %s does not match "
+                "the recorded %s (corrupted on disk)"
+                % (path, rel, digest, want_digest))
+
+
+def _load_shard(fpath: str, mmap_mode=None) -> np.ndarray:
+    """``np.load`` with unreadable/truncated content re-typed as
+    :class:`CheckpointCorruptionError` — a damaged PRE-integrity
+    checkpoint (nothing for the hash gate to check) must still engage
+    restore's fallback chain, never an untyped crash."""
+    try:
+        return np.load(fpath, mmap_mode=mmap_mode)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptionError(
+            "shard file %s is unreadable (%s)" % (fpath, e)) from None
 
 
 def _index_key(index, shape) -> Tuple[Tuple[int, int], ...]:
@@ -99,6 +228,7 @@ def _index_key(index, shape) -> Tuple[Tuple[int, int], ...]:
 _LATEST = "LATEST"
 _PREFIX = "ckpt-"
 _TMP_PREFIX = ".tmp-"
+_INTEGRITY = "integrity.json"
 
 
 class TrainCheckpoint:
@@ -117,6 +247,12 @@ class TrainCheckpoint:
         self._bg: Optional[threading.Thread] = None
         self._bg_result: Optional[str] = None
         self._bg_error: Optional[BaseException] = None
+        # restore bookkeeping (read by the executor and the drills):
+        # which checkpoint actually restored, how many were skipped on
+        # the way there, and the shard-exchange host-buffer high-water
+        self.last_restore_path: Optional[str] = None
+        self.last_restore_fallbacks: int = 0
+        self.last_restore_stats: Optional[Dict] = None
         os.makedirs(self.run_dir, exist_ok=True)
 
     # ------------------------------------------------------------------
@@ -225,18 +361,46 @@ class TrainCheckpoint:
         return snap
 
     @staticmethod
-    def _gather_shards(program, scope, compiled, copy: bool):
-        """Collect mesh-committed persistables as per-shard host arrays
-        (replicas deduplicated by shard index).  Returns None when
-        ``compiled`` is None or nothing is mesh-committed.  Each shard
-        copies only ITS slice to host — the full tensor never exists in
-        one buffer.  ``copy=True`` (async mode) forces an owned numpy
+    def _shard_entry(val, copy: bool, extra: Optional[Dict] = None
+                     ) -> Dict:
+        """One manifest entry for a mesh-committed array: per-shard
+        host copies deduplicated by index (a replica is skipped — each
+        shard copies only ITS slice, the full tensor never exists in
+        one buffer).  ``copy=True`` (async mode) forces an owned numpy
         copy so a donated device buffer mutated by the next step cannot
         reach the writer thread."""
+        from paddle_tpu.sharding.rules import spec_to_manifest
+
+        shape = tuple(int(d) for d in val.shape)
+        seen: Dict[Tuple, np.ndarray] = {}
+        for s in val.addressable_shards:
+            key = _index_key(s.index, shape)
+            if key in seen:
+                continue  # a replica of an already-captured shard
+            arr = np.asarray(s.data)  # THIS shard only, never full
+            if copy:
+                arr = np.array(arr, copy=True)
+            seen[key] = arr
+        spec = getattr(val.sharding, "spec", None)
+        entry = {
+            "shape": shape,
+            "dtype": str(val.dtype),
+            "spec": spec_to_manifest(spec) if spec is not None else None,
+            "shards": sorted(seen.items()),
+        }
+        if extra:
+            entry.update(extra)
+        return entry
+
+    @staticmethod
+    def _gather_shards(program, scope, compiled, copy: bool):
+        """Collect mesh-committed persistables (and any bound
+        mesh-table runtime's rows/moments) as per-shard host arrays.
+        Returns None when ``compiled`` is None or nothing is
+        mesh-committed."""
         if compiled is None:
             return None
         from paddle_tpu import io as _io
-        from paddle_tpu.sharding.rules import spec_to_manifest
 
         mesh = compiled.mesh
         mesh_axes = {str(a): int(n) for a, n in
@@ -244,9 +408,8 @@ class TrainCheckpoint:
         entries: Dict[str, Dict] = {}
         for v in _io._collect(program, _io._is_persistable, None):
             val = scope.get(v.name)
-            shards = getattr(val, "addressable_shards", None)
             sh = getattr(val, "sharding", None)
-            if (not shards or sh is None
+            if (not getattr(val, "addressable_shards", None) or sh is None
                     or len(getattr(sh, "device_set", ())) <= 1):
                 continue  # host / single-device value: params/ path
             if getattr(sh, "is_fully_replicated", False):
@@ -256,24 +419,18 @@ class TrainCheckpoint:
                 # shards/ would pin a replicated checkpoint to this
                 # mesh's exact shape for zero space win
                 continue
-            shape = tuple(int(d) for d in val.shape)
-            seen: Dict[Tuple, np.ndarray] = {}
-            for s in shards:
-                key = _index_key(s.index, shape)
-                if key in seen:
-                    continue  # a replica of an already-captured shard
-                arr = np.asarray(s.data)  # THIS shard only, never full
-                if copy:
-                    arr = np.array(arr, copy=True)
-                seen[key] = arr
-            spec = getattr(sh, "spec", None)
-            entries[v.name] = {
-                "shape": shape,
-                "dtype": str(val.dtype),
-                "spec": (spec_to_manifest(spec)
-                         if spec is not None else None),
-                "shards": sorted(seen.items()),
-            }
+            entries[v.name] = TrainCheckpoint._shard_entry(val, copy)
+        # mesh-resident sparse tables (sharding.sparse): rows + moments
+        # live as sharded device arrays on the runtime, not in the
+        # scope — dump them shard-wise through the same manifest,
+        # tagged so restore routes them back into the runtime
+        runtime = getattr(program, "_mesh_tables", None)
+        if runtime is not None:
+            for ename, ent in runtime.checkpoint_state().items():
+                entries[ename] = TrainCheckpoint._shard_entry(
+                    ent["array"], copy,
+                    extra={"kind": ent["kind"], "table": ent["table"],
+                           "height": int(ent["height"])})
         if not entries:
             return None
         return {"mesh_axes": mesh_axes, "vars": entries}
@@ -306,6 +463,7 @@ class TrainCheckpoint:
             cursor.update(extra)
         with open(os.path.join(tmp, "cursor.json"), "w") as f:
             json.dump(cursor, f)
+        total_bytes = self._write_integrity(tmp)
         if _faults.active is not None:  # disarmed: one is-None gate
             # the chaos window: a kill/delay/error HERE lands between a
             # fully staged tmp dir and its commit — resume must still
@@ -313,6 +471,7 @@ class TrainCheckpoint:
             _faults.active.faultpoint(
                 "checkpoint.commit", run_dir=self.run_dir, step=int(step))
         os.replace(tmp, final)
+        TRAIN_CHECKPOINT_BYTES.set(total_bytes)
         # move LATEST only after the checkpoint directory is committed
         ptr_tmp = os.path.join(self.run_dir, _LATEST + ".tmp")
         with open(ptr_tmp, "w") as f:
@@ -321,6 +480,24 @@ class TrainCheckpoint:
         TRAIN_CHECKPOINTS.inc()
         self._prune(keep_name=self._name(step))
         return final
+
+    @staticmethod
+    def _write_integrity(tmp: str) -> int:
+        """Hash every staged file into ``integrity.json`` (the LAST
+        file written before the commit rename, so it covers all the
+        others); returns the checkpoint's total byte size."""
+        files: Dict[str, Dict] = {}
+        total = 0
+        for dirpath, _, fns in os.walk(tmp):
+            for fn in sorted(fns):
+                p = os.path.join(dirpath, fn)
+                rel = os.path.relpath(p, tmp).replace(os.sep, "/")
+                size = os.path.getsize(p)
+                files[rel] = {"sha256": hash_file(p), "bytes": size}
+                total += size
+        with open(os.path.join(tmp, _INTEGRITY), "w") as f:
+            json.dump({"algo": "sha256", "files": files}, f)
+        return total + os.path.getsize(os.path.join(tmp, _INTEGRITY))
 
     @staticmethod
     def _write_shards(dirname: str, shard_state) -> None:
@@ -338,12 +515,16 @@ class TrainCheckpoint:
                 np.save(os.path.join(dirname, fname), arr)
                 files.append({"file": fname,
                               "index": [list(se) for se in key]})
-            manifest["vars"][name] = {
+            doc = {
                 "shape": list(ent["shape"]),
                 "dtype": ent["dtype"],
                 "spec": ent["spec"],
                 "shards": files,
             }
+            for extra in ("kind", "table", "height"):
+                if extra in ent:
+                    doc[extra] = ent[extra]
+            manifest["vars"][name] = doc
         with open(os.path.join(dirname, "manifest.json"), "w") as f:
             json.dump(manifest, f)
 
@@ -396,7 +577,10 @@ class TrainCheckpoint:
 
     # ------------------------------------------------------------------
     def latest(self) -> Optional[str]:
-        """Path of the newest COMMITTED checkpoint, or None."""
+        """Path of the checkpoint the ``LATEST`` pointer names, or None
+        when there is no pointer or its target is gone.  :meth:`restore`
+        does NOT stop here — a dangling pointer falls back through the
+        remaining complete checkpoints (counted)."""
         ptr = os.path.join(self.run_dir, _LATEST)
         if not os.path.exists(ptr):
             return None
@@ -405,129 +589,354 @@ class TrainCheckpoint:
         path = os.path.join(self.run_dir, name)
         return path if os.path.isdir(path) else None
 
+    def _completed(self) -> List[str]:
+        """Committed checkpoint directory names, NEWEST first."""
+        return sorted(
+            (d for d in os.listdir(self.run_dir)
+             if d.startswith(_PREFIX)
+             and os.path.isdir(os.path.join(self.run_dir, d))),
+            key=self._step_of, reverse=True)
+
     def restore(self, program, scope, ps_client=None,
                 compiled=None) -> Optional[Dict]:
-        """Restore the newest checkpoint into ``scope`` (and the PS
-        tables through ``ps_client``); returns its cursor dict, or None
-        when the run directory holds no committed checkpoint (fresh
-        start).  A shard-wise checkpoint needs ``compiled`` (the same
-        sharded layout the run trains through) so each shard re-places
-        straight onto its device — a mesh whose shape differs from the
-        saved one is a typed :class:`CheckpointMeshMismatchError`."""
+        """Restore the newest VERIFIABLE checkpoint into ``scope`` (and
+        the PS tables through ``ps_client``, and any bound mesh-table
+        runtime); returns its cursor dict, or None when the run
+        directory holds no committed checkpoint (fresh start).
+
+        Integrity first: each candidate is verified against its
+        ``integrity.json`` before anything loads — a corrupt/truncated
+        checkpoint (or a ``LATEST`` pointer naming a pruned directory)
+        falls back to the next-newest complete one, counted in
+        ``train_checkpoint_fallback_total`` /
+        ``train_checkpoint_corruption_total``; only when NO candidate
+        verifies does the :class:`CheckpointCorruptionError` surface.
+
+        A shard-wise checkpoint needs ``compiled`` (the run's sharded
+        layout).  The mesh does NOT have to match the saving one: a
+        different shape or device assignment restores through the
+        shard-exchange path (each device's region assembled from the
+        overlapping saved shard files, slice-wise).  Genuinely
+        incompatible specs — a layout that cannot resolve on the new
+        mesh, shape drift, shards that no longer tile a region — stay a
+        typed :class:`CheckpointMeshMismatchError` and do NOT fall
+        back (they are configuration errors, not disk corruption)."""
+        self.last_restore_path = None
+        self.last_restore_fallbacks = 0
+        self.last_restore_stats = None
+        names = self._completed()
+        ptr = os.path.join(self.run_dir, _LATEST)
+        pointed = None
+        if os.path.exists(ptr):
+            with open(ptr) as f:
+                pointed = f.read().strip()
+        if not names:
+            if pointed:
+                # the pointer is on-disk evidence committed state
+                # EXISTED; with every checkpoint directory gone this is
+                # a loss, not a fresh start — never restart from step 0
+                # silently
+                TRAIN_CHECKPOINT_CORRUPTION.inc()
+                raise CheckpointCorruptionError(
+                    "run dir %s: LATEST names %r but no committed "
+                    "checkpoint directory remains — the run's state "
+                    "was lost (bad prune / partial disk restore?)"
+                    % (self.run_dir, pointed))
+            return None
+        if pointed and pointed not in names:
+            # dangling pointer (its target was pruned or lost): the
+            # newest complete checkpoint serves instead — counted,
+            # never a silent fresh start
+            TRAIN_CHECKPOINT_FALLBACKS.inc()
+            self.last_restore_fallbacks += 1
+        last_err: Optional[CheckpointCorruptionError] = None
+        for i, name in enumerate(names):
+            path = os.path.join(self.run_dir, name)
+            if _faults.active is not None:  # disarmed: one is-None gate
+                # the restore-side chaos window (mirrors
+                # checkpoint.commit on the save side): delay/error here
+                # lands between picking a candidate and trusting it
+                _faults.active.faultpoint(
+                    "checkpoint.restore", run_dir=self.run_dir, path=path)
+            try:
+                verify_checkpoint_dir(path)
+                cursor = self._restore_one(path, program, scope,
+                                           ps_client, compiled)
+            except CheckpointCorruptionError as e:
+                # a pre-integrity checkpoint (nothing to verify against)
+                # can still fail at LOAD time — _restore_one types its
+                # unreadable-file failures so the fallback engages for
+                # them too; the scope may be partially written, but the
+                # next candidate's load overwrites every name it set
+                TRAIN_CHECKPOINT_CORRUPTION.inc()
+                last_err = e
+                if i + 1 < len(names):
+                    TRAIN_CHECKPOINT_FALLBACKS.inc()
+                    self.last_restore_fallbacks += 1
+                continue
+            TRAIN_CHECKPOINT_RESTORES.inc()
+            self.last_restore_path = path
+            return cursor
+        raise last_err  # every candidate failed verification
+
+    def _restore_one(self, path: str, program, scope, ps_client,
+                     compiled) -> Dict:
+        """Load one verified checkpoint directory (params + shards +
+        PS tables + cursor).  Unreadable/truncated file content
+        re-raises as :class:`CheckpointCorruptionError` (restore's
+        fallback class); configuration errors (missing ps_client /
+        compiled / mesh-table binding, mesh incompatibility) keep
+        their own types and do NOT fall back."""
         from paddle_tpu import io as _io
 
-        path = self.latest()
-        if path is None:
-            return None
-        _io.load_persistables(None, os.path.join(path, "params"),
-                              main_program=program, scope=scope)
+        try:
+            _io.load_persistables(None, os.path.join(path, "params"),
+                                  main_program=program, scope=scope)
+        except (OSError, ValueError, KeyError) as e:
+            raise CheckpointCorruptionError(
+                "checkpoint %s: params failed to load (%s)"
+                % (path, e)) from None
         shards_dir = os.path.join(path, "shards")
         if os.path.isdir(shards_dir):
-            if compiled is None:
-                raise ValueError(
-                    "checkpoint %s holds SHARD-wise state — pass the "
-                    "run's CompiledProgram (compiled=) so shards "
-                    "re-place onto its mesh" % path)
-            self._restore_shards(shards_dir, scope, compiled)
-        ps_dir = os.path.join(path, "ps")
-        if os.path.isdir(ps_dir):
+            self.last_restore_stats = self._restore_shards(
+                shards_dir, scope, compiled, program)
+        ps_dir = os.path.isdir(os.path.join(path, "ps"))
+        if ps_dir:
             if ps_client is None:
                 raise ValueError(
                     "checkpoint %s carries PS tables but no ps_client was "
                     "given to restore them" % path)
-            self._restore_ps(ps_dir, ps_client)
+            self._restore_ps(os.path.join(path, "ps"), ps_client)
+        if ps_dir or (self.last_restore_stats or {}).get("mesh_tables"):
             cache = getattr(program, "_embedding_cache", None)
             if cache is not None:
-                # the restore rewrote rows wholesale server-side: a
-                # cached copy from before it is stale (regression-pinned
-                # in tests/test_embedding_cache.py)
+                # the restore rewrote rows wholesale (server-side or on
+                # the mesh): a cached copy from before it is stale
+                # (regression-pinned in tests/test_embedding_cache.py)
                 cache.invalidate()
-        with open(os.path.join(path, "cursor.json")) as f:
-            return json.load(f)
+        try:
+            with open(os.path.join(path, "cursor.json")) as f:
+                return json.load(f)
+        except (OSError, ValueError) as e:
+            raise CheckpointCorruptionError(
+                "checkpoint %s: unreadable cursor.json (%s)"
+                % (path, e)) from None
 
-    @staticmethod
-    def _restore_shards(dirname: str, scope, compiled) -> None:
-        """Re-place saved shards onto the compiled program's mesh: each
-        device receives exactly its index's shard via ``device_put`` +
-        ``make_array_from_single_device_arrays`` — the full tensor is
-        never assembled host-side.  Typed failures: a mesh shape
-        differing from the saved one, a layout whose resolved spec
-        drifted from the saved spec, or a device index with no saved
-        shard file."""
+    # ------------------------------------------------------------------
+    # shard-exchange restore
+    # ------------------------------------------------------------------
+    def _restore_shards(self, dirname: str, scope, compiled,
+                        program=None) -> Dict:
+        """Re-place saved shards onto the CURRENT mesh/layout.
+
+        Same-topology fast path: a target region that exactly matches a
+        saved shard loads its file whole.  Different topology (mesh
+        shape, device assignment, or layout): each target device's
+        region is ASSEMBLED from the overlapping saved shard files —
+        slice-wise reads out of memory-mapped ``.npy`` files, so the
+        largest host buffer is one device's region (tracked in the
+        returned stats as ``max_region_bytes``); the full tensor is
+        never materialized on any host, in either direction.
+
+        Typed :class:`CheckpointMeshMismatchError` only for the
+        genuinely incompatible: the layout cannot resolve on this mesh
+        (axis divisibility), the global shape drifted from the program,
+        or the saved shards do not tile a required region."""
         import jax
 
-        from paddle_tpu.sharding.rules import spec_to_manifest
-
-        with open(os.path.join(dirname, "manifest.json")) as f:
-            manifest = json.load(f)
-        mesh = compiled.mesh
-        cur_axes = {str(a): int(n) for a, n in
-                    zip(mesh.axis_names, mesh.devices.shape)}
-        saved_axes = {str(a): int(n)
-                      for a, n in manifest["mesh_axes"].items()}
-        if cur_axes != saved_axes:
-            raise CheckpointMeshMismatchError(
-                "shard-wise checkpoint was saved on mesh %s but this "
-                "run's mesh is %s — shards cannot re-place on a "
-                "different mesh shape (resume on the original shape, "
-                "or re-shard offline)" % (saved_axes, cur_axes))
-
-        def _norm(doc):
-            doc = list(doc or [])
-            while doc and doc[-1] is None:
-                doc.pop()  # trailing replicated dims are spec-equal
-            return doc
-
+        try:
+            with open(os.path.join(dirname, "manifest.json")) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            raise CheckpointCorruptionError(
+                "checkpoint shards manifest %s is unreadable (%s)"
+                % (os.path.join(dirname, "manifest.json"), e)) from None
+        stats = {"direct": 0, "exchanged": 0, "regions": 0,
+                 "shard_files_read": 0, "max_region_bytes": 0,
+                 "mesh_tables": 0}
+        runtime = (getattr(program, "_mesh_tables", None)
+                   if program is not None else None)
         for name, ent in manifest["vars"].items():
-            sharding = compiled.state_sharding(name)
             shape = tuple(int(d) for d in ent["shape"])
-            saved_spec = ent.get("spec")
-            cur_spec = spec_to_manifest(sharding.spec)
-            if saved_spec is not None and _norm(saved_spec) != _norm(
-                    cur_spec):
-                raise CheckpointMeshMismatchError(
-                    "var %r was saved with partition spec %s but the "
-                    "current layout resolves it to %s — the rules "
-                    "changed since the checkpoint; restore with the "
-                    "saving layout" % (name, saved_spec, cur_spec))
-            by_index = {}
+            dtype = np.dtype(ent["dtype"])
+            saved = []
             for doc in ent["shards"]:
-                key = tuple(tuple(int(x) for x in se)
+                box = tuple(tuple(int(x) for x in se)
                             for se in doc["index"])
-                by_index[key] = os.path.join(dirname, doc["file"])
-            loaded: Dict[Tuple, np.ndarray] = {}
-            arrays = []
-            for dev, idx in sharding.addressable_devices_indices_map(
-                    shape).items():
-                key = _index_key(idx, shape)
-                fpath = by_index.get(key)
-                if fpath is None:
+                saved.append((box, os.path.join(dirname, doc["file"])))
+            if ent.get("kind") in ("mesh_table", "mesh_table_moments"):
+                self._restore_mesh_table(name, ent, saved, shape, dtype,
+                                         runtime, stats)
+                continue
+            if compiled is None:
+                raise ValueError(
+                    "checkpoint %s holds SHARD-wise state — pass the "
+                    "run's CompiledProgram (compiled=) so shards "
+                    "re-place onto its mesh" % os.path.dirname(dirname))
+            try:
+                sharding = compiled.state_sharding(name)
+            except Exception as e:
+                # e.g. a dim no longer divisible by the new mesh's axis
+                # size — the one genuinely spec-incompatible resume
+                raise CheckpointMeshMismatchError(
+                    "var %r: the current layout cannot resolve on this "
+                    "mesh (%s) — the checkpoint itself is fine; fix the "
+                    "layout or resume on a compatible mesh"
+                    % (name, e)) from None
+            var = (program.global_block()._find_var_recursive(name)
+                   if program is not None else None)
+            if (var is not None and var.shape is not None
+                    and -1 not in tuple(var.shape)
+                    and tuple(int(d) for d in var.shape) != shape):
+                raise CheckpointMeshMismatchError(
+                    "var %r was saved with global shape %s but the "
+                    "program declares %s — the model changed since the "
+                    "checkpoint" % (name, shape, tuple(var.shape)))
+            scope.set(name, self._exchange_place(
+                jax, name, shape, dtype, sharding, saved, stats))
+        return stats
+
+    def _exchange_place(self, jax, name, shape, dtype, sharding, saved,
+                        stats, required_rows=None):
+        """Assemble every distinct target region of ``sharding`` over
+        ``shape`` from the saved shard files and place it per device;
+        returns the committed global array."""
+        from paddle_tpu.sharding.train import box_overlap, shard_boxes
+
+        # the coverage check below sums overlap volumes, which is exact
+        # ONLY over a disjoint shard grid — and these boxes come from an
+        # untrusted manifest.  A duplicate/overlapping entry could fake
+        # full coverage while leaving zero-filled holes.
+        for i in range(len(saved)):
+            for j in range(i + 1, len(saved)):
+                if box_overlap(saved[i][0], saved[j][0]) is not None:
                     raise CheckpointMeshMismatchError(
-                        "var %r: device %s expects shard index %s but "
-                        "the checkpoint holds only %s — layout/mesh "
-                        "drift since the save"
-                        % (name, dev, key, sorted(by_index)))
-                arr = loaded.get(key)
-                if arr is None:
-                    arr = loaded[key] = np.load(fpath)
+                        "var %r: saved shard indexes %s and %s overlap "
+                        "— a PartitionSpec shard grid is disjoint, so "
+                        "the manifest was doctored or mis-written"
+                        % (name, saved[i][0], saved[j][0]))
+        arrays = []
+        for box, devs in shard_boxes(sharding, shape).items():
+            stats["regions"] += 1
+            if required_rows is None:
+                required = box
+            else:
+                required = box_overlap(
+                    box, ((0, int(required_rows)),)
+                    + tuple((0, int(d)) for d in shape[1:]))
+            arr = self._assemble_region(name, box, dtype, saved,
+                                        required, stats)
+            for dev in devs:
                 arrays.append(jax.device_put(arr, dev))
-            scope.set(name, jax.make_array_from_single_device_arrays(
-                shape, sharding, arrays))
+        return jax.make_array_from_single_device_arrays(
+            shape, sharding, arrays)
+
+    @staticmethod
+    def _assemble_region(name, box, dtype, saved, required, stats):
+        """One target region: the exact-match fast path loads the saved
+        file whole; otherwise overlapping saved shards are slice-read
+        (mmap) into a region-sized buffer.  ``required`` (a sub-box of
+        ``box``, or None for none) must be fully tiled by the saved
+        shards — cells outside it (mesh-table padding rows) zero-fill."""
+        from paddle_tpu.sharding.train import box_overlap, box_volume
+
+        for sbox, fpath in saved:
+            if sbox == box:
+                arr = _load_shard(fpath)  # shard-sized, never the full tensor
+                stats["direct"] += 1
+                stats["shard_files_read"] += 1
+                stats["max_region_bytes"] = max(
+                    stats["max_region_bytes"], int(arr.nbytes))
+                return arr
+        buf = np.zeros(tuple(hi - lo for lo, hi in box), dtype)
+        covered = 0
+        for sbox, fpath in saved:
+            ov = box_overlap(sbox, box)
+            if ov is None:
+                continue
+            src = _load_shard(fpath, mmap_mode="r")  # slice-wise read only
+            src_sl = tuple(slice(lo - s[0], hi - s[0])
+                           for (lo, hi), s in zip(ov, sbox))
+            dst_sl = tuple(slice(lo - b[0], hi - b[0])
+                           for (lo, hi), b in zip(ov, box))
+            buf[dst_sl] = src[src_sl]
+            stats["shard_files_read"] += 1
+            if required is not None:
+                req_ov = box_overlap(ov, required)
+                if req_ov is not None:
+                    covered += box_volume(req_ov)
+        if required is not None and covered != box_volume(required):
+            raise CheckpointMeshMismatchError(
+                "var %r: the saved shards cover only %d of %d cells of "
+                "target region %s — the checkpoint's shard set is "
+                "incomplete for this layout/mesh (doctored manifest, or "
+                "a partial save)" % (name, covered,
+                                     box_volume(required), box))
+        stats["exchanged"] += 1
+        stats["max_region_bytes"] = max(
+            stats["max_region_bytes"], int(buf.nbytes))
+        return buf
+
+    def _restore_mesh_table(self, name, ent, saved, saved_shape, dtype,
+                            runtime, stats) -> None:
+        """Route a ``kind: mesh_table[_moments]`` manifest entry back
+        into the bound :class:`MeshTableRuntime` — the same exchange
+        step, with the CURRENT padded height as the target shape (row
+        padding differs across shard counts; rows past the real height
+        are never read by a lookup and zero-fill)."""
+        import jax
+
+        table = str(ent.get("table", name))
+        kind = str(ent["kind"])
+        if runtime is None or table not in getattr(runtime, "tables", {}):
+            raise ValueError(
+                "checkpoint entry %r is mesh-table state for table %r "
+                "but the program has no mesh-table runtime binding it — "
+                "bind_mesh_tables(...) on the run's CompiledProgram "
+                "before restoring" % (name, table))
+        tbl = runtime.tables[table]
+        if kind == "mesh_table_moments" and tbl.moments is None:
+            return  # saved adagrad moments, runtime runs sgd: unused
+        target = (tbl.moments if kind == "mesh_table_moments"
+                  else tbl.array)
+        cur_shape = tuple(int(d) for d in target.shape)
+        if tuple(saved_shape[1:]) != tuple(cur_shape[1:]):
+            raise CheckpointMeshMismatchError(
+                "mesh table %r: saved row shape %s vs the runtime's %s "
+                "— the table changed since the checkpoint"
+                % (table, saved_shape[1:], cur_shape[1:]))
+        height = int(ent.get("height", saved_shape[0]))
+        if height != tbl.height:
+            raise CheckpointMeshMismatchError(
+                "mesh table %r: saved height %d vs the runtime's %d — "
+                "the table changed since the checkpoint"
+                % (table, height, tbl.height))
+        runtime.install_state(table, kind, self._exchange_place(
+            jax, name, cur_shape, dtype, target.sharding, saved, stats,
+            required_rows=min(height, int(saved_shape[0]))))
+        stats["mesh_tables"] += 1
 
     @staticmethod
     def _restore_ps(dirname: str, ps_client) -> None:
-        with open(os.path.join(dirname, "manifest.json")) as f:
-            manifest = json.load(f)
+        try:
+            with open(os.path.join(dirname, "manifest.json")) as f:
+                manifest = json.load(f)
+            tables = [(int(ent["index"]), str(ent["table"]),
+                       bool(ent.get("moments"))) for ent in
+                      manifest["tables"]]
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            raise CheckpointCorruptionError(
+                "checkpoint PS manifest %s is unreadable/malformed (%s)"
+                % (os.path.join(dirname, "manifest.json"), e)) from None
         state = {}
-        for ent in manifest["tables"]:
-            i = int(ent["index"])
-            ids = np.load(os.path.join(dirname, "t%03d_ids.npy" % i))
-            rows = np.load(os.path.join(dirname, "t%03d_rows.npy" % i))
+        for i, table, has_moments in tables:
+            ids = _load_shard(os.path.join(dirname, "t%03d_ids.npy" % i))
+            rows = _load_shard(os.path.join(dirname, "t%03d_rows.npy" % i))
             mpath = os.path.join(dirname, "t%03d_moments.npy" % i)
             # pre-moments checkpoints (no flag, no file) restore as
             # before: rows only, accumulators restart
-            if ent.get("moments") and os.path.exists(mpath):
-                state[str(ent["table"])] = (ids, rows, np.load(mpath))
+            if has_moments and os.path.exists(mpath):
+                state[table] = (ids, rows, _load_shard(mpath))
             else:
-                state[str(ent["table"])] = (ids, rows)
+                state[table] = (ids, rows)
         ps_client.load_tables(state)

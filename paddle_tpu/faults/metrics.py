@@ -15,6 +15,8 @@ from paddle_tpu.monitor import registry as _registry
 __all__ = [
     "FAULTS_INJECTED", "RETRY_ATTEMPTS",
     "BACKEND_HALFOPEN_PROBES", "TRAIN_CHECKPOINTS",
+    "TRAIN_CHECKPOINT_RESTORES", "TRAIN_CHECKPOINT_FALLBACKS",
+    "TRAIN_CHECKPOINT_CORRUPTION", "TRAIN_CHECKPOINT_BYTES",
 ]
 
 FAULTS_INJECTED = _registry.REGISTRY.counter(
@@ -33,3 +35,20 @@ BACKEND_HALFOPEN_PROBES = _registry.REGISTRY.counter(
 TRAIN_CHECKPOINTS = _registry.REGISTRY.counter(
     "train_checkpoints_total",
     "training checkpoints committed (atomic tmp+rename completed)")
+TRAIN_CHECKPOINT_RESTORES = _registry.REGISTRY.counter(
+    "train_checkpoint_restore_total",
+    "checkpoints successfully restored (integrity-verified; cross-mesh "
+    "shard-exchange restores count here too)")
+TRAIN_CHECKPOINT_FALLBACKS = _registry.REGISTRY.counter(
+    "train_checkpoint_fallback_total",
+    "restore fell back past a checkpoint it could not use (corrupt, "
+    "truncated, or a dangling LATEST pointer) to an older complete one "
+    "— counted per checkpoint skipped, never silent")
+TRAIN_CHECKPOINT_CORRUPTION = _registry.REGISTRY.counter(
+    "train_checkpoint_corruption_total",
+    "checkpoints that failed integrity verification at restore "
+    "(content-hash mismatch, truncated or missing files)")
+TRAIN_CHECKPOINT_BYTES = _registry.REGISTRY.gauge(
+    "train_checkpoint_bytes",
+    "total on-disk bytes of the last committed training checkpoint "
+    "(every file the integrity manifest covers)")

@@ -325,6 +325,48 @@ class MeshTableRuntime:
         serving path — this one syncs)."""
         return np.asarray(self.lookup(table, np.asarray(ids)))
 
+    # ------------------------------------------------------------------
+    # checkpoint surface: the sharded row/moment arrays ride
+    # TrainCheckpoint's shards/ path like any mesh-committed persistable
+    # (paddle_tpu.faults.checkpoint gathers/restores through these two)
+    # ------------------------------------------------------------------
+    def checkpoint_state(self) -> Dict[str, Dict[str, Any]]:
+        """``{entry name: {table, kind, array, height}}`` — every device
+        array the runtime owns, named for a checkpoint manifest: the row
+        array under the table's own name (kind ``mesh_table``) and the
+        optimizer moments under ``<table>#moments`` (kind
+        ``mesh_table_moments``).  Arrays are PADDED to the shard grid;
+        ``height`` is the real row count — rows past it are never read
+        by a lookup, so a restore may zero-fill them."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for name, tbl in sorted(self.tables.items()):
+            out[name] = {"table": name, "kind": "mesh_table",
+                         "array": tbl.array, "height": tbl.height}
+            if tbl.moments is not None:
+                out[name + "#moments"] = {
+                    "table": name, "kind": "mesh_table_moments",
+                    "array": tbl.moments, "height": tbl.height}
+        return out
+
+    def install_state(self, table: str, kind: str, array) -> None:
+        """Swap in a restored device array for ``table``'s rows or
+        moments.  The array must already be placed with the table's own
+        sharding/shape (the checkpoint restore re-places shard-wise onto
+        this runtime's mesh before calling)."""
+        tbl = self.tables[table]
+        expect = tbl.array.shape
+        if tuple(array.shape) != tuple(expect):
+            raise ValueError(
+                "restored %s for table %r has shape %s but the runtime "
+                "holds %s" % (kind, table, tuple(array.shape),
+                              tuple(expect)))
+        if kind == "mesh_table":
+            tbl.array = array
+        elif kind == "mesh_table_moments":
+            tbl.moments = array
+        else:
+            raise ValueError("unknown mesh-table state kind %r" % kind)
+
     def stats(self) -> Dict[str, Any]:
         return {
             "n_shards": self.n_shards,

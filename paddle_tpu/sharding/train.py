@@ -57,6 +57,10 @@ __all__ = [
     "state_bytes",
     "publish_state_bytes",
     "retire_state_bytes",
+    "box_overlap",
+    "box_volume",
+    "boxes_cover",
+    "shard_boxes",
 ]
 
 _KINDS = ("param", "grad", "moment")
@@ -212,6 +216,62 @@ def sharded_train_program(program, rules, optimizer=None,
                 else CompiledProgram(program))
     return compiled.with_sharding_rules(rules, mesh=mesh,
                                         mesh_axes=mesh_axes)
+
+
+# ---------------------------------------------------------------------------
+# shard-box algebra: index regions as ((start, stop), ...) per dim.
+#
+# The shard-exchange checkpoint restore (faults/checkpoint.py) and the
+# offline verifier (tools/check_checkpoint.py) both reason about which
+# saved shard regions tile which target device regions — one definition
+# of the interval math, so the runtime and the tool cannot drift.
+# ---------------------------------------------------------------------------
+def box_overlap(a, b):
+    """Intersection of two boxes (same rank), or None when disjoint on
+    any dim.  A box is ``((start, stop), ...)`` over the global shape."""
+    out = []
+    for (a0, a1), (b0, b1) in zip(a, b):
+        lo, hi = max(int(a0), int(b0)), min(int(a1), int(b1))
+        if lo >= hi:
+            return None
+        out.append((lo, hi))
+    return tuple(out)
+
+
+def box_volume(box) -> int:
+    n = 1
+    for lo, hi in box:
+        n *= max(0, int(hi) - int(lo))
+    return n
+
+
+def boxes_cover(boxes, target) -> bool:
+    """True iff ``boxes`` (pairwise-disjoint regions — a PartitionSpec
+    sharding's shard grid is) fully tile ``target``: the disjointness
+    makes overlap-volume summation an exact coverage test."""
+    vol = 0
+    for b in boxes:
+        ov = box_overlap(b, target)
+        if ov is not None:
+            vol += box_volume(ov)
+    return vol == box_volume(target)
+
+
+def shard_boxes(sharding, shape):
+    """``{box: [devices]}`` — each DISTINCT addressable shard region of
+    ``sharding`` over global ``shape`` and the local devices holding a
+    replica of it.  The shard-exchange restore assembles each box once
+    and ``device_put``s it per device."""
+    out: Dict = {}
+    for dev, idx in sharding.addressable_devices_indices_map(
+            tuple(int(d) for d in shape)).items():
+        box = []
+        for sl, dim in zip(idx, shape):
+            start = 0 if sl.start is None else int(sl.start)
+            stop = int(dim) if sl.stop is None else int(sl.stop)
+            box.append((start, stop))
+        out.setdefault(tuple(box), []).append(dev)
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,85 @@
+"""Shared choreography for the SIGKILL-during-background-save drills.
+
+Several chaos tests (sharded training, the cross-mesh resume chain,
+mesh-table checkpointing) stage the exact same sequence against a
+``_train_child.py`` subprocess: drain its pipes on threads, wait for
+the first COMMITTED checkpoint, wait for the NEXT save's staged
+``.tmp-`` directory (held open by an injected ``checkpoint.commit``
+delay), SIGKILL it in that window, and read back the last committed
+step.  One definition here so a change to the staging protocol (the
+``.tmp-`` prefix, the ``LATEST`` semantics) cannot drift between
+hand-copied loops.  Not a test module.
+"""
+import os
+import re
+import signal
+import threading
+import time
+
+# the layout protocol strings come from the ONE definition — a rename
+# of the staging prefix / pointer file must break loudly here, not
+# leave the drills waiting forever on a stale literal
+from paddle_tpu.faults.checkpoint import _LATEST, _TMP_PREFIX
+
+LOSS_RE = re.compile(r"batch (\d+): \{'loss': array\(([0-9.eE+-]+)")
+
+
+def parse_losses(lines):
+    """{global step: loss} out of the child's debug print stream."""
+    out = {}
+    for line in lines:
+        m = LOSS_RE.search(line)
+        if m:
+            out[int(m.group(1))] = float(m.group(2))
+    return out
+
+
+def drain(proc):
+    """Drain stdout+stderr on daemon threads (a chatty child — jax
+    logs on stderr — must never block on a full pipe before its first
+    checkpoint); returns the two growing line sinks."""
+    lines, err_lines = [], []
+
+    def _collect(stream, sink):
+        try:
+            for line in stream:
+                sink.append(line)
+        except Exception:
+            pass
+
+    threading.Thread(target=_collect, args=(proc.stdout, lines),
+                     daemon=True).start()
+    threading.Thread(target=_collect, args=(proc.stderr, err_lines),
+                     daemon=True).start()
+    return lines, err_lines
+
+
+def kill_mid_background_save(proc, run_dir, lines, err_lines,
+                             timeout=120):
+    """Wait for the first commit, then for the next save's staged
+    ``.tmp-`` dir, SIGKILL the child in that window; returns the last
+    COMMITTED step (the only one resume may trust)."""
+    try:
+        deadline = time.monotonic() + timeout
+        latest = os.path.join(run_dir, _LATEST)
+        while not os.path.exists(latest):
+            assert proc.poll() is None, (
+                "child died before its first checkpoint:\n"
+                + "".join(lines) + "".join(err_lines))
+            assert time.monotonic() < deadline, (
+                "no checkpoint within %ds" % timeout)
+            time.sleep(0.05)
+        while not any(d.startswith(_TMP_PREFIX)
+                      for d in os.listdir(run_dir)):
+            assert proc.poll() is None, (
+                "child died before staging its background save:\n"
+                + "".join(lines) + "".join(err_lines))
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGKILL)
+        assert proc.wait(timeout=30) == -9
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    with open(latest) as f:
+        return int(f.read().strip().rsplit("-", 1)[1])

@@ -4,7 +4,18 @@ under the retry budget; persistent non-retryable outages surface typed
 at the join), and the hot-id cache tier through a PS outage (hits keep
 serving, misses fail typed, and the brownout cache-only rung holds the
 endpoint available — typed and counted — until the PS heals).
+
+ISSUE 15 adds the mesh-table checkpoint drill: a child training
+through mesh-RESIDENT tables (``bind_mesh_tables``, adagrad moments)
+is SIGKILLed during a background save; resume must come up from the
+last COMPLETE checkpoint with loss continuity AND row-value parity
+against an uninterrupted golden run.
 """
+import os
+import re
+import subprocess
+import sys
+
 import numpy as np
 import pytest
 
@@ -13,6 +24,9 @@ from paddle_tpu import faults, framework, monitor
 from paddle_tpu.distributed.ps import ParameterServer, PSClient
 from paddle_tpu.serving.embedding_cache import EmbeddingRowCache
 from paddle_tpu.serving.errors import BackendUnavailable
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
 @pytest.fixture(autouse=True)
@@ -229,3 +243,107 @@ def test_inline_concurrent_pulls_propagate_worker_fault_typed():
             assert np.isfinite(float(np.asarray(l)))
     finally:
         server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Mesh-table checkpointing: SIGKILL during a background save → resume
+# ---------------------------------------------------------------------------
+sys.path.insert(0, os.path.join(REPO_ROOT, "tests", "chaos"))
+
+import _drill  # noqa: E402 — shared SIGKILL-mid-save choreography
+
+_parse_losses = _drill.parse_losses
+_ROWS_RE = re.compile(r"ROWS (\w+) ([0-9.eE+-]+) ([0-9.eE+-]+)")
+
+
+def _parse_rows(lines):
+    for line in lines:
+        m = _ROWS_RE.search(line)
+        if m:
+            return m.group(1), float(m.group(2)), float(m.group(3))
+    return None
+
+
+def _spawn_mt_child(run_dir, steps, step_delay, resume=False,
+                    commit_delay=None):
+    argv = [sys.executable, "-u",
+            os.path.join(REPO_ROOT, "tests", "chaos", "_train_child.py"),
+            "--run-dir", run_dir, "--steps", str(steps),
+            "--ckpt-every", "5", "--step-delay", str(step_delay),
+            "--async-ckpt", "--mesh-tables"]
+    if resume:
+        argv.append("--resume")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PADDLE_TPU_FAULTS", None)
+    if commit_delay is not None:
+        env["PADDLE_TPU_FAULTS"] = (
+            "checkpoint.commit=delay:%g,after=1" % commit_delay)
+    prev = env.get("PYTHONPATH")
+    env["PYTHONPATH"] = REPO_ROOT + (os.pathsep + prev if prev else "")
+    return subprocess.Popen(argv, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, env=env)
+
+
+def test_mesh_table_sigkill_during_background_save_resumes(tmp_path):
+    """The ISSUE 15 sparse drill: mesh-RESIDENT tables (rows + adagrad
+    moments, shard-wise in shards/) survive a SIGKILL during a
+    background save.  Resume comes up from the last COMPLETE
+    checkpoint; per-step losses AND the final table row values match an
+    uninterrupted golden run."""
+    import json as _json
+
+    run_dir = str(tmp_path / "run")
+    proc = _spawn_mt_child(run_dir, steps=400, step_delay=0.05,
+                           commit_delay=30.0)
+    lines, err_lines = _drill.drain(proc)
+    committed = _drill.kill_mid_background_save(proc, run_dir, lines,
+                                                err_lines)
+    killed = _parse_losses(lines)
+    assert committed == 5  # the stalled second save never committed
+
+    # the committed checkpoint carries the table SHARD-wise: rows AND
+    # moments, (48, 4) saved as two (24, 4) halves, kind-tagged
+    sdir = os.path.join(run_dir, "ckpt-%06d" % committed, "shards")
+    man = _json.load(open(os.path.join(sdir, "manifest.json")))
+    assert man["vars"]["mt_tbl"]["kind"] == "mesh_table"
+    assert man["vars"]["mt_tbl#moments"]["kind"] == "mesh_table_moments"
+    for key in ("mt_tbl", "mt_tbl#moments"):
+        ent = man["vars"][key]
+        assert ent["shape"] == [48, 4] and len(ent["shards"]) == 2
+        for doc in ent["shards"]:
+            assert np.load(os.path.join(sdir, doc["file"])).shape == (24, 4)
+
+    # golden: an UNINTERRUPTED run over the same horizon (fresh dir)
+    horizon = committed + 6
+    gold = _spawn_mt_child(str(tmp_path / "gold"), steps=horizon,
+                           step_delay=0.0)
+    gout, gerr = gold.communicate(timeout=180)
+    assert gold.returncode == 0, gerr
+    golden = _parse_losses(gout.splitlines())
+    gold_rows = _parse_rows(gout.splitlines())
+    assert gold_rows is not None
+
+    # resume: same run dir, same horizon
+    res = _spawn_mt_child(run_dir, steps=horizon, step_delay=0.0,
+                          resume=True)
+    out, err = res.communicate(timeout=180)
+    assert res.returncode == 0, err
+    assert ("RESUMED_FROM %d" % committed) in out
+    resumed = _parse_losses(out.splitlines())
+    assert min(resumed) == committed  # nothing before the cursor re-ran
+
+    # loss continuity: vs the killed run on its overlap, and vs the
+    # golden run on EVERY resumed step (rows + moments restored — a
+    # moment-less restore would re-diverge adagrad step sizes)
+    for step in sorted(set(killed) & set(resumed)):
+        np.testing.assert_allclose(resumed[step], killed[step], rtol=1e-4)
+    for step in sorted(resumed):
+        np.testing.assert_allclose(
+            resumed[step], golden[step], rtol=1e-4,
+            err_msg="divergence vs golden at step %d" % step)
+
+    # row-value parity: the resumed table IS the uninterrupted table
+    res_rows = _parse_rows(out.splitlines())
+    assert res_rows is not None and res_rows[0] == gold_rows[0]
+    np.testing.assert_allclose(res_rows[1:], gold_rows[1:], rtol=1e-5)

@@ -6,7 +6,6 @@ prefetch-thread death (``reader.prefetch``), and crash-resumable
 with loss-trajectory continuity.
 """
 import os
-import re
 import signal
 import subprocess
 import sys
@@ -21,6 +20,9 @@ from paddle_tpu import faults, framework, monitor, reader
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(REPO_ROOT, "tests", "chaos"))
+
+import _drill  # noqa: E402 — shared SIGKILL-mid-save choreography
 
 
 @pytest.fixture(autouse=True)
@@ -301,16 +303,7 @@ def _spawn_child(run_dir, steps, step_delay, resume=False):
                             stderr=subprocess.PIPE, text=True, env=env)
 
 
-_LOSS_RE = re.compile(r"batch (\d+): \{'loss': array\(([0-9.eE+-]+)")
-
-
-def _parse_losses(lines):
-    out = {}
-    for line in lines:
-        m = _LOSS_RE.search(line)
-        if m:
-            out[int(m.group(1))] = float(m.group(2))
-    return out
+_parse_losses = _drill.parse_losses
 
 
 def test_sigkill_then_resume_loss_continuity(tmp_path):
@@ -320,21 +313,9 @@ def test_sigkill_then_resume_loss_continuity(tmp_path):
     killed run's, so the trajectory is continuous, not restarted."""
     run_dir = str(tmp_path / "run")
     proc = _spawn_child(run_dir, steps=400, step_delay=0.15)
-    lines, err_lines = [], []
-
-    def _collect(stream, sink):
-        try:
-            for line in stream:
-                sink.append(line)
-        except Exception:
-            pass
-
     # both pipes drain on threads: a chatty child (jax logs on stderr)
     # must never block on a full pipe before its first checkpoint
-    threading.Thread(target=_collect, args=(proc.stdout, lines),
-                     daemon=True).start()
-    threading.Thread(target=_collect, args=(proc.stderr, err_lines),
-                     daemon=True).start()
+    lines, err_lines = _drill.drain(proc)
     try:
         # wait for the first committed checkpoint + two more steps
         deadline = time.monotonic() + 120
@@ -380,7 +361,7 @@ def test_sigkill_then_resume_loss_continuity(tmp_path):
 
 
 def _spawn_async_child(run_dir, steps, step_delay, resume=False,
-                       commit_delay=None, sharded=False):
+                       commit_delay=None, sharded=False, mesh=None):
     argv = [sys.executable, "-u",
             os.path.join(REPO_ROOT, "tests", "chaos", "_train_child.py"),
             "--run-dir", run_dir, "--steps", str(steps),
@@ -390,6 +371,8 @@ def _spawn_async_child(run_dir, steps, step_delay, resume=False,
         argv.append("--resume")
     if sharded:
         argv.append("--sharded")
+    if mesh is not None:
+        argv += ["--mesh", str(mesh)]
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PADDLE_TPU_FAULTS", None)
@@ -415,45 +398,13 @@ def test_sigkill_during_background_save_resumes_from_last_complete(
     run_dir = str(tmp_path / "run")
     proc = _spawn_async_child(run_dir, steps=400, step_delay=0.05,
                               commit_delay=30.0)
-    lines, err_lines = [], []
-
-    def _collect(stream, sink):
-        try:
-            for line in stream:
-                sink.append(line)
-        except Exception:
-            pass
-
-    threading.Thread(target=_collect, args=(proc.stdout, lines),
-                     daemon=True).start()
-    threading.Thread(target=_collect, args=(proc.stderr, err_lines),
-                     daemon=True).start()
-    try:
-        deadline = time.monotonic() + 120
-        latest = os.path.join(run_dir, "LATEST")
-        # first commit (step 5) goes through (the delay arms after=1)
-        while not os.path.exists(latest):
-            assert proc.poll() is None, (
-                "child died before its first checkpoint:\n"
-                + "".join(lines) + "".join(err_lines))
-            assert time.monotonic() < deadline, "no checkpoint within 120s"
-            time.sleep(0.05)
-        # the second save stages .tmp-ckpt-000010 and stalls in the
-        # injected commit delay — the kill window
-        while not any(d.startswith(".tmp-") for d in os.listdir(run_dir)):
-            assert proc.poll() is None, (
-                "child died before staging its background save:\n"
-                + "".join(lines) + "".join(err_lines))
-            assert time.monotonic() < deadline
-            time.sleep(0.05)
-        proc.send_signal(signal.SIGKILL)
-        assert proc.wait(timeout=30) == -9
-    finally:
-        if proc.poll() is None:
-            proc.kill()
+    # the first commit (step 5) goes through (the delay arms after=1);
+    # the second save stages .tmp-ckpt-000010 and stalls in the
+    # injected commit delay — the kill window
+    lines, err_lines = _drill.drain(proc)
+    committed = _drill.kill_mid_background_save(proc, run_dir, lines,
+                                                err_lines)
     killed = _parse_losses(lines)
-    with open(latest) as f:
-        committed = int(f.read().strip().rsplit("-", 1)[1])
     assert committed == 5  # the stalled step-10 save never committed
     assert any(d.startswith(".tmp-") for d in os.listdir(run_dir))
 
@@ -474,6 +425,151 @@ def test_sigkill_during_background_save_resumes_from_last_complete(
     assert not any(d.startswith(".tmp-") for d in os.listdir(run_dir))
 
 
+def test_cross_mesh_sigkill_resume_chain(tmp_path):
+    """ISSUE 15 topology-elasticity drill: SIGKILL an fsdp-2 child mid
+    background save, resume it on an fsdp-4 mesh (the shard-exchange
+    restore re-slices the saved halves), then resume THAT run's
+    checkpoint back on fsdp-2 — loss-trajectory continuity holds across
+    both mesh changes."""
+    run_dir = str(tmp_path / "run")
+    proc = _spawn_async_child(run_dir, steps=400, step_delay=0.05,
+                              commit_delay=30.0, sharded=True, mesh=2)
+    lines, err_lines = _drill.drain(proc)
+    committed = _drill.kill_mid_background_save(proc, run_dir, lines,
+                                                err_lines)
+    killed = _parse_losses(lines)
+    latest = os.path.join(run_dir, "LATEST")
+    assert committed == 5  # the stalled second save never committed
+
+    # leg 2: resume the fsdp-2 checkpoint on an fsdp-FOUR mesh; runs to
+    # step 10 and commits its own (fsdp-4) checkpoint there
+    res4 = _spawn_async_child(run_dir, steps=committed + 6,
+                              step_delay=0.0, resume=True, sharded=True,
+                              mesh=4)
+    out4, err4 = res4.communicate(timeout=180)
+    assert res4.returncode == 0, err4
+    assert ("RESUMED_FROM %d" % committed) in out4
+    resumed4 = _parse_losses(out4.splitlines())
+    assert min(resumed4) == committed
+    # continuity ACROSS the mesh change: overlapping steps match the
+    # killed fsdp-2 run exactly
+    overlap = sorted(set(killed) & set(resumed4))
+    assert overlap
+    for step in overlap:
+        np.testing.assert_allclose(
+            resumed4[step], killed[step], rtol=1e-4,
+            err_msg="divergence at cross-mesh resumed step %d" % step)
+    with open(latest) as f:
+        committed4 = int(f.read().strip().rsplit("-", 1)[1])
+    assert committed4 == 10  # the fsdp-4 leg committed its own
+
+    # leg 3: resume the fsdp-4 checkpoint BACK on fsdp-2
+    res2 = _spawn_async_child(run_dir, steps=committed4 + 4,
+                              step_delay=0.0, resume=True, sharded=True,
+                              mesh=2)
+    out2, err2 = res2.communicate(timeout=180)
+    assert res2.returncode == 0, err2
+    assert ("RESUMED_FROM %d" % committed4) in out2
+    resumed2 = _parse_losses(out2.splitlines())
+    assert min(resumed2) == committed4
+    overlap2 = sorted(set(resumed4) & set(resumed2))
+    for step in overlap2:
+        np.testing.assert_allclose(
+            resumed2[step], resumed4[step], rtol=1e-4,
+            err_msg="divergence at back-resumed step %d" % step)
+    assert all(np.isfinite(v) for v in resumed2.values())
+
+
+def test_corrupted_shard_falls_back_typed_and_training_resumes(tmp_path):
+    """The corrupted-shard drill: flip one byte in a shard file of the
+    newest checkpoint — restore detects it (content hash), falls back
+    typed+counted to the previous complete checkpoint, and training
+    RESUMES from it with the correct cursor.  The armed
+    ``checkpoint.restore`` fault point exercises the restore path the
+    same way ``checkpoint.commit`` does the save path: an injected
+    error surfaces typed from the resume, a delay only slows it."""
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu import sharding
+    from paddle_tpu.sharding.rules import PartitionRules
+
+    def build():
+        from paddle_tpu import unique_name
+
+        with unique_name.guard():
+            prog, startup = framework.Program(), framework.Program()
+            prog.random_seed = startup.random_seed = 17
+            with framework.program_guard(prog, startup):
+                x = fluid.layers.data("x", [4])
+                y = fluid.layers.data("y", [1])
+                pred = fluid.layers.fc(x, 1)
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(pred, y))
+                opt = fluid.optimizer.AdamOptimizer(0.05)
+                opt.minimize(loss)
+            compiled = sharding.sharded_train_program(
+                prog, PartitionRules([(r".", P("fsdp"))], name="c/fsdp"),
+                optimizer=opt, mesh_axes={"fsdp": 2})
+            return compiled, prog, startup, loss
+
+    run_dir = str(tmp_path / "run")
+    feeds = _feeds(12)
+    compiled, prog, startup, loss = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        out = exe.train_from_dataset(
+            program=compiled, dataset=feeds, scope=scope,
+            fetch_list=[loss], checkpoint_dir=run_dir, checkpoint_every=4)
+    golden = [float(np.asarray(o[0])) for o in out]
+
+    # flip one byte in a shard file of the NEWEST checkpoint (keep=2:
+    # ckpt-000008 and ckpt-000012 survive)
+    sdir = os.path.join(run_dir, "ckpt-000012", "shards")
+    victim = next(os.path.join(sdir, f) for f in sorted(os.listdir(sdir))
+                  if f.endswith(".npy"))
+    with open(victim, "r+b") as f:
+        f.seek(80)
+        b = f.read(1)
+        f.seek(80)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+    # an armed restore-side ERROR surfaces typed out of the resume
+    compiled2, prog2, startup2, loss2 = build()
+    fresh = fluid.Scope()
+    with fluid.scope_guard(fresh):
+        exe.run(startup2)
+        with faults.armed("checkpoint.restore=error:RuntimeError,times=1"):
+            with pytest.raises(RuntimeError, match="injected fault"):
+                exe.train_from_dataset(
+                    program=compiled2, dataset=feeds, scope=fresh,
+                    fetch_list=[loss2], resume_from=run_dir)
+
+    # healed (delay only): restore skips the corrupt ckpt-000012,
+    # lands typed+counted on ckpt-000008, and training continues from
+    # step 8 along the golden trajectory
+    c0 = monitor.counter_value("train_checkpoint_corruption_total")
+    f0 = monitor.counter_value("train_checkpoint_fallback_total")
+    compiled3, prog3, startup3, loss3 = build()
+    fresh2 = fluid.Scope()
+    with fluid.scope_guard(fresh2):
+        exe.run(startup3)
+        with faults.armed("checkpoint.restore=delay:0.01"):
+            out = exe.train_from_dataset(
+                program=compiled3, dataset=feeds, scope=fresh2,
+                fetch_list=[loss3], resume_from=run_dir)
+    assert exe.last_resume_step == 8
+    assert exe.last_restore_path.endswith("ckpt-000008")
+    assert exe.last_restore_fallbacks == 1
+    assert monitor.counter_value(
+        "train_checkpoint_corruption_total") == c0 + 1
+    assert monitor.counter_value(
+        "train_checkpoint_fallback_total") == f0 + 1
+    resumed = [float(np.asarray(o[0])) for o in out]
+    np.testing.assert_allclose(resumed, golden[8:], rtol=2e-4)
+
+
 def test_sharded_sigkill_during_background_save(tmp_path):
     """The SHARDED drill through the same ``checkpoint.commit`` fault
     point: the child trains fsdp-2 through the rules surface (Adam —
@@ -485,42 +581,10 @@ def test_sharded_sigkill_during_background_save(tmp_path):
     run_dir = str(tmp_path / "run")
     proc = _spawn_async_child(run_dir, steps=400, step_delay=0.05,
                               commit_delay=30.0, sharded=True)
-    lines, err_lines = [], []
-
-    def _collect(stream, sink):
-        try:
-            for line in stream:
-                sink.append(line)
-        except Exception:
-            pass
-
-    threading.Thread(target=_collect, args=(proc.stdout, lines),
-                     daemon=True).start()
-    threading.Thread(target=_collect, args=(proc.stderr, err_lines),
-                     daemon=True).start()
-    try:
-        deadline = time.monotonic() + 120
-        latest = os.path.join(run_dir, "LATEST")
-        while not os.path.exists(latest):
-            assert proc.poll() is None, (
-                "child died before its first checkpoint:\n"
-                + "".join(lines) + "".join(err_lines))
-            assert time.monotonic() < deadline, "no checkpoint within 120s"
-            time.sleep(0.05)
-        while not any(d.startswith(".tmp-") for d in os.listdir(run_dir)):
-            assert proc.poll() is None, (
-                "child died before staging its background save:\n"
-                + "".join(lines) + "".join(err_lines))
-            assert time.monotonic() < deadline
-            time.sleep(0.05)
-        proc.send_signal(signal.SIGKILL)
-        assert proc.wait(timeout=30) == -9
-    finally:
-        if proc.poll() is None:
-            proc.kill()
+    lines, err_lines = _drill.drain(proc)
+    committed = _drill.kill_mid_background_save(proc, run_dir, lines,
+                                                err_lines)
     killed = _parse_losses(lines)
-    with open(latest) as f:
-        committed = int(f.read().strip().rsplit("-", 1)[1])
     assert committed == 5  # the stalled second save never committed
     # the committed checkpoint IS shard-wise: per-shard files with
     # SHARD shapes (the fc weight (4,1) saved as two (2,1) halves)

@@ -357,6 +357,222 @@ def test_checkpoint_prune_orders_numerically_past_padding(tmp_path):
     assert ck.latest().endswith("ckpt-1500000")
 
 
+def test_dangling_latest_falls_back_to_remaining_checkpoints(tmp_path):
+    """Regression (ISSUE 15 small fix): a LATEST pointer naming a
+    pruned/missing checkpoint must fall back typed+counted through the
+    remaining complete checkpoints — not fail on the dangling pointer,
+    and not silently fresh-start while committed state exists."""
+    from paddle_tpu.faults.checkpoint import TrainCheckpoint
+
+    prog, startup, loss = _tiny_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    ck = TrainCheckpoint(str(tmp_path), keep=3)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for step in (5, 10):
+            ck.save(prog, scope, step=step)
+    # simulate a lost/pruned pointer target
+    import shutil
+
+    shutil.rmtree(str(tmp_path / "ckpt-000010"))
+    assert ck.latest() is None  # the pointer dangles...
+    f0 = monitor.counter_value("train_checkpoint_fallback_total")
+    r0 = monitor.counter_value("train_checkpoint_restore_total")
+    scope2 = fluid.Scope()
+    cursor = ck.restore(prog, scope2)  # ...but restore finds ckpt-000005
+    assert cursor["step"] == 5
+    assert ck.last_restore_path.endswith("ckpt-000005")
+    assert ck.last_restore_fallbacks == 1
+    assert monitor.counter_value("train_checkpoint_fallback_total") == f0 + 1
+    assert monitor.counter_value("train_checkpoint_restore_total") == r0 + 1
+
+    # with EVERY checkpoint dir gone but the pointer still there, the
+    # run's state was lost — typed, never a silent step-0 fresh start
+    from paddle_tpu.faults.checkpoint import CheckpointCorruptionError
+
+    shutil.rmtree(str(tmp_path / "ckpt-000005"))
+    with pytest.raises(CheckpointCorruptionError, match="no committed"):
+        ck.restore(prog, fluid.Scope())
+    # a genuinely fresh dir (no pointer, no checkpoints) stays None
+    os.remove(str(tmp_path / "LATEST"))
+    assert ck.restore(prog, fluid.Scope()) is None
+
+
+def test_integrity_manifest_covers_every_file_and_detects_tamper(
+        tmp_path):
+    """Every committed checkpoint carries integrity.json listing every
+    other file with size + sha256; verify_checkpoint_dir passes on a
+    clean dir and types a flipped byte, a truncation, a deleted file,
+    and an unlisted extra file as CheckpointCorruptionError."""
+    import json as _json
+
+    from paddle_tpu.faults.checkpoint import (
+        CheckpointCorruptionError,
+        TrainCheckpoint,
+        verify_checkpoint_dir,
+    )
+
+    prog, startup, _ = _tiny_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    ck = TrainCheckpoint(str(tmp_path))
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        path = ck.save(prog, scope, step=5)
+    with open(os.path.join(path, "integrity.json")) as f:
+        doc = _json.load(f)
+    on_disk = set()
+    for dirpath, _, fns in os.walk(path):
+        for fn in fns:
+            rel = os.path.relpath(os.path.join(dirpath, fn), path)
+            if rel != "integrity.json":
+                on_disk.add(rel.replace(os.sep, "/"))
+    assert set(doc["files"]) == on_disk and on_disk  # complete, both ways
+    verify_checkpoint_dir(path)  # clean: no raise
+    # the bytes gauge published the checkpoint's size at commit
+    total = sum(e["bytes"] for e in doc["files"].values())
+    got = monitor.counter_value("train_checkpoint_bytes")
+    assert got >= total  # + integrity.json itself
+
+    # flipped byte
+    victim = os.path.join(path, "cursor.json")
+    raw = open(victim, "rb").read()
+    with open(victim, "wb") as f:
+        f.write(bytes([raw[0] ^ 0xFF]) + raw[1:])
+    with pytest.raises(CheckpointCorruptionError, match="hash"):
+        verify_checkpoint_dir(path)
+    with open(victim, "wb") as f:
+        f.write(raw)  # heal
+    # truncation
+    with open(victim, "wb") as f:
+        f.write(raw[:-1])
+    with pytest.raises(CheckpointCorruptionError, match="bytes"):
+        verify_checkpoint_dir(path)
+    with open(victim, "wb") as f:
+        f.write(raw)
+    # deleted file
+    os.rename(victim, victim + ".bak")
+    with pytest.raises(CheckpointCorruptionError, match="missing"):
+        verify_checkpoint_dir(path)
+    os.rename(victim + ".bak", victim)
+    # unlisted extra file (post-commit tamper)
+    extra = os.path.join(path, "params", "smuggled.npy")
+    with open(extra, "w") as f:
+        f.write("x")
+    with pytest.raises(CheckpointCorruptionError, match="not in"):
+        verify_checkpoint_dir(path)
+    os.remove(extra)
+    verify_checkpoint_dir(path)
+
+    # a STRUCTURALLY malformed manifest (valid JSON, wrong shape) is
+    # the typed corruption too — an untyped KeyError/TypeError here
+    # would defeat the fallback chain
+    integ = os.path.join(path, "integrity.json")
+    good = open(integ).read()
+    for bad in ('{"algo": "sha256"}',
+                '{"algo": "sha256", "files": "nope"}',
+                '{"algo": "sha256", "files": {"cursor.json": {}}}',
+                '{"algo": "sha256", "files": {"cursor.json": 3}}'):
+        with open(integ, "w") as f:
+            f.write(bad)
+        with pytest.raises(CheckpointCorruptionError, match="malformed"):
+            verify_checkpoint_dir(path)
+    with open(integ, "w") as f:
+        f.write(good)
+    verify_checkpoint_dir(path)
+
+    # pre-integrity checkpoints (no manifest) pass unverified
+    os.remove(integ)
+    verify_checkpoint_dir(path)
+    cursor = ck.restore(prog, fluid.Scope())
+    assert cursor["step"] == 5
+
+
+def test_pre_integrity_load_failure_falls_back_typed(tmp_path):
+    """A checkpoint from before the integrity manifest existed has
+    nothing for the hash gate to check — but a damaged file in it must
+    STILL engage the fallback chain at load time (typed + counted),
+    never an untyped np.load crash over a half-restored scope."""
+    from paddle_tpu.faults.checkpoint import TrainCheckpoint
+
+    prog, startup, loss = _tiny_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    ck = TrainCheckpoint(str(tmp_path), keep=3)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ck.save(prog, scope, step=5)
+        path10 = ck.save(prog, scope, step=10)
+    # make ckpt-000010 look pre-integrity, then truncate a params file
+    os.remove(os.path.join(path10, "integrity.json"))
+    victim = next(os.path.join(path10, "params", f)
+                  for f in sorted(os.listdir(os.path.join(path10, "params")))
+                  if f.endswith(".npy"))
+    with open(victim, "r+b") as f:
+        f.truncate(10)
+    c0 = monitor.counter_value("train_checkpoint_corruption_total")
+    scope2 = fluid.Scope()
+    cursor = ck.restore(prog, scope2)
+    assert cursor["step"] == 5  # fell back past the damaged newest
+    assert ck.last_restore_fallbacks == 1
+    assert monitor.counter_value(
+        "train_checkpoint_corruption_total") == c0 + 1
+
+
+def test_executor_restore_bookkeeping_defaults_and_resets(tmp_path):
+    """A fresh Executor answers the restore-bookkeeping reads before
+    any epoch ran, and a plain (non-resume) run RESETS them — it must
+    not keep reporting a previous run's restore/fallbacks."""
+    exe = fluid.Executor(fluid.CPUPlace())
+    assert exe.last_resume_step is None
+    assert exe.last_restore_path is None
+    assert exe.last_restore_fallbacks == 0
+    assert exe.last_restore_stats is None
+
+    prog, startup, loss = _tiny_model()
+    run_dir = str(tmp_path / "run")
+    rng = np.random.RandomState(0)
+    feeds = [{"x": rng.rand(8, 4).astype("float32"),
+              "y": rng.rand(8, 1).astype("float32")} for _ in range(2)]
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.train_from_dataset(program=prog, dataset=feeds, scope=scope,
+                               fetch_list=[loss], checkpoint_dir=run_dir,
+                               checkpoint_every=2)
+        exe.train_from_dataset(program=prog, dataset=feeds, scope=scope,
+                               fetch_list=[loss], resume_from=run_dir)
+        assert exe.last_resume_step == 2
+        assert exe.last_restore_path.endswith("ckpt-000002")
+        # a plain run afterwards clears the stale restore report
+        exe.train_from_dataset(program=prog, dataset=feeds, scope=scope,
+                               fetch_list=[loss])
+        assert exe.last_resume_step is None
+        assert exe.last_restore_path is None
+        assert exe.last_restore_fallbacks == 0
+
+
+def test_restore_fault_point_arms_the_restore_path(tmp_path):
+    """checkpoint.restore mirrors checkpoint.commit on the read side:
+    an armed error fires out of restore() typed; healed, the same
+    restore succeeds."""
+    from paddle_tpu.faults.checkpoint import TrainCheckpoint
+
+    prog, startup, _ = _tiny_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    ck = TrainCheckpoint(str(tmp_path))
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ck.save(prog, scope, step=5)
+    with faults.armed("checkpoint.restore=error:RuntimeError,times=1"):
+        with pytest.raises(RuntimeError, match="injected fault"):
+            ck.restore(prog, fluid.Scope())
+        # healed after times=1: the very next restore works
+        assert ck.restore(prog, fluid.Scope())["step"] == 5
+
+
 def test_checkpoint_ps_tables_roundtrip(tmp_path):
     """PS rows restore by VALUE through the assign op — not replayed
     through the optimizer — into a fresh server."""

@@ -145,6 +145,96 @@ def test_mesh_table_bytes_and_zero_recompiles_mixed_batches():
         rt.close()
 
 
+def test_mesh_table_checkpoint_cross_mesh_restore(tmp_path):
+    """ISSUE 15: mesh-table rows AND adagrad moments ride
+    TrainCheckpoint shard-wise and restore onto a DIFFERENT shard
+    count — including a padded-height change (V=50 pads to 50 on mp-2
+    but 52 on mp-4) — with loss continuity vs an uninterrupted run and
+    row-value parity.  Restoring without the runtime bound is typed."""
+    import os
+
+    from paddle_tpu import unique_name
+    from paddle_tpu.faults.checkpoint import TrainCheckpoint
+
+    V, B = 50, 16
+    feeds = _feeds(V, B, 8, seed=6)
+    run_dir = str(tmp_path / "run")
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    def build(n):
+        with unique_name.guard():
+            prog, startup, loss = _emb_model(V=V, optimizer="adagrad",
+                                             seed=35)
+        compiled = CompiledProgram(prog).with_mesh(
+            mesh_lib.make_mesh({"mp": n}))
+        rt = bind_mesh_tables(compiled, optimizer="adagrad", lr=0.1,
+                              initializer="zeros")
+        return prog, startup, loss, compiled, rt
+
+    # golden: uninterrupted 8 steps on mp-2
+    prog, startup, loss, compiled, rt = build(2)
+    golden = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for f in feeds:
+            (l,) = exe.run(compiled, feed=dict(f), fetch_list=[loss])
+            golden.append(float(np.asarray(l)))
+        gold_rows = rt.rows("ctr_table", np.arange(V, dtype=np.int64))
+    rt.close()
+
+    # leg 1: mp-2, steps 0..4, checkpoint at 4
+    prog, startup, loss, compiled, rt = build(2)
+    leg1 = []
+    with fluid.scope_guard(fluid.Scope()) as s1:
+        exe.run(startup)
+        out = exe.train_from_dataset(
+            program=compiled, dataset=[dict(f) for f in feeds[:4]],
+            scope=s1, fetch_list=[loss], checkpoint_dir=run_dir,
+            checkpoint_every=4)
+    leg1 = [float(np.asarray(o[0])) for o in out]
+    rt.close()
+    # the checkpoint carries the table shard-wise: padded (50, 6) rows
+    # as two (25, 6) halves, kind-tagged, moments alongside
+    import json as _json
+
+    sdir = os.path.join(run_dir, "ckpt-000004", "shards")
+    man = _json.load(open(os.path.join(sdir, "manifest.json")))
+    assert man["vars"]["ctr_table"]["kind"] == "mesh_table"
+    assert man["vars"]["ctr_table"]["height"] == V
+    assert man["vars"]["ctr_table#moments"]["kind"] == "mesh_table_moments"
+    for doc in man["vars"]["ctr_table"]["shards"]:
+        assert np.load(os.path.join(sdir, doc["file"])).shape == (25, 6)
+
+    # restoring WITHOUT a runtime bound is typed, not a silent skip
+    with unique_name.guard():
+        bare_prog, bare_startup, _ = _emb_model(V=V, optimizer="adagrad",
+                                                seed=35)
+    with fluid.scope_guard(fluid.Scope()) as sb:
+        exe.run(bare_startup)
+        with pytest.raises(ValueError, match="bind_mesh_tables"):
+            TrainCheckpoint(run_dir).restore(bare_prog, sb)
+
+    # leg 2: resume on mp-FOUR (padded height grows 50 -> 52; the
+    # exchange re-slices the halves into quarters, zero-fills padding)
+    prog4, startup4, loss4, compiled4, rt4 = build(4)
+    assert rt4.tables["ctr_table"].padded_height == 52
+    with fluid.scope_guard(fluid.Scope()) as s2:
+        exe.run(startup4)
+        out = exe.train_from_dataset(
+            program=compiled4, dataset=[dict(f) for f in feeds],
+            scope=s2, fetch_list=[loss4], resume_from=run_dir)
+        assert exe.last_resume_step == 4
+        leg2 = [float(np.asarray(o[0])) for o in out]
+        rows4 = rt4.rows("ctr_table", np.arange(V, dtype=np.int64))
+    rt4.close()
+
+    # the chain IS the uninterrupted trajectory (moments included —
+    # adagrad would re-diverge step sizes on a moment-less restore)...
+    np.testing.assert_allclose(leg1 + leg2, golden, rtol=2e-4, atol=1e-6)
+    # ...and the final table row values match the uninterrupted run's
+    np.testing.assert_allclose(rows4, gold_rows, rtol=1e-4, atol=1e-6)
+
+
 def test_mesh_table_requires_compiled_run():
     """A mesh-resident table's lookup is mesh-committed: running the
     program UNCOMPILED is a typed error at prefetch, not a jax device
