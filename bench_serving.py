@@ -67,6 +67,36 @@ the slot pool's bucket ladders keep the compiled-shape set closed).
 Env knobs: BENCH_DECODE_REQUESTS (default 24), BENCH_DECODE_SLOTS
 (default 8), BENCH_DECODE_STEPS (per tick, default 4).
 
+Since decode tier 2 the ``--decode`` line also carries the three
+independently toggleable decode-tier-2 legs, each measured against its
+own off-baseline on the same staggered drill:
+
+* ``prefix_cache``: ten requests sharing a 48-token prompt prefix,
+  submitted staggered (each waits its result so the freed slot's
+  prefix KV is offered before the next probe) against a server with
+  and without a :class:`serving.prefix_cache.PrefixKVCache` — the
+  prefill-token counter must drop >= 50% with the cache on (asserted),
+  and TTFT p50 rides the line for both.
+* ``speculative``: the same prompts decoded with and without
+  draft-then-verify rounds on ONE server at ``steps_per_tick=1`` (the
+  dispatch-bound regime a k-wide accepted run amortizes), using a
+  unigram transition-table draft distilled from the baseline pass's
+  own greedy rollouts.  Greedy-exact acceptance pins parity — the
+  speculative pass must emit bit-identical sequences (asserted) — and
+  the line reports tokens/s both ways plus the acceptance telemetry.
+* ``affinity``: a REAL 2-child wire fleet hosting one saved decode
+  endpoint with per-child prefix caches, driven by returning
+  "sessions" (prompts sharing a per-session head) through a
+  prefix-affinity balancer and a plain least-loaded one — per-child
+  ``/healthz`` prefix-cache hit deltas, fleet ``affinity_hits``, and
+  both children's ``/statusz`` jit-cache misses (must be 0; asserted)
+  ride the line.
+
+Env knobs: BENCH_DECODE_PREFIX_REQUESTS (default 10),
+BENCH_DECODE_SPEC_REQUESTS / BENCH_DECODE_SPEC_GEN /
+BENCH_DECODE_SPEC_K (default 8/24/8), BENCH_DECODE_AFFINITY_SESSIONS /
+BENCH_DECODE_AFFINITY_ROUNDS (default 4/3).
+
 ``--sharded`` (or $BENCH_SERVING_SHARDED=1) benches MODEL-PARALLEL
 serving (``paddle_tpu.sharding``): the same transformer-LM endpoint
 served replicated vs as a 2-way tp group on the 8-device CPU mesh
@@ -756,6 +786,240 @@ def _decode_workload(rng, n, max_seq_len):
     return reqs
 
 
+# target-LM dims shared by the decode legs (the tier-2 legs rebuild
+# draft/verify fns and the fleet endpoint around the same weights)
+_DEC_DIMS = (512, 64, 2, 4, 128, 64)  # V, D, L, H, DI, max_seq_len
+
+
+def _decode_prefix_drill(srv, prefix, suffixes, gen=4):
+    """The staggered shared-prefix drill: sequential requests (each
+    waits its result, so the freed slot's prefix KV is offered before
+    the next prompt probes).  Returns (prefill-token delta, sorted
+    TTFT list) — the on/off comparison runs this twice."""
+    d0 = int(srv.metrics()["decode"]["prefill_tokens"])
+    ttfts = []
+    for sfx in suffixes:
+        prompt = np.concatenate([prefix, sfx]).astype(np.int32)
+        r = srv.submit({"tokens": prompt}, max_new_tokens=gen)
+        r.result(timeout=300.0)
+        ttfts.append(r.first_token_t - r.submit_t)
+        time.sleep(0.02)  # let the release tick offer the prefix KV
+    ttfts.sort()
+    return int(srv.metrics()["decode"]["prefill_tokens"]) - d0, ttfts
+
+
+def _decode_spec_block(state, spec_prompts, spec_gen, refs, rollouts):
+    """The speculative leg: distill a unigram transition-table draft
+    from the baseline pass's OWN greedy rollouts (the cheapest draft
+    that still tracks the target — ~70% of this LM's greedy transitions
+    are last-token-predictable), then decode the same prompts with and
+    without draft-then-verify on one server at ``steps_per_tick=1``,
+    the dispatch-bound regime where a k-wide accepted run amortizes
+    scheduler dispatches.  Greedy-exact acceptance pins parity: both
+    passes must emit sequences bit-identical to ``refs``."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.decoding import (
+        make_transformer_lm_pooled_step_fn,
+        make_transformer_lm_pooled_verify_fn,
+    )
+    from paddle_tpu.serving.decode import DecodeServer
+    from paddle_tpu.serving.speculative import SpeculativeConfig
+
+    V, D, L, H, DI, ML = _DEC_DIMS
+    k = int(os.environ.get("BENCH_DECODE_SPEC_K", "8"))
+    counts = {}
+    for seq in rollouts:
+        for a, b in zip(seq[:-1].tolist(), seq[1:].tolist()):
+            row = counts.setdefault(a, {})
+            row[b] = row.get(b, 0) + 1
+    table_np = np.zeros((V,), np.int32)
+    for a, nxt in counts.items():
+        table_np[a] = max(nxt.items(), key=lambda kv: kv[1])[0]
+    table = jnp.asarray(table_np)
+
+    def draft_step_fn(cache, tok, ts):
+        # one table lookup as logits — argmax lands on table[tok]
+        return jax.nn.one_hot(table[tok], V, dtype=jnp.float32) * 10.0, cache
+
+    step_fn, make_cache = make_transformer_lm_pooled_step_fn(
+        state, V, D, L, H, DI)
+    verify_fn = make_transformer_lm_pooled_verify_fn(
+        state, V, D, L, H, DI)
+    spec = SpeculativeConfig(
+        verify_fn, draft_step_fn,
+        lambda s, t: {"bias": jnp.zeros((s, 1), jnp.float32)}, k=k)
+    srv = DecodeServer(step_fn, make_cache, eos_id=1, max_seq_len=ML,
+                       max_slots=4, steps_per_tick=1,
+                       name="bench-decode-spec", speculative=spec)
+    warm = srv.warmup()
+
+    def one_pass(speculative):
+        g0 = int(srv.metrics()["decode"]["generated_tokens"])
+        t0 = time.perf_counter()
+        outs = []
+        for g in range(0, len(spec_prompts), 4):
+            grp = [srv.submit({"tokens": p}, max_new_tokens=spec_gen,
+                              speculative=speculative)
+                   for p in spec_prompts[g:g + 4]]
+            outs.extend(np.asarray(r.result(timeout=300.0)[0])
+                        for r in grp)
+        elapsed = time.perf_counter() - t0
+        toks = int(srv.metrics()["decode"]["generated_tokens"]) - g0
+        return outs, toks / elapsed
+
+    base_outs, base_tps = one_pass(False)
+    spec_outs, spec_tps = one_pass(True)
+    sm = srv.metrics()
+    telemetry = dict(sm["decode"].get("speculative") or {})
+    recompiles = int(sm.get("recompiles", 0))
+    srv.stop(drain=True, timeout=60.0)
+    for ref, b_out, s_out in zip(refs, base_outs, spec_outs):
+        if not (np.array_equal(ref, b_out) and np.array_equal(ref, s_out)):
+            raise AssertionError(
+                "speculative decode broke greedy parity: ref=%r base=%r "
+                "spec=%r" % (ref.tolist(), b_out.tolist(), s_out.tolist()))
+    if recompiles:
+        raise AssertionError(
+            "speculative server recompiled after warmup: %d" % recompiles)
+    telemetry.update(
+        steps_per_tick=1,
+        baseline_tokens_per_s=round(base_tps, 1),
+        speculative_tokens_per_s=round(spec_tps, 1),
+        speedup=round(spec_tps / max(1e-9, base_tps), 2),
+        parity=True,
+        warmup_compiles=int(warm),
+        recompiles=recompiles)
+    return telemetry
+
+
+def _decode_affinity_fleet_block(state):
+    """The cache-affinity leg: a REAL 2-child fleet hosting one saved
+    decode endpoint with a per-child prefix KV cache, driven by
+    returning "sessions" (prompts sharing a per-session head).  With
+    prefix affinity ON the balancer re-routes a returning prefix hash
+    to the child whose cache last served it (a bounded tie-break that
+    never defeats load balancing); OFF, least-loaded routing scatters
+    the sessions across children and the child-side caches miss.  Each
+    phase uses DISJOINT session prefixes so both start cold."""
+    from paddle_tpu.serving import wire
+    from paddle_tpu.serving.decode import save_decode_endpoint
+
+    V, D, L, H, DI, ML = _DEC_DIMS
+    sessions = int(os.environ.get("BENCH_DECODE_AFFINITY_SESSIONS", "4"))
+    rounds = int(os.environ.get("BENCH_DECODE_AFFINITY_ROUNDS", "3"))
+
+    def drill(fb, bases):
+        ttfts, toks = [], [0]
+        lock = threading.Lock()
+
+        def session(si):
+            srng = np.random.RandomState(1000 + si)
+            for r_i in range(rounds):
+                sfx = srng.randint(3, 400, 2 + r_i).astype(np.int32)
+                prompt = np.concatenate([bases[si], sfx])
+                t0 = time.perf_counter()
+                first, n = None, 0
+                for c in fb.infer_stream({"tokens": prompt},
+                                         max_new_tokens=4):
+                    if first is None:
+                        first = time.perf_counter() - t0
+                    n += int(np.asarray(c).reshape(-1).size)
+                with lock:
+                    ttfts.append(first)
+                    toks[0] += n
+                time.sleep(0.05)  # freed slot offers its prefix KV
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=session, args=(i,))
+                   for i in range(sessions)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        ttfts.sort()
+        return {
+            "tokens_per_s": round(toks[0] / elapsed, 1),
+            "ttft_ms_p50": round(ttfts[len(ttfts) // 2] * 1e3, 2),
+            "ttft_ms_p99": round(
+                ttfts[min(len(ttfts) - 1,
+                          int(len(ttfts) * 0.99))] * 1e3, 2),
+            "requests": len(ttfts),
+        }
+
+    rng = np.random.RandomState(11)
+    bases_off = [rng.randint(3, 400, 32).astype(np.int32)
+                 for _ in range(sessions)]
+    bases_on = [rng.randint(3, 400, 32).astype(np.int32)
+                for _ in range(sessions)]
+    with tempfile.TemporaryDirectory() as tmp:
+        d = os.path.join(tmp, "lm-decode-affinity")
+        save_decode_endpoint(
+            d, state, vocab_size=V, d_model=D, n_layer=L, n_head=H,
+            d_inner=DI, eos_id=1, max_seq_len=ML, max_slots=4,
+            steps_per_tick=4, prefix_cache_bytes=16 << 20)
+        fleet = wire.FleetBalancer.from_launch(
+            d, 2, name="decode-affinity", prefix_affinity=True)
+        try:
+            warmup_compiles = fleet.warmup()
+
+            def child_cache_stats():
+                out = {}
+                for be in fleet._backends:
+                    h = be.transport.get_json("/healthz")
+                    out[be.name] = dict(h.get("prefix_cache") or {})
+                return out
+
+            # OFF phase: a plain least-loaded balancer over the SAME
+            # children (bare addresses — no relaunch, same warm caches)
+            fb_off = wire.FleetBalancer(
+                [(be.handle.host, be.handle.port)
+                 for be in fleet._backends],
+                name="decode-affinity-off", prefix_affinity=False)
+            try:
+                c0 = child_cache_stats()
+                off = drill(fb_off, bases_off)
+            finally:
+                fb_off.stop()
+            c1 = child_cache_stats()
+            on = drill(fleet, bases_on)
+            c2 = child_cache_stats()
+
+            def hit_delta(a, b):
+                return sum(int(b[n].get("hits", 0)) - int(a[n].get("hits", 0))
+                           for n in b)
+
+            off["child_prefix_hits"] = hit_delta(c0, c1)
+            on["child_prefix_hits"] = hit_delta(c1, c2)
+            on["affinity_hits"] = sum(
+                s["affinity_hits"]
+                for s in fleet.backend_stats().values())
+            if on["affinity_hits"] <= 0:
+                raise AssertionError(
+                    "prefix-affinity fleet recorded no affinity hits")
+            recompiles = {}
+            for be in fleet._backends:
+                st = be.transport.get_json("/statusz")
+                recompiles[be.name] = int(st["jit_cache"]["misses"])
+            if any(recompiles.values()):
+                raise AssertionError(
+                    "decode-affinity fleet recompiled after warmup: %r"
+                    % recompiles)
+            return {
+                "children": 2,
+                "sessions": sessions,
+                "rounds": rounds,
+                "affinity_on": on,
+                "affinity_off": off,
+                "warmup_compiles": int(warmup_compiles),
+                "jit_misses_after_warmup": recompiles,
+            }
+        finally:
+            fleet.stop(shutdown_backends=True)
+
+
 def run_decode():
     """The ``--decode`` line: token-level scheduling, measured."""
     import jax
@@ -772,7 +1036,7 @@ def run_decode():
     n_requests = int(os.environ.get("BENCH_DECODE_REQUESTS", "24"))
     max_slots = int(os.environ.get("BENCH_DECODE_SLOTS", "8"))
     steps = int(os.environ.get("BENCH_DECODE_STEPS", "4"))
-    V, D, L, H, DI, ML = 512, 64, 2, 4, 128, 64
+    V, D, L, H, DI, ML = _DEC_DIMS
     rng = np.random.RandomState(0)
     state = random_transformer_lm_state(rng, V, D, L, H, DI, ML)
     step_fn, make_cache = make_transformer_lm_pooled_step_fn(
@@ -841,9 +1105,69 @@ def run_decode():
     late_ttft_ms = (late.first_token_t - late.submit_t) * 1e3
 
     m = srv.metrics()
-    recompiles = int(m.get("recompiles", 0))
     d = m["decode"]
+
+    # --- decode tier 2: prefix-cache OFF leg + the speculative leg's
+    # baseline rollouts, both on the (cache-less) main server ---------
+    rng2 = np.random.RandomState(7)
+    n_prefix = int(os.environ.get("BENCH_DECODE_PREFIX_REQUESTS", "10"))
+    shared = rng2.randint(3, 400, 48).astype(np.int32)
+    suffixes = [rng2.randint(3, 400, 2 + i % 4).astype(np.int32)
+                for i in range(n_prefix)]
+    off_prefill, off_ttfts = _decode_prefix_drill(srv, shared, suffixes)
+
+    spec_n = int(os.environ.get("BENCH_DECODE_SPEC_REQUESTS", "8"))
+    spec_gen = int(os.environ.get("BENCH_DECODE_SPEC_GEN", "24"))
+    spec_prompts = [rng2.randint(3, 400, 4 + i % 5).astype(np.int32)
+                    for i in range(spec_n)]
+    refs, rollouts = [], []
+    for g in range(0, spec_n, max_slots):
+        grp = [srv.submit({"tokens": p}, max_new_tokens=spec_gen)
+               for p in spec_prompts[g:g + max_slots]]
+        for p, r in zip(spec_prompts[g:g + max_slots], grp):
+            out = np.asarray(r.result(timeout=300.0)[0])
+            refs.append(out)
+            rollouts.append(np.concatenate([p, out]))
+    recompiles = int(srv.metrics().get("recompiles", 0))
     srv.stop(drain=True, timeout=60.0)
+
+    # prefix-cache ON leg: the same staggered drill against a server
+    # whose freed slots offer their prefix KV for shared-prefix admits
+    psrv = DecodeServer(step_fn, make_cache, eos_id=1, max_seq_len=ML,
+                        max_slots=max_slots, steps_per_tick=steps,
+                        name="bench-decode-prefix",
+                        prefix_cache=32 << 20)
+    prefix_warm = psrv.warmup()
+    on_prefill, on_ttfts = _decode_prefix_drill(psrv, shared, suffixes)
+    pm = psrv.metrics()
+    prefix_stats = dict(pm["decode"].get("prefix_cache") or {})
+    prefix_recompiles = int(pm.get("recompiles", 0))
+    psrv.stop(drain=True, timeout=60.0)
+    prefill_cut = 1.0 - on_prefill / max(1, off_prefill)
+    if prefill_cut < 0.5:
+        raise AssertionError(
+            "shared-prefix cache cut prefill tokens by only %.0f%% "
+            "(off=%d on=%d) — the acceptance bar is >= 50%%"
+            % (prefill_cut * 100, off_prefill, on_prefill))
+    if prefix_recompiles:
+        raise AssertionError(
+            "prefix-cache server recompiled after warmup: %d"
+            % prefix_recompiles)
+    prefix_block = {
+        "requests": n_prefix,
+        "prefill_tokens_off": off_prefill,
+        "prefill_tokens_on": on_prefill,
+        "prefill_cut": round(prefill_cut, 3),
+        "ttft_ms_p50_off": round(off_ttfts[len(off_ttfts) // 2] * 1e3, 2),
+        "ttft_ms_p50_on": round(on_ttfts[len(on_ttfts) // 2] * 1e3, 2),
+        "cache": prefix_stats,
+        "warmup_compiles": int(prefix_warm),
+        "recompiles": prefix_recompiles,
+    }
+
+    spec_block = _decode_spec_block(
+        state, spec_prompts, spec_gen, refs, rollouts)
+    affinity_block = _decode_affinity_fleet_block(state)
     ttfts.sort()
     cont_tps = cont_tokens / cont_s
     rat_tps = rat_tokens / rat_s
@@ -867,6 +1191,9 @@ def run_decode():
         "warmup_compiles": compiles,
         "warmup_s": round(warmup_s, 1),
         "recompiles": recompiles,
+        "prefix_cache": prefix_block,
+        "speculative": spec_block,
+        "affinity": affinity_block,
         "platform": jax.devices()[0].platform,
     }
 

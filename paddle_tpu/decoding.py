@@ -33,6 +33,8 @@ __all__ = [
     "beam_search_cached", "greedy_search_cached",
     "make_transformer_lm_step_fn",
     "make_transformer_lm_pooled_step_fn", "make_slot_decode_fns",
+    "make_transformer_lm_pooled_verify_fn", "make_prefix_admit_fn",
+    "kv_leaf_seq_axis",
     "random_transformer_lm_state",
 ]
 
@@ -375,10 +377,88 @@ def make_transformer_lm_pooled_step_fn(
     return step_fn, make_cache
 
 
+def make_transformer_lm_pooled_verify_fn(
+    state,
+    vocab_size: int,
+    d_model: int,
+    n_layer: int,
+    n_head: int,
+    d_inner: int,
+    name: str = "lm",
+):
+    """The K-wide teacher-forced forward for speculative verification.
+
+    ``verify_fn(cache, tokens [S, K] int32, ts [S] int32) -> (logits
+    [S, K, V], cache)``: row ``i`` consumes ``tokens[i, j]`` at position
+    ``ts[i] + j`` for every ``j`` in ONE call — exactly the math of K
+    sequential :func:`make_transformer_lm_pooled_step_fn` steps (same
+    weights dict, same post-LN/gelu blocks), with causal masking among
+    the K fresh positions, so ``argmax(logits[i, j])`` is bit-identical
+    to the token the sequential path would produce after consuming
+    ``tokens[i, :j + 1]``.  That equality is what makes greedy-exact
+    speculative acceptance output-identical (parity-pinned in
+    tests/test_prefix_cache.py).
+
+    Positions are clamped to the cache T axis like the sequential step
+    clamps its buffer indices; a clamped lane is garbage-in-garbage-out
+    but such lanes are inactive/finished and their results are never
+    committed.  The K fresh K/V rows are scattered into the cache BEFORE
+    attention (write-before-read, same invariant as the pooled step), so
+    position ``ts + j`` attends to the just-written rows ``ts .. ts + j``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    d_head = d_model // n_head
+    W = {k: jnp.asarray(v) for k, v in state.items()}
+    scale = 1.0 / float(np.sqrt(d_head))
+
+    def verify_fn(cache, tokens, ts):
+        S, K = tokens.shape
+        T = cache[0]["k"].shape[2]
+        p = jnp.minimum(ts[:, None] + jnp.arange(K)[None, :], T - 1)
+        x = W[name + "_word_emb"][tokens] + W[name + "_pos_emb"][p]
+        sel = (jnp.arange(T)[None, None, :] == p[:, :, None])  # [S,K,T]
+        touched = sel.any(axis=1)[:, None, :, None]            # [S,1,T,1]
+        pos_ok = (jnp.arange(T)[None, None, None, :]
+                  <= p[:, :, None, None])                      # [S,K,1,T]
+        new_cache = []
+        for i in range(n_layer):
+            pfx = "%s_dec_%d" % (name, i)
+            q = _fc(W, x, pfx + "_att_q").reshape(S, K, n_head, d_head)
+            k = _fc(W, x, pfx + "_att_k").reshape(S, K, n_head, d_head)
+            v = _fc(W, x, pfx + "_att_v").reshape(S, K, n_head, d_head)
+            # scatter the K fresh rows at positions p: the one-hot
+            # einsum reduces to an exact copy for the (distinct) live
+            # positions; clamp collisions only happen on lanes past
+            # their buffer, whose rows are never read back
+            selk = sel.astype(k.dtype)
+            kc = jnp.where(touched,
+                           jnp.einsum("skt,skhd->shtd", selk, k),
+                           cache[i]["k"])
+            vc = jnp.where(touched,
+                           jnp.einsum("skt,skhd->shtd", selk, v),
+                           cache[i]["v"])
+            new_cache.append({"k": kc, "v": vc})
+            scores = jnp.einsum("skhd,shtd->skht", q, kc) * scale
+            scores = jnp.where(pos_ok, scores, -1e9)
+            w = jax.nn.softmax(scores, axis=-1)
+            ctx = jnp.einsum("skht,shtd->skhd", w, vc).reshape(S, K, d_model)
+            att = _fc(W, ctx, pfx + "_att_out")
+            x = _ln(W, x + att, pfx + "_ln1")
+            h = jax.nn.gelu(_fc(W, x, pfx + "_ffn_fc0"), approximate=False)
+            x = _ln(W, x + _fc(W, h, pfx + "_ffn_fc1"), pfx + "_ln2")
+        logits = _fc(W, x, name + "_head")
+        return logits, new_cache
+
+    return verify_fn
+
+
 # ---------------------------------------------------------------------------
 # Slot-pool decode: the fused multi-token chunk + admit executables
 # ---------------------------------------------------------------------------
-def make_slot_decode_fns(step_fn, eos_id: int, steps: int):
+def make_slot_decode_fns(step_fn, eos_id: int, steps: int,
+                         draft_step_fn=None):
     """Build the three pure functions the serving slot pool compiles per
     (slot-rung, length-rung) pair: ``chunk(state) -> state`` advancing
     every active slot by up to ``steps`` tokens in ONE device dispatch
@@ -408,6 +488,16 @@ def make_slot_decode_fns(step_fn, eos_id: int, steps: int):
     or reaches ``total_len``; inactive slots are fully masked (their
     ``pos`` does not advance) and cost only the wasted lane math the
     bucket ladder already prices in.
+
+    Extra state leaves pass through untouched (dict-copy semantics), so
+    the speculative pool's ``spec`` flag and ``draft_cache`` ride the
+    same executables.  With ``draft_step_fn`` the plain chunk also
+    teacher-forces each consumed token through the draft model, keeping
+    ``state["draft_cache"]`` position-synced with the target — a slot
+    that alternates plain and speculative rounds never sees a stale
+    draft cache (write-before-read covers the rest).  ``admit`` grows an
+    optional trailing ``spec_flag`` scalar marking the seated slot
+    speculative.
     """
     import jax
     import jax.numpy as jnp
@@ -429,52 +519,135 @@ def make_slot_decode_fns(step_fn, eos_id: int, steps: int):
             jnp.where(do_write, nxt, cur))
         newly_fin = do_write & (
             (nxt == eos_id) | ((pos + 2) >= state["total_len"]))
-        return {
-            "cache": cache,
-            "tokens": tokens,
-            "pos": jnp.where(active, pos + 1, pos),
-            "prompt_len": state["prompt_len"],
-            "total_len": state["total_len"],
-            "active": active & ~newly_fin,
-            "finished": state["finished"] | newly_fin,
-            "n_gen": state["n_gen"] + do_write.astype("int32"),
-        }
+        out = dict(state)
+        out.update(
+            cache=cache,
+            tokens=tokens,
+            pos=jnp.where(active, pos + 1, pos),
+            active=active & ~newly_fin,
+            finished=state["finished"] | newly_fin,
+            n_gen=state["n_gen"] + do_write.astype("int32"))
+        if draft_step_fn is not None:
+            _, out["draft_cache"] = draft_step_fn(
+                state["draft_cache"], tok_in, pos)
+        return out
 
     def chunk(state):
         return jax.lax.fori_loop(0, steps, _body, state)
 
-    def admit(state, slot_mask, prompt, prompt_len, total_len):
+    def admit(state, slot_mask, prompt, prompt_len, total_len,
+              spec_flag=None):
         # slot_mask [S] bool (one admitted slot), prompt [T] int32
         # (padded host-side), prompt_len/total_len () int32 scalars.
         # The cache passes through UNTOUCHED: the write-before-read
         # invariant (see make_transformer_lm_pooled_step_fn) makes
         # zeroing a reused slot's rows unnecessary.
         mask = slot_mask
-        return {
-            "cache": state["cache"],
-            "tokens": jnp.where(mask[:, None], prompt[None, :],
-                                state["tokens"]),
-            "pos": jnp.where(mask, 0, state["pos"]),
-            "prompt_len": jnp.where(mask, prompt_len, state["prompt_len"]),
-            "total_len": jnp.where(mask, total_len, state["total_len"]),
-            "active": state["active"] | mask,
-            "finished": state["finished"] & ~mask,
-            "n_gen": jnp.where(mask, 0, state["n_gen"]),
-        }
+        out = dict(state)
+        out.update(
+            tokens=jnp.where(mask[:, None], prompt[None, :],
+                             state["tokens"]),
+            pos=jnp.where(mask, 0, state["pos"]),
+            prompt_len=jnp.where(mask, prompt_len, state["prompt_len"]),
+            total_len=jnp.where(mask, total_len, state["total_len"]),
+            active=state["active"] | mask,
+            finished=state["finished"] & ~mask,
+            n_gen=jnp.where(mask, 0, state["n_gen"]))
+        if spec_flag is not None:
+            out["spec"] = jnp.where(mask, spec_flag, state["spec"])
+        return out
 
     def release(state, slot_mask):
         # deactivate without finishing: the slot becomes seatable again
         # (its request was aborted host-side); tokens/cache stay — the
         # write-before-read invariant protects the next occupant
-        return {
-            "cache": state["cache"],
-            "tokens": state["tokens"],
-            "pos": state["pos"],
-            "prompt_len": state["prompt_len"],
-            "total_len": state["total_len"],
-            "active": state["active"] & ~slot_mask,
-            "finished": state["finished"] & ~slot_mask,
-            "n_gen": state["n_gen"],
-        }
+        out = dict(state)
+        out.update(
+            active=state["active"] & ~slot_mask,
+            finished=state["finished"] & ~slot_mask)
+        return out
 
     return chunk, admit, release
+
+
+# ---------------------------------------------------------------------------
+# Prefix KV installation (serving.prefix_cache's device half)
+# ---------------------------------------------------------------------------
+def kv_leaf_seq_axis(shape, n_slots: int, seq_len: int):
+    """The sequence axis of a per-slot KV-cache leaf, or None when the
+    leaf carries no per-slot sequence state (no leading slot axis of
+    ``n_slots``, or no axis of size ``seq_len`` past it).
+
+    Convention: the LAST axis of size ``seq_len`` that is not the final
+    axis, else the final axis — the transformer cache is ``[S, H, T,
+    Dh]`` (T at -2, robust to an ``H == T`` or ``Dh == T`` coincidence)
+    and simple per-position buffers are ``[S, T]`` (T final).  Both the
+    host extract/pad side and the traced install side resolve the axis
+    through this one function so they can never disagree.
+    """
+    if len(shape) < 2 or shape[0] != n_slots:
+        return None
+    inner = tuple(shape[1:])
+    cands = [i for i, d in enumerate(inner) if d == seq_len]
+    if not cands:
+        return None
+    non_final = [i for i in cands if i != len(inner) - 1]
+    return (non_final[-1] if non_final else cands[-1]) + 1
+
+
+def make_prefix_admit_fn(admit_fn):
+    """Wrap a :func:`make_slot_decode_fns` ``admit`` with shared-prefix
+    KV installation: ``admit_prefix(state, slot_mask, prompt,
+    prompt_len, total_len, kv_leaves, prefix_len[, spec_flag])`` seats
+    the request as usual, then overwrites the slot's first
+    ``prefix_len`` cache positions with the retained KV blocks and
+    starts ``pos`` at ``prefix_len`` — prefill resumes at the unmatched
+    suffix.
+
+    ``kv_leaves`` is the flattened leaf list of the state's KV subtrees
+    (``cache`` plus ``draft_cache`` when present, in tree-flatten
+    order), each leaf host-padded along its sequence axis to the
+    state's length rung; non-qualifying positions carry a ``(1,)``
+    dummy.  Qualification and the sequence axis are decided by STATIC
+    shapes (:func:`kv_leaf_seq_axis`), so one compiled executable per
+    rung pair serves every cached prefix length — ``prefix_len`` stays
+    a dynamic scalar.  Positional embeddings are absolute, so retained
+    rows are position-correct for any matching prompt.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def admit_prefix(state, slot_mask, prompt, prompt_len, total_len,
+                     kv_leaves, prefix_len, spec_flag=None):
+        if spec_flag is None:
+            out = admit_fn(state, slot_mask, prompt, prompt_len,
+                           total_len)
+        else:
+            out = admit_fn(state, slot_mask, prompt, prompt_len,
+                           total_len, spec_flag)
+        S, T = state["tokens"].shape
+        keep = jnp.arange(T) < prefix_len
+        sub = {"cache": out["cache"]}
+        if "draft_cache" in out:
+            sub["draft_cache"] = out["draft_cache"]
+        leaves, treedef = jax.tree_util.tree_flatten(sub)
+        new_leaves = []
+        for cur, pre in zip(leaves, kv_leaves):
+            ax = kv_leaf_seq_axis(cur.shape, S, T)
+            if ax is None or tuple(pre.shape) != tuple(cur.shape[1:]):
+                new_leaves.append(cur)
+                continue
+            kshape = [1] * cur.ndim
+            kshape[ax] = T
+            sel = (slot_mask.reshape((S,) + (1,) * (cur.ndim - 1))
+                   & keep.reshape(kshape))
+            new_leaves.append(
+                jnp.where(sel, pre[None].astype(cur.dtype), cur))
+        sub = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        out["cache"] = sub["cache"]
+        if "draft_cache" in sub:
+            out["draft_cache"] = sub["draft_cache"]
+        out["pos"] = jnp.where(slot_mask, prefix_len, out["pos"])
+        return out
+
+    return admit_prefix

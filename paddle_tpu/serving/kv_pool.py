@@ -76,8 +76,11 @@ class KVSlotPool:
                  slot_ladder: Optional[Sequence[int]] = None,
                  len_ladder: Optional[Sequence[int]] = None,
                  steps: int = 4,
-                 on_recompile: Optional[Callable[[], None]] = None):
-        from paddle_tpu.decoding import make_slot_decode_fns
+                 on_recompile: Optional[Callable[[], None]] = None,
+                 prefix: bool = False,
+                 speculative=None):
+        from paddle_tpu.decoding import (make_prefix_admit_fn,
+                                         make_slot_decode_fns)
 
         self._make_cache = make_cache
         self.eos_id = int(eos_id)
@@ -85,8 +88,29 @@ class KVSlotPool:
         self.slot_policy = BucketPolicy(max_slots, slot_ladder)
         self.len_policy = BucketPolicy(
             max_seq_len, len_ladder or default_len_ladder(max_seq_len))
-        self._fns = make_slot_decode_fns(step_fn, self.eos_id, self.steps)
+        # decode tier 2 (both default-off so the base pool's compiled
+        # set — and its warmup count — are exactly the PR-9 three):
+        # ``prefix`` adds the admit_prefix executable (shared-prefix KV
+        # installation); ``speculative`` (a SpeculativeConfig) threads
+        # the draft cache + spec flag through the state and adds the
+        # fused draft+verify spec_chunk executable.
+        self.prefix = bool(prefix)
+        self.speculative = speculative
+        self._fns = make_slot_decode_fns(
+            step_fn, self.eos_id, self.steps,
+            draft_step_fn=(speculative.draft_step_fn
+                           if speculative is not None else None))
         self._chunk_fn, self._admit_fn, self._release_fn = self._fns
+        self._admit_prefix_fn = (
+            make_prefix_admit_fn(self._admit_fn) if self.prefix else None)
+        if speculative is not None:
+            from paddle_tpu.serving.speculative import make_spec_chunk_fn
+
+            self._spec_chunk_fn = make_spec_chunk_fn(
+                speculative.verify_fn, speculative.draft_step_fn,
+                self.eos_id, speculative.k)
+        else:
+            self._spec_chunk_fn = None
         self._jitted = None  # built lazily (first compile / warmup)
         self._exe: Dict[Tuple[str, int, int], object] = {}
         self._lock = threading.Lock()
@@ -125,7 +149,22 @@ class KVSlotPool:
                 "admit": jax.jit(self._admit_fn, **kw),
                 "release": jax.jit(self._release_fn, **kw),
             }
+            if self._admit_prefix_fn is not None:
+                self._jitted["admit_prefix"] = jax.jit(
+                    self._admit_prefix_fn, **kw)
+            if self._spec_chunk_fn is not None:
+                self._jitted["spec_chunk"] = jax.jit(
+                    self._spec_chunk_fn, **kw)
         return self._jitted
+
+    def _kinds(self) -> List[str]:
+        """Every executable kind this pool compiles per rung pair."""
+        kinds = ["chunk", "admit", "release"]
+        if self.prefix:
+            kinds.append("admit_prefix")
+        if self.speculative is not None:
+            kinds.append("spec_chunk")
+        return kinds
 
     def _state_spec(self, s: int, t: int):
         """Abstract (ShapeDtypeStruct) pool state for rung pair
@@ -135,7 +174,7 @@ class KVSlotPool:
 
         cache = jax.eval_shape(lambda: self._make_cache(s, t))
         i32 = np.dtype(np.int32)
-        return {
+        spec = {
             "cache": cache,
             "tokens": jax.ShapeDtypeStruct((s, t), i32),
             "pos": jax.ShapeDtypeStruct((s,), i32),
@@ -145,6 +184,23 @@ class KVSlotPool:
             "finished": jax.ShapeDtypeStruct((s,), np.dtype(bool)),
             "n_gen": jax.ShapeDtypeStruct((s,), i32),
         }
+        if self.speculative is not None:
+            spec["spec"] = jax.ShapeDtypeStruct((s,), np.dtype(bool))
+            spec["draft_cache"] = jax.eval_shape(
+                lambda: self.speculative.draft_make_cache(s, t))
+        return spec
+
+    def _kv_subtree_leaves(self, state_or_spec):
+        """Flattened leaves of the state's KV subtrees (``cache`` plus
+        ``draft_cache`` when speculative) — the fixed order the prefix
+        cache stores and ``admit_prefix`` consumes."""
+        import jax
+
+        sub = {"cache": state_or_spec["cache"]}
+        if "draft_cache" in state_or_spec:
+            sub["draft_cache"] = state_or_spec["draft_cache"]
+        leaves, _ = jax.tree_util.tree_flatten(sub)
+        return leaves
 
     def alloc(self, s: int, t: int) -> Dict[str, object]:
         """A fresh zeroed pool state for rung pair ``(s, t)``, HOST-side
@@ -207,7 +263,7 @@ class KVSlotPool:
 
         spec = self._state_spec(s, t)
         jitted = self._jit()[kind]
-        if kind == "chunk":
+        if kind in ("chunk", "spec_chunk"):
             return jitted.lower(spec).compile()
         i32 = np.dtype(np.int32)
         mask = jax.ShapeDtypeStruct((s,), np.dtype(bool))
@@ -215,7 +271,22 @@ class KVSlotPool:
             return jitted.lower(spec, mask).compile()
         prompt = jax.ShapeDtypeStruct((t,), i32)
         scalar = jax.ShapeDtypeStruct((), i32)
-        return jitted.lower(spec, mask, prompt, scalar, scalar).compile()
+        args = [spec, mask, prompt, scalar, scalar]
+        if kind == "admit_prefix":
+            from paddle_tpu.decoding import kv_leaf_seq_axis
+
+            kv = []
+            for leaf in self._kv_subtree_leaves(spec):
+                ax = kv_leaf_seq_axis(leaf.shape, s, t)
+                kv.append(jax.ShapeDtypeStruct(
+                    leaf.shape[1:] if ax is not None else (1,),
+                    leaf.dtype if ax is not None
+                    else np.dtype(np.float32)))
+            args.append(kv)
+            args.append(scalar)  # prefix_len
+        if self.speculative is not None:
+            args.append(jax.ShapeDtypeStruct((), np.dtype(bool)))
+        return jitted.lower(*args).compile()
 
     # ------------------------------------------------------------------
     def warmup(self) -> int:
@@ -226,7 +297,7 @@ class KVSlotPool:
         proof the serving layer asserts on."""
         compiles = 0
         for s, t in self.rung_pairs():
-            for kind in ("chunk", "admit", "release"):
+            for kind in self._kinds():
                 key = (kind, s, t)
                 with self._lock:
                     have = key in self._exe
@@ -262,24 +333,101 @@ class KVSlotPool:
         return out
 
     def admit(self, state, slot: int, prompt: np.ndarray,
-              prompt_len: int, total_len: int) -> Dict[str, object]:
+              prompt_len: int, total_len: int,
+              spec: bool = False) -> Dict[str, object]:
         """Seat one request into free slot ``slot``: the prompt is
         padded host-side to the state's length rung and the slot's
         flags/cursors reset in ONE device dispatch (the cache passes
         through untouched — write-before-read makes zeroing a reused
-        slot unnecessary)."""
+        slot unnecessary).  ``spec`` marks the slot for speculative
+        rounds (ignored unless the pool was built with a
+        SpeculativeConfig)."""
         s, t = self.state_rungs(state)
+        mask, buf = self._admit_host_args(s, t, slot, prompt)
+        # hot-path: begin kv_admit (executable lookup + async dispatch)
+        exe = self._get_exe("admit", s, t)
+        args = [state, mask, buf,
+                np.asarray(prompt_len, np.int32),  # hot-ok: host scalar
+                np.asarray(total_len, np.int32)]  # hot-ok: host scalar
+        if self.speculative is not None:
+            args.append(np.asarray(bool(spec)))  # hot-ok: host scalar
+        out = exe(*args)
+        # hot-path: end kv_admit
+        return out
+
+    def _admit_host_args(self, s: int, t: int, slot: int, prompt):
         mask = np.zeros((s,), bool)
         mask[slot] = True
         buf = np.zeros((t,), np.int32)
         n = min(len(prompt), t)
         buf[:n] = np.asarray(prompt[:n], np.int32)
-        # hot-path: begin kv_admit (executable lookup + async dispatch)
-        exe = self._get_exe("admit", s, t)
-        out = exe(state, mask, buf,
-                  np.asarray(prompt_len, np.int32),  # hot-ok: host scalar
-                  np.asarray(total_len, np.int32))  # hot-ok: host scalar
-        # hot-path: end kv_admit
+        return mask, buf
+
+    def admit_prefix(self, state, slot: int, prompt: np.ndarray,
+                     prompt_len: int, total_len: int,
+                     kv_leaves, prefix_len: int,
+                     spec: bool = False) -> Dict[str, object]:
+        """Seat a request whose first ``prefix_len`` positions are
+        served from retained KV blocks (``kv_leaves``: the prefix
+        cache's stored leaf list, per :meth:`extract_kv` order): the
+        leaves are host-padded to the current length rung and installed
+        by the warmed ``admit_prefix`` executable, and the slot starts
+        at ``pos = prefix_len`` — prefill resumes at the unmatched
+        suffix.  Requires ``prefix=True`` at construction."""
+        from paddle_tpu.decoding import kv_leaf_seq_axis
+
+        if self._admit_prefix_fn is None:
+            raise RuntimeError(
+                "pool was built without prefix=True — admit_prefix has "
+                "no warmed executable")
+        s, t = self.state_rungs(state)
+        mask, buf = self._admit_host_args(s, t, slot, prompt)
+        spec_leaves = self._kv_subtree_leaves(self._state_spec(s, t))
+        kv = []
+        for sd, ent in zip(spec_leaves, kv_leaves):
+            ax = kv_leaf_seq_axis(sd.shape, s, t)
+            if ax is None or ent is None:
+                kv.append(np.zeros((1,), np.float32))
+                continue
+            tgt = np.zeros(sd.shape[1:], sd.dtype)
+            sl = [slice(0, min(a, b))
+                  for a, b in zip(ent.shape, tgt.shape)]
+            tgt[tuple(sl)] = ent[tuple(sl)]
+            kv.append(tgt)
+        # hot-path: begin kv_admit_prefix (executable lookup + async
+        # dispatch; the leaf re-pad above is host numpy on stored
+        # host arrays — no device sync)
+        exe = self._get_exe("admit_prefix", s, t)
+        args = [state, mask, buf,
+                np.asarray(prompt_len, np.int32),  # hot-ok: host scalar
+                np.asarray(total_len, np.int32),  # hot-ok: host scalar
+                kv,
+                np.asarray(prefix_len, np.int32)]  # hot-ok: host scalar
+        if self.speculative is not None:
+            args.append(np.asarray(bool(spec)))  # hot-ok: host scalar
+        out = exe(*args)
+        # hot-path: end kv_admit_prefix
+        return out
+
+    def extract_kv(self, state, slot: int, m: int):
+        """Materialize slot ``slot``'s first ``m`` KV positions as host
+        arrays (the prefix cache's retained-entry payload): one list
+        entry per KV subtree leaf (:func:`decoding.kv_leaf_seq_axis`
+        order), ``None`` for leaves carrying no per-slot sequence
+        state.  A control-plane d2h — called when a slot is FREED, off
+        the tick's dispatch path."""
+        from paddle_tpu.decoding import kv_leaf_seq_axis
+
+        s, t = self.state_rungs(state)
+        out = []
+        for leaf in self._kv_subtree_leaves(state):
+            ax = kv_leaf_seq_axis(tuple(leaf.shape), s, t)
+            if ax is None:
+                out.append(None)
+                continue
+            sl = [slice(None)] * (leaf.ndim - 1)
+            sl[ax - 1] = slice(0, int(m))
+            out.append(np.asarray(leaf[slot][tuple(sl)]))
         return out
 
     def release(self, state, slots: Sequence[int]) -> Dict[str, object]:

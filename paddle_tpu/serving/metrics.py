@@ -44,6 +44,13 @@ _COUNTER_HELP = {
     "warmup_compiles": "XLA compiles performed by warmup()",
     "recompiles": "jit-cache misses AFTER warmup",
     "requeued": "batches re-routed off a failed/removed replica",
+    # decode tier 2 (zero on non-decode servers)
+    "prefix_fallback": "shared-prefix admissions that fell back to a "
+                       "full prefill (corrupted/evicted-mid-admit "
+                       "entry — degraded, never wrong tokens)",
+    "prefix_store_failed": "freed-slot prefix KV offers that failed to "
+                           "extract or store (the entry is simply not "
+                           "retained)",
 }
 _LABELS = ("server", "instance")
 _COUNTERS = {

@@ -376,7 +376,8 @@ class RemoteClient:
     def infer_stream(self, feed, timeout_ms: Optional[float] = None,
                      trace_id: Optional[str] = None,
                      priority: int = PRIORITY_NORMAL,
-                     max_new_tokens: Optional[int] = None):
+                     max_new_tokens: Optional[int] = None,
+                     speculative: Optional[bool] = None):
         """Stream generated-token chunks from a remote decode endpoint
         (``serving.decode.DecodeServer`` behind a ``ServingProcess``):
         each yielded 1-D int32 array is one chunk, received over the
@@ -402,6 +403,10 @@ class RemoteClient:
         extra = {}
         if max_new_tokens is not None:
             extra["max_new_tokens"] = int(max_new_tokens)
+        if speculative is not None:
+            # decode tier 2: ask the endpoint to draft-and-verify this
+            # stream (greedy-exact — same tokens, fewer target steps)
+            extra["speculative"] = bool(speculative)
         it, first = wire_stream_open(
             self._transport, names, arrays, remaining_ms, tid,
             extra_meta=extra, priority=priority)

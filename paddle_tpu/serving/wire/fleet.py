@@ -38,10 +38,12 @@ below N-1 and cold jit caches never see traffic.
 """
 from __future__ import annotations
 
+import hashlib
 import random
 import threading
 import time
 import weakref
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -72,6 +74,7 @@ from paddle_tpu.serving.wire.client import (
 )
 from paddle_tpu.serving.wire.http import HttpTransport
 from paddle_tpu.serving.wire.metrics import (
+    FLEET_AFFINITY_HITS,
     RETRY_THROTTLED,
     WIRE_BACKEND_RETIRED,
     WIRE_HEALTH_CHECK_FAILURES,
@@ -100,6 +103,15 @@ _RETRYABLE = (BackendUnavailable, _errors.ServerClosed, WireProtocolError)
 # balancer falls back to its own in-flight counts (a stale report from
 # a quiet backend must not repel traffic forever)
 _LOAD_FRESH_S = 5.0
+
+# cache-affinity routing is a bounded TIE-BREAK, never a mandate: the
+# hinted backend (whose prefix KV cache is warm for this prompt head)
+# wins only while its load score is within this slack of the
+# least-loaded candidate.  A hot-prefix herd therefore spills to other
+# backends exactly when least-loaded routing says it should, and a
+# browned-out / overloaded / paused backend never attracts traffic on
+# the strength of a warm cache (those filters run BEFORE the tie-break).
+_AFFINITY_SLACK = 1.0
 
 
 class _RetryThrottle:
@@ -145,7 +157,7 @@ class _Backend:
                  "consec_health_failures", "retired_at", "removed",
                  "give_up", "next_probe_at", "reported_depth",
                  "reported_limit", "reported_brownout", "load_ts",
-                 "not_before")
+                 "not_before", "prefix_hints", "affinity_hits")
 
     def __init__(self, idx: int, name: str, transport: HttpTransport,
                  handle: Optional[_launch.ServerHandle] = None):
@@ -174,6 +186,11 @@ class _Backend:
         # (set from ServerOverloaded.retry_after_ms — a shedding backend
         # must not be re-dispatched to before its own hint elapses)
         self.not_before = 0.0
+        # cache-affinity bookkeeping: prompt-prefix hashes this backend
+        # served last (bounded LRU, guarded by _route_cv) and how many
+        # requests landed here BECAUSE of the hint
+        self.prefix_hints: "OrderedDict[str, None]" = OrderedDict()
+        self.affinity_hits = 0
 
 
 class FleetBalancer:
@@ -185,6 +202,16 @@ class FleetBalancer:
     concurrent requests PER BACKEND (admission control: with every live
     backend at the bound, submitters wait — and time out typed against
     their deadline rather than queuing unboundedly).
+
+    ``prefix_affinity=True`` folds prompt-prefix cache affinity into
+    routing: requests whose ``tokens`` feed shares its first
+    ``affinity_block`` tokens with an earlier request prefer the backend
+    that served it (whose ``PrefixKVCache`` retains that prefix's KV),
+    as a bounded tie-break on the load score (``_AFFINITY_SLACK``) —
+    never overriding the alive/capacity/retry-after filters or genuine
+    load imbalance.  Each backend remembers its last ``affinity_hints``
+    prefix hashes; ``serving_fleet_affinity_hits_total`` counts routes
+    the hint decided.
     """
 
     def __init__(self, backends: Sequence, name: str = "fleet",
@@ -195,7 +222,10 @@ class FleetBalancer:
                  supervisor: Optional[_launch.Supervisor] = None,
                  retry_policy: Optional[RetryPolicy] = None,
                  retry_rate_per_s: float = 100.0,
-                 retry_burst: int = 32):
+                 retry_burst: int = 32,
+                 prefix_affinity: bool = False,
+                 affinity_block: int = 16,
+                 affinity_hints: int = 1024):
         if not backends:
             raise ValueError("FleetBalancer needs at least one backend")
         self.name = name
@@ -216,6 +246,10 @@ class FleetBalancer:
         # own retries amplify saturation into metastable collapse
         self._throttle = _RetryThrottle(retry_rate_per_s, retry_burst)
         self._throttled_counter = RETRY_THROTTLED.labels(fleet=name)
+        self._prefix_affinity = bool(prefix_affinity)
+        self._affinity_block = int(affinity_block)
+        self._affinity_hints = int(affinity_hints)
+        self._affinity_counter = FLEET_AFFINITY_HITS.labels(fleet=name)
         # circuit-breaker re-admission: a failure-retired backend goes
         # half-open after cooldown_s and takes one probe; a backend
         # whose PROCESS died is revived through the supervisor (capped
@@ -299,6 +333,8 @@ class FleetBalancer:
                     "load_fresh": (b.load_ts is not None
                                    and now - b.load_ts <= _LOAD_FRESH_S),
                     "paused_ms": max(0.0, (b.not_before - now) * 1e3),
+                    "prefix_hints": len(b.prefix_hints),
+                    "affinity_hits": b.affinity_hits,
                 }
                 for b in self._backends
             }
@@ -362,8 +398,28 @@ class FleetBalancer:
             score += float(be.reported_depth) + float(be.reported_brownout)
         return score
 
+    def _affinity_key(self, names, arrays) -> Optional[str]:
+        """The routing affinity key for one request: a hash of the first
+        ``affinity_block`` tokens of its ``tokens`` feed (the same
+        prompt head a backend's ``PrefixKVCache`` keys on), or ``None``
+        when affinity is off / the feed has no token prompt / the prompt
+        is shorter than one block.  Computed on the submitting thread
+        BEFORE dispatch — never inside the routing hot region."""
+        if not self._prefix_affinity:
+            return None
+        try:
+            idx = names.index("tokens")
+        except ValueError:
+            return None
+        head = np.asarray(arrays[idx]).reshape(-1)[:self._affinity_block]
+        if head.size < self._affinity_block:
+            return None
+        return hashlib.sha1(
+            np.ascontiguousarray(head, np.int32).tobytes()).hexdigest()
+
     def _pick(self, exclude: Optional[_Backend],
-              now: Optional[float] = None) -> Optional[_Backend]:
+              now: Optional[float] = None,
+              affinity_key: Optional[str] = None) -> Optional[_Backend]:
         now = time.monotonic() if now is None else now
         live = [b for b in self._backends
                 if b.alive and b is not exclude
@@ -371,7 +427,19 @@ class FleetBalancer:
                 and b.not_before <= now]
         if not live:
             return None
-        return min(live, key=lambda b: self._load_score(b, now))
+        best = min(live, key=lambda b: self._load_score(b, now))
+        if affinity_key is not None:
+            # bounded tie-break: the hinted backend (warm prefix KV for
+            # this prompt head) wins only within _AFFINITY_SLACK of the
+            # least-loaded score, and only after the same eligibility
+            # filters every candidate passed — affinity never defeats
+            # balancing, overload pacing, or retirement
+            bound = self._load_score(best, now) + _AFFINITY_SLACK
+            for b in live:
+                if (affinity_key in b.prefix_hints
+                        and self._load_score(b, now) <= bound):
+                    return b
+        return best
 
     def _update_load(self, be: _Backend, load) -> None:
         """Fold one response's load report (success meta ``load``, or
@@ -389,7 +457,8 @@ class FleetBalancer:
             be.load_ts = time.monotonic()
 
     def _acquire(self, exclude: Optional[_Backend],
-                 deadline: Optional[float]) -> _Backend:
+                 deadline: Optional[float],
+                 affinity_key: Optional[str] = None) -> _Backend:
         with self._route_cv:
             while True:
                 if self._closed:
@@ -403,12 +472,14 @@ class FleetBalancer:
                     self._metrics.count("expired")
                     raise DeadlineExceeded(
                         "deadline passed before acquiring a backend")
-                be = self._pick(exclude, now)
+                be = self._pick(exclude, now, affinity_key)
                 if be is None and exclude is not None and not any(
                         b.alive and b is not exclude for b in self._backends):
                     be = self._pick(None, now)  # only the excluded one: reuse
                 if be is not None:
                     be.in_flight += 1
+                    if affinity_key is not None:
+                        self._note_affinity_locked(be, affinity_key)
                     return be
                 if not any(b.alive for b in self._backends):
                     raise ServingError(
@@ -428,6 +499,23 @@ class FleetBalancer:
                         raise DeadlineExceeded(
                             "deadline passed waiting for fleet capacity")
                 self._route_cv.wait(timeout=wait)
+
+    def _note_affinity_locked(self, be: _Backend, key: str) -> None:
+        """Record where this prefix landed (holding _route_cv): a
+        returning prefix on its hinted backend is an affinity hit; any
+        landing re-hints the key here (the request is about to warm THIS
+        backend's prefix cache — after a spill or retirement, future
+        requests should follow the KV, not the stale hint)."""
+        if key in be.prefix_hints:
+            be.prefix_hints.move_to_end(key)
+            be.affinity_hits += 1
+            self._affinity_counter.inc()
+            return
+        for other in self._backends:
+            other.prefix_hints.pop(key, None)
+        be.prefix_hints[key] = None
+        while len(be.prefix_hints) > self._affinity_hints:
+            be.prefix_hints.popitem(last=False)
 
     def _release(self, be: _Backend, ok: bool) -> None:
         with self._route_cv:
@@ -486,6 +574,7 @@ class FleetBalancer:
         tid = trace_id or monitor.new_trace_id()
         self.last_trace_id = tid
         names, arrays = self._normalize(feed)
+        akey = self._affinity_key(names, arrays)
         deadline = (
             time.monotonic() + float(timeout_ms) / 1e3
             if timeout_ms is not None else None)
@@ -494,7 +583,8 @@ class FleetBalancer:
         rec = _spans.recording() or fr is not None
         if not rec:
             _, routs = self._route(names, arrays, timeout_ms, deadline, tid,
-                                   priority=priority, precision=precision)
+                                   priority=priority, precision=precision,
+                                   affinity_key=akey)
             return routs
         t0 = time.perf_counter()
         err: Optional[BaseException] = None
@@ -510,7 +600,8 @@ class FleetBalancer:
                     with _spans.capture(cap):
                         rmeta, routs = self._route(
                             names, arrays, timeout_ms, deadline, tid,
-                            priority=priority, precision=precision)
+                            priority=priority, precision=precision,
+                            affinity_key=akey)
             extra_spans = list(rmeta.get("spans") or ())
             return routs
         except BaseException as e:  # noqa: BLE001 — observed, re-raised
@@ -530,14 +621,14 @@ class FleetBalancer:
     # the only waits are the bounded capacity CV, the retry budget's
     # jittered backoff, and socket I/O)
     def _route(self, names, arrays, timeout_ms, deadline, tid,
-               priority=None, precision=None):
+               priority=None, precision=None, affinity_key=None):
         t_submit = time.perf_counter()
         extra = {"precision": str(precision)} if precision is not None else None
         budget = self._retry_policy.budget(
             deadline=deadline, op="fleet.requeue")
         exclude: Optional[_Backend] = None
         while True:
-            be = self._acquire(exclude, deadline)
+            be = self._acquire(exclude, deadline, affinity_key)
             remaining_ms = timeout_ms
             if deadline is not None:
                 remaining_ms = (deadline - time.monotonic()) * 1e3
@@ -707,7 +798,8 @@ class FleetBalancer:
     def infer_stream(self, feed, timeout_ms: Optional[float] = None,
                      trace_id: Optional[str] = None,
                      priority: Optional[int] = None,
-                     max_new_tokens: Optional[int] = None):
+                     max_new_tokens: Optional[int] = None,
+                     speculative: Optional[bool] = None):
         """Stream generated-token chunks through the fleet: the request
         routes like ``infer`` (least loaded, retry pacing, requeue), and
         a failure BEFORE the first message — unreachable backend, shed,
@@ -719,10 +811,14 @@ class FleetBalancer:
         (``BackendUnavailable``) instead of silently replaying the
         sequence on a survivor — the caller decides whether to resubmit.
         Every chunk carries one trace id (``last_trace_id``); the final
-        meta lands in ``last_stream_final``."""
+        meta lands in ``last_stream_final``.  ``speculative=True`` asks
+        the backend to decode this stream with its draft model
+        (greedy-exact, so the tokens are identical either way); the
+        backend must have been loaded with a ``draft`` manifest."""
         tid = trace_id or monitor.new_trace_id()
         self.last_trace_id = tid
         names, arrays = self._normalize(feed)
+        akey = self._affinity_key(names, arrays)
         deadline = (
             time.monotonic() + float(timeout_ms) / 1e3
             if timeout_ms is not None else None)
@@ -730,11 +826,13 @@ class FleetBalancer:
         extra = {}
         if max_new_tokens is not None:
             extra["max_new_tokens"] = int(max_new_tokens)
+        if speculative is not None:
+            extra["speculative"] = bool(speculative)
         budget = self._retry_policy.budget(
             deadline=deadline, op="fleet.requeue")
         exclude: Optional[_Backend] = None
         while True:
-            be = self._acquire(exclude, deadline)
+            be = self._acquire(exclude, deadline, akey)
             remaining_ms = timeout_ms
             if deadline is not None:
                 remaining_ms = (deadline - time.monotonic()) * 1e3
@@ -828,7 +926,9 @@ class FleetBalancer:
     def _stream_chunks(self, be: _Backend, it, first, tid: str,
                        settled: List[bool]):
         t_submit = time.perf_counter()
-        sid = _spans.new_span_id() if _spans.recording() else None
+        fr = _flight.get()
+        sid = (_spans.new_span_id()
+               if (_spans.recording() or fr is not None) else None)
         err: Optional[BaseException] = None
         clean = False
         counter = [0]
@@ -873,14 +973,37 @@ class FleetBalancer:
                     if isinstance(err, _RETRYABLE):
                         self._record_failure(be)
                     self._metrics.count("failed")
+            dur = time.perf_counter() - t_submit
             if sid is not None:
                 with _spans.trace_context((tid,)):
                     _spans.record_span(
-                        "serving/client_stream", t_submit,
-                        time.perf_counter() - t_submit, cat="client",
-                        span_id=sid, chunks=counter[0],
+                        "serving/client_stream", t_submit, dur,
+                        cat="client", span_id=sid, chunks=counter[0],
                         error=err is not None, fleet=self.name,
                         backend=be.name)
+            if fr is not None:
+                # the stream's flight record names the backend that
+                # served it: /tracez answers "which process decoded this
+                # stream" without correlating server-side logs
+                span = {
+                    "name": "serving/client_stream", "cat": "client",
+                    "id": sid, "ts": _spans.wall_ts(t_submit), "dur": dur,
+                    "tid": threading.get_ident(), "trace_ids": [tid],
+                    "chunks": counter[0], "fleet": self.name,
+                    "backend": be.name,
+                }
+                if err is not None:
+                    span["error"] = True
+                if fr.get_record(tid) is not None:
+                    fr.add_span(tid, span)
+                elif clean or err is not None:
+                    # abandonment (err None, not clean) is neutral: it
+                    # must not occupy the bounded ring
+                    status = ("ok" if err is None else
+                              "deadline" if isinstance(err, DeadlineExceeded)
+                              else "error")
+                    fr.consider(tid, dur, status, [span],
+                                fleet=self.name, backend=be.name)
 
     # ------------------------------------------------------------------
     # health checking + rolling replacement

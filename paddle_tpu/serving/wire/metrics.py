@@ -19,6 +19,7 @@ __all__ = [
     "WIRE_CODEC_SECONDS", "WIRE_BACKEND_RETIRED",
     "WIRE_HEALTH_CHECKS", "WIRE_HEALTH_CHECK_FAILURES",
     "WIRE_BACKEND_RELAUNCHES", "RETRY_THROTTLED",
+    "FLEET_AFFINITY_HITS",
 ]
 
 WIRE_REQUESTS = _registry.REGISTRY.counter(
@@ -59,3 +60,8 @@ RETRY_THROTTLED = _registry.REGISTRY.counter(
     "typed error propagated to the caller instead of amplifying load "
     "on a saturated backend (back-pressure, not a retry storm)",
     ("fleet",))
+FLEET_AFFINITY_HITS = _registry.REGISTRY.counter(
+    "serving_fleet_affinity_hits_total",
+    "fleet requests routed to the backend their prompt-prefix hash was "
+    "last served by (cache-affinity routing: the hinted backend's "
+    "prefix KV cache is warm for this prompt head)", ("fleet",))

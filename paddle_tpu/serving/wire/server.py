@@ -381,6 +381,13 @@ class ServingProcess:
             "brownout_level": m.get("brownout_level"),
             "max_batch_size": srv.max_batch_size,
             "streaming": bool(getattr(srv, "supports_streaming", False)),
+            # decode tier 2 discovery: the balancer's affinity routing
+            # and the bench read whether this endpoint retains prefix KV
+            # and/or carries a draft model (None on non-decode servers)
+            "prefix_cache": (
+                srv.prefix_cache.stats()
+                if getattr(srv, "prefix_cache", None) is not None else None),
+            "speculative_k": getattr(srv, "speculative_k", None),
             # a sharded backend is one MODEL-PARALLEL GROUP of devices
             # behind one address — the balancer routes to groups exactly
             # like single-chip replicas (in-flight accounting, warmup,
@@ -495,6 +502,8 @@ class ServingProcess:
             kw["priority"] = int(meta["priority"])
         if meta.get("max_new_tokens") is not None:
             kw["max_new_tokens"] = int(meta["max_new_tokens"])
+        if meta.get("speculative"):
+            kw["speculative"] = True
         with _spans.trace_context((tid,)):
             req = srv.submit(
                 feed, timeout_ms=meta.get("timeout_ms"), trace_id=tid,

@@ -406,3 +406,93 @@ def test_decode_step_delay_stretches_ticks_but_loses_nothing():
             assert time.perf_counter() - t0 >= 0.15  # the stalls landed
     finally:
         srv.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# decode.prefix_admit: shared-prefix KV admission fault injection
+# ---------------------------------------------------------------------------
+def _prefix_decode_server(name):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.serving.decode import DecodeServer
+    from paddle_tpu.serving.prefix_cache import PrefixKVCache
+
+    V, EOS = 23, 9
+
+    def step_fn(cache, tokens, ts):
+        return jax.nn.one_hot((tokens + 1) % V, V) * 10.0, cache
+
+    def make_cache(n_rows, seq_len):
+        return {"z": jnp.zeros((n_rows, seq_len), "float32")}
+
+    cache = PrefixKVCache(capacity_bytes=1 << 20, block_tokens=4,
+                          name=name)
+    srv = DecodeServer(step_fn, make_cache, eos_id=EOS, max_seq_len=16,
+                       max_slots=2, steps_per_tick=2, name=name,
+                       prefix_cache=cache)
+    srv.warmup(configure_cache=False)
+    # warm one retained entry: tokens 1..8 decode to EOS immediately and
+    # the freed slot offers its block-aligned 8-token prefix
+    out = srv.submit({"tokens": np.arange(1, 9, dtype=np.int32)}).result(
+        timeout=30.0)
+    assert out[0].tolist() == [9]
+    deadline = time.monotonic() + 10.0
+    while cache.stats()["entries"] == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert cache.stats()["entries"] == 1
+    return srv, cache
+
+
+def test_decode_prefix_admit_error_falls_back_to_full_prefill():
+    """An injected ``decode.prefix_admit`` error (the corrupted /
+    evicted-mid-admit window) DEGRADES to a full prefill — the output
+    is exactly the uncached decode, the fallback is counted, and the
+    next matching admission uses the cache again.  Wrong tokens are the
+    one forbidden outcome; zero recompiles throughout."""
+    srv, cache = _prefix_decode_server("chaos-prefix")
+    prompt = np.array([1, 2, 3, 4, 5, 6, 7, 8, 10], np.int32)
+    try:
+        p0 = srv.metrics()["decode"]["prefill_tokens"]
+        with faults.armed("decode.prefix_admit=error:RuntimeError,times=1"):
+            out = srv.submit({"tokens": prompt},
+                             max_new_tokens=4).result(timeout=30.0)
+        assert out[0].tolist() == [11, 12, 13, 14]  # degraded, not wrong
+        m = srv.metrics()
+        assert m["prefix_fallback"] == 1
+        assert cache.stats()["fallbacks"] == 1
+        # the fallback re-ran the FULL prefill: all 9 prompt tokens
+        assert m["decode"]["prefill_tokens"] - p0 == 9
+        # healed: the same prompt now admits through the retained prefix
+        # (only the unmatched 1-token suffix prefills)
+        p1 = m["decode"]["prefill_tokens"]
+        out = srv.submit({"tokens": prompt},
+                         max_new_tokens=4).result(timeout=30.0)
+        assert out[0].tolist() == [11, 12, 13, 14]
+        assert srv.metrics()["decode"]["prefill_tokens"] - p1 == 1
+        assert srv._pool.jit_cache_stats()["misses"] == 0
+        assert srv.metrics().get("recompiles", 0) == 0
+    finally:
+        faults.disarm()
+        srv.stop(drain=False)
+
+
+def test_decode_prefix_admit_delay_is_slow_not_wrong():
+    """``decode.prefix_admit`` delay mode: the admission stalls (the
+    eviction-race window stretched wide) but the shared-prefix install
+    still lands — same tokens, prefill still skipped."""
+    srv, _cache = _prefix_decode_server("chaos-prefix-delay")
+    prompt = np.array([1, 2, 3, 4, 5, 6, 7, 8, 10], np.int32)
+    try:
+        p0 = srv.metrics()["decode"]["prefill_tokens"]
+        with faults.armed("decode.prefix_admit=delay:0.05,times=1"):
+            t0 = time.perf_counter()
+            out = srv.submit({"tokens": prompt},
+                             max_new_tokens=4).result(timeout=30.0)
+            assert time.perf_counter() - t0 >= 0.05  # the stall landed
+        assert out[0].tolist() == [11, 12, 13, 14]
+        assert srv.metrics()["decode"]["prefill_tokens"] - p0 == 1
+        assert srv.metrics().get("prefix_fallback", 0) == 0
+    finally:
+        faults.disarm()
+        srv.stop(drain=False)

@@ -29,6 +29,16 @@ Input JSON (either shape):
       power-of-two buckets; apply offline via
       ``bind_distributed_tables(..., id_bucket_ladder=...)``)
 
+    {"decode": {"seq_len_histogram": {"24": 120, "40": 7},
+                "max_seq_len": 128, "len_ladder": [32, 64, 128]}}
+      (a DecodeServer ``metrics()`` / ``/statusz`` snapshot — the
+      ``decode`` block is found at the top level or under ``metrics``,
+      or pass the block itself — proposes the KV LENGTH ladder via
+      ``plan_kv_ladder``.  Applying it re-warms every (slot, length)
+      rung pair, so it is a RESTART-TIME decision: pass the proposal
+      to ``DecodeServer(len_ladder=...)`` on the next deploy; never
+      re-plan a live decode server)
+
 Usage::
 
     python tools/autotune_ladder.py histogram.json [--max-rungs 8]
@@ -59,9 +69,35 @@ def _find_block(doc):
         "(top level or under 'metrics')")
 
 
-def propose(doc, max_rungs: int = 8):
-    from paddle_tpu.serving.autotune import plan, plan_id_ladder
+def _find_decode_block(doc):
+    """The dict holding ``seq_len_histogram`` — the document itself, its
+    ``decode`` block, or the ``decode`` block of a ``metrics`` dump."""
+    for cand in (doc, doc.get("decode"),
+                 (doc.get("metrics") or {}).get("decode")
+                 if isinstance(doc.get("metrics"), dict) else None):
+        if isinstance(cand, dict) and "seq_len_histogram" in cand:
+            return cand
+    return None
 
+
+def propose(doc, max_rungs: int = 8):
+    from paddle_tpu.serving.autotune import (
+        plan, plan_id_ladder, plan_kv_ladder)
+
+    blk = _find_decode_block(doc)
+    if blk is not None:
+        # a decode /statusz snapshot: propose the KV length ladder.
+        # Restart-time only — a ladder change re-warms every rung pair,
+        # so the proposal feeds DecodeServer(len_ladder=...) on the
+        # next deploy, never a live re-plan.
+        max_seq = blk.get("max_seq_len")
+        if max_seq is None:
+            hist = blk["seq_len_histogram"]
+            max_seq = max(int(k) for k in hist) if hist else 0
+        return plan_kv_ladder(
+            blk["seq_len_histogram"], int(max_seq),
+            current_ladder=blk.get("len_ladder"),
+            max_rungs=max_rungs)
     if "uniq_id_histogram" in doc:
         # the sparse-prefetch unique-id-count document: propose the id
         # BUCKET ladder (offline only — a live change re-warms)

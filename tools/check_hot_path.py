@@ -71,6 +71,12 @@ CHECKED_FILES = [
     # sync in either serializes every DeepFM step/request
     "paddle_tpu/sharding/sparse.py",
     "paddle_tpu/serving/embedding_cache.py",
+    # decode tier 2: the prefix-cache probe runs on the scheduler thread
+    # between ticks (prefix_probe — pure host hashing, no device syncs),
+    # and the speculative round dispatch is one warmed-executable call
+    # (spec_verify) — a blocking sync in either stalls every decode tick
+    "paddle_tpu/serving/prefix_cache.py",
+    "paddle_tpu/serving/speculative.py",
 ]
 
 # blocking-sync tokens (substring match on code, not comments)

@@ -40,6 +40,8 @@ REGISTERING_MODULES = [
     "paddle_tpu.faults.metrics",
     "paddle_tpu.sharding.metrics",
     "paddle_tpu.serving.embedding_cache",
+    "paddle_tpu.serving.prefix_cache",
+    "paddle_tpu.serving.speculative",
 ]
 
 # README table rows look like ``| `metric_name` | type | ... |``
