@@ -131,6 +131,23 @@ bandwidth win.  The line measures the HARNESS (parity, recompiles,
 manifest transport, both ladders warmed); the speedup itself is a TPU
 number — bf16 halves the HBM bytes an inference step moves, which is
 the binding constraint at MFU 0.13 (BENCH_r05).
+
+``--fleet-obs`` (or $BENCH_SERVING_FLEET_OBS=1) benches the FLEET
+OBSERVABILITY control tower: one REAL 2-child wire fleet serving the
+LeNet endpoint, driven by the same staggered-arrival storm twice —
+once bare, once with the balancer's federated admin tier up, the
+scraper riding the health loop, and a latency SLO burn-rate engine
+evaluating every 100 ms.  The line asserts the tower's three
+contracts: (1) the federated ``/metrics`` carries every child
+``serving_*`` counter series verbatim under a distinct ``backend=``
+label and ``/statusz``'s fleet aggregate equals the children's sum
+exactly; (2) an injected-latency window (``fleet.dispatch`` delay
+fault in the balancer) drives the fast-burn pair of the p99 SLO to
+fire — visible in ``/sloz`` and as a critical ``slo/fired`` event in
+``/eventz`` — and clean traffic clears it again; (3) observability-on
+QPS stays within 2% of bare (BENCH_OBS_QPS_FLOOR, default 0.98) and
+both children's recompile counters stay 0.
+Env knobs: BENCH_OBS_QPS_FLOOR, BENCH_OBS_FAULT_DELAY_S (default 0.6).
 """
 import json
 import os
@@ -1329,6 +1346,365 @@ def run_precision():
     }
 
 
+def _fleet_obs_storm(fleet, make_rows, threads, requests,
+                     stagger_s=0.02, seed=300):
+    """Staggered-arrival open storm through the balancer: every thread
+    starts ``stagger_s`` after its predecessor (an arrival ramp, not a
+    thundering herd), mixed request sizes.  Returns the client-observed
+    throughput/latency block."""
+    from paddle_tpu import serving
+
+    lats = [[] for _ in range(threads)]
+    shed = [0] * threads
+    start = threading.Barrier(threads + 1)
+
+    def storm(tid):
+        rng = np.random.RandomState(seed + tid)
+        start.wait()
+        time.sleep(stagger_s * tid)
+        for i in range(requests):
+            n = REQ_SIZES[(tid + i) % len(REQ_SIZES)]
+            feed = make_rows(n, rng)
+            r0 = time.perf_counter()
+            try:
+                fleet.infer(feed, timeout_ms=30000)
+                lats[tid].append(time.perf_counter() - r0)
+            except serving.ServerOverloaded:
+                shed[tid] += 1
+
+    workers = [threading.Thread(target=storm, args=(t,))
+               for t in range(threads)]
+    for t in workers:
+        t.start()
+    start.wait()
+    t0 = time.perf_counter()
+    for t in workers:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    all_lats = np.asarray(
+        [v for per in lats for v in per], dtype=np.float64)
+    return {
+        "requests_per_sec": round(all_lats.size / elapsed, 1),
+        "latency_p50_ms": round(
+            float(np.percentile(all_lats, 50)) * 1e3, 3),
+        "latency_p99_ms": round(
+            float(np.percentile(all_lats, 99)) * 1e3, 3),
+        "completed": int(all_lats.size),
+        "shed": int(sum(shed)),
+        "elapsed_s": round(elapsed, 2),
+    }
+
+
+def _fleet_obs_federation_check(fleet, admin):
+    """The exact-sum federation contract, checked while the fleet is
+    idle: every child ``serving_*`` counter series must appear in the
+    federated ``/metrics`` verbatim under that child's ``backend=``
+    label, and ``/statusz``'s fleet aggregate must equal the children's
+    sum exactly."""
+    from paddle_tpu.monitor import registry as _registry
+
+    # direct child expositions first, then a forced scrape: with no
+    # traffic in flight the serving_* counters cannot move in between,
+    # so the cached docs the federated views serve match these exactly
+    children = {}
+    for be in fleet._backends:
+        children[be.name] = _registry.parse_exposition(
+            be.transport.get_text("/metrics"))
+    fleet.scrape_once()
+    fed = _registry.parse_exposition(admin.get_text("/metrics"))
+    statusz = admin.get_json("/statusz")
+
+    fed_index = {}
+    for fam_name, fam in fed.items():
+        if fam["type"] != "counter":
+            continue
+        for name, labels, value in fam["samples"]:
+            fed_index[(name, tuple(sorted(labels.items())))] = value
+
+    series_checked = 0
+    families = set()
+    sums = {}
+    for backend, fams in children.items():
+        for fam_name, fam in fams.items():
+            if fam["type"] != "counter" or not fam_name.startswith(
+                    "serving_"):
+                continue
+            for name, labels, value in fam["samples"]:
+                want = dict(labels)
+                want["backend"] = backend
+                key = (name, tuple(sorted(want.items())))
+                got = fed_index.get(key)
+                if got != value:
+                    raise AssertionError(
+                        "federated /metrics mismatch for %s%r: child %s "
+                        "has %r, federation has %r"
+                        % (name, labels, backend, value, got))
+                series_checked += 1
+                families.add(fam_name)
+                sums[fam_name] = sums.get(fam_name, 0.0) + value
+    if series_checked == 0:
+        raise AssertionError("no child serving_* counter series federated")
+
+    agg = (statusz.get("aggregate") or {}).get("counters") or {}
+    for fam_name, want in sums.items():
+        got = agg.get(fam_name)
+        if got != want:
+            raise AssertionError(
+                "federated /statusz aggregate mismatch for %s: children "
+                "sum to %r, aggregate says %r" % (fam_name, want, got))
+
+    backends_seen = {
+        labels.get("backend")
+        for fam in fed.values()
+        for _, labels, _ in fam["samples"]}
+    missing = {be.name for be in fleet._backends} - backends_seen
+    if missing:
+        raise AssertionError(
+            "federated /metrics missing backend label(s): %r" % missing)
+    return {
+        "counter_families_checked": len(families),
+        "series_checked": series_checked,
+        "aggregate_families_checked": len(sums),
+        "backends": sorted(be.name for be in fleet._backends),
+    }
+
+
+def _fleet_obs_slo_drill(fleet, admin, make_rows, slo_name, delay_s):
+    """The injected-latency fire/clear drill: arm a delay fault on the
+    balancer's own dispatch so every routed request blows the latency
+    SLO's threshold, poll ``/sloz`` until the fast-burn pair fires,
+    disarm, drive clean traffic until it clears, and verify both
+    transitions landed in ``/eventz``."""
+    from paddle_tpu import faults
+
+    def fast_alert(doc):
+        for obj in doc.get("objectives") or ():
+            if obj.get("name") != slo_name:
+                continue
+            for a in obj.get("alerts") or ():
+                if a.get("pair") == "fast":
+                    return a, obj
+        return None, None
+
+    # continuous injectors keep the SCALED short window populated: a
+    # serial one-at-a-time loop leaves sub-second gaps with no
+    # completions at all, and an empty window reads as burn 0
+    stop = threading.Event()
+
+    def injector(seed):
+        rng_l = np.random.RandomState(seed)
+        while not stop.is_set():
+            try:
+                fleet.infer(make_rows(1, rng_l), timeout_ms=60000)
+            except Exception:
+                pass  # the drill only needs completions, not answers
+
+    injectors = [threading.Thread(target=injector, args=(900 + i,))
+                 for i in range(4)]
+    fired_doc = None
+    cleared = False
+    fired_after_s = cleared_after_s = None
+    try:
+        t0 = time.perf_counter()
+        with faults.armed("fleet.dispatch=delay:%g" % delay_s):
+            for t in injectors:
+                t.start()
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                doc = admin.get_json("/sloz")
+                alert, obj = fast_alert(doc)
+                if alert is not None and alert.get("firing"):
+                    fired_doc = {
+                        "alert": alert,
+                        "burn_5m": (obj["windows"].get("5m")
+                                    or {}).get("burn"),
+                        "burn_1h": (obj["windows"].get("1h")
+                                    or {}).get("burn"),
+                    }
+                    break
+                time.sleep(0.05)
+            fired_after_s = time.perf_counter() - t0
+        if fired_doc is None:
+            raise AssertionError(
+                "fast-burn SLO alert never fired in /sloz under an "
+                "injected %gs dispatch delay" % delay_s)
+
+        # fault disarmed, injectors still running: clean completions
+        # drain the short window and the alert must clear
+        t0 = time.perf_counter()
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            alert, _ = fast_alert(admin.get_json("/sloz"))
+            if alert is not None and not alert.get("firing"):
+                cleared = True
+                break
+            time.sleep(0.05)
+        cleared_after_s = time.perf_counter() - t0
+    finally:
+        stop.set()
+        for t in injectors:
+            t.join(timeout=90.0)
+    if not cleared:
+        raise AssertionError(
+            "fast-burn SLO alert never cleared in /sloz after the "
+            "injection window ended")
+
+    events = (admin.get_json("/eventz").get("events") or ())
+    transitions = {
+        e["kind"]: e for e in events
+        if e.get("kind") in ("slo/fired", "slo/cleared")
+        and e.get("slo") == slo_name and e.get("pair") == "fast"}
+    if "slo/fired" not in transitions:
+        raise AssertionError(
+            "no fast-pair slo/fired event for %r in federated /eventz"
+            % slo_name)
+    if transitions["slo/fired"].get("severity") != "critical":
+        raise AssertionError(
+            "fast-pair slo/fired event is not critical: %r"
+            % transitions["slo/fired"])
+    if "slo/cleared" not in transitions:
+        raise AssertionError(
+            "no fast-pair slo/cleared event for %r in federated /eventz"
+            % slo_name)
+    return {
+        "fired_after_s": round(fired_after_s, 2),
+        "cleared_after_s": round(cleared_after_s, 2),
+        "burn_5m_at_fire": fired_doc["burn_5m"],
+        "burn_1h_at_fire": fired_doc["burn_1h"],
+        "events": sorted(transitions),
+    }
+
+
+def run_fleet_obs():
+    """The ``--fleet-obs`` line: the observability control tower on a
+    real 2-child fleet — federation exactness, the SLO fire/clear
+    drill, and the cost of watching (QPS with the tower on vs off)."""
+    import jax
+
+    import bench_common
+    from paddle_tpu import monitor
+    from paddle_tpu.monitor import slo as slo_mod
+    from paddle_tpu.serving import wire
+
+    bench_common.configure_compile_cache(bench_common.HOME_CACHE_DIR)
+    qps_floor = float(os.environ.get("BENCH_OBS_QPS_FLOOR", "0.98"))
+    delay_s = float(os.environ.get("BENCH_OBS_FAULT_DELAY_S", "0.6"))
+    slo_name = "fleet-p99-latency"
+
+    with tempfile.TemporaryDirectory() as tmp:
+        d = os.path.join(tmp, "lenet-obs-fleet")
+        make_rows = _save_lenet(d)
+        fleet = wire.FleetBalancer.from_launch(
+            d, 2, name="obs-fleet",
+            launch_kwargs={"max_batch_size": MAX_BATCH,
+                           "batch_timeout_ms": TIMEOUT_MS,
+                           "queue_capacity": max(64, THREADS * 8)},
+            health_interval_s=0.5, scrape_interval_s=0.5)
+        engine = None
+        try:
+            t0 = time.perf_counter()
+            warmup_compiles = fleet.warmup()
+            warmup_s = time.perf_counter() - t0
+
+            # rinse storm: sockets opened, ladders exercised, so the
+            # off/on comparison below measures the tower, not warmup
+            _fleet_obs_storm(fleet, make_rows, THREADS,
+                             max(4, REQUESTS // 4), seed=100)
+
+            # interleaved off/on pairs, capacity = best storm per mode:
+            # identical-config storms on this shared host jitter ~3-10%
+            # (one-sided — interference only ever slows a storm), so the
+            # max over several interleaved runs is the capacity estimate,
+            # and a below-floor ratio earns extra pairs before failing —
+            # only a REPRODUCIBLE tower tax trips the assert
+            min_pairs = int(os.environ.get("BENCH_OBS_MIN_PAIRS", "3"))
+            max_pairs = int(os.environ.get("BENCH_OBS_MAX_PAIRS", "6"))
+
+            def tower_up():
+                addr = fleet.start_admin()
+                slo_mod.install(
+                    [slo_mod.latency(
+                        slo_name,
+                        histogram="serving_request_latency_seconds",
+                        threshold_s=0.25, target=0.99,
+                        server="obs-fleet")],
+                    interval_s=0.1, window_scale=0.001)
+                return wire.HttpTransport(*addr)
+
+            def tower_down():
+                slo_mod.uninstall()
+                fleet._stop_admin()
+
+            off_runs, on_runs = [], []
+            admin = None
+            pair = 0
+            while True:
+                pair += 1
+                if admin is not None:
+                    tower_down()
+                off_runs.append(_fleet_obs_storm(
+                    fleet, make_rows, THREADS, REQUESTS, seed=200 + pair))
+                admin = tower_up()
+                engine = slo_mod.get()
+                on_runs.append(_fleet_obs_storm(
+                    fleet, make_rows, THREADS, REQUESTS, seed=300 + pair))
+                off = max(off_runs, key=lambda r: r["requests_per_sec"])
+                on = max(on_runs, key=lambda r: r["requests_per_sec"])
+                qps_ratio = round(
+                    on["requests_per_sec"]
+                    / max(1e-9, off["requests_per_sec"]), 3)
+                if pair >= min_pairs and qps_ratio >= qps_floor:
+                    break
+                if pair >= max_pairs:
+                    break
+
+            federation = _fleet_obs_federation_check(fleet, admin)
+            drill = _fleet_obs_slo_drill(
+                fleet, admin, make_rows, slo_name, delay_s)
+
+            recompiles = {}
+            for be in fleet._backends:
+                status = be.transport.get_json("/statusz")
+                recompiles[be.name] = int(status["metrics"]["recompiles"])
+            if any(recompiles.values()):
+                raise AssertionError(
+                    "observed fleet recompiled after warmup: %r"
+                    % recompiles)
+            if qps_ratio < qps_floor:
+                raise AssertionError(
+                    "observability tax too high: QPS with federation+SLO "
+                    "on is %.3fx off (floor %.2f; off=%s on=%s)"
+                    % (qps_ratio, qps_floor, off["requests_per_sec"],
+                       on["requests_per_sec"]))
+
+            burn = monitor.snapshot().get("slo_burn_rate") or {}
+            return {
+                "metric": "serving_fleet_obs_qps_ratio",
+                "unit": "ratio",
+                "value": qps_ratio,
+                "children": 2,
+                "off": off,
+                "on": on,
+                "qps_floor": qps_floor,
+                "storm_pairs": pair,
+                "federation": federation,
+                "slo_drill": drill,
+                "burn_gauge_series": len(burn.get("series", ())),
+                "recompiles_after_warmup": recompiles,
+                "warmup_compiles": int(warmup_compiles),
+                "warmup_s": round(warmup_s, 2),
+                "threads": THREADS,
+                "requests_per_thread": REQUESTS,
+                "max_batch_size": MAX_BATCH,
+                "batch_timeout_ms": TIMEOUT_MS,
+                "platform": jax.devices()[0].platform,
+            }
+        finally:
+            if engine is not None:
+                slo_mod.uninstall()
+            fleet.stop(shutdown_backends=True)
+
+
 def main():
     import bench_common
 
@@ -1336,6 +1712,10 @@ def main():
     # registry snapshot next to the JSON line
     import sys
 
+    if "--fleet-obs" in sys.argv[1:] or os.environ.get(
+            "BENCH_SERVING_FLEET_OBS"):
+        bench_common.emit_result(run_fleet_obs())
+        return
     if "--precision" in sys.argv[1:] or os.environ.get(
             "BENCH_SERVING_PRECISION"):
         bench_common.emit_result(run_precision())

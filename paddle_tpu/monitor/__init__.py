@@ -57,9 +57,16 @@ from paddle_tpu.monitor.registry import (
     Histogram,
     MetricsRegistry,
     REGISTRY,
+    merge_expositions,
+    parse_exposition,
+    relabel_exposition,
 )
+from paddle_tpu.monitor import events as events
 from paddle_tpu.monitor import flight as _flight
+from paddle_tpu.monitor import slo as slo
 from paddle_tpu.monitor import spans as _spans
+from paddle_tpu.monitor.events import EventRing, eventz
+from paddle_tpu.monitor.events import emit as emit_event
 from paddle_tpu.monitor.flight import FlightRecorder, new_trace_id
 from paddle_tpu.monitor.push import PushGateway, push_gateway
 from paddle_tpu.monitor.spans import (
@@ -95,6 +102,9 @@ __all__ = [
     "trace_context", "current_trace_ids", "set_thread_lane",
     "new_span_id", "parent_scope", "current_parent",
     "new_trace_id", "flight_recorder", "FlightRecorder",
+    "events", "EventRing", "emit_event", "eventz",
+    "slo",
+    "parse_exposition", "relabel_exposition", "merge_expositions",
     "push_gateway", "PushGateway",
     "export_chrome_trace", "trace_session", "TraceSession",
 ]

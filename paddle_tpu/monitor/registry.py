@@ -31,6 +31,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 __all__ = [
     "Counter", "Gauge", "Histogram", "CallbackCounter", "MetricsRegistry",
     "REGISTRY", "DEFAULT_BUCKETS",
+    "parse_exposition", "relabel_exposition", "merge_expositions",
+    "render_exposition", "aggregate_families",
 ]
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -477,3 +479,211 @@ class MetricsRegistry:
 
 
 REGISTRY = MetricsRegistry()
+
+
+# ---------------------------------------------------------------------------
+# federation helpers: parse / relabel / merge text expositions
+#
+# A fleet balancer scrapes each child's /metrics (text 0.0.4 — the
+# format render_text() above emits), tags every sample with the child's
+# backend id, and re-exposes the union alongside its own registry.  The
+# helpers below are that pipeline: text -> family dict -> relabel ->
+# merge -> text.  A routing tree of balancers federates transitively
+# because relabel PREFIXES an existing backend label instead of
+# clobbering it ("edge" scraping a child already labeled backend="b1"
+# yields backend="edge/b1").
+# ---------------------------------------------------------------------------
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)(?:\s+\S+)?\s*$")
+_LABEL_PAIR_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _unescape(s: str) -> str:
+    return re.sub(
+        r"\\(.)", lambda m: {"n": "\n"}.get(m.group(1), m.group(1)), s)
+
+
+def parse_exposition(text: str) -> Dict[str, Dict[str, object]]:
+    """Parse a Prometheus text-0.0.4 exposition into an insertion-ordered
+    family dict::
+
+        {family: {"type": kind, "help": help,
+                  "samples": [(sample_name, labels_dict, value), ...]}}
+
+    ``value`` is a float (``+Inf`` parses to ``inf``).  Histogram
+    families keep their flattened ``_bucket``/``_sum``/``_count``
+    samples verbatim — merging re-emits them untouched, so federated
+    output round-trips exactly.  Unrecognized/comment lines are skipped;
+    a sample with no preceding TYPE gets an ``untyped`` family."""
+    families: Dict[str, Dict[str, object]] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line == "# EOF":
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+                fam = families.setdefault(
+                    parts[2], {"type": "untyped", "help": "",
+                               "samples": []})
+                if parts[1] == "TYPE":
+                    fam["type"] = parts[3].strip() if len(parts) > 3 else "untyped"
+                else:
+                    fam["help"] = parts[3] if len(parts) > 3 else ""
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, label_blob, value_str = m.group(1), m.group(2), m.group(3)
+        labels: Dict[str, str] = {}
+        if label_blob:
+            for k, v in _LABEL_PAIR_RE.findall(label_blob):
+                labels[k] = _unescape(v)
+        try:
+            value = float(value_str)
+        except ValueError:
+            continue
+        family = name
+        if family not in families:
+            for suffix in _HIST_SUFFIXES:
+                if name.endswith(suffix) and name[: -len(suffix)] in families:
+                    family = name[: -len(suffix)]
+                    break
+        fam = families.setdefault(
+            family, {"type": "untyped", "help": "", "samples": []})
+        fam["samples"].append((name, labels, value))
+    return families
+
+
+def relabel_exposition(families: Dict[str, Dict[str, object]],
+                       label: str, value: str,
+                       ) -> Dict[str, Dict[str, object]]:
+    """A new family dict with ``label=value`` stamped onto every sample.
+    A sample that already carries ``label`` (this scrape target is
+    itself a federating balancer) gets the new value PREFIXED —
+    ``value + "/" + old`` — preserving the full routing path."""
+    out: Dict[str, Dict[str, object]] = {}
+    for fam_name, fam in families.items():
+        samples = []
+        for name, labels, v in fam["samples"]:
+            labels = dict(labels)
+            old = labels.get(label)
+            labels[label] = ("%s/%s" % (value, old)) if old else str(value)
+            samples.append((name, labels, v))
+        out[fam_name] = {"type": fam["type"], "help": fam["help"],
+                         "samples": samples}
+    return out
+
+
+def merge_expositions(expositions: Sequence[Dict[str, Dict[str, object]]],
+                      ) -> Dict[str, Dict[str, object]]:
+    """Merge parsed expositions into one family dict: first-seen HELP /
+    TYPE wins per family, samples concatenate in input order.  Callers
+    are responsible for label-disjointness (relabel_exposition's
+    ``backend`` tag) — duplicate series are emitted as-is."""
+    merged: Dict[str, Dict[str, object]] = {}
+    for families in expositions:
+        for fam_name, fam in families.items():
+            into = merged.get(fam_name)
+            if into is None:
+                merged[fam_name] = {"type": fam["type"], "help": fam["help"],
+                                    "samples": list(fam["samples"])}
+            else:
+                if into["type"] == "untyped" and fam["type"] != "untyped":
+                    into["type"] = fam["type"]
+                if not into["help"]:
+                    into["help"] = fam["help"]
+                into["samples"].extend(fam["samples"])
+    return merged
+
+
+def render_exposition(families: Dict[str, Dict[str, object]]) -> str:
+    """Render a (parsed/relabeled/merged) family dict back to Prometheus
+    text 0.0.4 — one HELP/TYPE block per family name."""
+    lines: List[str] = []
+    for fam_name in sorted(families):
+        fam = families[fam_name]
+        if fam["help"]:
+            lines.append("# HELP %s %s"
+                         % (fam_name, str(fam["help"]).replace("\n", " ")))
+        lines.append("# TYPE %s %s" % (fam_name, fam["type"]))
+        for name, labels, value in fam["samples"]:
+            if float(value) == math.inf:
+                val = "+Inf"
+            elif value == int(value) and abs(value) < 1e15:
+                val = "%d" % int(value)
+            else:
+                val = _fmt(value)
+            lines.append("%s%s %s" % (name, _label_str(labels), val))
+    return "\n".join(lines) + "\n"
+
+
+def aggregate_families(families: Dict[str, Dict[str, object]],
+                       quantiles: Sequence[float] = (0.5, 0.99),
+                       ) -> Dict[str, Dict[str, object]]:
+    """True cross-series aggregates of a (merged) exposition — the
+    fleet-/statusz view: counters sum, gauges take the worst case
+    (max), histograms merge bucket-wise with count/sum/mean and
+    bucket-interpolated quantile estimates::
+
+        {"counters": {name: sum}, "gauges": {name: max},
+         "histograms": {name: {"count", "sum", "mean",
+                               "p50_est", "p99_est"}}}
+    """
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    histograms: Dict[str, Dict[str, object]] = {}
+    for fam_name, fam in families.items():
+        kind = fam["type"]
+        if kind == "counter":
+            counters[fam_name] = sum(v for _, _, v in fam["samples"])
+        elif kind == "gauge":
+            vals = [v for _, _, v in fam["samples"]]
+            if vals:
+                gauges[fam_name] = max(vals)
+        elif kind == "histogram":
+            count = 0.0
+            total = 0.0
+            buckets: Dict[float, float] = {}
+            for name, labels, v in fam["samples"]:
+                if name.endswith("_count"):
+                    count += v
+                elif name.endswith("_sum"):
+                    total += v
+                elif name.endswith("_bucket"):
+                    le = labels.get("le", "+Inf")
+                    f = math.inf if le == "+Inf" else float(le)
+                    buckets[f] = buckets.get(f, 0.0) + v
+            agg: Dict[str, object] = {
+                "count": count, "sum": total,
+                "mean": (total / count) if count else None,
+            }
+            for q in quantiles:
+                key = "p%g_est" % (q * 100.0)
+                agg[key] = _bucket_quantile(buckets, count, q)
+            histograms[fam_name] = agg
+    return {"counters": counters, "gauges": gauges,
+            "histograms": histograms}
+
+
+def _bucket_quantile(buckets: Dict[float, float], count: float,
+                     q: float) -> Optional[float]:
+    """Linear-interpolated quantile estimate from merged cumulative
+    buckets (the textbook Prometheus ``histogram_quantile``)."""
+    if not buckets or count <= 0:
+        return None
+    rank = q * count
+    prev_le, prev_cum = 0.0, 0.0
+    for le in sorted(buckets):
+        cum = buckets[le]
+        if cum >= rank:
+            if le == math.inf:
+                return prev_le if prev_cum else None
+            width = le - prev_le
+            frac = ((rank - prev_cum) / (cum - prev_cum)
+                    if cum > prev_cum else 1.0)
+            return prev_le + width * frac
+        prev_le, prev_cum = le, cum
+    return prev_le

@@ -55,6 +55,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from paddle_tpu import monitor
+from paddle_tpu.monitor import events as _events
 
 __all__ = [
     "PRIORITY_HIGH", "PRIORITY_NORMAL", "PRIORITY_LOW",
@@ -477,9 +478,12 @@ class BrownoutController:
                 self.level += direction
                 self._pending = None
                 self._gauge.set(self.level)
-                monitor.record_instant(
-                    "serving/brownout", cat="serving", server=self.name,
-                    level=self.level)
+                # event ring + span-stream instant in one call; a level
+                # RISE is degradation (warning), easing back is info
+                _events.emit(
+                    "serving/brownout",
+                    severity="warning" if direction > 0 else "info",
+                    cat="serving", server=self.name, level=self.level)
             return self.level
 
     def close(self) -> None:

@@ -250,6 +250,23 @@ class RemoteClient:
     def healthz(self) -> Dict[str, object]:
         return self._transport.get_json("/healthz")
 
+    # -- admin observability surfaces (tooling/test conveniences) ------
+    def statusz(self) -> Dict[str, object]:
+        return self._transport.get_json("/statusz")
+
+    def tracez(self) -> Dict[str, object]:
+        return self._transport.get_json("/tracez")
+
+    def sloz(self) -> Dict[str, object]:
+        return self._transport.get_json("/sloz")
+
+    def eventz(self) -> Dict[str, object]:
+        return self._transport.get_json("/eventz")
+
+    def metrics_text(self) -> str:
+        """The raw ``/metrics`` exposition (what a scraper sees)."""
+        return self._transport.get_text("/metrics")
+
     def warmup(self, timeout_s: float = 600.0) -> int:
         """Trigger the remote server's bucket-ladder warmup; returns the
         XLA compile count it performed."""

@@ -33,6 +33,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from paddle_tpu.monitor import spans as _spans
 from paddle_tpu.serving.errors import WireProtocolError
 from paddle_tpu.serving.wire.metrics import WIRE_CODEC_SECONDS
 
@@ -53,6 +54,16 @@ DEFAULT_MAX_FRAMES = 4096           # meta + arrays + end
 
 _ENC = WIRE_CODEC_SECONDS.labels(op="encode")
 _DEC = WIRE_CODEC_SECONDS.labels(op="decode")
+
+
+def _codec_exemplar() -> Optional[Dict[str, str]]:
+    """Exemplar linking a codec observation to the request being
+    encoded/decoded: the calling thread's active trace context (a
+    tuple read — free when no request attribution is live), so
+    ``/metrics?openmetrics`` tails point into ``/tracez`` here exactly
+    like the executor and serving-latency histograms."""
+    ids = _spans.current_trace_ids()
+    return {"trace_id": ids[0]} if ids else None
 
 
 def encode_message(meta: Dict[str, object],
@@ -84,7 +95,7 @@ def encode_message(meta: Dict[str, object],
     buf.write(_HEADER.pack(_KIND_END, 0))
     out = buf.getvalue()
     # hot-path: end wire_encode
-    _ENC.observe(time.perf_counter() - t0)
+    _ENC.observe(time.perf_counter() - t0, exemplar=_codec_exemplar())
     return out
 
 
@@ -156,7 +167,7 @@ def read_message(f, max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
         raise WireProtocolError(
             "message exceeds %d frames without an end frame" % max_frames)
     # hot-path: end wire_decode
-    _DEC.observe(time.perf_counter() - t0)
+    _DEC.observe(time.perf_counter() - t0, exemplar=_codec_exemplar())
     return meta, arrays
 
 

@@ -39,6 +39,7 @@ below N-1 and cold jit caches never see traffic.
 from __future__ import annotations
 
 import hashlib
+import json
 import random
 import threading
 import time
@@ -52,7 +53,10 @@ from paddle_tpu import faults as _faults
 from paddle_tpu import monitor
 from paddle_tpu.faults.metrics import BACKEND_HALFOPEN_PROBES
 from paddle_tpu.faults.retry import RetryPolicy
+from paddle_tpu.monitor import events as _events
 from paddle_tpu.monitor import flight as _flight
+from paddle_tpu.monitor import registry as _registry
+from paddle_tpu.monitor import slo as _slo
 from paddle_tpu.monitor import spans as _spans
 from paddle_tpu.serving import errors as _errors
 from paddle_tpu.serving.errors import (
@@ -74,6 +78,8 @@ from paddle_tpu.serving.wire.client import (
 )
 from paddle_tpu.serving.wire.http import HttpTransport
 from paddle_tpu.serving.wire.metrics import (
+    FEDERATION_SCRAPES,
+    FEDERATION_STALENESS,
     FLEET_AFFINITY_HITS,
     RETRY_THROTTLED,
     WIRE_BACKEND_RETIRED,
@@ -225,7 +231,9 @@ class FleetBalancer:
                  retry_burst: int = 32,
                  prefix_affinity: bool = False,
                  affinity_block: int = 16,
-                 affinity_hints: int = 1024):
+                 affinity_hints: int = 1024,
+                 admin_port: Optional[int] = None,
+                 scrape_interval_s: float = 2.0):
         if not backends:
             raise ValueError("FleetBalancer needs at least one backend")
         self.name = name
@@ -271,11 +279,26 @@ class FleetBalancer:
         self._pool = None  # lazy persistent executor (infer_many)
         self._health_stop = threading.Event()
         self._health_thread = None
+        # observability federation: the health thread doubles as the
+        # scraper (admin tier only — a balancer without an admin port
+        # never issues a scrape), caching each child's /metrics text and
+        # /statusz /tracez /eventz docs for the federated admin surface
+        self._scrape_interval_s = float(scrape_interval_s)
+        self._scrape_lock = threading.Lock()
+        self._scrapes: Dict[int, Dict[str, object]] = {}
+        self._scrape_ok = FEDERATION_SCRAPES.labels(fleet=name, status="ok")
+        self._scrape_err = FEDERATION_SCRAPES.labels(
+            fleet=name, status="error")
+        self._staleness = FEDERATION_STALENESS.labels(fleet=name)
+        self._admin_server = None
+        self._admin_thread = None
         if health_interval_s:
             self._health_thread = threading.Thread(
                 target=self._health_loop, args=(float(health_interval_s),),
                 name="wire-fleet-health-%s" % name, daemon=True)
             self._health_thread.start()
+        if admin_port is not None:
+            self.start_admin(admin_port)
 
     # ------------------------------------------------------------------
     @classmethod
@@ -536,8 +559,9 @@ class FleetBalancer:
         be.alive = False
         be.retired_at = time.monotonic()  # half-open cooldown starts now
         self._retired_counter.inc()
-        monitor.record_instant(
-            "wire/backend_retired", cat="wire",
+        # event ring + span-stream instant in one call (emit forwards)
+        _events.emit(
+            "wire/backend_retired", severity="error", cat="wire",
             fleet=self.name, backend=be.name, reason=why)
         self._route_cv.notify_all()
 
@@ -1041,6 +1065,11 @@ class FleetBalancer:
                     with self._route_cv:
                         if be.alive:
                             self._retire_locked(be, "health checks")
+            # observability federation rides the same background loop
+            # (never the request path): scrape due backends' admin
+            # surfaces into the cache the admin endpoints serve from
+            if self._admin_server is not None:
+                self._scrape_pass()
             self._reanimate()
             with self._route_cv:
                 nxt = min((b.next_probe_at for b in self._backends
@@ -1119,8 +1148,8 @@ class FleetBalancer:
                 else:
                     be.retired_at = time.monotonic()
             if ok:
-                monitor.record_instant(
-                    "wire/backend_readmitted", cat="wire",
+                _events.emit(
+                    "wire/backend_readmitted", severity="info", cat="wire",
                     fleet=self.name, backend=be.name)
 
     def check_health(self) -> Dict[str, bool]:
@@ -1136,6 +1165,288 @@ class FleetBalancer:
                 self._health_failures.inc()
                 out[be.name] = False
         return out
+
+    # ------------------------------------------------------------------
+    # observability federation: scrape cache + fleet-merged admin docs
+    # ------------------------------------------------------------------
+    def _scrape_backend(self, be: _Backend) -> None:
+        """Fetch one backend's observability surfaces into the cache.
+        Partial failure keeps the previous (stale) docs — the federated
+        view degrades to older data, never to a hole."""
+        docs: Dict[str, object] = {}
+        ok = True
+        try:
+            docs["metrics_text"] = be.transport.get_text(
+                "/metrics", timeout_s=2.0)
+        except (ServingError, NotImplementedError):
+            ok = False
+        for key, path in (("statusz", "/statusz"), ("tracez", "/tracez"),
+                          ("eventz", "/eventz")):
+            try:
+                docs[key] = be.transport.get_json(path, timeout_s=2.0)
+            except ServingError:
+                ok = False
+        (self._scrape_ok if ok else self._scrape_err).inc()
+        if not docs:
+            return
+        with self._scrape_lock:
+            ent = self._scrapes.setdefault(be.idx, {})
+            ent.update(docs)
+            ent["backend"] = be.name
+            ent["ts"] = time.monotonic()
+            ent["wall_ts"] = time.time()
+
+    def _scrape_pass(self, force: bool = False) -> None:
+        """One scrape round over live backends whose per-backend clock
+        is due (``force`` ignores the clocks), then refresh the
+        worst-case staleness gauge."""
+        with self._route_cv:
+            targets = [b for b in self._backends if b.alive]
+        now = time.monotonic()
+        for be in targets:
+            with self._scrape_lock:
+                due = self._scrapes.get(be.idx, {}).get("next_at", 0.0)
+            if not force and due > now:
+                continue
+            with self._scrape_lock:
+                self._scrapes.setdefault(be.idx, {})["next_at"] = (
+                    now + self._scrape_interval_s)
+            self._scrape_backend(be)
+        with self._scrape_lock:
+            ages = [time.monotonic() - s["ts"]
+                    for b in targets
+                    for s in (self._scrapes.get(b.idx),)
+                    if s is not None and "ts" in s]
+        if ages:
+            self._staleness.set(round(max(ages), 3))
+
+    def scrape_once(self) -> None:
+        """Synchronously refresh every live backend's cached
+        observability docs (bench/test convenience; the health loop
+        does this continuously once the admin tier is up)."""
+        self._scrape_pass(force=True)
+
+    def _scrape_snapshot(self) -> List[Dict[str, object]]:
+        with self._scrape_lock:
+            return [dict(self._scrapes[i]) for i in sorted(self._scrapes)
+                    if "backend" in self._scrapes[i]]
+
+    def federated_metrics(self) -> str:
+        """The balancer's own registry plus every scraped child
+        exposition re-labeled ``backend=<id>`` (an already-labeled
+        child — itself a federating balancer — gets prefixed, so a
+        routing tree federates transitively), merged into one
+        Prometheus text-0.0.4 document."""
+        parts = [_registry.parse_exposition(monitor.render_text())]
+        for s in self._scrape_snapshot():
+            text = s.get("metrics_text")
+            if not text:
+                continue
+            parts.append(_registry.relabel_exposition(
+                _registry.parse_exposition(text), "backend",
+                str(s["backend"])))
+        return _registry.render_exposition(
+            _registry.merge_expositions(parts))
+
+    def federated_statusz(self) -> Dict[str, object]:
+        """Fleet-merged ``/statusz``: the balancer's own routing view,
+        every child's cached statusz verbatim, and TRUE cross-fleet
+        aggregates over the scraped expositions (summed counters,
+        bucket-merged histograms with estimated quantiles, worst-case
+        gauges)."""
+        now = time.monotonic()
+        scrapes = self._scrape_snapshot()
+        children = {}
+        parts = []
+        for s in scrapes:
+            entry: Dict[str, object] = {
+                "age_s": round(now - s["ts"], 3) if "ts" in s else None}
+            if "statusz" in s:
+                entry["statusz"] = s["statusz"]
+            children[str(s["backend"])] = entry
+            if s.get("metrics_text"):
+                parts.append(_registry.parse_exposition(s["metrics_text"]))
+        return {
+            "fleet": self.name,
+            "role": "balancer",
+            "balancer": self.metrics(),
+            "backends": children,
+            "aggregate": _registry.aggregate_families(
+                _registry.merge_expositions(parts)),
+        }
+
+    def federated_tracez(self) -> Dict[str, object]:
+        """One slow-request list across the fleet: the balancer's own
+        flight recorder plus every child's cached ``/tracez``, records
+        tagged with the backend they came from (trace trees intact),
+        newest first."""
+        requests: List[Dict[str, object]] = []
+        retained: Dict[str, int] = {}
+        fr = _flight.get()
+        if fr is not None:
+            own = fr.statusz()
+            retained["_balancer"] = own.get("retained", 0)
+            for r in own.get("requests", ()):
+                r = dict(r)
+                r["backend"] = "_balancer"
+                requests.append(r)
+        for s in self._scrape_snapshot():
+            doc = s.get("tracez")
+            if not isinstance(doc, dict):
+                continue
+            name = str(s["backend"])
+            retained[name] = doc.get("retained", 0)
+            for r in doc.get("requests", ()):
+                r = dict(r)
+                r["backend"] = name
+                requests.append(r)
+        requests.sort(key=lambda r: r.get("ts") or 0.0, reverse=True)
+        return {"fleet": self.name, "role": "balancer",
+                "backends": retained, "requests": requests}
+
+    def federated_eventz(self) -> Dict[str, object]:
+        """Fleet-merged operational event tail: the balancer's own ring
+        plus every child's cached ``/eventz``, backend-tagged, ordered
+        by wall timestamp."""
+        merged: List[Dict[str, object]] = []
+        own = _events.eventz()
+        for e in own.get("events", ()):
+            e = dict(e)
+            e["backend"] = "_balancer"
+            merged.append(e)
+        backends = {"_balancer": own.get("retained", 0)}
+        for s in self._scrape_snapshot():
+            doc = s.get("eventz")
+            if not isinstance(doc, dict):
+                continue
+            name = str(s["backend"])
+            backends[name] = doc.get("retained", 0)
+            for e in doc.get("events", ()):
+                e = dict(e)
+                e["backend"] = name
+                merged.append(e)
+        merged.sort(key=lambda e: e.get("ts") or 0.0)
+        return {"fleet": self.name, "role": "balancer",
+                "backends": backends, "events": merged}
+
+    def admin_healthz(self) -> Dict[str, object]:
+        with self._route_cv:
+            alive = sum(1 for b in self._backends if b.alive)
+            total = len(self._backends)
+            closed = self._closed
+        return {"ok": not closed and alive > 0, "role": "balancer",
+                "fleet": self.name, "backends_alive": alive,
+                "backends_total": total}
+
+    # ------------------------------------------------------------------
+    # admin HTTP tier: the balancer's own pane of glass
+    # ------------------------------------------------------------------
+    def start_admin(self, port: int = 0) -> Tuple[str, int]:
+        """Serve the federated observability surface from this balancer:
+        ``/healthz /metrics /statusz /tracez /sloz /eventz`` (GET) and
+        ``/quitquitquit`` (POST).  ``port=0`` binds an ephemeral port;
+        returns the bound ``(host, port)`` (also ``admin_address``).
+        Starting the admin tier is what arms the federation scraper on
+        the health loop — a balancer without one never scrapes."""
+        if self._admin_server is not None:
+            return self.admin_address
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        fleet = self
+
+        class _AdminHandler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet, like the wire server
+                pass
+
+            def _send(self, status: int, body: bytes, ctype: str) -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                try:
+                    self.wfile.write(body)
+                except (ConnectionError, BrokenPipeError):
+                    pass
+
+            def _send_json(self, doc, status: int = 200) -> None:
+                self._send(status, json.dumps(doc).encode("utf-8"),
+                           "application/json; charset=utf-8")
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/healthz":
+                        self._send_json(fleet.admin_healthz())
+                    elif path == "/metrics":
+                        self._send(
+                            200,
+                            fleet.federated_metrics().encode("utf-8"),
+                            "text/plain; version=0.0.4; charset=utf-8")
+                    elif path == "/statusz":
+                        self._send_json(fleet.federated_statusz())
+                    elif path == "/tracez":
+                        self._send_json(fleet.federated_tracez())
+                    elif path == "/sloz":
+                        self._send_json(_slo.sloz())
+                    elif path == "/eventz":
+                        self._send_json(fleet.federated_eventz())
+                    else:
+                        self.send_error(404, "unknown path")
+                except Exception as e:  # noqa: BLE001 — typed to the peer
+                    self._send_json({"error": type(e).__name__,
+                                     "message": str(e)}, status=500)
+
+            def do_POST(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/quitquitquit":
+                    self._send_json({"ok": True, "admin_stopping": True})
+                    threading.Thread(
+                        target=fleet._stop_admin,
+                        name="fleet-admin-quit", daemon=True).start()
+                else:
+                    self.send_error(404, "unknown path")
+
+        class _QuietAdminServer(ThreadingHTTPServer):
+            daemon_threads = True
+
+            def handle_error(self, request, client_address):
+                import sys
+                exc = sys.exc_info()[1]
+                if isinstance(exc, (ConnectionError, BrokenPipeError)):
+                    return
+                super().handle_error(request, client_address)
+
+        srv = _QuietAdminServer(("127.0.0.1", int(port)), _AdminHandler)
+        self._admin_server = srv
+        self._admin_thread = threading.Thread(
+            target=srv.serve_forever,
+            name="fleet-admin-%s" % self.name, daemon=True)
+        self._admin_thread.start()
+        # first federated view without waiting a full scrape interval
+        try:
+            self.scrape_once()
+        except Exception:
+            pass
+        return self.admin_address
+
+    @property
+    def admin_address(self) -> Optional[Tuple[str, int]]:
+        """Bound ``(host, port)`` of the admin tier, or None."""
+        srv = self._admin_server
+        if srv is None:
+            return None
+        return srv.server_address[0], srv.server_address[1]
+
+    def _stop_admin(self) -> None:
+        srv, self._admin_server = self._admin_server, None
+        thread, self._admin_thread = self._admin_thread, None
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=5.0)
 
     def rolling_replace(self, warmup: bool = True,
                         drain_timeout_s: float = 30.0
@@ -1169,8 +1480,8 @@ class FleetBalancer:
                 deadline = time.monotonic() + drain_timeout_s
                 while old.in_flight > 0 and time.monotonic() < deadline:
                     self._route_cv.wait(timeout=0.1)
-            monitor.record_instant(
-                "wire/backend_replaced", cat="wire",
+            _events.emit(
+                "wire/backend_replaced", severity="info", cat="wire",
                 fleet=self.name, old=old.name, new=be.name)
             old.handle.shutdown(timeout_s=drain_timeout_s)
             old.transport.close()
@@ -1190,6 +1501,11 @@ class FleetBalancer:
         self._health_stop.set()
         if self._health_thread is not None:
             self._health_thread.join(timeout=5.0)
+        self._stop_admin()
+        # retire this fleet's federation series from the exposition
+        FEDERATION_SCRAPES.remove_labels(fleet=self.name, status="ok")
+        FEDERATION_SCRAPES.remove_labels(fleet=self.name, status="error")
+        FEDERATION_STALENESS.remove_labels(fleet=self.name)
         with self._shape_lock:
             pool, self._pool = self._pool, None
         if pool is not None:

@@ -80,6 +80,14 @@ class Transport(abc.ABC):
                  timeout_s: Optional[float] = None) -> Dict[str, object]:
         """GET a JSON control document (health/status surfaces)."""
 
+    def get_text(self, path: str,
+                 timeout_s: Optional[float] = None) -> str:
+        """GET a plain-text document (the ``/metrics`` exposition — the
+        federation scraper's fetch).  Optional: a transport that cannot
+        serve raw text raises — the scraper just skips the backend."""
+        raise NotImplementedError(
+            "%s does not support text GETs" % type(self).__name__)
+
     @abc.abstractmethod
     def close(self) -> None:
         """Release pooled connections (idempotent)."""
@@ -315,6 +323,29 @@ class HttpTransport(Transport):
 
             raise WireProtocolError("undecodable JSON from %s: %s"
                                     % (path, e)) from e
+
+    def get_text(self, path: str,
+                 timeout_s: Optional[float] = None) -> str:
+        conn = self._conn(timeout_s)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            payload = resp.read()
+        except socket.timeout as e:
+            self._drop_conn()
+            raise DeadlineExceeded(
+                "wire GET %s on %s:%d timed out"
+                % ((path,) + self.address)) from e
+        except (ConnectionError, http.client.HTTPException, OSError) as e:
+            self._drop_conn()
+            raise BackendUnavailable(
+                "backend %s:%d unreachable: %r" % (self._host, self._port, e)
+            ) from e
+        if resp.status != 200:
+            raise BackendUnavailable(
+                "GET %s on %s:%d -> HTTP %d"
+                % (path, self._host, self._port, resp.status))
+        return payload.decode("utf-8", errors="replace")
 
     def close(self) -> None:
         self._closed = True

@@ -20,6 +20,7 @@ __all__ = [
     "WIRE_HEALTH_CHECKS", "WIRE_HEALTH_CHECK_FAILURES",
     "WIRE_BACKEND_RELAUNCHES", "RETRY_THROTTLED",
     "FLEET_AFFINITY_HITS",
+    "FEDERATION_SCRAPES", "FEDERATION_STALENESS",
 ]
 
 WIRE_REQUESTS = _registry.REGISTRY.counter(
@@ -59,6 +60,16 @@ RETRY_THROTTLED = _registry.REGISTRY.counter(
     "fleet re-dispatches the token-bucket retry throttle denied: the "
     "typed error propagated to the caller instead of amplifying load "
     "on a saturated backend (back-pressure, not a retry storm)",
+    ("fleet",))
+FEDERATION_SCRAPES = _registry.REGISTRY.counter(
+    "wire_federation_scrapes_total",
+    "balancer observability scrapes of child admin surfaces "
+    "(status=ok|error; one count per backend per scrape pass)",
+    ("fleet", "status"))
+FEDERATION_STALENESS = _registry.REGISTRY.gauge(
+    "wire_federation_staleness_seconds",
+    "age of the OLDEST live backend's last successful observability "
+    "scrape (worst-case staleness of the balancer's federated view)",
     ("fleet",))
 FLEET_AFFINITY_HITS = _registry.REGISTRY.counter(
     "serving_fleet_affinity_hits_total",

@@ -35,7 +35,9 @@ import time
 from typing import Dict, Optional, Tuple
 
 from paddle_tpu import monitor
+from paddle_tpu.monitor import events as _events
 from paddle_tpu.monitor import flight as _flight
+from paddle_tpu.monitor import slo as _slo
 from paddle_tpu.monitor import spans as _spans
 from paddle_tpu.serving.errors import (
     DeadlineExceeded,
@@ -191,6 +193,10 @@ class ServingProcess:
                         self._send_json(sp.server.statusz())
                     elif path == "/tracez":
                         self._send_json(sp.server.tracez())
+                    elif path == "/sloz":
+                        self._send_json(_slo.sloz())
+                    elif path == "/eventz":
+                        self._send_json(_events.eventz())
                     else:
                         self.send_error(404, "unknown path")
                 except Exception as e:  # noqa: BLE001 — typed to the peer

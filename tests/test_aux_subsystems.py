@@ -727,3 +727,85 @@ def test_configure_compile_cache_subprocess_contract(tmp_path):
     # explicitly empty -> disabled (config None), env left empty
     got = run({"JAX_COMPILATION_CACHE_DIR": ""}, want)
     assert got == {"ret": None, "env": "", "cfg": None}
+
+
+def test_fleet_top_once_renders_a_live_fleet():
+    """``tools/fleet_top.py --once`` against a REAL 2-child stub fleet's
+    federated admin tier: one frame on stdout, exit code 0 — the
+    operator console's CI smoke (PR 17)."""
+    import sys
+    import threading
+    import time
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import fleet_top
+
+    from paddle_tpu.monitor import slo as slo_mod
+    from paddle_tpu.serving import wire
+    from paddle_tpu.serving.server import InferenceServer
+
+    class _Stub:
+        def get_input_names(self):
+            return ["x"]
+
+        def get_output_names(self):
+            return ["y"]
+
+        def input_specs(self):
+            return {"x": ((8,), np.dtype("float32"))}
+
+        def jit_cache_stats(self):
+            return {"entries": 0, "hits": 0, "misses": 0}
+
+        def run_padded(self, feed, n_valid=None):
+            return [np.asarray(feed["x"][:n_valid]).sum(
+                axis=1, keepdims=True)]
+
+    sps = []
+    for i in range(2):
+        srv = InferenceServer(_Stub(), max_batch_size=8,
+                              batch_timeout_ms=1, name="top-%d" % i)
+        sp = wire.ServingProcess(srv)
+        sp.start()
+        sps.append(sp)
+    fleet = wire.FleetBalancer(
+        [sp.address for sp in sps], name="topfleet",
+        health_interval_s=0.2, admin_port=0, scrape_interval_s=0.1)
+    eng = slo_mod.install(
+        [slo_mod.availability("top-avail", good="wire_requests_total",
+                              bad="wire_backend_retired_total",
+                              target=0.999)],
+        interval_s=0.05, window_scale=0.001)
+    try:
+        rng = np.random.RandomState(0)
+        for _ in range(5):
+            fleet.infer({"x": rng.rand(2, 8).astype("float32")})
+        deadline = time.monotonic() + 5
+        while eng._ticks == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        fleet.scrape_once()
+        host, port = fleet.admin_address
+
+        out = _io.StringIO()
+        real = sys.stdout
+        sys.stdout = out
+        try:
+            rc = fleet_top.main(
+                ["%s:%d" % (host, port), "--once", "--no-color"])
+        finally:
+            sys.stdout = real
+        frame = out.getvalue()
+        assert rc == 0
+        assert "topfleet" in frame and "BACKEND" in frame
+        assert "2/2 alive" in frame
+        assert "top-avail" in frame  # the SLO table rendered
+        # a dead admin address exits 1, not a traceback
+        assert fleet_top.main(
+            ["127.0.0.1:1", "--once", "--no-color"]) == 1
+    finally:
+        slo_mod.uninstall()
+        fleet.stop()
+        for sp in sps:
+            sp.stop()

@@ -87,6 +87,24 @@ BANNED_TOKENS = [
     "np.array(",
     "time.sleep",
     ".copy_to_host",
+    # observability background work: the SLO evaluator and the
+    # federation scraper are background-thread-only by contract — a
+    # registry-wide snapshot/evaluate or a child-admin HTTP fetch
+    # inside a request hot region would trade tail latency for a
+    # dashboard.  (events are transition-rate, also never hot-path.)
+    "evaluate_once",
+    "_scrape_pass",
+    "scrape_once",
+    "_scrape_backend",
+    ".get_text(",
+    "federated_metrics",
+    "federated_statusz",
+    "federated_tracez",
+    "federated_eventz",
+    "_events.emit",
+    "events.emit",
+    ".sloz(",
+    ".eventz(",
 ]
 
 _BEGIN = re.compile(r"#\s*hot-path:\s*begin\b\s*(?P<label>[\w./-]*)")

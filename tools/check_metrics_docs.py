@@ -30,6 +30,8 @@ from typing import Set, Tuple
 REGISTERING_MODULES = [
     "paddle_tpu.monitor",
     "paddle_tpu.monitor.flight",
+    "paddle_tpu.monitor.events",
+    "paddle_tpu.monitor.slo",
     "paddle_tpu.monitor.push",
     "paddle_tpu.executor",
     "paddle_tpu.reader",
