@@ -256,13 +256,20 @@ def _merge_cal(res, cal):
 # serving_observability stage (the 2-child LeNet fleet under the
 # staggered storm twice — bare vs federated admin + SLO engine — plus
 # the injected-latency fire/clear drill; ~55 s measured cold, the one
-# endpoint compiles through the persistent cache).
-_BUDGETS = {"probe": 90, "bert": 570, "resnet": 540, "cal": 480, "nmt": 540,
-            "deepfm": 360, "deepfm_sparse": 90, "dispatch_sharded": 90,
+# endpoint compiles through the persistent cache).  Rebalanced r18
+# (bert 570->540, resnet 540->510, nmt 540->510): frees 90 s for the
+# precision × sharding legs — serving_precision 120->150 (the tp
+# transformer-LM endpoint sharded-fp32 vs composed sharded-bf16 on the
+# CPU mesh), serving_decode 180->210 (the int8-KV parity +
+# fixed-HBM-concurrency leg: two small decode servers reusing the
+# stage's persistent cache), deepfm_sparse 90->120 (the int8-row
+# fp32-parity double-train on a trimmed 200k-row table).
+_BUDGETS = {"probe": 90, "bert": 540, "resnet": 510, "cal": 480, "nmt": 510,
+            "deepfm": 360, "deepfm_sparse": 120, "dispatch_sharded": 90,
             "dispatch_sharded_train": 60, "checkpoint": 60,
             "serving_wire": 120,
-            "serving_overload": 90, "serving_decode": 180,
-            "serving_sharded": 90, "serving_precision": 120,
+            "serving_overload": 90, "serving_decode": 210,
+            "serving_sharded": 90, "serving_precision": 150,
             "serving_observability": 90}
 # set to a reduced table when the liveness probe fails: with the backend
 # known-wedged, burning every stage's full budget buys nothing — short
@@ -480,9 +487,11 @@ def _deepfm_sparse_block():
     1/n of replicated, 0 recompiles), serial vs overlapped PS sparse
     prefetch (strict examples/s improvement asserted), and the
     Zipf(1.0) hot-id serving-cache stage (hit ratio + lookup p99 with
-    the cache on/off).  Runs on the virtual CPU mesh regardless of the
-    accelerator under test: the bytes ratio and the overlap/cache wins
-    are host-side claims."""
+    the cache on/off), plus the int8-row leg (fp32 vs int8 table rows:
+    per-step train-loss parity at the pinned rtol and per-device table
+    bytes <= 0.35x fp32).  Runs on the virtual CPU mesh regardless of
+    the accelerator under test: the bytes ratio and the overlap/cache
+    wins are host-side claims."""
     import bench_common
 
     # the virtual device count must match the mesh the subprocess
@@ -596,10 +605,17 @@ def _serving_precision_block():
     LeNet and DeepFM endpoints served fp32 vs under a bf16 precision
     policy, parity inside the exported rtol bound, zero recompiles for
     both the policy default and the fp32 opt-out, plus a real 2-child
-    wire fleet serving the bf16 manifest.  CPU-host numbers measure the
-    harness (the bf16 speedup itself is a TPU number — CPUs emulate
-    bf16); trimmed storm sizes keep it inside the budget."""
+    wire fleet serving the bf16 manifest, plus the sharded-bf16
+    composed leg (the tp transformer-LM endpoint exported with BOTH a
+    tp layout and a bf16 policy — QPS and dtype-aware per-device HBM
+    vs the sharded-fp32 export; it needs the virtual CPU mesh).
+    CPU-host numbers measure the harness (the bf16 speedup itself is a
+    TPU number — CPUs emulate bf16); trimmed storm sizes keep it
+    inside the budget."""
+    import bench_common
+
     return _run_sub("serving_precision", {
+        **bench_common.virtual_mesh_env(),
         "BENCH_SERVING_PRECISION": "1",
         "BENCH_SERVING_THREADS": os.environ.get(
             "BENCH_SERVING_THREADS", "4"),
@@ -631,7 +647,11 @@ def _serving_decode_block():
     request-at-a-time vs token-level continuous batching — tokens/s for
     both, the speedup (>= 2x is the acceptance bar), streamed TTFT, the
     late-arrival drill, and the post-warmup recompile count (must stay
-    0: the slot pool's bucket ladders close the compiled-shape set)."""
+    0: the slot pool's bucket ladders close the compiled-shape set).
+    Tier 2 legs ride the same stage: shared-prefix caching,
+    speculative decode, cache-affinity fleet routing, and the int8-KV
+    leg (exact token parity vs fp32 KV + >= 1.8x concurrent sequences
+    at a fixed HBM budget from the pool's own byte accounting)."""
     return _run_sub("serving_decode", {
         "BENCH_SERVING_DECODE": "1",
         "BENCH_DECODE_REQUESTS": os.environ.get(

@@ -703,12 +703,14 @@ SHARDED_TP = int(os.environ.get("BENCH_SHARDED_TP", "2"))
 _LM_V, _LM_D, _LM_L, _LM_H, _LM_DI, _LM_S = 512, 64, 2, 4, 128, 32
 
 
-def _save_lm_bench(sharded: bool):
+def _save_lm_bench(sharded: bool, precision=None):
     """Save-fn factory for the transformer-LM endpoint (the "giant
     model" stand-in): same weights both ways (seeded), with the
     canonical tp layout + mesh embedded in the manifest when
     ``sharded`` — the predictor then loads as ONE model-parallel group
-    spanning ``BENCH_SHARDED_TP`` devices of the virtual CPU mesh."""
+    spanning ``BENCH_SHARDED_TP`` devices of the virtual CPU mesh.
+    ``precision`` composes a precision policy into the same export (the
+    --precision sharded-bf16 leg rides this)."""
     def save_fn(dirname):
         import paddle_tpu as fluid
         from paddle_tpu import framework, models, sharding
@@ -726,6 +728,8 @@ def _save_lm_bench(sharded: bool):
         if sharded:
             kw = dict(sharding_rules=sharding.transformer_lm_rules("tp"),
                       sharding_mesh={"tp": SHARDED_TP})
+        if precision is not None:
+            kw["precision_policy"] = precision
         with fluid.scope_guard(fluid.Scope()):
             exe.run(startup)
             fluid.save_inference_model(
@@ -1037,6 +1041,90 @@ def _decode_affinity_fleet_block(state):
             fleet.stop(shutdown_backends=True)
 
 
+def _decode_int8_kv_block(state, prompts, gen, max_slots, steps):
+    """The int8 KV-slot leg: the SAME LM weights behind a fp32-KV and
+    an int8-KV decode server — greedy token parity asserted exactly,
+    tokens/s both ways, and concurrent sequences at a fixed HBM budget
+    from the pool's own ``kv_rung_bytes`` accounting (the int8 rung
+    must buy >= 1.8x, the acceptance floor; per-slot-per-head fp32
+    scales cost 4/d_head extra so the exact ratio is
+    (d_head + 4) / (4 * d_head))."""
+    from paddle_tpu.decoding import make_transformer_lm_pooled_step_fn
+    from paddle_tpu.serving.decode import DecodeServer
+
+    V, D, L, H, DI, ML = _DEC_DIMS
+    legs, tokens = {}, {}
+    for dt in ("fp32", "int8"):
+        step_fn, make_cache = make_transformer_lm_pooled_step_fn(
+            state, V, D, L, H, DI, kv_dtype=dt)
+        srv = DecodeServer(step_fn, make_cache, eos_id=1, max_seq_len=ML,
+                           max_slots=max_slots, steps_per_tick=steps,
+                           name="bench-decode-kv-" + dt, kv_dtype=dt)
+        warm = srv.warmup()
+        outs = []
+        t0 = time.perf_counter()
+        for g in range(0, len(prompts), max_slots):
+            grp = [srv.submit({"tokens": p}, max_new_tokens=gen)
+                   for p in prompts[g:g + max_slots]]
+            outs.extend(np.asarray(r.result(timeout=300.0)[0])
+                        for r in grp)
+        elapsed = time.perf_counter() - t0
+        m = srv.metrics()
+        generated = int(m["decode"]["generated_tokens"])
+        recompiles = int(m.get("recompiles", 0))
+        pool = srv._pool
+        rungs = pool.rung_pairs()
+        rung_bytes = {r: pool.kv_rung_bytes(*r) for r in rungs}
+        srv.stop(drain=True, timeout=60.0)
+        if recompiles:
+            raise AssertionError(
+                "%s-KV decode server recompiled after warmup: %d"
+                % (dt, recompiles))
+        tokens[dt] = outs
+        legs[dt] = {
+            "tokens_per_s": round(generated / elapsed, 1),
+            "kv_bytes_top_rung": int(rung_bytes[rungs[-1]]),
+            "warmup_compiles": int(warm),
+            "recompiles": recompiles,
+            "_rung_bytes": rung_bytes,
+        }
+    for a, b in zip(tokens["fp32"], tokens["int8"]):
+        if not np.array_equal(a, b):
+            raise AssertionError(
+                "int8-KV greedy tokens diverged from fp32-KV: %r vs %r"
+                % (a.tolist(), b.tolist()))
+    # fixed HBM budget: at every (slots, len) rung pair, how many
+    # concurrent sequences does a budget sized for 4 fp32 rungs buy?
+    worst = None
+    rb32 = legs["fp32"].pop("_rung_bytes")
+    rb8 = legs["int8"].pop("_rung_bytes")
+    for (s, t), b32 in rb32.items():
+        budget = 4 * b32
+        seq32 = (budget // b32) * s
+        seq8 = (budget // rb8[(s, t)]) * s
+        ratio = seq8 / max(1, seq32)
+        if worst is None or ratio < worst[0]:
+            worst = (ratio, s, t, seq32, seq8)
+    if worst[0] < 1.8:
+        raise AssertionError(
+            "int8 KV bought only %.2fx concurrent sequences at rung "
+            "(%d, %d) — the acceptance floor is 1.8x" % worst[:3])
+    return {
+        "concurrent_sequences_vs_fp32": round(worst[0], 2),
+        "worst_rung": [worst[1], worst[2]],
+        "sequences_at_budget_fp32": int(worst[3]),
+        "sequences_at_budget_int8": int(worst[4]),
+        "kv_bytes_vs_fp32": round(
+            legs["int8"]["kv_bytes_top_rung"]
+            / legs["fp32"]["kv_bytes_top_rung"], 4),
+        "token_parity_exact": True,
+        "requests": len(prompts),
+        "max_new_tokens": gen,
+        "fp32": legs["fp32"],
+        "int8": legs["int8"],
+    }
+
+
 def run_decode():
     """The ``--decode`` line: token-level scheduling, measured."""
     import jax
@@ -1185,6 +1273,12 @@ def run_decode():
     spec_block = _decode_spec_block(
         state, spec_prompts, spec_gen, refs, rollouts)
     affinity_block = _decode_affinity_fleet_block(state)
+    int8_n = int(os.environ.get("BENCH_DECODE_INT8_REQUESTS", "6"))
+    int8_gen = int(os.environ.get("BENCH_DECODE_INT8_GEN", "16"))
+    int8_prompts = [rng2.randint(3, 400, 3 + i % 4).astype(np.int32)
+                    for i in range(int8_n)]
+    int8_block = _decode_int8_kv_block(
+        state, int8_prompts, int8_gen, max_slots, steps)
     ttfts.sort()
     cont_tps = cont_tokens / cont_s
     rat_tps = rat_tokens / rat_s
@@ -1211,6 +1305,7 @@ def run_decode():
         "prefix_cache": prefix_block,
         "speculative": spec_block,
         "affinity": affinity_block,
+        "int8_kv": int8_block,
         "platform": jax.devices()[0].platform,
     }
 
@@ -1301,17 +1396,60 @@ def _precision_fleet_block(save_fn, requests=48):
             fleet.stop(shutdown_backends=True)
 
 
+def _precision_sharded_block():
+    """The composed precision × sharding leg (the tentpole's
+    acceptance number): the transformer-LM endpoint exported
+    sharded-fp32 vs exported with BOTH the tp layout and a bf16
+    precision policy in one manifest.  QPS both ways plus the
+    dtype-aware ``hbm_bytes_per_device`` from ``sharding_stats()`` —
+    the composed endpoint must rent strictly fewer per-device bytes
+    (the hoisted params live bf16 at shard shape; embedding lookups
+    stay fp32, so the saving is the cast set's half-width, not exactly
+    half the total).  Both endpoints enforce the zero-recompile
+    contract inside ``_bench_endpoint``."""
+    f32 = _bench_endpoint("lm-tp%d-fp32" % SHARDED_TP,
+                          _save_lm_bench(True))
+    bf16 = _bench_endpoint(
+        "lm-tp%d-bf16" % SHARDED_TP,
+        _save_lm_bench(True, precision={"dtype": "bf16"}))
+    hbm_f32 = (f32.get("sharding") or {}).get("hbm_bytes_per_device")
+    hbm_bf16 = (bf16.get("sharding") or {}).get("hbm_bytes_per_device")
+    if not hbm_f32 or not hbm_bf16 or hbm_bf16 >= hbm_f32:
+        raise AssertionError(
+            "composed sharded-bf16 endpoint did not cut per-device HBM: "
+            "fp32=%r bf16=%r" % (hbm_f32, hbm_bf16))
+    return {
+        "tp": SHARDED_TP,
+        "qps_vs_sharded_fp32": round(
+            bf16["rows_per_sec"] / max(1e-9, f32["rows_per_sec"]), 3),
+        "hbm_bytes_per_device_fp32": int(hbm_f32),
+        "hbm_bytes_per_device_bf16": int(hbm_bf16),
+        "hbm_bytes_vs_fp32": round(hbm_bf16 / hbm_f32, 4),
+        "endpoints": {"sharded_fp32": f32, "sharded_bf16": bf16},
+    }
+
+
 def run_precision():
     """The ``--precision`` line: the same endpoints served fp32 vs
     under a bf16 precision policy — QPS and p99 both ways, parity
     within the exported rtol bound, 0 recompiles after warmup
-    (bf16-default AND fp32-opt-out requests), and the 2-child wire
-    fleet leg serving the mixed-precision manifest."""
+    (bf16-default AND fp32-opt-out requests), the 2-child wire fleet
+    leg serving the mixed-precision manifest, and the sharded-bf16
+    composed leg (the tp transformer-LM endpoint fp32 vs with a bf16
+    policy in the same manifest: QPS + dtype-aware per-device HBM)."""
     import functools
-
-    import jax
+    import sys
 
     import bench_common
+
+    if "jax" not in sys.modules:
+        # standalone invocation (`python bench_serving.py --precision`):
+        # the sharded-bf16 composed leg loads a tp group and needs the
+        # virtual multi-device CPU mesh (env only effective before the
+        # first jax import; bench.py's serving_precision stage injects
+        # the same env into its subprocess)
+        os.environ.update(bench_common.virtual_mesh_env())
+    import jax
 
     bench_common.configure_compile_cache(bench_common.HOME_CACHE_DIR)
     endpoints = {}
@@ -1332,12 +1470,14 @@ def run_precision():
             "parity": _parity_check(name, save_fn),
         }
     fleet = _precision_fleet_block(_save_lenet)
+    sharded_bf16 = _precision_sharded_block()
     return {
         "metric": "serving_precision_qps_vs_fp32",
         "unit": "ratio",
         "value": endpoints["lenet"]["qps_vs_fp32"],
         "endpoints": endpoints,
         "fleet": fleet,
+        "sharded_bf16": sharded_bf16,
         "threads": THREADS,
         "requests_per_thread": REQUESTS,
         "max_batch_size": MAX_BATCH,

@@ -203,13 +203,22 @@ def build_bf16_variant(program, fetch_names: Sequence[str],
     return variant, info
 
 
-def variant_scope(program, base_scope, cast_params: Set[str]):
+def variant_scope(program, base_scope, cast_params: Set[str],
+                  host_cast: bool = False):
     """A scope for the variant program sharing the base scope's values,
     with the hoisted parameters cast to bf16 ONCE (device-resident in
     bf16 from here on — this is the load-time "param placement" where
     the dtype policy lands).  Values not named ``cast_params`` are
-    shared by reference (jax arrays are immutable)."""
+    shared by reference (jax arrays are immutable).
+
+    ``host_cast=True`` (the precision × sharding composed mode): the
+    cast lands in HOST memory (numpy bf16 via ``ml_dtypes``) instead of
+    on device, so the value stays a staged host array until the sharded
+    dispatcher ``device_put``s it shard-by-shard — a bf16 tp/fsdp
+    program then never materializes an fp32 (or full-width bf16) copy
+    of a cast param on device."""
     import jax.numpy as jnp
+    import ml_dtypes
 
     from paddle_tpu.scope import Scope
 
@@ -221,7 +230,10 @@ def variant_scope(program, base_scope, cast_params: Set[str]):
         if val is None:
             continue
         if v.name in cast_params:
-            val = jnp.asarray(val, jnp.bfloat16)
+            if host_cast:
+                val = np.asarray(val).astype(ml_dtypes.bfloat16)
+            else:
+                val = jnp.asarray(val, jnp.bfloat16)
         sc.set(v.name, val)
     return sc
 

@@ -34,9 +34,25 @@ __all__ = [
     "make_transformer_lm_step_fn",
     "make_transformer_lm_pooled_step_fn", "make_slot_decode_fns",
     "make_transformer_lm_pooled_verify_fn", "make_prefix_admit_fn",
-    "kv_leaf_seq_axis",
+    "kv_leaf_seq_axis", "normalize_kv_dtype",
     "random_transformer_lm_state",
 ]
+
+#: KV-cache storage dtypes the pooled builders accept.  "int8" stores
+#: K/V rows quantized (per-slot-per-head-per-position absmax scales as
+#: sibling ``k_scale``/``v_scale`` leaves — see paddle_tpu.quant),
+#: quantize-on-write / dequant-at-attend inside the jitted step.
+KV_DTYPES = ("fp32", "int8")
+
+
+def normalize_kv_dtype(kv_dtype) -> str:
+    d = str(kv_dtype or "fp32").lower()
+    d = {"float32": "fp32", "fp32": "fp32", "int8": "int8"}.get(d)
+    if d is None:
+        raise ValueError(
+            "unsupported kv_dtype %r (supported: %s)"
+            % (kv_dtype, list(KV_DTYPES)))
+    return d
 
 
 def random_transformer_lm_state(rng, vocab, d_model, n_layer, n_head,
@@ -262,12 +278,19 @@ def make_transformer_lm_step_fn(
 
 
 def _lm_forward_one(W, name, cache, x, t, ts, n_layer, n_head, d_head,
-                    d_model, scale):
+                    d_model, scale, kv_int8=False):
     """One incremental transformer-LM forward shared by the scalar-``t``
     and slot-pooled (per-row ``ts``) step fns.  Exactly one of ``t``
     (scalar loop position, all rows aligned) / ``ts`` ([N] int32, each
     row at its own position) is not None; the cache T axis is read from
-    the cache itself so one builder serves every length rung."""
+    the cache itself so one builder serves every length rung.
+
+    ``kv_int8`` (pooled path only): the cache stores K/V rows int8 with
+    per-(slot, head, position) fp32 scales as sibling ``k_scale``/
+    ``v_scale`` leaves — each fresh row is quantized as it is written
+    (quantize-on-write) and the whole cache is dequantized in registers
+    at attention time (dequant-at-attend), so HBM traffic moves int8
+    bytes while the attention math stays fp32."""
     import jax
     import jax.numpy as jnp
 
@@ -279,28 +302,48 @@ def _lm_forward_one(W, name, cache, x, t, ts, n_layer, n_head, d_head,
     else:
         pos_ok = (jnp.arange(T)[None, :] <= ts[:, None])[:, None, :]  # [N,1,T]
         row_t = (jnp.arange(T)[None, :] == ts[:, None])    # [N,T]
+    if kv_int8:
+        from paddle_tpu.quant import dequantize_rows, quantize_rows
     new_cache = []
     for i in range(n_layer):
         p = "%s_dec_%d" % (name, i)
         q = _fc(W, x, p + "_att_q").reshape(n, n_head, d_head)
         k = _fc(W, x, p + "_att_k").reshape(n, n_head, d_head)
         v = _fc(W, x, p + "_att_v").reshape(n, n_head, d_head)
-        if ts is None:
-            kc = jax.lax.dynamic_update_index_in_dim(
-                cache[i]["k"], k, t, axis=2)
-            vc = jax.lax.dynamic_update_index_in_dim(
-                cache[i]["v"], v, t, axis=2)
-        else:
-            # per-row scatter: each lane writes its OWN position — the
-            # one-hot select is O(cache) like the attention itself
+        if kv_int8:
+            # quantize-on-write: one absmax scale per fresh (row, head)
+            kq, ks = quantize_rows(k)                      # [N,H] scales
+            vq, vs = quantize_rows(v)
             sel = row_t[:, None, :, None]                  # [N,1,T,1]
-            kc = jnp.where(sel, k[:, :, None, :], cache[i]["k"])
-            vc = jnp.where(sel, v[:, :, None, :], cache[i]["v"])
-        new_cache.append({"k": kc, "v": vc})
-        scores = jnp.einsum("nhd,nhtd->nht", q, kc) * scale
+            ssel = row_t[:, None, :]                       # [N,1,T]
+            kc = jnp.where(sel, kq[:, :, None, :], cache[i]["k"])
+            vc = jnp.where(sel, vq[:, :, None, :], cache[i]["v"])
+            ksc = jnp.where(ssel, ks[:, :, None], cache[i]["k_scale"])
+            vsc = jnp.where(ssel, vs[:, :, None], cache[i]["v_scale"])
+            new_cache.append({"k": kc, "k_scale": ksc,
+                              "v": vc, "v_scale": vsc})
+            # dequant-at-attend: int8 bytes leave HBM, fp32 enters the
+            # einsums
+            kcf = dequantize_rows(kc, ksc)
+            vcf = dequantize_rows(vc, vsc)
+        else:
+            if ts is None:
+                kc = jax.lax.dynamic_update_index_in_dim(
+                    cache[i]["k"], k, t, axis=2)
+                vc = jax.lax.dynamic_update_index_in_dim(
+                    cache[i]["v"], v, t, axis=2)
+            else:
+                # per-row scatter: each lane writes its OWN position —
+                # the one-hot select is O(cache) like the attention
+                sel = row_t[:, None, :, None]              # [N,1,T,1]
+                kc = jnp.where(sel, k[:, :, None, :], cache[i]["k"])
+                vc = jnp.where(sel, v[:, :, None, :], cache[i]["v"])
+            new_cache.append({"k": kc, "v": vc})
+            kcf, vcf = kc, vc
+        scores = jnp.einsum("nhd,nhtd->nht", q, kcf) * scale
         scores = jnp.where(pos_ok, scores, -1e9)
         w = jax.nn.softmax(scores, axis=-1)
-        ctx = jnp.einsum("nht,nhtd->nhd", w, vc).reshape(n, d_model)
+        ctx = jnp.einsum("nht,nhtd->nhd", w, vcf).reshape(n, d_model)
         att = _fc(W, ctx, p + "_att_out")
         x = _ln(W, x + att, p + "_ln1")
         h = jax.nn.gelu(_fc(W, x, p + "_ffn_fc0"), approximate=False)
@@ -330,6 +373,7 @@ def make_transformer_lm_pooled_step_fn(
     n_head: int,
     d_inner: int,
     name: str = "lm",
+    kv_dtype: str = "fp32",
 ):
     """The slot-pool variant of :func:`make_transformer_lm_step_fn`.
 
@@ -353,14 +397,40 @@ def make_transformer_lm_pooled_step_fn(
     written every cache position ``<= ts`` (prefill consumes each prompt
     token through the same step), and the mask hides ``> ts`` — stale
     rows from a previous occupant are never read.
+
+    ``kv_dtype="int8"`` stores the cache int8 (per-slot-per-head
+    scales as sibling ``k_scale``/``v_scale`` [N, H, T] fp32 leaves,
+    quantize-on-write / dequant-at-attend — see ``_lm_forward_one``),
+    roughly quartering per-slot KV bytes so a fixed HBM budget holds
+    ~2x+ the concurrent sequences.  The scale leaves keep the slot
+    axis leading and the sequence axis last, so the slot pool's
+    ``resize``/``extract_kv``/``admit_prefix`` carry them exactly like
+    the K/V leaves (``kv_leaf_seq_axis`` qualifies them) — prefix
+    caching and speculative decode compose unchanged.
     """
     import jax.numpy as jnp
 
+    kv_dtype = normalize_kv_dtype(kv_dtype)
+    kv_int8 = kv_dtype == "int8"
     d_head = d_model // n_head
     W = {k: jnp.asarray(v) for k, v in state.items()}
     scale = 1.0 / float(np.sqrt(d_head))
 
     def make_cache(n_rows: int, seq_len: int):
+        if kv_int8:
+            return [
+                {
+                    "k": jnp.zeros((n_rows, n_head, seq_len, d_head),
+                                   "int8"),
+                    "k_scale": jnp.zeros((n_rows, n_head, seq_len),
+                                         "float32"),
+                    "v": jnp.zeros((n_rows, n_head, seq_len, d_head),
+                                   "int8"),
+                    "v_scale": jnp.zeros((n_rows, n_head, seq_len),
+                                         "float32"),
+                }
+                for _ in range(n_layer)
+            ]
         return [
             {
                 "k": jnp.zeros((n_rows, n_head, seq_len, d_head), "float32"),
@@ -372,7 +442,8 @@ def make_transformer_lm_pooled_step_fn(
     def step_fn(cache, tokens, ts):
         x = W[name + "_word_emb"][tokens] + W[name + "_pos_emb"][ts]
         return _lm_forward_one(W, name, cache, x, None, ts, n_layer,
-                               n_head, d_head, d_model, scale)
+                               n_head, d_head, d_model, scale,
+                               kv_int8=kv_int8)
 
     return step_fn, make_cache
 
@@ -385,6 +456,7 @@ def make_transformer_lm_pooled_verify_fn(
     n_head: int,
     d_inner: int,
     name: str = "lm",
+    kv_dtype: str = "fp32",
 ):
     """The K-wide teacher-forced forward for speculative verification.
 
@@ -405,13 +477,24 @@ def make_transformer_lm_pooled_verify_fn(
     committed.  The K fresh K/V rows are scattered into the cache BEFORE
     attention (write-before-read, same invariant as the pooled step), so
     position ``ts + j`` attends to the just-written rows ``ts .. ts + j``.
+
+    ``kv_dtype`` must match the step fn the cache was built for: with
+    ``"int8"`` each fresh row is quantized EXACTLY like the sequential
+    step quantizes it (same per-row absmax), scattered as int8 with its
+    scale, and the cache dequantized at attention time — quantization
+    is deterministic, so greedy-exact acceptance still holds
+    bit-for-bit against the int8 sequential path.
     """
     import jax
     import jax.numpy as jnp
 
+    kv_dtype = normalize_kv_dtype(kv_dtype)
+    kv_int8 = kv_dtype == "int8"
     d_head = d_model // n_head
     W = {k: jnp.asarray(v) for k, v in state.items()}
     scale = 1.0 / float(np.sqrt(d_head))
+    if kv_int8:
+        from paddle_tpu.quant import dequantize_rows, quantize_rows
 
     def verify_fn(cache, tokens, ts):
         S, K = tokens.shape
@@ -420,6 +503,7 @@ def make_transformer_lm_pooled_verify_fn(
         x = W[name + "_word_emb"][tokens] + W[name + "_pos_emb"][p]
         sel = (jnp.arange(T)[None, None, :] == p[:, :, None])  # [S,K,T]
         touched = sel.any(axis=1)[:, None, :, None]            # [S,1,T,1]
+        touched_s = sel.any(axis=1)[:, None, :]                # [S,1,T]
         pos_ok = (jnp.arange(T)[None, None, None, :]
                   <= p[:, :, None, None])                      # [S,K,1,T]
         new_cache = []
@@ -432,18 +516,49 @@ def make_transformer_lm_pooled_verify_fn(
             # einsum reduces to an exact copy for the (distinct) live
             # positions; clamp collisions only happen on lanes past
             # their buffer, whose rows are never read back
-            selk = sel.astype(k.dtype)
-            kc = jnp.where(touched,
-                           jnp.einsum("skt,skhd->shtd", selk, k),
-                           cache[i]["k"])
-            vc = jnp.where(touched,
-                           jnp.einsum("skt,skhd->shtd", selk, v),
-                           cache[i]["v"])
-            new_cache.append({"k": kc, "v": vc})
-            scores = jnp.einsum("skhd,shtd->skht", q, kc) * scale
+            selk = sel.astype(jnp.float32)
+            if kv_int8:
+                # quantize each fresh row the way the sequential step
+                # does (per-row absmax) BEFORE the scatter: int8 codes
+                # are exact small integers in fp32, so the one-hot
+                # einsum copy round-trips them bit-identically
+                kq, ks = quantize_rows(k)                  # [S,K,H]
+                vq, vs = quantize_rows(v)
+                kc = jnp.where(
+                    touched,
+                    jnp.clip(jnp.einsum("skt,skhd->shtd", selk,
+                                        kq.astype(jnp.float32)),
+                             -127.0, 127.0).astype(jnp.int8),
+                    cache[i]["k"])
+                vc = jnp.where(
+                    touched,
+                    jnp.clip(jnp.einsum("skt,skhd->shtd", selk,
+                                        vq.astype(jnp.float32)),
+                             -127.0, 127.0).astype(jnp.int8),
+                    cache[i]["v"])
+                ksc = jnp.where(touched_s,
+                                jnp.einsum("skt,skh->sht", selk, ks),
+                                cache[i]["k_scale"])
+                vsc = jnp.where(touched_s,
+                                jnp.einsum("skt,skh->sht", selk, vs),
+                                cache[i]["v_scale"])
+                new_cache.append({"k": kc, "k_scale": ksc,
+                                  "v": vc, "v_scale": vsc})
+                kcf = dequantize_rows(kc, ksc)
+                vcf = dequantize_rows(vc, vsc)
+            else:
+                kc = jnp.where(touched,
+                               jnp.einsum("skt,skhd->shtd", selk, k),
+                               cache[i]["k"])
+                vc = jnp.where(touched,
+                               jnp.einsum("skt,skhd->shtd", selk, v),
+                               cache[i]["v"])
+                new_cache.append({"k": kc, "v": vc})
+                kcf, vcf = kc, vc
+            scores = jnp.einsum("skhd,shtd->skht", q, kcf) * scale
             scores = jnp.where(pos_ok, scores, -1e9)
             w = jax.nn.softmax(scores, axis=-1)
-            ctx = jnp.einsum("skht,shtd->skhd", w, vc).reshape(S, K, d_model)
+            ctx = jnp.einsum("skht,shtd->skhd", w, vcf).reshape(S, K, d_model)
             att = _fc(W, ctx, pfx + "_att_out")
             x = _ln(W, x + att, pfx + "_ln1")
             h = jax.nn.gelu(_fc(W, x, pfx + "_ffn_fc0"), approximate=False)

@@ -764,7 +764,8 @@ class TrainCheckpoint:
                 box = tuple(tuple(int(x) for x in se)
                             for se in doc["index"])
                 saved.append((box, os.path.join(dirname, doc["file"])))
-            if ent.get("kind") in ("mesh_table", "mesh_table_moments"):
+            if ent.get("kind") in ("mesh_table", "mesh_table_moments",
+                                   "mesh_table_scales"):
                 self._restore_mesh_table(name, ent, saved, shape, dtype,
                                          runtime, stats)
                 continue
@@ -897,8 +898,19 @@ class TrainCheckpoint:
         tbl = runtime.tables[table]
         if kind == "mesh_table_moments" and tbl.moments is None:
             return  # saved adagrad moments, runtime runs sgd: unused
-        target = (tbl.moments if kind == "mesh_table_moments"
-                  else tbl.array)
+        if kind == "mesh_table_scales":
+            target = getattr(tbl, "scales", None)
+            if target is None:
+                # int8-row checkpoint restored into an fp32 runtime —
+                # a dtype mismatch, not a mesh problem: name the fix
+                raise CheckpointMeshMismatchError(
+                    "mesh table %r: checkpoint carries int8 row scales "
+                    "but the runtime stores fp32 rows — rebind with "
+                    "bind_mesh_tables(row_dtype='int8') to restore this "
+                    "checkpoint" % table)
+        else:
+            target = (tbl.moments if kind == "mesh_table_moments"
+                      else tbl.array)
         cur_shape = tuple(int(d) for d in target.shape)
         if tuple(saved_shape[1:]) != tuple(cur_shape[1:]):
             raise CheckpointMeshMismatchError(

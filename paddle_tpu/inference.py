@@ -105,33 +105,60 @@ class AnalysisPredictor(PaddlePredictor):
         self._precision: Optional[Dict[str, Any]] = None
         self._default_dtype = "fp32"
         self._variants: Dict[str, Any] = {}  # dtype -> (program, scope)
+        # dtype -> hoisted param names (bf16) — the cast set a composed
+        # sharded endpoint applies at shard-placement time
+        self._variant_cast_params: Dict[str, List[str]] = {}
+        self._variant_compiled: Dict[str, Any] = {}  # dtype -> CompiledProgram
+        self._compiled = None
         pmanifest = getattr(self._program, "_precision_manifest", None)
+        smanifest = getattr(self._program, "_sharding_manifest", None)
+        # composed manifests are cross-linked at export; a doctored
+        # manifest carrying only one block is a TYPED error, never a
+        # silently-degraded endpoint
+        if pmanifest and pmanifest.get("sharded") and not smanifest:
+            from paddle_tpu.contrib.mixed_precision.inference import (
+                PrecisionPolicyError,
+            )
+
+            raise PrecisionPolicyError(
+                "precision manifest in %r says sharded=true but the "
+                "model carries no sharding block — the manifest was "
+                "doctored or truncated; re-export the endpoint"
+                % (config.model_dir,))
+        if smanifest and smanifest.get("precision_dtype") and not pmanifest:
+            from paddle_tpu.sharding.rules import ShardingRuleError
+
+            raise ShardingRuleError(
+                "sharding manifest in %r names precision_dtype=%r but "
+                "the model carries no precision block — the manifest "
+                "was doctored or truncated; re-export the endpoint"
+                % (config.model_dir, smanifest.get("precision_dtype")))
         if pmanifest:
-            self._init_precision(pmanifest, config)
+            self._init_precision(pmanifest, config,
+                                 composed=bool(smanifest))
         # a saved sharding manifest (save_inference_model's
         # sharding_rules=) reconstructs the SAME model-parallel layout
         # here: this predictor then owns a mesh-spanning group of
         # devices instead of one chip's replica
-        self._compiled = None
-        manifest = getattr(self._program, "_sharding_manifest", None)
-        if manifest:
+        if smanifest:
             from paddle_tpu.sharding.rules import (
                 PartitionRules,
                 ShardingRuleError,
             )
 
-            rules_doc = manifest.get("rules")
+            rules_doc = smanifest.get("rules")
             if not rules_doc:
                 raise ShardingRuleError(
                     "malformed sharding manifest in %r: missing 'rules' "
-                    "(%r)" % (config.model_dir, manifest))
+                    "(%r)" % (config.model_dir, smanifest))
             self.with_sharding_rules(
                 PartitionRules.from_manifest(rules_doc),
-                mesh_axes=manifest.get("mesh_axes"))
+                mesh_axes=smanifest.get("mesh_axes"))
 
     # --- TPU-native precision surface (contrib/mixed_precision) ---
     def _init_precision(self, manifest: Dict[str, Any],
-                        config: AnalysisConfig) -> None:
+                        config: AnalysisConfig,
+                        composed: bool = False) -> None:
         """Rebuild the endpoint's low-precision variant from its
         manifest: bf16 re-runs the deterministic rewrite on the loaded
         program and casts the hoisted params ONCE at placement time
@@ -139,7 +166,13 @@ class AnalysisPredictor(PaddlePredictor):
         loads the frozen sub-model (int8 weights + dequantize ops) the
         export materialized.  Both run through the SAME executor, so
         the jit/plan caches and ``jit_cache_stats`` cover every
-        variant."""
+        variant.
+
+        ``composed=True`` (the model also carries a sharding manifest):
+        the hoisted casts stay HOST-side (``variant_scope(host_cast=
+        True)``) so the sharded dispatcher device_puts each param as an
+        already-bf16 shard — no fp32 full-width copy ever lands on
+        device for the variant."""
         import os
 
         import paddle_tpu as fluid
@@ -152,8 +185,16 @@ class AnalysisPredictor(PaddlePredictor):
                 custom_white_list=manifest.get("custom_white_list"),
                 custom_black_list=manifest.get("custom_black_list"))
             vscope = mp_inf.variant_scope(
-                variant, self._scope, set(info["cast_params"]))
+                variant, self._scope, set(info["cast_params"]),
+                host_cast=composed)
+            self._variant_cast_params[dtype] = list(info["cast_params"])
         elif dtype == "int8":
+            if composed:
+                raise mp_inf.PrecisionPolicyError(
+                    "int8 precision manifest in %r cannot compose with "
+                    "a sharding manifest (the frozen sub-model carries "
+                    "its own param set) — re-export unsharded or bf16"
+                    % (config.model_dir,))
             vdir = manifest.get("variant_dir")
             if not vdir:
                 raise mp_inf.PrecisionPolicyError(
@@ -205,6 +246,9 @@ class AnalysisPredictor(PaddlePredictor):
             raise ValueError(
                 "endpoint has no %r variant (it serves %s)"
                 % (d, self.precision_dtypes()))
+        compiled = self._variant_compiled.get(d)
+        if compiled is not None:
+            return compiled, entry[1]
         return entry
 
     # --- TPU-native sharding surface (paddle_tpu/sharding) ---
@@ -220,6 +264,23 @@ class AnalysisPredictor(PaddlePredictor):
 
         self._compiled = CompiledProgram(self._program).with_sharding_rules(
             rules, mesh=mesh, mesh_axes=mesh_axes)
+        # precision × sharding: each bf16 variant gets its OWN compiled
+        # wrapper over the SAME mesh + rules (hoisting keeps param names
+        # intact so the rules cover the variant verbatim), with the
+        # hoisted param set bound as placement-time casts — the variant
+        # dispatch then shards AND casts in one device_put per param
+        self._variant_compiled = {}
+        for d, (vprog, _vscope) in self._variants.items():
+            cast_params = self._variant_cast_params.get(d)
+            if cast_params is None:
+                continue  # int8 sub-model: its own frozen param set
+            import ml_dtypes
+
+            vc = CompiledProgram(vprog).with_sharding_rules(
+                rules, mesh=self._compiled.mesh)
+            vc.with_cast_dtypes(
+                {n: ml_dtypes.bfloat16 for n in cast_params})
+            self._variant_compiled[d] = vc
         return self
 
     @property
@@ -227,33 +288,44 @@ class AnalysisPredictor(PaddlePredictor):
         """True when this predictor spans a model-parallel mesh."""
         return self._compiled is not None
 
-    def param_placements(self) -> Dict[str, Dict[str, Any]]:
+    def param_placements(self, precision: Optional[str] = None
+                         ) -> Dict[str, Dict[str, Any]]:
         """Observed placement per persistable: resolved spec, this
-        host's addressable shard shape, and per-device bytes.  Ground
-        truth for "each param is placed per its rule" — read AFTER
-        warmup/first run (before placement, params report their host
-        staging shape with ``placed=False``)."""
+        host's addressable shard shape, STORED dtype, and per-device
+        bytes.  Ground truth for "each param is placed per its rule" —
+        read AFTER warmup/first run (before placement, params report
+        their host staging shape with ``placed=False``).
+
+        ``precision`` selects the variant observed (like :meth:`run`):
+        None = the policy default, so a bf16 endpoint reports its
+        bf16-stored params and bytes; ``"fp32"`` reads the base
+        program.  Bytes are always computed from the stored dtype."""
+        target, scope = self._select_variant(precision)
+        compiled = (target if getattr(target, "_is_compiled_program", False)
+                    else None)
+        program = getattr(target, "_program", target)
         out: Dict[str, Dict[str, Any]] = {}
-        for v in self._program.list_vars():
+        for v in program.list_vars():
             if not v.persistable or v.is_data:
                 continue
-            val = self._scope.get(v.name)
+            val = scope.get(v.name)
             if val is None:
                 continue
-            spec = (self._compiled._spec_for_state(v.name)
-                    if self._compiled is not None else None)
+            spec = (compiled._spec_for_state(v.name)
+                    if compiled is not None else None)
             shape = tuple(np.shape(val))
             entry: Dict[str, Any] = {
                 "spec": list(tuple(spec)) if spec is not None else None,
                 "shape": shape,
+                "dtype": str(np.dtype(val.dtype)) if hasattr(val, "dtype")
+                         else str(np.asarray(val).dtype),
             }
             sh = getattr(val, "sharding", None)
             shards = getattr(val, "addressable_shards", None)
             if sh is not None and shards:
                 shard_shape = tuple(shards[0].data.shape)
                 entry["shard_shape"] = shard_shape
-                entry["bytes_per_device"] = int(
-                    shards[0].data.size * val.dtype.itemsize)
+                entry["bytes_per_device"] = int(shards[0].data.nbytes)
                 entry["sharded"] = shard_shape != shape
                 entry["placed"] = len(sh.device_set) > 1
             else:
@@ -266,12 +338,16 @@ class AnalysisPredictor(PaddlePredictor):
             out[v.name] = entry
         return out
 
-    def sharding_stats(self, group: Optional[str] = None) -> Dict[str, Any]:
+    def sharding_stats(self, group: Optional[str] = None,
+                       precision: Optional[str] = None) -> Dict[str, Any]:
         """Aggregate placement accounting for this predictor's group:
         parameter counts, per-device HBM bytes vs the replicated
-        baseline.  ``group=<label>`` additionally publishes the
-        per-device bytes to the ``sharding_group_hbm_bytes`` gauge."""
-        placements = self.param_placements()
+        baseline — both from the STORED dtype of the selected variant
+        (None = the policy default), so a composed bf16+sharded
+        endpoint reports its real (halved) HBM rent.  ``group=<label>``
+        additionally publishes the per-device bytes to the
+        ``sharding_group_hbm_bytes`` gauge."""
+        placements = self.param_placements(precision)
         hbm = sum(p["bytes_per_device"] for p in placements.values())
         total = 0  # the replicated baseline: every param whole, per chip
         for p in placements.values():

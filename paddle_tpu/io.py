@@ -109,9 +109,14 @@ def save_persistables(executor, dirname, main_program=None, filename=None,
 
 
 def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
-              filename=None, scope=None):
+              filename=None, scope=None, to_device=True):
     """reference: io.py:529.  Loads into the current global scope (or
-    ``scope`` when given)."""
+    ``scope`` when given).  ``to_device=False`` stages the values as
+    HOST numpy arrays instead of pushing them to a device — a sharded
+    endpoint's params are then first touched on device per shard by
+    ``CompiledProgram._shard_inputs``, so a full-width device copy is
+    never materialized (and the placement-time dtype cast of a composed
+    bf16+sharded endpoint sees the cheap host value)."""
     program = main_program or framework.default_main_program()
     scope = scope if scope is not None else global_scope()
     import jax.numpy as jnp
@@ -140,7 +145,7 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
                     "shape mismatch loading %r: checkpoint %s vs program %s"
                     % (name, arr.shape, expect)
                 )
-        scope.set(name, jnp.asarray(arr))
+        scope.set(name, jnp.asarray(arr) if to_device else arr)
 
 
 def load_params(executor, dirname, main_program=None, filename=None):
@@ -380,11 +385,21 @@ def save_inference_model(
     if precision_policy is not None and sharding_rules is not None:
         from paddle_tpu.contrib.mixed_precision.inference import (
             PrecisionPolicyError,
+            normalize_dtype,
         )
 
-        raise PrecisionPolicyError(
-            "precision_policy and sharding_rules are not yet composable "
-            "on one endpoint — export two models or drop one")
+        # bf16 composes: hoisting keeps param NAMES and shapes intact,
+        # so the partition rules cover the variant's param set verbatim
+        # and the loader applies the hoisted casts at shard-placement
+        # time.  int8 does not: its variant is a separate frozen
+        # sub-model whose quantized weights carry their own names.
+        if normalize_dtype(precision_policy.get("dtype") or "") != "bf16":
+            raise PrecisionPolicyError(
+                "precision_policy dtype %r is not composable with "
+                "sharding_rules on one endpoint — only the bf16 variant "
+                "shares the base program's param set (hoisted casts); "
+                "export the int8 model unsharded or drop one"
+                % precision_policy.get("dtype"))
     precision = None
     if precision_policy is not None:
         precision = _export_precision_variant(
@@ -434,6 +449,12 @@ def save_inference_model(
                           if sharding_mesh else None),
             "rules": sharding_rules.to_manifest(),
         }
+    if precision is not None and sharding is not None:
+        # cross-link the two blocks so a doctored manifest carrying only
+        # one of them is a TYPED load error, not a silently-degraded
+        # endpoint (fp32-but-sharded, or bf16-but-replicated)
+        precision["sharded"] = True
+        sharding["precision_dtype"] = precision["dtype"]
     return _save_model(dirname, pruned, feeded_var_names, fetch_names,
                        executor, model_filename, params_filename,
                        sharding=sharding, precision=precision)
@@ -451,6 +472,11 @@ def load_inference_model(dirname, executor, model_filename=None, params_filename
         program._sharding_manifest = model["sharding"]
     if model.get("precision"):
         program._precision_manifest = model["precision"]
-    load_vars(executor, dirname, program, filename=params_filename)
+    # sharded endpoints stage params host-side: the compiled dispatcher
+    # device_puts each param with its NamedSharding on first use, so
+    # device memory only ever holds per-shard (and, composed with a
+    # bf16 policy, already-cast) bytes — never a full-width fp32 copy
+    load_vars(executor, dirname, program, filename=params_filename,
+              to_device=not model.get("sharding"))
     fetch_vars = [program.global_block().var(n) for n in model["fetch_names"]]
     return program, model["feed_names"], fetch_vars

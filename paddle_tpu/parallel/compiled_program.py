@@ -64,6 +64,12 @@ class CompiledProgram:
         # back through the scope, so per-step state placement work drops
         # to zero (see _shard_inputs)
         self._steady_tokens: set = set()
+        # param name -> np.dtype applied at shard-placement time: a
+        # value whose dtype differs is cast host-side right before its
+        # device_put, so the device only ever holds per-shard bytes in
+        # the target dtype (the composed bf16+sharded endpoint's hoisted
+        # casts land here — see with_cast_dtypes)
+        self._cast_dtypes: Dict[str, Any] = {}
 
     # ------------------------------------------------------------------
     def with_data_parallel(
@@ -146,6 +152,23 @@ class CompiledProgram:
         # state-bytes series; the new layout republishes at placement)
         self._clear_sharding_memos()
         self._rules = rules
+        return self
+
+    def with_cast_dtypes(self, dtypes: Dict[str, Any]) -> "CompiledProgram":
+        """Bind placement-time dtype casts (precision × sharding).
+
+        ``dtypes``: param name → numpy-compatible dtype (e.g.
+        ``ml_dtypes.bfloat16``).  During ``_shard_inputs`` a listed
+        state value whose dtype differs is cast host-side immediately
+        before its ``device_put``, so the cast happens ONCE per param at
+        placement and the device never materializes the source-width
+        array — the hoisted param casts of a bf16 variant land exactly
+        here when the endpoint is also sharded."""
+        self._cast_dtypes = {str(n): np.dtype(d) for n, d in
+                             dict(dtypes or {}).items()}
+        # a new cast map invalidates steady-state conclusions (a steady
+        # token would skip the placement pass that applies the casts)
+        self._steady_tokens.clear()
         return self
 
     @property
@@ -306,9 +329,10 @@ class CompiledProgram:
         device_put = jax.device_put
         feed_sharding = self.feed_sharding
         state_sharding = self.state_sharding
+        cast_dtypes = self._cast_dtypes
         restaged: Dict[str, Any] = {}
 
-        def put(arrs, sh_of, track=False):
+        def put(arrs, sh_of, track=False, cast=False):
             out = {}
             for n, a in arrs.items():
                 sh = sh_of(n, a)
@@ -319,6 +343,13 @@ class CompiledProgram:
                             and cur.mesh is sh.mesh and cur.spec == sh.spec)):
                     out[n] = a
                 else:
+                    if cast and cast_dtypes:
+                        # placement-time precision cast (cold: runs only
+                        # on the restage pass, never in steady state —
+                        # the value is the load-time host-staged array)
+                        tgt = cast_dtypes.get(n)
+                        if tgt is not None and np.dtype(a.dtype) != tgt:
+                            a = np.asarray(a).astype(tgt)  # hot-ok: host-staged param, placement-time only
                     out[n] = device_put(a, sh)
                     if track:
                         restaged[n] = out[n]
@@ -333,8 +364,8 @@ class CompiledProgram:
         if steady_token is not None and steady_token in self._steady_tokens:
             return feed_out, mut_state, ro_state, restaged
         state_sh = lambda n, a: state_sharding(n)  # noqa: E731
-        mut_out = put(mut_state, state_sh, track=True)
-        ro_out = put(ro_state, state_sh, track=True)
+        mut_out = put(mut_state, state_sh, track=True, cast=True)
+        ro_out = put(ro_state, state_sh, track=True, cast=True)
         if steady_token is not None and not restaged:
             self._steady_tokens.add(steady_token)
         kind_of = getattr(self._rules, "state_kind", None)

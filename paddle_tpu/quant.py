@@ -1,0 +1,59 @@
+"""Shared int8 row quantization helpers (traced, jit-side only).
+
+One quantization scheme serves every int8 surface in the tree — KV
+cache rows (``decoding.make_transformer_lm_pooled_step_fn(kv_dtype=
+"int8")``) and mesh-table embedding rows (``MeshTableRuntime(
+row_dtype="int8")``): **symmetric per-row absmax** in the LLM.int8()
+lineage.  A "row" is the last axis of the tensor; each row gets one
+fp32 scale ``max|row| / 127`` and the row is stored as
+``round(row / scale)`` clipped to ``[-127, 127]``.
+
+Two properties the callers rely on:
+
+* **the max element always lands exactly on ±127**, so
+  ``quantize_rows(dequantize_rows(q, s))`` is the identity — a
+  gather→dequant→requant→scatter update path writes back
+  bit-identical (q, scale) for untouched rows, which is what makes
+  the sparse push's collision-safe scatter deterministic;
+* **zero rows stay zero** (the scale is floored, not the values), so
+  freshly allocated cache/table storage round-trips as exact zeros.
+
+Both helpers are pure ``jnp`` and MUST only be called inside jitted
+functions (the step fn, the shard_map lookup/push bodies) — never on
+the scheduler tick loop or any other host thread.  ``tools/
+check_hot_path.py`` lists this file so any future host-side region
+added here inherits the blocking-sync guard.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["quantize_rows", "dequantize_rows", "INT8_SCALE_FLOOR"]
+
+# scale floor: keeps all-zero rows representable (0 / floor == 0) and
+# the dequant finite; any real row's absmax dominates it
+INT8_SCALE_FLOOR = 1e-8
+
+
+# hot-path: begin int8_quant (pure jnp ops traced into the step/verify
+# executables and the mesh-table push kernels; a host sync here would
+# land in every decode tick and sparse train step)
+def quantize_rows(x):
+    """Quantize ``x [..., row]`` to (int8 values, fp32 scales [...]).
+
+    Symmetric per-row absmax: ``scale = max|row| / 127`` (floored at
+    :data:`INT8_SCALE_FLOOR`), values ``round(row / scale)`` clipped to
+    ``[-127, 127]``.  The row's max element maps to exactly ±127.
+    """
+    x = jnp.asarray(x, jnp.float32)  # hot-ok: jnp.asarray is a traced cast, not a host d2h
+    scale = jnp.maximum(
+        jnp.max(jnp.abs(x), axis=-1) / 127.0, INT8_SCALE_FLOOR)
+    q = jnp.clip(jnp.round(x / scale[..., None]), -127.0, 127.0)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def dequantize_rows(q, scale):
+    """Inverse of :func:`quantize_rows`: fp32 ``q * scale`` with the
+    scale broadcast back over the row axis."""
+    return q.astype(jnp.float32) * scale[..., None]
+# hot-path: end int8_quant

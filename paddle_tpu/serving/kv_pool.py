@@ -78,11 +78,19 @@ class KVSlotPool:
                  steps: int = 4,
                  on_recompile: Optional[Callable[[], None]] = None,
                  prefix: bool = False,
-                 speculative=None):
+                 speculative=None,
+                 kv_dtype: str = "fp32"):
         from paddle_tpu.decoding import (make_prefix_admit_fn,
-                                         make_slot_decode_fns)
+                                         make_slot_decode_fns,
+                                         normalize_kv_dtype)
 
         self._make_cache = make_cache
+        # the cache storage dtype ``make_cache`` allocates (advertised
+        # on /healthz; the pool itself is dtype-agnostic — shapes and
+        # dtypes all flow from the state spec, so the int8 rung variant
+        # with its sibling scale leaves rides resize/extract/admit
+        # unchanged)
+        self.kv_dtype = normalize_kv_dtype(kv_dtype)
         self.eos_id = int(eos_id)
         self.steps = max(1, int(steps))
         self.slot_policy = BucketPolicy(max_slots, slot_ladder)
@@ -241,6 +249,24 @@ class KVSlotPool:
         """The (slot, length) rung pair a state currently occupies."""
         s, t = state["tokens"].shape
         return int(s), int(t)
+
+    def kv_rung_bytes(self, s: int, t: int) -> int:
+        """KV bytes one state of rung pair ``(s, t)`` holds (cache +
+        sibling scale leaves + draft cache) — computed from the state
+        SPEC's stored dtypes, no allocation.  This is the pool-
+        accounting ground truth the ``serving_kv_cache_bytes`` gauge
+        and the int8-KV capacity bench read: an int8 pool's rung holds
+        ~4x less than fp32's, so a fixed HBM budget seats ~2x+ the
+        concurrent sequences at the next slot rung up."""
+        total = 0
+        for leaf in self._kv_subtree_leaves(self._state_spec(s, t)):
+            total += int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+        return int(total)
+
+    def kv_state_bytes(self, state) -> int:
+        """:meth:`kv_rung_bytes` for ``state``'s current rung pair."""
+        s, t = self.state_rungs(state)
+        return self.kv_rung_bytes(s, t)
 
     # ------------------------------------------------------------------
     def _get_exe(self, kind: str, s: int, t: int):

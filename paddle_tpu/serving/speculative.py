@@ -94,10 +94,16 @@ def make_lm_speculative(target_state, *, vocab_size: int, d_model: int,
                         draft_n_layer: int, draft_n_head: int,
                         draft_d_inner: int, k: int = 4,
                         name: str = "lm",
-                        draft_name: str = "draft") -> SpeculativeConfig:
+                        draft_name: str = "draft",
+                        kv_dtype: str = "fp32") -> SpeculativeConfig:
     """A :class:`SpeculativeConfig` for a transformer-LM target + a
     (smaller) transformer-LM draft sharing the vocabulary — the
-    in-tree pair ``save/load_decode_endpoint`` persists."""
+    in-tree pair ``save/load_decode_endpoint`` persists.
+
+    ``kv_dtype``: the TARGET's KV-cache storage dtype — must match the
+    step fn the pool runs, so the verify call reads/writes the same
+    int8-coded cache leaves.  The draft always keeps fp32 KV (it is
+    small by construction; quantizing it buys nothing)."""
     from paddle_tpu.decoding import (
         make_transformer_lm_pooled_step_fn,
         make_transformer_lm_pooled_verify_fn,
@@ -105,7 +111,7 @@ def make_lm_speculative(target_state, *, vocab_size: int, d_model: int,
 
     verify_fn = make_transformer_lm_pooled_verify_fn(
         target_state, vocab_size, d_model, n_layer, n_head, d_inner,
-        name=name)
+        name=name, kv_dtype=kv_dtype)
     draft_step_fn, draft_make_cache = make_transformer_lm_pooled_step_fn(
         draft_state, vocab_size, draft_d_model, draft_n_layer,
         draft_n_head, draft_d_inner, name=draft_name)

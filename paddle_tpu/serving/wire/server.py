@@ -408,9 +408,25 @@ class ServingProcess:
                           else None),
             "precision_dtypes": list(
                 getattr(srv, "_precision_dtypes", ["fp32"])),
+            # storage-dtype discovery: the decode pool's KV dtype and
+            # the bound mesh tables' row dtype (None where the surface
+            # doesn't apply) — fleet_top renders these as the dtype
+            # column and capacity planners read them with the byte
+            # gauges
+            "kv_dtype": getattr(srv, "kv_dtype", None),
+            "row_dtype": self._row_dtype(srv),
             "input_names": list(srv._feed_names),
             "output_names": list(srv._predictor.get_output_names()),
         }
+
+    @staticmethod
+    def _row_dtype(srv) -> Optional[str]:
+        """Row storage dtype of the served program's bound mesh tables
+        (``bind_mesh_tables``), None when it has none."""
+        program = getattr(getattr(srv, "_predictor", None),
+                          "_program", None)
+        runtime = getattr(program, "_mesh_tables", None)
+        return getattr(runtime, "row_dtype", None)
 
     # ------------------------------------------------------------------
     def _infer(self, feed, timeout_ms, traceparent: Optional[str],

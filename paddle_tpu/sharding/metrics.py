@@ -17,7 +17,7 @@ from __future__ import annotations
 from paddle_tpu.monitor import registry as _registry
 
 __all__ = ["PARAMS_SHARDED", "GROUP_HBM_BYTES", "TRAIN_STATE_BYTES",
-           "SPARSE_TABLE_BYTES", "SPARSE_LOOKUPS"]
+           "SPARSE_TABLE_BYTES", "SPARSE_ROW_DTYPE", "SPARSE_LOOKUPS"]
 
 PARAMS_SHARDED = _registry.REGISTRY.counter(
     "sharding_params_sharded_total",
@@ -43,6 +43,11 @@ SPARSE_TABLE_BYTES = _registry.REGISTRY.gauge(
     "per-device bytes of one mesh-resident row-sharded lookup table "
     "(the addressable shard — ~1/n_shards of the replicated table); "
     "set at bind, retired by MeshTableRuntime.close()", ("table",))
+SPARSE_ROW_DTYPE = _registry.REGISTRY.gauge(
+    "sharding_sparse_row_dtype",
+    "info gauge (value always 1) naming one mesh-resident table's row "
+    "STORAGE dtype (fp32 | int8 per-row-scaled codes); set at bind, "
+    "retired by MeshTableRuntime.close()", ("table", "dtype"))
 SPARSE_LOOKUPS = _registry.REGISTRY.counter(
     "sharding_sparse_lookups_total",
     "device-side gathers served by mesh-resident tables (each one a "

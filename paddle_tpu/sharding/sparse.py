@@ -22,7 +22,19 @@ across devices.  This module is the runtime for that declaration:
   scatter-add update applied per shard with the SERVER-side optimizer
   semantics (``sgd`` / ``adagrad`` — numerically the ``ps._Table.push``
   kernels), so a mesh-resident table trains with loss parity against
-  the PS path for deterministic initializers.
+  the PS path for deterministic initializers;
+* ``row_dtype="int8"`` stores rows as int8 codes with per-row fp32
+  absmax scales (``paddle_tpu.quant``) riding the SAME shard layout —
+  ~4x fewer table bytes per device at the same shard count.  Lookup
+  dequantizes after the local gather, BEFORE the psum (collectives
+  move fp32 rows, tables store int8); push dequant-accumulates: the
+  per-target-row aggregated grad is applied to the dequantized row and
+  the result requantized, and the quantizer's fixed-point identity
+  (``requantize(dequantize(q, s)) == (q, s)`` exactly) makes the
+  row-set write collision-safe — every lane targeting a row writes the
+  identical bytes, and untouched rows round-trip unchanged.  Adagrad
+  moments stay fp32 (they are optimizer state, not capacity-bound
+  serving state).
 
 Unique-id counts are bucketed by the caller (the executor's prefetch
 pads to a power-of-two ladder, or the autotuned
@@ -46,20 +58,38 @@ import numpy as np
 from paddle_tpu.parallel import mesh as mesh_lib
 from paddle_tpu.sharding import metrics as _sh_metrics
 
-__all__ = ["MeshTable", "MeshTableRuntime", "bind_mesh_tables"]
+__all__ = ["MeshTable", "MeshTableRuntime", "bind_mesh_tables",
+           "ROW_DTYPES", "normalize_row_dtype"]
+
+ROW_DTYPES = ("fp32", "int8")
+
+
+def normalize_row_dtype(row_dtype) -> str:
+    """Canonicalize a table row storage dtype (``None`` -> ``fp32``;
+    ``"float32"`` is accepted as an alias)."""
+    d = str(row_dtype or "fp32").lower()
+    if d == "float32":
+        d = "fp32"
+    if d not in ROW_DTYPES:
+        raise ValueError(
+            "mesh-table row_dtype %r not in %s" % (row_dtype, ROW_DTYPES))
+    return d
 
 
 class MeshTable:
     """One mesh-resident table: the sharded row array plus the
     server-optimizer state that rides with it (adagrad moments shard
-    exactly like their rows)."""
+    exactly like their rows).  ``row_dtype="int8"`` tables carry a
+    per-row fp32 ``scales`` array sharded like the rows' id dim."""
 
     __slots__ = ("name", "dim", "height", "padded_height",
-                 "rows_per_shard", "array", "moments")
+                 "rows_per_shard", "array", "moments", "row_dtype",
+                 "scales")
 
     def __init__(self, name: str, dim: int, height: int,
                  padded_height: int, rows_per_shard: int,
-                 array, moments=None):
+                 array, moments=None, row_dtype: str = "fp32",
+                 scales=None):
         self.name = name
         self.dim = int(dim)
         self.height = int(height)
@@ -67,15 +97,26 @@ class MeshTable:
         self.rows_per_shard = int(rows_per_shard)
         self.array = array
         self.moments = moments
+        self.row_dtype = row_dtype
+        self.scales = scales
 
     def bytes_per_device(self) -> int:
-        """Addressable shard bytes of the row array on one device (the
-        capacity number: ~``1/n_shards`` of the replicated table)."""
+        """Addressable shard bytes of the row array (plus the int8
+        scales, when present) on one device — the capacity number, from
+        the STORED dtype: ~``1/n_shards`` of replicated, and ~4x less
+        again for int8 rows."""
         shards = self.array.addressable_shards
-        return int(shards[0].data.nbytes) if shards else 0
+        total = int(shards[0].data.nbytes) if shards else 0
+        if self.scales is not None:
+            sshards = self.scales.addressable_shards
+            total += int(sshards[0].data.nbytes) if sshards else 0
+        return total
 
     def replicated_bytes(self) -> int:
-        return int(self.array.nbytes)
+        total = int(self.array.nbytes)
+        if self.scales is not None:
+            total += int(self.scales.nbytes)
+        return total
 
 
 class MeshTableRuntime:
@@ -98,7 +139,8 @@ class MeshTableRuntime:
 
     def __init__(self, program, mesh, axis: str,
                  optimizer: str = "sgd", lr: float = 0.1,
-                 initializer: str = "zeros", seed: int = 0):
+                 initializer: str = "zeros", seed: int = 0,
+                 row_dtype: str = "fp32"):
         if optimizer not in self._OPTIMIZERS:
             raise ValueError(
                 "mesh-table optimizer %r not in %s"
@@ -113,6 +155,7 @@ class MeshTableRuntime:
         self.mesh = mesh
         self.axis = axis
         self.optimizer = optimizer
+        self.row_dtype = normalize_row_dtype(row_dtype)
         self.lr = float(lr)
         self.n_shards = int(dict(
             zip(mesh.axis_names, mesh.devices.shape))[axis])
@@ -158,15 +201,32 @@ class MeshTableRuntime:
                 "mesh-table initializer %r not in ('zeros', 'uniform')"
                 % initializer)
         sh = NamedSharding(self.mesh, P(self.axis, None))
+        scales = None
+        if self.row_dtype == "int8":
+            from paddle_tpu.quant import INT8_SCALE_FLOOR
+
+            # host-side mirror of quant.quantize_rows (np.rint rounds
+            # half-to-even like jnp.round, so the device push kernels
+            # round-trip these exact codes)
+            hs = np.maximum(
+                np.max(np.abs(host), axis=1) / 127.0,
+                INT8_SCALE_FLOOR).astype(np.float32)
+            host = np.clip(np.rint(host / hs[:, None]),
+                           -127, 127).astype(np.int8)
+            scales = jax.device_put(
+                hs, NamedSharding(self.mesh, P(self.axis)))
         arr = jax.device_put(host, sh)
         moments = None
         if self.optimizer == "adagrad":
             moments = jax.device_put(np.zeros((padded, dim), np.float32), sh)
         tbl = MeshTable(name, dim, height, padded, padded // self.n_shards,
-                        arr, moments)
+                        arr, moments, row_dtype=self.row_dtype,
+                        scales=scales)
         self.tables[name] = tbl
         _sh_metrics.SPARSE_TABLE_BYTES.labels(table=name).set(
             tbl.bytes_per_device())
+        _sh_metrics.SPARSE_ROW_DTYPE.labels(
+            table=name, dtype=self.row_dtype).set(1)
 
     # ------------------------------------------------------------------
     # Executable builders: one per (table, bucket) — warmup() walks the
@@ -193,6 +253,27 @@ class MeshTableRuntime:
         axis = self.axis
         rps = tbl.rows_per_shard
 
+        if tbl.scales is not None:
+            from paddle_tpu.quant import dequantize_rows
+
+            def local_lookup(shard, scales, ids):
+                # int8 rung: dequantize AFTER the local gather, BEFORE
+                # the psum — the table stores int8, the collective
+                # moves (and the step consumes) fp32 rows
+                lo = jax.lax.axis_index(axis) * rps
+                local = ids - lo
+                ok = (local >= 0) & (local < rps)
+                safe = jnp.clip(local, 0, rps - 1)
+                rows = jnp.where(
+                    ok[:, None],
+                    dequantize_rows(shard[safe], scales[safe]), 0.0)
+                return jax.lax.psum(rows, axis)
+
+            smapped = mesh_lib.shard_map(
+                local_lookup, mesh=self.mesh,
+                in_specs=(P(axis, None), P(axis), P()), out_specs=P())
+            return jax.jit(smapped)
+
         def local_lookup(shard, ids):
             # id→shard routing: each shard gathers the rows it owns and
             # zeros the rest; the psum assembles full rows everywhere
@@ -218,6 +299,7 @@ class MeshTableRuntime:
         rps = tbl.rows_per_shard
         lr = self.lr
         adagrad = self.optimizer == "adagrad"
+        int8_rows = tbl.scales is not None
 
         def route(ids):
             # shard-wise routing, shared by both kernels: ids the shard
@@ -230,7 +312,52 @@ class MeshTableRuntime:
             ok = (local >= 0) & (local < rps)
             return ok, jnp.clip(local, 0, rps - 1)
 
-        if adagrad:
+        if int8_rows:
+            from paddle_tpu.quant import dequantize_rows, quantize_rows
+
+            # The int8 push is a row-SET, not a scatter-add: the update
+            # must re-quantize whole rows (codes AND scale change
+            # together).  An ``at[].set`` with duplicate indexes —
+            # bucket-padding dups, clipped foreign ids — is only
+            # deterministic when every colliding lane writes identical
+            # bytes, so grads are first aggregated per TARGET row
+            # (``same @ g``: lanes routed to one row all see the row's
+            # total grad).  Lanes whose row took no grad write
+            # ``requantize(dequantize(row))``, exact-identity by the
+            # quantizer's fixed-point property — untouched rows keep
+            # their bytes.
+            if adagrad:
+                def local_push(shard, scales, mom, ids, grads):
+                    ok, safe = route(ids)
+                    g = jnp.where(ok[:, None], grads, 0.0)
+                    same = (safe[:, None] == safe[None, :]).astype(g.dtype)
+                    m_row = mom[safe] + same @ (g * g)
+                    mom = mom.at[safe].set(m_row)
+                    g_row = same @ g
+                    base = dequantize_rows(shard[safe], scales[safe])
+                    nq, ns = quantize_rows(
+                        base - lr * g_row / (jnp.sqrt(m_row) + 1e-6))
+                    return (shard.at[safe].set(nq),
+                            scales.at[safe].set(ns), mom)
+
+                in_specs = (P(axis, None), P(axis), P(axis, None),
+                            P(), P())
+                out_specs = (P(axis, None), P(axis), P(axis, None))
+                donate_args = (0, 1, 2)
+            else:
+                def local_push(shard, scales, ids, grads):
+                    ok, safe = route(ids)
+                    g = jnp.where(ok[:, None], grads, 0.0)
+                    same = (safe[:, None] == safe[None, :]).astype(g.dtype)
+                    g_row = same @ g
+                    base = dequantize_rows(shard[safe], scales[safe])
+                    nq, ns = quantize_rows(base - lr * g_row)
+                    return shard.at[safe].set(nq), scales.at[safe].set(ns)
+
+                in_specs = (P(axis, None), P(axis), P(), P())
+                out_specs = (P(axis, None), P(axis))
+                donate_args = (0, 1)
+        elif adagrad:
             def local_push(shard, mom, ids, grads):
                 # numerically ps._Table.push adagrad: m += g*g;
                 # row -= lr*g/(sqrt(m)+1e-6), per unique id
@@ -283,6 +410,8 @@ class MeshTableRuntime:
         fn = self._fn("lookup", table, ids.shape[0])
         self.lookups += 1
         _sh_metrics.SPARSE_LOOKUPS.inc()
+        if tbl.scales is not None:
+            return fn(tbl.array, tbl.scales, ids)
         return fn(tbl.array, ids)
 
     def push(self, table: str, uniq_ids, grads) -> None:
@@ -294,7 +423,14 @@ class MeshTableRuntime:
         tbl = self.tables[table]
         ids = jnp.asarray(uniq_ids, jnp.int32).reshape(-1)  # hot-ok: device-side cast, not a host sync
         fn = self._fn("push", table, ids.shape[0])
-        if tbl.moments is not None:
+        if tbl.scales is not None:
+            if tbl.moments is not None:
+                tbl.array, tbl.scales, tbl.moments = fn(
+                    tbl.array, tbl.scales, tbl.moments, ids, grads)
+            else:
+                tbl.array, tbl.scales = fn(
+                    tbl.array, tbl.scales, ids, grads)
+        elif tbl.moments is not None:
             tbl.array, tbl.moments = fn(tbl.array, tbl.moments, ids, grads)
         else:
             tbl.array = fn(tbl.array, ids, grads)
@@ -337,11 +473,18 @@ class MeshTableRuntime:
         optimizer moments under ``<table>#moments`` (kind
         ``mesh_table_moments``).  Arrays are PADDED to the shard grid;
         ``height`` is the real row count — rows past it are never read
-        by a lookup, so a restore may zero-fill them."""
+        by a lookup, so a restore may zero-fill them.  int8 tables add
+        their per-row scales under ``<table>#scales`` (kind
+        ``mesh_table_scales``): codes without scales decode to garbage,
+        so the pair checkpoints and restores together."""
         out: Dict[str, Dict[str, Any]] = {}
         for name, tbl in sorted(self.tables.items()):
             out[name] = {"table": name, "kind": "mesh_table",
                          "array": tbl.array, "height": tbl.height}
+            if tbl.scales is not None:
+                out[name + "#scales"] = {
+                    "table": name, "kind": "mesh_table_scales",
+                    "array": tbl.scales, "height": tbl.height}
             if tbl.moments is not None:
                 out[name + "#moments"] = {
                     "table": name, "kind": "mesh_table_moments",
@@ -354,24 +497,44 @@ class MeshTableRuntime:
         sharding/shape (the checkpoint restore re-places shard-wise onto
         this runtime's mesh before calling)."""
         tbl = self.tables[table]
-        expect = tbl.array.shape
-        if tuple(array.shape) != tuple(expect):
+        if kind == "mesh_table":
+            target = tbl.array
+        elif kind == "mesh_table_moments":
+            target = tbl.moments
+        elif kind == "mesh_table_scales":
+            target = tbl.scales
+        else:
+            raise ValueError("unknown mesh-table state kind %r" % kind)
+        if target is None:
+            raise ValueError(
+                "restored %s for table %r but the runtime holds no such "
+                "state (row_dtype=%r, optimizer=%r)"
+                % (kind, table, tbl.row_dtype, self.optimizer))
+        if tuple(array.shape) != tuple(target.shape):
             raise ValueError(
                 "restored %s for table %r has shape %s but the runtime "
                 "holds %s" % (kind, table, tuple(array.shape),
-                              tuple(expect)))
+                              tuple(target.shape)))
+        if np.dtype(array.dtype) != np.dtype(target.dtype):
+            raise ValueError(
+                "restored %s for table %r has dtype %s but the runtime "
+                "holds %s — the checkpoint was written under a "
+                "different row_dtype; rebind with the matching one"
+                % (kind, table, np.dtype(array.dtype),
+                   np.dtype(target.dtype)))
         if kind == "mesh_table":
             tbl.array = array
         elif kind == "mesh_table_moments":
             tbl.moments = array
         else:
-            raise ValueError("unknown mesh-table state kind %r" % kind)
+            tbl.scales = array
 
     def stats(self) -> Dict[str, Any]:
         return {
             "n_shards": self.n_shards,
             "axis": self.axis,
             "optimizer": self.optimizer,
+            "row_dtype": self.row_dtype,
             "compiles": self.compiles,
             "lookups": self.lookups,
             "pushes": self.pushes,
@@ -379,6 +542,7 @@ class MeshTableRuntime:
                 name: {
                     "height": t.height,
                     "dim": t.dim,
+                    "row_dtype": t.row_dtype,
                     "bytes_per_device": t.bytes_per_device(),
                     "replicated_bytes": t.replicated_bytes(),
                 }
@@ -388,8 +552,10 @@ class MeshTableRuntime:
 
     def close(self) -> None:
         """Retire the per-table gauge series and drop the device state."""
-        for name in self.tables:
+        for name, tbl in self.tables.items():
             _sh_metrics.SPARSE_TABLE_BYTES.remove_labels(table=name)
+            _sh_metrics.SPARSE_ROW_DTYPE.remove_labels(
+                table=name, dtype=tbl.row_dtype)
         self.tables.clear()
         self._fns.clear()
 
@@ -397,7 +563,8 @@ class MeshTableRuntime:
 def bind_mesh_tables(compiled, axis: Optional[str] = None,
                      optimizer: str = "sgd", lr: float = 0.1,
                      initializer: str = "zeros",
-                     seed: int = 0) -> MeshTableRuntime:
+                     seed: int = 0,
+                     row_dtype: str = "fp32") -> MeshTableRuntime:
     """Materialize ``compiled``'s distributed lookup tables ON its mesh,
     row-sharded over ``axis`` (default: the mesh's first axis), and
     attach the runtime so the executor's prefetch path routes every
@@ -410,6 +577,10 @@ def bind_mesh_tables(compiled, axis: Optional[str] = None,
     feed is registered mesh-REPLICATED (its leading dim is unique ids,
     not batch), while the id/label feeds keep the normal batch
     sharding.  Returns the runtime (also at ``program._mesh_tables``).
+
+    ``row_dtype="int8"`` stores rows quantized (per-row absmax scales)
+    for ~4x fewer table bytes per device — lookups still hand the step
+    fp32 rows, so the consuming program is unchanged.
     """
     if not getattr(compiled, "_is_compiled_program", False):
         raise ValueError(
@@ -421,7 +592,7 @@ def bind_mesh_tables(compiled, axis: Optional[str] = None,
     axis = axis or mesh.axis_names[0]
     runtime = MeshTableRuntime(
         program, mesh, axis, optimizer=optimizer, lr=lr,
-        initializer=initializer, seed=seed)
+        initializer=initializer, seed=seed, row_dtype=row_dtype)
     program._mesh_tables = runtime
     # the prefetched-rows feeds replicate (leading dim = unique ids);
     # everything else keeps the compiled program's batch sharding

@@ -1,0 +1,259 @@
+"""int8 mesh-table embedding rows (ISSUE 18 tentpole c):
+``MeshTableRuntime(row_dtype="int8")`` stores rows as int8 codes with
+per-row fp32 scales sharded alongside — dequant after the shard-routed
+gather, before the psum; the grad push dequant-accumulates and
+requantizes whole rows so training parity holds.
+
+Pinned here:
+
+* DeepFM-style train-step loss parity vs fp32 rows at rtol 2e-3 (sgd
+  AND adagrad server-optimizer semantics),
+* per-device table bytes <= 0.35x fp32 at embed dims >= 32 (the
+  acceptance bound; exact ratio is (D + 4) / (4 * D)),
+* ``sharding_sparse_table_bytes`` computes from the STORED dtype and
+  the ``sharding_sparse_row_dtype`` info gauge names the rung,
+* checkpoint state carries the scales (kind ``mesh_table_scales``) and
+  a cross-dtype restore is a typed error, never silent garbage,
+* the Zipf cache-hit drill is unchanged: ``EmbeddingRowCache`` caches
+  DEQUANTIZED rows, so the serving hot path never sees codes.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import framework, monitor
+from paddle_tpu.parallel import mesh as mesh_lib
+from paddle_tpu.parallel.compiled_program import CompiledProgram
+from paddle_tpu.quant import dequantize_rows, quantize_rows
+from paddle_tpu.sharding.sparse import (
+    ROW_DTYPES,
+    bind_mesh_tables,
+    normalize_row_dtype,
+)
+
+V, D, B = 40, 32, 16
+PARITY_RTOL = 2e-3  # pinned: fp32-vs-int8 per-step train loss bound
+
+
+def _emb_model(optimizer="sgd", lr=0.1, seed=21):
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = seed
+    with framework.program_guard(prog, startup):
+        ids = fluid.layers.data("ids", [1], dtype="int64")
+        y = fluid.layers.data("y", [1])
+        emb = fluid.layers.embedding(
+            ids, [V, D], is_sparse=True, is_distributed=True,
+            param_attr=fluid.ParamAttr(name="ctr_table"))
+        pred = fluid.layers.fc(emb, 1, name="head")
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        if optimizer == "adagrad":
+            fluid.optimizer.AdagradOptimizer(lr).minimize(loss)
+        else:
+            fluid.optimizer.SGDOptimizer(lr).minimize(loss)
+    return prog, startup, loss
+
+
+def _feeds(n, seed=4):
+    rng = np.random.RandomState(seed)
+    return [{"ids": rng.randint(0, V, (B, 1)).astype("int64"),
+             "y": rng.randn(B, 1).astype("float32")} for _ in range(n)]
+
+
+def _train(row_dtype, optimizer, feeds):
+    prog, startup, loss = _emb_model(optimizer=optimizer)
+    mesh = mesh_lib.make_mesh({"mp": 4})
+    compiled = CompiledProgram(prog).with_mesh(mesh)
+    rt = bind_mesh_tables(compiled, optimizer=optimizer, lr=0.1,
+                          initializer="zeros", row_dtype=row_dtype)
+    try:
+        exe = fluid.Executor(fluid.CPUPlace())
+        losses = []
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            for f in feeds:
+                (l,) = exe.run(compiled, feed=dict(f), fetch_list=[loss])
+                losses.append(float(np.asarray(l)))
+        tbl = rt.tables["ctr_table"]
+        return losses, tbl.bytes_per_device(), rt.stats()
+    finally:
+        rt.close()
+
+
+def test_row_dtype_normalization():
+    assert ROW_DTYPES == ("fp32", "int8")
+    assert normalize_row_dtype(None) == "fp32"
+    assert normalize_row_dtype("float32") == "fp32"
+    with pytest.raises(ValueError):
+        normalize_row_dtype("fp16")
+
+
+def test_quant_identity_and_zero_rows():
+    """The shared scheme's two load-bearing properties: requantizing a
+    dequantized row is bit-identical (what makes the push's scatter-set
+    write-back safe for untouched rows), and zero rows stay zero."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(16, D).astype(np.float32))
+    q, s = quantize_rows(x)
+    assert q.dtype == jnp.int8 and s.dtype == jnp.float32
+    q2, s2 = quantize_rows(dequantize_rows(q, s))
+    np.testing.assert_array_equal(np.asarray(q), np.asarray(q2))
+    np.testing.assert_array_equal(np.asarray(s), np.asarray(s2))
+    qz, sz = quantize_rows(jnp.zeros((4, D)))
+    assert np.asarray(qz).sum() == 0
+    np.testing.assert_array_equal(
+        np.asarray(dequantize_rows(qz, sz)), np.zeros((4, D)))
+
+
+@pytest.mark.parametrize("optimizer", ["sgd", "adagrad"])
+def test_int8_rows_train_parity_and_bytes(optimizer):
+    feeds = _feeds(10)
+    l32, b32, _ = _train("fp32", optimizer, feeds)
+    l8, b8, st8 = _train("int8", optimizer, feeds)
+    np.testing.assert_allclose(l8, l32, rtol=PARITY_RTOL, atol=1e-6)
+    assert b8 <= 0.35 * b32, (b8, b32)
+    assert st8["row_dtype"] == "int8"
+    assert st8["tables"]["ctr_table"]["row_dtype"] == "int8"
+
+
+def test_sparse_bytes_gauge_from_stored_dtype_and_info_gauge():
+    """Satellite pin: sharding_sparse_table_bytes carries the stored-
+    dtype bytes (codes + scales, NOT the declared fp32 width), and the
+    sharding_sparse_row_dtype info gauge names the rung while the
+    runtime lives and is retired with it."""
+    prog, startup, loss = _emb_model()
+    mesh = mesh_lib.make_mesh({"mp": 4})
+    compiled = CompiledProgram(prog).with_mesh(mesh)
+    rt = bind_mesh_tables(compiled, optimizer="sgd", lr=0.1,
+                          initializer="zeros", row_dtype="int8")
+    try:
+        tbl = rt.tables["ctr_table"]
+        pad_rows = tbl.array.shape[0]  # padded to the shard grid
+        per_dev = pad_rows // 4
+        assert tbl.bytes_per_device() == per_dev * D + per_dev * 4
+        assert tbl.replicated_bytes() == pad_rows * D + pad_rows * 4
+        snap = monitor.REGISTRY.snapshot()
+        series = {tuple(sorted(s["labels"].items())): s["value"]
+                  for s in snap["sharding_sparse_table_bytes"]["series"]}
+        assert series[(("table", "ctr_table"),)] == tbl.bytes_per_device()
+        dt_series = {tuple(sorted(s["labels"].items())): s["value"]
+                     for s in snap["sharding_sparse_row_dtype"]["series"]}
+        assert dt_series[
+            (("dtype", "int8"), ("table", "ctr_table"))] == 1
+    finally:
+        rt.close()
+    snap = monitor.REGISTRY.snapshot()
+    assert not any(
+        (s["labels"] or {}).get("table") == "ctr_table"
+        for s in snap.get("sharding_sparse_row_dtype",
+                          {"series": []})["series"])
+
+
+def test_int8_zero_recompiles_mixed_batches():
+    """The zero-recompile ladder contract survives the int8 rung: after
+    warmup, mixed bucket/batch traffic costs no compiles."""
+    prog, startup, loss = _emb_model()
+    mesh = mesh_lib.make_mesh({"mp": 4})
+    compiled = CompiledProgram(prog).with_mesh(mesh)
+    rt = bind_mesh_tables(compiled, optimizer="sgd", lr=0.1,
+                          initializer="zeros", row_dtype="int8")
+    try:
+        rt.warmup([8, 16, 32])
+        exe = fluid.Executor(fluid.CPUPlace())
+        rng = np.random.RandomState(0)
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            for b in (8, 16, 32):
+                f = {"ids": rng.randint(0, V, (b, 1)).astype("int64"),
+                     "y": rng.randn(b, 1).astype("float32")}
+                exe.run(compiled, feed=dict(f), fetch_list=[loss])
+            compiles0 = rt.compiles
+            misses0 = exe.jit_cache_stats()["misses"]
+            for i in range(9):
+                b = (8, 16, 32)[i % 3]
+                f = {"ids": rng.randint(0, V, (b, 1)).astype("int64"),
+                     "y": rng.randn(b, 1).astype("float32")}
+                exe.run(compiled, feed=dict(f), fetch_list=[loss])
+        assert rt.compiles == compiles0
+        assert exe.jit_cache_stats()["misses"] == misses0
+    finally:
+        rt.close()
+
+
+def test_checkpoint_carries_scales_and_cross_dtype_is_typed():
+    prog, startup, loss = _emb_model()
+    mesh = mesh_lib.make_mesh({"mp": 4})
+    compiled = CompiledProgram(prog).with_mesh(mesh)
+    rt = bind_mesh_tables(compiled, optimizer="sgd", lr=0.1,
+                          initializer="zeros", row_dtype="int8")
+    try:
+        exe = fluid.Executor(fluid.CPUPlace())
+        rng = np.random.RandomState(7)
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            for f in _feeds(3, seed=7):
+                exe.run(compiled, feed=dict(f), fetch_list=[loss])
+        cs = rt.checkpoint_state()
+        kinds = {v["kind"] for v in cs.values()}
+        assert kinds == {"mesh_table", "mesh_table_scales"}
+        assert str(cs["ctr_table"]["array"].dtype) == "int8"
+        # round-trip: lookups agree before/after reinstall
+        probe = np.arange(V, dtype=np.int64)
+        before = np.asarray(rt.lookup("ctr_table", probe))
+        for ent in cs.values():
+            rt.install_state(ent["table"], ent["kind"], ent["array"])
+        np.testing.assert_array_equal(
+            np.asarray(rt.lookup("ctr_table", probe)), before)
+    finally:
+        rt.close()
+
+    # an fp32 runtime refuses the scales leaf (typed, names the fix)
+    prog2, _, _ = _emb_model()
+    compiled2 = CompiledProgram(prog2).with_mesh(mesh_lib.make_mesh({"mp": 4}))
+    rt32 = bind_mesh_tables(compiled2, optimizer="sgd", lr=0.1,
+                            initializer="zeros")
+    try:
+        with pytest.raises(ValueError, match="row_dtype"):
+            rt32.install_state("ctr_table", "mesh_table_scales",
+                               cs["ctr_table#scales"]["array"])
+        # and an int8 rows array mismatches the fp32 table's DTYPE
+        with pytest.raises(ValueError, match="dtype"):
+            rt32.install_state("ctr_table", "mesh_table",
+                               cs["ctr_table"]["array"])
+    finally:
+        rt32.close()
+
+
+def test_embedding_cache_serves_dequantized_rows():
+    """The serving hot path is untouched: EmbeddingRowCache caches the
+    DEQUANTIZED fp32 rows from an int8 runtime, and its hit accounting
+    (the Zipf drill's substrate) behaves exactly as over fp32 rows."""
+    from paddle_tpu.serving.embedding_cache import EmbeddingRowCache
+
+    prog, startup, loss = _emb_model()
+    mesh = mesh_lib.make_mesh({"mp": 4})
+    compiled = CompiledProgram(prog).with_mesh(mesh)
+    rt = bind_mesh_tables(compiled, optimizer="sgd", lr=0.1,
+                          initializer="uniform", row_dtype="int8")
+    try:
+        cache = EmbeddingRowCache(capacity_rows=V, name="i8rows")
+        try:
+            class _RtClient:
+                def pull_sparse(self, table, ids):
+                    return np.asarray(rt.lookup(table, ids))
+
+            cli = _RtClient()
+            ids = np.arange(8, dtype=np.int64)
+            rows = cache.lookup_through(cli, "ctr_table", ids)
+            assert rows.dtype == np.float32 and rows.shape == (8, D)
+            np.testing.assert_array_equal(
+                rows, np.asarray(rt.lookup("ctr_table", ids)))
+            again = cache.lookup_through(cli, "ctr_table", ids)
+            np.testing.assert_array_equal(again, rows)
+            st = cache.stats()
+            assert st["hits"] >= 8 and st["misses"] >= 8
+        finally:
+            cache.close()
+    finally:
+        rt.close()

@@ -26,6 +26,16 @@ def test_train_layouts_cover_accumulators():
     assert problems == [], "\n".join(problems)
 
 
+def test_layouts_cover_bf16_variants():
+    """Composed precision x sharding: each family's bf16 variant must
+    keep the base param grammar (hoisted casts flip dtypes, never
+    names) and resolve under every canonical layout — the invariant
+    that lets one sharding manifest serve both the fp32 program and
+    its bf16 variant."""
+    problems = check_partition_rules.check_bf16_variants()
+    assert problems == [], "\n".join(problems)
+
+
 def test_train_builder_sees_real_accumulators():
     """The train build must produce a real accumulator map — an empty
     map would make train coverage pass vacuously — and the checker must

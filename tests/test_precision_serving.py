@@ -169,12 +169,16 @@ def test_unknown_policy_dtype_and_keys_typed(tmp_path):
                            "custom_black_list": ["softmax"]})
 
 
-def test_precision_and_sharding_not_composable(tmp_path):
+def test_int8_precision_and_sharding_not_composable(tmp_path):
+    """bf16 composes with sharding (tests/test_precision_sharding.py);
+    int8's frozen sub-model carries its own param set and stays typed-
+    refused when combined with a layout."""
     from paddle_tpu import sharding
 
+    cal = [_lenet_feed(n=2, seed=100)]
     with pytest.raises(mp_inf.PrecisionPolicyError):
         _export(tmp_path / "ep", _build_lm,
-                precision={"dtype": "bf16"},
+                precision={"dtype": "int8", "calibration": cal},
                 sharding_rules=sharding.transformer_lm_rules("tp"),
                 sharding_mesh={"tp": 2})
 

@@ -1,0 +1,228 @@
+"""Precision × sharding composed (ISSUE 18 tentpole a): one endpoint
+exports BOTH a bf16 precision policy and a tp layout, the manifest
+cross-links the two blocks, and the loader reconstructs layout AND
+variant — the hoisted param casts applied at shard-placement time, so
+no fp32 full-width param ever materializes on device for the variant.
+
+Pinned here:
+
+* composed export → load → serve passes the typed parity gate (rtol
+  from the policy) with the fp32 per-request opt-out still warmed,
+* per-shard dtype asserted via ``param_placements()`` — bf16 stored,
+  dtype-aware ``bytes_per_device`` (satellite: ``sharding_stats`` /
+  ``sharding_group_hbm_bytes`` compute from the STORED dtype),
+* ZERO recompiles after warmup across both ladders behind
+  ``InferenceServer``,
+* a doctored manifest carrying only one of the two blocks is a typed
+  load error, never a silently-degraded endpoint.
+"""
+import json
+import os
+import shutil
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import framework, models, monitor, serving, sharding
+from paddle_tpu.contrib.mixed_precision import inference as mp_inf
+from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+from paddle_tpu.sharding.rules import ShardingRuleError
+
+SEQ, D_MODEL, VOCAB, TP = 16, 32, 256, 2
+
+
+def _save_lm(dirname, precision=None, sharded=False):
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 21  # identical weights
+    with framework.program_guard(prog, startup):
+        ids = fluid.layers.data("src_ids", [SEQ], dtype="int64")
+        _, logits = models.transformer_lm(
+            ids, None, vocab_size=VOCAB, d_model=D_MODEL, n_layer=2,
+            n_head=4, d_inner=64, seq_len=SEQ, max_pos=64)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        kw = {}
+        if sharded:
+            kw = dict(sharding_rules=sharding.transformer_lm_rules("tp"),
+                      sharding_mesh={"tp": TP})
+        if precision is not None:
+            kw["precision_policy"] = precision
+        fluid.save_inference_model(
+            dirname, ["src_ids"], [logits], exe, prog, **kw)
+    return dirname
+
+
+@pytest.fixture(scope="module")
+def dirs():
+    with tempfile.TemporaryDirectory() as tmp:
+        yield {
+            "replicated": _save_lm(os.path.join(tmp, "rep")),
+            "sharded_fp32": _save_lm(os.path.join(tmp, "tp2"),
+                                     sharded=True),
+            "composed": _save_lm(os.path.join(tmp, "bf16tp2"),
+                                 precision={"dtype": "bf16"},
+                                 sharded=True),
+        }
+
+
+def _ids(n, seed=0):
+    return np.random.RandomState(seed).randint(
+        1, VOCAB, (n, SEQ)).astype(np.int64)
+
+
+def test_manifest_cross_links_both_blocks(dirs):
+    with open(os.path.join(dirs["composed"], "__model__")) as f:
+        model = json.load(f)
+    assert model["precision"]["sharded"] is True
+    assert model["sharding"]["precision_dtype"] == "bf16"
+    assert model["sharding"]["mesh_axes"] == {"tp": TP}
+    # single-block exports stay un-linked (no spurious typed errors)
+    with open(os.path.join(dirs["sharded_fp32"], "__model__")) as f:
+        assert "precision_dtype" not in json.load(f)["sharding"]
+
+
+def test_composed_load_reconstructs_layout_and_variant(dirs):
+    pred = create_paddle_predictor(AnalysisConfig(dirs["composed"]))
+    assert pred.sharded
+    policy = pred.precision_policy
+    assert policy["dtype"] == "bf16" and policy["sharded"] is True
+    assert policy["max_rel_err"] <= policy["rtol"]
+    assert pred.precision_dtypes() == ["bf16", "fp32"]
+
+    rep = create_paddle_predictor(AnalysisConfig(dirs["replicated"]))
+    x = _ids(3, seed=5)
+    out_low, = pred.run({"src_ids": x})
+    out_ref, = rep.run({"src_ids": x})
+    # the typed parity gate's bound holds at serve time too
+    assert mp_inf.max_rel_err([out_ref], [out_low]) <= policy["rtol"]
+    # fp32 opt-out is the exact base program
+    out_f, = pred.run({"src_ids": x}, precision="fp32")
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_ref),
+                               rtol=2e-4, atol=2e-4)
+    # and the variants genuinely differ (bf16 is not silently fp32)
+    assert not np.array_equal(np.asarray(out_low, np.float32),
+                              np.asarray(out_f))
+
+
+def test_no_fp32_fullwidth_param_on_device(dirs):
+    """Acceptance: per-shard dtype via param_placements() — every
+    hoisted param is STORED bf16 at shard shape; bytes are dtype-aware
+    (half the fp32 shard); nothing reports a full-width fp32 shape."""
+    pred = create_paddle_predictor(AnalysisConfig(dirs["composed"]))
+    pred.run({"src_ids": _ids(2)})  # place both variants
+    pl_low = pred.param_placements()           # policy default = bf16
+    pl_f32 = pred.param_placements("fp32")     # base program
+    cast = set(pred._variant_cast_params["bf16"])
+    assert cast  # the variant hoisted a real param set
+    for name in cast:
+        p = pl_low[name]
+        assert p["dtype"] == "bfloat16", (name, p)
+        assert p["placed"], name
+        n_shard = int(np.prod(p["shard_shape"]))
+        assert p["bytes_per_device"] == 2 * n_shard, (name, p)
+        if p["sharded"]:
+            # the shard, not the full shape, is what's on device
+            assert n_shard < int(np.prod(p["shape"])), name
+    # fp32 opt-out params stay fp32 at 4 bytes/elem
+    qw = pl_f32["lm_dec_0_att_q_w"]
+    assert qw["dtype"] == "float32"
+    assert qw["bytes_per_device"] == 4 * int(np.prod(qw["shard_shape"]))
+
+
+def test_sharding_stats_bytes_from_stored_dtype(dirs):
+    """Satellite pin: sharding_stats()/sharding_group_hbm_bytes report
+    the STORED dtype's bytes — the composed bf16 endpoint's per-device
+    HBM is about half the fp32-sharded export's."""
+    comp = create_paddle_predictor(AnalysisConfig(dirs["composed"]))
+    f32 = create_paddle_predictor(AnalysisConfig(dirs["sharded_fp32"]))
+    comp.run({"src_ids": _ids(2)})
+    comp.run({"src_ids": _ids(2)}, precision="fp32")  # place the opt-out too
+    f32.run({"src_ids": _ids(2)})
+    s_low = comp.sharding_stats(group="bf16tp2")
+    s_f32 = f32.sharding_stats()
+    assert s_low["n_sharded"] == s_f32["n_sharded"] >= 20
+    # dtype-aware to the byte: every hoisted param saves exactly half
+    # its fp32 per-device footprint (the un-hoisted embedding lookups
+    # stay fp32, so the total is the fp32 rent minus the cast set's
+    # 2-bytes-per-element savings)
+    pl_low = comp.param_placements()
+    saved = sum(2 * int(np.prod(pl_low[n]["shard_shape"]))
+                for n in comp._variant_cast_params["bf16"])
+    assert saved > 0
+    assert s_low["hbm_bytes_per_device"] == s_f32[
+        "hbm_bytes_per_device"] - saved
+    # the opt-out variant still reports full fp32 rent
+    s_opt = comp.sharding_stats(precision="fp32")
+    assert s_opt["hbm_bytes_per_device"] == s_f32["hbm_bytes_per_device"]
+    # the gauge carries the dtype-aware number
+    snap = monitor.REGISTRY.snapshot()["sharding_group_hbm_bytes"]
+    series = {tuple(sorted(s["labels"].items())): s["value"]
+              for s in snap["series"]}
+    assert series[(("group", "bf16tp2"),)] == s_low["hbm_bytes_per_device"]
+
+
+def test_composed_serving_zero_recompiles(dirs):
+    """The zero-recompile warmup contract holds composed: both ladders
+    warm, a storm mixing policy-default and fp32 opt-out requests never
+    compiles, batches never mix precisions."""
+    pred = create_paddle_predictor(AnalysisConfig(dirs["composed"]))
+    srv = serving.InferenceServer(
+        pred, max_batch_size=8, batch_timeout_ms=2, queue_capacity=64,
+        name="bf16tp2-srv")
+    try:
+        compiles = srv.warmup()
+        assert compiles == 2 * len(srv.bucket_ladder)
+        misses0 = pred.jit_cache_stats()["misses"]
+        cli = serving.Client(srv)
+        for i in range(30):
+            feed = {"src_ids": _ids(1 + i % 3, seed=i)}
+            cli.infer(feed, precision="fp32" if i % 5 == 0 else None)
+        m = srv.metrics()
+        assert m["recompiles"] == 0
+        assert pred.jit_cache_stats()["misses"] == misses0
+        assert m["precision_requests"]["bf16"] == 24
+        assert m["precision_requests"]["fp32"] == 6
+    finally:
+        srv.stop(drain=True)
+
+
+def _doctor(src, strip):
+    dst = tempfile.mkdtemp(prefix="doctored-")
+    for f in os.listdir(src):
+        shutil.copy(os.path.join(src, f), os.path.join(dst, f))
+    with open(os.path.join(dst, "__model__")) as f:
+        model = json.load(f)
+    del model[strip]
+    with open(os.path.join(dst, "__model__"), "w") as f:
+        json.dump(model, f)
+    return dst
+
+
+def test_doctored_single_block_manifests_are_typed(dirs):
+    """A composed export whose manifest lost one block fails TYPED at
+    load — fp32-but-sharded and bf16-but-replicated are both refused."""
+    no_precision = _doctor(dirs["composed"], "precision")
+    try:
+        with pytest.raises(ShardingRuleError, match="precision_dtype"):
+            create_paddle_predictor(AnalysisConfig(no_precision))
+    finally:
+        shutil.rmtree(no_precision)
+    no_sharding = _doctor(dirs["composed"], "sharding")
+    try:
+        with pytest.raises(mp_inf.PrecisionPolicyError,
+                           match="sharded=true"):
+            create_paddle_predictor(AnalysisConfig(no_sharding))
+    finally:
+        shutil.rmtree(no_sharding)
+
+
+def test_composed_parity_gate_still_typed(tmp_path):
+    """The export parity gate rides through composition unchanged: an
+    impossible rtol fails typed at export, before anything saves."""
+    with pytest.raises(mp_inf.PrecisionParityError):
+        _save_lm(str(tmp_path / "ep"),
+                 precision={"dtype": "bf16", "rtol": 1e-9},
+                 sharded=True)

@@ -85,7 +85,8 @@ def _check_shards(sdir: str, ck_name: str, problems: List[str]) -> None:
         shape = tuple(int(d) for d in ent["shape"])
         full = tuple((0, d) for d in shape)
         required = full
-        if ent.get("kind") in ("mesh_table", "mesh_table_moments"):
+        if ent.get("kind") in ("mesh_table", "mesh_table_moments",
+                               "mesh_table_scales"):
             height = min(int(ent.get("height", shape[0])), shape[0])
             required = ((0, height),) + full[1:]
         boxes = []

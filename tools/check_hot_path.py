@@ -77,6 +77,10 @@ CHECKED_FILES = [
     # (spec_verify) — a blocking sync in either stalls every decode tick
     "paddle_tpu/serving/prefix_cache.py",
     "paddle_tpu/serving/speculative.py",
+    # int8 quantize/dequantize helpers run INSIDE jitted step/verify
+    # fns and the mesh-table push kernels — any host sync here would
+    # land in every decode tick and every sparse train step
+    "paddle_tpu/quant.py",
 ]
 
 # blocking-sync tokens (substring match on code, not comments)

@@ -25,6 +25,12 @@ optimizer accumulators (via rule inheritance from their param), LR
 vars — with no unmatched name and no dead rule.  A layout that serves
 fine but cannot train fails here, not in the first sharded epoch.
 
+BF16-VARIANT mode extends it to the composed precision × sharding
+exports: each family's bf16 variant (``build_bf16_variant`` — rewrite,
+hoist param casts, pin fetches) must keep the base parameter grammar
+and resolve under every canonical layout, since one sharding manifest
+serves both the fp32 program and its variant.
+
 Wired into tier-1 via tests/test_partition_rules.py (same pattern as
 check_fault_points.py); also runnable directly::
 
@@ -42,9 +48,10 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 def _build_family(family: str, train: bool):
     """Build one family's real in-tree model; with ``train`` a real
     Adam minimize is appended (labels + backward + accumulators).
-    Returns ({persistable name: shape}, optimizer-or-None) — ONE
-    construction per family, so the serve and train guards can never
-    validate against different parameter grammars."""
+    Returns ({persistable name: shape}, optimizer-or-None, program,
+    fetch var — the loss when training, the serve output otherwise) —
+    ONE construction per family, so the serve and train guards can
+    never validate against different parameter grammars."""
     import paddle_tpu as fluid
     from paddle_tpu import framework, models
     from paddle_tpu.models.seq2seq import transformer_nmt
@@ -55,7 +62,7 @@ def _build_family(family: str, train: bool):
             ids = fluid.layers.data("src_ids", [16], dtype="int64")
             lbl = (fluid.layers.data("lbl", [16, 1], dtype="int64")
                    if train else None)
-            loss, _ = models.transformer_lm(
+            loss, out = models.transformer_lm(
                 ids, lbl, vocab_size=128, d_model=32, n_layer=2,
                 n_head=4, d_inner=64, seq_len=16, max_pos=64)
         elif family == "transformer_nmt":
@@ -63,15 +70,15 @@ def _build_family(family: str, train: bool):
             tgt = fluid.layers.data("tgt_ids", [8], dtype="int64")
             lbl = (fluid.layers.data("lbl", [8, 1], dtype="int64")
                    if train else None)
-            loss, _ = transformer_nmt(src, tgt, lbl, None,
-                                      src_len=8, tgt_len=8)
+            loss, out = transformer_nmt(src, tgt, lbl, None,
+                                        src_len=8, tgt_len=8)
         elif family == "deepfm":
             ids = fluid.layers.data("feat_ids", [39, 1], dtype="int64")
             vals = fluid.layers.data("feat_vals", [39])
             lbl = fluid.layers.data("lbl", [1], dtype="int64")
-            loss, _ = models.deepfm_ctr(ids, vals, lbl, num_features=1000,
-                                        num_fields=39, embed_dim=8,
-                                        deep_layers=(16, 16))
+            loss, out = models.deepfm_ctr(ids, vals, lbl, num_features=1000,
+                                          num_fields=39, embed_dim=8,
+                                          deep_layers=(16, 16))
         else:
             raise ValueError("unknown family %r" % family)
         opt = None
@@ -87,7 +94,7 @@ def _build_family(family: str, train: bool):
         for v in prog.list_vars()
         if v.persistable and not v.is_data
     }
-    return shapes, opt
+    return shapes, opt, prog, (loss if loss is not None else out)
 
 
 def _build(family: str) -> Dict[str, Tuple[int, ...]]:
@@ -101,7 +108,7 @@ def _build_train(family: str):
     real Adam minimize, so the persistable set includes every optimizer
     accumulator and the LR var — exactly what a sharded training run
     must place."""
-    shapes, opt = _build_family(family, train=True)
+    shapes, opt, _, _ = _build_family(family, train=True)
     return shapes, opt.accumulator_map()
 
 
@@ -169,14 +176,64 @@ def check_train() -> List[str]:
     return problems
 
 
+def check_bf16_variants() -> List[str]:
+    """Precision × sharding composed-mode guard: the bf16 VARIANT of
+    each family's model must keep the base parameter grammar — hoisted
+    casts flip dtypes, never names — so every canonical layout resolves
+    the variant's param set exactly like the base's.  This is the
+    invariant that lets ONE sharding manifest serve both the fp32
+    program and its bf16 variant (``save_inference_model`` composes the
+    two blocks; ``AnalysisPredictor`` reconstructs both on load): if a
+    refactor ever makes hoisting rename a parameter, it fails here, not
+    at a sharded bf16 endpoint's first warmup."""
+    from paddle_tpu.contrib.mixed_precision.inference import (
+        build_bf16_variant,
+    )
+    from paddle_tpu.sharding.layouts import FAMILIES, MODES, canonical_rules
+    from paddle_tpu.sharding.rules import ShardingRuleError
+
+    problems: List[str] = []
+    for family in sorted(FAMILIES):
+        base_shapes, _, prog, fetch = _build_family(family, train=False)
+        variant, info = build_bf16_variant(prog, [fetch.name])
+        if not info["cast_params"]:
+            problems.append(
+                "family %r: bf16 variant hoisted zero params — the "
+                "composed export would serve fp32 under a bf16 label"
+                % family)
+        vshapes = {
+            v.name: tuple(v.shape or ())
+            for v in variant.list_vars()
+            if v.persistable and not v.is_data
+        }
+        if set(vshapes) != set(base_shapes):
+            added = sorted(set(vshapes) - set(base_shapes))[:3]
+            gone = sorted(set(base_shapes) - set(vshapes))[:3]
+            problems.append(
+                "family %r: bf16 variant param set drifted from the "
+                "base program (added %s, removed %s) — one sharding "
+                "manifest can no longer cover both" % (family, added,
+                                                       gone))
+            continue
+        for mode in MODES:
+            rules = canonical_rules(family, mode)
+            try:
+                rules.match(vshapes)
+            except ShardingRuleError as e:
+                problems.append(
+                    "layout %s/%s does not cover the family's bf16 "
+                    "variant: %s" % (family, mode, e))
+    return problems
+
+
 def main() -> int:
-    problems = check() + check_train()
+    problems = check() + check_train() + check_bf16_variants()
     if not problems:
         from paddle_tpu.sharding.layouts import FAMILIES, MODES
 
         print("check_partition_rules: OK (%d layouts cover %d families, "
-              "serve + train)" % (len(FAMILIES) * len(MODES),
-                                  len(FAMILIES)))
+              "serve + train + bf16 variants)"
+              % (len(FAMILIES) * len(MODES), len(FAMILIES)))
         return 0
     for p in problems:
         print("check_partition_rules: %s" % p, file=sys.stderr)

@@ -4,9 +4,11 @@
 Polls a FleetBalancer's federated admin endpoints (``/statusz``,
 ``/sloz``, ``/eventz`` — see ``FleetBalancer.start_admin``) and renders
 the operator's one screen for a running fleet: per-backend QPS,
-p50/p99 latency, mean TTFT, batch occupancy, brownout level and
-in-flight counts, the SLO objectives' multi-window burn rates with
-firing alerts, and the fleet-merged operational event tail.
+p50/p99 latency, mean TTFT, batch occupancy, brownout level,
+in-flight counts and the precision/storage dtype mix (default
+precision dtype plus the int8 KV-cache / mesh-table-row rungs), the
+SLO objectives' multi-window burn rates with firing alerts, and the
+fleet-merged operational event tail.
 
 Pure stdlib (urllib + ANSI), so it runs anywhere the fleet does::
 
@@ -61,6 +63,28 @@ def _hist_mean_ms(registry: dict, name: str) -> object:
     return (total / count) * 1e3 if count else None
 
 
+def _dtype_tag(metrics: dict, registry: dict) -> str:
+    """One compact storage/compute-dtype tag per backend from its
+    scraped statusz: default precision dtype, then the non-fp32 storage
+    rungs (decode KV cache, mesh-table rows) as ``kv:``/``row:`` parts
+    — e.g. ``bf16+kv:int8``; plain fp32 everywhere renders ``fp32``."""
+    parts = []
+    dts = metrics.get("precision_dtypes")
+    if isinstance(dts, (list, tuple)) and dts:
+        parts.append(str(dts[0]))
+    kv = (metrics.get("decode") or {}).get("kv_dtype")
+    if kv and kv != "fp32":
+        parts.append("kv:%s" % kv)
+    fam = (registry or {}).get("sharding_sparse_row_dtype")
+    if isinstance(fam, dict):
+        row_dts = sorted({
+            str((s.get("labels") or {}).get("dtype"))
+            for s in fam.get("series", ())
+            if (s.get("labels") or {}).get("dtype")})
+        parts.extend("row:%s" % d for d in row_dts if d != "fp32")
+    return "+".join(parts) if parts else ("fp32" if metrics else "-")
+
+
 def _backend_rows(statusz: dict):
     """Join the balancer's routing view with each child's scraped
     statusz into per-backend display rows."""
@@ -82,6 +106,7 @@ def _backend_rows(statusz: dict):
             "ttft_ms": _hist_mean_ms(reg, "serving_decode_ttft_seconds"),
             "occupancy": m.get("mean_batch_occupancy"),
             "brownout": r.get("brownout_level"),
+            "dtype": _dtype_tag(m, reg),
             "age_s": (scraped.get(name) or {}).get("age_s"),
         })
     return rows
@@ -106,18 +131,19 @@ def render_frame(statusz: dict, sloz: dict, eventz: dict,
                     paint("critical", "BURNING")))
     lines.append("")
 
-    lines.append("%-28s %-5s %5s %7s %8s %8s %8s %5s %5s"
+    lines.append("%-28s %-5s %5s %7s %8s %8s %8s %5s %5s %-13s"
                  % ("BACKEND", "alive", "infl", "qps", "p50_ms",
-                    "p99_ms", "ttft_ms", "occ", "brn"))
+                    "p99_ms", "ttft_ms", "occ", "brn", "dtype"))
     for r in rows:
-        lines.append("%-28s %-5s %5s %7s %8s %8s %8s %5s %5s" % (
+        lines.append("%-28s %-5s %5s %7s %8s %8s %8s %5s %5s %-13s" % (
             r["name"][:28],
             {True: "yes", False: "NO"}.get(r["alive"], "?"),
             r["in_flight"] if r["in_flight"] is not None else "-",
             _f(r["qps"]), _f(r["p50_ms"], "%.2f"),
             _f(r["p99_ms"], "%.2f"), _f(r["ttft_ms"], "%.2f"),
             _f(r["occupancy"], "%.2f"),
-            r["brownout"] if r["brownout"] is not None else "-"))
+            r["brownout"] if r["brownout"] is not None else "-",
+            r["dtype"][:13]))
     if not rows:
         lines.append("  (no backends scraped yet)")
     lines.append("")
