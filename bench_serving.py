@@ -109,6 +109,23 @@ HBM footprint vs the replicated baseline (sharded params hold 1/tp of
 their bytes per device — the capacity headroom the layout buys).
 Env knobs: BENCH_SHARDED_TP (default 2).
 
+``--long-context`` (or $BENCH_SERVING_LONG_CONTEXT=1) benches
+LONG-CONTEXT serving: the fused-attention transformer LM at a sequence
+length whose UNSHARDED activations exceed the per-chip budget
+(BENCH_LC_CHIP_BUDGET_BYTES, default 16 MiB), served three ways —
+unsharded, sp-2, sp-4 (the canonical ``sp`` layout rides the manifest;
+attention runs as ring attention over the sp mesh axis) — plus the
+same export as a pp-2 ``PipelinePredictor`` micro-batched (M=4) vs
+sequential (M=1).  The line reports tokens/s and activation
+bytes/device per leg and asserts: sp-4 logits match unsharded at
+rtol 2e-4, sp-4 activation bytes/device are exactly 1/4 of unsharded
+(and fit the budget the unsharded footprint exceeds), a post-warmup
+mixed-length storm never recompiles, pipelined output is exact, and
+the executed pp-2/M-4 schedule's bubble ratio is < 0.5 (the
+sequential M=1 schedule pins the 0.5 worst case it must beat).
+Env knobs: BENCH_LC_SEQ (default 512), BENCH_LC_BATCH (4),
+BENCH_LC_REPS (6), BENCH_LC_CHIP_BUDGET_BYTES.
+
 ``--precision`` (or $BENCH_SERVING_PRECISION=1) benches MIXED-PRECISION
 serving (``contrib/mixed_precision`` pointed at the inference path):
 LeNet and DeepFM each served plain fp32 vs under a bf16 precision
@@ -785,6 +802,184 @@ def run_sharded():
         "requests_per_thread": REQUESTS,
         "max_batch_size": MAX_BATCH,
         "batch_timeout_ms": TIMEOUT_MS,
+        "platform": jax.devices()[0].platform,
+    }
+
+
+# ---------------------------------------------------------------------------
+# --long-context: sequence-parallel ring attention + pipelined predictor
+# ---------------------------------------------------------------------------
+_LC_S = int(os.environ.get("BENCH_LC_SEQ", "512"))
+_LC_B = int(os.environ.get("BENCH_LC_BATCH", "4"))
+_LC_REPS = int(os.environ.get("BENCH_LC_REPS", "6"))
+_LC_BUDGET = int(os.environ.get("BENCH_LC_CHIP_BUDGET_BYTES",
+                                str(16 << 20)))
+_LC_DIMS = (512, 64, 2, 4, 128)  # V, D, L, H, DI
+
+
+def _save_lc_lm(n_sp):
+    """Save-fn factory for the LONG-CONTEXT fused-attention LM export
+    (seq ``_LC_S``; causality is the fused op's attr, so no [S, S]
+    bias tensor exists to blow the activation budget or block the
+    pipeline cut).  ``n_sp > 1`` embeds the canonical ``sp`` layout +
+    mesh in the manifest: the loaded predictor then constrains every
+    [*, S, *] intermediate onto ``n_sp`` devices and dispatches
+    attention as ring attention over the sp axis."""
+    V, D, L, H, DI = _LC_DIMS
+
+    def save_fn(dirname):
+        import paddle_tpu as fluid
+        from paddle_tpu import framework, models, sharding
+
+        prog, startup = framework.Program(), framework.Program()
+        prog.random_seed = startup.random_seed = 11
+        with framework.program_guard(prog, startup):
+            ids = fluid.layers.data("src_ids", [_LC_S], dtype="int64")
+            _, logits = models.transformer_lm(
+                ids, None, vocab_size=V, d_model=D, n_layer=L,
+                n_head=H, d_inner=DI, seq_len=_LC_S, max_pos=2 * _LC_S,
+                fused_attention=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        kw = {}
+        if n_sp > 1:
+            kw = dict(sharding_rules=sharding.transformer_lm_rules("sp"),
+                      sharding_mesh={"sp": n_sp})
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            fluid.save_inference_model(
+                dirname, ["src_ids"], [logits], exe, prog, **kw)
+
+    return save_fn
+
+
+def _lc_tokens_per_s(run_fn):
+    """tokens/s over ``_LC_REPS`` steady dispatches of a [B, S] batch
+    (one untimed dispatch first: compile + placement)."""
+    run_fn()
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(_LC_REPS):
+        out = run_fn()
+    np.asarray(out[0])
+    elapsed = time.perf_counter() - t0
+    return round(_LC_REPS * _LC_B * _LC_S / elapsed, 1)
+
+
+def run_long_context():
+    """The ``--long-context`` line (see module docstring)."""
+    import sys
+
+    import bench_common
+
+    if "jax" not in sys.modules:
+        os.environ.update(bench_common.virtual_mesh_env())
+    import jax
+
+    from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+    from paddle_tpu.parallel.pipeline_predictor import PipelinePredictor
+
+    bench_common.configure_compile_cache(bench_common.HOME_CACHE_DIR)
+    V = _LC_DIMS[0]
+    rng = np.random.RandomState(42)
+    x = rng.randint(1, V, (_LC_B, _LC_S)).astype(np.int64)
+    x_small = x[:2]
+
+    with tempfile.TemporaryDirectory() as tmp:
+        legs = {}
+        preds = {}
+        for n_sp in (1, 2, 4):
+            name = "unsharded" if n_sp == 1 else "sp%d" % n_sp
+            d = os.path.join(tmp, name)
+            _save_lc_lm(n_sp)(d)
+            pred = create_paddle_predictor(AnalysisConfig(d))
+            preds[name] = (pred, d)
+            tps = _lc_tokens_per_s(lambda p=pred: p.run({"src_ids": x}))
+            leg = {"tokens_per_s": tps}
+            if pred.sharded:
+                stats = pred.sharding_stats()
+                leg["activation_bytes_per_device"] = (
+                    stats["activation_bytes_per_device"])
+                leg["activation_bytes_unsharded"] = (
+                    stats["activation_bytes_unsharded"])
+            legs[name] = leg
+
+        # parity: the sp-4 ring-attention group must reproduce the
+        # unsharded logits (the acceptance rtol)
+        ref, _ = preds["unsharded"]
+        sp4, _ = preds["sp4"]
+        out_r, = ref.run({"src_ids": x_small})
+        out_s, = sp4.run({"src_ids": x_small})
+        np.testing.assert_allclose(out_s, out_r, rtol=2e-4, atol=2e-4)
+
+        # capacity: the unsharded activation footprint exceeds the
+        # per-chip budget; the sp-4 share is exactly 1/4 and fits it
+        unsharded_act = legs["sp4"]["activation_bytes_unsharded"]
+        sp4_act = legs["sp4"]["activation_bytes_per_device"]
+        if unsharded_act <= _LC_BUDGET:
+            raise AssertionError(
+                "long-context leg is not long enough: unsharded "
+                "activations %d <= budget %d (raise BENCH_LC_SEQ)"
+                % (unsharded_act, _LC_BUDGET))
+        if sp4_act * 4 != unsharded_act or sp4_act > _LC_BUDGET:
+            raise AssertionError(
+                "sp-4 activation share %d is not 1/4 of %d within the "
+                "%d budget" % (sp4_act, unsharded_act, _LC_BUDGET))
+
+        # zero-recompile across a mixed-length storm: warm the padded
+        # sizes once each, then a shuffled storm must never miss again
+        storm_sizes = sorted({_LC_B, max(1, _LC_B // 2), 1})
+        feeds = {n: {"src_ids": x[:n]} for n in storm_sizes}
+        for f in feeds.values():
+            sp4.run(f)
+        misses0 = sp4.jit_cache_stats()["misses"]
+        order = [storm_sizes[i % len(storm_sizes)] for i in range(12)]
+        rng.shuffle(order)
+        for n in order:
+            sp4.run(feeds[n])
+        recompiles = sp4.jit_cache_stats()["misses"] - misses0
+        if recompiles:
+            raise AssertionError(
+                "sp-4 predictor recompiled %d time(s) during the "
+                "mixed-length storm" % recompiles)
+
+        # pipeline: the SAME unsharded export served pp-2 micro-batched
+        # (M=4) vs sequential (M=1, the structural 0.5-bubble worst
+        # case) — outputs must be exact, executed bubble < 0.5
+        _, udir = preds["unsharded"]
+        out_ref, = ref.run({"src_ids": x})
+        for label, m in (("pp2_m4", 4), ("pp2_m1", 1)):
+            pipe = PipelinePredictor(udir, n_stages=2, num_microbatches=m)
+            tps = _lc_tokens_per_s(
+                lambda p=pipe: p.run({"src_ids": x}))
+            out_p, = pipe.run({"src_ids": x})
+            if np.abs(out_p - out_ref).max() != 0.0:
+                raise AssertionError(
+                    "pipelined (%s) output is not exact vs unpipelined"
+                    % label)
+            st = pipe.pipeline_stats()
+            legs[label] = {
+                "tokens_per_s": tps,
+                "bubble_ratio": st["bubble_ratio"],
+                "stage_occupancy": st["stage_occupancy"],
+                "cut_vars": st["cut_vars"],
+            }
+        if not legs["pp2_m4"]["bubble_ratio"] < 0.5:
+            raise AssertionError(
+                "pp-2/M-4 bubble ratio %r is not < 0.5"
+                % legs["pp2_m4"]["bubble_ratio"])
+
+    return {
+        "metric": "serving_long_context_tokens_per_s",
+        "unit": "tokens/sec",
+        "value": legs["sp4"]["tokens_per_s"],
+        "seq_len": _LC_S,
+        "batch": _LC_B,
+        "chip_budget_bytes": _LC_BUDGET,
+        "unsharded_activation_bytes": unsharded_act,
+        "sp4_activation_bytes_per_device": sp4_act,
+        "recompiles_after_warmup": 0,
+        "pipeline_bubble_ratio": legs["pp2_m4"]["bubble_ratio"],
+        "legs": legs,
         "platform": jax.devices()[0].platform,
     }
 
@@ -1871,6 +2066,10 @@ def main():
     if "--sharded" in sys.argv[1:] or os.environ.get(
             "BENCH_SERVING_SHARDED"):
         bench_common.emit_result(run_sharded())
+        return
+    if "--long-context" in sys.argv[1:] or os.environ.get(
+            "BENCH_SERVING_LONG_CONTEXT"):
+        bench_common.emit_result(run_long_context())
         return
     mode = _wire_mode()
     if mode:

@@ -22,7 +22,17 @@ __all__ = ["lower_block", "trace_ops"]
 
 
 def trace_ops(ops, env: Dict[str, Any], block=None) -> Dict[str, Any]:
-    """Run (or trace) a sequence of Operators over an env of name->array."""
+    """Run (or trace) a sequence of Operators over an env of name->array.
+
+    When an activation-sharding context is installed on this thread
+    (``sharding.activations.tracing`` — the executor wraps a compiled
+    program's block trace in one), every op output written to the env
+    passes through the constrainer: matched intermediates get
+    ``with_sharding_constraint`` applied in-trace, unmatched ones are
+    left for GSPMD propagation."""
+    from paddle_tpu.sharding import activations as _sh_act
+
+    act = _sh_act.current()
     for op in ops:
         kernel = registry.get_kernel(op.type)
         ins: Dict[str, List[Any]] = {}
@@ -50,7 +60,7 @@ def trace_ops(ops, env: Dict[str, Any], block=None) -> Dict[str, Any]:
                 vals = [vals]
             for n, v in zip(names, vals):
                 if n != EMPTY_VAR_NAME and v is not None:
-                    env[n] = v
+                    env[n] = v if act is None else act.constrain(n, v)
     return env
 
 

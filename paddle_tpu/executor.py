@@ -601,6 +601,24 @@ class Executor:
             if _rec:
                 _t0 = time.perf_counter()
             fn = lowering.lower_block(block, feed_names, fetch_names, state_out)
+            _act = (compiled.activation_constrainer()
+                    if compiled is not None else None)
+            if _act is not None:
+                # sequence-parallel serving: install the activation
+                # constrainer around the block trace so matched
+                # intermediates get with_sharding_constraint applied
+                # in-trace (trace time = first dispatch of this key —
+                # steady-state dispatches never re-enter fn)
+                _base_fn = fn
+
+                def fn(state, feed, _base=_base_fn, _c=_act):
+                    from paddle_tpu.sharding import activations as _sh_act
+
+                    _c.begin_trace()
+                    with _sh_act.tracing(_c):
+                        out = _base(state, feed)
+                    _c.end_trace()
+                    return out
 
             if steps == 1:
                 def stepfn(mut_state, ro_state, feed_dict):

@@ -364,10 +364,30 @@ class AnalysisPredictor(PaddlePredictor):
             "hbm_bytes_per_device": int(hbm),
             "replicated_bytes": int(total),
         }
+        # an activation-constrained (sp) layout additionally reports
+        # its intermediate footprint from the last traced program —
+        # the long-context capacity claim ("activations fit one chip's
+        # share") reads activation_bytes_per_device vs unsharded.
+        # None until a run traced the program; 0-valued after a trace
+        # that constrained nothing (both faithfully distinguished)
+        act = (self._compiled.activation_stats()
+               if self._compiled is not None else None)
+        if act is not None:
+            stats["activation_bytes_unsharded"] = (
+                act["activation_bytes_unsharded"])
+            stats["activation_bytes_per_device"] = (
+                act["activation_bytes_per_device"])
+            stats["n_activations_constrained"] = act["n_constrained"]
         if group is not None:
-            from paddle_tpu.sharding.metrics import GROUP_HBM_BYTES
+            from paddle_tpu.sharding.metrics import (
+                ACTIVATION_BYTES,
+                GROUP_HBM_BYTES,
+            )
 
             GROUP_HBM_BYTES.labels(group=str(group)).set(float(hbm))
+            if act is not None:
+                ACTIVATION_BYTES.labels(group=str(group)).set(
+                    float(act["activation_bytes_per_device"]))
         return stats
 
     # --- reference surface ---

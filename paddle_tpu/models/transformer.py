@@ -243,16 +243,25 @@ def transformer_lm(
     dropout_rate: float = 0.0,
     is_test: bool = False,
     name: str = "lm",
+    fused_attention: bool = False,
 ):
     """Decoder-only causal LM; returns (avg_loss, logits).
 
     src_ids/labels: int64 [N, S] / [N, S, 1].
+
+    ``fused_attention=True`` (needs dropout_rate=0): causality goes in
+    as the fused op's ``causal=`` attr instead of a materialized [S, S]
+    bias — the build the sequence-parallel (sp) serving layout needs,
+    since only the fused op can dispatch to ring attention (no S^2
+    tensor may exist for the seq axis to shard).
     """
     x = _embeddings(src_ids, vocab_size, d_model, max_pos, seq_len, name)
-    causal = _causal_bias(seq_len, x.dtype)
+    causal = None if fused_attention else _causal_bias(seq_len, x.dtype)
     for i in range(n_layer):
         x = encoder_layer(
-            x, d_model, n_head, d_inner, causal, dropout_rate, is_test, name="%s_dec_%d" % (name, i)
+            x, d_model, n_head, d_inner, causal, dropout_rate, is_test,
+            name="%s_dec_%d" % (name, i), fused=fused_attention,
+            causal=fused_attention,
         )
     logits = _fc3(x, vocab_size, name + "_head")
     if labels is None:  # inference/decoding program: logits only

@@ -649,8 +649,15 @@ def fused_attention(inputs, attrs):
     positions form their own segment, so real tokens never attend them;
     pad rows' outputs are garbage-by-construction in BOTH impls and must
     be masked downstream, as the reference's padded attention does).
-    Multi-chip long context goes through parallel/ring_attention.py
-    (sp axis), not this op.
+
+    Multi-chip long context: when this op is traced under a
+    sequence-parallel activation context (a CompiledProgram whose rules
+    carry sp activation rules — sharding/activations.py), and the
+    sequence divides the sp axis, it dispatches to
+    ``parallel/ring_attention.py``: blockwise exact attention with K/V
+    rotating around the ring, O(S/sp) activation memory per chip.
+    Padding masks and non-divisible lengths fall back to the gathered
+    einsum path (GSPMD inserts the collectives).
     """
     import os as _os
 
@@ -663,6 +670,27 @@ def fused_attention(inputs, attrs):
     mask = maybe(inputs, "Mask")
     causal = bool(attrs.get("causal", False))
     scale = float(attrs.get("scale", 1.0))
+
+    from paddle_tpu.sharding import activations as _sh_act
+
+    _act = _sh_act.current()
+    if _act is not None and _act.sp_axis is not None and mask is None:
+        sp = _act.sp_axis
+        n_sp = int(_act.axis_sizes.get(sp, 1))
+        S = int(q.shape[2])
+        if n_sp > 1 and S % n_sp == 0 and tuple(k.shape) == tuple(q.shape):
+            from jax.sharding import PartitionSpec as P
+
+            from paddle_tpu.parallel import mesh as mesh_lib
+            from paddle_tpu.parallel.ring_attention import ring_attention
+
+            spec = P(None, None, sp, None)
+            ring = mesh_lib.shard_map(
+                lambda qq, kk, vv: ring_attention(
+                    qq, kk, vv, axis_name=sp, causal=causal, scale=scale),
+                mesh=_act.mesh, in_specs=(spec, spec, spec),
+                out_specs=spec)
+            return {"Out": ring(q, k, v)}
     use_flash = (
         jax.default_backend() == "tpu"
         and _os.environ.get("PADDLE_TPU_FLASH_ATTENTION", "0") == "1"

@@ -70,6 +70,10 @@ class CompiledProgram:
         # the target dtype (the composed bf16+sharded endpoint's hoisted
         # casts land here — see with_cast_dtypes)
         self._cast_dtypes: Dict[str, Any] = {}
+        # activation constrainer (sequence-parallel serving): built once
+        # per rules+mesh bind, installed by the executor around block
+        # tracing; holds the per-name activation-bytes report
+        self._act_constrainer = None
 
     # ------------------------------------------------------------------
     def with_data_parallel(
@@ -175,6 +179,34 @@ class CompiledProgram:
     def sharding_rules(self):
         return self._rules
 
+    def activation_constrainer(self):
+        """The trace-time activation constrainer for this plan, or None
+        when the bound rules carry no activation rules.  Built once per
+        rules+mesh bind (cleared with the sharding memos) — the
+        constrainer's own (name, shape) memo is what keeps re-traces of
+        new bucket rungs from re-scanning the regex list."""
+        if self._act_constrainer is not None:
+            return self._act_constrainer
+        rules = self._rules
+        if rules is None or not (getattr(rules, "activations", ())
+                                 or getattr(rules, "activation_default", None)
+                                 is not None):
+            return None
+        from paddle_tpu.sharding.activations import ActivationConstrainer
+
+        axes = self._mesh_axes or dict(
+            zip(self.mesh.axis_names, self.mesh.devices.shape))
+        self._act_constrainer = ActivationConstrainer(
+            rules, self.mesh, axes)
+        return self._act_constrainer
+
+    def activation_stats(self):
+        """Aggregate activation-bytes report of the last traced program
+        (see ActivationConstrainer.stats), or None when activations are
+        not ruled."""
+        c = self.activation_constrainer()
+        return c.stats() if c is not None else None
+
     def _clear_sharding_memos(self) -> None:
         if getattr(self._rules, "state_kind", None) is not None:
             # a mesh/rules rebind tears the old training layout down:
@@ -185,6 +217,7 @@ class CompiledProgram:
         self._sharding_memo.clear()
         self._state_sh_memo.clear()
         self._feed_sh_memo.clear()
+        self._act_constrainer = None
         # a re-bound mesh invalidates every steady-state conclusion: a
         # stale token would skip state placement against the OLD layout
         self._steady_tokens.clear()

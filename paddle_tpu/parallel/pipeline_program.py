@@ -25,7 +25,15 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["build_pipeline_step"]
+__all__ = ["build_pipeline_step", "PipelinePlanError", "propose_cut_vars"]
+
+
+class PipelinePlanError(ValueError):
+    """A pipeline stage plan that cannot run: the cut vars don't yield
+    the stage count the mesh's ``pp`` axis expects, a cut leaves a
+    stage with zero ops, or no single-crossing cut boundary exists for
+    the requested stage count.  Raised at plan time with both counts
+    named — never as a raw %-format assert or an XLA shape error."""
 
 
 def _stage_ranges(ops, cut_names: Sequence[str]):
@@ -39,15 +47,80 @@ def _stage_ranges(ops, cut_names: Sequence[str]):
             if c in op.output_arg_names:
                 idx = i
         if idx is None:
-            raise ValueError("cut var %r is not produced by the program" % c)
+            raise PipelinePlanError(
+                "cut var %r is not produced by the program" % c)
         bounds[c] = idx + 1
     ordered = sorted(cut_names, key=lambda c: bounds[c])
     cuts = [bounds[c] for c in ordered]
     if len(set(cuts)) != len(cuts):
-        raise ValueError("cut vars %r share a producer boundary" % (cut_names,))
+        raise PipelinePlanError(
+            "cut vars %r share a producer boundary" % (cut_names,))
     starts = [0] + cuts
     ends = cuts + [len(ops)]
-    return [slice(s, e) for s, e in zip(starts, ends) if e > s], ordered
+    ranges = []
+    for i, (s, e) in enumerate(zip(starts, ends)):
+        if e <= s:
+            at = ("before cut var %r" % ordered[i] if i < len(ordered)
+                  else "after cut var %r" % ordered[-1])
+            raise PipelinePlanError(
+                "stage %d of %d (%s) would contain zero ops — the plan's "
+                "%d cut vars do not split the program's %d ops into "
+                "non-empty stages"
+                % (i, len(cut_names) + 1, at, len(cut_names), len(ops)))
+        ranges.append(slice(s, e))
+    return ranges, ordered
+
+
+def propose_cut_vars(ops, n_stages: int, skip_names: Sequence[str] = ()
+                     ) -> List[str]:
+    """Pick ``n_stages - 1`` cut vars that split ``ops`` into balanced
+    stages, each boundary crossed by exactly ONE live intermediate (the
+    single activation the GPipe hand-off can carry).
+
+    ``skip_names``: names that don't count as crossing activations —
+    params and feeds (replicated onto every stage, available everywhere).
+    Raises :class:`PipelinePlanError` when fewer than ``n_stages - 1``
+    single-crossing boundaries exist (e.g. a program whose layers share
+    a materialized attention bias: every boundary carries two live vars,
+    so no single cut var can express it — build with fused attention)."""
+    if n_stages < 2:
+        raise PipelinePlanError(
+            "pipeline needs at least 2 stages (got %d)" % n_stages)
+    skip = set(skip_names)
+    produced_at: Dict[str, int] = {}
+    last_use: Dict[str, int] = {}
+    for i, op in enumerate(ops):
+        for n in op.input_arg_names:
+            if n not in skip:
+                last_use[n] = i
+        for n in op.output_arg_names:
+            if n not in skip:
+                produced_at[n] = i
+    # boundary b (between op b-1 and op b) is cuttable when exactly one
+    # live non-param/non-feed var crosses it AND that var's (last)
+    # producer is op b-1 — _stage_ranges cuts at the producer, so any
+    # other producer position would induce a different boundary
+    candidates: Dict[int, str] = {}
+    for b in range(1, len(ops)):
+        crossing = [n for n, p in produced_at.items()
+                    if p < b and last_use.get(n, -1) >= b]
+        if len(crossing) == 1 and produced_at[crossing[0]] == b - 1:
+            candidates[b] = crossing[0]
+    if len(candidates) < n_stages - 1:
+        raise PipelinePlanError(
+            "program has %d single-crossing boundaries but %d stages "
+            "need %d cut vars — multi-var boundaries (e.g. a shared "
+            "materialized attention bias crossing every layer) cannot "
+            "be pipelined; rebuild the program so each stage boundary "
+            "carries one activation" % (len(candidates), n_stages,
+                                        n_stages - 1))
+    chosen: List[int] = []
+    for j in range(1, n_stages):
+        ideal = j * len(ops) / float(n_stages)
+        best = min((b for b in candidates if b not in chosen),
+                   key=lambda b: abs(b - ideal))
+        chosen.append(best)
+    return [candidates[b] for b in sorted(chosen)]
 
 
 def build_pipeline_step(program, loss_name: str, plan: Dict[str, Any], mesh):
@@ -70,12 +143,13 @@ def build_pipeline_step(program, loss_name: str, plan: Dict[str, Any], mesh):
     M = int(plan["num_microbatches"])
     ranges, cut_names = _stage_ranges(ops, list(plan["cut_vars"]))
     K = len(ranges)
-    if K != len(cut_names) + 1:
-        raise ValueError("cut vars collapse into %d stages" % K)
     pp_size = mesh.shape["pp"]
     if pp_size != K:
-        raise ValueError(
-            "pipeline has %d stages but mesh pp axis is %d" % (K, pp_size)
+        raise PipelinePlanError(
+            "op-stage plan has %d stages (%d cut vars) but the mesh's "
+            "pp axis has %d devices — the schedule maps one stage per "
+            "pp coordinate, so the counts must agree (add/remove cut "
+            "vars or rebuild the mesh)" % (K, len(cut_names), pp_size)
         )
 
     param_names = sorted(p.name for p in program.all_parameters())

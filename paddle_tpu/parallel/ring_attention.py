@@ -40,8 +40,11 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True, scale: O
 
     q_pos = rank * Tl + jnp.arange(Tl)  # global positions of my queries
 
-    neg = jnp.asarray(-1e30, q.dtype)
+    neg = jnp.full((), -1e30, q.dtype)
 
+    # hot-path: begin ring_step (the blockwise K/V-rotation body — traced
+    # into every sp-serving executable; einsum/ppermute only, a host sync
+    # or sleep here would land inside every long-context warmup trace)
     def block(carry, step):
         """Process the K/V block that started at rank (rank - step) % n."""
         acc, m, l, kb, vb = carry
@@ -62,6 +65,7 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True, scale: O
         kb = jax.lax.ppermute(kb, axis_name, perm)
         vb = jax.lax.ppermute(vb, axis_name, perm)
         return (acc_new, m_new, l_new, kb, vb), None
+    # hot-path: end ring_step
 
     # derive inits from q so they inherit its device-varying (vma) type —
     # a plain jnp.zeros carry would mismatch the scan body under shard_map

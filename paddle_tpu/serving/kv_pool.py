@@ -79,7 +79,8 @@ class KVSlotPool:
                  on_recompile: Optional[Callable[[], None]] = None,
                  prefix: bool = False,
                  speculative=None,
-                 kv_dtype: str = "fp32"):
+                 kv_dtype: str = "fp32",
+                 len_multiple: int = 1):
         from paddle_tpu.decoding import (make_prefix_admit_fn,
                                          make_slot_decode_fns,
                                          normalize_kv_dtype)
@@ -94,8 +95,19 @@ class KVSlotPool:
         self.eos_id = int(eos_id)
         self.steps = max(1, int(steps))
         self.slot_policy = BucketPolicy(max_slots, slot_ladder)
-        self.len_policy = BucketPolicy(
-            max_seq_len, len_ladder or default_len_ladder(max_seq_len))
+        # ``len_multiple`` (sequence-parallel serving): every length
+        # rung — and the cap itself — rounds UP to the next multiple,
+        # so a pool feeding an sp-sharded model only ever compiles
+        # sp-divisible sequence lengths (the ring layout's divisibility
+        # rule holds on every rung, not just the top)
+        self.len_multiple = max(1, int(len_multiple))
+        ladder = list(len_ladder or default_len_ladder(max_seq_len))
+        if self.len_multiple > 1:
+            lm = self.len_multiple
+            max_seq_len = -(-int(max_seq_len) // lm) * lm
+            ladder = sorted({-(-int(t) // lm) * lm for t in ladder}
+                            | {max_seq_len})
+        self.len_policy = BucketPolicy(max_seq_len, ladder)
         # decode tier 2 (both default-off so the base pool's compiled
         # set — and its warmup count — are exactly the PR-9 three):
         # ``prefix`` adds the admit_prefix executable (shared-prefix KV

@@ -78,6 +78,15 @@ _PADDING_WASTE = monitor.gauge(
     "serving_padding_waste_ratio",
     "cumulative padding rows / padded rows for this endpoint (the "
     "bucket ladder's rent; the autotuner's objective)", _LABELS)
+_PIPELINE_BUBBLE = monitor.gauge(
+    "serving_pipeline_bubble_ratio",
+    "structural GPipe bubble of a pipelined replica's last executed "
+    "schedule, (K-1)/(M+K-1) — the idle fraction the micro-batch count "
+    "amortizes", _LABELS)
+_PIPELINE_OCCUPANCY = monitor.gauge(
+    "serving_pipeline_stage_occupancy",
+    "fraction of schedule slots each pipeline stage spends computing "
+    "(M/(M+K-1)); one series per stage coordinate", _LABELS + ("stage",))
 
 # distinguishes same-named servers constructed in one process
 _instance_seq = itertools.count()
@@ -95,6 +104,8 @@ class ServingMetrics:
         self._replans = _LADDER_REPLANS.labels(**lbl)
         self._waste_gauge = _PADDING_WASTE.labels(**lbl)
         self._precision_children: Dict[str, object] = {}  # dtype -> child
+        self._pipeline_children: Dict[str, object] = {}  # stage -> child
+        self._pipeline_bubble = None  # gauge child, set on first publish
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
         self._latencies: deque = deque(maxlen=_RESERVOIR)  # seconds, per request
@@ -120,8 +131,14 @@ class ServingMetrics:
             metric.remove_labels(**lbl)
         with self._lock:
             dtypes = list(self._precision_children)
+            stages = list(self._pipeline_children)
+            had_pipeline = self._pipeline_bubble is not None
         for dtype in dtypes:
             _PRECISION_REQS.remove_labels(dtype=dtype, **lbl)
+        for stage in stages:
+            _PIPELINE_OCCUPANCY.remove_labels(stage=stage, **lbl)
+        if had_pipeline:
+            _PIPELINE_BUBBLE.remove_labels(**lbl)
 
     # ------------------------------------------------------------------
     def count(self, key: str, n: int = 1) -> None:
@@ -144,6 +161,27 @@ class ServingMetrics:
     def count_replan(self) -> None:
         """One applied bucket-ladder re-plan."""
         self._replans.inc()
+
+    def set_pipeline(self, stats: Dict[str, object]) -> None:
+        """Publish a pipelined replica's schedule shape (a
+        ``PipelinePredictor.pipeline_stats()`` dict): the structural
+        bubble ratio plus one occupancy series per stage coordinate."""
+        lbl = {"server": self.name, "instance": self.instance}
+        with self._lock:
+            if self._pipeline_bubble is None:
+                self._pipeline_bubble = _PIPELINE_BUBBLE.labels(**lbl)
+            bubble = self._pipeline_bubble
+            children = []
+            for stage, occ in sorted(stats["stage_occupancy"].items()):
+                stage = str(stage)
+                child = self._pipeline_children.get(stage)
+                if child is None:
+                    child = self._pipeline_children[stage] = (
+                        _PIPELINE_OCCUPANCY.labels(stage=stage, **lbl))
+                children.append((child, occ))
+        bubble.set(round(float(stats["bubble_ratio"]), 6))
+        for child, occ in children:
+            child.set(round(float(occ), 6))
 
     def observe_arrival(self, n_rows: int) -> None:
         """Record one request's row count into the arrival histogram."""

@@ -345,6 +345,16 @@ class InferenceServer:
             # the capacity math ("does the model fit one chip's
             # share?") reads hbm_bytes_per_device vs replicated_bytes
             doc["sharding"] = sharding
+        pipeline = {}
+        for r in self._replicas:
+            pstats_fn = getattr(r.predictor, "pipeline_stats", None)
+            if callable(pstats_fn):
+                pipeline[r.name] = pstats_fn()
+        if pipeline:
+            # a pipelined replica is a pp-GROUP of devices behind one
+            # name; the schedule math ("is the bubble amortized?") reads
+            # bubble_ratio vs microbatches_last
+            doc["pipeline"] = pipeline
         return doc
 
     # ------------------------------------------------------------------
@@ -445,6 +455,12 @@ class InferenceServer:
             if callable(stats_fn) and getattr(rep.predictor, "sharded",
                                               False):
                 stats_fn(group="%s/%s" % (self.name, rep.name))
+            # a pipelined replica publishes its schedule shape (bubble
+            # ratio + per-stage occupancy gauges) once warmup compiled
+            # every rung's GPipe executable
+            pstats_fn = getattr(rep.predictor, "pipeline_stats", None)
+            if callable(pstats_fn):
+                self._metrics.set_pipeline(pstats_fn())
         self._metrics.count("warmup_compiles", compiles)
         self._warmed = True
         return compiles

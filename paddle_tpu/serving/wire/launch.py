@@ -136,6 +136,8 @@ def launch_server(
     flight_slow_ms: Optional[float] = None,
     ready_timeout_s: float = 180.0,
     env: Optional[Dict[str, str]] = None,
+    pipeline_stages: Optional[int] = None,
+    pipeline_microbatches: int = 4,
 ) -> ServerHandle:
     """Spawn one serving child process and wait for its READY line.
 
@@ -143,13 +145,21 @@ def launch_server(
     tail-sampling threshold (0 retains everything) — required for the
     cross-process span merge; omitted, the child pays zero tracing rent.
     A child that exits (or stays silent) before READY raises with its
-    captured stderr tail, never hangs the parent."""
+    captured stderr tail, never hangs the parent.
+
+    ``pipeline_stages``: serve the model as a micro-batched
+    ``PipelinePredictor`` group of this depth (over a ``{"pp": K}``
+    mesh inside the child) instead of single-device replicas;
+    ``pipeline_microbatches`` caps the GPipe micro-batch count.  The
+    child's ``/healthz`` then advertises the pipeline group."""
     spec = {
         "model_dir": model_dir, "host": host, "port": port, "name": name,
         "replicas": replicas, "max_batch_size": max_batch_size,
         "batch_timeout_ms": batch_timeout_ms,
         "queue_capacity": queue_capacity, "warmup": warmup,
         "flight_slow_ms": flight_slow_ms,
+        "pipeline_stages": pipeline_stages,
+        "pipeline_microbatches": pipeline_microbatches,
     }
     argv = [
         sys.executable, "-m", "paddle_tpu.serving.wire.launch",
@@ -163,6 +173,9 @@ def launch_server(
         argv.append("--warmup")
     if flight_slow_ms is not None:
         argv += ["--flight-slow-ms", str(flight_slow_ms)]
+    if pipeline_stages is not None:
+        argv += ["--pipeline-stages", str(pipeline_stages),
+                 "--pipeline-microbatches", str(pipeline_microbatches)]
     child_env = dict(os.environ)
     if env:
         child_env.update(env)
@@ -313,6 +326,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--queue-capacity", type=int, default=256)
     parser.add_argument("--warmup", action="store_true")
     parser.add_argument("--flight-slow-ms", type=float, default=None)
+    parser.add_argument("--pipeline-stages", type=int, default=None)
+    parser.add_argument("--pipeline-microbatches", type=int, default=4)
     args = parser.parse_args(argv)
 
     from paddle_tpu import monitor
@@ -330,6 +345,26 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         server = load_decode_endpoint(
             args.model_dir,
+            queue_capacity=args.queue_capacity,
+            name=args.name,
+        )
+    elif args.pipeline_stages:
+        # a pipelined child hosts ONE pp-group predictor per replica:
+        # the GPipe schedule spans the child's local devices, and the
+        # server routes to the group exactly like a single-chip replica
+        from paddle_tpu.parallel.pipeline_predictor import PipelinePredictor
+        from paddle_tpu.serving.server import InferenceServer
+
+        predictors = [
+            PipelinePredictor(
+                args.model_dir, n_stages=args.pipeline_stages,
+                num_microbatches=args.pipeline_microbatches)
+            for _ in range(max(1, args.replicas))
+        ]
+        server = InferenceServer(
+            predictors,
+            max_batch_size=args.max_batch_size,
+            batch_timeout_ms=args.batch_timeout_ms,
             queue_capacity=args.queue_capacity,
             name=args.name,
         )

@@ -399,6 +399,13 @@ class ServingProcess:
             # like single-chip replicas (in-flight accounting, warmup,
             # retirement unchanged)
             "sharded": bool(getattr(srv._predictor, "sharded", False)),
+            # a pipelined backend is one pp-GROUP behind one address:
+            # the balancer and bench read the stage count + structural
+            # bubble ratio here (None on unpipelined endpoints)
+            "pipeline": (
+                srv._predictor.pipeline_stats()
+                if callable(getattr(srv._predictor, "pipeline_stats",
+                                    None)) else None),
             # mixed-precision discovery: the policy dtype this endpoint
             # serves by default (None = plain fp32) and every dtype a
             # request may ask for — clients and the bench read this

@@ -19,6 +19,11 @@ Modes
   (ZeRO-3-style parameter sharding; GSPMD all-gathers at use).
 * ``fsdp_tp`` — the 2D combination: the ``tp`` layout with every
   replicated weight dim sharded over ``fsdp`` instead.
+* ``sp`` (transformer family only, outside ``MODES``) — sequence
+  parallelism for long-context serving: parameters replicate and the
+  layout's ACTIVATION rules shard the sequence axis over ``sp``; the
+  fused attention op dispatches to ``parallel/ring_attention.py`` when
+  traced under an sp activation context.
 
 Coverage is a tested invariant, not an intention:
 ``tools/check_partition_rules.py`` builds each family's real in-tree
@@ -34,6 +39,7 @@ from paddle_tpu.sharding.rules import PartitionRules, ShardingRuleError
 __all__ = [
     "AXIS_TP",
     "AXIS_FSDP",
+    "AXIS_SP",
     "MODES",
     "FAMILIES",
     "canonical_rules",
@@ -41,7 +47,14 @@ __all__ = [
 
 AXIS_TP = "tp"
 AXIS_FSDP = "fsdp"
+AXIS_SP = "sp"
 
+# the modes every family must support (tools/check_partition_rules.py
+# loops these over serve + train + bf16-variant builds).  ``sp`` is NOT
+# a member: sequence parallelism is a transformer-family activation
+# layout (DeepFM has no sequence axis, and sp has no train story), so
+# it is reachable via canonical_rules(family, "sp") for the transformer
+# builders only and guarded by the tool's dedicated check_sp pass.
 MODES = ("tp", "fsdp", "fsdp_tp")
 
 
@@ -57,11 +70,32 @@ def _transformer_rules(mode: str, name: str) -> PartitionRules:
     parameter grammar is identical up to the attention-name alternation
     (``_att_`` encoder-style vs ``_self_``/``_cross_`` decoder-style)."""
     attn = r"_(att|self|cross)_"
+    act_rules = ()
+    act_default = None
     if mode == "tp":
         col_w, col_b = _P(None, AXIS_TP), _P(AXIS_TP)
         row_w, row_b = _P(AXIS_TP, None), _P()
         emb = _P(AXIS_TP, None)
         ln = _P()
+    elif mode == "sp":
+        # sequence parallel: every PARAM replicates (the 13 patterns are
+        # kept so coverage + no-dead-rules hold for the family grammar);
+        # the sharding lives in ACTIVATION rules over the auto-generated
+        # intermediate names.  The seq axis sits at dim 2 of the fused
+        # attention context ([N, H, S, D]) and dim 1 of everything the
+        # fc / layer_norm / residual / embedding chain produces
+        # ([N, S, ...]).  reshape/transpose tmps are deliberately
+        # unconstrained (the seq axis moves around in them; GSPMD
+        # propagation places them from their producers/consumers).
+        # Divisibility contract: serve with seq_len % sp == 0 — the
+        # constrainer skips a non-divisible dim rather than erroring,
+        # and the fused_attention op falls back to its gathered path.
+        col_w = col_b = row_w = row_b = emb = ln = _P()
+        act_rules = (
+            (attn + r"fused_\d+\.tmp", _P(None, None, AXIS_SP)),
+            (r"^(fc|layer_norm|elementwise_add|embedding)_\d+\.tmp",
+             _P(None, AXIS_SP)),
+        )
     elif mode == "fsdp":
         return PartitionRules(
             [(r".", _P(AXIS_FSDP))], name=name)  # dim-0 shard everything
@@ -71,8 +105,9 @@ def _transformer_rules(mode: str, name: str) -> PartitionRules:
         emb = _P((AXIS_FSDP, AXIS_TP), None)
         ln = _P()
     else:
-        raise ShardingRuleError("unknown layout mode %r (have %s)"
-                                % (mode, MODES))
+        raise ShardingRuleError(
+            "unknown layout mode %r (have %s; the transformer family "
+            "additionally has 'sp')" % (mode, MODES))
     return PartitionRules([
         # attention: q/k/v column-parallel, the output projection
         # row-parallel (Megatron-LM, Shoeybi et al.)
@@ -93,7 +128,7 @@ def _transformer_rules(mode: str, name: str) -> PartitionRules:
         (r"_head_b$", col_b),
         # norms replicate (tiny, and every rank needs them whole)
         (r"_ln\d_(scale|bias)$", ln),
-    ], name=name)
+    ], name=name, activations=act_rules, activation_default=act_default)
 
 
 def transformer_lm_rules(mode: str = "tp") -> PartitionRules:

@@ -16,8 +16,9 @@ from __future__ import annotations
 
 from paddle_tpu.monitor import registry as _registry
 
-__all__ = ["PARAMS_SHARDED", "GROUP_HBM_BYTES", "TRAIN_STATE_BYTES",
-           "SPARSE_TABLE_BYTES", "SPARSE_ROW_DTYPE", "SPARSE_LOOKUPS"]
+__all__ = ["PARAMS_SHARDED", "GROUP_HBM_BYTES", "ACTIVATION_BYTES",
+           "TRAIN_STATE_BYTES", "SPARSE_TABLE_BYTES", "SPARSE_ROW_DTYPE",
+           "SPARSE_LOOKUPS"]
 
 PARAMS_SHARDED = _registry.REGISTRY.counter(
     "sharding_params_sharded_total",
@@ -28,6 +29,12 @@ GROUP_HBM_BYTES = _registry.REGISTRY.gauge(
     "per-device HBM bytes of one model-parallel group's persistable "
     "state (sharded params count their shard, replicated params their "
     "full size)", ("group",))
+ACTIVATION_BYTES = _registry.REGISTRY.gauge(
+    "sharding_activation_bytes",
+    "per-device bytes of one group's constrained intermediate "
+    "activations, summed over the last traced program (sequence-"
+    "parallel serving's capacity number: ~1/n_sp of the unsharded "
+    "activation footprint)", ("group",))
 TRAIN_STATE_BYTES = _registry.REGISTRY.gauge(
     "sharding_train_state_bytes",
     "per-device bytes of sharded-training state by kind (param | grad "

@@ -22,6 +22,16 @@ Rule sets serialize to a JSON-safe manifest (``to_manifest`` /
 ``from_manifest``) so ``save_inference_model`` can carry the layout
 with the weights and a serving child reconstructs the same placement
 (paddle_tpu/io.py, paddle_tpu/inference.py).
+
+ACTIVATION rules (``activations=``) are a second ordered rule list over
+*intermediate* var names — the ``with_sharding_constraint`` placement
+surface (sequence-parallel serving shards activations, not params).
+Their semantics differ from param rules in one load-bearing way: an
+unmatched activation resolves to ``activation_default``, which is
+``None`` by default and means **no constraint at all** (GSPMD
+propagation decides) — never silent replication.  A ``PartitionSpec()``
+default would pin every intermediate replicated and defeat the sharding
+the matched rules ask for.
 """
 from __future__ import annotations
 
@@ -129,13 +139,23 @@ class PartitionRules:
     """
 
     def __init__(self, rules: Iterable[Tuple[str, Any]], default=None,
-                 name: str = "rules"):
+                 name: str = "rules", activations: Iterable[Tuple[str, Any]] = (),
+                 activation_default=None):
         self.name = str(name)
         self.rules: Tuple[Tuple[str, Any], ...] = tuple(
             (str(pat), _as_spec(spec)) for pat, spec in rules)
         self._compiled = tuple(
             (re.compile(pat), spec) for pat, spec in self.rules)
         self.default = _as_spec(default) if default is not None else None
+        # activation (intermediate-var) rules: same first-match-wins
+        # grammar, but the fallback is None = NO constraint (see module
+        # docstring) — P() here would force replication
+        self.activations: Tuple[Tuple[str, Any], ...] = tuple(
+            (str(pat), _as_spec(spec)) for pat, spec in activations)
+        self._act_compiled = tuple(
+            (re.compile(pat), spec) for pat, spec in self.activations)
+        self.activation_default = (_as_spec(activation_default)
+                                   if activation_default is not None else None)
         if not self.rules and self.default is None:
             raise ShardingRuleError(
                 "empty rule set %r with no default spec" % self.name)
@@ -145,14 +165,22 @@ class PartitionRules:
         """A copy of this rule set with ``default`` as the unmatched-name
         fallback spec.  Subclasses override so a rebuild keeps their
         extra state (TrainPartitionRules' accumulator map)."""
-        return PartitionRules(self.rules, default=default, name=self.name)
+        return PartitionRules(self.rules, default=default, name=self.name,
+                              activations=self.activations,
+                              activation_default=self.activation_default)
 
     def axes(self) -> set:
-        """Every mesh axis name any rule (or the default) refers to."""
+        """Every mesh axis name any rule (or the default) refers to —
+        activation rules included, so ``validate_mesh`` catches a
+        missing ``sp`` axis at bind time, not as an XLA unbound-axis
+        failure inside the first traced constraint."""
         out: set = set()
         specs = [spec for _, spec in self.rules]
+        specs.extend(spec for _, spec in self.activations)
         if self.default is not None:
             specs.append(self.default)
+        if self.activation_default is not None:
+            specs.append(self.activation_default)
         for spec in specs:
             for e in tuple(spec):
                 if e is None:
@@ -222,6 +250,45 @@ class PartitionRules:
             for name, leaf in params.items()
         }
 
+    # hot-path: begin activation_resolve (resolution happens at jit
+    # TRACE time — once per cache key, but tracing sits inside the first
+    # dispatch of the executor's hot region, so it must stay pure regex
+    # + dict work: no device sync, no sleeps)
+    def activation_spec_for(self, name: str, shape=None):
+        """Resolve one INTERMEDIATE var name to its PartitionSpec, or
+        ``None`` for "no constraint" (unmatched and no
+        ``activation_default``).  A spec whose rank exceeds the value's
+        is resolved to None rather than raised: intermediates are
+        auto-named and rule authors match families of them, so a
+        low-rank straggler (a scalar scale, a [S] position vector)
+        simply goes unconstrained."""
+        hit = None
+        for rx, spec in self._act_compiled:
+            if rx.search(name) is not None:
+                hit = spec
+                break
+        if hit is None:
+            hit = self.activation_default
+        if hit is None:
+            return None
+        shp = _shape_of(shape) if shape is not None else None
+        if shp is not None and len(tuple(hit)) > len(shp):
+            return None
+        return hit
+    # hot-path: end activation_resolve
+
+    def dead_activation_rules(self, names: Iterable[str]) -> list:
+        """Activation patterns matching NONE of ``names`` — same
+        stale-cruft contract as :meth:`dead_rules`, checked by
+        tools/check_partition_rules.py against the real program's
+        intermediate var set."""
+        names = list(names)
+        out = []
+        for rx, _ in self._act_compiled:
+            if not any(rx.search(n) is not None for n in names):
+                out.append(rx.pattern)
+        return out
+
     def dead_rules(self, names: Iterable[str]) -> list:
         """Patterns that match NONE of ``names`` — a dead rule in a
         canonical layout is stale cruft that will rot (the
@@ -283,6 +350,12 @@ class PartitionRules:
         }
         if self.default is not None:
             doc["default"] = spec_to_manifest(self.default)
+        if self.activations:
+            doc["activations"] = [[pat, spec_to_manifest(spec)]
+                                  for pat, spec in self.activations]
+        if self.activation_default is not None:
+            doc["activation_default"] = spec_to_manifest(
+                self.activation_default)
         return doc
 
     @classmethod
@@ -290,21 +363,29 @@ class PartitionRules:
         try:
             rules = [(pat, spec_from_manifest(spec))
                      for pat, spec in doc["rules"]]
+            acts = [(pat, spec_from_manifest(spec))
+                    for pat, spec in doc.get("activations", [])]
         except (KeyError, TypeError, ValueError) as e:
             raise ShardingRuleError(
                 "malformed partition-rules manifest: %r" % (doc,)) from e
         default = doc.get("default")
+        act_default = doc.get("activation_default")
         return cls(rules,
                    default=spec_from_manifest(default)
                    if default is not None else None,
-                   name=doc.get("name", "rules"))
+                   name=doc.get("name", "rules"),
+                   activations=acts,
+                   activation_default=spec_from_manifest(act_default)
+                   if act_default is not None else None)
 
     # ------------------------------------------------------------------
     def __len__(self) -> int:
         return len(self.rules)
 
     def __repr__(self) -> str:
-        return "PartitionRules(%r, %d rules%s)" % (
+        return "PartitionRules(%r, %d rules%s%s)" % (
             self.name, len(self.rules),
             ", default=%s" % (tuple(self.default),)
-            if self.default is not None else "")
+            if self.default is not None else "",
+            ", %d activation rules" % len(self.activations)
+            if self.activations else "")

@@ -81,6 +81,15 @@ CHECKED_FILES = [
     # fns and the mesh-table push kernels — any host sync here would
     # land in every decode tick and every sparse train step
     "paddle_tpu/quant.py",
+    # long-context serving: the ring-attention K/V rotation body and
+    # the GPipe stage hand-off are traced into every sp/pipelined
+    # serving executable (ring_step, pipeline_handoff), and the
+    # activation constrainer runs per-op-output inside the block trace
+    # (activation_constrain) — a host sync in any of them lands inside
+    # every long-context warmup trace or compiled schedule
+    "paddle_tpu/parallel/ring_attention.py",
+    "paddle_tpu/parallel/pipeline_predictor.py",
+    "paddle_tpu/sharding/activations.py",
 ]
 
 # blocking-sync tokens (substring match on code, not comments)

@@ -31,6 +31,16 @@ hoist param casts, pin fetches) must keep the base parameter grammar
 and resolve under every canonical layout, since one sharding manifest
 serves both the fp32 program and its variant.
 
+SP mode extends it to the sequence-parallel serving layout: the
+transformer family's ``sp`` layout (params replicated, ACTIVATION
+rules carrying the sharding) must fully cover the real FUSED-attention
+LM build — every param resolves, no dead param rule, every activation
+rule matches at least one real intermediate name, and the fused
+attention output (the ring-attention dispatch target) is constrained.
+``sp`` lives outside ``MODES`` (it is serve-only and
+transformer-only), so it gets its own check instead of riding the
+family x mode loops.
+
 Wired into tier-1 via tests/test_partition_rules.py (same pattern as
 check_fault_points.py); also runnable directly::
 
@@ -226,13 +236,72 @@ def check_bf16_variants() -> List[str]:
     return problems
 
 
+def check_sp() -> List[str]:
+    """Sequence-parallel layout guard, validated against the real
+    FUSED-attention LM build — the sp serving target, where causality
+    is the fused op's attr and no [S, S] bias tensor exists to be
+    mis-sharded.  Param rules must cover the full param set with no
+    dead rule (all-replicated, but coverage is what lets one manifest
+    carry the layout); activation rules must each match a real
+    intermediate name, and the fused attention output — the tensor the
+    executor's ring dispatch keys on — must resolve to a constraint."""
+    import paddle_tpu as fluid
+    from paddle_tpu import framework, models
+    from paddle_tpu.sharding.layouts import transformer_lm_rules
+    from paddle_tpu.sharding.rules import ShardingRuleError
+
+    problems: List[str] = []
+    prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(prog, startup):
+        ids = fluid.layers.data("src_ids", [16], dtype="int64")
+        models.transformer_lm(
+            ids, None, vocab_size=128, d_model=32, n_layer=2,
+            n_head=4, d_inner=64, seq_len=16, max_pos=64,
+            fused_attention=True)
+    params = {
+        v.name: tuple(v.shape or ())
+        for v in prog.list_vars()
+        if v.persistable and not v.is_data
+    }
+    inter = [v.name for v in prog.list_vars()
+             if not v.persistable and not v.is_data]
+    if not inter:
+        return ["fused transformer_lm built zero intermediates"]
+    rules = transformer_lm_rules("sp")
+    try:
+        rules.match(params)
+    except ShardingRuleError as e:
+        problems.append(
+            "sp layout does not cover the fused LM's params: %s" % e)
+    for pat in rules.dead_rules(params):
+        problems.append(
+            "sp layout param rule %r matches no parameter (dead rule)"
+            % pat)
+    for pat in rules.dead_activation_rules(inter):
+        problems.append(
+            "sp layout activation rule %r matches no fused-LM "
+            "intermediate (dead rule)" % pat)
+    constrained = [n for n in inter
+                   if rules.activation_spec_for(n) is not None]
+    if not constrained:
+        problems.append(
+            "sp layout constrains zero fused-LM intermediates")
+    if not any("att_fused" in n for n in constrained):
+        problems.append(
+            "sp layout leaves the fused attention output unconstrained "
+            "— the ring-attention dispatch target must carry the sp "
+            "spec")
+    return problems
+
+
 def main() -> int:
-    problems = check() + check_train() + check_bf16_variants()
+    problems = (check() + check_train() + check_bf16_variants()
+                + check_sp())
     if not problems:
         from paddle_tpu.sharding.layouts import FAMILIES, MODES
 
         print("check_partition_rules: OK (%d layouts cover %d families, "
-              "serve + train + bf16 variants)"
+              "serve + train + bf16 variants + sp activations)"
               % (len(FAMILIES) * len(MODES), len(FAMILIES)))
         return 0
     for p in problems:
