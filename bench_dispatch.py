@@ -42,6 +42,14 @@ re-place) and CROSS-mesh restore onto fsdp-4 (the topology-elastic
 shard-exchange assembly), with the exchange host-buffer high-water
 reported alongside so the never-a-full-tensor claim has a number.
 
+``--train-obs`` (or ``run_train_obs()``): the TRAINING-OBSERVABILITY
+tax — the same Adam block looped through ``train_from_dataset`` with
+the step-phase ledger + anomaly watchdog armed vs disarmed, rounds
+alternated on the same compiled state.  Asserts the armed tax on the
+best round stays under 2% (the control tower must not tax the second
+it attributes) and that the armed ledger's books balance (phases sum
+to the epoch wall clock).
+
 Env knobs: BENCH_DISPATCH_LAYERS (default 20 -> ~190 ops with backward
 + sgd), BENCH_DISPATCH_DIM (default 32), BENCH_DISPATCH_ITERS (default
 200), BENCH_DISPATCH_BATCH (default 8; the sharded mode rounds it up to
@@ -491,12 +499,105 @@ def run_checkpoint(layers=None, dim=None, batch=BATCH):
     }
 
 
+def run_train_obs(layers=10, dim=256, batch=256, steps=60, rounds=5):
+    """Armed-ledger tax: ``train_from_dataset`` epochs over the same
+    compiled Adam block with the step-phase ledger + watchdog armed vs
+    disarmed, rounds alternated so drift hits both arms.  Asserts the
+    best-round armed tax < 2% and that the armed ledger's books balance
+    (phases sum to the epoch wall within its 1% tolerance).  Sized for
+    a realistic ~12 ms CPU step (NOT the dispatch bench's deliberately
+    tiny block): the armed cost is a fixed few tens of µs per step, and
+    judging it against a sub-2 ms toy step measures interpreter churn,
+    not the control tower's tax on training anyone runs.  Best-of
+    protocol: a noisy host can only slow a round down, so on a tax miss
+    up to two more round batches extend both minima before judging."""
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.monitor import train as mtrain
+
+    platform = jax.devices()[0].platform
+    place = fluid.TPUPlace(0) if platform == "tpu" else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    prog, startup, loss, _ = build_train_program(layers, dim, seed=13)
+    rng = np.random.RandomState(0)
+    feeds = [{"x": rng.rand(batch, dim).astype(np.float32)}
+             for _ in range(steps)]
+
+    def timed_feeds(periods):
+        # identical instrument in both arms: per-step period from the
+        # batch iterator's cadence — the median ignores host spikes an
+        # epoch total would charge to whichever arm was running
+        prev = time.perf_counter()
+        for f in feeds:
+            yield f
+            now = time.perf_counter()
+            periods.append(now - prev)
+            prev = now
+
+    scope = fluid.Scope()
+    off, on = [], []
+    led = None
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+
+        def epoch(**kw):
+            periods = []
+            exe.train_from_dataset(program=prog,
+                                   dataset=timed_feeds(periods),
+                                   fetch_list=[loss], **kw)
+            return sorted(periods)[len(periods) // 2]
+
+        def paired_tax():
+            # adjacent off/on epochs share the host's speed regime, so
+            # their ratio cancels drift; the median over rounds is the
+            # tax estimate (min-of-epochs is one lucky epoch, this is a
+            # consensus of paired comparisons)
+            ratios = sorted(b / a for a, b in zip(off, on))
+            return ratios[len(ratios) // 2] - 1.0
+
+        epoch()  # compile + settle state avals
+        for batch_no in range(3):
+            for _ in range(rounds):
+                off.append(epoch())
+                led = mtrain.StepPhaseLedger()
+                on.append(epoch(phase_ledger=led, watchdog=True))
+            if paired_tax() < 0.02:
+                break
+
+    snap = led.snapshot()
+    booked = sum(snap["phases"].values())
+    assert abs(booked - snap["wall_s"]) <= 0.01 * snap["wall_s"] + 1e-6, \
+        "ledger books off: %.6f booked vs %.6f wall" % (
+            booked, snap["wall_s"])
+
+    best_off, best_on = min(off), min(on)
+    tax = paired_tax()
+    assert tax < 0.02, "armed train-obs tax %.4f >= 2%%" % tax
+    return {
+        "metric": "train_obs_armed_tax_pct",
+        "value": round(tax * 100.0, 3),
+        "unit": "%",
+        "disarmed_steps_per_sec": round(1.0 / best_off, 2),
+        "armed_steps_per_sec": round(1.0 / best_on, 2),
+        "armed_device_execute_frac": round(
+            snap["fractions"].get("device_execute", 0.0), 4),
+        "steps": steps,
+        "rounds": rounds,
+        "layers": layers,
+        "dim": dim,
+        "batch": batch,
+        "platform": platform,
+    }
+
+
 def main():
     import sys
 
     sharded = "--sharded" in sys.argv[1:]
     sharded_train = "--sharded-train" in sys.argv[1:]
     checkpoint = "--checkpoint" in sys.argv[1:]
+    train_obs = "--train-obs" in sys.argv[1:]
     import bench_common
 
     if sharded or sharded_train or checkpoint:
@@ -508,6 +609,8 @@ def main():
     bench_common.configure_compile_cache(bench_common.HOME_CACHE_DIR)
     if checkpoint:
         bench_common.emit_result(run_checkpoint())
+    elif train_obs:
+        bench_common.emit_result(run_train_obs())
     elif sharded_train:
         bench_common.emit_result(run_sharded_train())
     else:

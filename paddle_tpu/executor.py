@@ -33,8 +33,10 @@ from paddle_tpu import framework
 from paddle_tpu import faults as _faults
 from paddle_tpu.core import lowering
 from paddle_tpu.core import types as core_types
+from paddle_tpu.monitor import events as _mon_events
 from paddle_tpu.monitor import registry as _mon_registry
 from paddle_tpu.monitor import spans as _mon_spans
+from paddle_tpu.monitor import train as _mon_train
 from paddle_tpu.scope import Scope, global_scope
 
 __all__ = ["Executor", "AsyncExecutor"]
@@ -126,6 +128,14 @@ _mon_registry.REGISTRY.counter_callback(
 _MON_DISPATCH_HIST = _mon_registry.REGISTRY.histogram(
     "executor_dispatch_overhead_seconds",
     "per-run host dispatch overhead (recorded under trace sessions)")
+# per-step train-loop distribution — always on (a train step is ms-scale
+# against a ~2us observe) with the epoch's trace id pinned as an
+# OpenMetrics exemplar, the same linkage mechanism as
+# serving_request_latency_seconds: a slow step surfaced in /trainz
+# points straight at its flight-recorded span tree
+_MON_TRAIN_STEP_HIST = _mon_registry.REGISTRY.histogram(
+    "executor_train_step_seconds",
+    "per-step train_from_dataset wall time (exemplar: epoch trace id)")
 
 
 def _as_fetch_name(f) -> str:
@@ -261,6 +271,16 @@ class Executor:
     last_restore_path = None
     last_restore_fallbacks = 0
     last_restore_stats = None
+    # training control tower (monitor/train.py): ``_train_ledger`` arms
+    # run()'s phase charges for the duration of one train_from_dataset
+    # epoch (one is-None gate on the disarmed path); the ``last_*``
+    # handles keep /trainz answering after the epoch ends
+    _train_ledger = None
+    _train_admin = None
+    _train_admin_thread = None
+    last_train_ledger = None
+    last_train_watchdog = None
+    last_train_log = None
 
     def __init__(self, place=None, plan_cache_capacity: Optional[int] = None,
                  jit_cache_capacity: Optional[int] = None,
@@ -376,6 +396,12 @@ class Executor:
         if _faults.active is not None:  # disarmed: one is-None gate
             _faults.active.faultpoint("executor.run")
         _rec = _mon_spans.recording()
+        # step-phase ledger (training control tower): disarmed runs pay
+        # this one is-None gate; armed runs open a window-exclusive
+        # device_execute window whose explicit h2d/ps_wait charges below
+        # subtract out, so no wall-clock second is attributed twice
+        _led = self._train_ledger
+        _led_tok = _led.window_begin() if _led is not None else None
         _t_run0 = time.perf_counter()
         compiled = None
         if program is not None and getattr(program, "_is_compiled_program", False):
@@ -462,8 +488,16 @@ class Executor:
         )
         ps_push = ()
         if dist_tables:
-            ps_push = self._prefetch_distributed_tables(
-                program, program.global_block(), feed, compiled=compiled)
+            if _led is None:
+                ps_push = self._prefetch_distributed_tables(
+                    program, program.global_block(), feed, compiled=compiled)
+            else:
+                # inline (non-overlapped) sparse pulls block right here —
+                # the ledger files them under ps_wait, not device_execute
+                _t_ps = time.perf_counter()
+                ps_push = self._prefetch_distributed_tables(
+                    program, program.global_block(), feed, compiled=compiled)
+                _led.charge("ps_wait", time.perf_counter() - _t_ps)
 
         plan = self._plans.get(plan_key) if use_program_cache else None
         if plan is not None:
@@ -505,7 +539,7 @@ class Executor:
         # pass through untouched — no host round-trip.  Dtype coercion
         # tables were resolved once at plan build.
         device = self._device_cached()
-        if _rec:
+        if _rec or _led is not None:
             _t0 = time.perf_counter()
         feed_arrays = {}
         np_dts, jax_dts = plan.feed_np_dtypes, plan.feed_jax_dtypes
@@ -521,6 +555,8 @@ class Executor:
                 continue
             arr = np.asarray(val, dtype=np_dts.get(name))  # hot-ok: host ndarray feed, not a device array
             feed_arrays[name] = jax.device_put(arr, device)
+        if _led is not None:
+            _led.charge("h2d", time.perf_counter() - _t0)
         if _rec:
             _mon_spans.record_span(
                 "executor/h2d_feed", _t0, time.perf_counter() - _t0,
@@ -751,8 +787,13 @@ class Executor:
             dense_ps["step"] += 1
             if not overlap:
                 min_v = dense_ps["step"] if dense_ps["sync"] else 0
+                _t_pd = time.perf_counter() if _led is not None else 0.0
                 for name in names:
                     scope.set(name, client.pull_dense(name, min_version=min_v))
+                if _led is not None:
+                    # the blocking (non-overlapped) dense pull is PS wire
+                    # wait, not device time
+                    _led.charge("ps_wait", time.perf_counter() - _t_pd)
         if ps_push:
             # mesh-resident tables: shard-wise device update, grad never
             # leaves HBM.  PS tables: async mode enqueues on the
@@ -806,6 +847,12 @@ class Executor:
                 _mon_spans.record_span(
                     "executor/d2h_fetch", _t0, time.perf_counter() - _t0,
                     cat="transfer", n_fetch=len(fetches))
+        if _led is not None:
+            # remainder of the run window = dispatch + jitted call + the
+            # d2h sync that realizes the device step (run() is async
+            # after dispatch; the np.asarray above is where device time
+            # becomes observable on this thread)
+            _led.window_end(_led_tok, "device_execute")
         return fetches
 
     # ------------------------------------------------------------------
@@ -977,6 +1024,9 @@ class Executor:
         stats = self._cache_stats
         stats["ps_pull_wait_s"] += wait
         stats["ps_pull_overlap_s"] += max(0.0, result.get("dur", 0.0) - wait)
+        led = self._train_ledger
+        if led is not None:
+            led.charge("ps_wait", wait)
         exc = result.get("exc")
         if exc is not None:
             raise exc
@@ -1089,6 +1139,11 @@ class Executor:
         stats = self._cache_stats
         stats["ps_pull_wait_s"] += wait
         stats["ps_pull_overlap_s"] += max(0.0, result.get("dur", 0.0) - wait)
+        led = self._train_ledger
+        if led is not None:
+            # the join runs inside the data_wait window (next(batches));
+            # window-exclusive accounting moves it into ps_wait
+            led.charge("ps_wait", wait)
         exc = result.get("exc")
         if exc is not None:
             raise exc
@@ -1493,7 +1548,8 @@ class Executor:
                            trainer_desc=None, trace_id=None,
                            checkpoint_dir=None, checkpoint_every=0,
                            checkpoint_epoch=0, resume_from=None,
-                           checkpoint_async=False):
+                           checkpoint_async=False, phase_ledger=None,
+                           watchdog=None, train_log=None):
         """Loop the dataset's batches through run() (reference:
         executor.py train_from_dataset -> C++ Trainer/DeviceWorker loop,
         trainer.h:38; here the compiled step is the device worker).
@@ -1528,7 +1584,24 @@ class Executor:
         ``executor/train_step`` span parented to one
         ``executor/train_epoch`` span — a training epoch is correlatable
         in ``/tracez``/the merged Chrome trace exactly like a serving
-        request."""
+        request.
+
+        Training control tower (monitor/train.py):
+        ``phase_ledger=True`` (or a ``StepPhaseLedger`` instance) arms
+        the step-phase ledger — every wall-clock second of the epoch is
+        attributed to data_wait / h2d / device_execute / ps_wait /
+        checkpoint / restore_fallback / other, exported as
+        ``train_phase_seconds_total{phase=}`` plus throughput and MFU
+        gauges, and asserted to sum to the elapsed time within 1%.
+        ``watchdog=True`` (or a ``TrainWatchdog``) runs EWMA + z-score
+        anomaly detection per step (NaN/Inf loss, loss spikes,
+        grad-norm blowups, step-time stragglers), emitting
+        ``train/anomaly`` events and raising ``TrainAnomalyError`` for
+        kinds in its ``halt_on``.  ``train_log=<path>`` streams one
+        JSONL record per step (phases, loss, anomalies, trace id),
+        replayable offline via ``monitor.train.replay_step_log`` /
+        ``train_top --replay``.  ``start_train_admin()`` serves it all
+        at ``/trainz``."""
         n_prefetch = int(thread)
         if trainer_desc is not None:
             worker = trainer_desc._worker
@@ -1553,6 +1626,27 @@ class Executor:
             and getattr(program, "_is_compiled_program", False) else None)
         prog_obj = compiled._program if compiled is not None else (
             program if program is not None else framework.default_main_program())
+        # training control tower: build/adopt the ledger, watchdog and
+        # step log for this epoch.  The ledger's epoch window opens HERE
+        # so a resume restore below is attributed (restore_fallback)
+        # inside the same wall-clock the 1% sum contract covers.
+        led = None
+        if phase_ledger:
+            led = (phase_ledger
+                   if isinstance(phase_ledger, _mon_train.StepPhaseLedger)
+                   else _mon_train.StepPhaseLedger())
+            self.last_train_ledger = led
+            led.begin_epoch()
+        wd = None
+        if watchdog:
+            wd = (watchdog
+                  if isinstance(watchdog, _mon_train.TrainWatchdog)
+                  else _mon_train.TrainWatchdog())
+            self.last_train_watchdog = wd
+        steplog = None
+        if train_log:
+            steplog = _mon_train.StepLog(train_log)
+            self.last_train_log = train_log
         # crash-resume: restore persistables + PS tables + the dataset
         # cursor BEFORE the first batch, then skip the consumed prefix
         ckpt = None
@@ -1573,10 +1667,13 @@ class Executor:
                 # to a different checkpoint_dir (fork-a-run semantics)
                 src = (ckpt if checkpoint_dir in (None, resume_from)
                        else TrainCheckpoint(resume_from))
+                _led_tok = led.window_begin() if led is not None else None
                 cursor = src.restore(
                     prog_obj, scope or global_scope(),
                     ps_client=getattr(prog_obj, "_ps_client", None),
                     compiled=compiled)
+                if _led_tok is not None:
+                    led.window_end(_led_tok, "restore_fallback")
                 # which checkpoint actually served (integrity fallback
                 # may have skipped corrupt/pruned ones — the drills and
                 # operators read these alongside last_resume_step)
@@ -1586,6 +1683,19 @@ class Executor:
                 if cursor is not None:
                     start_step = int(cursor.get("step", 0))
                     self.last_resume_step = start_step
+                # resume/fallback history belongs in /eventz and the
+                # step log, not stdout: one severity-tagged event per
+                # resume (warning when integrity fallbacks were taken)
+                _mon_events.emit(
+                    "train/resume",
+                    severity=("warning" if self.last_restore_fallbacks
+                              else "info"),
+                    message="resumed from %s at step %d (%d fallback(s))"
+                    % (self.last_restore_path, start_step,
+                       self.last_restore_fallbacks),
+                    cat="train", step=start_step,
+                    path=self.last_restore_path,
+                    fallbacks=self.last_restore_fallbacks)
         batches = iter(dataset)
         if start_step:
             import itertools as _itertools
@@ -1624,6 +1734,11 @@ class Executor:
                 and getattr(prog_obj, "_ps_communicator", None) is not None
                 and getattr(prog_obj, "_sparse_overlap", True)):
             batches = self._sparse_overlap_iter(prog_obj, batches)
+        if led is not None:
+            # data_wait attribution: each next() on the (possibly
+            # prefetch-wrapped) iterator, minus whatever the nested
+            # sparse-prefetch join already charged to ps_wait
+            batches = led.timed_iter(batches)
         # dense-PS async mode: overlap each step's host param pull with
         # the device compute (the pull thread runs while the chip works;
         # ps_pull_overlap_s counts the hidden seconds).  Sync mode keeps
@@ -1646,6 +1761,10 @@ class Executor:
         epoch_t0 = None
         n_steps = 0
         results = []
+        _monitoring = (led is not None or wd is not None
+                       or steplog is not None)
+        self._train_ledger = led  # arm run()'s phase charges (or clear)
+        _t_prev = time.perf_counter()
         try:
             for i, feed in enumerate(batches):
                 step = start_step + i  # global step (resume-aware cursor)
@@ -1667,21 +1786,104 @@ class Executor:
                 else:
                     out = self.run(program, feed=feed, fetch_list=fetch_list, scope=scope)
                 n_steps += 1
+                _t_now = time.perf_counter()
+                _dur = _t_now - _t_prev  # step period incl. data_wait
+                _t_prev = _t_now
+                _MON_TRAIN_STEP_HIST.observe(
+                    _dur, exemplar={"trace_id": tid})
                 if fetch_list:
                     results.append(out)
                     if debug and i % print_period == 0:
                         names = fetch_info or [ _as_fetch_name(f) for f in fetch_list]
+                        # stdout stays (the chaos drills parse it); the
+                        # event makes the same progress line scrapeable
+                        # via /eventz and the step log
                         print("batch %d:" % step, dict(zip(names, [np.asarray(o) for o in out])))
+                        _mon_events.emit(
+                            "train/progress", severity="info",
+                            message="batch %d: %s" % (step, {
+                                n: float(np.mean(v))
+                                for n, v in zip(names, out)
+                                if np.issubdtype(
+                                    np.asarray(v).dtype, np.number)
+                            }),
+                            cat="train", step=step)
+                if _monitoring:
+                    _ex = _mon_train.batch_examples(feed)
+                    loss_val = None
+                    if out and fetch_list:
+                        _li = wd.loss_index if wd is not None else 0
+                        try:
+                            loss_val = float(np.mean(out[_li]))
+                        except (TypeError, ValueError, IndexError):
+                            loss_val = None
+                    row = None
+                    if led is not None:
+                        if led.flops_per_step is None:
+                            # static-FLOPs MFU numerator, resolved once
+                            # against the first batch's leading dim
+                            led.flops_per_step = (
+                                _mon_train.estimate_block_flops(
+                                    prog_obj, batch=max(1, _ex)))
+                        row = led.step_done(
+                            step, _dur, examples=_ex, loss=loss_val)
+                    anomalies = ()
+                    if wd is not None:
+                        anomalies = wd.observe_step(
+                            step, loss=loss_val, step_time_s=_dur)
+                    if steplog is not None:
+                        rec = (dict(row) if row is not None
+                               else {"step": step,
+                                     "duration_s": round(_dur, 6),
+                                     "examples": _ex})
+                        if loss_val is not None and "loss" not in rec:
+                            rec["loss"] = loss_val
+                        if anomalies:
+                            rec["anomalies"] = list(anomalies)
+                        rec["trace_id"] = tid
+                        steplog.write(rec)
+                    if wd is not None and anomalies:
+                        # typed halt (TrainAnomalyError) for kinds in
+                        # halt_on — after the step is logged, so the
+                        # fatal step is in the record
+                        wd.raise_if_halt(anomalies)
                 if ckpt is not None and ckpt.should_save(step + 1):
+                    _led_tok = (led.window_begin()
+                                if led is not None else None)
                     self._train_checkpoint(
                         ckpt, prog_obj, scope or global_scope(),
                         step + 1, int(checkpoint_epoch), ps_ctx,
                         async_=bool(checkpoint_async), compiled=compiled)
+                    if _led_tok is not None:
+                        # foreground cost only: quiesce + (sync) write or
+                        # (async) copy-on-write snapshot.  The quiesce's
+                        # dense-pull join stays in ps_wait (exclusive
+                        # window) — checkpoint is the save itself.
+                        led.window_end(_led_tok, "checkpoint",
+                                       detail="sync")
             if ckpt is not None:
                 # commit the tail background save before returning (a
                 # write error surfaces here, on the epoch's own path)
+                _led_tok = led.window_begin() if led is not None else None
                 ckpt.wait()
+                if _led_tok is not None:
+                    # async-commit join: the tail of the background
+                    # serialization the step loop didn't hide
+                    led.window_end(_led_tok, "checkpoint",
+                                   detail="commit")
+            if led is not None:
+                # clean exit: close the ledger strictly — the remainder
+                # lands in `other` and the 1% sum contract is asserted
+                led.finish_epoch()
         finally:
+            self._train_ledger = None  # disarm run()'s phase charges
+            if led is not None:
+                # exceptional exit: close the ledger WITHOUT the sum
+                # assert (the epoch's own error must propagate; a
+                # partial ledger is still worth reading in /trainz)
+                led.finish_epoch(strict=False)
+            if steplog is not None:
+                steplog.close()
             if ckpt is not None and ckpt.in_flight:
                 # abnormal exit with a save still writing: join so the
                 # writer can't race teardown; the epoch's primary error
@@ -1739,6 +1941,33 @@ class Executor:
         saver(program, scope, step=step, epoch=epoch,
               ps_client=getattr(program, "_ps_client", None),
               compiled=compiled)
+
+    # ------------------------------------------------------------------
+    # training control tower: the trainer's scrapeable surface
+    # ------------------------------------------------------------------
+    def start_train_admin(self, host: str = "127.0.0.1", port: int = 0):
+        """Serve this trainer's observability surface over HTTP
+        (``port=0`` = ephemeral; returns the bound ``(host, port)``):
+        ``/metrics`` (Prometheus/OpenMetrics with exemplars),
+        ``/trainz`` (ledger snapshot + last-N step table + watchdog
+        state + checkpoint/resume history), ``/statusz``, ``/tracez``,
+        ``/eventz``, ``/healthz``.  The same document shapes the fleet
+        federation scraper consumes — register the returned address via
+        ``FleetBalancer.add_scrape_target`` and the trainer shows up in
+        the fleet pane next to the serving backends."""
+        return _mon_train.start_train_admin(self, host=host, port=port)
+
+    def stop_train_admin(self) -> None:
+        _mon_train.stop_train_admin(self)
+
+    @property
+    def train_admin_address(self):
+        srv = self._train_admin
+        return srv.server_address if srv is not None else None
+
+    def trainz(self):
+        """The ``/trainz`` document (see ``monitor.train.trainz_doc``)."""
+        return _mon_train.trainz_doc(self)
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,

@@ -65,6 +65,7 @@ from paddle_tpu.monitor import events as events
 from paddle_tpu.monitor import flight as _flight
 from paddle_tpu.monitor import slo as slo
 from paddle_tpu.monitor import spans as _spans
+from paddle_tpu.monitor import train as train
 from paddle_tpu.monitor.events import EventRing, eventz
 from paddle_tpu.monitor.events import emit as emit_event
 from paddle_tpu.monitor.flight import FlightRecorder, new_trace_id
@@ -103,7 +104,7 @@ __all__ = [
     "new_span_id", "parent_scope", "current_parent",
     "new_trace_id", "flight_recorder", "FlightRecorder",
     "events", "EventRing", "emit_event", "eventz",
-    "slo",
+    "slo", "train",
     "parse_exposition", "relabel_exposition", "merge_expositions",
     "push_gateway", "PushGateway",
     "export_chrome_trace", "trace_session", "TraceSession",

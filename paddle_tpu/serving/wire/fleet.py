@@ -286,6 +286,12 @@ class FleetBalancer:
         self._scrape_interval_s = float(scrape_interval_s)
         self._scrape_lock = threading.Lock()
         self._scrapes: Dict[int, Dict[str, object]] = {}
+        # scrape-only children (add_scrape_target): federated into the
+        # admin surface but never routed to — how a TRAINING admin
+        # (Executor.start_train_admin) joins the fleet's pane of glass.
+        # Negative idx keys them into _scrapes without colliding with
+        # routing backends.
+        self._scrape_only: List[_Backend] = []
         self._scrape_ok = FEDERATION_SCRAPES.labels(fleet=name, status="ok")
         self._scrape_err = FEDERATION_SCRAPES.labels(
             fleet=name, status="error")
@@ -334,6 +340,23 @@ class FleetBalancer:
                 HttpTransport(host, port, timeout_s=self._timeout_s))
         self._backends.append(be)
         return be
+
+    def add_scrape_target(self, name: str, address) -> None:
+        """Register a scrape-ONLY child: its ``/metrics`` ``/statusz``
+        ``/tracez`` ``/eventz`` surfaces federate into this balancer's
+        admin endpoints under ``backend=<name>``, but it never receives
+        routed inference traffic or health-gated retirement.  This is
+        how a trainer (``Executor.start_train_admin``) shows up in the
+        same pane of glass as the serving backends.  ``address`` is a
+        ``(host, port)`` tuple (e.g. the value ``start_train_admin``
+        returned)."""
+        host, port = address
+        be = _Backend(
+            -1, str(name),
+            HttpTransport(host, int(port), timeout_s=self._timeout_s))
+        with self._route_cv:
+            be.idx = -(len(self._scrape_only) + 1)
+            self._scrape_only.append(be)
 
     # ------------------------------------------------------------------
     @property
@@ -1202,6 +1225,7 @@ class FleetBalancer:
         worst-case staleness gauge."""
         with self._route_cv:
             targets = [b for b in self._backends if b.alive]
+            targets.extend(self._scrape_only)
         now = time.monotonic()
         for be in targets:
             with self._scrape_lock:
@@ -1515,6 +1539,8 @@ class FleetBalancer:
                 if be.handle is not None:
                     be.handle.shutdown(timeout_s=timeout_s)
         for be in self._backends:
+            be.transport.close()
+        for be in self._scrape_only:
             be.transport.close()
         self._metrics.close()
 

@@ -1,0 +1,139 @@
+"""Chaos drills for the training control tower (ISSUE 20): the
+anomaly watchdog against a genuinely poisoned run (a NaN batch must
+halt the epoch typed, with the critical ``train/anomaly`` event and
+the fatal step in the step log), and the step-phase ledger against an
+armed ``ps.pull`` delay (the injected stall must be attributed to
+``ps_wait`` — not smeared into ``device_execute`` — or every "the PS
+is slow" diagnosis the ledger exists for would be wrong).
+"""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import faults, framework
+from paddle_tpu.distributed.ps import ParameterServer
+from paddle_tpu.monitor import events as mon_events
+from paddle_tpu.monitor import train as mtrain
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    yield
+    faults.disarm()
+
+
+# ---------------------------------------------------------------------------
+# NaN-loss drill: poisoned batch -> typed halt, critical event, logged step
+# ---------------------------------------------------------------------------
+def test_nan_loss_drill_halts_typed_with_critical_event(tmp_path):
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 41
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [6])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+    rng = np.random.RandomState(5)
+    feeds = [
+        {"x": rng.randn(4, 6).astype("float32"),
+         "y": rng.randn(4, 1).astype("float32")}
+        for _ in range(10)
+    ]
+    feeds[6]["x"][:] = np.inf  # the poison: loss goes non-finite at step 6
+    log = str(tmp_path / "drill.jsonl")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(mtrain.TrainAnomalyError) as ei:
+            exe.train_from_dataset(
+                program=prog, dataset=feeds, scope=scope,
+                fetch_list=[loss], phase_ledger=True, watchdog=True,
+                train_log=log)
+    assert ei.value.kind == "nan_loss" and ei.value.step == 6
+
+    # the controller-facing surfaces all tell the same story:
+    # 1. /trainz — the watchdog pinned the halt
+    doc = exe.trainz()
+    assert doc["watchdog"]["halted"]["kind"] == "nan_loss"
+    assert doc["watchdog"]["halted"]["step"] == 6
+    # 2. /eventz — the critical train/anomaly event is in the ring
+    evs = [e for e in mon_events.eventz()["events"]
+           if e.get("kind") == "train/anomaly"
+           and e.get("anomaly") == "nan_loss" and e.get("step") == 6]
+    assert evs and evs[-1]["severity"] == "critical"
+    # 3. the step log — the fatal step was written BEFORE the raise,
+    #    anomaly attached, so a postmortem replay sees it
+    rows = [json.loads(l) for l in open(log) if l.strip()]
+    assert rows[-1]["step"] == 6
+    assert [a["kind"] for a in rows[-1]["anomalies"]] == ["nan_loss"]
+    rep = mtrain.replay_step_log(log)
+    assert rep["anomalies"] and rep["anomalies"][-1]["kind"] == "nan_loss"
+    # 4. the partial ledger stayed readable (non-strict close) and the
+    #    executor disarmed its hot-path gate on the way out
+    assert exe.last_train_ledger.snapshot()["finished"]
+    assert exe.last_train_ledger.snapshot()["n_steps"] == 7  # steps 0..6
+    assert exe._train_ledger is None
+
+
+# ---------------------------------------------------------------------------
+# ps.pull delay drill: the stall lands in ps_wait, not device_execute
+# ---------------------------------------------------------------------------
+def test_ps_pull_delay_is_attributed_to_ps_wait_not_device():
+    V, B, N, DELAY = 50, 8, 6, 0.05
+    server = ParameterServer().start()
+    try:
+        prog, startup = framework.Program(), framework.Program()
+        prog.random_seed = startup.random_seed = 43
+        with framework.program_guard(prog, startup):
+            ids = fluid.layers.data("ids", [1], dtype="int64")
+            y = fluid.layers.data("y", [1])
+            emb = fluid.layers.embedding(
+                ids, [V, 4], is_sparse=True, is_distributed=True,
+                param_attr=fluid.ParamAttr(name="obs_tbl"))
+            pred = fluid.layers.fc(emb, 1, name="head")
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+        # sync mode: every step pulls its rows inline on the hot path —
+        # exactly the pulls the ledger must bill to ps_wait
+        fluid.distributed.bind_distributed_tables(
+            prog, [server.endpoint], optimizer="sgd", lr=0.1,
+            initializer="zeros", async_mode=False)
+        rng = np.random.RandomState(7)
+        feeds = [
+            {"ids": rng.randint(0, V, (B, 1)).astype("int64"),
+             "y": rng.randn(B, 1).astype("float32")}
+            for _ in range(N)
+        ]
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            # baseline epoch (warm caches, jit) — then the delayed one
+            exe.train_from_dataset(program=prog, dataset=feeds,
+                                   scope=scope, fetch_list=[loss],
+                                   phase_ledger=True)
+            base = exe.last_train_ledger.snapshot()["phases"]
+            with faults.armed("ps.pull=delay:%g" % DELAY):
+                out = exe.train_from_dataset(
+                    program=prog, dataset=feeds, scope=scope,
+                    fetch_list=[loss], phase_ledger=True)
+                pulls = faults.active.triggers().get("ps.pull", 0)
+        assert len(out) == N and pulls >= N
+        snap = exe.last_train_ledger.snapshot()
+        injected = pulls * DELAY
+        # the injected stall is billed to ps_wait...
+        assert snap["phases"]["ps_wait"] >= 0.8 * injected
+        # ...and did NOT leak into device_execute (stays near baseline,
+        # nowhere near the injected seconds)
+        assert snap["phases"]["device_execute"] < (
+            base["device_execute"] + 0.5 * injected)
+        # books still balance under fault injection
+        total = sum(snap["phases"].values())
+        assert abs(total - snap["wall_s"]) <= 0.01 * snap["wall_s"] + 1e-6
+    finally:
+        server.stop()

@@ -809,3 +809,73 @@ def test_fleet_top_once_renders_a_live_fleet():
         fleet.stop()
         for sp in sps:
             sp.stop()
+
+
+def test_train_top_once_renders_a_live_training_run(tmp_path):
+    """``tools/train_top.py --once`` against a REAL trainer's admin
+    tier (phase bars, throughput, watchdog, step table), plus the
+    offline ``--replay`` mode over the run's step log — the training
+    console's CI smoke (PR 20)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import train_top
+
+    from paddle_tpu import framework
+
+    prog, startup = framework.Program(), framework.Program()
+    prog.random_seed = startup.random_seed = 27
+    with framework.program_guard(prog, startup):
+        x = fluid.layers.data("x", [6])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+    rng = np.random.RandomState(9)
+    feeds = [
+        {"x": rng.randn(4, 6).astype("float32"),
+         "y": rng.randn(4, 1).astype("float32")}
+        for _ in range(6)
+    ]
+    log = str(tmp_path / "steps.jsonl")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.train_from_dataset(
+            program=prog, dataset=feeds, scope=scope, fetch_list=[loss],
+            phase_ledger=True, watchdog=True, train_log=log)
+    addr = exe.start_train_admin(port=0)
+    try:
+        out = _io.StringIO()
+        real = sys.stdout
+        sys.stdout = out
+        try:
+            rc = train_top.main(
+                ["%s:%d" % addr, "--once", "--no-color"])
+        finally:
+            sys.stdout = real
+        frame = out.getvalue()
+        assert rc == 0
+        assert "PHASE" in frame and "device_execute" in frame
+        assert "WATCHDOG" in frame and "throughput" in frame
+        assert "STEP" in frame  # the per-step table rendered
+
+        # offline replay of the same run's step log, no server needed
+        out = _io.StringIO()
+        sys.stdout = out
+        try:
+            rc = train_top.main(["--replay", log, "--no-color"])
+        finally:
+            sys.stdout = real
+        replay = out.getvalue()
+        assert rc == 0
+        assert "PHASE" in replay and "steps 6" in replay
+
+        # a dead admin address exits 1, not a traceback
+        assert train_top.main(
+            ["127.0.0.1:1", "--once", "--no-color"]) == 1
+    finally:
+        exe.stop_train_admin()

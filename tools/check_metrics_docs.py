@@ -44,6 +44,7 @@ REGISTERING_MODULES = [
     "paddle_tpu.serving.embedding_cache",
     "paddle_tpu.serving.prefix_cache",
     "paddle_tpu.serving.speculative",
+    "paddle_tpu.monitor.train",
 ]
 
 # README table rows look like ``| `metric_name` | type | ... |``
